@@ -1,0 +1,469 @@
+"""Controller: the cluster control plane (GCS-equivalent).
+
+Reference parity: src/ray/gcs/gcs_server/gcs_server.h — node membership,
+actor directory (gcs_actor_manager.h) with max_restarts handling, named
+actors, internal KV (kv_manager), pubsub broker, and the cluster-wide task
+scheduler (a centralized stand-in for the reference's distributed
+lease-based dispatch; daemons still own local worker pools).
+
+Runs inside the head process's event loop; daemons and clients reach it
+over its RpcServer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .protocol import ClientPool, RpcServer
+from ..exceptions import ActorDiedError, InfeasibleResourceError, TaskError
+
+logger = logging.getLogger(__name__)
+
+
+class NodeEntry:
+    __slots__ = ("node_id", "addr", "resources_total", "resources_avail",
+                 "labels", "alive", "num_running", "last_heartbeat")
+
+    def __init__(self, node_id: str, addr: Tuple[str, int],
+                 resources: Dict[str, float], labels: Dict[str, str]):
+        self.node_id = node_id
+        self.addr = tuple(addr)
+        self.resources_total = dict(resources)
+        self.resources_avail = dict(resources)
+        self.labels = labels or {}
+        self.alive = True
+        self.num_running = 0
+        self.last_heartbeat = time.monotonic()
+
+    def fits(self, req: Dict[str, float]) -> bool:
+        for k, v in req.items():
+            if self.resources_avail.get(k, 0.0) + 1e-9 < v:
+                return False
+        return True
+
+    def feasible(self, req: Dict[str, float]) -> bool:
+        for k, v in req.items():
+            if self.resources_total.get(k, 0.0) + 1e-9 < v:
+                return False
+        return True
+
+    def acquire(self, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            self.resources_avail[k] = self.resources_avail.get(k, 0.0) - v
+        self.num_running += 1
+
+    def release(self, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            self.resources_avail[k] = min(
+                self.resources_total.get(k, 0.0),
+                self.resources_avail.get(k, 0.0) + v)
+        self.num_running = max(0, self.num_running - 1)
+
+    def utilization(self) -> float:
+        fracs = []
+        for k, total in self.resources_total.items():
+            if total > 0:
+                fracs.append(1.0 - self.resources_avail.get(k, 0.0) / total)
+        return max(fracs) if fracs else 0.0
+
+
+class ActorEntry:
+    __slots__ = ("actor_id", "name", "namespace", "state", "addr", "node_id",
+                 "worker_id", "creation_spec", "max_restarts", "restarts",
+                 "death_cause", "waiters", "lifetime")
+
+    def __init__(self, actor_id: str, spec: dict):
+        self.actor_id = actor_id
+        self.name = spec.get("actor_name")
+        self.namespace = spec.get("namespace", "default")
+        self.state = "PENDING"          # PENDING -> ALIVE -> RESTARTING/DEAD
+        self.addr: Optional[Tuple[str, int]] = None
+        self.node_id: Optional[str] = None
+        self.worker_id: Optional[str] = None
+        self.creation_spec = spec
+        self.max_restarts = spec.get("max_restarts", 0)
+        self.restarts = 0
+        self.death_cause = ""
+        self.lifetime = spec.get("lifetime")
+        self.waiters: List[asyncio.Event] = []
+
+
+class Controller:
+    def __init__(self, session_name: str):
+        self.session_name = session_name
+        self.server = RpcServer()
+        self.server.register_object(self)
+        self.pool = ClientPool()
+        self.address: Optional[Tuple[str, int]] = None
+        self.nodes: Dict[str, NodeEntry] = {}
+        self.actors: Dict[str, ActorEntry] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.subscribers: Dict[str, List[Tuple[str, int]]] = {}
+        self.pending: List[dict] = []          # specs waiting for resources
+        # task_id -> (node_id, resources, spec)
+        self.running: Dict[str, Tuple[str, Dict[str, float], dict]] = {}
+        self.node_timeout_s = 10.0
+        self.placement_groups: Dict[str, Any] = {}
+        self._sched_event = asyncio.Event()
+        self._sched_task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.address = await self.server.start(host, port)
+        self._sched_task = asyncio.ensure_future(self._schedule_loop())
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        return self.address
+
+    async def stop(self):
+        self._closed = True
+        if self._sched_task:
+            self._sched_task.cancel()
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.stop()
+        await self.pool.close_all()
+
+    # ------------------------------------------------------------- nodes
+
+    async def rpc_register_node(self, node_id: str, addr, resources,
+                                labels=None) -> dict:
+        self.nodes[node_id] = NodeEntry(node_id, addr, resources, labels)
+        logger.info("node %s registered at %s with %s",
+                    node_id[:8], addr, resources)
+        self._sched_event.set()
+        return {"session_name": self.session_name}
+
+    async def rpc_unregister_node(self, node_id: str) -> None:
+        node = self.nodes.get(node_id)
+        if node:
+            node.alive = False
+            await self._on_node_death(node_id)
+
+    async def rpc_heartbeat(self, node_id: str, num_workers: int = 0) -> None:
+        node = self.nodes.get(node_id)
+        if node:
+            node.last_heartbeat = time.monotonic()
+
+    async def _on_node_death(self, node_id: str) -> None:
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state == "ALIVE":
+                await self._handle_actor_death(
+                    actor.actor_id, f"node {node_id[:8]} died")
+        # Fail in-flight normal tasks on the node; owners may retry.
+        from ..exceptions import WorkerCrashedError
+        for task_id, (nid, req, spec) in list(self.running.items()):
+            if nid == node_id and not spec.get("is_actor_creation"):
+                self.running.pop(task_id, None)
+                await self._fail_task(spec, WorkerCrashedError(
+                    f"node {node_id[:8]} died while running task"))
+        self._sched_event.set()
+
+    async def _health_loop(self) -> None:
+        """Node failure detector (reference parity:
+        src/ray/gcs/gcs_server/gcs_health_check_manager.h:45)."""
+        while not self._closed:
+            await asyncio.sleep(2.0)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > self.node_timeout_s:
+                    logger.warning("node %s missed heartbeats for %.0fs; "
+                                   "marking dead", node.node_id[:8],
+                                   now - node.last_heartbeat)
+                    node.alive = False
+                    await self._on_node_death(node.node_id)
+
+    async def rpc_list_nodes(self) -> List[dict]:
+        return [{
+            "node_id": n.node_id, "addr": n.addr, "alive": n.alive,
+            "resources_total": n.resources_total,
+            "resources_available": n.resources_avail,
+            "labels": n.labels,
+        } for n in self.nodes.values()]
+
+    async def rpc_cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources_total.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    async def rpc_available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources_avail.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    # ---------------------------------------------------------- scheduling
+
+    async def rpc_submit_task(self, spec: dict) -> dict:
+        if spec.get("is_actor_creation") and spec.get("actor_name") \
+                and not spec.get("is_restart"):
+            key = (spec.get("namespace", "default"), spec["actor_name"])
+            if key in self.named_actors:
+                await self._fail_task(spec, ValueError(
+                    f"actor name {spec['actor_name']!r} already taken "
+                    f"in namespace {key[0]!r}"))
+                return {"status": "rejected"}
+            self.named_actors[key] = spec["actor_id"]
+        self.pending.append(spec)
+        self._sched_event.set()
+        return {"status": "queued"}
+
+    async def _schedule_loop(self) -> None:
+        while not self._closed:
+            await self._sched_event.wait()
+            self._sched_event.clear()
+            await self._pump()
+
+    async def _pump(self) -> None:
+        still_pending: List[dict] = []
+        for spec in self.pending:
+            placed = await self._try_place(spec)
+            if placed is None:
+                still_pending.append(spec)
+        self.pending = still_pending
+
+    async def _try_place(self, spec: dict) -> Optional[str]:
+        req = dict(spec.get("resources") or {})
+        strategy = spec.get("scheduling") or {}
+        candidates = [n for n in self.nodes.values() if n.alive]
+        if strategy.get("type") == "node_affinity":
+            target = [n for n in candidates
+                      if n.node_id == strategy.get("node_id")]
+            if not target and not strategy.get("soft"):
+                await self._fail_task(
+                    spec, InfeasibleResourceError(
+                        f"node {strategy.get('node_id')} not found"))
+                return "failed"
+            if target:
+                candidates = target
+        pg = strategy.get("placement_group")
+        if pg is not None:
+            node_id, bundle_res = self._resolve_bundle(
+                pg, strategy.get("bundle_index", -1), req)
+            if node_id == "__pending__":
+                return None
+            if node_id is None:
+                await self._fail_task(spec, InfeasibleResourceError(
+                    f"placement group {pg} unavailable"))
+                return "failed"
+            candidates = [n for n in candidates if n.node_id == node_id]
+        if not any(n.feasible(req) for n in candidates):
+            if all(not n.feasible(req) for n in self.nodes.values() if n.alive):
+                await self._fail_task(spec, InfeasibleResourceError(
+                    f"no node can ever satisfy {req} "
+                    f"(cluster: {await self.rpc_cluster_resources()})"))
+                return "failed"
+            return None
+        fitting = [n for n in candidates if n.fits(req)]
+        if not fitting:
+            return None
+        # Hybrid-lite: pack onto the most-utilized node below 50% utilization,
+        # else spread to the least utilized (reference:
+        # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:61).
+        below = [n for n in fitting if n.utilization() < 0.5]
+        if below:
+            node = max(below, key=lambda n: n.utilization())
+        else:
+            node = min(fitting, key=lambda n: n.utilization())
+        node.acquire(req)
+        self.running[spec["task_id"]] = (node.node_id, req, spec)
+        if spec.get("is_actor_creation"):
+            self._register_pending_actor(spec, node.node_id)
+        try:
+            await self.pool.get(node.addr).call("execute_task", spec=spec)
+        except Exception as e:
+            logger.warning("dispatch to node %s failed: %r", node.node_id[:8], e)
+            node.release(req)
+            self.running.pop(spec["task_id"], None)
+            node.alive = False
+            await self._on_node_death(node.node_id)
+            self.pending.append(spec)
+            self._sched_event.set()
+            return None
+        return node.node_id
+
+    def _resolve_bundle(self, pg_id: str, bundle_index: int, req: dict):
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return None, None
+        return pg.resolve_bundle(bundle_index, req)
+
+    async def _fail_task(self, spec: dict, error: Exception) -> None:
+        try:
+            await self.pool.get(spec["owner_addr"]).oneway(
+                "object_ready", object_id=spec["return_id"], error=error,
+                task_id=spec["task_id"])
+        except Exception:
+            pass
+
+    async def rpc_task_finished(self, task_id: str, node_id: str) -> None:
+        entry = self.running.pop(task_id, None)
+        if entry is not None:
+            node = self.nodes.get(entry[0])
+            if node is not None:
+                node.release(entry[1])
+        self._sched_event.set()
+
+    # -------------------------------------------------------------- actors
+
+    def _register_pending_actor(self, spec: dict, node_id: str) -> None:
+        # Name uniqueness was checked/claimed at submission (rpc_submit_task).
+        actor_id = spec["actor_id"]
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            entry = ActorEntry(actor_id, spec)
+            self.actors[actor_id] = entry
+        entry.node_id = node_id
+
+    async def rpc_actor_started(self, actor_id: str, addr,
+                                worker_id: str) -> None:
+        entry = self.actors.get(actor_id)
+        if entry is None or entry.state == "DEAD":
+            return  # never resurrect a DEAD actor (e.g. killed mid-restart)
+        entry.addr = tuple(addr)
+        entry.worker_id = worker_id
+        entry.state = "ALIVE"
+        for ev in entry.waiters:
+            ev.set()
+        entry.waiters.clear()
+
+    async def rpc_actor_creation_failed(self, actor_id: str,
+                                        reason: str) -> None:
+        await self._handle_actor_death(actor_id, reason, restartable=False)
+
+    async def rpc_actor_died(self, actor_id: str, reason: str) -> None:
+        await self._handle_actor_death(actor_id, reason)
+
+    async def _handle_actor_death(self, actor_id: str, reason: str,
+                                  restartable: bool = True) -> None:
+        entry = self.actors.get(actor_id)
+        if entry is None or entry.state == "DEAD":
+            return
+        # Release creation-task resources tied to this actor.
+        task_id = entry.creation_spec.get("task_id")
+        await self.rpc_task_finished(task_id, entry.node_id or "")
+        if restartable and entry.restarts < entry.max_restarts:
+            entry.restarts += 1
+            entry.state = "RESTARTING"
+            entry.addr = None
+            logger.info("restarting actor %s (%d/%d): %s", actor_id[:8],
+                        entry.restarts, entry.max_restarts, reason)
+            spec = dict(entry.creation_spec)
+            spec["is_restart"] = True
+            self.pending.append(spec)
+            self._sched_event.set()
+        else:
+            entry.state = "DEAD"
+            entry.death_cause = reason
+            for ev in entry.waiters:
+                ev.set()
+            entry.waiters.clear()
+            if entry.name:
+                self.named_actors.pop((entry.namespace, entry.name), None)
+
+    async def rpc_get_actor_info(self, actor_id: str,
+                                 wait: bool = True) -> Optional[dict]:
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            # May not be registered yet (submit in flight): wait briefly.
+            if wait:
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    entry = self.actors.get(actor_id)
+                    if entry is not None:
+                        break
+            if entry is None:
+                return None
+        while wait and entry.state in ("PENDING", "RESTARTING"):
+            ev = asyncio.Event()
+            entry.waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=120.0)
+            except asyncio.TimeoutError:
+                return {"state": entry.state, "addr": None,
+                        "death_cause": "timeout waiting for actor start"}
+        return {"state": entry.state, "addr": entry.addr,
+                "node_id": entry.node_id, "worker_id": entry.worker_id,
+                "death_cause": entry.death_cause, "name": entry.name}
+
+    async def rpc_get_named_actor(self, name: str,
+                                  namespace: str = "default") -> Optional[dict]:
+        actor_id = self.named_actors.get((namespace, name))
+        if actor_id is None:
+            return None
+        info = await self.rpc_get_actor_info(actor_id, wait=True)
+        if info is None or info.get("state") == "DEAD":
+            return None
+        info["actor_id"] = actor_id
+        return info
+
+    async def rpc_kill_actor(self, actor_id: str,
+                             no_restart: bool = True) -> bool:
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            return False
+        if no_restart:
+            entry.max_restarts = entry.restarts  # disable further restarts
+        if entry.state == "ALIVE" and entry.node_id:
+            node = self.nodes.get(entry.node_id)
+            if node is not None:
+                try:
+                    await self.pool.get(node.addr).call(
+                        "kill_actor_worker", actor_id=actor_id)
+                except Exception:
+                    pass
+        await self._handle_actor_death(
+            actor_id, "killed via ray_tpu.kill()", restartable=not no_restart)
+        return True
+
+    async def rpc_list_actors(self) -> List[dict]:
+        return [{
+            "actor_id": a.actor_id, "name": a.name, "namespace": a.namespace,
+            "state": a.state, "node_id": a.node_id, "restarts": a.restarts,
+            "class_name": a.creation_spec.get("class_name"),
+            "death_cause": a.death_cause,
+        } for a in self.actors.values()]
+
+    # ------------------------------------------------------------------ kv
+
+    async def rpc_kv_put(self, key: str, value: bytes,
+                         overwrite: bool = True) -> bool:
+        if not overwrite and key in self.kv:
+            return False
+        self.kv[key] = value
+        return True
+
+    async def rpc_kv_get(self, key: str) -> Optional[bytes]:
+        return self.kv.get(key)
+
+    async def rpc_kv_del(self, key: str) -> bool:
+        return self.kv.pop(key, None) is not None
+
+    async def rpc_kv_keys(self, prefix: str = "") -> List[str]:
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # -------------------------------------------------------------- pubsub
+
+    async def rpc_subscribe(self, topic: str, addr) -> None:
+        self.subscribers.setdefault(topic, []).append(tuple(addr))
+
+    async def rpc_publish(self, topic: str, message) -> int:
+        subs = self.subscribers.get(topic, [])
+        delivered = 0
+        for addr in list(subs):
+            try:
+                await self.pool.get(addr).oneway(
+                    "pubsub_message", topic=topic, message=message)
+                delivered += 1
+            except Exception:
+                subs.remove(addr)
+        return delivered

@@ -1,0 +1,726 @@
+"""CoreClient: the per-process runtime used by drivers AND workers.
+
+Reference parity: src/ray/core_worker/core_worker.h (SubmitTask/CreateActor/
+SubmitActorTask/Get/Put/Wait) + reference_count.h, collapsed into one
+asyncio-native Python class. Key design choices (deliberately different from
+the reference's C++ lease-based dispatch):
+
+- Ownership: the submitting process owns task results. Workers push results
+  directly to the owner's RPC server (small inline, large as a shm location),
+  so `get` never touches the control plane.
+- Actor calls go direct caller->actor-worker over a cached connection (one
+  RTT, result inline in the response) — the controller is only consulted to
+  resolve the actor's address. This is the reference's ActorTaskSubmitter
+  fast path (transport/actor_task_submitter.h).
+- Normal tasks go through the controller's scheduler (centralized for now;
+  the lease-reuse optimization lives in the daemon's worker pool).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import state
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .object_store import (MemoryStore, ShmLocation, read_from_shm,
+                           write_to_shm)
+from .protocol import ClientPool, ConnectionLost, RpcServer
+from .serialization import (INLINE_OBJECT_LIMIT, SerializedObject,
+                            serialize, serialize_code)
+from ..exceptions import (ActorDiedError, ActorError, GetTimeoutError,
+                          ObjectLostError, RayTpuError, TaskCancelledError,
+                          TaskError, WorkerCrashedError)
+
+logger = logging.getLogger(__name__)
+
+
+class LoopRunner:
+    """An asyncio loop, either owned (background thread) or external."""
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        if loop is not None:
+            self.loop = loop
+            self._thread = None
+        else:
+            self.loop = asyncio.new_event_loop()
+            started = threading.Event()
+
+            def _run():
+                asyncio.set_event_loop(self.loop)
+                self.loop.call_soon(started.set)
+                self.loop.run_forever()
+
+            self._thread = threading.Thread(target=_run, daemon=True,
+                                            name="ray_tpu-io")
+            self._thread.start()
+            started.wait()
+
+    def run_sync(self, coro, timeout: Optional[float] = None):
+        if self.on_loop_thread():
+            raise RuntimeError(
+                "blocking ray_tpu API called from the event-loop thread; "
+                "inside async actors use `await ref` / the aio_* APIs.")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            raise GetTimeoutError("operation timed out")
+
+    def call_soon(self, coro) -> None:
+        try:
+            asyncio.run_coroutine_threadsafe(coro, self.loop)
+        except RuntimeError:
+            pass  # loop already closed (interpreter shutdown)
+
+    def on_loop_thread(self) -> bool:
+        try:
+            return asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            return False
+
+    def stop(self):
+        if self._thread is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(timeout=5)
+
+
+class ReferenceCounter:
+    """Distributed ref counting (owner-side authoritative).
+
+    Reference parity: src/ray/core_worker/reference_count.h — simplified to
+    local counts + a borrower count maintained at the owner.
+    """
+
+    def __init__(self, client: "CoreClient"):
+        self._client = client
+        self._local: Dict[str, int] = {}
+        self._owner_of: Dict[str, Optional[Tuple[str, int]]] = {}
+        self._borrowers: Dict[str, int] = {}   # owner side: remote holders
+        self._owned: Dict[str, bool] = {}      # ids this process owns
+        self._lock = threading.Lock()
+
+    def register_owned(self, object_id: str) -> None:
+        with self._lock:
+            self._owned[object_id] = True
+            self._borrowers.setdefault(object_id, 0)
+
+    def add_local_ref(self, object_id: str, owner_addr, borrowed: bool) -> None:
+        notify = False
+        with self._lock:
+            n = self._local.get(object_id, 0)
+            self._local[object_id] = n + 1
+            self._owner_of[object_id] = owner_addr
+            if borrowed and n == 0 and not self._owned.get(object_id):
+                notify = True
+        if notify and owner_addr and not self._client.is_shutdown:
+            self._client.loop_runner.call_soon(
+                self._client._send_ref_event(owner_addr, object_id, +1))
+
+    def remove_local_ref(self, object_id: str) -> None:
+        if self._client is None or self._client.is_shutdown:
+            return
+        free = notify = False
+        owner_addr = None
+        with self._lock:
+            n = self._local.get(object_id, 0) - 1
+            if n <= 0:
+                self._local.pop(object_id, None)
+                owner_addr = self._owner_of.pop(object_id, None)
+                if self._owned.get(object_id):
+                    if self._borrowers.get(object_id, 0) <= 0:
+                        free = True
+                else:
+                    notify = True
+            else:
+                self._local[object_id] = n
+        if notify and owner_addr:
+            self._client.loop_runner.call_soon(
+                self._client._send_ref_event(owner_addr, object_id, -1))
+        if free:
+            self._client._free_owned(object_id)
+
+    def on_borrower_event(self, object_id: str, delta: int) -> None:
+        free = False
+        with self._lock:
+            n = self._borrowers.get(object_id, 0) + delta
+            self._borrowers[object_id] = n
+            if (n <= 0 and self._owned.get(object_id)
+                    and self._local.get(object_id, 0) == 0):
+                free = True
+        if free:
+            self._client._free_owned(object_id)
+
+    def pin(self, object_id: str) -> None:
+        """Prevent freeing while e.g. a task holding this arg is in flight."""
+        with self._lock:
+            self._borrowers[object_id] = self._borrowers.get(object_id, 0) + 1
+
+    def unpin(self, object_id: str) -> None:
+        self.on_borrower_event(object_id, -1)
+
+
+class PendingTask:
+    __slots__ = ("spec", "retries_left", "arg_ids")
+
+    def __init__(self, spec: dict, retries_left: int, arg_ids=()):
+        self.spec = spec
+        self.retries_left = retries_left
+        self.arg_ids = tuple(arg_ids)
+
+
+class CoreClient:
+    """Per-process runtime: submission, ownership, object access."""
+
+    def __init__(self, controller_addr: Tuple[str, int],
+                 node_addr: Optional[Tuple[str, int]],
+                 session_name: str,
+                 loop_runner: Optional[LoopRunner] = None,
+                 worker_id: Optional[str] = None,
+                 job_id: Optional[str] = None,
+                 namespace: str = "default"):
+        self.controller_addr = tuple(controller_addr)
+        self.node_addr = tuple(node_addr) if node_addr else None
+        self.session_name = session_name
+        self.namespace = namespace
+        self.worker_id = worker_id or WorkerID.generate().hex()
+        self.job_id = job_id
+        self.loop_runner = loop_runner or LoopRunner()
+        self.memory_store = MemoryStore()
+        self.ref_counter = ReferenceCounter(self)
+        self.pool = ClientPool()
+        self.server = RpcServer()
+        self.server.register_object(self)
+        self.address: Optional[Tuple[str, int]] = None
+        self.is_shutdown = False
+        self._pending_tasks: Dict[str, PendingTask] = {}
+        # actor_id -> (addr or None, generation); cached resolution
+        self._actor_addrs: Dict[str, Tuple[str, int]] = {}
+        # Per-actor submission sequence numbers (ordering guarantee like the
+        # reference's SequentialActorSubmitQueue).
+        self._actor_seq: Dict[str, int] = {}
+        self._actor_seq_lock = threading.Lock()
+        self._actor_resolve_locks: Dict[str, asyncio.Lock] = {}
+        self._shm_keepalive: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def async_start(self) -> None:
+        self.address = await self.server.start()
+
+    def start(self) -> None:
+        self.loop_runner.run_sync(self.async_start(), timeout=10)
+
+    def shutdown(self) -> None:
+        self.is_shutdown = True
+        try:
+            self.loop_runner.run_sync(self._async_shutdown(), timeout=5)
+        except Exception:
+            pass
+
+    async def _async_shutdown(self) -> None:
+        await self.server.stop()
+        await self.pool.close_all()
+
+    def _controller(self):
+        return self.pool.get(self.controller_addr)
+
+    def _daemon(self):
+        return self.pool.get(self.node_addr)
+
+    # ----------------------------------------------------------- server rpcs
+
+    async def rpc_object_ready(self, object_id: str, payload=None,
+                               location=None, error=None,
+                               task_id: Optional[str] = None) -> None:
+        """A worker pushed a task result to us (we are the owner)."""
+        pending = self._pending_tasks.pop(task_id, None) if task_id else None
+        if error is not None:
+            err = error if isinstance(error, Exception) else RayTpuError(str(error))
+            retriable = isinstance(err, WorkerCrashedError)
+            if retriable and pending is not None and pending.retries_left > 0:
+                pending.retries_left -= 1
+                self._pending_tasks[task_id] = pending
+                logger.warning("retrying task %s (%d retries left)",
+                               pending.spec.get("name"), pending.retries_left)
+                await self._controller().call("submit_task", spec=pending.spec)
+                return
+            self.memory_store.put_error(object_id, err)
+            self._unpin_args(pending)
+            return
+        if location is not None:
+            self.memory_store.put_location(object_id, location)
+        else:
+            self.memory_store.put_serialized(
+                object_id, SerializedObject.from_flat(payload))
+        self._unpin_args(pending)
+
+    def _unpin_args(self, pending: Optional[PendingTask]) -> None:
+        if pending is None:
+            return
+        for arg_id in pending.arg_ids:
+            self.ref_counter.unpin(arg_id)
+
+    async def rpc_get_object(self, object_id: str, timeout: Optional[float] = None):
+        """Serve one of our owned objects to a borrower."""
+        ok = await self.memory_store.wait_available(object_id, timeout or 120.0)
+        if not ok:
+            return {"status": "timeout"}
+        entry = self.memory_store.get_entry(object_id)
+        if entry is None:
+            return {"status": "lost"}
+        if entry.location is not None:
+            return {"status": "location", "location": entry.location}
+        if entry.serialized is not None:
+            return {"status": "inline", "payload": entry.serialized.to_flat()}
+        if entry.has_value:
+            if isinstance(entry.value, Exception):
+                return {"status": "error", "error": entry.value}
+            return {"status": "inline", "payload": serialize(entry.value).to_flat()}
+        return {"status": "lost"}
+
+    async def rpc_ref_event(self, object_id: str, delta: int) -> None:
+        self.ref_counter.on_borrower_event(object_id, delta)
+
+    async def rpc_ping(self) -> str:
+        return "pong"
+
+    async def _send_ref_event(self, owner_addr, object_id: str, delta: int):
+        try:
+            await self.pool.get(owner_addr).oneway(
+                "ref_event", object_id=object_id, delta=delta)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ put
+
+    def put(self, value: Any) -> ObjectRef:
+        object_id = ObjectID.generate().hex()
+        self.ref_counter.register_owned(object_id)
+        serialized = serialize(value)
+        if serialized.total_size <= INLINE_OBJECT_LIMIT or self.node_addr is None:
+            self.memory_store.put_value(object_id, value, serialized)
+        else:
+            shm_name, size = write_to_shm(object_id, serialized, self.session_name)
+            location = ShmLocation(self.node_addr, shm_name, size)
+            self.loop_runner.run_sync(self._daemon().call(
+                "register_object", object_id=object_id,
+                shm_name=shm_name, size=size))
+            self.memory_store.put_location(object_id, location)
+        return ObjectRef(object_id, self.address, _client=self)
+
+    # ------------------------------------------------------------------ get
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _gather():
+            return await asyncio.gather(
+                *[self.aio_get(r, deadline=deadline) for r in ref_list])
+
+        outer = None if timeout is None else timeout + 5.0
+        values = self.loop_runner.run_sync(_gather(), timeout=outer)
+        return values[0] if single else values
+
+    async def aio_get(self, ref: ObjectRef, deadline: Optional[float] = None):
+        object_id = ref.id
+        while True:
+            entry = self.memory_store.get_entry(object_id)
+            if entry is not None:
+                return await self._materialize(object_id, entry)
+            is_owner = ref.owner_addr == self.address or ref.owner_addr is None
+            if is_owner:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"get timed out on {object_id[:12]}")
+                ok = await self.memory_store.wait_available(object_id, remaining)
+                if not ok:
+                    raise GetTimeoutError(f"get timed out on {object_id[:12]}")
+                continue
+            # Borrowed ref: fetch from owner.
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"get timed out on {object_id[:12]}")
+            try:
+                reply = await self.pool.get(ref.owner_addr).call(
+                    "get_object", object_id=object_id, timeout=remaining)
+            except ConnectionLost:
+                raise ObjectLostError(
+                    f"owner of {object_id[:12]} at {ref.owner_addr} is gone")
+            status = reply["status"]
+            if status == "timeout":
+                continue  # loop re-checks deadline
+            if status == "lost":
+                raise ObjectLostError(f"object {object_id[:12]} was freed")
+            if status == "error":
+                raise reply["error"]
+            if status == "location":
+                entry = self.memory_store.get_entry(object_id)
+                if entry is None:
+                    self.memory_store.put_location(object_id, reply["location"])
+                    entry = self.memory_store.get_entry(object_id)
+                return await self._materialize(object_id, entry)
+            serialized = SerializedObject.from_flat(reply["payload"])
+            value = serialized.deserialize()
+            self.memory_store.put_value(object_id, value)
+            return value
+
+    async def _materialize(self, object_id: str, entry):
+        if entry.has_value:
+            if entry.is_error:
+                raise entry.value
+            return entry.value
+        if entry.serialized is not None:
+            value = entry.serialized.deserialize()
+            entry.value = value
+            entry.has_value = True
+            return value
+        if entry.location is not None:
+            loc: ShmLocation = entry.location
+            if self._shm_is_local(loc):
+                value, shm = await asyncio.get_running_loop().run_in_executor(
+                    None, read_from_shm, loc.shm_name, loc.size)
+                entry.shm_keepalive = shm
+            else:
+                reply = await self.pool.get(loc.node_addr).call(
+                    "fetch_object", object_id=object_id)
+                if reply is None:
+                    raise ObjectLostError(f"object {object_id[:12]} not on node")
+                value = SerializedObject.from_flat(reply).deserialize()
+            entry.value = value
+            entry.has_value = True
+            return value
+        raise ObjectLostError(f"object {object_id[:12]} has no data")
+
+    def _shm_is_local(self, loc: ShmLocation) -> bool:
+        # Single-machine sessions: every daemon's shm is attachable. Probe by
+        # host equality; cross-host transfer goes through fetch_object.
+        return loc.node_addr[0] in ("127.0.0.1", "localhost") or (
+            self.node_addr is not None and loc.node_addr[0] == self.node_addr[0])
+
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        return asyncio.run_coroutine_threadsafe(
+            self.aio_get(ref), self.loop_runner.loop)
+
+    # ----------------------------------------------------------------- wait
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        coro = self._aio_wait(list(refs), num_returns, timeout)
+        outer = None if timeout is None else timeout + 5.0
+        return self.loop_runner.run_sync(coro, timeout=outer)
+
+    async def _aio_wait(self, refs, num_returns, timeout):
+        done_ids: set = set()
+
+        async def _one(ref):
+            try:
+                await self.aio_get(ref)
+            except GetTimeoutError:
+                raise
+            except Exception:
+                pass  # errored objects count as ready
+            done_ids.add(ref.id)
+
+        tasks = {asyncio.ensure_future(_one(r)): r for r in refs}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while len(done_ids) < num_returns:
+                remaining = None if deadline is None else max(
+                    0, deadline - time.monotonic())
+                pending = [t for t in tasks if not t.done()]
+                if not pending:
+                    break
+                finished, _ = await asyncio.wait(
+                    pending, timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not finished:
+                    break
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+        ready = [r for r in refs if r.id in done_ids][:max(num_returns, 0)]
+        ready_set = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in ready_set]
+        return ready, not_ready
+
+    # ------------------------------------------------------------ tasks
+
+    def submit_task(self, fn, args: tuple, kwargs: dict, opts: dict,
+                    fn_blob: Optional[bytes] = None):
+        task_id = TaskID.generate().hex()
+        num_returns = opts.get("num_returns") or 1
+        return_ids = [ObjectID.generate().hex() for _ in range(num_returns)]
+        for rid in return_ids:
+            self.ref_counter.register_owned(rid)
+        arg_refs = _collect_refs(args) + _collect_refs(kwargs)
+        for r in arg_refs:
+            self.ref_counter.pin(r.id)
+        spec = {
+            "task_id": task_id,
+            "name": opts.get("name") or getattr(fn, "__name__", "task"),
+            "fn_blob": fn_blob if fn_blob is not None else serialize_code(fn),
+            "args_blob": serialize((args, kwargs)).to_flat(),
+            "return_id": return_ids[0],
+            "return_ids": return_ids,
+            "num_returns": num_returns,
+            "owner_addr": self.address,
+            "resources": _resources_from_opts(opts, default_cpu=1.0),
+            "scheduling": opts.get("scheduling_strategy"),
+            "is_actor_creation": False,
+            "runtime_env": opts.get("runtime_env"),
+        }
+        retries = opts.get("max_retries", 0)
+        pend = PendingTask(spec, retries, [r.id for r in arg_refs])
+        self._pending_tasks[task_id] = pend
+        refs = [ObjectRef(rid, self.address, _client=self)
+                for rid in return_ids]
+
+        async def _submit():
+            try:
+                await self._controller().call("submit_task", spec=spec)
+            except Exception as e:
+                for rid in return_ids:
+                    self.memory_store.put_error(
+                        rid, TaskError(spec["name"],
+                                       f"submission failed: {e!r}"))
+                self._unpin_args(self._pending_tasks.pop(task_id, None))
+
+        self.loop_runner.call_soon(_submit())
+        return refs[0] if num_returns == 1 else refs
+
+    # ------------------------------------------------------------ actors
+
+    def create_actor(self, cls, args: tuple, kwargs: dict, opts: dict,
+                     cls_blob: Optional[bytes] = None):
+        actor_id = ActorID.generate().hex()
+        task_id = TaskID.generate().hex()
+        return_id = ObjectID.generate().hex()
+        self.ref_counter.register_owned(return_id)
+        spec = {
+            "task_id": task_id,
+            "name": opts.get("name") or f"{cls.__name__}.__init__",
+            "class_name": cls.__name__,
+            "fn_blob": cls_blob if cls_blob is not None else serialize_code(cls),
+            "args_blob": serialize((args, kwargs)).to_flat(),
+            "return_id": return_id,
+            "owner_addr": self.address,
+            "resources": _resources_from_opts(opts, default_cpu=0.0),
+            "scheduling": opts.get("scheduling_strategy"),
+            "is_actor_creation": True,
+            "actor_id": actor_id,
+            "actor_name": opts.get("name"),
+            "namespace": opts.get("namespace") or self.namespace,
+            "max_concurrency": opts.get("max_concurrency"),
+            "max_restarts": opts.get("max_restarts", 0),
+            "lifetime": opts.get("lifetime"),
+            "runtime_env": opts.get("runtime_env"),
+        }
+        creation_ref = ObjectRef(return_id, self.address, _client=self)
+
+        async def _submit():
+            try:
+                await self._controller().call("submit_task", spec=spec)
+            except Exception as e:
+                self.memory_store.put_error(
+                    return_id,
+                    ActorDiedError(actor_id, f"creation submission failed: {e!r}"))
+
+        self.loop_runner.call_soon(_submit())
+        return actor_id, creation_ref
+
+    async def _reresolve_actor(self, actor_id: str, old_addr):
+        lock = self._actor_resolve_locks.setdefault(actor_id, asyncio.Lock())
+        async with lock:
+            cur = self._actor_addrs.get(actor_id)
+            if cur is not None and cur != old_addr:
+                return cur  # another caller already re-resolved
+            self._actor_addrs.pop(actor_id, None)
+            addr = await self._resolve_actor(actor_id)
+            # New incarnation: restart our submission sequence.
+            with self._actor_seq_lock:
+                self._actor_seq[actor_id] = 0
+            return addr
+
+    async def _resolve_actor(self, actor_id: str, wait: bool = True):
+        addr = self._actor_addrs.get(actor_id)
+        if addr is not None:
+            return addr
+        reply = await self._controller().call(
+            "get_actor_info", actor_id=actor_id, wait=wait)
+        if reply is None or reply.get("state") == "DEAD":
+            raise ActorDiedError(actor_id, (reply or {}).get("death_cause", ""))
+        addr = tuple(reply["addr"])
+        self._actor_addrs[actor_id] = addr
+        return addr
+
+    def submit_actor_task(self, actor_id: str, method: str, args: tuple,
+                          kwargs: dict, opts: dict) -> ObjectRef:
+        return_id = ObjectID.generate().hex()
+        self.ref_counter.register_owned(return_id)
+        ref = ObjectRef(return_id, self.address, _client=self)
+        arg_refs = _collect_refs(args) + _collect_refs(kwargs)
+        for r in arg_refs:
+            self.ref_counter.pin(r.id)
+        args_blob = serialize((args, kwargs)).to_flat()
+        with self._actor_seq_lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+
+        async def _call():
+            try:
+                await self._call_actor_inner(
+                    actor_id, method, args_blob, return_id, seq)
+            finally:
+                for r in arg_refs:
+                    self.ref_counter.unpin(r.id)
+
+        self.loop_runner.call_soon(_call())
+        return ref
+
+    async def _call_actor_inner(self, actor_id, method, args_blob,
+                                return_id, seq):
+            addr = None
+            try:
+                addr = await self._resolve_actor(actor_id)
+                reply = await self.pool.get(addr).call(
+                    "call_actor", actor_id=actor_id, method=method,
+                    args_blob=args_blob, caller=self.worker_id, seq=seq,
+                    return_id=return_id)
+            except ActorDiedError as e:
+                self.memory_store.put_error(return_id, e)
+                return
+            except (ConnectionLost, OSError):
+                # The actor may have restarted elsewhere: re-resolve once and
+                # retry with a fresh sequence number from the reset counter.
+                try:
+                    addr = await self._reresolve_actor(actor_id, addr)
+                    with self._actor_seq_lock:
+                        seq2 = self._actor_seq.get(actor_id, 0)
+                        self._actor_seq[actor_id] = seq2 + 1
+                    reply = await self.pool.get(addr).call(
+                        "call_actor", actor_id=actor_id, method=method,
+                        args_blob=args_blob, caller=self.worker_id, seq=seq2,
+                        return_id=return_id)
+                except Exception as e2:
+                    self.memory_store.put_error(
+                        return_id,
+                        e2 if isinstance(e2, ActorDiedError) else
+                        ActorDiedError(actor_id, f"actor connection lost: {e2!r}"))
+                    return
+            except Exception as e:
+                self.memory_store.put_error(
+                    return_id, ActorDiedError(actor_id, f"call failed: {e!r}"))
+                # Don't stall later seqs behind this one.
+                if addr is not None:
+                    try:
+                        await self.pool.get(addr).oneway(
+                            "skip_actor_seq", actor_id=actor_id,
+                            caller=self.worker_id, seq=seq)
+                    except Exception:
+                        pass
+                return
+            status = reply["status"]
+            if status == "ok":
+                self.memory_store.put_serialized(
+                    return_id, SerializedObject.from_flat(reply["payload"]))
+            elif status == "location":
+                self.memory_store.put_location(return_id, reply["location"])
+            else:
+                self.memory_store.put_error(
+                    return_id, ActorError(method, reply["error_tb"]))
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.loop_runner.run_sync(self._controller().call(
+            "kill_actor", actor_id=actor_id, no_restart=no_restart))
+        self._actor_addrs.pop(actor_id, None)
+
+    def get_actor_handle_info(self, name: str, namespace: Optional[str]):
+        return self.loop_runner.run_sync(self._controller().call(
+            "get_named_actor", name=name,
+            namespace=namespace or self.namespace))
+
+    # -------------------------------------------------------------- cluster
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.loop_runner.run_sync(
+            self._controller().call("cluster_resources"))
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.loop_runner.run_sync(
+            self._controller().call("available_resources"))
+
+    def nodes(self) -> List[dict]:
+        return self.loop_runner.run_sync(self._controller().call("list_nodes"))
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self.loop_runner.run_sync(self._controller().call(
+            "kv_put", key=key, value=value, overwrite=overwrite))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.loop_runner.run_sync(self._controller().call("kv_get", key=key))
+
+    def kv_del(self, key: str) -> bool:
+        return self.loop_runner.run_sync(self._controller().call("kv_del", key=key))
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.loop_runner.run_sync(
+            self._controller().call("kv_keys", prefix=prefix))
+
+    # ------------------------------------------------------------- freeing
+
+    def _free_owned(self, object_id: str) -> None:
+        entry = self.memory_store.delete(object_id)
+        if entry is not None and entry.location is not None:
+            loc = entry.location
+
+            async def _free():
+                try:
+                    await self.pool.get(loc.node_addr).oneway(
+                        "free_object", object_id=object_id)
+                except Exception:
+                    pass
+
+            if not self.is_shutdown:
+                self.loop_runner.call_soon(_free())
+
+
+def _collect_refs(obj, out=None) -> List[ObjectRef]:
+    if out is None:
+        out = []
+    if isinstance(obj, ObjectRef):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple, set)):
+        for x in obj:
+            _collect_refs(x, out)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_refs(v, out)
+    return out
+
+
+def _resources_from_opts(opts: dict, default_cpu: float) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    resources["CPU"] = float(default_cpu if num_cpus is None else num_cpus)
+    num_tpus = opts.get("num_tpus")
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    num_gpus = opts.get("num_gpus")
+    if num_gpus:
+        resources["GPU"] = float(num_gpus)
+    memory = opts.get("memory")
+    if memory:
+        resources["memory"] = float(memory)
+    return {k: v for k, v in resources.items() if v}

@@ -1,0 +1,375 @@
+"""Node daemon: per-node worker pool + local object store host.
+
+Reference parity: src/ray/raylet/node_manager.h (worker leases, worker pool
+worker_pool.h:224, local object management). Differences by design: task
+placement is done by the controller; the daemon's job is worker lifecycle
+(spawn/reuse/kill/monitor), pushing tasks into workers, hosting the node's
+shared-memory object registry, and serving cross-node object fetches.
+
+Can run in-process (head node: inside the driver's event loop) or as a
+standalone process (`python -m ray_tpu._private.daemon`) for multi-node
+clusters / fake-multi-node tests (cluster_utils).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .ids import NodeID, WorkerID
+from .object_store import NodeObjectStore
+from .protocol import ClientPool, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    __slots__ = ("worker_id", "addr", "pid", "proc", "state", "current_task",
+                 "actor_id", "spawn_time")
+
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.addr: Optional[Tuple[str, int]] = None
+        self.proc = proc
+        self.pid = proc.pid
+        self.state = "starting"      # starting | idle | busy | actor | dead
+        self.current_task: Optional[dict] = None
+        self.actor_id: Optional[str] = None
+        self.spawn_time = time.monotonic()
+
+
+class NodeDaemon:
+    def __init__(self, controller_addr: Tuple[str, int], session_name: str,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[str] = None,
+                 temp_dir: Optional[str] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.node_id = node_id or NodeID.generate().hex()
+        self.controller_addr = tuple(controller_addr)
+        self.session_name = session_name
+        self.resources = dict(resources or {})
+        self.labels = labels or {}
+        self.temp_dir = temp_dir or f"/tmp/ray_tpu/{session_name}"
+        self.worker_env = worker_env or {}
+        self.server = RpcServer()
+        self.server.register_object(self)
+        self.pool = ClientPool()
+        self.address: Optional[Tuple[str, int]] = None
+        self.object_store = NodeObjectStore(session_name)
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.idle: List[str] = []
+        self._register_events: Dict[str, asyncio.Event] = {}
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._closed = False
+        if "CPU" not in self.resources:
+            self.resources["CPU"] = float(os.cpu_count() or 1)
+        # TPU chip pool for device isolation (reference parity:
+        # python/ray/_private/accelerators/tpu.py:193-209 TPU_VISIBLE_CHIPS).
+        self._free_tpu_chips: List[int] = list(
+            range(int(self.resources.get("TPU", 0))))
+        self._task_tpu_chips: Dict[str, List[int]] = {}
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        os.makedirs(os.path.join(self.temp_dir, "logs"), exist_ok=True)
+        self.address = await self.server.start(host, port)
+        await self.pool.get(self.controller_addr).call(
+            "register_node", node_id=self.node_id, addr=self.address,
+            resources=self.resources, labels=self.labels)
+        self._monitor_task = asyncio.ensure_future(self._monitor_loop())
+        return self.address
+
+    async def stop(self):
+        self._closed = True
+        if self._monitor_task:
+            self._monitor_task.cancel()
+        for w in self.workers.values():
+            self._kill_proc(w)
+        self.object_store.free_all()
+        await self.server.stop()
+        await self.pool.close_all()
+
+    def _kill_proc(self, w: WorkerHandle) -> None:
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+        w.state = "dead"
+
+    # --------------------------------------------------------- worker pool
+
+    async def _spawn_worker(self) -> WorkerHandle:
+        worker_id = WorkerID.generate().hex()
+        log_path = os.path.join(self.temp_dir, "logs", f"worker-{worker_id[:12]}.log")
+        log_file = open(log_path, "ab")
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_SESSION"] = self.session_name
+        # Workers must import ray_tpu (and the driver's user modules) even
+        # when the package isn't installed: propagate the package parent dir
+        # plus the driver's sys.path entries.
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        extra = [pkg_parent] + [p for p in sys.path
+                                if p and os.path.isdir(p)]
+        existing = env.get("PYTHONPATH", "")
+        seen, parts = set(), []
+        for p in extra + existing.split(os.pathsep):
+            if p and p not in seen:
+                seen.add(p)
+                parts.append(p)
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--controller", f"{self.controller_addr[0]}:{self.controller_addr[1]}",
+             "--daemon", f"{self.address[0]}:{self.address[1]}",
+             "--worker-id", worker_id,
+             "--node-id", self.node_id,
+             "--session", self.session_name],
+            stdout=log_file, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+        log_file.close()
+        handle = WorkerHandle(worker_id, proc)
+        self.workers[worker_id] = handle
+        ev = asyncio.Event()
+        self._register_events[worker_id] = ev
+        try:
+            await asyncio.wait_for(ev.wait(), timeout=60.0)
+        except asyncio.TimeoutError:
+            self._kill_proc(handle)
+            raise RuntimeError(
+                f"worker failed to start within 60s; see {log_path}")
+        finally:
+            self._register_events.pop(worker_id, None)
+        return handle
+
+    async def rpc_register_worker(self, worker_id: str, addr) -> dict:
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return {"status": "unknown"}
+        handle.addr = tuple(addr)
+        handle.state = "idle"
+        ev = self._register_events.get(worker_id)
+        if ev:
+            ev.set()
+        return {"status": "ok"}
+
+    async def _acquire_worker(self) -> WorkerHandle:
+        while self.idle:
+            worker_id = self.idle.pop()
+            handle = self.workers.get(worker_id)
+            if handle is not None and handle.state == "idle":
+                return handle
+        return await self._spawn_worker()
+
+    def _release_worker(self, handle: WorkerHandle) -> None:
+        if handle.state == "busy":
+            handle.state = "idle"
+            handle.current_task = None
+            self.idle.append(handle.worker_id)
+
+    async def rpc_prestart_workers(self, count: int) -> int:
+        started = 0
+        for _ in range(count):
+            try:
+                h = await self._spawn_worker()
+                self.idle.append(h.worker_id)
+                started += 1
+            except Exception:
+                break
+        return started
+
+    # ----------------------------------------------------------- execution
+
+    async def rpc_execute_task(self, spec: dict) -> dict:
+        asyncio.ensure_future(self._run_task(spec))
+        return {"status": "accepted"}
+
+    def _assign_tpu_chips(self, spec: dict) -> None:
+        n = int((spec.get("resources") or {}).get("TPU", 0))
+        if n > 0 and self._free_tpu_chips:
+            chips = self._free_tpu_chips[:n]
+            self._free_tpu_chips = self._free_tpu_chips[n:]
+            self._task_tpu_chips[spec["task_id"]] = chips
+            spec["_tpu_chips"] = chips
+
+    def _release_tpu_chips(self, task_id: str) -> None:
+        chips = self._task_tpu_chips.pop(task_id, None)
+        if chips:
+            self._free_tpu_chips.extend(chips)
+
+    async def _run_task(self, spec: dict) -> None:
+        controller = self.pool.get(self.controller_addr)
+        self._assign_tpu_chips(spec)
+        try:
+            handle = await self._acquire_worker()
+        except Exception as e:
+            await self._report_failure(spec, f"worker spawn failed: {e!r}")
+            self._release_tpu_chips(spec["task_id"])
+            await controller.oneway("task_finished", task_id=spec["task_id"],
+                                    node_id=self.node_id)
+            return
+        handle.state = "busy"
+        handle.current_task = spec
+        if spec.get("is_actor_creation"):
+            handle.state = "actor"
+            handle.actor_id = spec["actor_id"]
+            try:
+                reply = await self.pool.get(handle.addr).call(
+                    "create_actor", spec=spec)
+            except Exception as e:
+                self._release_tpu_chips(spec["task_id"])
+                await controller.oneway(
+                    "actor_creation_failed", actor_id=spec["actor_id"],
+                    reason=f"worker died during actor creation: {e!r}")
+                await self._report_failure(
+                    spec, f"actor creation crashed: {e!r}")
+                return
+            if reply.get("status") == "ok":
+                await controller.oneway(
+                    "actor_started", actor_id=spec["actor_id"],
+                    addr=handle.addr, worker_id=handle.worker_id)
+                # Creation-task resources stay held until actor death;
+                # do NOT send task_finished here.
+            else:
+                handle.state = "busy"
+                handle.actor_id = None
+                self._release_worker(handle)
+                self._release_tpu_chips(spec["task_id"])
+                await controller.oneway(
+                    "actor_creation_failed", actor_id=spec["actor_id"],
+                    reason=reply.get("error_tb", "init failed"))
+                await controller.oneway(
+                    "task_finished", task_id=spec["task_id"],
+                    node_id=self.node_id)
+        else:
+            try:
+                await self.pool.get(handle.addr).call(
+                    "run_task", spec=spec)
+            except Exception as e:
+                await self._report_failure(
+                    spec, f"worker crashed while running task: {e!r}")
+                if handle.state != "dead":
+                    self._kill_proc(handle)
+            else:
+                self._release_worker(handle)
+            self._release_tpu_chips(spec["task_id"])
+            await controller.oneway("task_finished", task_id=spec["task_id"],
+                                    node_id=self.node_id)
+
+    async def _report_failure(self, spec: dict, reason: str) -> None:
+        from ..exceptions import WorkerCrashedError
+        try:
+            await self.pool.get(spec["owner_addr"]).oneway(
+                "object_ready", object_id=spec["return_id"],
+                error=WorkerCrashedError(reason), task_id=spec["task_id"])
+        except Exception:
+            pass
+
+    async def rpc_kill_actor_worker(self, actor_id: str) -> bool:
+        for handle in self.workers.values():
+            if handle.actor_id == actor_id and handle.state == "actor":
+                if handle.current_task is not None:
+                    self._release_tpu_chips(handle.current_task["task_id"])
+                self._kill_proc(handle)
+                return True
+        return False
+
+    # -------------------------------------------------------------- objects
+
+    async def rpc_register_object(self, object_id: str, shm_name: str,
+                                  size: int) -> None:
+        self.object_store.register(object_id, shm_name, size)
+
+    async def rpc_fetch_object(self, object_id: str) -> Optional[bytes]:
+        return self.object_store.read_bytes(object_id)
+
+    async def rpc_free_object(self, object_id: str) -> None:
+        self.object_store.free(object_id)
+
+    async def rpc_node_stats(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "num_workers": len([w for w in self.workers.values()
+                                if w.state != "dead"]),
+            "num_idle": len(self.idle),
+            "object_store_objects": self.object_store.num_objects,
+            "object_store_bytes": self.object_store.bytes_used,
+        }
+
+    # ------------------------------------------------------------- monitor
+
+    async def _monitor_loop(self) -> None:
+        controller = self.pool.get(self.controller_addr)
+        while not self._closed:
+            await asyncio.sleep(0.5)
+            try:
+                await controller.oneway("heartbeat", node_id=self.node_id)
+            except Exception:
+                pass
+            for handle in list(self.workers.values()):
+                if handle.state == "dead":
+                    self.workers.pop(handle.worker_id, None)
+                    continue
+                if handle.proc.poll() is not None:
+                    prev_state = handle.state
+                    handle.state = "dead"
+                    if handle.worker_id in self.idle:
+                        self.idle.remove(handle.worker_id)
+                    spec = handle.current_task
+                    if spec is not None:
+                        self._release_tpu_chips(spec["task_id"])
+                    if prev_state == "actor" and handle.actor_id:
+                        try:
+                            await controller.oneway(
+                                "actor_died", actor_id=handle.actor_id,
+                                reason=f"worker process {handle.pid} exited "
+                                       f"with code {handle.proc.returncode}")
+                        except Exception:
+                            pass
+                    elif prev_state == "busy" and spec is not None:
+                        await self._report_failure(
+                            spec, f"worker process {handle.pid} died "
+                                  f"(exit code {handle.proc.returncode})")
+                        try:
+                            await controller.oneway(
+                                "task_finished", task_id=spec["task_id"],
+                                node_id=self.node_id)
+                        except Exception:
+                            pass
+                    self.workers.pop(handle.worker_id, None)
+
+
+async def _standalone_main(args) -> None:
+    host, port = args.controller.rsplit(":", 1)
+    daemon = NodeDaemon(
+        controller_addr=(host, int(port)),
+        session_name=args.session,
+        resources=dict(eval(args.resources)) if args.resources else None,
+        node_id=args.node_id or None)
+    await daemon.start()
+    print(f"ray_tpu daemon {daemon.node_id} at {daemon.address}", flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--resources", default="")
+    parser.add_argument("--node-id", default="")
+    args = parser.parse_args()
+    asyncio.run(_standalone_main(args))
+
+
+if __name__ == "__main__":
+    main()

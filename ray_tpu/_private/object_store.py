@@ -1,0 +1,251 @@
+"""Object stores: per-process memory store + per-node shared-memory store.
+
+Reference parity:
+- memory store: src/ray/core_worker/store_provider/memory_store/memory_store.h
+  (small objects / direct-call returns live in the owner process).
+- shm store: src/ray/object_manager/plasma/store.h — ours maps each large
+  object to one POSIX shared-memory segment (multiprocessing.shared_memory)
+  registered with the node daemon, which owns lifecycle (free/unlink) and
+  serves cross-node fetches. Readers attach and deserialize zero-copy:
+  numpy arrays reference the mapped segment directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, Optional, Tuple
+
+from .serialization import SerializedObject
+
+_MISSING = object()
+
+
+def create_untracked_shm(name: str, size: int) -> shared_memory.SharedMemory:
+    """Create a shm segment not owned by this process's resource tracker.
+
+    Workers create segments but the node daemon owns their lifecycle; without
+    unregistering, a worker exiting would unlink segments that must outlive it.
+    """
+    shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    return shm
+
+
+def _unlink_shm(name: str) -> None:
+    """Unlink a segment without touching any resource tracker.
+
+    Both create_untracked_shm and attach_shm unregister from the tracker
+    (segment lifecycle belongs to the node daemon, not to whichever process
+    happens to exit first), so SharedMemory.unlink()'s internal unregister
+    would hit a tracker cache miss. Unlink at the POSIX level instead.
+    """
+    try:
+        from multiprocessing import shared_memory as _sm
+        _sm._posixshmem.shm_unlink("/" + name if not name.startswith("/")
+                                   else name)
+    except FileNotFoundError:
+        pass
+    except Exception:
+        pass
+
+
+def attach_shm(name: str) -> shared_memory.SharedMemory:
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    return shm
+
+
+class ShmLocation:
+    """Where a large object's bytes live: a segment on some node."""
+
+    __slots__ = ("node_addr", "shm_name", "size")
+
+    def __init__(self, node_addr: Tuple[str, int], shm_name: str, size: int):
+        self.node_addr = tuple(node_addr)
+        self.shm_name = shm_name
+        self.size = size
+
+    def __reduce__(self):
+        return (ShmLocation, (self.node_addr, self.shm_name, self.size))
+
+
+class MemoryStoreEntry:
+    __slots__ = ("serialized", "location", "value", "has_value", "is_error",
+                 "shm_keepalive")
+
+    def __init__(self):
+        self.serialized: Optional[SerializedObject] = None
+        self.location: Optional[ShmLocation] = None
+        self.value: Any = _MISSING
+        self.has_value = False
+        self.is_error = False
+        self.shm_keepalive = None  # keeps mapped segment alive while value cached
+
+
+class MemoryStore:
+    """Owner-process store: inline values or locations of shm-backed ones."""
+
+    def __init__(self):
+        self._entries: Dict[str, MemoryStoreEntry] = {}
+        self._events: Dict[str, asyncio.Event] = {}
+
+    def contains(self, object_id: str) -> bool:
+        return object_id in self._entries
+
+    def put_serialized(self, object_id: str, serialized: SerializedObject) -> None:
+        entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        entry.serialized = serialized
+        self._signal(object_id)
+
+    def put_value(self, object_id: str, value: Any,
+                  serialized: Optional[SerializedObject] = None) -> None:
+        entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        entry.value = value
+        entry.has_value = True
+        entry.serialized = serialized
+        self._signal(object_id)
+
+    def put_location(self, object_id: str, location: ShmLocation) -> None:
+        entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        entry.location = location
+        self._signal(object_id)
+
+    def put_error(self, object_id: str, error: Exception) -> None:
+        """Store an exception as the object's value (raised on get)."""
+        entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        entry.value = error
+        entry.has_value = True
+        entry.is_error = True
+        self._signal(object_id)
+
+    def get_entry(self, object_id: str) -> Optional[MemoryStoreEntry]:
+        return self._entries.get(object_id)
+
+    def delete(self, object_id: str) -> Optional[MemoryStoreEntry]:
+        self._events.pop(object_id, None)
+        return self._entries.pop(object_id, None)
+
+    def _signal(self, object_id: str) -> None:
+        ev = self._events.get(object_id)
+        if ev is not None:
+            ev.set()
+
+    async def wait_available(self, object_id: str,
+                             timeout: Optional[float] = None) -> bool:
+        if object_id in self._entries:
+            return True
+        ev = self._events.setdefault(object_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            if object_id in self._entries:
+                self._events.pop(object_id, None)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class ShmStoreEntry:
+    __slots__ = ("shm_name", "size", "sealed", "shm", "pinned")
+
+    def __init__(self, shm_name: str, size: int):
+        self.shm_name = shm_name
+        self.size = size
+        self.sealed = False
+        self.shm: Optional[shared_memory.SharedMemory] = None
+        self.pinned = 0
+
+
+class NodeObjectStore:
+    """Node-daemon-side registry of shm segments holding sealed objects."""
+
+    def __init__(self, session_name: str):
+        self.session_name = session_name
+        self._entries: Dict[str, ShmStoreEntry] = {}
+        self._seq = 0
+
+    def segment_name(self, object_id: str) -> str:
+        # shm names are capped ~250 chars and must be unique machine-wide.
+        return f"rtpu_{self.session_name[:8]}_{object_id[:20]}"
+
+    def register(self, object_id: str, shm_name: str, size: int) -> None:
+        entry = ShmStoreEntry(shm_name, size)
+        entry.sealed = True
+        self._entries[object_id] = entry
+
+    def contains(self, object_id: str) -> bool:
+        e = self._entries.get(object_id)
+        return e is not None and e.sealed
+
+    def get(self, object_id: str) -> Optional[ShmStoreEntry]:
+        return self._entries.get(object_id)
+
+    def read_bytes(self, object_id: str) -> Optional[bytes]:
+        """Copy an object's flat bytes out (for cross-node transfer)."""
+        entry = self._entries.get(object_id)
+        if entry is None or not entry.sealed:
+            return None
+        if entry.shm is None:
+            entry.shm = attach_shm(entry.shm_name)
+        return bytes(entry.shm.buf[: entry.size])
+
+    def free(self, object_id: str) -> None:
+        entry = self._entries.pop(object_id, None)
+        if entry is None:
+            return
+        if entry.shm is not None:
+            try:
+                entry.shm.close()
+            except Exception:
+                pass
+        _unlink_shm(entry.shm_name)
+
+    def free_all(self) -> None:
+        for object_id in list(self._entries):
+            self.free(object_id)
+
+    @property
+    def num_objects(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        return sum(e.size for e in self._entries.values())
+
+
+def write_to_shm(object_id: str, serialized: SerializedObject,
+                 session_name: str) -> Tuple[str, int]:
+    """Create a segment for `serialized` and write its flat layout into it.
+
+    Returns (shm_name, size). Caller must register it with the node daemon.
+    """
+    size = serialized.flat_size()
+    name = f"rtpu_{session_name[:8]}_{object_id[:20]}"
+    shm = create_untracked_shm(name, size)
+    try:
+        serialized.write_flat(shm.buf)
+    finally:
+        shm.close()
+    return name, size
+
+
+def read_from_shm(shm_name: str, size: int):
+    """Attach a sealed segment and deserialize zero-copy.
+
+    Returns (value, shm_handle). The handle must be kept alive as long as the
+    value may reference the mapping (numpy arrays view into it).
+    """
+    shm = attach_shm(shm_name)
+    serialized = SerializedObject.from_flat(shm.buf[:size])
+    value = serialized.deserialize()
+    return value, shm

@@ -1,0 +1,108 @@
+"""Serialization: cloudpickle for code, pickle-5 out-of-band for data.
+
+Reference parity: python/ray/_private/serialization.py — but there is no
+custom binary format here; numpy / jax host arrays ride as out-of-band
+buffers so they can live zero-copy in shared memory. jax.Array device
+buffers are converted to host numpy at the boundary (device_get) — device
+state never crosses processes (on TPU each process owns its chips).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Sequence, Tuple
+
+import cloudpickle
+
+# Objects whose serialized size is at or below this are returned inline in
+# RPC replies and stored in the owner's memory store; larger ones go to the
+# node shared-memory store. (Ray: max_direct_call_object_size, 100KB.)
+INLINE_OBJECT_LIMIT = 100 * 1024
+
+
+class SerializedObject:
+    """A serialized value: pickle stream + out-of-band buffers."""
+
+    __slots__ = ("data", "buffers")
+
+    def __init__(self, data: bytes, buffers: Sequence[Any]):
+        self.data = data
+        self.buffers = list(buffers)
+
+    @property
+    def total_size(self) -> int:
+        return len(self.data) + sum(len(b) for b in self.buffers)
+
+    def deserialize(self) -> Any:
+        return pickle.loads(self.data, buffers=self.buffers)
+
+    # -- flat byte layout (for shm segments / network transfer) --
+    # u32 nbuf | u64 len * (nbuf+1) | data | buffers...
+    def to_flat(self) -> bytes:
+        out = io.BytesIO()
+        lens = [len(self.data)] + [len(b) for b in self.buffers]
+        out.write(len(self.buffers).to_bytes(4, "little"))
+        for n in lens:
+            out.write(n.to_bytes(8, "little"))
+        out.write(self.data)
+        for b in self.buffers:
+            out.write(b if isinstance(b, bytes) else bytes(b))
+        return out.getvalue()
+
+    def flat_size(self) -> int:
+        return 4 + 8 * (1 + len(self.buffers)) + self.total_size
+
+    def write_flat(self, view: memoryview) -> int:
+        lens = [len(self.data)] + [len(b) for b in self.buffers]
+        off = 0
+        view[off:off + 4] = len(self.buffers).to_bytes(4, "little")
+        off += 4
+        for n in lens:
+            view[off:off + 8] = n.to_bytes(8, "little")
+            off += 8
+        view[off:off + len(self.data)] = self.data
+        off += len(self.data)
+        for b in self.buffers:
+            bl = len(b)
+            view[off:off + bl] = b if isinstance(b, (bytes, memoryview)) else bytes(b)
+            off += bl
+        return off
+
+    @classmethod
+    def from_flat(cls, view) -> "SerializedObject":
+        """Parse from a buffer; returned buffers are zero-copy views."""
+        view = memoryview(view)
+        nbuf = int.from_bytes(view[0:4], "little")
+        off = 4
+        lens = []
+        for _ in range(nbuf + 1):
+            lens.append(int.from_bytes(view[off:off + 8], "little"))
+            off += 8
+        data = bytes(view[off:off + lens[0]])
+        off += lens[0]
+        buffers = []
+        for n in lens[1:]:
+            buffers.append(view[off:off + n])
+            off += n
+        return cls(data, buffers)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    try:
+        data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    except TypeError:
+        # Some objects reject out-of-band buffers; retry without.
+        buffers = []
+        data = cloudpickle.dumps(value, protocol=5)
+    return SerializedObject(data, [b.raw() for b in buffers])
+
+
+def serialize_code(obj: Any) -> bytes:
+    """Serialize a function/class definition (no out-of-band split)."""
+    return cloudpickle.dumps(obj)
+
+
+def deserialize_code(data: bytes) -> Any:
+    return pickle.loads(data)

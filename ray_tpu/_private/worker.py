@@ -1,0 +1,189 @@
+"""Driver-side bootstrap: ray_tpu.init()/shutdown() and the head node.
+
+Reference parity: python/ray/_private/worker.py (ray.init :1285, connect
+:2279, shutdown :1901) + node.py — collapsed: the controller and the head
+node daemon run inside the driver process's background event loop (worker
+processes are real subprocesses), so bring-up is milliseconds and teardown
+is deterministic. Additional daemons (real or fake multi-node) register
+with the same controller over TCP.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from typing import Dict, List, Optional
+
+from .controller import Controller
+from .core import CoreClient, LoopRunner
+from .daemon import NodeDaemon
+from . import state
+
+_runtime = None
+
+
+class Runtime:
+    """Everything owned by this driver's session."""
+
+    def __init__(self, client: CoreClient, controller: Controller,
+                 head_daemon: Optional[NodeDaemon], loop_runner: LoopRunner,
+                 session_name: str):
+        self.client = client
+        self.controller = controller
+        self.head_daemon = head_daemon
+        self.loop_runner = loop_runner
+        self.session_name = session_name
+        self.extra_daemons: List[NodeDaemon] = []
+
+
+def init(address: Optional[str] = None,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         labels: Optional[Dict[str, str]] = None,
+         namespace: str = "default",
+         ignore_reinit_error: bool = False,
+         local_mode: bool = False,
+         _prestart_workers: int = 0,
+         **_ignored) -> "Runtime":
+    global _runtime
+    if _runtime is not None:
+        if ignore_reinit_error:
+            return _runtime
+        raise RuntimeError("ray_tpu.init() called twice; "
+                           "pass ignore_reinit_error=True to allow.")
+    if local_mode:
+        from .local_mode import LocalModeClient
+        client = LocalModeClient(namespace=namespace)
+        state.set_client(client)
+        _runtime = Runtime(client, None, None, None, "local")
+        atexit.register(shutdown)
+        return _runtime
+
+    if address is not None:
+        raise NotImplementedError(
+            "connecting to an existing cluster (init(address=...)) is not "
+            "supported yet; start a head session with init() and add nodes "
+            "via add_fake_node() or the standalone daemon.")
+
+    session_name = f"s{int(time.time())}_{os.getpid()}"
+    loop_runner = LoopRunner()
+
+    node_resources = dict(resources or {})
+    if num_cpus is not None:
+        node_resources["CPU"] = float(num_cpus)
+    elif "CPU" not in node_resources:
+        node_resources["CPU"] = float(os.cpu_count() or 1)
+    if num_tpus is not None:
+        node_resources["TPU"] = float(num_tpus)
+    else:
+        from ..accelerators.tpu import TPUAcceleratorManager
+        detected = TPUAcceleratorManager.autodetect_resources()
+        for k, v in detected.items():
+            node_resources.setdefault(k, v)
+
+    async def _bootstrap():
+        controller = Controller(session_name)
+        await controller.start()
+        daemon = NodeDaemon(controller.address, session_name,
+                            resources=node_resources, labels=labels)
+        await daemon.start()
+        return controller, daemon
+
+    controller, head_daemon = loop_runner.run_sync(_bootstrap(), timeout=30)
+    client = CoreClient(controller.address,
+                        head_daemon.address if head_daemon else None,
+                        session_name, loop_runner=loop_runner,
+                        namespace=namespace)
+    client.start()
+    state.set_client(client)
+    _runtime = Runtime(client, controller, head_daemon, loop_runner,
+                       session_name)
+    if _prestart_workers:
+        loop_runner.run_sync(
+            client.pool.get(head_daemon.address).call(
+                "prestart_workers", count=_prestart_workers), timeout=120)
+    atexit.register(shutdown)
+    return _runtime
+
+
+def shutdown() -> None:
+    global _runtime
+    if _runtime is None:
+        return
+    rt, _runtime = _runtime, None
+    state.set_client(None)
+    if rt.loop_runner is None:   # local mode
+        return
+    rt.client.is_shutdown = True
+
+    async def _teardown():
+        for d in rt.extra_daemons:
+            try:
+                await d.stop()
+            except Exception:
+                pass
+        if rt.head_daemon is not None:
+            await rt.head_daemon.stop()
+        if rt.controller is not None:
+            await rt.controller.stop()
+
+    try:
+        rt.loop_runner.run_sync(_teardown(), timeout=10)
+    except Exception:
+        pass
+    try:
+        rt.client.shutdown()
+    except Exception:
+        pass
+    rt.loop_runner.stop()
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def current_runtime() -> Optional[Runtime]:
+    return _runtime
+
+
+def add_fake_node(num_cpus: float = 1.0,
+                  resources: Optional[Dict[str, float]] = None,
+                  labels: Optional[Dict[str, str]] = None) -> str:
+    """Register an extra in-process node daemon (multi-node testing).
+
+    Reference parity: python/ray/cluster_utils.py:135 (Cluster.add_node) —
+    each fake node runs a real NodeDaemon with its own worker processes.
+    """
+    rt = _runtime
+    if rt is None or rt.controller is None:
+        raise RuntimeError("init() a non-local session first")
+    node_resources = dict(resources or {})
+    node_resources.setdefault("CPU", num_cpus)
+
+    async def _add():
+        daemon = NodeDaemon(rt.controller.address, rt.session_name,
+                            resources=node_resources, labels=labels)
+        await daemon.start()
+        return daemon
+
+    daemon = rt.loop_runner.run_sync(_add(), timeout=30)
+    rt.extra_daemons.append(daemon)
+    return daemon.node_id
+
+
+def remove_node(node_id: str) -> bool:
+    """Stop a fake node's daemon (kills its workers) — chaos testing."""
+    rt = _runtime
+    if rt is None:
+        return False
+    for d in list(rt.extra_daemons):
+        if d.node_id == node_id:
+            async def _stop():
+                await d.stop()
+                await rt.controller.rpc_unregister_node(node_id=node_id)
+            rt.loop_runner.run_sync(_stop(), timeout=15)
+            rt.extra_daemons.remove(d)
+            return True
+    return False

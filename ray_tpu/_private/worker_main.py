@@ -1,0 +1,332 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference parity: python/ray/_private/workers/default_worker.py + the
+execution side of _raylet.pyx (task_execution_handler :2283) and
+src/ray/core_worker/transport/task_receiver.h / actor_scheduling_queue.h:
+- normal tasks run on a thread-pool executor (the RPC loop stays live);
+- sync actors execute methods FIFO on a dedicated executor whose width is
+  max_concurrency;
+- async actors schedule coroutine methods directly on the event loop
+  (bounded by a semaphore), like the reference's fiber-based async actors.
+
+Workers embed a full CoreClient, so user code can submit nested tasks,
+create actors, and call ray_tpu.get/put from inside tasks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import concurrent.futures
+import inspect
+import logging
+import os
+import signal
+import sys
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+from . import state
+from .core import CoreClient, LoopRunner
+from .object_ref import ObjectRef
+from .object_store import ShmLocation, write_to_shm
+from .serialization import (INLINE_OBJECT_LIMIT, SerializedObject,
+                            deserialize_code, serialize)
+
+logger = logging.getLogger(__name__)
+
+
+class ActorState:
+    def __init__(self, actor_id: str, instance: Any,
+                 max_concurrency: Optional[int]):
+        self.actor_id = actor_id
+        self.instance = instance
+        # Defaults mirror the reference: sync actors 1, async actors 1000 —
+        # but an explicit user value is always honored.
+        if max_concurrency is None:
+            max_concurrency = 1000 if _is_async_actor(instance) else 1
+        self.max_concurrency = max(1, max_concurrency)
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.max_concurrency,
+            thread_name_prefix=f"actor-{actor_id[:8]}")
+        self.async_semaphore = asyncio.Semaphore(self.max_concurrency)
+        # Per-caller admission ordering (reference parity:
+        # src/ray/core_worker/transport/actor_scheduling_queue.h): calls are
+        # admitted to the executor strictly in the caller's submission order.
+        self.next_seq: Dict[str, int] = {}
+        self.seq_cond = asyncio.Condition()
+
+    async def admit(self, caller: str, seq) -> None:
+        if seq is None or caller is None:
+            return
+        async with self.seq_cond:
+            while self.next_seq.get(caller, 0) < seq:
+                await self.seq_cond.wait()
+
+    async def admitted(self, caller: str, seq) -> None:
+        if seq is None or caller is None:
+            return
+        async with self.seq_cond:
+            expected = self.next_seq.get(caller, 0)
+            if seq >= expected:
+                self.next_seq[caller] = seq + 1
+            self.seq_cond.notify_all()
+
+
+def _is_async_actor(instance: Any) -> bool:
+    for name in dir(type(instance)):
+        if name.startswith("__"):
+            continue
+        fn = getattr(type(instance), name, None)
+        if fn is not None and inspect.iscoroutinefunction(fn):
+            return True
+    return False
+
+
+class WorkerRuntime:
+    def __init__(self, client: CoreClient, daemon_addr: Tuple[str, int],
+                 worker_id: str, node_id: str):
+        self.client = client
+        self.daemon_addr = daemon_addr
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.actors: Dict[str, ActorState] = {}
+        self.current_actor_id: Optional[str] = None
+        self.task_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="task")
+        client.server.register("run_task", self.rpc_run_task)
+        client.server.register("create_actor", self.rpc_create_actor)
+        client.server.register("call_actor", self.rpc_call_actor)
+        client.server.register("shutdown_worker", self.rpc_shutdown_worker)
+        client.server.register("skip_actor_seq", self.rpc_skip_actor_seq)
+
+    # ------------------------------------------------------------- helpers
+
+    async def _resolve_args(self, args_blob: bytes):
+        args, kwargs = SerializedObject.from_flat(args_blob).deserialize()
+        # Top-level ObjectRefs are resolved to values (reference semantics:
+        # python/ray/_raylet.pyx argument unwrapping); nested refs stay refs.
+        args = list(args)
+        for i, a in enumerate(args):
+            if isinstance(a, ObjectRef):
+                args[i] = await self.client.aio_get(a)
+        for k, v in list(kwargs.items()):
+            if isinstance(v, ObjectRef):
+                kwargs[k] = await self.client.aio_get(v)
+        return tuple(args), kwargs
+
+    async def _push_result(self, owner_addr, object_id: str, value: Any,
+                           task_id: Optional[str] = None) -> None:
+        serialized = serialize(value)
+        owner = self.client.pool.get(tuple(owner_addr))
+        if serialized.total_size <= INLINE_OBJECT_LIMIT:
+            await owner.oneway("object_ready", object_id=object_id,
+                               payload=serialized.to_flat(), task_id=task_id)
+        else:
+            shm_name, size = await asyncio.get_running_loop().run_in_executor(
+                None, write_to_shm, object_id, serialized,
+                self.client.session_name)
+            await self.client.pool.get(self.daemon_addr).call(
+                "register_object", object_id=object_id,
+                shm_name=shm_name, size=size)
+            location = ShmLocation(self.daemon_addr, shm_name, size)
+            await owner.oneway("object_ready", object_id=object_id,
+                               location=location, task_id=task_id)
+
+    async def _push_error(self, owner_addr, object_id: str, error: Exception,
+                          task_id: Optional[str] = None) -> None:
+        import pickle
+        try:
+            pickle.loads(pickle.dumps(error))
+        except Exception:
+            from ..exceptions import RayTpuError
+            error = RayTpuError(f"{type(error).__name__}: {error}")
+        try:
+            await self.client.pool.get(tuple(owner_addr)).oneway(
+                "object_ready", object_id=object_id, error=error,
+                task_id=task_id)
+        except Exception:
+            logger.exception("failed to push error to owner")
+
+    # ------------------------------------------------------------- tasks
+
+    def _apply_tpu_isolation(self, spec: dict) -> None:
+        chips = spec.get("_tpu_chips")
+        if chips is not None:
+            from ..accelerators.tpu import TPUAcceleratorManager
+            TPUAcceleratorManager.set_current_process_visible_accelerators(
+                chips)
+
+    async def rpc_run_task(self, spec: dict) -> dict:
+        from ..exceptions import TaskError
+        loop = asyncio.get_running_loop()
+        try:
+            self._apply_tpu_isolation(spec)
+            fn = deserialize_code(spec["fn_blob"])
+            args, kwargs = await self._resolve_args(spec["args_blob"])
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                result = await loop.run_in_executor(
+                    self.task_executor, lambda: fn(*args, **kwargs))
+        except Exception:
+            tb = traceback.format_exc()
+            err = TaskError(spec.get("name", "task"), tb)
+            return_ids = spec.get("return_ids") or [spec["return_id"]]
+            for i, rid in enumerate(return_ids):
+                await self._push_error(
+                    spec["owner_addr"], rid, err,
+                    task_id=spec["task_id"] if i == 0 else None)
+            return {"status": "error"}
+        num_returns = spec.get("num_returns", 1)
+        if num_returns > 1:
+            return_ids = spec["return_ids"]
+            if not isinstance(result, (tuple, list)) \
+                    or len(result) != num_returns:
+                err = TaskError(
+                    spec.get("name", "task"),
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{type(result).__name__} of length "
+                    f"{len(result) if hasattr(result, '__len__') else 'n/a'}")
+                for i, rid in enumerate(return_ids):
+                    await self._push_error(
+                        spec["owner_addr"], rid, err,
+                        task_id=spec["task_id"] if i == 0 else None)
+                return {"status": "error"}
+            for i, (rid, part) in enumerate(zip(return_ids, result)):
+                await self._push_result(
+                    spec["owner_addr"], rid, part,
+                    task_id=spec["task_id"] if i == len(return_ids) - 1
+                    else None)
+        else:
+            await self._push_result(spec["owner_addr"], spec["return_id"],
+                                    result, task_id=spec["task_id"])
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------- actors
+
+    async def rpc_create_actor(self, spec: dict) -> dict:
+        loop = asyncio.get_running_loop()
+        actor_id = spec["actor_id"]
+        try:
+            self._apply_tpu_isolation(spec)
+            cls = deserialize_code(spec["fn_blob"])
+            args, kwargs = await self._resolve_args(spec["args_blob"])
+            self.current_actor_id = actor_id
+            instance = await loop.run_in_executor(
+                None, lambda: cls(*args, **kwargs))
+        except Exception:
+            tb = traceback.format_exc()
+            from ..exceptions import ActorDiedError
+            await self._push_error(
+                spec["owner_addr"], spec["return_id"],
+                ActorDiedError(actor_id,
+                               f"__init__ failed:\n{tb}"),
+                task_id=spec["task_id"])
+            return {"status": "error", "error_tb": tb}
+        self.actors[actor_id] = ActorState(
+            actor_id, instance, spec.get("max_concurrency"))
+        if not spec.get("is_restart"):
+            await self._push_result(spec["owner_addr"], spec["return_id"],
+                                    None, task_id=spec["task_id"])
+        return {"status": "ok"}
+
+    async def rpc_call_actor(self, actor_id: str, method: str,
+                             args_blob: bytes, caller=None,
+                             seq=None, return_id=None) -> dict:
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"status": "error",
+                    "error_tb": f"actor {actor_id[:12]} not hosted here"}
+        loop = asyncio.get_running_loop()
+        try:
+            args, kwargs = await self._resolve_args(args_blob)
+            fn = getattr(actor.instance, method)
+            await actor.admit(caller, seq)
+            if inspect.iscoroutinefunction(fn):
+                async def _run():
+                    async with actor.async_semaphore:
+                        return await fn(*args, **kwargs)
+                work = asyncio.ensure_future(_run())
+            else:
+                work = loop.run_in_executor(
+                    actor.executor, lambda: fn(*args, **kwargs))
+            await actor.admitted(caller, seq)
+            result = await work
+        except Exception:
+            await actor.admitted(caller, seq)
+            return {"status": "error", "error_tb": traceback.format_exc()}
+        serialized = serialize(result)
+        if serialized.total_size <= INLINE_OBJECT_LIMIT:
+            return {"status": "ok", "payload": serialized.to_flat()}
+        # Register under the caller's return_id so the owner's free_object
+        # (by return_id) reaches the right segment.
+        object_id = return_id or os.urandom(16).hex()
+        shm_name, size = await loop.run_in_executor(
+            None, write_to_shm, object_id, serialized,
+            self.client.session_name)
+        await self.client.pool.get(self.daemon_addr).call(
+            "register_object", object_id=object_id, shm_name=shm_name,
+            size=size)
+        return {"status": "location",
+                "location": ShmLocation(self.daemon_addr, shm_name, size)}
+
+    async def rpc_skip_actor_seq(self, actor_id: str, caller: str,
+                                 seq) -> None:
+        actor = self.actors.get(actor_id)
+        if actor is not None:
+            await actor.admitted(caller, seq)
+
+    async def rpc_shutdown_worker(self) -> dict:
+        asyncio.get_running_loop().call_later(0.05, sys.exit, 0)
+        return {"status": "ok"}
+
+
+async def async_main(args) -> None:
+    chost, cport = args.controller.rsplit(":", 1)
+    dhost, dport = args.daemon.rsplit(":", 1)
+    controller_addr = (chost, int(cport))
+    daemon_addr = (dhost, int(dport))
+    loop_runner = LoopRunner(loop=asyncio.get_running_loop())
+    client = CoreClient(controller_addr, daemon_addr, args.session,
+                        loop_runner=loop_runner, worker_id=args.worker_id)
+    await client.async_start()
+    state.set_client(client)
+    runtime = WorkerRuntime(client, daemon_addr, args.worker_id, args.node_id)
+    client.runtime_context = {
+        "worker_id": args.worker_id, "node_id": args.node_id,
+        "runtime": runtime,
+    }
+    daemon = client.pool.get(daemon_addr)
+    await daemon.call("register_worker", worker_id=args.worker_id,
+                      addr=client.address)
+    # Exit if the daemon goes away (parent supervision).
+    while True:
+        await asyncio.sleep(2.0)
+        try:
+            await daemon.call("node_stats")
+        except Exception:
+            logger.warning("daemon unreachable; worker exiting")
+            os._exit(1)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--daemon", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {args.worker_id[:8]}] %(levelname)s %(message)s")
+    signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
+    try:
+        asyncio.run(async_main(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

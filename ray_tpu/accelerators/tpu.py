@@ -1,0 +1,125 @@
+"""TPU accelerator manager: detection, isolation, pod-slice resources.
+
+Reference parity: python/ray/_private/accelerators/tpu.py:109-375
+(TPUAcceleratorManager) — resource name "TPU", TPU_VISIBLE_CHIPS isolation,
+GCE/GKE metadata probing, pod-type detection, the auto
+"TPU-{version}-{cores}-head" resource, valid chip counts {1, 2, 4, 8}.
+
+Detection is environment-driven (no jax import here — importing jax grabs
+the chips, which must only happen inside the worker that owns them).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+TPU_RESOURCE_NAME = "TPU"
+VALID_CHIPS_PER_HOST = (1, 2, 4, 8)
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+# GCE metadata env mirrors (set by TPU VM images / GKE webhook).
+ACCEL_TYPE_ENVS = ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE")
+WORKER_ID_ENV = "TPU_WORKER_ID"
+POD_NAME_ENVS = ("TPU_NAME", "TPU_POD_NAME")
+
+
+class TPUAcceleratorManager:
+    """Static methods mirroring the reference AcceleratorManager ABC
+    (python/ray/_private/accelerators/accelerator.py:5)."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return TPU_RESOURCE_NAME
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        override = os.environ.get("RAY_TPU_NUM_CHIPS")
+        if override:
+            return int(override)
+        # TPU VM images expose one /dev/accel* (or vfio group) per chip.
+        chips = glob.glob("/dev/accel*")
+        if chips:
+            return len(chips)
+        chips = glob.glob("/dev/vfio/[0-9]*")
+        if chips:
+            return len(chips)
+        return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        for env in ACCEL_TYPE_ENVS:
+            v = os.environ.get(env)
+            if v:
+                return v  # e.g. "v5p-64"
+        return None
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float):
+        if quantity not in VALID_CHIPS_PER_HOST and quantity >= 1:
+            return (False,
+                    f"TPU request must be one of {VALID_CHIPS_PER_HOST} "
+                    f"chips (got {quantity}); multi-host workloads request "
+                    f"whole hosts via the pod-slice head resource.")
+        return True, None
+
+    @staticmethod
+    def set_current_process_visible_accelerators(chip_ids: List[int]) -> None:
+        """Restrict this process (and jax in it) to the given chips."""
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chip_ids)
+        # Bounds for subsets of a host (reference tpu.py:193-209).
+        n = len(chip_ids)
+        if n in (1, 2):
+            os.environ["TPU_CHIPS_PER_HOST_BOUNDS"] = f"{n},1,1"
+            os.environ["TPU_HOST_BOUNDS"] = "1,1,1"
+
+    @staticmethod
+    def get_current_process_visible_accelerators() -> Optional[List[int]]:
+        v = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if v is None:
+            return None
+        return [int(x) for x in v.split(",") if x]
+
+    # ------------------------------------------------------ pod-slice info
+
+    @staticmethod
+    def get_current_pod_name() -> Optional[str]:
+        for env in POD_NAME_ENVS:
+            v = os.environ.get(env)
+            if v:
+                return v
+        return None
+
+    @staticmethod
+    def get_current_pod_worker_count() -> Optional[int]:
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+        if hostnames:
+            return len(hostnames.split(","))
+        return None
+
+    @staticmethod
+    def get_current_pod_head_resource_name() -> Optional[str]:
+        """The gang-scheduling anchor: e.g. 'TPU-v5p-64-head' exists (=1)
+        only on worker 0 of a slice (reference tpu.py:352-375)."""
+        accel = TPUAcceleratorManager.get_current_node_accelerator_type()
+        if accel is None:
+            return None
+        worker_id = os.environ.get(WORKER_ID_ENV, "0")
+        if worker_id != "0":
+            return None
+        return f"TPU-{accel}-head"
+
+    @staticmethod
+    def autodetect_resources() -> Dict[str, float]:
+        """Resources this node should advertise."""
+        out: Dict[str, float] = {}
+        n = TPUAcceleratorManager.get_current_node_num_accelerators()
+        if n > 0:
+            out[TPU_RESOURCE_NAME] = float(n)
+            accel = TPUAcceleratorManager.get_current_node_accelerator_type()
+            if accel:
+                out[f"TPU-{accel}"] = float(n)
+            head = TPUAcceleratorManager.get_current_pod_head_resource_name()
+            if head:
+                out[head] = 1.0
+        return out

@@ -1,0 +1,73 @@
+"""@ray_tpu.remote for functions.
+
+Reference parity: python/ray/remote_function.py (RemoteFunction._remote :303)
+and option handling (_private/ray_option_utils.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ._private import state
+
+_VALID_OPTS = {
+    "num_cpus", "num_gpus", "num_tpus", "memory", "resources", "name",
+    "max_retries", "num_returns", "scheduling_strategy", "runtime_env",
+    "max_concurrency", "max_restarts", "lifetime", "namespace",
+    "placement_group", "placement_group_bundle_index",
+}
+
+
+def validate_options(opts: Dict[str, Any]) -> Dict[str, Any]:
+    bad = set(opts) - _VALID_OPTS
+    if bad:
+        raise ValueError(f"unknown option(s): {sorted(bad)}")
+    return opts
+
+
+def normalize_scheduling(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold placement_group/scheduling_strategy objects into a plain dict."""
+    opts = dict(opts)
+    strategy = opts.get("scheduling_strategy")
+    pg = opts.pop("placement_group", None)
+    if pg is not None and strategy is None:
+        strategy = {"type": "placement_group",
+                    "placement_group": getattr(pg, "id", pg),
+                    "bundle_index": opts.pop("placement_group_bundle_index", -1)}
+    elif strategy is not None and not isinstance(strategy, dict):
+        strategy = strategy.to_dict()
+    opts["scheduling_strategy"] = strategy
+    return opts
+
+
+class RemoteFunction:
+    def __init__(self, fn, opts: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._opts = validate_options(opts or {})
+        self._fn_blob: Optional[bytes] = None   # cached cloudpickle of fn
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs):
+        client = state.current_client()
+        if self._fn_blob is None and not getattr(client, "is_local_mode", False):
+            from ._private.serialization import serialize_code
+            self._fn_blob = serialize_code(self._fn)
+        return client.submit_task(self._fn, args, kwargs,
+                                  normalize_scheduling(self._opts),
+                                  fn_blob=self._fn_blob)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(validate_options(opts))
+        return RemoteFunction(self._fn, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__!r} cannot be called "
+            f"directly; use .remote().")
+
+    @property
+    def func(self):
+        """The underlying Python function (for local execution/tests)."""
+        return self._fn

@@ -1,0 +1,32 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Reference parity for test strategy: SURVEY.md §4 — the in-process
+multi-host simulation is `xla_force_host_platform_device_count=8`
+(the Cluster-equivalent for SPMD code paths).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    import ray_tpu
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def ray_local():
+    import ray_tpu
+    ray_tpu.init(local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,175 @@
+"""Task semantics: submit/get/wait/errors/nesting/retries.
+
+Modeled on reference tests python/ray/tests/test_basic*.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (GetTimeoutError, InfeasibleResourceError,
+                                TaskError)
+
+
+def test_simple_task(ray_start):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_many_parallel_tasks(ray_start):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs) == [i * i for i in range(20)]
+
+
+def test_task_error_propagates(ray_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("intentional")
+
+    with pytest.raises(TaskError, match="intentional"):
+        ray_tpu.get(boom.remote())
+
+
+def test_object_ref_args(ray_start):
+    @ray_tpu.remote
+    def plus1(x):
+        return x + 1
+
+    a = plus1.remote(0)
+    b = plus1.remote(a)       # ref as arg, resolved at worker
+    c = plus1.remote(b)
+    assert ray_tpu.get(c) == 3
+
+
+def test_put_and_pass(ray_start):
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+
+    @ray_tpu.remote
+    def read(d):
+        return d["k"][-1]
+
+    assert ray_tpu.get(read.remote(ref)) == 3
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+
+def test_large_object_roundtrip(ray_start):
+    arr = np.arange(1_000_000, dtype=np.float32)   # 4MB -> shm
+
+    @ray_tpu.remote
+    def make():
+        return np.arange(1_000_000, dtype=np.float32)
+
+    out = ray_tpu.get(make.remote())
+    np.testing.assert_array_equal(out, arr)
+
+    ref = ray_tpu.put(arr * 2)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(ref)) == float((arr * 2).sum())
+
+
+def test_nested_tasks(ray_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+def test_wait(ray_start):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(3)
+        return "slow"
+
+    s, f = slow.remote(), fast.remote()
+    ready, not_ready = ray_tpu.wait([s, f], num_returns=1, timeout=2.0)
+    assert ready == [f]
+    assert not_ready == [s]
+    ready2, _ = ray_tpu.wait([s], num_returns=1)
+    assert ready2 == [s]
+
+
+def test_get_timeout(ray_start):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.5)
+
+
+def test_infeasible_resources(ray_start):
+    @ray_tpu.remote(num_cpus=10_000)
+    def f():
+        return 1
+
+    with pytest.raises(InfeasibleResourceError):
+        ray_tpu.get(f.remote(), timeout=10)
+
+
+def test_options_override(ray_start):
+    @ray_tpu.remote(num_cpus=10_000)
+    def f():
+        return "ran"
+
+    assert ray_tpu.get(f.options(num_cpus=1).remote()) == "ran"
+
+
+def test_async_task_function(ray_start):
+    @ray_tpu.remote
+    async def afn(x):
+        return x * 3
+
+    assert ray_tpu.get(afn.remote(4)) == 12
+
+
+def test_kwargs_and_defaults(ray_start):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=2)) == 13
+
+
+def test_cluster_resources_visible(ray_start):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 8
+    nodes = ray_tpu.nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+
+
+def test_num_returns(ray_start):
+    @ray_tpu.remote(num_returns=3)
+    def split():
+        return 1, 2, 3
+
+    a, b, c = split.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+    @ray_tpu.remote(num_returns=2)
+    def bad():
+        return 1  # not a 2-tuple
+
+    with pytest.raises(TaskError, match="num_returns=2"):
+        ray_tpu.get(bad.remote()[0])
