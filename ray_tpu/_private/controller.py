@@ -155,9 +155,17 @@ class Controller:
                 await self._handle_actor_death(
                     actor.actor_id, f"node {node_id[:8]} died")
         # Fail in-flight normal tasks on the node; owners may retry.
+        # Actor creations in flight are routed through actor-death handling
+        # so max_restarts applies (resubmitted elsewhere if budget remains).
         from ..exceptions import WorkerCrashedError
         for task_id, (nid, req, spec) in list(self.running.items()):
-            if nid == node_id and not spec.get("is_actor_creation"):
+            if nid != node_id:
+                continue
+            if spec.get("is_actor_creation"):
+                await self._handle_actor_death(
+                    spec["actor_id"],
+                    f"node {node_id[:8]} died during actor creation")
+            else:
                 self.running.pop(task_id, None)
                 await self._fail_task(spec, WorkerCrashedError(
                     f"node {node_id[:8]} died while running task"))
@@ -298,10 +306,26 @@ class Controller:
         return pg.resolve_bundle(bundle_index, req)
 
     async def _fail_task(self, spec: dict, error: Exception) -> None:
+        if spec.get("is_actor_creation"):
+            # Release the claimed name and mark the directory entry dead so
+            # the name can be reused and get_actor fails fast.
+            actor_id = spec.get("actor_id")
+            name = spec.get("actor_name")
+            if name:
+                key = (spec.get("namespace", "default"), name)
+                if self.named_actors.get(key) == actor_id:
+                    del self.named_actors[key]
+            entry = self.actors.get(actor_id)
+            if entry is not None and entry.state != "DEAD":
+                entry.state = "DEAD"
+                entry.death_cause = str(error)
+                for ev in entry.waiters:
+                    ev.set()
+                entry.waiters.clear()
         try:
             await self.pool.get(spec["owner_addr"]).oneway(
-                "object_ready", object_id=spec["return_id"], error=error,
-                task_id=spec["task_id"])
+                "object_ready", error=error, task_id=spec["task_id"],
+                object_ids=spec.get("return_ids") or [spec["return_id"]])
         except Exception:
             pass
 
@@ -369,6 +393,11 @@ class Controller:
             entry.waiters.clear()
             if entry.name:
                 self.named_actors.pop((entry.namespace, entry.name), None)
+            if entry.addr is None:
+                # Never came up: resolve the owner's creation ref with the
+                # death cause so nothing blocks on it.
+                await self._fail_task(entry.creation_spec,
+                                      ActorDiedError(actor_id, reason))
 
     async def rpc_get_actor_info(self, actor_id: str,
                                  wait: bool = True) -> Optional[dict]:

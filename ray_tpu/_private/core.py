@@ -236,10 +236,16 @@ class CoreClient:
 
     # ----------------------------------------------------------- server rpcs
 
-    async def rpc_object_ready(self, object_id: str, payload=None,
+    async def rpc_object_ready(self, object_id: str = None, payload=None,
                                location=None, error=None,
-                               task_id: Optional[str] = None) -> None:
-        """A worker pushed a task result to us (we are the owner)."""
+                               task_id: Optional[str] = None,
+                               object_ids=None) -> None:
+        """A worker pushed a task result to us (we are the owner).
+
+        Errors may carry `object_ids` (all return ids of a failed task) so a
+        multi-return task fails every ref atomically — and a retry decision
+        is made once, before anything is stored.
+        """
         pending = self._pending_tasks.pop(task_id, None) if task_id else None
         if error is not None:
             err = error if isinstance(error, Exception) else RayTpuError(str(error))
@@ -251,7 +257,8 @@ class CoreClient:
                                pending.spec.get("name"), pending.retries_left)
                 await self._controller().call("submit_task", spec=pending.spec)
                 return
-            self.memory_store.put_error(object_id, err)
+            for oid in (object_ids or [object_id]):
+                self.memory_store.put_error(oid, err)
             self._unpin_args(pending)
             return
         if location is not None:
@@ -559,7 +566,8 @@ class CoreClient:
             return addr
         reply = await self._controller().call(
             "get_actor_info", actor_id=actor_id, wait=wait)
-        if reply is None or reply.get("state") == "DEAD":
+        if reply is None or reply.get("state") == "DEAD" \
+                or reply.get("addr") is None:
             raise ActorDiedError(actor_id, (reply or {}).get("death_cause", ""))
         addr = tuple(reply["addr"])
         self._actor_addrs[actor_id] = addr

@@ -268,8 +268,9 @@ class NodeDaemon:
         from ..exceptions import WorkerCrashedError
         try:
             await self.pool.get(spec["owner_addr"]).oneway(
-                "object_ready", object_id=spec["return_id"],
-                error=WorkerCrashedError(reason), task_id=spec["task_id"])
+                "object_ready", error=WorkerCrashedError(reason),
+                task_id=spec["task_id"],
+                object_ids=spec.get("return_ids") or [spec["return_id"]])
         except Exception:
             pass
 

@@ -21,6 +21,12 @@ from .serialization import SerializedObject
 _MISSING = object()
 
 
+def segment_name(session_name: str, object_id: str) -> str:
+    """Canonical shm segment name (POSIX shm names cap ~250 chars and must
+    be unique machine-wide)."""
+    return f"rtpu_{session_name[:8]}_{object_id[:20]}"
+
+
 def create_untracked_shm(name: str, size: int) -> shared_memory.SharedMemory:
     """Create a shm segment not owned by this process's resource tracker.
 
@@ -101,12 +107,14 @@ class MemoryStore:
 
     def put_serialized(self, object_id: str, serialized: SerializedObject) -> None:
         entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        self._clear_error(entry)
         entry.serialized = serialized
         self._signal(object_id)
 
     def put_value(self, object_id: str, value: Any,
                   serialized: Optional[SerializedObject] = None) -> None:
         entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        self._clear_error(entry)
         entry.value = value
         entry.has_value = True
         entry.serialized = serialized
@@ -114,8 +122,17 @@ class MemoryStore:
 
     def put_location(self, object_id: str, location: ShmLocation) -> None:
         entry = self._entries.setdefault(object_id, MemoryStoreEntry())
+        self._clear_error(entry)
         entry.location = location
         self._signal(object_id)
+
+    @staticmethod
+    def _clear_error(entry: MemoryStoreEntry) -> None:
+        # A successful (retried) result replaces a previously stored error.
+        if entry.is_error:
+            entry.is_error = False
+            entry.has_value = False
+            entry.value = _MISSING
 
     def put_error(self, object_id: str, error: Exception) -> None:
         """Store an exception as the object's value (raised on get)."""
@@ -175,8 +192,7 @@ class NodeObjectStore:
         self._seq = 0
 
     def segment_name(self, object_id: str) -> str:
-        # shm names are capped ~250 chars and must be unique machine-wide.
-        return f"rtpu_{self.session_name[:8]}_{object_id[:20]}"
+        return segment_name(self.session_name, object_id)
 
     def register(self, object_id: str, shm_name: str, size: int) -> None:
         entry = ShmStoreEntry(shm_name, size)
@@ -230,7 +246,7 @@ def write_to_shm(object_id: str, serialized: SerializedObject,
     Returns (shm_name, size). Caller must register it with the node daemon.
     """
     size = serialized.flat_size()
-    name = f"rtpu_{session_name[:8]}_{object_id[:20]}"
+    name = segment_name(session_name, object_id)
     shm = create_untracked_shm(name, size)
     try:
         serialized.write_flat(shm.buf)

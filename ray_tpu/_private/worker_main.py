@@ -134,7 +134,8 @@ class WorkerRuntime:
                                location=location, task_id=task_id)
 
     async def _push_error(self, owner_addr, object_id: str, error: Exception,
-                          task_id: Optional[str] = None) -> None:
+                          task_id: Optional[str] = None,
+                          object_ids=None) -> None:
         import pickle
         try:
             pickle.loads(pickle.dumps(error))
@@ -144,7 +145,7 @@ class WorkerRuntime:
         try:
             await self.client.pool.get(tuple(owner_addr)).oneway(
                 "object_ready", object_id=object_id, error=error,
-                task_id=task_id)
+                task_id=task_id, object_ids=object_ids)
         except Exception:
             logger.exception("failed to push error to owner")
 
@@ -171,12 +172,11 @@ class WorkerRuntime:
                     self.task_executor, lambda: fn(*args, **kwargs))
         except Exception:
             tb = traceback.format_exc()
-            err = TaskError(spec.get("name", "task"), tb)
-            return_ids = spec.get("return_ids") or [spec["return_id"]]
-            for i, rid in enumerate(return_ids):
-                await self._push_error(
-                    spec["owner_addr"], rid, err,
-                    task_id=spec["task_id"] if i == 0 else None)
+            await self._push_error(
+                spec["owner_addr"], spec["return_id"],
+                TaskError(spec.get("name", "task"), tb),
+                task_id=spec["task_id"],
+                object_ids=spec.get("return_ids") or [spec["return_id"]])
             return {"status": "error"}
         num_returns = spec.get("num_returns", 1)
         if num_returns > 1:
@@ -188,10 +188,9 @@ class WorkerRuntime:
                     f"task declared num_returns={num_returns} but returned "
                     f"{type(result).__name__} of length "
                     f"{len(result) if hasattr(result, '__len__') else 'n/a'}")
-                for i, rid in enumerate(return_ids):
-                    await self._push_error(
-                        spec["owner_addr"], rid, err,
-                        task_id=spec["task_id"] if i == 0 else None)
+                await self._push_error(
+                    spec["owner_addr"], spec["return_id"], err,
+                    task_id=spec["task_id"], object_ids=return_ids)
                 return {"status": "error"}
             for i, (rid, part) in enumerate(zip(return_ids, result)):
                 await self._push_result(

@@ -1,0 +1,2 @@
+from .attention import attention, flash_attention, reference_attention
+from .ring_attention import ring_attention, ring_attention_sharded

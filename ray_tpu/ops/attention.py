@@ -1,0 +1,213 @@
+"""Attention ops: XLA reference impl + Pallas TPU flash-attention kernel.
+
+This is net-new TPU work: the reference has no in-repo attention (vLLM is
+external; SURVEY.md §2.4 marks SP/long-context absent). Shapes follow
+(batch, seq, heads, head_dim) with GQA (kv_heads divides heads).
+
+The flash kernel uses the online-softmax accumulation pattern with a
+3-D grid (batch*heads, q_blocks, kv_blocks): the kv grid dimension is
+innermost and sequential on TPU, so the running max / denominator / output
+accumulator live in VMEM scratch across kv steps. Backward currently
+recomputes through the XLA reference (custom_vjp); a full Pallas backward
+kernel is planned.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
+    """(B, S, KVH, D) -> (B, S, H, D) by repeating each kv head."""
+    b, s, kvh, d = k.shape
+    if kvh == num_heads:
+        return k
+    reps = num_heads // kvh
+    return jnp.repeat(k, reps, axis=2)
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        q_offset: int | jax.Array = 0,
+                        kv_offset: int | jax.Array = 0,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain XLA attention. q: (B, Sq, H, D); k/v: (B, Sk, KVH, D).
+
+    q_offset/kv_offset are the global positions of the first query/key —
+    used by ring attention where each device holds a rotating kv chunk.
+    """
+    b, sq, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)[:, None]
+        k_pos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# --------------------------------------------------------------------------
+# Pallas flash attention (forward)
+# --------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr,
+                  *, causal: bool, scale: float,
+                  block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: the kv block is live iff its first key position can be seen
+    # by the last query of this q block.
+    if causal:
+        live = ki * block_k <= qi * block_q + (block_q - 1)
+    else:
+        live = ki >= 0
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)          # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_prev = m_scr[:]                          # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)             # (bq, 1)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool, scale: float,
+                   block_q: int, block_k: int,
+                   interpret: bool = False) -> jax.Array:
+    """q: (BH, Sq, D); k/v: (BKVH, Sk, D); grouped via index maps."""
+    bh, sq, d = q.shape
+    bkvh, sk, _ = k.shape
+    group = bh // bkvh
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention. q: (B, Sq, H, D); k/v: (B, Sk, KVH, D)."""
+    out, _ = _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    _, sk, kvh, _ = k.shape
+    scale_val = scale if scale is not None else d ** -0.5
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq or sk % bk or bq % 8 or bk % 8:
+        raise ValueError(
+            f"flash_attention needs seq lengths divisible by 8 and by the "
+            f"block size (sq={sq}, bq={bq}, sk={sk}, bk={bk}); pad inputs "
+            f"or use impl='xla'.")
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * kvh, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * kvh, sk, d)
+    of = _flash_forward(qf, kf, vf, causal, scale_val, bq, bk, interpret)
+    out = of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
+                    residuals, g):
+    q, k, v = residuals
+    # Rematerialized backward through the XLA reference implementation.
+    # TODO(perf): dedicated Pallas dq/dk/dv kernels.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(
+            q_, k_, v_, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# --------------------------------------------------------------------------
+# Dispatcher
+# --------------------------------------------------------------------------
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              causal: bool = True, impl: str = "auto") -> jax.Array:
+    """Pick the best attention implementation for the current backend."""
+    if impl == "auto":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        sq, sk = q.shape[1], k.shape[1]
+        ok_shapes = (sq % DEFAULT_BLOCK_Q == 0 and sk % DEFAULT_BLOCK_K == 0
+                     and q.shape[-1] >= 64)
+        impl = "pallas" if (on_tpu and ok_shapes) else "xla"
+    if impl == "pallas":
+        return flash_attention(q, k, v, causal)
+    if impl == "pallas_interpret":
+        return flash_attention(q, k, v, causal, None,
+                               DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, True)
+    return reference_attention(q, k, v, causal=causal)

@@ -1,0 +1,97 @@
+"""Device meshes: the TPU-native replacement for process groups.
+
+Reference parity: where the reference wires NCCL process groups
+(python/ray/util/collective/collective.py:123, train/torch/config.py:66),
+we declare a `MeshSpec` — named parallelism axes over a
+jax.sharding.Mesh — and let XLA compile collectives onto ICI. The axes:
+
+    dp     data parallel (gradient allreduce / psum)
+    fsdp   fully-sharded data parallel (params sharded, all-gather on use)
+    sp     sequence/context parallel (ring attention over ppermute)
+    tp     tensor parallel (heads/ffn sharded, psum on projections)
+    ep     expert parallel (MoE expert sharding, all_to_all dispatch)
+
+Pipeline parallelism is expressed separately (stage meshes / collective
+permute), not as a mesh axis here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+ALL_AXES = (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP)
+# Activation batch is sharded over every data-like axis.
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative parallelism layout. -1 on one axis = use remaining devices."""
+
+    dp: int = 1
+    fsdp: int = -1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = {f.name: getattr(self, f.name)
+                 for f in dataclasses.fields(self)}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh spec {sizes} needs {fixed} devices, have {n_devices}")
+        return MeshSpec(**sizes)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {AXIS_DP: self.dp, AXIS_FSDP: self.fsdp, AXIS_SP: self.sp,
+                AXIS_TP: self.tp, AXIS_EP: self.ep}
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        spec = self.resolve(len(devices))
+        sizes = spec.axis_sizes()
+        arr = np.array(devices).reshape([sizes[a] for a in ALL_AXES])
+        return Mesh(arr, ALL_AXES)
+
+    @property
+    def data_shards(self) -> int:
+        """Number of distinct data shards (global batch divisor)."""
+        return max(1, self.dp) * max(1, self.fsdp)
+
+
+def single_device_mesh() -> Mesh:
+    return MeshSpec(dp=1, fsdp=1, sp=1, tp=1, ep=1).build(jax.devices()[:1])
+
+
+def mesh_shape(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for host->device batches: batch over dp+fsdp, seq over sp."""
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES, AXIS_SP))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
