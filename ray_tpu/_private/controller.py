@@ -108,6 +108,7 @@ class Controller:
         self.running: Dict[str, Tuple[str, Dict[str, float], dict]] = {}
         self.node_timeout_s = 10.0
         self.placement_groups: Dict[str, Any] = {}
+        self.pending_pgs: List[Any] = []
         self._sched_event = asyncio.Event()
         self._sched_task: Optional[asyncio.Task] = None
         self._health_task: Optional[asyncio.Task] = None
@@ -132,7 +133,15 @@ class Controller:
 
     async def rpc_register_node(self, node_id: str, addr, resources,
                                 labels=None) -> dict:
-        self.nodes[node_id] = NodeEntry(node_id, addr, resources, labels)
+        node = NodeEntry(node_id, addr, resources, labels)
+        self.nodes[node_id] = node
+        # A re-registering node (same id) gets live PG reservations
+        # re-applied so PG tasks + new tasks can't oversubscribe it.
+        for pg in self.placement_groups.values():
+            if pg.state == "CREATED":
+                for b in pg.bundles:
+                    if b.node_id == node_id:
+                        node.acquire(b.resources)
         logger.info("node %s registered at %s with %s",
                     node_id[:8], addr, resources)
         self._sched_event.set()
@@ -150,6 +159,18 @@ class Controller:
             node.last_heartbeat = time.monotonic()
 
     async def _on_node_death(self, node_id: str) -> None:
+        # Placement groups with a bundle on the dead node become FAILED:
+        # their gang guarantee is broken. Reservations on surviving nodes
+        # are returned.
+        for pg in list(self.placement_groups.values()):
+            if pg.state == "CREATED" and any(
+                    b.node_id == node_id for b in pg.bundles):
+                for b in pg.bundles:
+                    if b.node_id != node_id:
+                        node = self.nodes.get(b.node_id)
+                        if node is not None:
+                            node.release(b.resources)
+                pg.fail(f"bundle node {node_id[:8]} died")
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state == "ALIVE":
                 await self._handle_actor_death(
@@ -232,6 +253,18 @@ class Controller:
             await self._pump()
 
     async def _pump(self) -> None:
+        # Placement groups first: gang reservations beat individual tasks.
+        still_pg: List[Any] = []
+        for pg in self.pending_pgs:
+            reason = pg.try_place(list(self.nodes.values()))
+            if reason is None:
+                pass                      # committed
+            elif reason == "":
+                still_pg.append(pg)       # retry when resources free up
+            else:
+                pg.fail(reason)
+        self.pending_pgs = still_pg
+
         still_pending: List[dict] = []
         for spec in self.pending:
             placed = await self._try_place(spec)
@@ -253,17 +286,11 @@ class Controller:
                 return "failed"
             if target:
                 candidates = target
-        pg = strategy.get("placement_group")
-        if pg is not None:
-            node_id, bundle_res = self._resolve_bundle(
-                pg, strategy.get("bundle_index", -1), req)
-            if node_id == "__pending__":
-                return None
-            if node_id is None:
-                await self._fail_task(spec, InfeasibleResourceError(
-                    f"placement group {pg} unavailable"))
-                return "failed"
-            candidates = [n for n in candidates if n.node_id == node_id]
+        pg_id = strategy.get("placement_group")
+        if pg_id is not None:
+            return await self._place_in_pg(spec, pg_id,
+                                           strategy.get("bundle_index", -1),
+                                           req)
         if not any(n.feasible(req) for n in candidates):
             if all(not n.feasible(req) for n in self.nodes.values() if n.alive):
                 await self._fail_task(spec, InfeasibleResourceError(
@@ -283,27 +310,65 @@ class Controller:
         else:
             node = min(fitting, key=lambda n: n.utilization())
         node.acquire(req)
-        self.running[spec["task_id"]] = (node.node_id, req, spec)
+        return await self._dispatch(spec, node,
+                                    lambda: node.release(req))
+
+    async def _place_in_pg(self, spec: dict, pg_id: str,
+                           bundle_index: int, req: dict) -> Optional[str]:
+        """Place a task inside a placement group bundle: resources come from
+        the bundle's reservation, not from node-available accounting."""
+        from ..exceptions import PlacementGroupUnavailableError
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg.state in ("REMOVED", "FAILED"):
+            await self._fail_task(spec, PlacementGroupUnavailableError(
+                f"placement group {pg_id[:12]} "
+                f"{'not found' if pg is None else pg.state.lower()}"
+                + (f": {pg.failure_reason}" if pg and pg.failure_reason else "")))
+            return "failed"
+        node_id, bidx = pg.resolve_bundle(bundle_index, req)
+        if node_id == "__pending__":
+            return None
+        if node_id is None:
+            await self._fail_task(spec, PlacementGroupUnavailableError(
+                f"no bundle in {pg_id[:12]} can hold {req} "
+                f"(bundle_index={bundle_index})"))
+            return "failed"
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            await self._fail_task(spec, PlacementGroupUnavailableError(
+                f"placement group {pg_id[:12]} bundle node died"))
+            return "failed"
+        pg.acquire_for_task(spec["task_id"], bidx, req)
+        node.num_running += 1
+
+        def cleanup():
+            pg.release_for_task(spec["task_id"])
+            node.num_running = max(0, node.num_running - 1)
+
+        return await self._dispatch(spec, node, cleanup)
+
+    async def _dispatch(self, spec: dict, node: NodeEntry,
+                        cleanup) -> Optional[str]:
+        """Send the spec to the node's daemon; on connection failure, undo
+        the resource acquisition (cleanup), mark the node dead, and leave
+        the spec pending (the caller's pump retains it)."""
+        self.running[spec["task_id"]] = (node.node_id,
+                                         dict(spec.get("resources") or {}),
+                                         spec)
         if spec.get("is_actor_creation"):
             self._register_pending_actor(spec, node.node_id)
         try:
             await self.pool.get(node.addr).call("execute_task", spec=spec)
         except Exception as e:
-            logger.warning("dispatch to node %s failed: %r", node.node_id[:8], e)
-            node.release(req)
+            logger.warning("dispatch to node %s failed: %r",
+                           node.node_id[:8], e)
+            cleanup()
             self.running.pop(spec["task_id"], None)
             node.alive = False
             await self._on_node_death(node.node_id)
-            self.pending.append(spec)
             self._sched_event.set()
             return None
         return node.node_id
-
-    def _resolve_bundle(self, pg_id: str, bundle_index: int, req: dict):
-        pg = self.placement_groups.get(pg_id)
-        if pg is None:
-            return None, None
-        return pg.resolve_bundle(bundle_index, req)
 
     async def _fail_task(self, spec: dict, error: Exception) -> None:
         if spec.get("is_actor_creation"):
@@ -332,9 +397,16 @@ class Controller:
     async def rpc_task_finished(self, task_id: str, node_id: str) -> None:
         entry = self.running.pop(task_id, None)
         if entry is not None:
-            node = self.nodes.get(entry[0])
-            if node is not None:
-                node.release(entry[1])
+            nid, req, spec = entry
+            node = self.nodes.get(nid)
+            pg_id = (spec.get("scheduling") or {}).get("placement_group")
+            pg = self.placement_groups.get(pg_id) if pg_id else None
+            if pg is not None:
+                pg.release_for_task(task_id)
+                if node is not None:
+                    node.num_running = max(0, node.num_running - 1)
+            elif node is not None:
+                node.release(req)
         self._sched_event.set()
 
     # -------------------------------------------------------------- actors
@@ -461,6 +533,56 @@ class Controller:
             "class_name": a.creation_spec.get("class_name"),
             "death_cause": a.death_cause,
         } for a in self.actors.values()]
+
+    # --------------------------------------------------------- placement groups
+
+    async def rpc_create_placement_group(self, pg_id: str, bundles,
+                                         strategy: str = "PACK",
+                                         name: str = "") -> dict:
+        from .placement import PlacementGroupEntry
+        pg = PlacementGroupEntry(pg_id, bundles, strategy, name)
+        self.placement_groups[pg_id] = pg
+        self.pending_pgs.append(pg)
+        self._sched_event.set()
+        return {"placement_group_id": pg_id}
+
+    async def rpc_pg_wait_ready(self, pg_id: str,
+                                timeout: Optional[float] = None) -> dict:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return {"state": "NOT_FOUND"}
+        while pg.state == "PENDING":
+            ev = asyncio.Event()
+            pg.waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=timeout or 120.0)
+            except asyncio.TimeoutError:
+                return {"state": pg.state, "reason": "timeout"}
+        return {"state": pg.state, "reason": pg.failure_reason}
+
+    async def rpc_remove_placement_group(self, pg_id: str) -> bool:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return False
+        if pg in self.pending_pgs:
+            self.pending_pgs.remove(pg)
+        # Kill actors created inside this PG (reference semantics: removing
+        # a PG stops its leaseholders).
+        for actor in list(self.actors.values()):
+            sched = (actor.creation_spec.get("scheduling") or {})
+            if sched.get("placement_group") == pg_id \
+                    and actor.state in ("ALIVE", "PENDING", "RESTARTING"):
+                await self.rpc_kill_actor(actor.actor_id, no_restart=True)
+        if pg.state == "CREATED":
+            pg.release_all(self.nodes)
+        else:
+            pg.mark_removed()       # wakes any pg.ready() waiters
+        self._sched_event.set()
+        return True
+
+    async def rpc_placement_group_table(self) -> Dict[str, dict]:
+        return {pg_id: pg.to_dict()
+                for pg_id, pg in self.placement_groups.items()}
 
     # ------------------------------------------------------------------ kv
 

@@ -1,0 +1,6 @@
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                     ScalingConfig)
+from .session import (get_checkpoint, get_context, get_local_rank,
+                      get_world_rank, get_world_size, report)
+from .trainer import DataParallelTrainer, JaxTrainer, Result, TrainWorker

@@ -1,0 +1,118 @@
+"""Checkpoint = a directory on storage; manager keeps top-K.
+
+Reference parity: python/ray/train/_checkpoint.py (directory-on-storage
+abstraction) + _internal/checkpoint_manager.py (top-K retention). Orbax
+handles the tensor serialization when saving jax pytrees
+(save_pytree/load_pytree); plain files work too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # -- jax pytree helpers (orbax) --
+
+    @classmethod
+    def save_pytree(cls, tree: Any, path: str) -> "Checkpoint":
+        import orbax.checkpoint as ocp
+        path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, "pytree"), tree, force=True)
+        return cls(path)
+
+    def load_pytree(self, abstract_tree: Any = None) -> Any:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        target = os.path.join(self.path, "pytree")
+        if abstract_tree is not None:
+            return ckptr.restore(target, item=abstract_tree)
+        return ckptr.restore(target)
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Tracks reported checkpoints, retains top-K by score (or recency)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self.checkpoints: List[Tuple[float, Checkpoint, Dict]] = []
+        self._counter = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict] = None) -> Checkpoint:
+        """Persist a reported checkpoint into storage and apply retention."""
+        metrics = metrics or {}
+        self._counter += 1
+        dest = os.path.join(self.storage_path,
+                            f"checkpoint_{self._counter:06d}")
+        if checkpoint.path != dest:
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        persisted = Checkpoint(dest)
+        with open(os.path.join(dest, ".metrics.json"), "w") as f:
+            json.dump({k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, str, bool))}, f)
+        if self.score_attribute and self.score_attribute in metrics:
+            score = float(metrics[self.score_attribute])
+            if self.score_order == "min":
+                score = -score
+        else:
+            score = float(self._counter)      # recency
+        self.checkpoints.append((score, persisted, metrics))
+        self._apply_retention()
+        return persisted
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None:
+            return
+        while len(self.checkpoints) > self.num_to_keep:
+            worst_idx = min(range(len(self.checkpoints)),
+                            key=lambda i: self.checkpoints[i][0])
+            _, ckpt, _ = self.checkpoints.pop(worst_idx)
+            shutil.rmtree(ckpt.path, ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        return self.checkpoints[-1][1] if self.checkpoints else None
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self.checkpoints:
+            return None
+        return max(self.checkpoints, key=lambda c: c[0])[1]

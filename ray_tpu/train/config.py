@@ -1,0 +1,61 @@
+"""Train configs.
+
+Reference parity: python/ray/air/config.py (ScalingConfig :103,
+FailureConfig :398, CheckpointConfig :448, RunConfig :597) — TPU-first:
+`use_tpu`/`tpus_per_worker` instead of GPU fields, and placement defaults
+to STRICT_SPREAD so multi-host TPU workers land one-per-host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: int = 4           # chips per host on most slices
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"   # STRICT_SPREAD for multi-host TPU
+    accelerator_type: Optional[str] = None   # e.g. "v5p-64"
+
+    def worker_bundle(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        bundle: Dict[str, float] = {"CPU": self.cpus_per_worker}
+        if self.use_tpu:
+            bundle["TPU"] = float(self.tpus_per_worker)
+            if self.accelerator_type:
+                bundle[f"TPU-{self.accelerator_type}"] = \
+                    float(self.tpus_per_worker)
+        return bundle
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0              # group restarts allowed; -1 = infinite
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        return os.path.join(base, self.name or "train_run")

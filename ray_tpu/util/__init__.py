@@ -1,0 +1,6 @@
+from .placement_group import (placement_group, remove_placement_group,
+                              placement_group_table, PlacementGroup,
+                              tpu_pod_placement_group)
+from .scheduling_strategies import (PlacementGroupSchedulingStrategy,
+                                    NodeAffinitySchedulingStrategy,
+                                    NodeLabelSchedulingStrategy)

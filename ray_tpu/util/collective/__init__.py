@@ -1,0 +1,5 @@
+from .collective import (init_collective_group, destroy_collective_group,
+                         allreduce, allgather, reducescatter, broadcast,
+                         barrier, send, recv, ReduceOp,
+                         create_collective_group)
+from . import xla

@@ -1,0 +1,239 @@
+"""Host-side actor collectives (cross-process, object-store transport).
+
+Reference parity: ray.util.collective's group management + GLOO backend
+(python/ray/util/collective/collective.py:123-625,
+collective_group/gloo_collective_group.py) — rendezvous through a named
+actor instead of Redis; payloads move through the shm object store. This
+is the control-plane collective for host coordination (e.g. Train worker
+groups exchanging addresses/metrics); in-program tensor collectives run
+over ICI via the xla module.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda parts: _tree_reduce(np.add, parts),
+    ReduceOp.PRODUCT: lambda parts: _tree_reduce(np.multiply, parts),
+    ReduceOp.MIN: lambda parts: _tree_reduce(np.minimum, parts),
+    ReduceOp.MAX: lambda parts: _tree_reduce(np.maximum, parts),
+}
+
+
+def _tree_reduce(op, parts: List[Any]):
+    out = parts[0]
+    for p in parts[1:]:
+        out = op(out, p)
+    return out
+
+
+class _GroupActor:
+    """Rendezvous + reduction point for one collective group (async actor)."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+        self.world_size = world_size
+        self._ops: Dict[str, dict] = {}
+        self._mailbox: Dict[tuple, Any] = {}
+        self._lock = asyncio.Lock()
+        self._events: Dict[str, Any] = {}
+
+    async def _op_slot(self, key: str):
+        import asyncio
+        async with self._lock:
+            slot = self._ops.get(key)
+            if slot is None:
+                slot = {"parts": {}, "event": asyncio.Event(), "result": None}
+                self._ops[key] = slot
+            return slot
+
+    async def contribute(self, key: str, rank: int, payload,
+                         op: Optional[str], mode: str):
+        """All ranks call; returns the collective result for this op key."""
+        import asyncio
+        slot = await self._op_slot(key)
+        slot["parts"][rank] = payload
+        if len(slot["parts"]) == self.world_size:
+            ordered = [slot["parts"][r] for r in range(self.world_size)]
+            if mode == "allreduce":
+                slot["result"] = _REDUCERS[op or ReduceOp.SUM](ordered)
+            elif mode == "allgather":
+                slot["result"] = ordered
+            elif mode == "broadcast":
+                src = int(op or 0)
+                slot["result"] = slot["parts"][src]
+            elif mode == "barrier":
+                slot["result"] = True
+            elif mode == "reducescatter":
+                reduced = _REDUCERS[ReduceOp.SUM](ordered)
+                slot["result"] = reduced
+            slot["event"].set()
+        await asyncio.wait_for(slot["event"].wait(), timeout=300.0)
+        result = slot["result"]
+        slot.setdefault("claimed", 0)
+        slot["claimed"] += 1
+        if slot["claimed"] >= self.world_size:
+            self._ops.pop(key, None)
+        if mode == "reducescatter":
+            # Each rank gets its shard of the reduced tensor.
+            arr = np.asarray(result)
+            return np.array_split(arr, self.world_size, axis=0)[rank]
+        return result
+
+    async def post(self, dst_rank: int, tag: str, payload):
+        import asyncio
+        key = (dst_rank, tag)
+        self._mailbox[key] = payload
+        ev = self._events.pop(key, None)
+        if ev is not None:
+            ev.set()
+        return True
+
+    async def take(self, dst_rank: int, tag: str):
+        import asyncio
+        key = (dst_rank, tag)
+        if key not in self._mailbox:
+            ev = self._events.setdefault(key, asyncio.Event())
+            await asyncio.wait_for(ev.wait(), timeout=300.0)
+        return self._mailbox.pop(key)
+
+
+class _GroupHandle:
+    def __init__(self, actor, world_size: int, rank: int, name: str):
+        self.actor = actor
+        self.world_size = world_size
+        self.rank = rank
+        self.name = name
+        self.op_counter = 0
+        self.send_tags: Dict[int, int] = {}
+        self.recv_tags: Dict[int, int] = {}
+
+
+_groups: Dict[str, _GroupHandle] = {}
+_groups_lock = threading.Lock()
+
+
+def _group_actor_name(group_name: str) -> str:
+    return f"__collective_group__{group_name}"
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Join a collective group (all members must call this)."""
+    import ray_tpu
+
+    name = _group_actor_name(group_name)
+    actor = None
+    if rank == 0:
+        GroupActor = ray_tpu.remote(_GroupActor)
+        actor = GroupActor.options(name=name, lifetime="detached").remote(
+            world_size)
+    else:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                actor = ray_tpu.get_actor(name)
+                break
+            except ValueError:
+                time.sleep(0.2)
+        if actor is None:
+            raise TimeoutError(
+                f"collective group {group_name!r} rendezvous timed out")
+    with _groups_lock:
+        _groups[group_name] = _GroupHandle(actor, world_size, rank,
+                                           group_name)
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend: str = "host",
+                            group_name: str = "default") -> None:
+    """Declarative variant (reference collective.py:160): the caller
+    creates the group actor; member actors then call init from inside."""
+    import ray_tpu
+    GroupActor = ray_tpu.remote(_GroupActor)
+    GroupActor.options(name=_group_actor_name(group_name),
+                       lifetime="detached").remote(world_size)
+
+
+def _handle(group_name: str) -> _GroupHandle:
+    h = _groups.get(group_name)
+    if h is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"process; call init_collective_group first")
+    return h
+
+
+def _run_op(group_name: str, payload, op, mode: str):
+    import ray_tpu
+    h = _handle(group_name)
+    key = f"{mode}:{h.op_counter}"
+    h.op_counter += 1
+    return ray_tpu.get(h.actor.contribute.remote(key, h.rank, payload, op,
+                                                 mode), timeout=300)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    return _run_op(group_name, tensor, op, "allreduce")
+
+
+def allgather(tensor, group_name: str = "default") -> List:
+    return _run_op(group_name, tensor, None, "allgather")
+
+
+def reducescatter(tensor, group_name: str = "default"):
+    return _run_op(group_name, tensor, None, "reducescatter")
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _run_op(group_name, tensor, src_rank, "broadcast")
+
+
+def barrier(group_name: str = "default") -> None:
+    _run_op(group_name, None, None, "barrier")
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    import ray_tpu
+    h = _handle(group_name)
+    tag = h.send_tags.get(dst_rank, 0)
+    h.send_tags[dst_rank] = tag + 1
+    ray_tpu.get(h.actor.post.remote(dst_rank,
+                                    f"{h.rank}->{dst_rank}:{tag}", tensor),
+                timeout=300)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    import ray_tpu
+    h = _handle(group_name)
+    tag = h.recv_tags.get(src_rank, 0)
+    h.recv_tags[src_rank] = tag + 1
+    return ray_tpu.get(h.actor.take.remote(h.rank,
+                                           f"{src_rank}->{h.rank}:{tag}"),
+                       timeout=300)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu
+    with _groups_lock:
+        h = _groups.pop(group_name, None)
+    if h is not None and h.rank == 0:
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(_group_actor_name(group_name)))
+        except Exception:
+            pass

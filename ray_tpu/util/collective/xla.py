@@ -1,0 +1,63 @@
+"""In-mesh collectives: the TPU replacement for NCCL groups.
+
+Reference parity: the NCCL backend of ray.util.collective
+(collective_group/nccl_collective_group.py) — but on TPU, in-program
+collectives belong to the compiler: these are named-axis wrappers over
+jax.lax primitives, usable inside shard_map/pjit, compiled onto ICI by XLA.
+Group management is the mesh itself (parallel/mesh.py), not a rendezvous.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Axis = Union[str, Sequence[str]]
+
+
+def allreduce(x, axis_name: Axis, op: str = "sum"):
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    raise ValueError(f"unsupported op {op!r}")
+
+
+def allgather(x, axis_name: Axis, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reducescatter(x, axis_name: Axis, axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def broadcast(x, axis_name: Axis, src_rank: int = 0):
+    """Every member gets src_rank's value."""
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src_rank, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def permute(x, axis_name: Axis, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: Axis, split_axis: int = 0,
+               concat_axis: int = 0):
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
+                              tiled=True)
+
+
+def rank(axis_name: Axis):
+    return jax.lax.axis_index(axis_name)
+
+
+def world_size(axis_name: str) -> int:
+    return jax.lax.axis_size(axis_name)

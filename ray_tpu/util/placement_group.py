@@ -1,0 +1,110 @@
+"""Placement group public API.
+
+Reference parity: python/ray/util/placement_group.py:145 (placement_group,
+PlacementGroup.ready/wait, remove_placement_group, placement_group_table)
+plus a TPU pod-slice helper that builds the gang bundles the reference
+derives from TPU-{type}-head resources (accelerators/tpu.py:352-375).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .._private import state
+from .._private.ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until all bundles are reserved. Returns True if CREATED."""
+        client = state.current_client()
+        if getattr(client, "is_local_mode", False):
+            return True
+        reply = client.loop_runner.run_sync(
+            client._controller().call("pg_wait_ready", pg_id=self.id,
+                                      timeout=timeout),
+            timeout=(timeout + 5.0) if timeout else None)
+        if reply.get("state") == "FAILED":
+            from ..exceptions import PlacementGroupUnavailableError
+            raise PlacementGroupUnavailableError(
+                f"placement group failed: {reply.get('reason')}")
+        return reply.get("state") == "CREATED"
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        try:
+            return self.ready(timeout=timeout_seconds)
+        except Exception:
+            return False
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy))
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id[:12]}, {self.strategy}, " \
+               f"{len(self.bundle_specs)} bundles)"
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty "
+                         "resource dicts")
+    from .._private.placement import VALID_STRATEGIES
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; "
+                         f"one of {VALID_STRATEGIES}")
+    client = state.current_client()
+    pg_id = PlacementGroupID.generate().hex()
+    pg = PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+    if getattr(client, "is_local_mode", False):
+        client.placement_groups[pg_id] = pg
+        return pg
+    client.loop_runner.run_sync(client._controller().call(
+        "create_placement_group", pg_id=pg_id,
+        bundles=[dict(b) for b in bundles], strategy=strategy, name=name))
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    client = state.current_client()
+    if getattr(client, "is_local_mode", False):
+        client.placement_groups.pop(pg.id, None)
+        return
+    client.loop_runner.run_sync(client._controller().call(
+        "remove_placement_group", pg_id=pg.id))
+
+
+def placement_group_table() -> Dict[str, dict]:
+    client = state.current_client()
+    if getattr(client, "is_local_mode", False):
+        return {pg_id: {"state": "CREATED"}
+                for pg_id in client.placement_groups}
+    return client.loop_runner.run_sync(
+        client._controller().call("placement_group_table"))
+
+
+def tpu_pod_placement_group(num_hosts: int, chips_per_host: int = 4,
+                            accelerator_type: Optional[str] = None,
+                            include_head_resource: bool = True
+                            ) -> PlacementGroup:
+    """Gang-reserve a whole TPU pod slice: one bundle per host
+    (STRICT_SPREAD), each holding the host's chips; bundle 0 additionally
+    anchors on the slice-head resource so multi-host jobs land on exactly
+    one slice."""
+    bundles: List[Dict[str, float]] = []
+    for i in range(num_hosts):
+        b: Dict[str, float] = {"TPU": float(chips_per_host)}
+        if accelerator_type:
+            b[f"TPU-{accelerator_type}"] = float(chips_per_host)
+            if i == 0 and include_head_resource:
+                b[f"TPU-{accelerator_type}-head"] = 1.0
+        bundles.append(b)
+    return placement_group(bundles, strategy="STRICT_SPREAD",
+                           name="tpu_pod_slice")

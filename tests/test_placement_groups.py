@@ -1,0 +1,104 @@
+"""Placement groups: reserve/commit, strategies, task placement, removal.
+
+Modeled on python/ray/tests/test_placement_group*.py."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import PlacementGroupUnavailableError
+from ray_tpu.util import (placement_group, remove_placement_group,
+                          placement_group_table,
+                          PlacementGroupSchedulingStrategy)
+
+
+def test_pack_pg_reserves_and_schedules(ray_start):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= 4.0 + 1e-9  # 8 total - 4 reserved
+
+    @ray_tpu.remote(num_cpus=2)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strat = PlacementGroupSchedulingStrategy(pg, 0)
+    node = ray_tpu.get(where.options(scheduling_strategy=strat).remote(),
+                       timeout=60)
+    assert node is not None
+    remove_placement_group(pg)
+    time.sleep(0.3)
+    assert ray_tpu.available_resources()["CPU"] >= 7.9
+
+
+def test_strict_spread_needs_enough_nodes(ray_start):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    with pytest.raises(PlacementGroupUnavailableError):
+        pg.ready(timeout=30)
+
+    n1 = ray_tpu.add_fake_node(num_cpus=2)
+    n2 = ray_tpu.add_fake_node(num_cpus=2)
+    try:
+        pg2 = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+        assert pg2.ready(timeout=60)
+        table = placement_group_table()
+        nodes = {b["node_id"] for b in table[pg2.id]["bundles"]}
+        assert len(nodes) == 3, "STRICT_SPREAD must use distinct nodes"
+        remove_placement_group(pg2)
+    finally:
+        ray_tpu.remove_node(n1)
+        ray_tpu.remove_node(n2)
+
+
+def test_strict_pack_one_node(ray_start):
+    n1 = ray_tpu.add_fake_node(num_cpus=4, resources={"tag_sp": 1.0})
+    try:
+        pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+        assert pg.ready(timeout=60)
+        table = placement_group_table()
+        nodes = {b["node_id"] for b in table[pg.id]["bundles"]}
+        assert len(nodes) == 1
+        remove_placement_group(pg)
+    finally:
+        ray_tpu.remove_node(n1)
+
+
+def test_pg_infeasible_fails_fast(ray_start):
+    pg = placement_group([{"CPU": 512}], strategy="STRICT_PACK")
+    with pytest.raises(PlacementGroupUnavailableError):
+        pg.ready(timeout=30)
+
+
+def test_actor_in_pg(ray_start):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+                  ).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    # Removing the PG kills its actors.
+    remove_placement_group(pg)
+    time.sleep(1.0)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=30)
+
+
+def test_pg_bundle_capacity_enforced(ray_start):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=2)
+    def big():
+        return 1
+
+    strat = PlacementGroupSchedulingStrategy(pg, 0)
+    with pytest.raises(PlacementGroupUnavailableError):
+        ray_tpu.get(big.options(scheduling_strategy=strat).remote(),
+                    timeout=30)
+    remove_placement_group(pg)
