@@ -577,6 +577,9 @@ class Controller:
             pg.release_all(self.nodes)
         else:
             pg.mark_removed()       # wakes any pg.ready() waiters
+        # Drop the entry so long-lived drivers creating/removing many PGs
+        # (e.g. Tune sweeps) don't grow the table without bound.
+        del self.placement_groups[pg_id]
         self._sched_event.set()
         return True
 

@@ -68,6 +68,14 @@ class PlacementGroupEntry:
         currently unplaceable ("" means retry later, non-empty means never).
         """
         alive = [n for n in nodes if n.alive]
+        # Fail fast (every strategy): a bundle larger than every node's
+        # TOTAL capacity can never be placed, so don't retry forever.
+        for i, b in enumerate(self.bundles):
+            if alive and not any(
+                    all(n.resources_total.get(k, 0.0) + 1e-9 >= v
+                        for k, v in b.resources.items()) for n in alive):
+                return (f"bundle {i} {b.resources} exceeds every node's "
+                        f"total capacity")
         # Work on a scratch copy of availability so failed prepares roll back.
         scratch = {n.node_id: dict(n.resources_avail) for n in alive}
 

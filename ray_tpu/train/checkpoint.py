@@ -54,11 +54,38 @@ class Checkpoint:
             return ckptr.restore(target, item=abstract_tree)
         return ckptr.restore(target)
 
+    def pack(self) -> "PackedCheckpoint":
+        """Serialize the directory to bytes so the checkpoint can cross
+        host boundaries through the object store (workers may run on a
+        different machine than the driver)."""
+        import io
+        import tarfile
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            tar.add(self.path, arcname=".")
+        return PackedCheckpoint(buf.getvalue())
+
     def __reduce__(self):
         return (Checkpoint, (self.path,))
 
     def __repr__(self):
         return f"Checkpoint({self.path})"
+
+
+class PackedCheckpoint:
+    """Checkpoint content as bytes (tar), produced worker-side by
+    Checkpoint.pack(); materialized driver-side into storage."""
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+
+    def unpack_into(self, dest: str) -> Checkpoint:
+        import io
+        import tarfile
+        os.makedirs(dest, exist_ok=True)
+        with tarfile.open(fileobj=io.BytesIO(self.blob), mode="r") as tar:
+            tar.extractall(dest, filter="data")
+        return Checkpoint(dest)
 
 
 class CheckpointManager:
@@ -82,9 +109,12 @@ class CheckpointManager:
         self._counter += 1
         dest = os.path.join(self.storage_path,
                             f"checkpoint_{self._counter:06d}")
-        if checkpoint.path != dest:
-            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
-        persisted = Checkpoint(dest)
+        if isinstance(checkpoint, PackedCheckpoint):
+            persisted = checkpoint.unpack_into(dest)
+        else:
+            if checkpoint.path != dest:
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            persisted = Checkpoint(dest)
         with open(os.path.join(dest, ".metrics.json"), "w") as f:
             json.dump({k: v for k, v in metrics.items()
                        if isinstance(v, (int, float, str, bool))}, f)

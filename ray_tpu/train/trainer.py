@@ -55,7 +55,12 @@ class TrainWorker:
 
     def run(self, train_loop_fn: Callable, loop_config: Optional[Dict],
             context: TrainContext,
-            starting_checkpoint: Optional[Checkpoint]) -> Dict[str, Any]:
+            starting_checkpoint) -> Dict[str, Any]:
+        from .checkpoint import PackedCheckpoint
+        if isinstance(starting_checkpoint, PackedCheckpoint):
+            import tempfile
+            starting_checkpoint = starting_checkpoint.unpack_into(
+                tempfile.mkdtemp(prefix="rtpu_resume_"))
         session = _Session(context, starting_checkpoint)
         self.session = session
         _set_session(session)
@@ -72,7 +77,16 @@ class TrainWorker:
             _set_session(None)
 
     def drain_reports(self) -> List[Dict[str, Any]]:
-        return self.session.drain() if self.session is not None else []
+        if self.session is None:
+            return []
+        reports = self.session.drain()
+        # Ship checkpoint CONTENT, not a path: the driver may be on a
+        # different host, so local directories don't travel.
+        for rep in reports:
+            ckpt = rep.get("checkpoint")
+            if isinstance(ckpt, Checkpoint):
+                rep["checkpoint"] = ckpt.pack()
+        return reports
 
     def ping(self) -> str:
         return "ok"
@@ -135,7 +149,11 @@ class JaxTrainer:
         workers = []
         try:
             try:
-                pg.ready(timeout=120)
+                if not pg.ready(timeout=120):
+                    return RayTpuError(
+                        f"placement group for {n} workers not placeable "
+                        f"within 120s (cluster short on "
+                        f"{sc.worker_bundle()})")
             except Exception as e:
                 return e
             coordinator = "127.0.0.1:35123" if self.bootstrap_jax else None
@@ -159,9 +177,10 @@ class JaxTrainer:
                 storage_path=manager.storage_path,
                 group_name=f"train_{id(self)}",
             ) for i in range(n)]
+            packed_start = starting_ckpt.pack() if starting_ckpt else None
             run_refs = [w.run.remote(self.train_loop,
                                      self.train_loop_config,
-                                     contexts[i], starting_ckpt)
+                                     contexts[i], packed_start)
                         for i, w in enumerate(workers)]
             return self._poll(workers, run_refs, manager, history)
         finally:
@@ -202,18 +221,64 @@ class JaxTrainer:
         except Exception:
             return
         # Rank 0's metrics define the run history (reference semantics);
-        # any rank may attach a checkpoint.
+        # any rank may attach a checkpoint — rank 0's wins when several
+        # ranks report one in the same drain round (SPMD loops typically
+        # report identical global state from every rank).
+        ckpt_rank = min((rank for rank, reports in enumerate(all_reports)
+                         if any(r.get("checkpoint") is not None
+                                for r in reports)), default=0)
         for rank, reports in enumerate(all_reports):
             for rep in reports:
                 ckpt = rep.get("checkpoint")
                 metrics = rep.get("metrics") or {}
-                if ckpt is not None and rank == 0:
+                if ckpt is not None and rank == ckpt_rank:
                     persisted = manager.register(ckpt, metrics)
-                    metrics = dict(metrics)
-                    metrics["_checkpoint_path"] = persisted.path
+                    if rank == 0:
+                        metrics = dict(metrics)
+                        metrics["_checkpoint_path"] = persisted.path
                 if rank == 0:
                     history.append(metrics)
 
 
 # Reference-parity alias: the generic data-parallel entry point.
 DataParallelTrainer = JaxTrainer
+
+
+def _trainer_as_trainable(trainer: "JaxTrainer") -> type:
+    """Wrap a JaxTrainer into a Tune function-trainable: the trial config
+    is merged into train_loop_config, fit() runs inside the trial actor,
+    and per-report metrics stream to Tune (reference:
+    base_trainer.py:808 as_trainable — a trainer *is* a one-trial Tune
+    experiment there too)."""
+    import copy as _copy
+
+    from ..tune.trainable import wrap_function
+
+    def _tune_fn(config):
+        from ..tune import trainable as _tune_session
+        run = _copy.copy(trainer)
+        run.train_loop_config = {**(trainer.train_loop_config or {}),
+                                 **config}
+        result = run.fit()
+        if result.error is not None:
+            raise result.error
+        for report in result.metrics_dataframe or [result.metrics]:
+            metrics = report.get("metrics", report) if isinstance(
+                report, dict) else report
+            _tune_session.report(dict(metrics))
+
+    fn = _tune_fn
+    fn.__name__ = "jax_trainer"
+    return wrap_function(fn)
+
+
+JaxTrainer.as_trainable = _trainer_as_trainable
+
+
+def _tune_resources_per_trial(trainer: "JaxTrainer") -> Dict[str, float]:
+    # A trial actor only coordinates; the trainer's own worker group holds
+    # the real resources.
+    return {"CPU": 0.1}
+
+
+JaxTrainer.tune_resources_per_trial = _tune_resources_per_trial

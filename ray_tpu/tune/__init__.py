@@ -1,0 +1,31 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Reference parity: python/ray/tune — Tuner.fit (tuner.py:312) driving
+Trainable actors through a TuneController (execution/tune_controller.py:68)
+with pluggable Searchers and TrialSchedulers (ASHA/HyperBand/PBT/median).
+TPU-first: trials are gang-schedulable (a trial's trainable can itself be
+a JaxTrainer spanning a pod slice via placement groups).
+"""
+
+from .search.sample import (uniform, quniform, loguniform, qloguniform,
+                            randint, qrandint, lograndint, choice,
+                            sample_from, grid_search)
+from .search.searcher import (Searcher, BasicVariantGenerator, RandomSearch,
+                              ConcurrencyLimiter)
+from .schedulers import (TrialScheduler, FIFOScheduler, MedianStoppingRule,
+                         AsyncHyperBandScheduler, ASHAScheduler,
+                         HyperBandScheduler, PopulationBasedTraining)
+from .trainable import Trainable, report, get_checkpoint
+from .trial import Trial
+from .tuner import ResultGrid, TuneConfig, TuneResult, Tuner, run
+
+__all__ = [
+    "uniform", "quniform", "loguniform", "qloguniform", "randint",
+    "qrandint", "lograndint", "choice", "sample_from", "grid_search",
+    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
+    "MedianStoppingRule", "AsyncHyperBandScheduler", "ASHAScheduler",
+    "HyperBandScheduler", "PopulationBasedTraining", "Trainable", "report",
+    "get_checkpoint", "Trial", "ResultGrid", "TuneConfig", "TuneResult",
+    "Tuner", "run",
+]

@@ -1,0 +1,11 @@
+from .trial_scheduler import (TrialScheduler, FIFOScheduler,
+                              MedianStoppingRule, CONTINUE, PAUSE, STOP)
+from .asha import AsyncHyperBandScheduler, ASHAScheduler
+from .hyperband import HyperBandScheduler
+from .pbt import PopulationBasedTraining
+
+__all__ = [
+    "TrialScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
+    "PopulationBasedTraining", "CONTINUE", "PAUSE", "STOP",
+]

@@ -1,0 +1,51 @@
+"""Trial: driver-side record of one hyperparameter configuration.
+
+Reference parity: python/ray/tune/experiment/trial.py (status machine
+PENDING/RUNNING/PAUSED/TERMINATED/ERROR, last_result, checkpoint
+bookkeeping).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+_counter = itertools.count()
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 experiment_name: str = "exp"):
+        self.trial_id = trial_id
+        self.config = config
+        self.experiment_name = experiment_name
+        self.status = PENDING
+        self.actor = None
+        self.inflight = None            # outstanding train() ObjectRef
+        self.last_result: Dict[str, Any] = {}
+        self.results: List[Dict[str, Any]] = []
+        self.checkpoint: Any = None     # payload from Trainable.save()
+        self.error: Optional[str] = None
+        self.iteration = 0
+        self.restore_payload: Any = None
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status in (TERMINATED, ERROR)
+
+    def metric_value(self, metric: str) -> Optional[float]:
+        value = self.last_result.get(metric)
+        return None if value is None else float(value)
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status}, it={self.iteration})"
+
+
+def new_trial_id() -> str:
+    return f"trial_{next(_counter):05d}"

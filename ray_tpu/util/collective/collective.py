@@ -43,13 +43,23 @@ def _tree_reduce(op, parts: List[Any]):
 class _GroupActor:
     """Rendezvous + reduction point for one collective group (async actor)."""
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int,
+                 declared_ranks: Optional[Dict[str, int]] = None):
         import asyncio
         self.world_size = world_size
+        # actor_id -> rank, set by create_collective_group so member
+        # actors can auto-join without an explicit init call.
+        self.declared_ranks = declared_ranks or {}
         self._ops: Dict[str, dict] = {}
         self._mailbox: Dict[tuple, Any] = {}
         self._lock = asyncio.Lock()
         self._events: Dict[str, Any] = {}
+
+    async def declared_rank_of(self, actor_id: str):
+        return self.declared_ranks.get(actor_id)
+
+    async def get_world_size(self) -> int:
+        return self.world_size
 
     async def _op_slot(self, key: str):
         import asyncio
@@ -162,19 +172,54 @@ def create_collective_group(actors, world_size: int, ranks: List[int],
                             backend: str = "host",
                             group_name: str = "default") -> None:
     """Declarative variant (reference collective.py:160): the caller
-    creates the group actor; member actors then call init from inside."""
+    declares the members and their ranks; member actors then auto-join on
+    their first collective op (or call init_collective_group explicitly)."""
     import ray_tpu
+    if len(actors) != world_size or len(ranks) != world_size:
+        raise ValueError(
+            f"need exactly world_size={world_size} actors and ranks "
+            f"(got {len(actors)} actors, {len(ranks)} ranks)")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(f"ranks must be a permutation of 0..{world_size-1}, "
+                         f"got {ranks}")
+    declared = {a._actor_id: r for a, r in zip(actors, ranks)}
     GroupActor = ray_tpu.remote(_GroupActor)
     GroupActor.options(name=_group_actor_name(group_name),
-                       lifetime="detached").remote(world_size)
+                       lifetime="detached").remote(world_size, declared)
 
 
 def _handle(group_name: str) -> _GroupHandle:
     h = _groups.get(group_name)
     if h is None:
+        h = _try_autojoin(group_name)
+    if h is None:
         raise RuntimeError(
             f"collective group {group_name!r} not initialized in this "
-            f"process; call init_collective_group first")
+            f"process; call init_collective_group first (or declare it "
+            f"with create_collective_group)")
+    return h
+
+
+def _try_autojoin(group_name: str) -> Optional[_GroupHandle]:
+    """Inside an actor declared via create_collective_group: look up our
+    rank from the group actor and join."""
+    import ray_tpu
+    actor_id = ray_tpu.get_runtime_context().get_actor_id()
+    if actor_id is None:
+        return None
+    try:
+        group_actor = ray_tpu.get_actor(_group_actor_name(group_name))
+        rank = ray_tpu.get(group_actor.declared_rank_of.remote(actor_id),
+                           timeout=30)
+    except Exception:
+        return None
+    if rank is None:
+        return None
+    world_size = ray_tpu.get(group_actor.get_world_size.remote(), timeout=30)
+    with _groups_lock:
+        h = _groups.setdefault(
+            group_name, _GroupHandle(group_actor, world_size, rank,
+                                     group_name))
     return h
 
 
@@ -232,7 +277,10 @@ def destroy_collective_group(group_name: str = "default") -> None:
     import ray_tpu
     with _groups_lock:
         h = _groups.pop(group_name, None)
-    if h is not None and h.rank == 0:
+    if h is not None:
+        # Any rank tears down the detached rendezvous actor — relying on
+        # rank 0 alone leaks it whenever rank 0 dies first, and the name
+        # could then never be reused.
         try:
             ray_tpu.kill(ray_tpu.get_actor(_group_actor_name(group_name)))
         except Exception:

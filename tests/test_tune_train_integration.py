@@ -1,0 +1,28 @@
+"""Tune over JaxTrainer: trainer-as-trainable path (base_trainer.py:808)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train, tune
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+
+def test_tuner_over_jax_trainer(ray_start, tmp_path):
+    def loop(config):
+        for step in range(3):
+            train.report({"loss": config["lr"] * (step + 1)})
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="tt", storage_path=str(tmp_path)))
+    tuner = Tuner(trainer,
+                  param_space={"lr": tune.grid_search([0.1, 0.3])},
+                  tune_config=TuneConfig(metric="loss", mode="min",
+                                         max_concurrent_trials=1))
+    results = tuner.fit()
+    assert len(results) == 2
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["lr"] == 0.1
