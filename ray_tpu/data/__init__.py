@@ -1,0 +1,23 @@
+"""ray_tpu.data: lazy, streaming, Arrow-block datasets.
+
+Reference parity: python/ray/data (Dataset dataset.py:154, logical plan
+_internal/logical/, StreamingExecutor _internal/execution/
+streaming_executor.py:48) — capability parity, TPU-first execution:
+batches hand off to jax.Arrays placed on mesh shardings with
+prefetch (`Dataset.iter_jax_batches`).
+"""
+
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import Block, BlockAccessor
+from .dataset import Dataset, GroupedData, MaterializedDataset
+from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
+                         range, read_binary_files, read_csv, read_datasource,
+                         read_json, read_numpy, read_parquet, read_text)
+
+__all__ = [
+    "Dataset", "MaterializedDataset", "GroupedData", "Block",
+    "BlockAccessor", "AggregateFn", "Count", "Sum", "Min", "Max", "Mean",
+    "Std", "range", "from_items", "from_numpy", "from_arrow", "from_pandas",
+    "read_parquet", "read_csv", "read_json", "read_text", "read_numpy",
+    "read_binary_files", "read_datasource",
+]

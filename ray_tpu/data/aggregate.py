@@ -1,0 +1,157 @@
+"""Groupby + aggregations.
+
+Reference parity: python/ray/data/aggregate.py and grouped_data.py —
+AggregateFn protocol (init/accumulate/merge/finalize) with built-ins
+Count/Sum/Min/Max/Mean/Std; execution is hash-partition + per-partition
+sorted aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from . import logical as L
+from .block import Block, BlockAccessor, concat_blocks
+
+
+class AggregateFn:
+    def __init__(self, *, init: Callable[[], Any],
+                 accumulate: Callable[[Any, np.ndarray], Any],
+                 merge: Callable[[Any, Any], Any],
+                 finalize: Callable[[Any], Any],
+                 name: str, on: Optional[str] = None):
+        self.init = init
+        self.accumulate = accumulate
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+        self.on = on
+
+
+def Count() -> AggregateFn:
+    return AggregateFn(
+        init=lambda: 0,
+        accumulate=lambda a, vals: a + len(vals),
+        merge=lambda a, b: a + b,
+        finalize=lambda a: a,
+        name="count()")
+
+
+def _col_agg(op_name: str, on: str, np_fn, merge_fn) -> AggregateFn:
+    return AggregateFn(
+        init=lambda: None,
+        accumulate=lambda a, vals: (
+            np_fn(vals) if a is None else merge_fn(a, np_fn(vals))
+        ) if len(vals) else a,
+        merge=lambda a, b: b if a is None else (a if b is None
+                                                else merge_fn(a, b)),
+        finalize=lambda a: a,
+        name=f"{op_name}({on})", on=on)
+
+
+def Sum(on: str) -> AggregateFn:
+    return _col_agg("sum", on, np.sum, lambda a, b: a + b)
+
+
+def Min(on: str) -> AggregateFn:
+    return _col_agg("min", on, np.min, min)
+
+
+def Max(on: str) -> AggregateFn:
+    return _col_agg("max", on, np.max, max)
+
+
+def Mean(on: str) -> AggregateFn:
+    return AggregateFn(
+        init=lambda: (0.0, 0),
+        accumulate=lambda a, vals: (a[0] + float(np.sum(vals)),
+                                    a[1] + len(vals)),
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        finalize=lambda a: a[0] / a[1] if a[1] else None,
+        name=f"mean({on})", on=on)
+
+
+def Std(on: str, ddof: int = 1) -> AggregateFn:
+    # Chan et al. parallel variance: track (count, mean, M2).
+    def acc(a, vals):
+        n0, mu0, m20 = a
+        n1 = len(vals)
+        if n1 == 0:
+            return a
+        mu1 = float(np.mean(vals))
+        m21 = float(np.var(vals)) * n1
+        return _merge((n0, mu0, m20), (n1, mu1, m21))
+
+    def _merge(a, b):
+        n0, mu0, m20 = a
+        n1, mu1, m21 = b
+        n = n0 + n1
+        if n == 0:
+            return a
+        delta = mu1 - mu0
+        mu = mu0 + delta * n1 / n
+        m2 = m20 + m21 + delta * delta * n0 * n1 / n
+        return (n, mu, m2)
+
+    return AggregateFn(
+        init=lambda: (0, 0.0, 0.0),
+        accumulate=acc, merge=_merge,
+        finalize=lambda a: float(np.sqrt(a[2] / (a[0] - ddof)))
+        if a[0] > ddof else None,
+        name=f"std({on})", on=on)
+
+
+def groupby_execute(op: L.GroupByAggregate, upstream, backend,
+                    max_in_flight) -> Iterator[Block]:
+    """Hash-aggregate across all input blocks; one output block, sorted
+    by key (global aggregate when key is None)."""
+    from .execution import _as_blocks
+
+    states: Dict[Any, List[Any]] = {}
+
+    def touch(key):
+        if key not in states:
+            states[key] = [agg.init() for agg in op.aggs]
+        return states[key]
+
+    for ref in upstream:
+        for block in _as_blocks(backend.get(ref)):
+            if block.num_rows == 0:
+                continue
+            cols = {c: block.column(c).to_numpy(zero_copy_only=False)
+                    for c in block.column_names}
+            if op.key is None:
+                st = touch(None)
+                for i, agg in enumerate(op.aggs):
+                    vals = cols[agg.on] if agg.on else cols[
+                        next(iter(cols))]
+                    st[i] = agg.accumulate(st[i], vals)
+                continue
+            keys = cols[op.key]
+            order = np.argsort(keys, kind="stable")
+            sorted_keys = keys[order]
+            uniq, starts = np.unique(sorted_keys, return_index=True)
+            starts = list(starts) + [len(sorted_keys)]
+            for u_i, key in enumerate(uniq):
+                sel = order[starts[u_i]:starts[u_i + 1]]
+                st = touch(key.item() if hasattr(key, "item") else key)
+                for i, agg in enumerate(op.aggs):
+                    vals = cols[agg.on][sel] if agg.on else \
+                        np.empty(len(sel))
+                    st[i] = agg.accumulate(st[i], vals)
+
+    if not states:
+        return
+    if op.key is None:
+        row = {agg.name: agg.finalize(st_i)
+               for agg, st_i in zip(op.aggs, states[None])}
+        yield pa.table({k: [v] for k, v in row.items()})
+        return
+    keys_sorted = sorted(states.keys())
+    out: Dict[str, list] = {op.key: list(keys_sorted)}
+    for i, agg in enumerate(op.aggs):
+        out[agg.name] = [agg.finalize(states[k][i]) for k in keys_sorted]
+    yield pa.table(out)

@@ -1,0 +1,153 @@
+"""Blocks: the unit of data movement in ray_tpu.data.
+
+A block is a pyarrow.Table. BlockAccessor wraps one block with
+format-agnostic helpers (rows, batches, slicing, size accounting).
+
+Reference parity: python/ray/data/block.py (BlockAccessor) — semantics
+only; this implementation is Arrow-native with numpy-dict batch views
+so batches hand off zero-copy into jnp.asarray where dtypes allow.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Row = Dict[str, Any]
+Batch = Union[pa.Table, Dict[str, np.ndarray], "pandas.DataFrame"]
+
+VALUE_COL = "value"  # column name used for simple (non-tabular) datasets
+
+
+def _to_table(data: Any) -> pa.Table:
+    """Coerce rows / dicts-of-arrays / tables / numpy into an Arrow table."""
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        cols = {k: _as_array(v) for k, v in data.items()}
+        return pa.table(cols)
+    if data.__class__.__module__.split(".")[0] == "pandas":
+        return pa.Table.from_pandas(data, preserve_index=False)
+    if isinstance(data, np.ndarray):
+        return pa.table({VALUE_COL: _as_array(data)})
+    if isinstance(data, list):
+        if not data:
+            return pa.table({})
+        if isinstance(data[0], dict):
+            keys = list(data[0].keys())
+            return pa.table(
+                {k: _as_array([row[k] for row in data]) for k in keys})
+        return pa.table({VALUE_COL: _as_array(data)})
+    raise TypeError(f"cannot convert {type(data)} to a block")
+
+
+def _as_array(v: Any) -> pa.Array:
+    if isinstance(v, (pa.Array, pa.ChunkedArray)):
+        return v
+    arr = np.asarray(v)
+    if arr.ndim > 1:
+        # Tensor column: store as fixed-size-list of flattened rows.
+        flat = arr.reshape(arr.shape[0], -1)
+        inner = pa.array(flat.ravel())
+        return pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+    return pa.array(arr)
+
+
+def from_pandas_df(df) -> pa.Table:
+    return pa.Table.from_pandas(df, preserve_index=False)
+
+
+class BlockAccessor:
+    """Format-agnostic view over one Arrow block."""
+
+    def __init__(self, block: Block):
+        if not isinstance(block, pa.Table):
+            block = _to_table(block)
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> Optional[pa.Schema]:
+        return self._table.schema if self._table.num_columns else None
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take(self, indices: List[int]) -> Block:
+        return self._table.take(pa.array(indices, type=pa.int64()))
+
+    def iter_rows(self) -> Iterator[Row]:
+        cols = self._table.column_names
+        for i in range(self._table.num_rows):
+            yield {c: self._table.column(c)[i].as_py() for c in cols}
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in self._table.column_names:
+            col = self._table.column(name).combine_chunks()
+            if isinstance(col, pa.ChunkedArray):
+                col = col.chunk(0) if col.num_chunks else pa.array([])
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                flat = col.flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape(len(col), width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_batch(self, batch_format: str) -> Batch:
+        if batch_format in ("numpy", "np"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow", None, "default"):
+            return self._table
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def sample(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        n = min(n, self.num_rows())
+        idx = rng.choice(self.num_rows(), size=n, replace=False)
+        return self.take([int(i) for i in idx])
+
+    def sort_indices(self, key: str, descending: bool = False) -> np.ndarray:
+        col = self._table.column(key).combine_chunks().to_numpy(
+            zero_copy_only=False)
+        order = np.argsort(col, kind="stable")
+        return order[::-1] if descending else order
+
+
+def batch_to_block(batch: Batch) -> Block:
+    """Convert a user-returned batch back into an Arrow block."""
+    return _to_table(batch)
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    if not blocks:
+        return pa.table({})
+    if len(blocks) == 1:
+        return blocks[0]
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def block_from_rows(rows: List[Row]) -> Block:
+    return _to_table(rows)

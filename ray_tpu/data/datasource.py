@@ -1,0 +1,176 @@
+"""Datasources: read_* / from_* / write helpers.
+
+Reference parity: python/ray/data/read_api.py + data/datasource/ —
+each read produces a list of read tasks (thunks returning blocks) so
+the streaming executor parallelizes and the Read op can stop early
+under a pushed-down limit.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from . import logical as L
+from .block import VALUE_COL, Block, _to_table
+from .dataset import Dataset, MaterializedDataset
+
+DEFAULT_BLOCK_ROWS = 1000
+
+
+# -- in-memory sources ------------------------------------------------------
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    k = _parallelism(parallelism, n)
+    bounds = np.linspace(0, n, k + 1, dtype=np.int64)
+
+    def make_task(lo: int, hi: int) -> Callable[[], Block]:
+        return lambda: pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    tasks = [make_task(int(bounds[i]), int(bounds[i + 1]))
+             for i in builtins.range(k)]
+    return Dataset(L.Read(tasks, source_name=f"range({n})"))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    k = _parallelism(parallelism, len(items))
+    blocks = []
+    bounds = np.linspace(0, len(items), k + 1, dtype=np.int64)
+    for i in builtins.range(k):
+        chunk = items[int(bounds[i]):int(bounds[i + 1])]
+        if chunk or i == 0:
+            blocks.append(_to_table(
+                chunk if chunk and isinstance(chunk[0], dict)
+                else {"item": chunk}))
+    return MaterializedDataset(blocks)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return MaterializedDataset([_to_table({column: arr})])
+
+
+def from_arrow(table: pa.Table) -> Dataset:
+    return MaterializedDataset([table])
+
+
+def from_pandas(df) -> Dataset:
+    return MaterializedDataset([pa.Table.from_pandas(
+        df, preserve_index=False)])
+
+
+# -- file sources -----------------------------------------------------------
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, f"*{suffix}"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+def _file_read(paths, suffix: str, reader: Callable[[str], Block],
+               name: str) -> Dataset:
+    files = _expand_paths(paths, suffix)
+
+    def make_task(f: str) -> Callable[[], Block]:
+        return lambda: reader(f)
+
+    return Dataset(L.Read([make_task(f) for f in files], source_name=name))
+
+
+def read_parquet(paths, **kw) -> Dataset:
+    import pyarrow.parquet as pq
+    return _file_read(paths, ".parquet",
+                      lambda f: pq.read_table(f, **kw), "Parquet")
+
+
+def read_csv(paths, **kw) -> Dataset:
+    import pyarrow.csv as pcsv
+    return _file_read(paths, ".csv",
+                      lambda f: pcsv.read_csv(f, **kw), "CSV")
+
+
+def read_json(paths, **kw) -> Dataset:
+    def reader(f: str) -> Block:
+        with open(f) as fh:
+            rows = [json.loads(line) for line in fh if line.strip()]
+        return _to_table(rows)
+    return _file_read(paths, ".jsonl", reader, "JSON")
+
+
+def read_text(paths, **kw) -> Dataset:
+    def reader(f: str) -> Block:
+        with open(f) as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+        return pa.table({"text": lines})
+    return _file_read(paths, ".txt", reader, "Text")
+
+
+def read_numpy(paths, **kw) -> Dataset:
+    def reader(f: str) -> Block:
+        return _to_table({"data": np.load(f)})
+    return _file_read(paths, ".npy", reader, "Numpy")
+
+
+def read_binary_files(paths, **kw) -> Dataset:
+    def reader(f: str) -> Block:
+        with open(f, "rb") as fh:
+            return pa.table({"path": [f], "bytes": [fh.read()]})
+    return _file_read(paths, "", reader, "Binary")
+
+
+def read_datasource(read_tasks: List[Callable[[], Block]],
+                    name: str = "Custom") -> Dataset:
+    """Escape hatch: bring your own read tasks."""
+    return Dataset(L.Read(list(read_tasks), source_name=name))
+
+
+# -- writes -----------------------------------------------------------------
+
+def write_blocks(blocks: Iterable[Block], path: str, fmt: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    for i, block in enumerate(blocks):
+        f = os.path.join(path, f"part-{i:05d}.{fmt}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(block, f)
+        elif fmt == "csv":
+            import pyarrow.csv as pcsv
+            pcsv.write_csv(block, f)
+        elif fmt == "json":
+            from .block import BlockAccessor
+            with open(f, "w") as fh:
+                for row in BlockAccessor(block).iter_rows():
+                    fh.write(json.dumps(row, default=_json_default) + "\n")
+        else:
+            raise ValueError(fmt)
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(type(o))
+
+
+def _parallelism(parallelism: int, n: int) -> int:
+    if parallelism and parallelism > 0:
+        return min(parallelism, max(n, 1))
+    return max(1, min(8, (n + DEFAULT_BLOCK_ROWS - 1) // DEFAULT_BLOCK_ROWS))

@@ -1,0 +1,477 @@
+"""Streaming execution of logical plans.
+
+Reference parity: python/ray/data/_internal/execution/ (StreamingExecutor,
+TaskPoolMapOperator, ActorPoolMapOperator, backpressure) — semantics only.
+
+Design: each operator is a generator transform over an iterator of block
+refs. Map operators keep a bounded window of in-flight tasks (backpressure)
+and yield blocks in order, overlapping upstream production with task
+execution. All-to-all ops (shuffle/sort/groupby/repartition) are
+map+reduce over tasks. Two compute backends:
+
+- ClusterBackend: blocks flow as ObjectRefs through ray_tpu tasks/actors.
+- InlineBackend: thread pool in-process (no cluster needed) — also the
+  path Train/RL data loading uses on a single host, where the GIL is
+  released inside Arrow/numpy.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from . import logical as L
+from .block import (Block, BlockAccessor, batch_to_block, block_from_rows,
+                    concat_blocks)
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+# ---------------------------------------------------------------------------
+# block transforms compiled from map-like logical ops
+# ---------------------------------------------------------------------------
+
+def _apply_stage(stage_kind: str, fn: Callable, blocks: List[Block],
+                 batch_size: Optional[int], batch_format: str) -> List[Block]:
+    out: List[Block] = []
+    for block in blocks:
+        acc = BlockAccessor(block)
+        if stage_kind == "map_rows":
+            out.append(block_from_rows([fn(r) for r in acc.iter_rows()]))
+        elif stage_kind == "filter":
+            mask = [bool(fn(r)) for r in acc.iter_rows()]
+            idx = [i for i, m in enumerate(mask) if m]
+            out.append(acc.take(idx) if idx else block.slice(0, 0))
+        elif stage_kind == "flat_map":
+            rows: List[dict] = []
+            for r in acc.iter_rows():
+                rows.extend(fn(r))
+            out.append(block_from_rows(rows))
+        elif stage_kind == "map_batches":
+            n = acc.num_rows()
+            bs = batch_size or n or 1
+            for start in range(0, max(n, 1), bs):
+                if n == 0 and start > 0:
+                    break
+                piece = BlockAccessor(acc.slice(start, min(start + bs, n))) \
+                    if n else acc
+                result = fn(piece.to_batch(batch_format))
+                out.append(batch_to_block(result))
+        else:
+            raise ValueError(stage_kind)
+    return out
+
+
+def compile_map_transform(op: L.AbstractMap) -> Callable[[Block], List[Block]]:
+    """Build a picklable block->blocks function for a (possibly fused) op."""
+    stages: List[Tuple[str, Callable, Optional[int], str]] = []
+    for s in (op.stages if isinstance(op, L.FusedMap) else [op]):
+        stages.append((s.fn_kind, s.fn, s.batch_size, s.batch_format))
+
+    def transform(block: Block, _stages=tuple(stages)) -> List[Block]:
+        blocks = [block]
+        for kind, fn, bs, fmt in _stages:
+            blocks = _apply_stage(kind, fn, blocks, bs, fmt)
+        return blocks
+
+    return transform
+
+
+class _ActorTransform:
+    """Stateful transform: constructs the callable-class stages once per
+    worker, then applies the stage chain to each block."""
+
+    def __init__(self, stage_specs: List[tuple]):
+        self._stages = []
+        for kind, fn_or_ctor, bs, fmt, is_ctor in stage_specs:
+            if is_ctor:
+                cls, args, kwargs = fn_or_ctor
+                fn = cls(*args, **kwargs)
+            else:
+                fn = fn_or_ctor
+            self._stages.append((kind, fn, bs, fmt))
+
+    def __call__(self, block: Block) -> List[Block]:
+        blocks = [block]
+        for kind, fn, bs, fmt in self._stages:
+            blocks = _apply_stage(kind, fn, blocks, bs, fmt)
+        return blocks
+
+
+def actor_stage_specs(op: L.AbstractMap) -> List[tuple]:
+    specs = []
+    for s in (op.stages if isinstance(op, L.FusedMap) else [op]):
+        if s.fn_constructor is not None:
+            specs.append((s.fn_kind, s.fn_constructor, s.batch_size,
+                          s.batch_format, True))
+        else:
+            specs.append((s.fn_kind, s.fn, s.batch_size, s.batch_format,
+                          False))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# compute backends
+# ---------------------------------------------------------------------------
+
+class InlineBackend:
+    """Thread-pool execution in-process."""
+
+    name = "inline"
+
+    def __init__(self, max_workers: int = 8):
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="ray_tpu_data")
+
+    def submit(self, fn: Callable, *args, **resources) -> Any:
+        return self._pool.submit(fn, *args)
+
+    def get(self, ref: Any) -> Any:
+        return ref.result() if isinstance(
+            ref, concurrent.futures.Future) else ref
+
+    def make_pool(self, stage_specs: List[tuple], size: int) -> "_InlinePool":
+        return _InlinePool(self, stage_specs, size)
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False)
+
+
+class _InlinePool:
+    def __init__(self, backend: InlineBackend, stage_specs, size: int):
+        self._backend = backend
+        self._workers = [_ActorTransform(stage_specs) for _ in range(size)]
+        self._rr = 0
+
+    def submit(self, block_ref) -> Any:
+        w = self._workers[self._rr % len(self._workers)]
+        self._rr += 1
+        block = self._backend.get(block_ref)
+        return self._backend.submit(w, block)
+
+    def shutdown(self):
+        pass
+
+
+def _run_data_task(payload: bytes, *args) -> Any:
+    """Module-level task body (picklable by reference on workers): unpickle
+    the function and apply it to the (runtime-resolved) args."""
+    import cloudpickle
+    fn = cloudpickle.loads(payload)
+    return fn(*args)
+
+
+class _MapWorkerActor:
+    """Actor holding a constructed stateful transform (cluster mode)."""
+
+    def __init__(self, specs_payload: bytes):
+        import cloudpickle
+        self._transform = _ActorTransform(cloudpickle.loads(specs_payload))
+
+    def apply(self, block: Block) -> List[Block]:
+        return self._transform(block)
+
+
+class ClusterBackend:
+    """Executes block transforms as ray_tpu tasks / actors."""
+
+    name = "cluster"
+
+    def __init__(self):
+        import ray_tpu
+        self._ray = ray_tpu
+
+    def submit(self, fn: Callable, *args, num_cpus=None, num_tpus=None):
+        import cloudpickle
+        from ray_tpu.remote_function import RemoteFunction
+        opts: Dict[str, Any] = {"num_cpus": num_cpus or 1}
+        if num_tpus:
+            opts["num_tpus"] = num_tpus
+        task = RemoteFunction(_run_data_task, opts)
+        return task.remote(cloudpickle.dumps(fn), *args)
+
+    def get(self, ref: Any) -> Any:
+        from ray_tpu._private.object_ref import ObjectRef
+        if isinstance(ref, ObjectRef):
+            return self._ray.get(ref)
+        return ref
+
+    def make_pool(self, stage_specs: List[tuple], size: int) -> "_ActorPool":
+        return _ActorPool(self, stage_specs, size)
+
+
+class _ActorPool:
+    def __init__(self, backend: ClusterBackend, stage_specs, size: int):
+        import cloudpickle
+        import ray_tpu
+        payload = cloudpickle.dumps(stage_specs)
+        cls = ray_tpu.remote(_MapWorkerActor)
+        self._actors = [cls.remote(payload) for _ in range(size)]
+        self._rr = 0
+
+    def submit(self, block_ref) -> Any:
+        a = self._actors[self._rr % len(self._actors)]
+        self._rr += 1
+        return a.apply.remote(block_ref)
+
+    def shutdown(self):
+        import ray_tpu
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def pick_backend() -> Any:
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        client = None
+        try:
+            from ray_tpu._private import state
+            client = state.current_client()
+        except Exception:
+            pass
+        if client is not None and not getattr(client, "is_local_mode", False):
+            return ClusterBackend()
+    return InlineBackend()
+
+
+# ---------------------------------------------------------------------------
+# streaming operator iterators
+# ---------------------------------------------------------------------------
+
+def _windowed(upstream: Iterator[Any], submit: Callable[[Any], Any],
+              resolve: Callable[[Any], Any],
+              max_in_flight: int) -> Iterator[Block]:
+    """Submit one task per upstream ref with bounded in-flight window;
+    yield each task's resulting blocks in order."""
+    pending: "collections.deque[Any]" = collections.deque()
+    for ref in upstream:
+        while len(pending) >= max_in_flight:
+            for blk in resolve(pending.popleft()):
+                yield blk
+        pending.append(submit(ref))
+    while pending:
+        for blk in resolve(pending.popleft()):
+            yield blk
+
+
+def execute_plan(plan: L.LogicalOp, backend, *,
+                 max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                 ) -> Iterator[Block]:
+    """Yield materialized output blocks of the optimized plan."""
+    plan = L.optimize(plan)
+    it = _build_iter(plan, backend, max_in_flight)
+    for ref in it:
+        blk = backend.get(ref)
+        if isinstance(blk, list):
+            for b in blk:
+                yield b
+        else:
+            yield blk
+
+
+def _build_iter(op: L.LogicalOp, backend, max_in_flight) -> Iterator[Any]:
+    """Returns an iterator of block refs (or blocks) for `op`'s output."""
+    if isinstance(op, L.InputData):
+        return iter(op.blocks)
+    if isinstance(op, L.Read):
+        return _read_iter(op, backend, max_in_flight)
+    upstream = _build_iter(op.input_op, backend, max_in_flight) \
+        if op.input_op is not None else iter(())
+    if isinstance(op, L.AbstractMap):
+        return _map_iter(op, upstream, backend, max_in_flight)
+    if isinstance(op, L.Limit):
+        return _limit_iter(op, upstream, backend)
+    if isinstance(op, L.Union):
+        extra = [_build_iter(o, backend, max_in_flight) for o in op.others]
+
+        def chain():
+            yield from upstream
+            for e in extra:
+                yield from e
+        return chain()
+    if isinstance(op, L.Zip):
+        other = _build_iter(op.other, backend, max_in_flight)
+        return _zip_iter(upstream, other, backend)
+    if isinstance(op, L.RandomShuffle):
+        return _shuffle_iter(op, upstream, backend, max_in_flight)
+    if isinstance(op, L.Repartition):
+        return _repartition_iter(op, upstream, backend)
+    if isinstance(op, L.Sort):
+        return _sort_iter(op, upstream, backend, max_in_flight)
+    if isinstance(op, L.GroupByAggregate):
+        from .aggregate import groupby_execute
+        return groupby_execute(op, upstream, backend, max_in_flight)
+    raise NotImplementedError(f"no physical op for {op!r}")
+
+
+def _read_iter(op: L.Read, backend, max_in_flight) -> Iterator[Any]:
+    tasks = list(op.read_tasks)
+    row_limit = op.row_limit
+
+    if row_limit is None:
+        yield from _windowed(
+            iter(tasks), lambda t: backend.submit(_call_thunk, t),
+            lambda ref: _as_blocks(backend.get(ref)), max_in_flight)
+        return
+    # With a pushed-down limit, read sequentially until satisfied.
+    produced = 0
+    for t in tasks:
+        if produced >= row_limit:
+            break
+        for blk in _as_blocks(backend.get(backend.submit(_call_thunk, t))):
+            yield blk
+            produced += BlockAccessor(blk).num_rows()
+            if produced >= row_limit:
+                break
+
+
+def _call_thunk(t):
+    return t()
+
+
+def _as_blocks(result) -> List[Block]:
+    if isinstance(result, list):
+        return result
+    return [result]
+
+
+def _map_iter(op: L.AbstractMap, upstream, backend, max_in_flight):
+    if op.uses_actors:
+        size = op.concurrency if isinstance(op.concurrency, int) else 2
+        pool = backend.make_pool(actor_stage_specs(op), size)
+        try:
+            yield from _windowed(
+                upstream, pool.submit,
+                lambda ref: _as_blocks(backend.get(ref)), max_in_flight)
+        finally:
+            pool.shutdown()
+        return
+    transform = compile_map_transform(op)
+    yield from _windowed(
+        upstream,
+        lambda block: backend.submit(
+            transform, block, num_cpus=op.num_cpus, num_tpus=op.num_tpus),
+        lambda ref: _as_blocks(backend.get(ref)), max_in_flight)
+
+
+def _limit_iter(op: L.Limit, upstream, backend):
+    remaining = op.limit
+    for ref in upstream:
+        if remaining <= 0:
+            return
+        for blk in _as_blocks(backend.get(ref)):
+            acc = BlockAccessor(blk)
+            if acc.num_rows() <= remaining:
+                remaining -= acc.num_rows()
+                yield blk
+            else:
+                yield acc.slice(0, remaining)
+                remaining = 0
+            if remaining <= 0:
+                return
+
+
+def _zip_iter(upstream, other, backend):
+    left = concat_blocks([b for r in upstream
+                          for b in _as_blocks(backend.get(r))])
+    right = concat_blocks([b for r in other
+                           for b in _as_blocks(backend.get(r))])
+    if left.num_rows != right.num_rows:
+        raise ValueError(
+            f"zip requires equal row counts ({left.num_rows} vs "
+            f"{right.num_rows})")
+    cols = {name: left.column(name) for name in left.column_names}
+    for name in right.column_names:
+        out = name if name not in cols else f"{name}_1"
+        cols[out] = right.column(name)
+    yield pa.table(cols)
+
+
+def _shuffle_iter(op: L.RandomShuffle, upstream, backend, max_in_flight):
+    """2-phase map/reduce shuffle: each block randomly partitioned into k
+    parts; reducer i concatenates part i of every block and permutes."""
+    refs = list(upstream)
+    k = max(len(refs), 1)
+    seed = op.seed
+
+    def partition(block: Block, i: int) -> List[Block]:
+        rng = np.random.default_rng(
+            None if seed is None else seed + 17 * i)
+        n = block.num_rows
+        assign = rng.integers(0, k, size=n)
+        acc = BlockAccessor(block)
+        return [acc.take(list(np.nonzero(assign == j)[0])) for j in range(k)]
+
+    parts: List[List[Block]] = []
+    for i, ref in enumerate(refs):
+        for blk in _as_blocks(backend.get(ref)):
+            parts.append(partition(blk, i))
+
+    def reduce_part(j: int) -> Block:
+        merged = concat_blocks([p[j] for p in parts]) if parts \
+            else pa.table({})
+        rng = np.random.default_rng(None if seed is None else seed + j)
+        order = rng.permutation(merged.num_rows)
+        return BlockAccessor(merged).take([int(x) for x in order])
+
+    for j in range(k):
+        if parts:
+            yield reduce_part(j)
+
+
+def _repartition_iter(op: L.Repartition, upstream, backend):
+    merged = concat_blocks(
+        [b for r in upstream for b in _as_blocks(backend.get(r))])
+    n = merged.num_rows
+    k = op.num_blocks
+    base, rem = divmod(n, k)
+    start = 0
+    for i in range(k):
+        size = base + (1 if i < rem else 0)
+        yield merged.slice(start, size)
+        start += size
+
+
+def _sort_iter(op: L.Sort, upstream, backend, max_in_flight):
+    """Sample-based range partition sort (parallel-friendly semantics)."""
+    blocks = [b for r in upstream for b in _as_blocks(backend.get(r))]
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return
+    k = len(blocks)
+    # Sample boundaries.
+    samples = np.concatenate([
+        BlockAccessor(b).sample(min(20, b.num_rows), seed=0)
+        .column(op.key).to_numpy(zero_copy_only=False) for b in blocks])
+    samples = np.sort(samples)
+    bounds = [samples[int(len(samples) * (i + 1) / k)]
+              for i in range(k - 1)] if k > 1 else []
+
+    def part_of(vals):
+        return np.searchsorted(np.asarray(bounds), vals, side="right") \
+            if bounds else np.zeros(len(vals), dtype=np.int64)
+
+    parts: List[List[Block]] = [[] for _ in range(k)]
+    for b in blocks:
+        vals = b.column(op.key).to_numpy(zero_copy_only=False)
+        pid = part_of(vals)
+        acc = BlockAccessor(b)
+        for j in range(k):
+            idx = np.nonzero(pid == j)[0]
+            if len(idx):
+                parts[j].append(acc.take([int(x) for x in idx]))
+    order = range(k - 1, -1, -1) if op.descending else range(k)
+    for j in order:
+        if not parts[j]:
+            continue
+        merged = concat_blocks(parts[j])
+        idx = BlockAccessor(merged).sort_indices(op.key, op.descending)
+        yield BlockAccessor(merged).take([int(x) for x in idx])

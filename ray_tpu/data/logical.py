@@ -1,0 +1,264 @@
+"""Logical plan: operators + optimizer rules.
+
+Reference parity: python/ray/data/_internal/logical/ (operators and
+rules operator_fusion / limit_pushdown — semantics only). A plan is a
+linear chain (Union/Zip hold extra inputs); optimization rewrites the
+chain before the streaming executor plans physical operators.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LogicalOp:
+    """Base logical operator. `input_op` forms the chain."""
+    name = "Op"
+
+    def __init__(self, input_op: Optional["LogicalOp"] = None):
+        self.input_op = input_op
+
+    def chain(self) -> List["LogicalOp"]:
+        ops: List[LogicalOp] = []
+        op: Optional[LogicalOp] = self
+        while op is not None:
+            ops.append(op)
+            op = op.input_op
+        return list(reversed(ops))
+
+    def __repr__(self):
+        return self.name
+
+
+class Read(LogicalOp):
+    """Leaf: a datasource producing read tasks (thunks -> blocks)."""
+    name = "Read"
+
+    def __init__(self, read_tasks: List[Callable[[], Any]],
+                 source_name: str = "Read", row_limit: Optional[int] = None):
+        super().__init__(None)
+        self.read_tasks = read_tasks
+        self.source_name = source_name
+        self.row_limit = row_limit  # set by limit pushdown
+
+    def __repr__(self):
+        return f"Read[{self.source_name}]"
+
+
+class InputData(LogicalOp):
+    """Leaf: pre-materialized blocks (from_items / from_arrow / splits)."""
+    name = "InputData"
+
+    def __init__(self, blocks: List[Any]):
+        super().__init__(None)
+        self.blocks = blocks
+
+
+# -- map-like ops (fusable) -------------------------------------------------
+
+class AbstractMap(LogicalOp):
+    """A row/batch transform. `transform(block) -> block` composed lazily."""
+
+    def __init__(self, input_op, fn, *, fn_kind: str,
+                 batch_size: Optional[int] = None,
+                 batch_format: str = "numpy",
+                 fn_constructor: Optional[Tuple[Any, tuple, dict]] = None,
+                 compute: Optional[Any] = None,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 concurrency: Optional[Any] = None):
+        super().__init__(input_op)
+        self.fn = fn
+        self.fn_kind = fn_kind          # map_rows|map_batches|filter|flat_map
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+        self.fn_constructor = fn_constructor  # (cls, args, kwargs) actor pool
+        self.compute = compute
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+        self.concurrency = concurrency
+
+    @property
+    def uses_actors(self) -> bool:
+        return self.fn_constructor is not None
+
+    def __repr__(self):
+        return f"{self.name}({getattr(self.fn, '__name__', 'fn')})"
+
+
+class MapRows(AbstractMap):
+    name = "MapRows"
+
+    def __init__(self, input_op, fn, **kw):
+        super().__init__(input_op, fn, fn_kind="map_rows", **kw)
+
+
+class MapBatches(AbstractMap):
+    name = "MapBatches"
+
+    def __init__(self, input_op, fn, **kw):
+        super().__init__(input_op, fn, fn_kind="map_batches", **kw)
+
+
+class Filter(AbstractMap):
+    name = "Filter"
+
+    def __init__(self, input_op, fn, **kw):
+        super().__init__(input_op, fn, fn_kind="filter", **kw)
+
+
+class FlatMap(AbstractMap):
+    name = "FlatMap"
+
+    def __init__(self, input_op, fn, **kw):
+        super().__init__(input_op, fn, fn_kind="flat_map", **kw)
+
+
+class FusedMap(AbstractMap):
+    """Several adjacent map-like ops fused into one task per block."""
+    name = "FusedMap"
+
+    def __init__(self, input_op, stages: List[AbstractMap]):
+        first = stages[0]
+        super().__init__(
+            input_op, None, fn_kind="fused",
+            fn_constructor=next(
+                (s.fn_constructor for s in stages if s.fn_constructor), None),
+            num_cpus=max((s.num_cpus or 0) for s in stages) or None,
+            num_tpus=max((s.num_tpus or 0) for s in stages) or None,
+            concurrency=next(
+                (s.concurrency for s in stages if s.concurrency), None))
+        self.stages = stages
+
+    def __repr__(self):
+        return "Fused[" + "->".join(repr(s) for s in self.stages) + "]"
+
+
+# -- all-to-all + misc ------------------------------------------------------
+
+class Limit(LogicalOp):
+    name = "Limit"
+
+    def __init__(self, input_op, limit: int):
+        super().__init__(input_op)
+        self.limit = limit
+
+
+class RandomShuffle(LogicalOp):
+    name = "RandomShuffle"
+
+    def __init__(self, input_op, seed: Optional[int] = None):
+        super().__init__(input_op)
+        self.seed = seed
+
+
+class Repartition(LogicalOp):
+    name = "Repartition"
+
+    def __init__(self, input_op, num_blocks: int):
+        super().__init__(input_op)
+        self.num_blocks = num_blocks
+
+
+class Sort(LogicalOp):
+    name = "Sort"
+
+    def __init__(self, input_op, key: str, descending: bool = False):
+        super().__init__(input_op)
+        self.key = key
+        self.descending = descending
+
+
+class GroupByAggregate(LogicalOp):
+    name = "GroupByAggregate"
+
+    def __init__(self, input_op, key: Optional[str], aggs: List[Any]):
+        super().__init__(input_op)
+        self.key = key
+        self.aggs = aggs
+
+
+class Union(LogicalOp):
+    name = "Union"
+
+    def __init__(self, input_op, others: List[LogicalOp]):
+        super().__init__(input_op)
+        self.others = others
+
+
+class Zip(LogicalOp):
+    name = "Zip"
+
+    def __init__(self, input_op, other: LogicalOp):
+        super().__init__(input_op)
+        self.other = other
+
+
+# -- optimizer --------------------------------------------------------------
+
+_FUSABLE = (MapRows, MapBatches, Filter, FlatMap)
+
+
+def optimize(plan: LogicalOp) -> LogicalOp:
+    """Apply rules: limit pushdown, then map fusion.
+
+    Operates on shallow copies of the chain — plans are shared between
+    Dataset objects (ds.limit(5) wraps ds's plan), so rules must never
+    write through to the originals.
+    """
+    ops = [copy.copy(op) for op in plan.chain()]
+    ops = _push_limit(ops)
+    ops = _fuse_maps(ops)
+    # Relink the (copied) chain.
+    prev: Optional[LogicalOp] = None
+    for op in ops:
+        op.input_op = prev if not isinstance(op, (Read, InputData)) else None
+        prev = op
+    return prev
+
+
+def _push_limit(ops: List[LogicalOp]) -> List[LogicalOp]:
+    """Annotate the Read with a row limit when every op between it and
+    the first Limit is row-preserving (MapRows), so the datasource stops
+    producing early. The Limit op itself always stays in the plan.
+
+    Reference: limit_pushdown rule.
+    """
+    acc: Optional[int] = None
+    for op in ops:
+        if isinstance(op, (Read, InputData, MapRows)):
+            continue
+        if isinstance(op, Limit):
+            acc = op.limit
+        break
+    if acc is not None and isinstance(ops[0], Read):
+        ops[0].row_limit = acc
+    return ops
+
+
+def _fuse_maps(ops: List[LogicalOp]) -> List[LogicalOp]:
+    out: List[LogicalOp] = []
+    run: List[AbstractMap] = []
+
+    def flush():
+        if not run:
+            return
+        if len(run) == 1:
+            out.append(run[0])
+        else:
+            out.append(FusedMap(None, list(run)))
+        run.clear()
+
+    for op in ops:
+        if isinstance(op, _FUSABLE):
+            # Actor-pool stages only fuse with stages sharing the same pool.
+            if run and (run[-1].uses_actors or op.uses_actors):
+                flush()
+            run.append(op)
+        else:
+            flush()
+            out.append(op)
+    flush()
+    return out
