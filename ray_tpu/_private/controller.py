@@ -557,7 +557,12 @@ class Controller:
             try:
                 await asyncio.wait_for(ev.wait(), timeout=timeout or 120.0)
             except asyncio.TimeoutError:
-                return {"state": pg.state, "reason": "timeout"}
+                # Still pending at the deadline. If the scheduler has
+                # recorded an infeasibility note, surface it so callers
+                # can distinguish "cluster busy" from "can never fit on
+                # current nodes".
+                return {"state": pg.state, "timeout": True,
+                        "reason": pg.failure_reason or "timeout"}
         return {"state": pg.state, "reason": pg.failure_reason}
 
     async def rpc_remove_placement_group(self, pg_id: str) -> bool:
@@ -578,8 +583,9 @@ class Controller:
         else:
             pg.mark_removed()       # wakes any pg.ready() waiters
         # Drop the entry so long-lived drivers creating/removing many PGs
-        # (e.g. Tune sweeps) don't grow the table without bound.
-        del self.placement_groups[pg_id]
+        # (e.g. Tune sweeps) don't grow the table without bound. pop():
+        # concurrent removals may race past the None check above.
+        self.placement_groups.pop(pg_id, None)
         self._sched_event.set()
         return True
 

@@ -68,14 +68,22 @@ class PlacementGroupEntry:
         currently unplaceable ("" means retry later, non-empty means never).
         """
         alive = [n for n in nodes if n.alive]
-        # Fail fast (every strategy): a bundle larger than every node's
-        # TOTAL capacity can never be placed, so don't retry forever.
+        # Each attempt re-derives the infeasibility note from the CURRENT
+        # nodes — clear any stale reason from earlier cluster states.
+        self.failure_reason = ""
+        # Infeasibility note (every strategy): a bundle larger than every
+        # CURRENT node's total capacity. Reference semantics keep the PG
+        # PENDING (the cluster may grow / late nodes may register), so this
+        # is retryable — the reason is recorded for ready()-timeout
+        # messages and the state API.
         for i, b in enumerate(self.bundles):
             if alive and not any(
                     all(n.resources_total.get(k, 0.0) + 1e-9 >= v
                         for k, v in b.resources.items()) for n in alive):
-                return (f"bundle {i} {b.resources} exceeds every node's "
-                        f"total capacity")
+                self.failure_reason = (
+                    f"bundle {i} {b.resources} exceeds every alive node's "
+                    f"total capacity (cluster may still be scaling up)")
+                return ""
         # Work on a scratch copy of availability so failed prepares roll back.
         scratch = {n.node_id: dict(n.resources_avail) for n in alive}
 
@@ -108,10 +116,11 @@ class PlacementGroupEntry:
             if packed is not None:
                 chosen = [packed] * len(self.bundles)
             elif self.strategy == "STRICT_PACK":
-                if self._feasible_on_one_node(alive):
-                    return ""           # retry when resources free up
-                return ("STRICT_PACK infeasible: no single node can hold "
-                        "all bundles")
+                if not self._feasible_on_one_node(alive):
+                    self.failure_reason = (
+                        "STRICT_PACK infeasible on current nodes: no "
+                        "single node can hold all bundles")
+                return ""               # retry when resources free up
             else:
                 # PACK soft-fallback: greedy first-fit across nodes.
                 chosen = self._greedy(alive, scratch, fits, take)
@@ -127,9 +136,10 @@ class PlacementGroupEntry:
                 if not cand:
                     if self.strategy == "STRICT_SPREAD" \
                             and len(alive) < len(self.bundles):
-                        return ("STRICT_SPREAD infeasible: "
-                                f"{len(self.bundles)} bundles > "
-                                f"{len(alive)} nodes")
+                        self.failure_reason = (
+                            "STRICT_SPREAD infeasible on current nodes: "
+                            f"{len(self.bundles)} bundles > "
+                            f"{len(alive)} nodes")
                     return ""
                 node = max(cand, key=lambda n: sum(
                     scratch[n.node_id].get(k, 0.0) for k in b.resources))

@@ -47,6 +47,7 @@ class TrainWorker:
         self.rank = rank
         self.world_size = world_size
         self.session: Optional[_Session] = None
+        self._ckpts: List[Checkpoint] = []   # reported, fetchable by id
         if jax_coordinator is not None and world_size > 1:
             import jax
             jax.distributed.initialize(
@@ -77,16 +78,23 @@ class TrainWorker:
             _set_session(None)
 
     def drain_reports(self) -> List[Dict[str, Any]]:
+        """Reports with checkpoints replaced by fetch ids: content is
+        tarred+shipped only for the one rank the driver selects
+        (fetch_checkpoint), not by all N ranks every drain round."""
         if self.session is None:
             return []
         reports = self.session.drain()
-        # Ship checkpoint CONTENT, not a path: the driver may be on a
-        # different host, so local directories don't travel.
         for rep in reports:
             ckpt = rep.get("checkpoint")
             if isinstance(ckpt, Checkpoint):
-                rep["checkpoint"] = ckpt.pack()
+                self._ckpts.append(ckpt)
+                rep["checkpoint"] = {"__ckpt_id__": len(self._ckpts) - 1}
         return reports
+
+    def fetch_checkpoint(self, ckpt_id: int):
+        """Pack + ship one reported checkpoint's content (driver may be on
+        a different host, so local directories don't travel)."""
+        return self._ckpts[ckpt_id].pack()
 
     def ping(self) -> str:
         return "ok"
@@ -109,10 +117,15 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
         self.bootstrap_jax = bootstrap_jax_distributed
+        self._on_report: Optional[Callable[..., None]] = None
 
     # ------------------------------------------------------------------ fit
 
-    def fit(self) -> Result:
+    def fit(self, on_report: Optional[Callable[..., None]] = None) -> Result:
+        """Run to completion. `on_report(metrics, checkpoint)` streams each
+        rank-0 report as it is drained (Tune integration hooks in here so
+        schedulers get per-iteration control)."""
+        self._on_report = on_report
         storage = self.run_config.resolved_storage_path()
         ckpt_cfg = self.run_config.checkpoint_config
         manager = CheckpointManager(
@@ -221,9 +234,10 @@ class JaxTrainer:
         except Exception:
             return
         # Rank 0's metrics define the run history (reference semantics);
-        # any rank may attach a checkpoint — rank 0's wins when several
-        # ranks report one in the same drain round (SPMD loops typically
-        # report identical global state from every rank).
+        # any rank may attach a checkpoint — the lowest reporting rank wins
+        # when several report in the same drain round (SPMD loops typically
+        # report identical global state from every rank), and only that
+        # rank's content is packed + shipped.
         ckpt_rank = min((rank for rank, reports in enumerate(all_reports)
                          if any(r.get("checkpoint") is not None
                                 for r in reports)), default=0)
@@ -231,13 +245,23 @@ class JaxTrainer:
             for rep in reports:
                 ckpt = rep.get("checkpoint")
                 metrics = rep.get("metrics") or {}
+                persisted = None
                 if ckpt is not None and rank == ckpt_rank:
-                    persisted = manager.register(ckpt, metrics)
-                    if rank == 0:
-                        metrics = dict(metrics)
-                        metrics["_checkpoint_path"] = persisted.path
+                    try:
+                        packed = ray_tpu.get(
+                            workers[rank].fetch_checkpoint.remote(
+                                ckpt["__ckpt_id__"]), timeout=120)
+                    except Exception:
+                        packed = None
+                    if packed is not None:
+                        persisted = manager.register(packed, metrics)
+                        if rank == 0:
+                            metrics = dict(metrics)
+                            metrics["_checkpoint_path"] = persisted.path
                 if rank == 0:
                     history.append(metrics)
+                    if self._on_report is not None:
+                        self._on_report(dict(metrics), persisted)
 
 
 # Reference-parity alias: the generic data-parallel entry point.
@@ -259,13 +283,20 @@ def _trainer_as_trainable(trainer: "JaxTrainer") -> type:
         run = _copy.copy(trainer)
         run.train_loop_config = {**(trainer.train_loop_config or {}),
                                  **config}
-        result = run.fit()
+        streamed = [0]
+
+        def on_report(metrics, checkpoint=None):
+            # Live bridge into the Tune session: blocks until the
+            # controller consumes, so ASHA/PBT decisions land while TPU
+            # compute is still pending, not after fit() finished.
+            streamed[0] += 1
+            _tune_session.report(dict(metrics), checkpoint=checkpoint)
+
+        result = run.fit(on_report=on_report)
         if result.error is not None:
             raise result.error
-        for report in result.metrics_dataframe or [result.metrics]:
-            metrics = report.get("metrics", report) if isinstance(
-                report, dict) else report
-            _tune_session.report(dict(metrics))
+        if not streamed[0] and result.metrics:
+            _tune_session.report(dict(result.metrics))
 
     fn = _tune_fn
     fn.__name__ = "jax_trainer"

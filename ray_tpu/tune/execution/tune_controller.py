@@ -71,11 +71,10 @@ class TuneController:
             return None
         trial_id = new_trial_id()
         config = self.searcher.suggest(trial_id)
-        if config is None:
-            # Distinguish exhausted from concurrency-limited: limiter
-            # returns None transiently while live trials exist.
-            if not getattr(self.searcher, "live", None):
-                self._searcher_done = True
+        if config is Searcher.FINISHED:
+            self._searcher_done = True
+            return None
+        if config is None:     # transient: retry on a later step
             return None
         trial = Trial(trial_id, config)
         self.trials.append(trial)
@@ -187,6 +186,19 @@ class TuneController:
 
     def step(self) -> bool:
         """One controller iteration; False when the experiment is over."""
+        # Schedulers (sync HyperBand) may decide to stop trials that are
+        # currently PAUSED at a rung barrier — apply before refilling.
+        pop_stops = getattr(self.scheduler, "pop_trials_to_stop", None)
+        if pop_stops is not None:
+            for tid in pop_stops():
+                trial = next((t for t in self._live()
+                              if t.trial_id == tid), None)
+                if trial is not None:
+                    self._stop_trial(trial, TERMINATED)
+                    self.scheduler.on_trial_complete(trial, trial.last_result
+                                                     or None)
+                    self.searcher.on_trial_complete(trial.trial_id,
+                                                    trial.last_result or None)
         running = [t for t in self._live() if t.status == RUNNING]
         # Fill capacity: scheduler picks among PENDING/PAUSED, searcher
         # supplies fresh configs.

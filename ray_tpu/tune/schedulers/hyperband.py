@@ -23,6 +23,7 @@ class _SyncBracket:
         self.rung_of: Dict[str, int] = {}
         self.waiting: Dict[str, float] = {}   # trial_id -> score at rung
         self.stopped: set = set()
+        self.newly_stopped: set = set()       # drained by the scheduler
 
     def add(self, trial_id: str) -> None:
         self.members.append(trial_id)
@@ -53,6 +54,7 @@ class _SyncBracket:
                 self.rung_of[tid] += 1
             else:
                 self.stopped.add(tid)
+                self.newly_stopped.add(tid)
         self.waiting.clear()
 
 
@@ -65,23 +67,27 @@ class HyperBandScheduler(TrialScheduler):
         self.max_t = max_t
         self.eta = reduction_factor
         s_max = int(math.log(max_t) / math.log(reduction_factor))
-        self._bracket_sizes = [
-            max(1, int(math.ceil((s_max + 1) / (s + 1)
-                                 * reduction_factor ** s)))
+        # Bracket spec pairs (capacity, halving exponent s): the bracket
+        # with the MOST trials starts at the SMALLEST budget (most rungs,
+        # aggressive halving) and vice versa.
+        self._bracket_specs = [
+            (max(1, int(math.ceil((s_max + 1) / (s + 1)
+                                  * reduction_factor ** s))), s)
             for s in reversed(range(s_max + 1))]
         self._brackets: List[_SyncBracket] = []
         self._next_bracket = 0
         self._trial_bracket: Dict[str, _SyncBracket] = {}
 
     def _open_bracket(self) -> _SyncBracket:
-        s = self._next_bracket % len(self._bracket_sizes)
+        capacity, s = self._bracket_specs[
+            self._next_bracket % len(self._bracket_specs)]
         rungs = []
         budget = max(1, int(self.max_t / self.eta ** s))
         while budget <= self.max_t:
             rungs.append(budget)
             budget = int(budget * self.eta)
         bracket = _SyncBracket(rungs or [self.max_t], self.eta)
-        bracket.capacity = self._bracket_sizes[s]
+        bracket.capacity = capacity
         self._brackets.append(bracket)
         self._next_bracket += 1
         return bracket
@@ -112,6 +118,34 @@ class HyperBandScheduler(TrialScheduler):
         if bracket is None:
             return
         bracket.stopped.add(trial.trial_id)
+        bracket.newly_stopped.discard(trial.trial_id)
         bracket.waiting.pop(trial.trial_id, None)
         if bracket.waiting and set(bracket.waiting) >= set(bracket.live_members()):
             bracket._promote()
+
+    def pop_trials_to_stop(self) -> List[str]:
+        out: List[str] = []
+        for bracket in self._brackets:
+            out.extend(bracket.newly_stopped)
+            bracket.newly_stopped.clear()
+        return out
+
+    def choose_trial_to_run(self, trials: List[Trial]) -> Optional[Trial]:
+        """Hold the synchronous rung barrier: a PAUSED trial is resumable
+        only once its bracket promoted it (it is no longer parked in
+        `waiting` and was not halved away)."""
+        from ..trial import PENDING
+        for trial in trials:
+            if trial.status == PENDING:
+                return trial
+        for trial in trials:
+            if trial.status != PAUSED:
+                continue
+            bracket = self._trial_bracket.get(trial.trial_id)
+            if bracket is None:
+                return trial
+            if trial.trial_id in bracket.waiting or \
+                    trial.trial_id in bracket.stopped:
+                continue
+            return trial
+        return None

@@ -19,6 +19,11 @@ class Searcher:
     """Suggest configs; observe results. Subclass to plug in external
     optimizers (the reference's OptunaSearch etc. implement this shape)."""
 
+    #: Sentinel return of suggest(): the search space is exhausted.
+    #: Plain None means "no suggestion available right now, retry later"
+    #: (e.g. under a ConcurrencyLimiter or an async optimizer backend).
+    FINISHED = "FINISHED"
+
     def __init__(self, metric: Optional[str] = None, mode: str = "max"):
         self.metric, self.mode = metric, mode
 
@@ -31,7 +36,7 @@ class Searcher:
         return True
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        """Next config, or None when exhausted."""
+        """Next config; Searcher.FINISHED when exhausted; None to retry."""
         raise NotImplementedError
 
     def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
@@ -65,7 +70,7 @@ class BasicVariantGenerator(Searcher):
         try:
             return next(self._iter)
         except StopIteration:
-            return None
+            return Searcher.FINISHED
 
 
 class RandomSearch(BasicVariantGenerator):
@@ -88,7 +93,7 @@ class ConcurrencyLimiter(Searcher):
         if len(self.live) >= self.max_concurrent:
             return None  # controller retries later
         config = self.searcher.suggest(trial_id)
-        if config is not None:
+        if config is not None and config is not Searcher.FINISHED:
             self.live.append(trial_id)
         return config
 

@@ -106,7 +106,14 @@ def get_checkpoint() -> Any:
 
 
 class FunctionTrainable(Trainable):
-    """Wraps fn(config) into the Trainable step protocol."""
+    """Wraps fn(config) into the Trainable step protocol.
+
+    Pause/resume contract (same as the reference's function trainables):
+    on resume the user function restarts from its beginning in a fresh
+    actor — it must call tune.get_checkpoint() and fast-forward from the
+    restored state, reporting checkpoints via tune.report(..., checkpoint=)
+    at rung-relevant milestones. A function that ignores checkpoints will
+    redo its pre-pause work."""
 
     _fn: Callable[[Dict[str, Any]], Any] = None  # set by wrap_function
 

@@ -54,6 +54,18 @@ class _GroupActor:
         self._mailbox: Dict[tuple, Any] = {}
         self._lock = asyncio.Lock()
         self._events: Dict[str, Any] = {}
+        self._departed: set = set()
+
+    async def deregister(self, rank: int) -> int:
+        """A rank leaving the group (destroy is collective). Returns the
+        number of ranks still registered — the caller that drops it to
+        zero kills the rendezvous actor, so teardown by a fast-finishing
+        rank can't yank the mailbox out from under peers mid-send."""
+        self._departed.add(rank)
+        return self.world_size - len(self._departed)
+
+    async def remaining(self) -> int:
+        return self.world_size - len(self._departed)
 
     async def declared_rank_of(self, actor_id: str):
         return self.declared_ranks.get(actor_id)
@@ -149,6 +161,7 @@ def init_collective_group(world_size: int, rank: int,
     name = _group_actor_name(group_name)
     actor = None
     if rank == 0:
+        _reap_stale_group(name)
         GroupActor = ray_tpu.remote(_GroupActor)
         actor = GroupActor.options(name=name, lifetime="detached").remote(
             world_size)
@@ -183,9 +196,36 @@ def create_collective_group(actors, world_size: int, ranks: List[int],
         raise ValueError(f"ranks must be a permutation of 0..{world_size-1}, "
                          f"got {ranks}")
     declared = {a._actor_id: r for a, r in zip(actors, ranks)}
+    name = _group_actor_name(group_name)
+    _reap_stale_group(name)
     GroupActor = ray_tpu.remote(_GroupActor)
-    GroupActor.options(name=_group_actor_name(group_name),
-                       lifetime="detached").remote(world_size, declared)
+    h = GroupActor.options(name=name, lifetime="detached").remote(
+        world_size, declared)
+    # Surface creation failures (e.g. a live group already owns the name)
+    # instead of silently letting members autojoin a stale actor.
+    ray_tpu.get(h.get_world_size.remote(), timeout=60)
+
+
+def _reap_stale_group(name: str) -> None:
+    """If a previous group actor with this name is dead or fully
+    deregistered (a member crashed before collective destroy completed),
+    kill it so the name is reusable. A live group with registered members
+    is left alone — creating over it then fails loudly."""
+    import ray_tpu
+    try:
+        existing = ray_tpu.get_actor(name)
+    except Exception:
+        return
+    try:
+        remaining = ray_tpu.get(existing.remaining.remote(), timeout=10)
+        stale = remaining <= 0
+    except Exception:
+        stale = True          # dead/unresponsive actor holds the name
+    if stale:
+        try:
+            ray_tpu.kill(existing)
+        except Exception:
+            pass
 
 
 def _handle(group_name: str) -> _GroupHandle:
@@ -274,14 +314,19 @@ def recv(src_rank: int, group_name: str = "default"):
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Collective teardown: each rank deregisters; whichever rank drops
+    the registration count to zero kills the detached rendezvous actor.
+    This neither leaks the actor when rank 0 dies first (survivors still
+    drain the count) nor kills it under peers with in-flight ops."""
     import ray_tpu
     with _groups_lock:
         h = _groups.pop(group_name, None)
     if h is not None:
-        # Any rank tears down the detached rendezvous actor — relying on
-        # rank 0 alone leaks it whenever rank 0 dies first, and the name
-        # could then never be reused.
         try:
-            ray_tpu.kill(ray_tpu.get_actor(_group_actor_name(group_name)))
+            remaining = ray_tpu.get(h.actor.deregister.remote(h.rank),
+                                    timeout=30)
+            if remaining <= 0:
+                ray_tpu.kill(ray_tpu.get_actor(
+                    _group_actor_name(group_name)))
         except Exception:
             pass

@@ -30,10 +30,15 @@ class PlacementGroup:
             client._controller().call("pg_wait_ready", pg_id=self.id,
                                       timeout=timeout),
             timeout=(timeout + 5.0) if timeout else None)
-        if reply.get("state") == "FAILED":
+        if reply.get("state") == "FAILED" or (
+                reply.get("timeout") and reply.get("reason")
+                not in (None, "", "timeout")):
+            # FAILED, or timed out while infeasible on the current nodes
+            # (the PG itself stays PENDING server-side, matching the
+            # reference: the cluster may still scale up).
             from ..exceptions import PlacementGroupUnavailableError
             raise PlacementGroupUnavailableError(
-                f"placement group failed: {reply.get('reason')}")
+                f"placement group not placeable: {reply.get('reason')}")
         return reply.get("state") == "CREATED"
 
     def wait(self, timeout_seconds: Optional[float] = None) -> bool:

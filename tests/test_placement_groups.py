@@ -64,10 +64,12 @@ def test_strict_pack_one_node(ray_start):
         ray_tpu.remove_node(n1)
 
 
-def test_pg_infeasible_fails_fast(ray_start):
+def test_pg_infeasible_raises_on_timeout(ray_start):
+    # Infeasible-on-current-nodes PGs stay PENDING (the cluster may still
+    # scale up), but ready() surfaces the recorded reason at the deadline.
     pg = placement_group([{"CPU": 512}], strategy="STRICT_PACK")
     with pytest.raises(PlacementGroupUnavailableError):
-        pg.ready(timeout=30)
+        pg.ready(timeout=5)
 
 
 def test_actor_in_pg(ray_start):
