@@ -1,0 +1,22 @@
+"""ray_tpu.rllib: TPU-native reinforcement learning.
+
+Reference parity: the new-stack RLlib (EnvRunners + Connectors +
+RLModule + Learner, rllib/algorithms/algorithm.py:198). Rollouts are
+compiled: pure-JAX envs scanned with the policy in one XLA program.
+"""
+
+from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.impala import IMPALA, IMPALAConfig
+from .algorithms.ppo import PPO, PPOConfig
+from .core.learner import Learner, LearnerGroup
+from .core.rl_module import DefaultRLModule, RLModule
+from .env.env_runner import SingleAgentEnvRunner
+from .env.env_runner_group import EnvRunnerGroup
+from .env.jax_env import CartPole, EnvSpec, JaxEnv, Pendulum, register_env
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "IMPALAConfig", "Learner", "LearnerGroup", "RLModule",
+    "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",
+    "JaxEnv", "CartPole", "Pendulum", "EnvSpec", "register_env",
+]

@@ -1,0 +1,3 @@
+from .algorithm import Algorithm, AlgorithmConfig
+from .ppo import PPO, PPOConfig, PPOLearner
+from .impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace

@@ -1,0 +1,133 @@
+"""IMPALA: async sampling + V-trace off-policy correction.
+
+Reference parity: rllib/algorithms/impala/impala.py:599 (async EnvRunner
+sampling, aggregation, vtrace learner). V-trace (Espeholt et al. 2018) is
+a reverse `lax.scan`, jitted with the loss. Sampling is asynchronous:
+the driver keeps one in-flight sample per env-runner actor, consumes
+whichever lands first (ray_tpu.wait), updates, and re-arms that runner
+with fresh weights — sampling and learning overlap instead of
+lock-stepping like PPO.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+
+from ..core.learner import Learner
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "rho_bar", "c_bar"))
+def vtrace(behavior_logp, target_logp, rewards, values, dones, final_value,
+           *, gamma: float = 0.99, rho_bar: float = 1.0, c_bar: float = 1.0):
+    """All inputs time-major [T, B] (final_value [B]). Returns
+    (vs [T, B], pg_advantages [T, B])."""
+    not_done = 1.0 - dones.astype(jnp.float32)
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_bar)
+    next_values = jnp.concatenate([values[1:], final_value[None]], axis=0)
+    deltas = rho * (rewards + gamma * next_values * not_done - values)
+
+    def backward(acc, inp):
+        delta, c_t, nd = inp
+        acc = delta + gamma * nd * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(final_value), (deltas, c, not_done),
+        reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], final_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * next_vs * not_done - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(IMPALA)
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_bar = 1.0
+        self.c_bar = 1.0
+        self.num_epochs = 1          # each batch consumed once
+        self.minibatch_size = 10 ** 9  # full batch
+
+
+class IMPALALearner(Learner):
+    """Minibatches are env-major [b, T, ...]; the loss transposes to
+    time-major and runs the vtrace scan."""
+
+    def __init__(self, spec, config: IMPALAConfig):
+        self._cfg = config
+        super().__init__(spec, config.learner_hyperparams(),
+                         config.module_class, config.model_config,
+                         seed=config.seed)
+
+    def compute_loss(self, params, mb):
+        cfg = self._cfg
+        tm = lambda a: jnp.swapaxes(a, 0, 1)  # [b, T, ...] -> [T, b, ...]
+        obs, actions = tm(mb["obs"]), tm(mb["actions"])
+        out = self.module.forward_train(params, obs)
+        dist = self.module.dist
+        inputs = out["action_dist_inputs"]
+        target_logp = dist.log_prob(inputs, actions)
+        vs, pg_adv = vtrace(
+            tm(mb["logp"]), target_logp, tm(mb["rewards"]), out["vf"],
+            tm(mb["dones"]), mb["final_vf"], gamma=cfg.gamma,
+            rho_bar=cfg.rho_bar, c_bar=cfg.c_bar)
+        policy_loss = -jnp.mean(pg_adv * target_logp)
+        vf_loss = jnp.mean((out["vf"] - vs) ** 2)
+        entropy = jnp.mean(dist.entropy(inputs))
+        loss = (policy_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        return loss, {"total_loss": loss, "policy_loss": policy_loss,
+                      "vf_loss": vf_loss, "entropy": entropy}
+
+
+def _to_env_major(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in batch.items():
+        out[k] = v if k == "final_vf" else np.swapaxes(v, 0, 1)
+    return out
+
+
+class IMPALA(Algorithm):
+    @classmethod
+    def default_config(cls) -> IMPALAConfig:
+        return IMPALAConfig()
+
+    @classmethod
+    def build_learner(cls, spec, config) -> IMPALALearner:
+        return IMPALALearner(spec, config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner
+        if self.env_runner_group._local is None:
+            for r in self.env_runner_group._remote:
+                self._inflight[r.sample.remote()] = r
+
+    def training_step(self) -> Dict[str, Any]:
+        erg = self.env_runner_group
+        if erg._local is not None:
+            result = erg.sample()
+        else:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            runner = self._inflight.pop(ready[0])
+            result = ray_tpu.get(ready[0])
+            # re-arm with fresh weights — async: learner proceeds meanwhile
+            ref = ray_tpu.put(self.learner_group.get_weights())
+            runner.set_weights.remote(ref)
+            self._inflight[runner.sample.remote()] = runner
+        train_batch = _to_env_major(result["batch"])
+        learner_metrics = self.learner_group.update(train_batch)
+        if erg._local is not None:
+            erg.sync_weights(self.learner_group.get_weights())
+        return self._roll_metrics(result["stats"], learner_metrics)

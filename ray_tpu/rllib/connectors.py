@@ -1,0 +1,81 @@
+"""ConnectorV2 pipelines: env→module, module→env, learner.
+
+Reference parity: rllib/connectors/connector_v2.py and the pipeline dirs
+rllib/connectors/{env_to_module,module_to_env,learner}/. A connector is a
+callable batch transform; pipelines compose them. The compiled rollout
+(env_runner.py) fuses the env/module connectors' hot work into XLA, so the
+default pipelines here carry the learner-side transforms: flatten
+time×env, GAE, advantage normalization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .core.postprocessing import compute_gae
+
+
+class ConnectorV2:
+    def __call__(self, batch: Dict[str, Any], **kwargs) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    def __init__(self, connectors: Optional[List[ConnectorV2]] = None):
+        self.connectors = list(connectors or [])
+
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(connector)
+        return self
+
+    def __call__(self, batch, **kwargs):
+        for c in self.connectors:
+            batch = c(batch, **kwargs)
+        return batch
+
+
+class GeneralAdvantageEstimation(ConnectorV2):
+    def __init__(self, gamma: float = 0.99, lam: float = 0.95):
+        self.gamma, self.lam = gamma, lam
+
+    def __call__(self, batch, **kwargs):
+        adv, targets = compute_gae(
+            batch["rewards"], batch["vf"], batch["dones"],
+            batch["final_vf"], gamma=self.gamma, lam=self.lam)
+        batch["advantages"] = np.asarray(adv)
+        batch["value_targets"] = np.asarray(targets)
+        return batch
+
+
+class NormalizeAdvantages(ConnectorV2):
+    def __call__(self, batch, **kwargs):
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        return batch
+
+
+class FlattenTimeEnv(ConnectorV2):
+    """[T, B, ...] → [T*B, ...] train batch (drops rollout-only keys)."""
+
+    DROP = ("final_vf",)
+
+    def __call__(self, batch, **kwargs):
+        out = {}
+        for k, v in batch.items():
+            if k in self.DROP:
+                continue
+            v = np.asarray(v)
+            out[k] = v.reshape((-1,) + v.shape[2:])
+        return out
+
+
+def default_learner_pipeline(gamma: float = 0.99, lam: float = 0.95,
+                             normalize_advantages: bool = True
+                             ) -> ConnectorPipelineV2:
+    pipe = ConnectorPipelineV2([GeneralAdvantageEstimation(gamma, lam),
+                                FlattenTimeEnv()])
+    if normalize_advantages:
+        pipe.append(NormalizeAdvantages())
+    return pipe

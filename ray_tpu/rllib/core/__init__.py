@@ -1,0 +1,3 @@
+from .rl_module import DefaultRLModule, RLModule, build_module
+from .learner import Learner, LearnerGroup, LearnerHyperparams
+from . import distributions, postprocessing

@@ -1,0 +1,84 @@
+"""Action distributions in pure JAX.
+
+Reference parity: rllib/models/torch/torch_distributions.py (Categorical,
+DiagGaussian). Here they are stateless namespaces over jnp arrays so they
+trace cleanly under jit/vmap/scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    @staticmethod
+    def sample(logits, key):
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @staticmethod
+    def log_prob(logits, actions):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def kl(logits_p, logits_q):
+        logp = jax.nn.log_softmax(logits_p, axis=-1)
+        logq = jax.nn.log_softmax(logits_q, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+    @staticmethod
+    def deterministic(logits):
+        return jnp.argmax(logits, axis=-1)
+
+
+class DiagGaussian:
+    """Parameterised by concat([mean, log_std], axis=-1)."""
+
+    @staticmethod
+    def split(params):
+        mean, log_std = jnp.split(params, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(params, key):
+        mean, log_std = DiagGaussian.split(params)
+        return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+    @staticmethod
+    def log_prob(params, actions):
+        mean, log_std = DiagGaussian.split(params)
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((actions - mean) ** 2 / var)
+            - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+    @staticmethod
+    def entropy(params):
+        _, log_std = DiagGaussian.split(params)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def kl(params_p, params_q):
+        mp, lsp = DiagGaussian.split(params_p)
+        mq, lsq = DiagGaussian.split(params_q)
+        return jnp.sum(
+            lsq - lsp
+            + (jnp.exp(2 * lsp) + (mp - mq) ** 2) / (2 * jnp.exp(2 * lsq))
+            - 0.5, axis=-1)
+
+    @staticmethod
+    def deterministic(params):
+        mean, _ = DiagGaussian.split(params)
+        return mean
+
+
+def for_spec(spec):
+    """Pick the distribution class for an EnvSpec."""
+    return Categorical if spec.discrete else DiagGaussian

@@ -1,0 +1,39 @@
+"""Trajectory postprocessing: GAE as a compiled reverse scan.
+
+Reference parity: rllib/connectors/learner/general_advantage_estimation.py
+(GAE connector in the learner pipeline) and
+rllib/evaluation/postprocessing.py:compute_advantages. TPU-native: a
+`lax.scan` in reverse over the time axis, jitted once, batched over envs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "lam"))
+def compute_gae(rewards, values, dones, final_values, *,
+                gamma: float = 0.99, lam: float = 0.95):
+    """rewards/values/dones: [T, B]; final_values: [B].
+    Returns (advantages [T, B], value_targets [T, B]).
+
+    Episode boundaries (dones) cut the bootstrap; auto-reset rollouts make
+    this exact for terminations and the standard approximation for
+    truncations.
+    """
+    not_done = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], final_values[None]], axis=0)
+    deltas = rewards + gamma * next_values * not_done - values
+
+    def backward(adv_next, inp):
+        delta, nd = inp
+        adv = delta + gamma * lam * nd * adv_next
+        return adv, adv
+
+    _, advantages = jax.lax.scan(
+        backward, jnp.zeros_like(final_values), (deltas, not_done),
+        reverse=True)
+    return advantages, advantages + values

@@ -1,0 +1,99 @@
+"""RLModule: the framework-agnostic policy container.
+
+Reference parity: rllib/core/rl_module/rl_module.py:260
+(forward_inference/forward_exploration/forward_train :549-633). TPU-native
+shape: a module is a pair (apply_fn, params-pytree); apply_fn is pure so
+it jits/vmaps/scans and shards with pjit. Default module is a flax.linen
+actor-critic MLP (the reference's default MLP catalog,
+rllib/core/models/catalog.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from typing import TYPE_CHECKING
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from . import distributions
+
+if TYPE_CHECKING:  # EnvSpec is duck-typed at runtime (avoids an env
+    from ..env.jax_env import EnvSpec  # package import cycle)
+
+
+class RLModule:
+    """Stateless spec + pure apply; params live outside (functional)."""
+
+    def __init__(self, spec: "EnvSpec"):
+        self.spec = spec
+        self.dist = distributions.for_spec(spec)
+
+    # subclasses define
+    def init(self, key) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params, obs) -> Dict[str, jnp.ndarray]:
+        """Returns {"action_dist_inputs": ..., "vf": ...}."""
+        raise NotImplementedError
+
+    # reference forward_* surface ------------------------------------------
+    def forward_inference(self, params, obs):
+        out = self.apply(params, obs)
+        return self.dist.deterministic(out["action_dist_inputs"])
+
+    def forward_exploration(self, params, obs, key):
+        out = self.apply(params, obs)
+        inputs = out["action_dist_inputs"]
+        action = self.dist.sample(inputs, key)
+        logp = self.dist.log_prob(inputs, action)
+        return action, logp, out["vf"]
+
+    def forward_train(self, params, obs):
+        return self.apply(params, obs)
+
+
+class _ActorCriticMLP(nn.Module):
+    hiddens: Sequence[int]
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        pi = x
+        for h in self.hiddens:
+            pi = nn.tanh(nn.Dense(h)(pi))
+        logits = nn.Dense(self.out_dim,
+                          kernel_init=nn.initializers.orthogonal(0.01))(pi)
+        v = x
+        for h in self.hiddens:
+            v = nn.tanh(nn.Dense(h)(v))
+        vf = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0))(v)
+        return logits, vf[..., 0]
+
+
+class DefaultRLModule(RLModule):
+    """MLP actor-critic with separate policy/value torsos."""
+
+    def __init__(self, spec, hiddens: Sequence[int] = (64, 64)):
+        super().__init__(spec)
+        out_dim = spec.num_actions if spec.discrete else 2 * spec.action_dim
+        self._net = _ActorCriticMLP(tuple(hiddens), out_dim)
+
+    def init(self, key):
+        dummy = jnp.zeros((1, self.spec.obs_dim), jnp.float32)
+        return self._net.init(key, dummy)
+
+    def apply(self, params, obs):
+        logits, vf = self._net.apply(params, obs)
+        return {"action_dist_inputs": logits, "vf": vf}
+
+
+def build_module(spec,
+                 module_class: Optional[type] = None,
+                 model_config: Optional[Dict[str, Any]] = None) -> RLModule:
+    model_config = model_config or {}
+    if module_class is not None:
+        return module_class(spec, **model_config)
+    return DefaultRLModule(spec, **model_config)

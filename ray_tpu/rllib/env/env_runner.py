@@ -1,0 +1,116 @@
+"""EnvRunner: samples experience with a compiled rollout.
+
+Reference parity: rllib/env/single_agent_env_runner.py:140 (sample loop
+over gymnasium vector envs) and env_runner_group.py:71. TPU-native
+inversion: the env is pure JAX (jax_env.py), so the WHOLE rollout —
+policy forward, env physics, auto-reset, episode bookkeeping — is one
+`lax.scan` under jit: a single device call per sample() instead of a
+Python loop with T host↔device round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .jax_env import JaxEnv, make_env
+from ..core.rl_module import RLModule, build_module
+
+
+class SingleAgentEnvRunner:
+    """Owns a vectorized env + module params; sample() returns a batch of
+    shape [T, B, ...] plus episode stats. Runs as a plain object in-driver
+    or as a ray_tpu actor in an EnvRunnerGroup."""
+
+    def __init__(self, env, num_envs: int = 8, rollout_length: int = 128,
+                 seed: int = 0, module_class: Optional[type] = None,
+                 model_config: Optional[Dict[str, Any]] = None):
+        self.env: JaxEnv = make_env(env)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.module: RLModule = build_module(
+            self.env.spec, module_class, model_config)
+        self._key = jax.random.PRNGKey(seed)
+        self._key, init_key, reset_key = jax.random.split(self._key, 3)
+        self.params = self.module.init(init_key)
+        self._env_state, self._obs = jax.vmap(self.env.reset)(
+            jax.random.split(reset_key, num_envs))
+        self._sample_jit = jax.jit(self._build_sample())
+
+    # -- compiled rollout ---------------------------------------------------
+    def _build_sample(self):
+        env, module = self.env, self.module
+        B, T = self.num_envs, self.rollout_length
+
+        def one_step(carry, step_key):
+            env_state, obs, ep_ret, ep_len, params = carry
+            act_key, step_keys, reset_keys = (
+                step_key[0], step_key[1], step_key[2])
+            action, logp, vf = module.forward_exploration(
+                params, obs, act_key)
+            next_state, next_obs, reward, done = jax.vmap(env.step)(
+                env_state, action, jax.random.split(step_keys, B))
+            ep_ret = ep_ret + reward
+            ep_len = ep_len + 1
+            # auto-reset finished envs (fresh state, keep static shapes)
+            reset_state, reset_obs = jax.vmap(env.reset)(
+                jax.random.split(reset_keys, B))
+            sel = lambda a, b: jnp.where(
+                jnp.reshape(done, (B,) + (1,) * (a.ndim - 1)), a, b)
+            next_state = jax.tree_util.tree_map(sel, reset_state, next_state)
+            next_obs = sel(reset_obs, next_obs)
+            out = dict(obs=obs, actions=action, logp=logp, vf=vf,
+                       rewards=reward, dones=done,
+                       finished_return=jnp.where(done, ep_ret, 0.0),
+                       finished_len=jnp.where(done, ep_len, 0))
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            ep_len = jnp.where(done, 0, ep_len)
+            return (next_state, next_obs, ep_ret, ep_len, params), out
+
+        def sample(params, env_state, obs, ep_ret, ep_len, key):
+            key, sub = jax.random.split(key)
+            step_keys = jax.random.split(sub, T * 3).reshape(T, 3, 2)
+            carry, batch = jax.lax.scan(
+                one_step, (env_state, obs, ep_ret, ep_len, params), step_keys)
+            env_state, obs, ep_ret, ep_len, _ = carry
+            final_out = module.forward_train(params, obs)
+            batch["final_vf"] = final_out["vf"]
+            return env_state, obs, ep_ret, ep_len, key, batch
+
+        return sample
+
+    # -- public API ---------------------------------------------------------
+    def sample(self) -> Dict[str, Any]:
+        if not hasattr(self, "_ep_ret"):
+            self._ep_ret = jnp.zeros(self.num_envs)
+            self._ep_len = jnp.zeros(self.num_envs, jnp.int32)
+        (self._env_state, self._obs, self._ep_ret, self._ep_len,
+         self._key, batch) = self._sample_jit(
+            self.params, self._env_state, self._obs, self._ep_ret,
+            self._ep_len, self._key)
+        batch = jax.device_get(batch)
+        done_mask = batch.pop("dones")
+        fin_ret = batch.pop("finished_return")
+        fin_len = batch.pop("finished_len")
+        n_done = int(done_mask.sum())
+        stats = {
+            "num_episodes": n_done,
+            "episode_return_mean": float(fin_ret.sum() / max(n_done, 1)),
+            "episode_len_mean": float(fin_len.sum() / max(n_done, 1)),
+            "env_steps": self.num_envs * self.rollout_length,
+        }
+        batch["dones"] = done_mask
+        return {"batch": {k: np.asarray(v) for k, v in batch.items()},
+                "stats": stats}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.device_put(params)
+
+    def ping(self) -> bool:
+        return True

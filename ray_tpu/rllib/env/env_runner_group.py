@@ -1,0 +1,102 @@
+"""EnvRunnerGroup: local or remote fleet of EnvRunners.
+
+Reference parity: rllib/env/env_runner_group.py:71 and the synchronous
+sampling helper rllib/execution/rollout_ops.py:20. With num_env_runners=0
+sampling happens in-process (the reference's local-worker path); otherwise
+N ray_tpu actors sample in parallel and the group gathers batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .env_runner import SingleAgentEnvRunner
+
+
+def _merge_batches(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Concatenate [T, B, ...] batches along the env axis; average stats."""
+    batches = [r["batch"] for r in results]
+    merged = {}
+    for k in batches[0]:
+        axis = 0 if k == "final_vf" else 1
+        merged[k] = np.concatenate([b[k] for b in batches], axis=axis)
+    n_eps = sum(r["stats"]["num_episodes"] for r in results)
+    ret_sum = sum(r["stats"]["episode_return_mean"]
+                  * r["stats"]["num_episodes"] for r in results)
+    len_sum = sum(r["stats"]["episode_len_mean"]
+                  * r["stats"]["num_episodes"] for r in results)
+    stats = {
+        "num_episodes": n_eps,
+        "episode_return_mean": ret_sum / max(n_eps, 1),
+        "episode_len_mean": len_sum / max(n_eps, 1),
+        "env_steps": sum(r["stats"]["env_steps"] for r in results),
+    }
+    return {"batch": merged, "stats": stats}
+
+
+class EnvRunnerGroup:
+    def __init__(self, env, num_env_runners: int = 0, num_envs_per_runner:
+                 int = 8, rollout_length: int = 128, seed: int = 0,
+                 module_class: Optional[type] = None,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 runner_resources: Optional[Dict[str, float]] = None):
+        self.num_env_runners = num_env_runners
+        if num_env_runners == 0:
+            self._local = SingleAgentEnvRunner(
+                env, num_envs_per_runner, rollout_length, seed,
+                module_class, model_config)
+            self._remote = []
+        else:
+            self._local = None
+            remote_cls = ray_tpu.remote(
+                **(runner_resources or {"num_cpus": 1}))(SingleAgentEnvRunner)
+            self._remote = [
+                remote_cls.remote(env, num_envs_per_runner, rollout_length,
+                                  seed + 1000 * (i + 1), module_class,
+                                  model_config)
+                for i in range(num_env_runners)]
+            ray_tpu.get([r.ping.remote() for r in self._remote])
+
+    def sample(self) -> Dict[str, Any]:
+        """Synchronous parallel sample across all runners."""
+        if self._local is not None:
+            return self._local.sample()
+        return _merge_batches(
+            ray_tpu.get([r.sample.remote() for r in self._remote]))
+
+    def sample_async(self):
+        """Kick off sampling on every remote runner; returns ObjectRefs
+        (IMPALA's async path). Local mode returns completed results."""
+        if self._local is not None:
+            return [self._local.sample()]
+        return [r.sample.remote() for r in self._remote]
+
+    def sync_weights(self, params) -> None:
+        if self._local is not None:
+            self._local.set_weights(params)
+        else:
+            # one put, fanned out by reference — the object store dedups
+            ref = ray_tpu.put(params)
+            ray_tpu.get([r.set_weights.remote(ref) for r in self._remote])
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        return ray_tpu.get(self._remote[0].get_weights.remote())
+
+    @property
+    def module(self):
+        if self._local is not None:
+            return self._local.module
+        return None
+
+    def stop(self) -> None:
+        for r in self._remote:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,147 @@
+"""Pure-functional JAX environments.
+
+The reference's RLlib samples with gymnasium vector envs on CPU
+(rllib/env/single_agent_env_runner.py:140 in the reference tree). The
+TPU-native inversion: environments are pure functions of (state, action)
+so rollouts compile into the same XLA program as the policy —
+`vmap` for batching, `lax.scan` for time — and the whole sample step is
+ONE device call instead of a per-step host loop.
+
+Env protocol (gymnax-style):
+  reset(key)        -> (state, obs)
+  step(state, action, key) -> (state, obs, reward, done)
+
+States are pytrees of arrays; everything static-shaped for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """Static description the module/connectors need."""
+    obs_dim: int
+    num_actions: int          # >0 -> discrete; 0 -> continuous
+    action_dim: int = 0       # for continuous envs
+    max_episode_steps: int = 500
+
+    @property
+    def discrete(self) -> bool:
+        return self.num_actions > 0
+
+
+class JaxEnv:
+    """Base class; subclasses are stateless — all state is in the pytree."""
+
+    spec: EnvSpec
+
+    def reset(self, key) -> Tuple[Any, jnp.ndarray]:
+        raise NotImplementedError
+
+    def step(self, state, action, key):
+        raise NotImplementedError
+
+
+class CartPole(JaxEnv):
+    """Classic cart-pole balance, standard physics (Barto et al.).
+
+    Matches gymnasium CartPole-v1 dynamics: force ±10 N, tau=0.02 s,
+    terminate at |x|>2.4 or |theta|>12 deg, reward 1 per step.
+    """
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.spec = EnvSpec(obs_dim=4, num_actions=2,
+                            max_episode_steps=max_episode_steps)
+
+    def reset(self, key):
+        state = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        return (state, jnp.zeros((), jnp.int32)), state
+
+    def step(self, state, action, key):
+        del key
+        s, t = state
+        x, x_dot, theta, theta_dot = s[0], s[1], s[2], s[3]
+        force = jnp.where(action == 1, 10.0, -10.0)
+        costh, sinth = jnp.cos(theta), jnp.sin(theta)
+        total_mass, polemass_length, length = 1.1, 0.05, 0.5
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) / total_mass
+        theta_acc = (9.8 * sinth - costh * temp) / (
+            length * (4.0 / 3.0 - 0.1 * costh ** 2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+        tau = 0.02
+        s2 = jnp.stack([x + tau * x_dot, x_dot + tau * x_acc,
+                        theta + tau * theta_dot, theta_dot + tau * theta_acc])
+        t2 = t + 1
+        terminated = (jnp.abs(s2[0]) > 2.4) | (jnp.abs(s2[2]) > 0.2095)
+        truncated = t2 >= self.spec.max_episode_steps
+        done = terminated | truncated
+        return (s2, t2), s2, jnp.float32(1.0), done
+
+
+class Pendulum(JaxEnv):
+    """Torque-controlled pendulum swing-up (continuous actions)."""
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.spec = EnvSpec(obs_dim=3, num_actions=0, action_dim=1,
+                            max_episode_steps=max_episode_steps)
+
+    def reset(self, key):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), minval=-np.pi, maxval=np.pi)
+        theta_dot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        state = (jnp.stack([theta, theta_dot]), jnp.zeros((), jnp.int32))
+        return state, self._obs(state[0])
+
+    @staticmethod
+    def _obs(s):
+        return jnp.stack([jnp.cos(s[0]), jnp.sin(s[0]), s[1]])
+
+    def step(self, state, action, key):
+        del key
+        s, t = state
+        theta, theta_dot = s[0], s[1]
+        u = jnp.clip(action[0], -2.0, 2.0)
+        norm_th = ((theta + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * theta_dot ** 2 + 0.001 * u ** 2
+        theta_dot2 = jnp.clip(
+            theta_dot + (3 * 9.81 / 2 * jnp.sin(theta) + 3.0 * u) * 0.05,
+            -8.0, 8.0)
+        theta2 = theta + theta_dot2 * 0.05
+        t2 = t + 1
+        done = t2 >= self.spec.max_episode_steps
+        s2 = jnp.stack([theta2, theta_dot2])
+        return (s2, t2), self._obs(s2), -cost, done
+
+
+_ENV_REGISTRY: Dict[str, Callable[[], JaxEnv]] = {
+    "CartPole-v1": CartPole,
+    "CartPole": CartPole,
+    "Pendulum-v1": Pendulum,
+    "Pendulum": Pendulum,
+}
+
+
+def register_env(name: str, factory: Callable[[], JaxEnv]) -> None:
+    """Register a user env factory (reference: ray.tune.register_env)."""
+    _ENV_REGISTRY[name] = factory
+
+
+def make_env(name_or_env) -> JaxEnv:
+    if isinstance(name_or_env, JaxEnv):
+        return name_or_env
+    if isinstance(name_or_env, str):
+        if name_or_env not in _ENV_REGISTRY:
+            raise ValueError(
+                f"unknown env {name_or_env!r}; registered: "
+                f"{sorted(_ENV_REGISTRY)}")
+        return _ENV_REGISTRY[name_or_env]()
+    if callable(name_or_env):
+        return name_or_env()
+    raise TypeError(f"cannot build env from {name_or_env!r}")

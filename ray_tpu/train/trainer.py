@@ -47,7 +47,12 @@ class TrainWorker:
         self.rank = rank
         self.world_size = world_size
         self.session: Optional[_Session] = None
-        self._ckpts: List[Checkpoint] = []   # reported, fetchable by id
+        # reported checkpoints, fetchable by monotonically-increasing id;
+        # pruned to the most recent few (the driver only ever fetches the
+        # current drain round's, so old unfetched entries are dead weight)
+        self._ckpts: Dict[int, Checkpoint] = {}
+        self._ckpt_seq = 0
+        self._ckpt_keep = 4
         if jax_coordinator is not None and world_size > 1:
             import jax
             jax.distributed.initialize(
@@ -87,8 +92,12 @@ class TrainWorker:
         for rep in reports:
             ckpt = rep.get("checkpoint")
             if isinstance(ckpt, Checkpoint):
-                self._ckpts.append(ckpt)
-                rep["checkpoint"] = {"__ckpt_id__": len(self._ckpts) - 1}
+                ckpt_id = self._ckpt_seq
+                self._ckpt_seq += 1
+                self._ckpts[ckpt_id] = ckpt
+                for old in sorted(self._ckpts)[:-self._ckpt_keep]:
+                    del self._ckpts[old]
+                rep["checkpoint"] = {"__ckpt_id__": ckpt_id}
         return reports
 
     def fetch_checkpoint(self, ckpt_id: int):
@@ -241,27 +250,33 @@ class JaxTrainer:
         ckpt_rank = min((rank for rank, reports in enumerate(all_reports)
                          if any(r.get("checkpoint") is not None
                                 for r in reports)), default=0)
-        for rank, reports in enumerate(all_reports):
-            for rep in reports:
-                ckpt = rep.get("checkpoint")
-                metrics = rep.get("metrics") or {}
-                persisted = None
-                if ckpt is not None and rank == ckpt_rank:
-                    try:
-                        packed = ray_tpu.get(
-                            workers[rank].fetch_checkpoint.remote(
-                                ckpt["__ckpt_id__"]), timeout=120)
-                    except Exception:
-                        packed = None
-                    if packed is not None:
-                        persisted = manager.register(packed, metrics)
-                        if rank == 0:
-                            metrics = dict(metrics)
-                            metrics["_checkpoint_path"] = persisted.path
-                if rank == 0:
-                    history.append(metrics)
-                    if self._on_report is not None:
-                        self._on_report(dict(metrics), persisted)
+        # Pass 1: fetch + persist ckpt_rank's checkpoints, keyed by report
+        # round so rank 0's lockstep report in the same round carries them
+        # (the checkpoint must reach the session even when ckpt_rank != 0).
+        persisted_by_round: Dict[int, Any] = {}
+        for i, rep in enumerate(all_reports[ckpt_rank]
+                                if ckpt_rank < len(all_reports) else []):
+            ckpt = rep.get("checkpoint")
+            if ckpt is None:
+                continue
+            try:
+                packed = ray_tpu.get(
+                    workers[ckpt_rank].fetch_checkpoint.remote(
+                        ckpt["__ckpt_id__"]), timeout=120)
+            except Exception:
+                packed = None
+            if packed is not None:
+                persisted_by_round[i] = manager.register(
+                    packed, rep.get("metrics") or {})
+        # Pass 2: rank 0's metrics define the run history.
+        for i, rep in enumerate(all_reports[0] if all_reports else []):
+            metrics = dict(rep.get("metrics") or {})
+            persisted = persisted_by_round.get(i)
+            if persisted is not None:
+                metrics["_checkpoint_path"] = persisted.path
+            history.append(metrics)
+            if self._on_report is not None:
+                self._on_report(dict(metrics), persisted)
 
 
 # Reference-parity alias: the generic data-parallel entry point.

@@ -108,6 +108,14 @@ class TuneController:
 
     # -- result handling ----------------------------------------------------
 
+    def _complete_trial(self, trial: Trial, result: Optional[Dict[str, Any]],
+                        save_first: bool = True) -> None:
+        """Terminate + notify scheduler/searcher (the one place completion
+        bookkeeping lives)."""
+        self._stop_trial(trial, TERMINATED, save_first=save_first)
+        self.scheduler.on_trial_complete(trial, result)
+        self.searcher.on_trial_complete(trial.trial_id, result)
+
     def _handle_result(self, trial: Trial, result: Dict[str, Any]) -> None:
         trial.last_result = {**trial.last_result, **result}
         trial.results.append(result)
@@ -116,9 +124,7 @@ class TuneController:
         hit_stop = any(result.get(key, float("-inf")) >= threshold
                        for key, threshold in self.stop_criteria.items())
         if result.get(DONE) or hit_stop:
-            self._stop_trial(trial, TERMINATED, save_first=True)
-            self.scheduler.on_trial_complete(trial, result)
-            self.searcher.on_trial_complete(trial.trial_id, result)
+            self._complete_trial(trial, result)
             return
         trial.tune_trials = self.trials  # PBT reads the population
         decision = self.scheduler.on_trial_result(trial, result)
@@ -129,9 +135,7 @@ class TuneController:
             self._exploit(trial, *exploit)
             return
         if decision == STOP:
-            self._stop_trial(trial, TERMINATED, save_first=True)
-            self.scheduler.on_trial_complete(trial, result)
-            self.searcher.on_trial_complete(trial.trial_id, result)
+            self._complete_trial(trial, result)
         elif decision == PAUSE:
             self._stop_trial(trial, PAUSED, save_first=True)
         else:
@@ -194,11 +198,8 @@ class TuneController:
                 trial = next((t for t in self._live()
                               if t.trial_id == tid), None)
                 if trial is not None:
-                    self._stop_trial(trial, TERMINATED)
-                    self.scheduler.on_trial_complete(trial, trial.last_result
-                                                     or None)
-                    self.searcher.on_trial_complete(trial.trial_id,
-                                                    trial.last_result or None)
+                    self._complete_trial(trial, trial.last_result or None,
+                                         save_first=False)
         running = [t for t in self._live() if t.status == RUNNING]
         # Fill capacity: scheduler picks among PENDING/PAUSED, searcher
         # supplies fresh configs.

@@ -34,10 +34,14 @@ _REDUCERS = {
 
 
 def _tree_reduce(op, parts: List[Any]):
-    out = parts[0]
-    for p in parts[1:]:
-        out = op(out, p)
-    return out
+    """Elementwise-reduce matching pytrees (dicts/lists of arrays — e.g.
+    whole gradient pytrees — or bare arrays)."""
+    import functools
+
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda *leaves: functools.reduce(op, leaves), *parts)
 
 
 class _GroupActor:
@@ -166,13 +170,25 @@ def init_collective_group(world_size: int, rank: int,
         actor = GroupActor.options(name=name, lifetime="detached").remote(
             world_size)
     else:
+        # Bind only to a FRESH group actor (remaining == world_size).
+        # A stale actor from a previous run can briefly hold the name
+        # while rank 0 reaps + recreates it; binding to that one would
+        # leave this member holding a dead handle (TOCTOU), so keep
+        # polling until the fresh incarnation appears.
         deadline = time.time() + 60
         while time.time() < deadline:
             try:
-                actor = ray_tpu.get_actor(name)
-                break
-            except ValueError:
-                time.sleep(0.2)
+                candidate = ray_tpu.get_actor(name)
+                remaining = ray_tpu.get(candidate.remaining.remote(),
+                                        timeout=10)
+                world = ray_tpu.get(candidate.get_world_size.remote(),
+                                    timeout=10)
+                if remaining == world == world_size:
+                    actor = candidate
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
         if actor is None:
             raise TimeoutError(
                 f"collective group {group_name!r} rendezvous timed out")
@@ -207,9 +223,10 @@ def create_collective_group(actors, world_size: int, ranks: List[int],
 
 
 def _reap_stale_group(name: str) -> None:
-    """If a previous group actor with this name is dead or fully
-    deregistered (a member crashed before collective destroy completed),
-    kill it so the name is reusable. A live group with registered members
+    """If a previous group actor with this name is dead, or its teardown
+    has begun (any member deregistered — including the case where a peer
+    crashed and the survivors drained partway), kill it so the name is
+    reusable. Only a fully-registered live group (remaining == world_size)
     is left alone — creating over it then fails loudly."""
     import ray_tpu
     try:
@@ -218,7 +235,8 @@ def _reap_stale_group(name: str) -> None:
         return
     try:
         remaining = ray_tpu.get(existing.remaining.remote(), timeout=10)
-        stale = remaining <= 0
+        world = ray_tpu.get(existing.get_world_size.remote(), timeout=10)
+        stale = remaining < world
     except Exception:
         stale = True          # dead/unresponsive actor holds the name
     if stale:
@@ -316,8 +334,10 @@ def recv(src_rank: int, group_name: str = "default"):
 def destroy_collective_group(group_name: str = "default") -> None:
     """Collective teardown: each rank deregisters; whichever rank drops
     the registration count to zero kills the detached rendezvous actor.
-    This neither leaks the actor when rank 0 dies first (survivors still
-    drain the count) nor kills it under peers with in-flight ops."""
+    If a member crashed without deregistering, the count never reaches
+    zero and the actor outlives the group — _reap_stale_group then
+    detects the partial teardown (remaining < world_size) and reclaims
+    the name on the next create/init with this group name."""
     import ray_tpu
     with _groups_lock:
         h = _groups.pop(group_name, None)
