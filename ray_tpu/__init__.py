@@ -23,7 +23,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "get_actor", "nodes", "cluster_resources",
+    "kill", "get_actor", "aio_get_actor", "nodes", "cluster_resources",
     "available_resources", "ObjectRef", "ActorHandle", "exceptions",
     "get_runtime_context", "method",
 ]
@@ -81,6 +81,16 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     info = _state.current_client().get_actor_handle_info(name, namespace)
+    if info is None:
+        raise ValueError(f"no actor named {name!r} found")
+    return ActorHandle(info["actor_id"], name)
+
+
+async def aio_get_actor(name: str,
+                        namespace: Optional[str] = None) -> ActorHandle:
+    """Async variant of get_actor for use inside async actors."""
+    info = await _state.current_client().aio_get_actor_handle_info(
+        name, namespace)
     if info is None:
         raise ValueError(f"no actor named {name!r} found")
     return ActorHandle(info["actor_id"], name)

@@ -659,6 +659,13 @@ class CoreClient:
             "get_named_actor", name=name,
             namespace=namespace or self.namespace))
 
+    async def aio_get_actor_handle_info(self, name: str,
+                                        namespace: Optional[str]):
+        """Event-loop-safe named-actor lookup (for async actors)."""
+        return await self._controller().call(
+            "get_named_actor", name=name,
+            namespace=namespace or self.namespace)
+
     # -------------------------------------------------------------- cluster
 
     def cluster_resources(self) -> Dict[str, float]:

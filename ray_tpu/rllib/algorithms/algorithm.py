@@ -100,8 +100,15 @@ class AlgorithmConfig:
 
     def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
         for k, v in d.items():
-            if hasattr(self, k):
-                setattr(self, k, v)
+            if k.startswith("_"):
+                continue
+            if not hasattr(self, k):
+                # fail loudly: a mistyped hyperparameter silently running
+                # with its default is the worst sweep outcome
+                raise ValueError(
+                    f"unknown config key {k!r}; valid keys: "
+                    f"{sorted(a for a in vars(self) if a != 'algo_class')}")
+            setattr(self, k, v)
         return self
 
     def learner_hyperparams(self) -> LearnerHyperparams:

@@ -18,8 +18,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import ray_tpu
-
 from ..core.learner import Learner
 from .algorithm import Algorithm, AlgorithmConfig
 
@@ -107,27 +105,9 @@ class IMPALA(Algorithm):
     def build_learner(cls, spec, config) -> IMPALALearner:
         return IMPALALearner(spec, config)
 
-    def setup(self, config: Dict[str, Any]) -> None:
-        super().setup(config)
-        self._inflight: Dict[Any, Any] = {}  # ref -> runner
-        if self.env_runner_group._local is None:
-            for r in self.env_runner_group._remote:
-                self._inflight[r.sample.remote()] = r
-
     def training_step(self) -> Dict[str, Any]:
-        erg = self.env_runner_group
-        if erg._local is not None:
-            result = erg.sample()
-        else:
-            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
-            runner = self._inflight.pop(ready[0])
-            result = ray_tpu.get(ready[0])
-            # re-arm with fresh weights — async: learner proceeds meanwhile
-            ref = ray_tpu.put(self.learner_group.get_weights())
-            runner.set_weights.remote(ref)
-            self._inflight[runner.sample.remote()] = runner
+        result = self.env_runner_group.sample_async_next(
+            self.learner_group.get_weights())
         train_batch = _to_env_major(result["batch"])
         learner_metrics = self.learner_group.update(train_batch)
-        if erg._local is not None:
-            erg.sync_weights(self.learner_group.get_weights())
         return self._roll_metrics(result["stats"], learner_metrics)

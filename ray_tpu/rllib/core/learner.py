@@ -123,7 +123,9 @@ class Learner:
         hps = self.hps
         batch = {k: jnp.asarray(v) for k, v in train_batch.items()}
         n = next(iter(batch.values())).shape[0]
-        mb = min(hps.minibatch_size, n)
+        if n == 0:
+            raise ValueError("empty train-batch shard")
+        mb = max(min(hps.minibatch_size, n), 1)
         nmb = max(n // mb, 1)
         auxes = []
         for _ in range(hps.num_epochs):
@@ -167,11 +169,11 @@ class LearnerGroup:
     DDP over NCCL; here the group wires a ray_tpu collective group.
     """
 
-    _GROUP_SEQ = 0
-
     def __init__(self, learner_factory: Callable[[], Learner],
                  num_learners: int = 0,
                  learner_resources: Optional[Dict[str, float]] = None):
+        import uuid
+
         self.num_learners = num_learners
         if num_learners <= 1:
             self._local = learner_factory()
@@ -185,8 +187,9 @@ class LearnerGroup:
             self._actors = [remote_cls.remote(learner_factory)
                             for _ in range(num_learners)]
             ray_tpu.get([a.ping.remote() for a in self._actors])
-            LearnerGroup._GROUP_SEQ += 1
-            self._group = f"learner_group_{LearnerGroup._GROUP_SEQ}"
+            # uuid, not a counter: group names rendezvous through GLOBAL
+            # named actors, so per-process counters collide across trials
+            self._group = f"learner_group_{uuid.uuid4().hex[:8]}"
             collective.create_collective_group(
                 self._actors, num_learners, list(range(num_learners)),
                 group_name=self._group)
@@ -199,13 +202,16 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update(train_batch)
         n = next(iter(train_batch.values())).shape[0]
-        shard = max(n // len(self._actors), 1)
+        num = len(self._actors)
         futs = []
         for i, a in enumerate(self._actors):
-            sl = {k: v[i * shard:(i + 1) * shard]
-                  for k, v in train_batch.items()}
+            # strided shards keep every row and leave no actor empty-handed
+            # (every actor MUST contribute to the allreduce); when n < num
+            # learners, wrap so each still gets at least one row
+            idx = np.arange(i, n, num) if i < n else np.array([i % n])
+            sl = {k: v[idx] for k, v in train_batch.items()}
             futs.append(a.update_with_allreduce.remote(
-                sl, self._group, len(self._actors)))
+                sl, self._group, num))
         all_metrics = ray_tpu.get(futs)
         return {k: float(np.mean([m[k] for m in all_metrics]))
                 for k in all_metrics[0]}
@@ -232,6 +238,16 @@ class LearnerGroup:
                 ray_tpu.kill(a)
             except Exception:
                 pass
+        if self._group is not None:
+            # learner actors are gone (no member will deregister), so the
+            # driver reclaims the detached rendezvous actor directly
+            from ray_tpu.util.collective.collective import _group_actor_name
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(
+                    _group_actor_name(self._group)))
+            except Exception:
+                pass
+            self._group = None
 
 
 class _LearnerActor:

@@ -45,6 +45,7 @@ class EnvRunnerGroup:
                  model_config: Optional[Dict[str, Any]] = None,
                  runner_resources: Optional[Dict[str, float]] = None):
         self.num_env_runners = num_env_runners
+        self._inflight: Dict[Any, Any] = {}   # sample ref -> runner
         if num_env_runners == 0:
             self._local = SingleAgentEnvRunner(
                 env, num_envs_per_runner, rollout_length, seed,
@@ -68,12 +69,24 @@ class EnvRunnerGroup:
         return _merge_batches(
             ray_tpu.get([r.sample.remote() for r in self._remote]))
 
-    def sample_async(self):
-        """Kick off sampling on every remote runner; returns ObjectRefs
-        (IMPALA's async path). Local mode returns completed results."""
+    def sample_async_next(self, weights) -> Dict[str, Any]:
+        """IMPALA's async path: keep one in-flight sample per remote
+        runner, return whichever lands first, and re-arm that runner with
+        the given (fresh) weights so sampling overlaps learning. Local
+        mode degrades to sync sample with a weight sync."""
         if self._local is not None:
-            return [self._local.sample()]
-        return [r.sample.remote() for r in self._remote]
+            self._local.set_weights(weights)
+            return self._local.sample()
+        if not self._inflight:
+            for r in self._remote:
+                self._inflight[r.sample.remote()] = r
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+        runner = self._inflight.pop(ready[0])
+        result = ray_tpu.get(ready[0])
+        ref = ray_tpu.put(weights)
+        runner.set_weights.remote(ref)
+        self._inflight[runner.sample.remote()] = runner
+        return result
 
     def sync_weights(self, params) -> None:
         if self._local is not None:

@@ -1,0 +1,24 @@
+"""ray_tpu.serve: model serving with replica autoscaling.
+
+Reference parity: python/ray/serve — controller-reconciled deployments
+(serve/_private/controller.py:84), power-of-two routing
+(pow_2_scheduler.py:52), HTTP ingress proxy (proxy.py:534), batching,
+model multiplexing, request-driven autoscaling.
+"""
+
+from .api import (Application, Deployment, delete, deployment,
+                  get_app_handle, get_deployment_handle, run, shutdown,
+                  start, status)
+from .batching import batch
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
+from ._private.proxy import Request, Response
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "start", "shutdown",
+    "delete", "status", "get_app_handle", "get_deployment_handle",
+    "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
+    "DeploymentConfig", "HTTPOptions", "batch", "multiplexed",
+    "get_multiplexed_model_id", "Request", "Response",
+]

@@ -1,0 +1,162 @@
+"""HTTP ingress proxy.
+
+Reference parity: serve/_private/proxy.py:534-1131 (HTTPProxy on uvicorn;
+route table from the controller, requests forwarded through handles).
+Here: an aiohttp server inside an async actor; the route table refreshes
+on a short poll of the controller; request bodies are forwarded to the
+app's ingress deployment via the async handle path.
+
+Ingress contract: the ingress callable receives a `serve.Request`
+(method/path/headers/query/body helpers). Return values map to HTTP:
+dict/list → JSON, str → text/plain, bytes → octet-stream,
+Response(status, body, content_type) for full control.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+
+from .common import CONTROLLER_NAME
+
+logger = logging.getLogger("ray_tpu.serve.proxy")
+
+
+class Request:
+    """What HTTP ingress callables receive (picklable, unlike an ASGI
+    scope)."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self.headers = headers
+        self._body = body
+
+    def body(self) -> bytes:
+        return self._body
+
+    def json(self) -> Any:
+        return json.loads(self._body or b"null")
+
+    @property
+    def text(self) -> str:
+        return (self._body or b"").decode()
+
+
+class Response:
+    def __init__(self, body: Any = b"", status: int = 200,
+                 content_type: str = "application/octet-stream"):
+        self.body = body
+        self.status = status
+        self.content_type = content_type
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._handles: Dict[str, Any] = {}
+        self._runner = None
+        self._refresh_task: Optional[asyncio.Task] = None
+
+    async def ready(self) -> int:
+        """Start the server; returns the bound port."""
+        if self._runner is not None:
+            return self._port
+        from aiohttp import web
+
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", self._handle_http)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self._refresh_task = asyncio.create_task(self._refresh_routes())
+        return self._port
+
+    async def _refresh_routes(self) -> None:
+        while True:
+            try:
+                controller = await ray_tpu.aio_get_actor(CONTROLLER_NAME)
+                table = await controller.get_route_table.remote()
+                self._routes = dict(table)
+            except Exception:
+                pass
+            await asyncio.sleep(1.0)
+
+    def _match(self, path: str) -> Optional[Tuple[str, str, str]]:
+        """Longest-prefix route match → (prefix, app, ingress)."""
+        best = None
+        for prefix, (app, ingress) in self._routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm + ("" if norm == "/" else "/")) or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, app, ingress)
+        return best
+
+    async def _handle_http(self, request):
+        from aiohttp import web
+
+        path = request.path
+        if path == "/-/healthz":
+            return web.Response(text="success")
+        if path == "/-/routes":
+            return web.json_response(
+                {p: f"{a}#{i}" for p, (a, i) in self._routes.items()})
+        match = self._match(path)
+        if match is None:
+            return web.Response(status=404,
+                                text=f"no app mounted at {path}")
+        prefix, app_name, ingress = match
+        from ..handle import DeploymentHandle
+        hkey = f"{app_name}#{ingress}"
+        handle = self._handles.get(hkey)
+        if handle is None:
+            handle = DeploymentHandle(ingress, app_name)
+            self._handles[hkey] = handle
+        body = await request.read()
+        sub_path = path[len(prefix):] if prefix != "/" else path
+        req = Request(request.method, sub_path or "/",
+                      dict(request.query), dict(request.headers), body)
+        try:
+            result = await handle.remote(req)
+        except Exception as e:
+            logger.exception("request to %s failed", hkey)
+            return web.Response(status=500, text=repr(e))
+        return self._to_http(web, result)
+
+    @staticmethod
+    def _to_http(web, result):
+        if isinstance(result, Response):
+            body = result.body
+            if isinstance(body, (dict, list)):
+                body = json.dumps(body).encode()
+            elif isinstance(body, str):
+                body = body.encode()
+            return web.Response(body=body, status=result.status,
+                                content_type=result.content_type)
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if result is None:
+            return web.Response(status=204)
+        return web.json_response({"result": repr(result)})
+
+    async def shutdown(self) -> bool:
+        if self._refresh_task:
+            self._refresh_task.cancel()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        return True

@@ -47,12 +47,14 @@ class TrainWorker:
         self.rank = rank
         self.world_size = world_size
         self.session: Optional[_Session] = None
-        # reported checkpoints, fetchable by monotonically-increasing id;
-        # pruned to the most recent few (the driver only ever fetches the
-        # current drain round's, so old unfetched entries are dead weight)
+        # reported checkpoints, fetchable by monotonically-increasing id.
+        # The driver acks each drain round (discard_checkpoints), which is
+        # the real cleanup; the size backstop only guards a driver that
+        # died mid-run, and is large enough that no single drain round can
+        # lose entries before they are fetched.
         self._ckpts: Dict[int, Checkpoint] = {}
         self._ckpt_seq = 0
-        self._ckpt_keep = 4
+        self._ckpt_keep = 64
         if jax_coordinator is not None and world_size > 1:
             import jax
             jax.distributed.initialize(
@@ -104,6 +106,12 @@ class TrainWorker:
         """Pack + ship one reported checkpoint's content (driver may be on
         a different host, so local directories don't travel)."""
         return self._ckpts[ckpt_id].pack()
+
+    def discard_checkpoints(self, upto_id: int) -> None:
+        """Driver ack: everything at or below upto_id was fetched or
+        deliberately skipped this drain round."""
+        for cid in [c for c in self._ckpts if c <= upto_id]:
+            del self._ckpts[cid]
 
     def ping(self) -> str:
         return "ok"
@@ -268,6 +276,13 @@ class JaxTrainer:
             if packed is not None:
                 persisted_by_round[i] = manager.register(
                     packed, rep.get("metrics") or {})
+        # Ack every rank's checkpoint entries for this round so workers
+        # free them (non-selected ranks' content is never fetched).
+        for rank, reports in enumerate(all_reports):
+            ids = [rep["checkpoint"]["__ckpt_id__"] for rep in reports
+                   if rep.get("checkpoint") is not None]
+            if ids:
+                workers[rank].discard_checkpoints.remote(max(ids))
         # Pass 2: rank 0's metrics define the run history.
         for i, rep in enumerate(all_reports[0] if all_reports else []):
             metrics = dict(rep.get("metrics") or {})
