@@ -1,0 +1,71 @@
+"""ray_tpu.llm: TPU-native LLM serving and batch inference.
+
+Reference parity: python/ray/llm + serve.llm public API
+(python/ray/serve/llm/__init__.py — LLMConfig, build_openai_app), with
+the external vLLM engine replaced by the in-repo TPU engine
+(paged KV cache + continuous batching, _internal/engine.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ._internal.engine import (EngineConfig, InferenceEngine, Request,
+                               SamplingParams)
+from ._internal.tokenizer import ByteTokenizer, load_tokenizer
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """Reference: serve/llm LLMConfig (pydantic there, dataclass here)."""
+    model_id: str = "default"
+    model_source: Any = "debug"          # preset name or LlamaConfig
+    tokenizer_source: Optional[str] = None
+    engine_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    deployment_config: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    accelerator_type: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "model_id": self.model_id,
+            "model_source": self.model_source,
+            "tokenizer_source": self.tokenizer_source,
+            "engine_kwargs": dict(self.engine_kwargs),
+        }
+
+
+def build_llm_deployment(llm_config: LLMConfig):
+    """One LLMServer deployment for one model."""
+    from .. import serve
+    from ._internal.server import LLMServerImpl
+
+    dep_cfg = dict(llm_config.deployment_config)
+    dep_cfg.setdefault("name", f"LLMServer:{llm_config.model_id}")
+    dep_cfg.setdefault("max_ongoing_requests", 64)
+    if llm_config.accelerator_type:
+        opts = dict(dep_cfg.get("ray_actor_options") or {})
+        opts.setdefault("num_tpus", 1)
+        dep_cfg["ray_actor_options"] = opts
+    return serve.deployment(**dep_cfg)(LLMServerImpl).bind(
+        llm_config.to_dict())
+
+
+def build_openai_app(config: Dict[str, Any]):
+    """{"llm_configs": [LLMConfig, ...]} → Application serving the
+    OpenAI API (reference: serve/llm build_openai_app)."""
+    from .. import serve
+    from ._internal.server import LLMRouterImpl
+
+    llm_configs = config["llm_configs"]
+    servers = [build_llm_deployment(c) for c in llm_configs]
+    return serve.deployment(name="LLMRouter", max_ongoing_requests=256)(
+        LLMRouterImpl).bind(*servers)
+
+
+__all__ = [
+    "LLMConfig", "build_openai_app", "build_llm_deployment",
+    "InferenceEngine", "EngineConfig", "SamplingParams", "Request",
+    "ByteTokenizer", "load_tokenizer",
+]

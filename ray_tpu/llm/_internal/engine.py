@@ -1,0 +1,303 @@
+"""TPU-native LLM inference engine: continuous batching over paged KV.
+
+Net-new component (the reference wraps external vLLM:
+python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py; here
+the engine itself is built TPU-first — SURVEY.md §7 hard part #1).
+
+Design:
+- ONE compiled decode program: the running batch lives in fixed
+  max_batch_size slots (static shapes), inactive slots masked — every
+  step is a single device call regardless of arrivals/completions.
+- Prefill compiles per padded length bucket; prompt KV scatters into the
+  page pool inside the same jit.
+- Sampling (greedy/temperature/top-p) fused into both programs.
+- Page pools are donated through every call → XLA updates KV in place
+  in HBM, no copy of the cache per token.
+- Continuous batching: each step() admits waiting requests into free
+  slots (admission-controlled by the page allocator), then decodes all
+  active slots together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import llama
+from ...models.llama import LlamaConfig
+from ...models.llama_infer import decode_step, prefill
+from .kv_cache import PageAllocator
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: Any = "debug"                 # preset name or LlamaConfig
+    max_batch_size: int = 8
+    page_size: int = 16
+    num_pages: int = 512
+    max_seq_len: Optional[int] = None    # default: model max_seq
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
+    seed: int = 0
+
+    def resolve_model(self) -> LlamaConfig:
+        return llama.config(self.model)
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0             # 0 → greedy
+    top_p: float = 1.0
+    stop_token_ids: tuple = ()
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_tokens: List[int]
+    params: SamplingParams
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+
+
+class _Slot:
+    def __init__(self, index: int):
+        self.index = index
+        self.request: Optional[Request] = None
+        self.pages: List[int] = []
+        self.position = 0        # tokens cached so far
+        self.last_token = 0
+
+
+def _sample(logits, key, temps, top_ps):
+    """logits: (B, V) f32; temps/top_ps: (B,). Greedy where temp<=0."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    # top-p: keep the smallest prefix of the sorted probs covering top_p
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_ps[:, None]   # always keeps rank 0
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+    filtered = jnp.where(keep, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, filtered, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+class InferenceEngine:
+    def __init__(self, config: EngineConfig,
+                 params: Optional[Dict[str, Any]] = None):
+        self.config = config
+        self.model_cfg = config.resolve_model()
+        self.max_seq = config.max_seq_len or self.model_cfg.max_seq
+        cfg, ec = self.model_cfg, config
+        if params is None:
+            params = llama.init_params(cfg, jax.random.PRNGKey(ec.seed))
+        self.params = jax.device_put(params)
+        self.allocator = PageAllocator(ec.num_pages, ec.page_size)
+        self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
+        kv_shape = (ec.num_pages, ec.page_size, cfg.n_layers,
+                    cfg.n_kv_heads, cfg.head_dim)
+        self.k_pages = jnp.zeros(kv_shape, cfg.dtype)
+        self.v_pages = jnp.zeros(kv_shape, cfg.dtype)
+        self._key = jax.random.PRNGKey(ec.seed + 1)
+
+        self.slots = [_Slot(i) for i in range(ec.max_batch_size)]
+        self.waiting: List[Request] = []
+        # host-side mirrors of the device-side slot state
+        self._page_tables = np.zeros(
+            (ec.max_batch_size, self.max_pages_per_seq), np.int32)
+
+        self._decode_fn = jax.jit(
+            self._build_decode(), donate_argnums=(1, 2))
+        self._prefill_fns: Dict[int, Any] = {}
+
+    # -- compiled programs --------------------------------------------------
+    def _build_decode(self):
+        cfg = self.model_cfg
+
+        def step(params, k_pages, v_pages, tokens, positions, page_tables,
+                 active, key, temps, top_ps):
+            logits, k_pages, v_pages = decode_step(
+                cfg, params, tokens, positions, k_pages, v_pages,
+                page_tables, active)
+            new_tokens = _sample(logits, key, temps, top_ps)
+            return new_tokens, k_pages, v_pages
+
+        return step
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            cfg = self.model_cfg
+
+            def run(params, k_pages, v_pages, tokens, true_lens,
+                    page_tables, key, temps, top_ps):
+                logits, k_pages, v_pages = prefill(
+                    cfg, params, tokens, true_lens, k_pages, v_pages,
+                    page_tables)
+                first = _sample(logits, key, temps, top_ps)
+                return first, k_pages, v_pages
+
+            fn = jax.jit(run, donate_argnums=(1, 2))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b and b <= self.max_seq:
+                return b
+        return self.max_seq
+
+    # -- public API ---------------------------------------------------------
+    def add_request(self, request: Request) -> None:
+        worst_case = len(request.prompt_tokens) + request.params.max_tokens
+        if worst_case > self.max_seq:
+            raise ValueError(
+                f"prompt+max_tokens exceeds max_seq_len {self.max_seq}")
+        if self.allocator.pages_needed(worst_case) \
+                > self.allocator.num_usable:
+            # would never be admittable — reject now instead of stalling
+            # the head of the queue forever
+            raise ValueError(
+                f"prompt+max_tokens needs "
+                f"{self.allocator.pages_needed(worst_case)} KV pages but "
+                f"the pool only has {self.allocator.num_usable}")
+        self.waiting.append(request)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            s.request is not None for s in self.slots)
+
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s.request is not None)
+
+    def step(self) -> List[Request]:
+        """Admit + prefill new requests, one decode for the running
+        batch. Returns requests that produced a token this step (check
+        .finished / .output_tokens)."""
+        touched: List[Request] = []
+        self._admit(touched)
+        if any(s.request is not None for s in self.slots):
+            self._decode(touched)
+        return touched
+
+    def generate(self, prompts: List[List[int]],
+                 params: Optional[SamplingParams] = None) -> List[Request]:
+        """Synchronous batch completion (the ray_tpu.data.llm path)."""
+        params = params or SamplingParams()
+        reqs = [Request(f"gen-{i}-{id(prompts)}", list(p), params)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            self.add_request(r)
+        while not all(r.finished for r in reqs):
+            self.step()
+        return reqs
+
+    # -- internals ----------------------------------------------------------
+    def _admit(self, touched: List[Request]) -> None:
+        for slot in self.slots:
+            if not self.waiting:
+                break
+            if slot.request is not None:
+                continue
+            req = self.waiting[0]
+            worst_case = len(req.prompt_tokens) + req.params.max_tokens
+            if not self.allocator.can_allocate(worst_case):
+                break            # head-of-line admission control
+            self.waiting.pop(0)
+            slot.request = req
+            slot.pages = self.allocator.allocate(worst_case)
+            slot.position = len(req.prompt_tokens)
+            table = np.zeros(self.max_pages_per_seq, np.int32)
+            table[:len(slot.pages)] = slot.pages
+            self._page_tables[slot.index] = table
+            self._prefill(slot, touched)
+
+    def _prefill(self, slot: _Slot, touched: List[Request]) -> None:
+        req = slot.request
+        n = len(req.prompt_tokens)
+        bucket = self._bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        self._key, sub = jax.random.split(self._key)
+        p = req.params
+        first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
+            jnp.asarray(self._page_tables[slot.index:slot.index + 1]),
+            sub, jnp.asarray([p.temperature], jnp.float32),
+            jnp.asarray([p.top_p], jnp.float32))
+        tok = int(first[0])
+        slot.last_token = tok
+        self._append_token(slot, tok, touched)
+
+    def _decode(self, touched: List[Request]) -> None:
+        B = self.config.max_batch_size
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        active = np.zeros(B, bool)
+        temps = np.zeros(B, np.float32)
+        top_ps = np.ones(B, np.float32)
+        for s in self.slots:
+            if s.request is None:
+                continue
+            tokens[s.index] = s.last_token
+            positions[s.index] = s.position
+            active[s.index] = True
+            temps[s.index] = s.request.params.temperature
+            top_ps[s.index] = s.request.params.top_p
+        self._key, sub = jax.random.split(self._key)
+        new_tokens, self.k_pages, self.v_pages = self._decode_fn(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._page_tables), jnp.asarray(active), sub,
+            jnp.asarray(temps), jnp.asarray(top_ps))
+        new_tokens = np.asarray(new_tokens)
+        for s in self.slots:
+            if s.request is None or not active[s.index]:
+                continue
+            s.position += 1          # the fed token is now cached
+            tok = int(new_tokens[s.index])
+            s.last_token = tok
+            self._append_token(s, tok, touched)
+
+    def _append_token(self, slot: _Slot, tok: int,
+                      touched: List[Request]) -> None:
+        req = slot.request
+        req.output_tokens.append(tok)
+        touched.append(req)
+        p = req.params
+        if tok in p.stop_token_ids:
+            self._finish(slot, "stop")
+        elif len(req.output_tokens) >= p.max_tokens:
+            self._finish(slot, "length")
+
+    def _finish(self, slot: _Slot, reason: str) -> None:
+        slot.request.finished = True
+        slot.request.finish_reason = reason
+        self.allocator.free(slot.pages)
+        slot.request = None
+        slot.pages = []
+        slot.position = 0
+        self._page_tables[slot.index] = 0
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active": self.num_active(),
+            "waiting": len(self.waiting),
+            "free_pages": self.allocator.free_pages,
+            "total_pages": self.allocator.num_usable,
+        }

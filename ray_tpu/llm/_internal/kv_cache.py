@@ -1,0 +1,41 @@
+"""Host-side page allocator for the paged KV cache.
+
+Reference parity: vLLM's BlockManager role (external to the reference —
+net-new here; SURVEY.md §7 step 10). Pages are allocated worst-case at
+admission (prompt + max_new_tokens) so a running sequence can never hit
+cache OOM mid-decode — admission control is the backpressure point.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class PageAllocator:
+    def __init__(self, num_pages: int, page_size: int):
+        # last page is the scratch page scatter_kv() uses for masked rows
+        self.page_size = page_size
+        self.num_usable = num_pages - 1
+        self._free: List[int] = list(range(self.num_usable))
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.pages_needed(num_tokens) <= len(self._free)
+
+    def allocate(self, num_tokens: int) -> List[int]:
+        n = self.pages_needed(num_tokens)
+        if n > len(self._free):
+            raise MemoryError(
+                f"KV cache exhausted: need {n} pages, {len(self._free)} "
+                f"free")
+        pages, self._free = self._free[:n], self._free[n:]
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(pages)

@@ -1,0 +1,192 @@
+"""LLMServer + LLMRouter Serve deployments (OpenAI-compatible).
+
+Reference parity: llm/_internal/serve/deployments/llm/llm_server.py:415
+(LLMServer wrapping the engine) and deployments/routers/router.py
+(LLMRouter exposing /v1/chat/completions, /v1/completions, /v1/models).
+The engine here is the TPU-native one (engine.py), not external vLLM.
+
+The server pumps engine.step() on a background asyncio task; each request
+registers an asyncio.Queue that tokens stream into, so concurrent HTTP
+requests share the continuously-batched decode loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .engine import EngineConfig, InferenceEngine, Request, SamplingParams
+from .tokenizer import load_tokenizer
+
+
+class LLMServerImpl:
+    """The deployment class body (decorated at app-build time)."""
+
+    def __init__(self, llm_config: Dict[str, Any]):
+        self._config = dict(llm_config)
+        engine_kwargs = dict(self._config.get("engine_kwargs") or {})
+        self.model_id = self._config.get("model_id", "default")
+        self.engine = InferenceEngine(EngineConfig(
+            model=self._config.get("model_source", "debug"),
+            **engine_kwargs))
+        self.tokenizer = load_tokenizer(
+            self._config.get("tokenizer_source"),
+            vocab_size=self.engine.model_cfg.vocab_size)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._pump: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+
+    # -- engine pump --------------------------------------------------------
+    def _ensure_pump(self) -> None:
+        if self._pump is None or self._pump.done():
+            self._wake = asyncio.Event()
+            self._pump = asyncio.create_task(self._pump_loop())
+
+    async def _pump_loop(self) -> None:
+        while True:
+            if not self.engine.has_work():
+                self._wake.clear()
+                await self._wake.wait()
+            # run the blocking device step off the event loop so request
+            # handlers/health checks stay responsive
+            touched = await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.step)
+            for req in touched:
+                q = self._queues.get(req.request_id)
+                if q is not None:
+                    q.put_nowait((req.output_tokens[-1], req.finished,
+                                  req.finish_reason))
+            await asyncio.sleep(0)
+
+    # -- generation ---------------------------------------------------------
+    async def _generate(self, prompt_tokens: List[int],
+                        params: SamplingParams) -> Request:
+        self._ensure_pump()
+        rid = uuid.uuid4().hex[:16]
+        req = Request(rid, prompt_tokens, params)
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        try:
+            self.engine.add_request(req)
+            self._wake.set()
+            while True:
+                _, finished, _ = await asyncio.wait_for(q.get(),
+                                                        timeout=300)
+                if finished:
+                    return req
+        finally:
+            self._queues.pop(rid, None)
+
+    def _sampling(self, body: Dict[str, Any]) -> SamplingParams:
+        eos = getattr(self.tokenizer, "eos_id",
+                      getattr(self.tokenizer, "eos_token_id", None))
+        stop = (eos,) if eos is not None else ()
+        return SamplingParams(
+            max_tokens=int(body.get("max_tokens") or 32),
+            temperature=float(body.get("temperature") or 0.0),
+            top_p=float(body.get("top_p") or 1.0),
+            stop_token_ids=stop)
+
+    async def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = self.tokenizer.apply_chat_template(
+            body.get("messages") or [])
+        toks = self.tokenizer.encode(prompt)
+        req = await self._generate(toks, self._sampling(body))
+        text = self.tokenizer.decode(req.output_tokens)
+        return {
+            "id": f"chatcmpl-{req.request_id}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_id,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(toks),
+                "completion_tokens": len(req.output_tokens),
+                "total_tokens": len(toks) + len(req.output_tokens),
+            },
+        }
+
+    async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        toks = self.tokenizer.encode(str(body.get("prompt") or ""))
+        req = await self._generate(toks, self._sampling(body))
+        return {
+            "id": f"cmpl-{req.request_id}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_id,
+            "choices": [{
+                "index": 0,
+                "text": self.tokenizer.decode(req.output_tokens),
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(toks),
+                "completion_tokens": len(req.output_tokens),
+                "total_tokens": len(toks) + len(req.output_tokens),
+            },
+        }
+
+    async def model_info(self) -> Dict[str, Any]:
+        return {"id": self.model_id, "object": "model",
+                "owned_by": "ray_tpu",
+                "engine": self.engine.stats()}
+
+    async def check_health(self) -> None:
+        return None
+
+
+class LLMRouterImpl:
+    """OpenAI-route ingress; fans out to per-model LLMServer handles."""
+
+    def __init__(self, *server_handles):
+        self._servers: Dict[str, Any] = {}
+        self._handles = list(server_handles)
+        self._resolved = False
+
+    async def _resolve(self) -> None:
+        if not self._resolved:
+            for h in self._handles:
+                info = await h.model_info.remote()
+                self._servers[info["id"]] = h
+            self._resolved = True
+
+    def _pick(self, body: Dict[str, Any]):
+        model = body.get("model")
+        if model and model in self._servers:
+            return self._servers[model]
+        if model and model not in self._servers:
+            return None
+        return next(iter(self._servers.values()))
+
+    async def __call__(self, request) -> Any:
+        from ...serve import Response
+
+        await self._resolve()
+        path = getattr(request, "path", "/")
+        method = getattr(request, "method", "POST")
+        if path.rstrip("/") == "/v1/models" and method == "GET":
+            models = [{"id": mid, "object": "model", "owned_by": "ray_tpu"}
+                      for mid in self._servers]
+            return {"object": "list", "data": models}
+        try:
+            body = request.json()
+        except Exception:
+            return Response({"error": "invalid JSON body"}, status=400,
+                            content_type="application/json")
+        server = self._pick(body)
+        if server is None:
+            return Response(
+                {"error": f"model {body.get('model')!r} not found"},
+                status=404, content_type="application/json")
+        if path.rstrip("/").endswith("/chat/completions"):
+            return await server.chat.remote(body)
+        if path.rstrip("/").endswith("/completions"):
+            return await server.completions.remote(body)
+        return Response({"error": f"no route {path}"}, status=404,
+                        content_type="application/json")

@@ -1,0 +1,161 @@
+"""Cache-aware Llama forward passes for inference.
+
+Net-new (reference inference = external vLLM; SURVEY.md §7 hard part #1).
+Two entry points, both designed to jit once and stay compiled:
+
+- prefill: full-prompt forward that also emits every layer's K/V and
+  scatters them into the shared page pool (ops/paged_attention.py layout:
+  [num_pages, page_size, n_layers, n_kv_heads, head_dim]).
+- decode_step: one token per active sequence, paged attention over the
+  pool, new KV scattered in-place (donate the pools for true in-place
+  HBM updates under jit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention as attention_op
+from ..ops.paged_attention import (gather_kv, paged_attention_on_gathered,
+                                   scatter_kv)
+from .llama import LlamaConfig, rms_norm, rope_frequencies
+
+
+def _rope_single(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, H, D) one token per sequence; cos/sin: (B, D//2)."""
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    cos = cos[:, None, :]
+    sin = sin[:, None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin],
+        axis=-1).astype(x.dtype)
+
+
+def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, H, D); cos/sin: (S, D//2) (shared positions)."""
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin],
+        axis=-1).astype(x.dtype)
+
+
+# ------------------------------------------------------------------- prefill
+
+def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
+            true_lens: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+            page_tables: jax.Array
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens: (B, S) padded prompts; true_lens: (B); page_tables:
+    (B, max_pages). Returns (last_logits (B, V) f32, k_pages, v_pages).
+    """
+    b, s = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    cos, sin = rope_frequencies(cfg, jnp.arange(s))
+
+    def layer_fn(x, layer):
+        y = rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = (y @ layer["wq"].astype(dt)).reshape(
+            b, s, cfg.n_heads, cfg.head_dim)
+        k = (y @ layer["wk"].astype(dt)).reshape(
+            b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (y @ layer["wv"].astype(dt)).reshape(
+            b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope_seq(q, cos, sin)
+        k = _rope_seq(k, cos, sin)
+        impl = "xla" if cfg.attention_impl in ("auto", "ring") \
+            else cfg.attention_impl
+        attn = attention_op(q, k, v, causal=True, impl=impl)
+        x = x + attn.reshape(b, s, cfg.q_dim) @ layer["wo"].astype(dt)
+        y = rms_norm(x, layer["ln2"], cfg.norm_eps)
+        gate = jax.nn.silu(y @ layer["wg"].astype(dt))
+        up = y @ layer["wi"].astype(dt)
+        x = x + (gate * up) @ layer["wd"].astype(dt)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    # ks/vs: (L, B, S, KVH, D) -> token-major (B*S, L, KVH, D)
+    k_rows = jnp.transpose(ks, (1, 2, 0, 3, 4)).reshape(
+        b * s, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+    v_rows = jnp.transpose(vs, (1, 2, 0, 3, 4)).reshape(
+        b * s, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+    positions = jnp.tile(jnp.arange(s), b)
+    valid = positions < jnp.repeat(true_lens, s)
+    tables = jnp.repeat(page_tables, s, axis=0)
+    k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
+                                  tables, positions, valid)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = last.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, k_pages, v_pages
+
+
+# -------------------------------------------------------------------- decode
+
+def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
+                tokens: jax.Array, positions: jax.Array,
+                k_pages: jax.Array, v_pages: jax.Array,
+                page_tables: jax.Array, active: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for the whole running batch.
+
+    tokens: (B,) last sampled token per slot; positions: (B,) its
+    absolute position (== number of cached tokens); active: (B,) bool.
+    Returns (logits (B, V) f32, k_pages, v_pages) with the new token's KV
+    scattered in.
+    """
+    b = tokens.shape[0]
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]          # (B, H)
+    cos, sin = rope_frequencies(cfg, positions)     # (B, D/2)
+
+    # One gather of the whole context for all layers, layer-major for scan.
+    k_ctx, v_ctx = gather_kv(k_pages, v_pages, page_tables)
+    k_ctx = jnp.transpose(k_ctx, (2, 0, 1, 3, 4))   # (L, B, ctx, KVH, D)
+    v_ctx = jnp.transpose(v_ctx, (2, 0, 1, 3, 4))
+
+    def layer_fn(x, inp):
+        layer, k_l, v_l = inp
+        y = rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = (y @ layer["wq"].astype(dt)).reshape(
+            b, cfg.n_heads, cfg.head_dim)
+        k = (y @ layer["wk"].astype(dt)).reshape(
+            b, cfg.n_kv_heads, cfg.head_dim)
+        v = (y @ layer["wv"].astype(dt)).reshape(
+            b, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope_single(q, cos, sin)
+        k = _rope_single(k, cos, sin)
+        # context plus the just-computed token (not yet in pages): valid
+        # cached entries are [0, positions), and the appended tail slot
+        # is always attendable (append_len=1)
+        k_full = jnp.concatenate([k_l, k[:, None]], axis=1)
+        v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
+        attn = paged_attention_on_gathered(
+            q, k_full, v_full, positions, append_len=1)
+        x = x + attn.reshape(b, cfg.q_dim) @ layer["wo"].astype(dt)
+        y = rms_norm(x, layer["ln2"], cfg.norm_eps)
+        gate = jax.nn.silu(y @ layer["wg"].astype(dt))
+        up = y @ layer["wi"].astype(dt)
+        x = x + (gate * up) @ layer["wd"].astype(dt)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_ctx, v_ctx))
+    k_rows = jnp.transpose(ks, (1, 0, 2, 3))        # (B, L, KVH, D)
+    v_rows = jnp.transpose(vs, (1, 0, 2, 3))
+    k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
+                                  page_tables, positions, active)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, k_pages, v_pages

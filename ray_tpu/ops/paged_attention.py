@@ -1,0 +1,99 @@
+"""Paged attention: decode-time attention over a block-paged KV cache.
+
+Net-new for the TPU build (the reference delegates paged attention to
+external vLLM CUDA kernels; SURVEY.md §7 step 10). Layout decision
+(TPU-first): one page pool shared by ALL layers —
+
+    k_pages, v_pages: [num_pages, page_size, n_layers, n_kv_heads, head_dim]
+
+so a decode token's KV for every layer lands in ONE scatter at
+(page, offset), and the per-step gather of a sequence's context is one
+take along the page axis (XLA turns both into efficient dynamic-slice
+loops over HBM; no per-layer page tables needed).
+
+The XLA path gathers pages into dense [B, ctx] KV then runs masked
+attention — the standard fallback. A Pallas kernel can later stream pages
+block-by-block without materializing the gather.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_kv(k_pages: jax.Array, v_pages: jax.Array,
+              page_tables: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """page_tables: [B, max_pages] int32 →
+    k/v: [B, max_pages*page_size, n_layers, n_kv_heads, head_dim]."""
+    def one(pages):
+        g = pages[page_tables]            # [B, P, page, L, KVH, D]
+        b, p, s, l, h, d = g.shape
+        return g.reshape(b, p * s, l, h, d)
+    return one(k_pages), one(v_pages)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_tables: jax.Array, seq_lens: jax.Array,
+                    layer: int) -> jax.Array:
+    """Single-layer decode attention.
+
+    q: [B, n_heads, head_dim] (one new token per sequence)
+    seq_lens: [B] number of valid cached tokens (including the new one)
+    Returns [B, n_heads, head_dim].
+    """
+    k, v = gather_kv(k_pages, v_pages, page_tables)
+    return paged_attention_on_gathered(
+        q, k[:, :, layer], v[:, :, layer], seq_lens)
+
+
+def paged_attention_on_gathered(q: jax.Array, k: jax.Array, v: jax.Array,
+                                seq_lens: jax.Array,
+                                append_len: int = 0) -> jax.Array:
+    """q: [B, H, D]; k/v: [B, ctx, KVH, D]; seq_lens: [B] → [B, H, D].
+
+    Valid positions: the first seq_lens[b] cached entries plus the last
+    `append_len` entries (decode appends the current token's KV at the
+    tail before it has been scattered into the pool). GQA: H query heads
+    share H//KVH groups. Softmax in float32, invalid positions -> -inf.
+    """
+    b, h, d = q.shape
+    ctx, kvh = k.shape[1], k.shape[2]
+    group = h // kvh
+    qf = q.reshape(b, kvh, group, d).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bckd->bkgc", qf, kf) / jnp.sqrt(d)
+    idx = jnp.arange(ctx)[None, :]
+    mask = idx < seq_lens[:, None]                        # [B, ctx]
+    if append_len:
+        mask = mask | (idx >= ctx - append_len)
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgc,bckd->bkgd", probs, vf)
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def scatter_kv(k_pages: jax.Array, v_pages: jax.Array,
+               k_new: jax.Array, v_new: jax.Array,
+               page_tables: jax.Array, positions: jax.Array,
+               valid: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Write new KV rows into the page pool.
+
+    k_new/v_new: [N, n_layers, n_kv_heads, head_dim] (N tokens, any mix of
+    sequences); page_tables: [N, max_pages] each token's OWN sequence
+    table; positions: [N] absolute position of each token; valid: [N]
+    bool — invalid rows write to a scratch page (the last page, which the
+    allocator never hands out) instead of branching.
+    """
+    page_size = k_pages.shape[1]
+    scratch = k_pages.shape[0] - 1
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    page_idx = jnp.where(valid, page_idx, scratch)
+    offset = positions % page_size
+    k_pages = k_pages.at[page_idx, offset].set(k_new)
+    v_pages = v_pages.at[page_idx, offset].set(v_new)
+    return k_pages, v_pages

@@ -22,3 +22,13 @@ __all__ = [
     "DeploymentConfig", "HTTPOptions", "batch", "multiplexed",
     "get_multiplexed_model_id", "Request", "Response",
 ]
+
+
+def __getattr__(name):
+    # serve.llm namespace (reference: python/ray/serve/llm), loaded
+    # lazily: the llm package pulls in jax + the model stack, which
+    # non-LLM serve processes (controller, proxy) must not pay for
+    if name == "llm":
+        from .. import llm
+        return llm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

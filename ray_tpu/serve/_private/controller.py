@@ -54,9 +54,16 @@ class _DeploymentInfo:
         self.autoscale_target: Optional[int] = None
         self._above_since: Optional[float] = None
         self._below_since: Optional[float] = None
+        # consecutive replica-start failures → exponential respawn backoff
+        self.start_failures = 0
+        self.next_start_at = 0.0
         self.apply_spec(spec)
 
     def apply_spec(self, spec: Dict[str, Any]) -> None:
+        if spec["version"] != getattr(self, "version", None):
+            # fresh code/config deserves a fresh backoff ladder
+            self.start_failures = 0
+            self.next_start_at = 0.0
         self.callable_blob = spec["callable_blob"]
         self.init_args_blob = spec["init_args_blob"]
         self.version = spec["version"]
@@ -265,10 +272,13 @@ class ServeController:
                        if r.version != info.version]
         running_new = [r for r in cur_version
                        if r.state == ReplicaState.RUNNING]
-        # 1) start missing current-version replicas
+        # 1) start missing current-version replicas (with exponential
+        # backoff after consecutive startup failures — a crashlooping
+        # constructor must not hot-spin the cluster)
         missing = target - len(cur_version)
-        for _ in range(max(missing, 0)):
-            self._start_replica(info)
+        if missing > 0 and time.time() >= info.next_start_at:
+            for _ in range(missing):
+                self._start_replica(info)
         # 2) rolling update: once enough new replicas run, drain old ones
         if old_version and len(running_new) >= min(target,
                                                    len(cur_version)):
@@ -322,9 +332,14 @@ class ServeController:
             info.replicas.pop(rep.replica_id, None)
             await self._kill(rep.handle)
             info.status = DeploymentStatus.UNHEALTHY
+            info.start_failures += 1
+            info.next_start_at = time.time() + min(
+                2.0 ** min(info.start_failures, 10) * 0.5, 30.0)
             return
         rep.state = ReplicaState.RUNNING
         rep.last_health = time.time()
+        info.start_failures = 0
+        info.next_start_at = 0.0
         self._bump(info)
 
     async def _stop_replica(self, info: _DeploymentInfo,

@@ -81,12 +81,15 @@ class ProxyActor:
         self._refresh_task = asyncio.create_task(self._refresh_routes())
         return self._port
 
+    async def _refresh_once(self) -> None:
+        controller = await ray_tpu.aio_get_actor(CONTROLLER_NAME)
+        table = await controller.get_route_table.remote()
+        self._routes = dict(table)
+
     async def _refresh_routes(self) -> None:
         while True:
             try:
-                controller = await ray_tpu.aio_get_actor(CONTROLLER_NAME)
-                table = await controller.get_route_table.remote()
-                self._routes = dict(table)
+                await self._refresh_once()
             except Exception:
                 pass
             await asyncio.sleep(1.0)
@@ -112,6 +115,14 @@ class ProxyActor:
             return web.json_response(
                 {p: f"{a}#{i}" for p, (a, i) in self._routes.items()})
         match = self._match(path)
+        if match is None:
+            # the app may have deployed since the last poll tick —
+            # refresh inline once before giving up
+            try:
+                await self._refresh_once()
+            except Exception:
+                pass
+            match = self._match(path)
         if match is None:
             return web.Response(status=404,
                                 text=f"no app mounted at {path}")
