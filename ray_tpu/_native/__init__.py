@@ -1,0 +1,56 @@
+"""Native (C++) components, loaded via ctypes.
+
+The .so builds on demand from src/ (g++ is in the base image); failures
+degrade gracefully — callers fall back to pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_libs = {}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _build_dir() -> str:
+    d = os.environ.get("RAY_TPU_BUILD_DIR")
+    if d:
+        return d
+    d = os.path.join(_REPO_ROOT, "build")
+    if os.access(os.path.dirname(d), os.W_OK):
+        return d
+    return os.path.join("/tmp", "ray_tpu_build")
+
+
+def load_library(name: str, source: str) -> Optional[ctypes.CDLL]:
+    """Load build/<name>.so, compiling src/<source> first if needed."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        lib = None
+        build = _build_dir()
+        so_path = os.path.join(build, f"{name}.so")
+        src_path = os.path.join(_REPO_ROOT, "src", source)
+        try:
+            if (not os.path.exists(so_path)
+                    or os.path.getmtime(so_path)
+                    < os.path.getmtime(src_path)):
+                os.makedirs(build, exist_ok=True)
+                tmp = so_path + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall",
+                     "-shared", "-pthread", "-o", tmp, src_path],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so_path)   # atomic: racing builders OK
+            lib = ctypes.CDLL(so_path)
+        except Exception:
+            lib = None
+        _libs[name] = lib
+        return lib

@@ -13,12 +13,85 @@ Reference parity:
 from __future__ import annotations
 
 import asyncio
+import os
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Dict, Optional, Tuple
 
 from .serialization import SerializedObject
 
 _MISSING = object()
+
+# ------------------------------------------------------------------ arena
+# Native C++ shared-memory arena (src/arena_store.cc) — the plasma-
+# equivalent data plane. One arena per session machine-wide; the first
+# node daemon creates it, workers/drivers attach lazily. When the native
+# lib is unavailable (or the arena is full), the per-object-segment path
+# below is the fallback.
+
+ARENA_DEFAULT_BYTES = int(os.environ.get("RAY_TPU_ARENA_BYTES",
+                                         256 << 20))
+
+_arenas: Dict[str, Any] = {}      # attached arenas by segment name
+
+
+def arena_name_for(session_name: str) -> str:
+    # full session name (timestamp+pid): arena names must never collide
+    # across concurrent sessions on one machine
+    return f"rtpu_{session_name}_arena"
+
+
+def _native_arena_mod():
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE_ARENA"):
+        return None
+    try:
+        from .._native import arena as native_arena
+    except Exception:
+        return None
+    return native_arena if native_arena.available() else None
+
+
+def attach_arena(name: str):
+    mod = _native_arena_mod()
+    if mod is None:
+        return None
+    a = _arenas.get(name)
+    if a is None:
+        a = mod.Arena.attach(name)
+        if a is not None:
+            _arenas[name] = a
+    return a
+
+
+def create_arena(session_name: str) -> Optional[Any]:
+    """Daemon-side: create the session arena, or attach if another
+    daemon on this machine already created it."""
+    mod = _native_arena_mod()
+    if mod is None:
+        return None
+    name = arena_name_for(session_name)
+    a = _arenas.get(name)
+    if a is None:
+        a, _ = mod.Arena.create_or_attach(name, ARENA_DEFAULT_BYTES)
+        if a is not None:
+            _arenas[name] = a
+    return a
+
+
+def unlink_session_arena(session_name: str) -> None:
+    """Session-wide teardown (driver shutdown): remove the arena segment.
+    Node-level daemon stops must NOT do this — other daemons of the same
+    session still serve objects out of it."""
+    name = arena_name_for(session_name)
+    _arenas.pop(name, None)
+    try:
+        from .._native.arena import Arena, available
+        if available():
+            a = Arena.attach(name)
+            if a is not None:
+                a.unlink()
+                a.detach()
+    except Exception:
+        pass
 
 
 def segment_name(session_name: str, object_id: str) -> str:
@@ -190,6 +263,10 @@ class NodeObjectStore:
         self.session_name = session_name
         self._entries: Dict[str, ShmStoreEntry] = {}
         self._seq = 0
+        # plasma-equivalent arena: first daemon on the machine creates
+        # it; lifetime is session-wide (unlink_session_arena at driver
+        # shutdown), NOT tied to this daemon
+        self.arena = create_arena(session_name)
 
     def segment_name(self, object_id: str) -> str:
         return segment_name(self.session_name, object_id)
@@ -211,6 +288,16 @@ class NodeObjectStore:
         entry = self._entries.get(object_id)
         if entry is None or not entry.sealed:
             return None
+        if entry.shm_name.startswith("arena:"):
+            _, arena_seg, oid = entry.shm_name.split(":", 2)
+            arena = attach_arena(arena_seg)
+            ref = arena.get(oid) if arena is not None else None
+            if ref is None:
+                return None
+            try:
+                return bytes(ref.buf[: entry.size])
+            finally:
+                ref.release()
         if entry.shm is None:
             entry.shm = attach_shm(entry.shm_name)
         return bytes(entry.shm.buf[: entry.size])
@@ -218,6 +305,12 @@ class NodeObjectStore:
     def free(self, object_id: str) -> None:
         entry = self._entries.pop(object_id, None)
         if entry is None:
+            return
+        if entry.shm_name.startswith("arena:"):
+            _, arena_seg, oid = entry.shm_name.split(":", 2)
+            arena = attach_arena(arena_seg)
+            if arena is not None:
+                arena.delete(oid)
             return
         if entry.shm is not None:
             try:
@@ -241,11 +334,30 @@ class NodeObjectStore:
 
 def write_to_shm(object_id: str, serialized: SerializedObject,
                  session_name: str) -> Tuple[str, int]:
-    """Create a segment for `serialized` and write its flat layout into it.
+    """Write `serialized` into shared memory for other processes.
 
-    Returns (shm_name, size). Caller must register it with the node daemon.
+    Preferred path: allocate+seal inside the native arena (one mmap per
+    process for ALL objects). Fallback (native lib missing or arena
+    full): one POSIX segment per object. Returns (shm_name, size) where
+    an arena-backed name is "arena:<segment>:<object_id>". Caller must
+    register it with the node daemon.
     """
     size = serialized.flat_size()
+    arena = attach_arena(arena_name_for(session_name))
+    if arena is not None:
+        buf = arena.create_buffer(object_id, size)
+        if buf is not None:
+            try:
+                serialized.write_flat(buf)
+            except BaseException:
+                # reclaim the unsealed slot — eviction never touches
+                # unsealed objects, so leaking it would be permanent
+                buf.release()
+                arena.delete(object_id)
+                raise
+            buf.release()
+            arena.seal(object_id)
+            return f"arena:{arena.name}:{object_id}", size
     name = segment_name(session_name, object_id)
     shm = create_untracked_shm(name, size)
     try:
@@ -256,11 +368,20 @@ def write_to_shm(object_id: str, serialized: SerializedObject,
 
 
 def read_from_shm(shm_name: str, size: int):
-    """Attach a sealed segment and deserialize zero-copy.
+    """Map a sealed object and deserialize zero-copy.
 
-    Returns (value, shm_handle). The handle must be kept alive as long as the
-    value may reference the mapping (numpy arrays view into it).
+    Returns (value, keepalive). The keepalive (arena Ref or shm handle)
+    must outlive the value — numpy arrays view into the mapping.
     """
+    if shm_name.startswith("arena:"):
+        _, arena_seg, object_id = shm_name.split(":", 2)
+        arena = attach_arena(arena_seg)
+        ref = arena.get(object_id) if arena is not None else None
+        if ref is None:
+            raise FileNotFoundError(
+                f"object {object_id[:12]} not in arena {arena_seg}")
+        serialized = SerializedObject.from_flat(ref.buf[:size])
+        return serialized.deserialize(), ref
     shm = attach_shm(shm_name)
     serialized = SerializedObject.from_flat(shm.buf[:size])
     value = serialized.deserialize()

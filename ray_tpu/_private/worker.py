@@ -137,6 +137,12 @@ def shutdown() -> None:
         rt.client.shutdown()
     except Exception:
         pass
+    # session-wide arena teardown (daemon stops deliberately don't unlink)
+    try:
+        from .object_store import unlink_session_arena
+        unlink_session_arena(rt.client.session_name)
+    except Exception:
+        pass
     rt.loop_runner.stop()
     try:
         atexit.unregister(shutdown)
