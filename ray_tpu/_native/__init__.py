@@ -39,9 +39,12 @@ def load_library(name: str, source: str) -> Optional[ctypes.CDLL]:
         so_path = os.path.join(build, f"{name}.so")
         src_path = os.path.join(_REPO_ROOT, "src", source)
         try:
-            if (not os.path.exists(so_path)
-                    or os.path.getmtime(so_path)
+            have_src = os.path.exists(src_path)
+            if not os.path.exists(so_path) or (
+                    have_src and os.path.getmtime(so_path)
                     < os.path.getmtime(src_path)):
+                if not have_src:
+                    raise FileNotFoundError(src_path)
                 os.makedirs(build, exist_ok=True)
                 tmp = so_path + f".tmp{os.getpid()}"
                 subprocess.run(

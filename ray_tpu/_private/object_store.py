@@ -345,6 +345,12 @@ def write_to_shm(object_id: str, serialized: SerializedObject,
     size = serialized.flat_size()
     arena = attach_arena(arena_name_for(session_name))
     if arena is not None:
+        # Policy note: a full arena falls back to per-object segments
+        # rather than evicting (Arena.evict). Evictable-looking objects
+        # (sealed, unpinned) are still owned by live ObjectRefs, and this
+        # runtime has task retries but no object reconstruction — evicting
+        # would turn "arena full" into ObjectLostError later. Eviction is
+        # reserved for a spill-to-disk layer that can restore.
         buf = arena.create_buffer(object_id, size)
         if buf is not None:
             try:

@@ -240,18 +240,37 @@ class WorkerRuntime:
         loop = asyncio.get_running_loop()
         try:
             args, kwargs = await self._resolve_args(args_blob)
-            fn = getattr(actor.instance, method)
-            await actor.admit(caller, seq)
-            if inspect.iscoroutinefunction(fn):
-                async def _run():
-                    async with actor.async_semaphore:
-                        return await fn(*args, **kwargs)
-                work = asyncio.ensure_future(_run())
-            else:
+            if method == "__rtpu_compiled_loop__":
+                # compiled-graph (ADAG) execution loop: a generic driver
+                # bound to this actor instance (ray_tpu/dag/compiled_dag.py).
+                # Runs on its OWN thread — it blocks for the graph's
+                # lifetime, and parking it in the actor's executor would
+                # starve every normal method call to this actor.
+                from ..dag.compiled_dag import run_actor_loop
+                import concurrent.futures as _cf
+                dedicated = _cf.ThreadPoolExecutor(
+                    1, thread_name_prefix=f"adag-{actor_id[:8]}")
+                await actor.admit(caller, seq)
                 work = loop.run_in_executor(
-                    actor.executor, lambda: fn(*args, **kwargs))
-            await actor.admitted(caller, seq)
-            result = await work
+                    dedicated, lambda: run_actor_loop(
+                        actor.instance, args[0]))
+                work.add_done_callback(
+                    lambda _: dedicated.shutdown(wait=False))
+                await actor.admitted(caller, seq)
+                result = await work
+            else:
+                fn = getattr(actor.instance, method)
+                await actor.admit(caller, seq)
+                if inspect.iscoroutinefunction(fn):
+                    async def _run():
+                        async with actor.async_semaphore:
+                            return await fn(*args, **kwargs)
+                    work = asyncio.ensure_future(_run())
+                else:
+                    work = loop.run_in_executor(
+                        actor.executor, lambda: fn(*args, **kwargs))
+                await actor.admitted(caller, seq)
+                result = await work
         except Exception:
             await actor.admitted(caller, seq)
             return {"status": "error", "error_tb": traceback.format_exc()}

@@ -27,6 +27,12 @@ class ActorMethod:
     def options(self, **opts):
         return self  # per-call options are accepted but unused for now
 
+    def bind(self, *args):
+        """Build a compiled-graph node from this method (reference:
+        python/ray/dag class_node.py — actor_method.bind)."""
+        from .dag.dag_node import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._name!r} cannot be called directly; "

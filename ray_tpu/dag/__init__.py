@@ -1,0 +1,13 @@
+"""ray_tpu.dag: compiled graphs (ADAG-equivalent).
+
+Reference parity: python/ray/dag — bind actor methods into a DAG,
+experimental_compile wires shared-memory channels between the actors,
+execute() streams through them without per-call task submission.
+"""
+
+from .compiled_dag import CompiledDAG, CompiledDAGRef, DagExecutionError
+from .dag_node import (ClassMethodNode, DAGNode, InputNode,
+                       MultiOutputNode)
+
+__all__ = ["InputNode", "MultiOutputNode", "DAGNode", "ClassMethodNode",
+           "CompiledDAG", "CompiledDAGRef", "DagExecutionError"]

@@ -1,0 +1,62 @@
+"""DAG node types for compiled graphs.
+
+Reference parity: python/ray/dag/dag_node.py (DAGNode,
+experimental_compile :265), input_node.py (InputNode context manager),
+class_node.py (ClassMethodNode via actor_method.bind), and
+output_node.py (MultiOutputNode).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+
+class DAGNode:
+    def experimental_compile(self, **kwargs):
+        from .compiled_dag import CompiledDAG
+        return CompiledDAG(self, **kwargs)
+
+    def _upstream(self) -> List["DAGNode"]:
+        return []
+
+
+class InputNode(DAGNode):
+    """`with InputNode() as inp:` — the per-execute input placeholder."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def __repr__(self):
+        return "InputNode()"
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method call in the graph."""
+
+    def __init__(self, actor_handle, method_name: str,
+                 args: Tuple[Any, ...]):
+        self.actor = actor_handle
+        self.method_name = method_name
+        self.args = args
+
+    def _upstream(self) -> List[DAGNode]:
+        return [a for a in self.args if isinstance(a, DAGNode)]
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.method_name})"
+
+
+class MultiOutputNode(DAGNode):
+    """Graph with several leaf outputs; execute() returns a list."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = list(outputs)
+
+    def _upstream(self) -> List[DAGNode]:
+        return list(self.outputs)
+
+    def __repr__(self):
+        return f"MultiOutputNode({len(self.outputs)})"

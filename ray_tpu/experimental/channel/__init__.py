@@ -1,0 +1,12 @@
+"""Typed channels for compiled graphs.
+
+Reference parity: python/ray/experimental/channel/ — shared-memory
+mutable-object channels (shared_memory_channel.py) with writer/reader
+semaphores. The native primitive is src/shm_channel.cc; this wrapper
+adds (de)serialization and a pure-Python fallback channel for
+environments without the native lib.
+"""
+
+from .shared_memory_channel import Channel, ChannelClosedError
+
+__all__ = ["Channel", "ChannelClosedError"]

@@ -1,0 +1,159 @@
+"""Shared-memory channel: single writer, N readers, one mutable slot.
+
+Reference parity: python/ray/experimental/channel/shared_memory_channel.py
+(796 LoC over C++ mutable objects — here over src/shm_channel.cc).
+A Channel handle pickles by name+role metadata, so it travels inside
+compiled-DAG specs to the actors at either end.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import uuid
+from typing import Any, Optional
+
+from ..._private.serialization import SerializedObject, serialize
+
+DEFAULT_CAPACITY = 4 << 20
+
+
+class ChannelClosedError(Exception):
+    pass
+
+
+def _lib():
+    from ..._native import load_library
+    lib = load_library("libshm_channel", "shm_channel.cc")
+    if lib is None:
+        return None
+    if not getattr(lib, "_chan_configured", False):
+        u64, vp, cp, dbl = (ctypes.c_uint64, ctypes.c_void_p,
+                            ctypes.c_char_p, ctypes.c_double)
+        lib.chan_create.restype = vp
+        lib.chan_create.argtypes = [cp, u64, u64]
+        lib.chan_attach.restype = vp
+        lib.chan_attach.argtypes = [cp]
+        lib.chan_write.restype = ctypes.c_int
+        lib.chan_write.argtypes = [vp, cp, u64, dbl]
+        lib.chan_read.restype = ctypes.c_int
+        lib.chan_read.argtypes = [vp, u64, ctypes.c_char_p, u64,
+                                  ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                  dbl]
+        lib.chan_capacity.restype = u64
+        lib.chan_capacity.argtypes = [vp]
+        lib.chan_close.argtypes = [vp]
+        lib.chan_detach.argtypes = [vp]
+        lib.chan_unlink.argtypes = [cp]
+        lib._chan_configured = True
+    return lib
+
+
+class Channel:
+    """create() on the driver; endpoints attach lazily on first use."""
+
+    def __init__(self, name: str, capacity: int, num_readers: int,
+                 _creator: bool = False):
+        self.name = name
+        self.capacity = capacity
+        self.num_readers = num_readers
+        self._h = None
+        self._creator = _creator
+        self._version = 0          # reader cursor
+        self._closed = False
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def create(cls, num_readers: int = 1,
+               capacity: int = DEFAULT_CAPACITY,
+               name: Optional[str] = None) -> "Channel":
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError(
+                "native channel lib unavailable (g++ build failed)")
+        name = name or f"rtpu_chan_{uuid.uuid4().hex[:16]}"
+        h = lib.chan_create(name.encode(), capacity, num_readers)
+        if not h:
+            raise RuntimeError(f"chan_create({name}) failed")
+        ch = cls(name, capacity, num_readers, _creator=True)
+        ch._h = h
+        return ch
+
+    def _handle(self):
+        if self._h is None:
+            lib = _lib()
+            h = lib.chan_attach(self.name.encode())
+            if not h:
+                raise ChannelClosedError(
+                    f"channel {self.name} is gone")
+            self._h = h
+        return self._h
+
+    # -- data plane ---------------------------------------------------------
+    def write(self, value: Any, timeout: float = 30.0) -> None:
+        lib = _lib()
+        blob = serialize(value).to_flat()
+        rc = lib.chan_write(self._handle(), blob, len(blob), timeout)
+        if rc == -32:                      # -EPIPE
+            raise ChannelClosedError(self.name)
+        if rc == -110:                     # -ETIMEDOUT
+            raise TimeoutError(
+                f"write to {self.name} timed out ({timeout}s); readers "
+                f"have not consumed the previous value")
+        if rc == -90:                      # -EMSGSIZE
+            raise ValueError(
+                f"message of {len(blob)} bytes exceeds channel capacity "
+                f"{self.capacity}")
+        if rc != 0:
+            raise RuntimeError(f"chan_write rc={rc}")
+
+    def read(self, timeout: float = 30.0) -> Any:
+        lib = _lib()
+        # reuse one read buffer: allocating+zeroing `capacity` bytes per
+        # read dominates latency for multi-MB channels
+        buf = getattr(self, "_read_buf", None)
+        if buf is None:
+            buf = self._read_buf = ctypes.create_string_buffer(
+                self.capacity)
+        out_len = ctypes.c_uint64()
+        out_ver = ctypes.c_uint64()
+        rc = lib.chan_read(self._handle(), self._version, buf,
+                           self.capacity, ctypes.byref(out_len),
+                           ctypes.byref(out_ver), timeout)
+        if rc == -32:
+            raise ChannelClosedError(self.name)
+        if rc == -110:
+            raise TimeoutError(f"read from {self.name} timed out "
+                               f"({timeout}s)")
+        if rc != 0:
+            raise RuntimeError(f"chan_read rc={rc}")
+        self._version = out_ver.value
+        return SerializedObject.from_flat(
+            memoryview(buf)[: out_len.value]).deserialize()
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            lib = _lib()
+            lib.chan_close(self._handle())
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        self.close()
+        lib = _lib()
+        if self._h is not None:
+            lib.chan_detach(self._h)
+            self._h = None
+        lib.chan_unlink(self.name.encode())
+
+    # -- pickling: handle travels, mapping re-attaches ----------------------
+    def __reduce__(self):
+        return (Channel, (self.name, self.capacity, self.num_readers))
+
+    def __repr__(self):
+        return (f"Channel({self.name}, cap={self.capacity}, "
+                f"readers={self.num_readers})")

@@ -36,6 +36,8 @@ struct Slot {
   char id[kIdLen];
   uint32_t state;
   uint32_t sealed;
+  uint32_t pending_delete;  // deleted while readers pinned it
+  uint32_t pad_;
   uint64_t offset;   // data offset from segment base
   uint64_t size;
   int64_t refcount;
@@ -190,14 +192,19 @@ extern "C" {
 // Create a new arena of `size` bytes with a table for `capacity` objects.
 // Returns an opaque handle or null.
 void* arena_create(const char* name, uint64_t size, uint64_t capacity) {
+  // reject segments too small to hold header + table + a minimal heap
+  uint64_t table_off = align_up(sizeof(Header), kAlign);
+  uint64_t table_bytes = align_up(capacity * sizeof(Slot), kAlign);
+  if (table_off + table_bytes + 2 * kAlign + sizeof(BlockHeader) > size) {
+    return nullptr;
+  }
   Handle* h = map_segment(name, size, /*create=*/true);
   if (!h) return nullptr;
   Header* hd = h->header;
   memset(hd, 0, sizeof(Header));
   hd->total_size = size;
   hd->table_capacity = capacity;
-  hd->table_offset = align_up(sizeof(Header), kAlign);
-  uint64_t table_bytes = align_up(capacity * sizeof(Slot), kAlign);
+  hd->table_offset = table_off;
   hd->heap_offset = hd->table_offset + table_bytes;
   hd->heap_size = size - hd->heap_offset;
   h->table = reinterpret_cast<Slot*>(
@@ -244,6 +251,7 @@ int64_t arena_alloc(void* handle, const char* id, uint64_t size) {
   memcpy(s->id, id, kIdLen);
   s->state = kUsed;
   s->sealed = 0;
+  s->pending_delete = 0;
   s->offset = static_cast<uint64_t>(off);
   s->size = size;
   s->refcount = 0;
@@ -268,7 +276,7 @@ int arena_get(void* handle, const char* id, uint64_t* offset,
   Handle* h = static_cast<Handle*>(handle);
   Locker lock(h->header);
   Slot* s = find_slot(h, id, false);
-  if (!s || !s->sealed) return -1;
+  if (!s || !s->sealed || s->pending_delete) return -1;
   s->refcount++;
   s->lru_tick = ++h->header->lru_clock;
   *offset = s->offset;
@@ -282,17 +290,27 @@ int arena_release(void* handle, const char* id) {
   Slot* s = find_slot(h, id, false);
   if (!s) return -1;
   if (s->refcount > 0) s->refcount--;
+  if (s->refcount == 0 && s->pending_delete) {
+    // deferred delete: last pinned reader gone, reclaim now
+    heap_free(h, s->offset);
+    s->state = kTombstone;
+    h->header->num_objects--;
+  }
   return 0;
 }
 
-// Delete an object regardless of refcount (owner decided; mapped readers
-// keep a valid mapping until the heap block is reused — same hazard
-// window plasma has on forced delete).
+// Delete an object. If readers still pin it (zero-copy numpy views into
+// the block), defer the heap free until the last release — freeing under
+// a pinned reader would let the next allocation overwrite live data.
 int arena_delete(void* handle, const char* id) {
   Handle* h = static_cast<Handle*>(handle);
   Locker lock(h->header);
   Slot* s = find_slot(h, id, false);
   if (!s) return -1;
+  if (s->refcount > 0) {
+    s->pending_delete = 1;   // invisible to new gets; freed on release
+    return 0;
+  }
   heap_free(h, s->offset);
   s->state = kTombstone;
   h->header->num_objects--;

@@ -1,0 +1,217 @@
+// Single-writer multi-reader shared-memory channel.
+//
+// Reference parity: the compiled-graph (ADAG) channel primitive —
+// src/ray/core_worker/experimental_mutable_object_manager.h (mutable
+// plasma objects with writer/reader semaphores) backing
+// python/ray/experimental/channel/shared_memory_channel.py. Semantics:
+// one logical slot; the writer blocks until every registered reader has
+// consumed the previous version; readers block until a version newer
+// than their cursor appears. Process-shared robust mutex + condvars in
+// the segment header; timeouts everywhere so a dead peer surfaces as an
+// error, not a deadlock.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055'4348414EULL;  // "RTPUCHAN"
+
+struct ChanHeader {
+  uint64_t magic;
+  uint64_t capacity;        // max message bytes
+  uint64_t msg_len;         // current message length
+  uint64_t version;         // 0 = nothing written yet
+  uint64_t num_readers;     // registered readers
+  uint64_t acks;            // readers that consumed current version
+  uint32_t closed;
+  pthread_mutex_t lock;
+  pthread_cond_t can_write;
+  pthread_cond_t can_read;
+};
+
+struct ChanHandle {
+  void* base;
+  uint64_t size;
+  ChanHeader* h;
+  char* data;
+  char name[256];
+};
+
+void abs_deadline(timespec* ts, double timeout_s) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += static_cast<time_t>(timeout_s);
+  ts->tv_nsec += static_cast<long>((timeout_s - static_cast<time_t>(
+      timeout_s)) * 1e9);
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+int lock_robust(ChanHeader* h) {
+  int rc = pthread_mutex_lock(&h->lock);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->lock);
+    rc = 0;
+  }
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* chan_create(const char* name, uint64_t capacity,
+                  uint64_t num_readers) {
+  uint64_t total = sizeof(ChanHeader) + capacity;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) { shm_unlink(name); return nullptr; }
+  auto* h = static_cast<ChanHeader*>(base);
+  memset(h, 0, sizeof(ChanHeader));
+  h->capacity = capacity;
+  h->num_readers = num_readers;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->lock, &ma);
+  pthread_mutexattr_destroy(&ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->can_write, &ca);
+  pthread_cond_init(&h->can_read, &ca);
+  pthread_condattr_destroy(&ca);
+
+  auto* hd = new ChanHandle();
+  hd->base = base;
+  hd->size = total;
+  hd->h = h;
+  hd->data = static_cast<char*>(base) + sizeof(ChanHeader);
+  snprintf(hd->name, sizeof(hd->name), "%s", name);
+  h->magic = kMagic;
+  return hd;
+}
+
+void* chan_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* base = mmap(nullptr, static_cast<uint64_t>(st.st_size),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  auto* h = static_cast<ChanHeader*>(base);
+  if (h->magic != kMagic) {
+    munmap(base, static_cast<uint64_t>(st.st_size));
+    return nullptr;
+  }
+  auto* hd = new ChanHandle();
+  hd->base = base;
+  hd->size = static_cast<uint64_t>(st.st_size);
+  hd->h = h;
+  hd->data = static_cast<char*>(base) + sizeof(ChanHeader);
+  snprintf(hd->name, sizeof(hd->name), "%s", name);
+  return hd;
+}
+
+// Write one message. Blocks until the previous version is fully
+// consumed. Returns 0, -ETIMEDOUT, -EPIPE (closed), -EMSGSIZE.
+int chan_write(void* handle, const char* buf, uint64_t len,
+               double timeout_s) {
+  auto* hd = static_cast<ChanHandle*>(handle);
+  ChanHeader* h = hd->h;
+  if (len > h->capacity) return -EMSGSIZE;
+  timespec ts;
+  abs_deadline(&ts, timeout_s);
+  if (lock_robust(h) != 0) return -EINVAL;
+  int rc = 0;
+  while (h->version > 0 && h->acks < h->num_readers && !h->closed) {
+    if (pthread_cond_timedwait(&h->can_write, &h->lock, &ts)
+        == ETIMEDOUT) { rc = -ETIMEDOUT; break; }
+  }
+  if (rc == 0 && h->closed) rc = -EPIPE;
+  if (rc == 0) {
+    memcpy(hd->data, buf, len);
+    h->msg_len = len;
+    h->version++;
+    h->acks = 0;
+    pthread_cond_broadcast(&h->can_read);
+  }
+  pthread_mutex_unlock(&h->lock);
+  return rc;
+}
+
+// Read the next message after `last_version`. On success copies up to
+// max_len bytes into out, stores the message length + new version, acks,
+// and returns 0. -ETIMEDOUT / -EPIPE (closed and nothing newer).
+int chan_read(void* handle, uint64_t last_version, char* out,
+              uint64_t max_len, uint64_t* out_len, uint64_t* out_version,
+              double timeout_s) {
+  auto* hd = static_cast<ChanHandle*>(handle);
+  ChanHeader* h = hd->h;
+  timespec ts;
+  abs_deadline(&ts, timeout_s);
+  if (lock_robust(h) != 0) return -EINVAL;
+  int rc = 0;
+  while (h->version <= last_version && !h->closed) {
+    if (pthread_cond_timedwait(&h->can_read, &h->lock, &ts)
+        == ETIMEDOUT) { rc = -ETIMEDOUT; break; }
+  }
+  if (rc == 0 && h->version <= last_version && h->closed) rc = -EPIPE;
+  if (rc == 0) {
+    uint64_t n = h->msg_len < max_len ? h->msg_len : max_len;
+    memcpy(out, hd->data, n);
+    *out_len = h->msg_len;
+    *out_version = h->version;
+    h->acks++;
+    if (h->acks >= h->num_readers) pthread_cond_broadcast(&h->can_write);
+  }
+  pthread_mutex_unlock(&h->lock);
+  return rc;
+}
+
+uint64_t chan_capacity(void* handle) {
+  return static_cast<ChanHandle*>(handle)->h->capacity;
+}
+
+void chan_close(void* handle) {
+  auto* hd = static_cast<ChanHandle*>(handle);
+  if (lock_robust(hd->h) == 0) {
+    hd->h->closed = 1;
+    pthread_cond_broadcast(&hd->h->can_read);
+    pthread_cond_broadcast(&hd->h->can_write);
+    pthread_mutex_unlock(&hd->h->lock);
+  }
+}
+
+void chan_detach(void* handle) {
+  auto* hd = static_cast<ChanHandle*>(handle);
+  munmap(hd->base, hd->size);
+  delete hd;
+}
+
+int chan_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -160,3 +160,25 @@ print("FALLBACK_OK")
                          cwd=os.path.dirname(os.path.dirname(
                              os.path.abspath(__file__))))
     assert "FALLBACK_OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_delete_defers_while_pinned(arena):
+    """Deleting a pinned object must not free the block under the
+    reader's zero-copy view."""
+    buf = arena.create_buffer(oid(40), 1024)
+    buf[:4] = b"data"
+    buf.release()
+    arena.seal(oid(40))
+    ref = arena.get(oid(40))
+    before = arena.stats()["bytes_allocated"]
+    assert arena.delete(oid(40))            # deferred: reader pinned
+    assert arena.stats()["bytes_allocated"] == before
+    assert arena.get(oid(40)) is None       # invisible to new gets
+    assert bytes(ref.buf[:4]) == b"data"    # view still valid
+    ref.release()                            # last release reclaims
+    assert arena.stats()["bytes_allocated"] < before
+
+
+def test_create_rejects_undersized_segment():
+    a = Arena.create(f"rtpu_tiny_{os.getpid()}", 65536, capacity=4096)
+    assert a is None                         # table would not fit
