@@ -1,0 +1,4 @@
+"""`python -m ray_tpu` → the CLI (reference: the `ray` console script)."""
+from .scripts.cli import main
+
+main()

@@ -106,6 +106,10 @@ class Controller:
         self.pending: List[dict] = []          # specs waiting for resources
         # task_id -> (node_id, resources, spec)
         self.running: Dict[str, Tuple[str, Dict[str, float], dict]] = {}
+        # task-event table backing the state API (reference: GCS task
+        # manager, src/ray/gcs/gcs_server/gcs_task_manager.h) — bounded
+        self.task_events: "Dict[str, dict]" = {}
+        self.task_events_cap = 10000
         self.node_timeout_s = 10.0
         self.placement_groups: Dict[str, Any] = {}
         self.pending_pgs: List[Any] = []
@@ -206,6 +210,16 @@ class Controller:
                     node.alive = False
                     await self._on_node_death(node.node_id)
 
+    async def rpc_get_session_info(self) -> dict:
+        """Bootstrap info for drivers attaching via init(address=...)."""
+        head_addr = None
+        for node in self.nodes.values():
+            if node.alive:
+                head_addr = list(node.addr)
+                break
+        return {"session_name": self.session_name,
+                "head_daemon_addr": head_addr}
+
     async def rpc_list_nodes(self) -> List[dict]:
         return [{
             "node_id": n.node_id, "addr": n.addr, "alive": n.alive,
@@ -242,9 +256,52 @@ class Controller:
                     f"in namespace {key[0]!r}"))
                 return {"status": "rejected"}
             self.named_actors[key] = spec["actor_id"]
+        self._task_event(spec["task_id"], "PENDING_SCHEDULING", spec=spec)
         self.pending.append(spec)
         self._sched_event.set()
         return {"status": "queued"}
+
+    def _task_event(self, task_id: str, state: str, spec: dict = None,
+                    node_id: str = None, error: str = None) -> None:
+        import time as _time
+        ev = self.task_events.get(task_id)
+        if ev is None:
+            if len(self.task_events) >= self.task_events_cap:
+                # evict terminal entries first (oldest insertion order);
+                # live PENDING/RUNNING events must survive churn
+                budget = self.task_events_cap // 10
+                victims = [k for k, e in self.task_events.items()
+                           if e["state"] in ("FINISHED", "FAILED")]
+                for key in victims[:budget]:
+                    del self.task_events[key]
+                if len(self.task_events) >= self.task_events_cap:
+                    for key in list(self.task_events)[:budget]:
+                        del self.task_events[key]
+            ev = self.task_events[task_id] = {
+                "task_id": task_id, "name": "", "type": "NORMAL_TASK",
+                "state": "", "node_id": None, "error": None,
+                "start_time": None, "end_time": None,
+                "creation_time": _time.time()}
+        if spec is not None:
+            ev["name"] = spec.get("name", "")
+            ev["type"] = ("ACTOR_CREATION_TASK"
+                          if spec.get("is_actor_creation")
+                          else "NORMAL_TASK")
+        ev["state"] = state
+        if node_id is not None:
+            ev["node_id"] = node_id
+        if state == "RUNNING":
+            ev["start_time"] = _time.time()
+        if state in ("FINISHED", "FAILED"):
+            ev["end_time"] = _time.time()
+        if error is not None:
+            ev["error"] = error
+
+    async def rpc_list_tasks(self, filters: dict = None) -> List[dict]:
+        events = list(self.task_events.values())
+        for key, val in (filters or {}).items():
+            events = [e for e in events if e.get(key) == val]
+        return events
 
     async def _schedule_loop(self) -> None:
         while not self._closed:
@@ -355,6 +412,7 @@ class Controller:
         self.running[spec["task_id"]] = (node.node_id,
                                          dict(spec.get("resources") or {}),
                                          spec)
+        self._task_event(spec["task_id"], "RUNNING", node_id=node.node_id)
         if spec.get("is_actor_creation"):
             self._register_pending_actor(spec, node.node_id)
         try:
@@ -371,6 +429,7 @@ class Controller:
         return node.node_id
 
     async def _fail_task(self, spec: dict, error: Exception) -> None:
+        self._task_event(spec["task_id"], "FAILED", error=repr(error))
         if spec.get("is_actor_creation"):
             # Release the claimed name and mark the directory entry dead so
             # the name can be reused and get_actor fails fast.
@@ -395,6 +454,7 @@ class Controller:
             pass
 
     async def rpc_task_finished(self, task_id: str, node_id: str) -> None:
+        self._task_event(task_id, "FINISHED")
         entry = self.running.pop(task_id, None)
         if entry is not None:
             nid, req, spec = entry

@@ -654,6 +654,15 @@ class CoreClient:
             "kill_actor", actor_id=actor_id, no_restart=no_restart))
         self._actor_addrs.pop(actor_id, None)
 
+    def controller_rpc(self, method: str, **kwargs):
+        """Generic control-plane RPC (state API, job submission)."""
+        return self.loop_runner.run_sync(
+            self._controller().call(method, **kwargs))
+
+    def daemon_rpc(self, addr, method: str, **kwargs):
+        return self.loop_runner.run_sync(
+            self.pool.get(tuple(addr)).call(method, **kwargs))
+
     def get_actor_handle_info(self, name: str, namespace: Optional[str]):
         return self.loop_runner.run_sync(self._controller().call(
             "get_named_actor", name=name,

@@ -295,6 +295,14 @@ class NodeDaemon:
     async def rpc_free_object(self, object_id: str) -> None:
         self.object_store.free(object_id)
 
+    async def rpc_list_objects(self) -> List[dict]:
+        """State-API view of this node's sealed shm objects."""
+        return [{"object_id": oid, "size": e.size,
+                 "node_id": self.node_id,
+                 "backend": ("arena" if e.shm_name.startswith("arena:")
+                             else "segment")}
+                for oid, e in self.object_store._entries.items()]
+
     async def rpc_node_stats(self) -> dict:
         return {
             "node_id": self.node_id,

@@ -62,10 +62,33 @@ def init(address: Optional[str] = None,
         return _runtime
 
     if address is not None:
-        raise NotImplementedError(
-            "connecting to an existing cluster (init(address=...)) is not "
-            "supported yet; start a head session with init() and add nodes "
-            "via add_fake_node() or the standalone daemon.")
+        # attach to an existing cluster: address = "host:port" of the
+        # controller (written to the cluster-address file by `ray_tpu
+        # start --head`)
+        host, _, port = address.rpartition(":")
+        controller_addr = (host or "127.0.0.1", int(port))
+        loop_runner = LoopRunner()
+
+        async def _fetch_info():
+            from .protocol import RpcClient
+            rpc = RpcClient(*controller_addr)
+            try:
+                return await rpc.call("get_session_info")
+            finally:
+                await rpc.close()
+
+        info = loop_runner.run_sync(_fetch_info(), timeout=15)
+        daemon_addr = info.get("head_daemon_addr")
+        client = CoreClient(controller_addr,
+                            tuple(daemon_addr) if daemon_addr else None,
+                            info["session_name"], loop_runner=loop_runner,
+                            namespace=namespace)
+        client.start()
+        state.set_client(client)
+        _runtime = Runtime(client, None, None, loop_runner,
+                           info["session_name"])
+        atexit.register(shutdown)
+        return _runtime
 
     session_name = f"s{int(time.time())}_{os.getpid()}"
     loop_runner = LoopRunner()
@@ -137,12 +160,14 @@ def shutdown() -> None:
         rt.client.shutdown()
     except Exception:
         pass
-    # session-wide arena teardown (daemon stops deliberately don't unlink)
-    try:
-        from .object_store import unlink_session_arena
-        unlink_session_arena(rt.client.session_name)
-    except Exception:
-        pass
+    # session-wide arena teardown — only when this process OWNS the
+    # session (attached drivers must not yank the arena from a live head)
+    if rt.controller is not None:
+        try:
+            from .object_store import unlink_session_arena
+            unlink_session_arena(rt.client.session_name)
+        except Exception:
+            pass
     rt.loop_runner.stop()
     try:
         atexit.unregister(shutdown)

@@ -165,11 +165,14 @@ class WorkerRuntime:
             self._apply_tpu_isolation(spec)
             fn = deserialize_code(spec["fn_blob"])
             args, kwargs = await self._resolve_args(spec["args_blob"])
-            if inspect.iscoroutinefunction(fn):
-                result = await fn(*args, **kwargs)
-            else:
-                result = await loop.run_in_executor(
-                    self.task_executor, lambda: fn(*args, **kwargs))
+            from ..util.tracing import span
+            with span(spec.get("name", "task"), "task::execute",
+                      task_id=spec.get("task_id", "")[:16]):
+                if inspect.iscoroutinefunction(fn):
+                    result = await fn(*args, **kwargs)
+                else:
+                    result = await loop.run_in_executor(
+                        self.task_executor, lambda: fn(*args, **kwargs))
         except Exception:
             tb = traceback.format_exc()
             await self._push_error(
@@ -245,7 +248,11 @@ class WorkerRuntime:
                 # bound to this actor instance (ray_tpu/dag/compiled_dag.py).
                 # Runs on its OWN thread — it blocks for the graph's
                 # lifetime, and parking it in the actor's executor would
-                # starve every normal method call to this actor.
+                # starve every normal method call to this actor. Like the
+                # reference's compiled graphs (which execute on a system
+                # concurrency group), graph-bound methods therefore run
+                # CONCURRENTLY with normal calls; the sync-actor FIFO
+                # guarantee covers normal calls only.
                 from ..dag.compiled_dag import run_actor_loop
                 import concurrent.futures as _cf
                 dedicated = _cf.ThreadPoolExecutor(

@@ -24,9 +24,6 @@ from typing import Any, Dict, List, Optional
 from ..experimental.channel import Channel, ChannelClosedError
 from .dag_node import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
-LOOP_READ_TIMEOUT_S = 600.0
-
-
 class _DagError:
     def __init__(self, tb: str):
         self.tb = tb
@@ -56,9 +53,26 @@ def run_actor_loop(instance, specs: List[Dict[str, Any]]) -> None:
     """
     import traceback
 
+    def read_retry(ch: Channel):
+        # timeouts are NOT fatal (a pipeline may idle arbitrarily long
+        # between executes, or a peer may stall); only channel closure —
+        # teardown — terminates the loop
+        while True:
+            try:
+                return ch.read(timeout=60.0)
+            except TimeoutError:
+                continue
+
+    def write_retry(ch: Channel, value) -> None:
+        while True:
+            try:
+                ch.write(value, timeout=60.0)
+                return
+            except TimeoutError:
+                continue          # driver not draining yet; keep waiting
+
     while True:
         values: Dict[int, Any] = {}
-        first_read = True
         try:
             for spec in specs:
                 args = []
@@ -70,18 +84,7 @@ def run_actor_loop(instance, specs: List[Dict[str, Any]]) -> None:
                             # this actor may need to produce a value a
                             # peer is waiting on before its own later
                             # inputs become available
-                            if first_read:
-                                while True:
-                                    try:
-                                        values[id(src)] = src.read(
-                                            timeout=60.0)
-                                        break
-                                    except TimeoutError:
-                                        continue    # idle pipeline
-                                first_read = False
-                            else:
-                                values[id(src)] = src.read(
-                                    timeout=LOOP_READ_TIMEOUT_S)
+                            values[id(src)] = read_retry(src)
                         val = values[id(src)]
                         if isinstance(val, _DagError) and err is None:
                             err = val
@@ -97,9 +100,8 @@ def run_actor_loop(instance, specs: List[Dict[str, Any]]) -> None:
                         result = _DagError(traceback.format_exc())
                 if spec["output"] is not None:
                     values[id(spec["output"])] = result
-                    spec["output"].write(result,
-                                         timeout=LOOP_READ_TIMEOUT_S)
-        except (ChannelClosedError, TimeoutError):
+                    write_retry(spec["output"], result)
+        except ChannelClosedError:
             return
 
 
@@ -138,6 +140,7 @@ class CompiledDAG:
         self._exec_count = 0
         self._fetch_count = 0
         self._results: Dict[int, Any] = {}
+        self._partial: Dict[int, Any] = {}   # channel idx -> value
         self._lock = threading.Lock()
         self._compile(root)
 
@@ -252,10 +255,17 @@ class CompiledDAG:
 
     def _fetch(self, index: int, timeout: float):
         with self._lock:
-            # results must be drained in order; channels serialize versions
+            # results must be drained in order; channels serialize
+            # versions. _partial keeps per-channel reads across a timeout
+            # so a retried get() never re-reads an already-acked channel
+            # (its cursor has advanced — re-reading would hang).
             while self._fetch_count <= index:
-                vals = [ch.read(timeout=timeout)
-                        for ch in self._output_channels]
+                for i, ch in enumerate(self._output_channels):
+                    if i not in self._partial:
+                        self._partial[i] = ch.read(timeout=timeout)
+                vals = [self._partial[i]
+                        for i in range(len(self._output_channels))]
+                self._partial.clear()
                 self._results[self._fetch_count] = (
                     vals if self._multi_output else vals[0])
                 self._fetch_count += 1

@@ -1,0 +1,10 @@
+"""Dashboard: REST state/metrics endpoints + job manager.
+
+Reference parity: python/ray/dashboard (head.py + modules: api, node,
+job, metrics, state). TS frontend replaced by JSON endpoints (the state
+CLI renders tables); Prometheus text at /metrics.
+"""
+
+from .head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

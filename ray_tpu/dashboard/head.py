@@ -1,0 +1,202 @@
+"""Dashboard head: aiohttp REST server in the head process.
+
+Routes (reference: dashboard/modules/*):
+  GET  /api/cluster_status      nodes + resources
+  GET  /api/nodes               list_nodes
+  GET  /api/tasks               task events
+  GET  /api/actors              actor directory
+  GET  /api/objects             shm object tables
+  GET  /api/placement_groups
+  GET  /metrics                 Prometheus text (driver + flushed workers)
+  POST /api/jobs                {"entrypoint": shell-cmd, ...} → job id
+  GET  /api/jobs                all jobs
+  GET  /api/jobs/{id}           one job
+  GET  /api/jobs/{id}/logs      captured stdout/stderr
+  POST /api/jobs/{id}/stop
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from .job_manager import JobManager
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self.job_manager = JobManager()
+        self._runner = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/api/cluster_status", self._cluster_status)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/objects", self._objects)
+        app.router.add_get("/api/placement_groups", self._pgs)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_post("/api/jobs", self._submit_job)
+        app.router.add_get("/api/jobs", self._list_jobs)
+        app.router.add_get("/api/jobs/{job_id}", self._get_job)
+        app.router.add_get("/api/jobs/{job_id}/logs", self._job_logs)
+        app.router.add_post("/api/jobs/{job_id}/stop", self._stop_job)
+        app.router.add_get("/", self._index)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        return self.port
+
+    async def stop(self) -> None:
+        self.job_manager.stop_all()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    async def _in_thread(fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args)
+
+    @staticmethod
+    def _json(payload) -> "web.Response":
+        from aiohttp import web
+        return web.json_response(payload)
+
+    # -- state routes -------------------------------------------------------
+    async def _index(self, request):
+        from aiohttp import web
+        return web.json_response({
+            "service": "ray_tpu dashboard",
+            "routes": ["/api/cluster_status", "/api/nodes", "/api/tasks",
+                       "/api/actors", "/api/objects",
+                       "/api/placement_groups", "/api/jobs", "/metrics"]})
+
+    async def _cluster_status(self, request):
+        import ray_tpu
+        total = await self._in_thread(ray_tpu.cluster_resources)
+        avail = await self._in_thread(ray_tpu.available_resources)
+        nodes = await self._in_thread(ray_tpu.nodes)
+        return self._json({"cluster_resources": total,
+                           "available_resources": avail,
+                           "num_nodes": len(nodes)})
+
+    async def _nodes(self, request):
+        from ..util import state as state_api
+        return self._json(await self._in_thread(state_api.list_nodes))
+
+    async def _tasks(self, request):
+        from ..util import state as state_api
+        return self._json(await self._in_thread(state_api.list_tasks))
+
+    async def _actors(self, request):
+        from ..util import state as state_api
+        return self._json(await self._in_thread(state_api.list_actors))
+
+    async def _objects(self, request):
+        from ..util import state as state_api
+        return self._json(await self._in_thread(state_api.list_objects))
+
+    async def _pgs(self, request):
+        from ..util import state as state_api
+        return self._json(
+            await self._in_thread(state_api.list_placement_groups))
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        from ..util import metrics as metrics_api
+        text = await self._in_thread(metrics_api.export_prometheus)
+        return web.Response(text=text,
+                            content_type="text/plain")
+
+    # -- job routes ---------------------------------------------------------
+    async def _submit_job(self, request):
+        body = await request.json()
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            from aiohttp import web
+            return web.json_response({"error": "entrypoint required"},
+                                     status=400)
+        job_id = await self._in_thread(
+            lambda: self.job_manager.submit(
+                entrypoint,
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+                submission_id=body.get("submission_id")))
+        return self._json({"submission_id": job_id, "job_id": job_id})
+
+    async def _list_jobs(self, request):
+        return self._json(self.job_manager.list_jobs())
+
+    async def _get_job(self, request):
+        info = self.job_manager.get_job(request.match_info["job_id"])
+        if info is None:
+            from aiohttp import web
+            return web.json_response({"error": "no such job"}, status=404)
+        return self._json(info)
+
+    async def _job_logs(self, request):
+        logs = self.job_manager.get_logs(request.match_info["job_id"])
+        if logs is None:
+            from aiohttp import web
+            return web.json_response({"error": "no such job"}, status=404)
+        return self._json({"logs": logs})
+
+    async def _stop_job(self, request):
+        ok = self.job_manager.stop(request.match_info["job_id"])
+        return self._json({"stopped": bool(ok)})
+
+
+_dashboard: Optional[DashboardHead] = None
+_thread_loop: Optional[asyncio.AbstractEventLoop] = None
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> DashboardHead:
+    """Start the dashboard on a background event loop thread (driver- or
+    head-process side)."""
+    global _dashboard, _thread_loop
+    if _dashboard is not None:
+        return _dashboard
+    dash = DashboardHead(host, port)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(dash.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="ray_tpu-dashboard")
+    t.start()
+    if not started.wait(timeout=15):
+        raise TimeoutError("dashboard failed to start")
+    _dashboard = dash
+    _thread_loop = loop
+    return dash
+
+
+def stop_dashboard() -> None:
+    global _dashboard, _thread_loop
+    if _dashboard is None:
+        return
+    dash, loop = _dashboard, _thread_loop
+    _dashboard = _thread_loop = None
+    fut = asyncio.run_coroutine_threadsafe(dash.stop(), loop)
+    try:
+        fut.result(timeout=10)
+    except Exception:
+        pass
+    loop.call_soon_threadsafe(loop.stop)

@@ -1,0 +1,136 @@
+"""Job manager: run submitted entrypoints as supervised subprocesses.
+
+Reference parity: dashboard/modules/job/job_manager.py:60 (JobManager +
+per-job supervisor; PENDING → RUNNING → SUCCEEDED/FAILED/STOPPED),
+with logs captured to the session log dir.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class JobManager:
+    def __init__(self, log_dir: Optional[str] = None):
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "ray_tpu", "job_logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+
+    def submit(self, entrypoint: str,
+               runtime_env: Optional[Dict[str, Any]] = None,
+               metadata: Optional[Dict[str, str]] = None,
+               submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id} already exists")
+            self._jobs[job_id] = {
+                "submission_id": job_id,
+                "entrypoint": entrypoint,
+                "status": JobStatus.PENDING,
+                "metadata": dict(metadata or {}),
+                "start_time": None, "end_time": None,
+                "submit_time": time.time(),
+                "return_code": None,
+                "message": "",
+            }
+        threading.Thread(target=self._supervise,
+                         args=(job_id, entrypoint, runtime_env or {}),
+                         daemon=True).start()
+        return job_id
+
+    def _supervise(self, job_id: str, entrypoint: str,
+                   runtime_env: Dict[str, Any]) -> None:
+        log_path = os.path.join(self._log_dir, f"{job_id}.log")
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in (runtime_env.get("env_vars") or {}).items()})
+        cwd = runtime_env.get("working_dir") or None
+        info = self._jobs[job_id]
+        try:
+            with open(log_path, "wb") as log:
+                proc = subprocess.Popen(entrypoint, shell=True, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env,
+                                        cwd=cwd,
+                                        start_new_session=True)
+                with self._lock:
+                    self._procs[job_id] = proc
+                    info["status"] = JobStatus.RUNNING
+                    info["start_time"] = time.time()
+                rc = proc.wait()
+        except Exception as e:
+            with self._lock:
+                info["status"] = JobStatus.FAILED
+                info["message"] = repr(e)
+                info["end_time"] = time.time()
+            return
+        with self._lock:
+            self._procs.pop(job_id, None)
+            info["return_code"] = rc
+            info["end_time"] = time.time()
+            if info["status"] == JobStatus.STOPPED:
+                pass
+            elif rc == 0:
+                info["status"] = JobStatus.SUCCEEDED
+            else:
+                info["status"] = JobStatus.FAILED
+                info["message"] = f"exit code {rc}"
+
+    # -- queries ------------------------------------------------------------
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(j) for j in self._jobs.values()]
+
+    def get_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            return dict(info) if info else None
+
+    def get_logs(self, job_id: str) -> Optional[str]:
+        if job_id not in self._jobs:
+            return None
+        path = os.path.join(self._log_dir, f"{job_id}.log")
+        try:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(job_id)
+            info = self._jobs.get(job_id)
+            if info is None:
+                return False
+            if proc is None:
+                return info["status"] in (JobStatus.STOPPED,)
+            info["status"] = JobStatus.STOPPED
+        try:
+            import signal
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except Exception:
+            proc.terminate()
+        return True
+
+    def stop_all(self) -> None:
+        with self._lock:
+            ids = list(self._procs)
+        for job_id in ids:
+            self.stop(job_id)

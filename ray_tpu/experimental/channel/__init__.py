@@ -3,8 +3,8 @@
 Reference parity: python/ray/experimental/channel/ — shared-memory
 mutable-object channels (shared_memory_channel.py) with writer/reader
 semaphores. The native primitive is src/shm_channel.cc; this wrapper
-adds (de)serialization and a pure-Python fallback channel for
-environments without the native lib.
+adds (de)serialization. Channels REQUIRE the native lib (g++ build):
+compiled graphs are a performance feature with no slow-path fallback.
 """
 
 from .shared_memory_channel import Channel, ChannelClosedError

@@ -1,0 +1,52 @@
+"""Cluster-wide KV on the control plane.
+
+Reference parity: python/ray/experimental/internal_kv.py:34 (GCS-backed
+_internal_kv_get/put/del/list/exists).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .._private import state as _state
+
+
+def _client():
+    return _state.current_client()
+
+
+def _internal_kv_initialized() -> bool:
+    return _state.current_client_or_none() is not None
+
+
+def _internal_kv_put(key, value, overwrite: bool = True,
+                     namespace: Optional[str] = None) -> bool:
+    key = _ns(key, namespace)
+    value = value if isinstance(value, bytes) else str(value).encode()
+    return _client().kv_put(key, value, overwrite=overwrite)
+
+
+def _internal_kv_get(key, namespace: Optional[str] = None
+                     ) -> Optional[bytes]:
+    return _client().kv_get(_ns(key, namespace))
+
+
+def _internal_kv_exists(key, namespace: Optional[str] = None) -> bool:
+    return _internal_kv_get(key, namespace) is not None
+
+
+def _internal_kv_del(key, namespace: Optional[str] = None) -> bool:
+    return _client().controller_rpc("kv_del", key=_ns(key, namespace))
+
+
+def _internal_kv_list(prefix, namespace: Optional[str] = None
+                      ) -> List[bytes]:
+    keys = _client().controller_rpc("kv_keys",
+                                    prefix=_ns(prefix, namespace))
+    return [k.encode() if isinstance(k, str) else k for k in keys]
+
+
+def _ns(key, namespace: Optional[str]) -> str:
+    if isinstance(key, bytes):
+        key = key.decode()
+    return f"{namespace}:{key}" if namespace else key

@@ -1,0 +1,71 @@
+"""Job submission SDK.
+
+Reference parity: python/ray/job_submission (JobSubmissionClient over the
+dashboard REST API; JobStatus lifecycle).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .dashboard.job_manager import JobStatus  # re-export
+
+__all__ = ["JobSubmissionClient", "JobStatus"]
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str = "http://127.0.0.1:8265"):
+        import requests
+        self._address = address.rstrip("/")
+        self._http = requests
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        r = self._http.post(
+            f"{self._address}/api/jobs",
+            json={"entrypoint": entrypoint, "runtime_env": runtime_env,
+                  "metadata": metadata, "submission_id": submission_id},
+            timeout=30)
+        r.raise_for_status()
+        return r.json()["submission_id"]
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        r = self._http.get(f"{self._address}/api/jobs", timeout=30)
+        r.raise_for_status()
+        return r.json()
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        r = self._http.get(f"{self._address}/api/jobs/{job_id}",
+                           timeout=30)
+        r.raise_for_status()
+        return r.json()
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_logs(self, job_id: str) -> str:
+        r = self._http.get(f"{self._address}/api/jobs/{job_id}/logs",
+                           timeout=30)
+        r.raise_for_status()
+        return r.json()["logs"]
+
+    def stop_job(self, job_id: str) -> bool:
+        r = self._http.post(f"{self._address}/api/jobs/{job_id}/stop",
+                            timeout=30)
+        r.raise_for_status()
+        return r.json()["stopped"]
+
+    def wait_until_finished(self, job_id: str,
+                            timeout_s: float = 300.0) -> str:
+        deadline = time.time() + timeout_s
+        terminal = {JobStatus.SUCCEEDED, JobStatus.FAILED,
+                    JobStatus.STOPPED}
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            if status in terminal:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} not finished in {timeout_s}s")

@@ -1,0 +1,241 @@
+"""The ray_tpu CLI: start/stop/status/list/summary/job.
+
+Reference parity: python/ray/scripts/scripts.py (`ray start --head`,
+`ray stop`, `ray status`) and util/state/state_cli.py (`ray list ...`,
+`ray summary ...`), plus `ray job submit/status/logs/stop`.
+
+`start --head` runs a persistent head process (controller + node daemon
++ dashboard) and writes the cluster-address file; drivers attach with
+ray_tpu.init(address=...) or RAY_TPU_ADDRESS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+ADDR_DIR = os.path.join(tempfile.gettempdir(), "ray_tpu")
+ADDR_FILE = os.path.join(ADDR_DIR, "ray_current_cluster")
+
+
+def _write_cluster_file(address: str, dashboard: str, pid: int) -> None:
+    os.makedirs(ADDR_DIR, exist_ok=True)
+    with open(ADDR_FILE, "w") as f:
+        json.dump({"address": address, "dashboard": dashboard,
+                   "pid": pid}, f)
+
+
+def read_cluster_file():
+    try:
+        with open(ADDR_FILE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _attach():
+    import ray_tpu
+    info = read_cluster_file()
+    if info is None:
+        sys.exit("no running cluster (start one with "
+                 "`ray_tpu start --head`)")
+    ray_tpu.init(address=info["address"])
+    return info
+
+
+# ------------------------------------------------------------------ verbs
+
+def cmd_start(args) -> None:
+    import ray_tpu
+
+    if not args.head:
+        sys.exit("joining an existing cluster as a worker node requires "
+                 "--head for now (single-host runtime); multi-host "
+                 "attach lands with the DCN transport")
+    rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    controller_addr = rt.controller.address
+    address = f"{controller_addr[0]}:{controller_addr[1]}"
+    dashboard_addr = ""
+    if not args.no_dashboard:
+        from ray_tpu.dashboard import start_dashboard
+        dash = start_dashboard(port=args.dashboard_port)
+        dashboard_addr = f"http://127.0.0.1:{dash.port}"
+    _write_cluster_file(address, dashboard_addr, os.getpid())
+    print(f"ray_tpu head started.\n  address: {address}\n"
+          f"  dashboard: {dashboard_addr or '(disabled)'}\n"
+          f"Attach with ray_tpu.init(address={address!r}); stop with "
+          f"`ray_tpu stop`.")
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        ray_tpu.shutdown()
+    else:
+        # stay alive as the head process in the background
+        try:
+            signal.pause()
+        except KeyboardInterrupt:
+            ray_tpu.shutdown()
+
+
+def cmd_stop(args) -> None:
+    info = read_cluster_file()
+    if info is None:
+        print("no cluster-address file; nothing to stop")
+        return
+    pid = info.get("pid")
+    try:
+        os.kill(pid, signal.SIGINT)
+        print(f"sent SIGINT to head process {pid}")
+    except ProcessLookupError:
+        print(f"head process {pid} already gone")
+    try:
+        os.remove(ADDR_FILE)
+    except FileNotFoundError:
+        pass
+
+
+def cmd_status(args) -> None:
+    import ray_tpu
+    _attach()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    nodes = ray_tpu.nodes()
+    print(f"Nodes: {len(nodes)}")
+    for node in nodes:
+        print(f"  {node}")
+    print("Resources:")
+    for key in sorted(total):
+        print(f"  {key}: {avail.get(key, 0):g}/{total[key]:g} free")
+    ray_tpu.shutdown()
+
+
+def _print_table(rows, columns) -> None:
+    if not rows:
+        print("(empty)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    print("  ".join(c.ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in columns))
+
+
+def cmd_list(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+    _attach()
+    kind = args.resource
+    if kind == "tasks":
+        _print_table(state_api.list_tasks(),
+                     ["task_id", "name", "type", "state", "node_id"])
+    elif kind == "actors":
+        _print_table(state_api.list_actors(),
+                     ["actor_id", "class_name", "state", "name"])
+    elif kind == "nodes":
+        _print_table(state_api.list_nodes(),
+                     ["node_id", "addr", "resources"])
+    elif kind == "objects":
+        _print_table(state_api.list_objects(),
+                     ["object_id", "size", "backend", "node_id"])
+    elif kind == "placement-groups":
+        _print_table(state_api.list_placement_groups(),
+                     ["placement_group_id", "state", "strategy"])
+    ray_tpu.shutdown()
+
+
+def cmd_summary(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+    _attach()
+    fn = {"tasks": state_api.summarize_tasks,
+          "actors": state_api.summarize_actors,
+          "objects": state_api.summarize_objects}[args.resource]
+    print(json.dumps(fn(), indent=2))
+    ray_tpu.shutdown()
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+    info = read_cluster_file()
+    dash = (info or {}).get("dashboard") or "http://127.0.0.1:8265"
+    client = JobSubmissionClient(args.address or dash)
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(f"submitted {job_id}")
+        if args.wait:
+            status = client.wait_until_finished(job_id)
+            print(f"{job_id}: {status}")
+            print(client.get_job_logs(job_id))
+    elif args.job_cmd == "list":
+        _print_table(client.list_jobs(),
+                     ["submission_id", "status", "entrypoint"])
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+
+
+# ------------------------------------------------------------------ parser
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--no-dashboard", action="store_true")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the running head")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resources + nodes")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("resource", choices=["tasks", "actors", "nodes",
+                                         "objects", "placement-groups"])
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize cluster state")
+    sp.add_argument("resource", choices=["tasks", "actors", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("job", help="job submission")
+    sp.add_argument("--address", default=None,
+                    help="dashboard address (http://host:port)")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

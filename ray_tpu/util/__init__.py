@@ -4,3 +4,10 @@ from .placement_group import (placement_group, remove_placement_group,
 from .scheduling_strategies import (PlacementGroupSchedulingStrategy,
                                     NodeAffinitySchedulingStrategy,
                                     NodeLabelSchedulingStrategy)
+
+from .actor_pool import ActorPool
+from .queue import Queue, Empty, Full
+from .check_serialize import inspect_serializability
+from . import metrics
+from . import state
+from . import tracing

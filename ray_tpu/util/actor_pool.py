@@ -1,0 +1,89 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference parity: python/ray/util/actor_pool.py (submit/map/
+map_unordered/get_next/get_next_unordered/push/pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; runs when an actor is idle."""
+        if not self._idle:
+            raise RuntimeError(
+                "no idle actors; call get_next()/get_next_unordered() "
+                "to harvest results first")
+        actor = self._idle.pop()
+        future = fn(actor, value)
+        self._future_to_actor[future] = (self._next_task_index, actor)
+        self._index_to_future[self._next_task_index] = future
+        self._next_task_index += 1
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    # -- harvesting ---------------------------------------------------------
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if self._next_return_index >= self._next_task_index:
+            raise StopIteration("no pending results")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = ray_tpu.get(future, timeout=timeout)
+        _, actor = self._future_to_actor.pop(future)
+        self._idle.append(actor)
+        return value
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next COMPLETED result, any order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        idx, actor = self._future_to_actor.pop(future)
+        self._index_to_future.pop(idx, None)
+        self._idle.append(actor)
+        return ray_tpu.get(future)
+
+    # -- bulk ---------------------------------------------------------------
+    def map(self, fn, values: Iterable[Any]):
+        for v in values:
+            if not self._idle:
+                yield self.get_next()
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values: Iterable[Any]):
+        for v in values:
+            if not self._idle:
+                yield self.get_next_unordered()
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- membership ---------------------------------------------------------
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,64 @@
+"""Serializability inspection.
+
+Reference parity: python/ray/util/check_serialize.py
+(inspect_serializability — walk an object's closure/attributes and name
+which inner object fails to pickle).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Set, Tuple
+
+from .._private.serialization import serialize
+
+
+class FailureTuple:
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.name!r}, parent={self.parent!r})"
+
+
+def _try(obj) -> bool:
+    try:
+        serialize(obj)
+        return True
+    except Exception:
+        return False
+
+
+def inspect_serializability(obj: Any, name: str = None
+                            ) -> Tuple[bool, Set[FailureTuple]]:
+    """Returns (serializable, failures); failures name the innermost
+    unserializable members."""
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    if _try(obj):
+        return True, set()
+    failures: Set[FailureTuple] = set()
+    found_inner = False
+    # closures
+    if inspect.isfunction(obj) and obj.__closure__:
+        for var, cell in zip(obj.__code__.co_freevars, obj.__closure__):
+            try:
+                inner = cell.cell_contents
+            except ValueError:
+                continue
+            if not _try(inner):
+                found_inner = True
+                ok, inner_fails = inspect_serializability(inner, var)
+                failures |= inner_fails or {FailureTuple(inner, var, name)}
+    # instance attributes
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        for attr, val in d.items():
+            if not _try(val):
+                found_inner = True
+                ok, inner_fails = inspect_serializability(val, attr)
+                failures |= inner_fails or {FailureTuple(val, attr, name)}
+    if not found_inner:
+        failures.add(FailureTuple(obj, name, None))
+    return False, failures

@@ -1,0 +1,199 @@
+"""User-defined metrics: Counter, Gauge, Histogram.
+
+Reference parity: python/ray/util/metrics.py:41-294 (Counter/Gauge/
+Histogram over the C++ OpenCensus pipeline → Prometheus). Here metrics
+live in a process-local registry; any process can render the Prometheus
+text exposition (export_prometheus), and worker registries are scraped
+into the dashboard via the controller KV (flush_to_kv / collect_cluster).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                      2.5, 5.0, 10.0]
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    # -- tags ---------------------------------------------------------------
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        merged = {**self._default_tags, **(tags or {})}
+        missing = set(self._tag_keys) - set(merged)
+        if missing:
+            raise ValueError(f"missing tag(s) {sorted(missing)} for "
+                             f"metric {self._name}")
+        return tuple(merged.get(k, "") for k in self._tag_keys)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def info(self) -> Dict[str, object]:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+    def _samples(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            return [(dict(zip(self._tag_keys, key)), val)
+                    for key, val in self._values.items()]
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or DEFAULT_BOUNDARIES)
+        self._buckets: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._counts: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            buckets[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def _samples(self):
+        with self._lock:
+            out = []
+            for key, buckets in self._buckets.items():
+                tags = dict(zip(self._tag_keys, key))
+                out.append((tags, {"buckets": list(buckets),
+                                   "sum": self._sums[key],
+                                   "count": self._counts[key]}))
+            return out
+
+
+# ----------------------------------------------------------------- export
+
+def _fmt_tags(tags: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in tags.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def export_prometheus() -> str:
+    """This process's registry in Prometheus text exposition format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        lines.append(f"# HELP {m._name} {m._description}")
+        lines.append(f"# TYPE {m._name} {m.metric_type}")
+        if isinstance(m, Histogram):
+            for tags, data in m._samples():
+                cumulative = 0
+                for bound, n in zip(m.boundaries + [float("inf")],
+                                    data["buckets"]):
+                    cumulative += n
+                    le = "+Inf" if bound == float("inf") else repr(bound)
+                    lines.append(
+                        f"{m._name}_bucket"
+                        + _fmt_tags(tags, 'le="%s"' % le)
+                        + f" {cumulative}")
+                lines.append(
+                    f"{m._name}_sum{_fmt_tags(tags)} {data['sum']}")
+                lines.append(
+                    f"{m._name}_count{_fmt_tags(tags)} {data['count']}")
+        else:
+            for tags, val in m._samples():
+                lines.append(f"{m._name}{_fmt_tags(tags)} {val}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot() -> Dict[str, object]:
+    """JSON-able snapshot of this process's registry."""
+    out = {}
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        out[m._name] = {"type": m.metric_type, "info": m.info,
+                        "samples": m._samples()}
+    return out
+
+
+def flush_to_kv() -> None:
+    """Publish this process's snapshot to the controller KV so the
+    dashboard / collect_cluster can aggregate across processes."""
+    import ray_tpu
+    from .._private import state as _state
+
+    client = _state.current_client_or_none()
+    if client is None:
+        return
+    wid = getattr(client, "worker_id", None) or f"pid{__import__('os').getpid()}"
+    client.kv_put(f"__metrics__/{wid}",
+                  json.dumps({"ts": time.time(),
+                              "metrics": snapshot()}).encode())
+
+
+def collect_cluster() -> Dict[str, object]:
+    """All flushed per-process snapshots, keyed by worker id."""
+    from .._private import state as _state
+
+    client = _state.current_client()
+    out = {}
+    for key in client.controller_rpc("kv_keys", prefix="__metrics__/"):
+        blob = client.kv_get(key)
+        if blob:
+            out[key.split("/", 1)[1]] = json.loads(blob)
+    return out
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "export_prometheus",
+           "snapshot", "flush_to_kv", "collect_cluster"]

@@ -1,0 +1,111 @@
+"""Distributed FIFO queue backed by an async actor.
+
+Reference parity: python/ray/util/queue.py (Queue over an _QueueActor;
+put/get with block/timeout, qsize/empty/full, put_nowait/get_nowait,
+shutdown).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item: Any, timeout: Optional[float] = None) -> bool:
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def put_nowait(self, item: Any) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def empty(self) -> bool:
+        return self._q.empty()
+
+    async def full(self) -> bool:
+        return self._q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0,
+                 actor_options: Optional[dict] = None):
+        cls = ray_tpu.remote(**(actor_options or {"num_cpus": 0}))(
+            _QueueActor)
+        self.actor = cls.remote(maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+                raise Full()
+            return
+        if not ray_tpu.get(self.actor.put.remote(item, timeout)):
+            raise Full()
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty()
+            return item
+        ok, item = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty()
+        return item
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def shutdown(self) -> None:
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass

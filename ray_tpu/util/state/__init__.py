@@ -1,0 +1,99 @@
+"""State API: introspect tasks, actors, objects, nodes, placement groups.
+
+Reference parity: python/ray/util/state/api.py (`ray list ...` client)
+backed by the GCS task manager / dashboard state aggregator. Here the
+control-plane controller keeps the bounded task-event table and the
+actor/node/PG directories; node daemons report their shm object tables.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+from ..._private import state as _state
+
+
+def _client():
+    return _state.current_client()
+
+
+def list_tasks(filters: Optional[Dict[str, Any]] = None,
+               detail: bool = False) -> List[Dict[str, Any]]:
+    return _client().controller_rpc("list_tasks", filters=filters)
+
+
+def list_actors(filters: Optional[Dict[str, Any]] = None
+                ) -> List[Dict[str, Any]]:
+    actors = _client().controller_rpc("list_actors")
+    for key, val in (filters or {}).items():
+        actors = [a for a in actors if a.get(key) == val]
+    return actors
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _client().controller_rpc("list_nodes")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    table = _client().controller_rpc("placement_group_table")
+    return [dict(info, placement_group_id=pg_id)
+            for pg_id, info in table.items()]
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Sealed shm objects across every node daemon."""
+    client = _client()
+    out: List[Dict[str, Any]] = []
+    for node in client.controller_rpc("list_nodes"):
+        addr = node.get("addr") or node.get("address")
+        if addr is None:
+            continue
+        try:
+            out.extend(client.daemon_rpc(addr, "list_objects"))
+        except Exception:
+            continue
+    return out
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    tasks = list_tasks()
+    by_state = Counter(t["state"] for t in tasks)
+    by_name = Counter(t["name"] for t in tasks)
+    return {"total": len(tasks), "by_state": dict(by_state),
+            "by_func_name": dict(by_name)}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    actors = list_actors()
+    by_state = Counter(a.get("state", "?") for a in actors)
+    by_class = Counter(a.get("class_name", "?") for a in actors)
+    return {"total": len(actors), "by_state": dict(by_state),
+            "by_class_name": dict(by_class)}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects()
+    return {"total": len(objs),
+            "total_size_bytes": sum(o["size"] for o in objs),
+            "by_backend": dict(Counter(o["backend"] for o in objs))}
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    for t in list_tasks():
+        if t["task_id"] == task_id:
+            return t
+    return None
+
+
+def get_actor(actor_id: str) -> Optional[Dict[str, Any]]:
+    for a in list_actors():
+        if a.get("actor_id") == actor_id:
+            return a
+    return None
+
+
+__all__ = ["list_tasks", "list_actors", "list_nodes", "list_objects",
+           "list_placement_groups", "summarize_tasks",
+           "summarize_actors", "summarize_objects", "get_task",
+           "get_actor"]

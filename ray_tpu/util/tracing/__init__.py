@@ -1,0 +1,83 @@
+"""Task/actor tracing: spans + Chrome-trace export.
+
+Reference parity: python/ray/util/tracing/tracing_helper.py (opt-in
+OpenTelemetry spans around submit/execute with context propagated in
+task specs) and the dashboard's Chrome-trace timeline. Here spans are
+recorded in a process-local ring and exported as Chrome trace events
+(chrome://tracing / Perfetto "traceEvents" JSON); enable with
+RAY_TPU_TRACE=1 or tracing.enable().
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+_enabled = bool(os.environ.get("RAY_TPU_TRACE"))
+_MAX_EVENTS = 100_000
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def span(name: str, category: str = "task", **attrs):
+    """Record one duration span (no-op unless tracing is enabled)."""
+    if not _enabled:
+        yield
+        return
+    start = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        end = time.perf_counter_ns()
+        with _lock:
+            if len(_events) < _MAX_EVENTS:
+                _events.append({
+                    "name": name, "cat": category, "ph": "X",
+                    "ts": start / 1e3, "dur": (end - start) / 1e3,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000,
+                    "args": attrs,
+                })
+
+
+def get_events() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def export_chrome_trace(path: Optional[str] = None) -> str:
+    """Write (or return) the Chrome trace JSON for this process."""
+    doc = json.dumps({"traceEvents": get_events(),
+                      "displayTimeUnit": "ms"})
+    if path:
+        with open(path, "w") as f:
+            f.write(doc)
+    return doc
+
+
+__all__ = ["enable", "disable", "is_enabled", "span", "get_events",
+           "clear", "export_chrome_trace"]

@@ -148,8 +148,13 @@ int chan_write(void* handle, const char* buf, uint64_t len,
   if (lock_robust(h) != 0) return -EINVAL;
   int rc = 0;
   while (h->version > 0 && h->acks < h->num_readers && !h->closed) {
-    if (pthread_cond_timedwait(&h->can_write, &h->lock, &ts)
-        == ETIMEDOUT) { rc = -ETIMEDOUT; break; }
+    int w = pthread_cond_timedwait(&h->can_write, &h->lock, &ts);
+    if (w == EOWNERDEAD) {
+      // a peer died holding the lock; recover and re-evaluate
+      pthread_mutex_consistent(&h->lock);
+      continue;
+    }
+    if (w == ETIMEDOUT) { rc = -ETIMEDOUT; break; }
   }
   if (rc == 0 && h->closed) rc = -EPIPE;
   if (rc == 0) {
@@ -176,8 +181,12 @@ int chan_read(void* handle, uint64_t last_version, char* out,
   if (lock_robust(h) != 0) return -EINVAL;
   int rc = 0;
   while (h->version <= last_version && !h->closed) {
-    if (pthread_cond_timedwait(&h->can_read, &h->lock, &ts)
-        == ETIMEDOUT) { rc = -ETIMEDOUT; break; }
+    int w = pthread_cond_timedwait(&h->can_read, &h->lock, &ts);
+    if (w == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->lock);
+      continue;
+    }
+    if (w == ETIMEDOUT) { rc = -ETIMEDOUT; break; }
   }
   if (rc == 0 && h->version <= last_version && h->closed) rc = -EPIPE;
   if (rc == 0) {
