@@ -1,0 +1,108 @@
+"""Mixture-of-Experts: top-k routing + capacity-based dispatch.
+
+Net-new for the TPU build (EP is absent in the reference — SURVEY.md
+§2.4; vLLM-internal only). GShard/Switch-style formulation chosen FOR the
+hardware: dispatch/combine are einsums against a [tokens, experts,
+capacity] one-hot — static shapes, MXU-friendly, and when the expert
+dimension is sharded over the `ep` mesh axis XLA lowers the dispatch
+einsum to the all-to-all over ICI (no hand-written collective).
+
+Tokens over an expert's capacity are dropped (residual passes through),
+the standard Switch behavior that keeps shapes static under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import with_logical_constraint as wlc
+
+
+def router_probs(x: jax.Array, router_w: jax.Array
+                 ) -> jax.Array:
+    """x: [T, H]; router_w: [H, E] → probs [T, E] (float32 softmax)."""
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def top_k_routing(probs: jax.Array, k: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """probs: [T, E] → (gates [T, k] renormalized, indices [T, k])."""
+    gates, idx = jax.lax.top_k(probs, k)
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    return gates, idx
+
+
+def load_balancing_loss(probs: jax.Array, idx: jax.Array,
+                        num_experts: int) -> jax.Array:
+    """Switch aux loss: E * Σ_e fraction_e * mean_prob_e."""
+    t = probs.shape[0]
+    sel = jax.nn.one_hot(idx[:, 0], num_experts)      # top-1 assignment
+    fraction = jnp.mean(sel, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return num_experts * jnp.sum(fraction * mean_prob)
+
+
+def make_dispatch(probs: jax.Array, k: int, capacity: int
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Build (dispatch [T, E, C] one-hot, combine [T, E, C] gate-weighted,
+    aux_loss) for capacity C per expert."""
+    t, num_experts = probs.shape
+    gates, idx = top_k_routing(probs, k)
+    aux = load_balancing_loss(probs, idx, num_experts)
+
+    dispatch = jnp.zeros((t, num_experts, capacity), probs.dtype)
+    combine = jnp.zeros((t, num_experts, capacity), probs.dtype)
+    for slot in range(k):                      # k is tiny (1-2): unrolled
+        e = idx[:, slot]                       # [T]
+        onehot = jax.nn.one_hot(e, num_experts, dtype=probs.dtype)
+        # position of each token within its expert's queue, counting
+        # earlier slots' assignments too
+        prior = dispatch.sum(axis=2)           # [T, E] taken so far
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - onehot
+                    + prior.sum(axis=0, keepdims=True))  # [T, E]
+        pos = jnp.sum(pos_in_e * onehot, axis=1)          # [T]
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)
+        contrib = (onehot * keep[:, None])[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gates[:, slot, None, None]
+    return dispatch, combine, aux
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array,
+            wi: jax.Array, wg: jax.Array, wd: jax.Array,
+            *, top_k: int = 2, capacity_factor: float = 1.25
+            ) -> Tuple[jax.Array, jax.Array]:
+    """MoE SwiGLU feed-forward.
+
+    x: [B, S, H]; router_w: [H, E]; wi/wg: [E, H, F]; wd: [E, F, H].
+    Returns (out [B, S, H], aux_loss scalar). Shard wi/wg/wd with logical
+    axes ("experts", ...) and the dispatched activations pick up the
+    all-to-all over the ep mesh axis.
+    """
+    b, s, h = x.shape
+    num_experts = router_w.shape[1]
+    dt = x.dtype
+    xt = x.reshape(b * s, h)
+    t = xt.shape[0]
+    capacity = max(int(t * top_k / num_experts * capacity_factor), 1)
+
+    probs = router_probs(xt, router_w)
+    dispatch, combine, aux = make_dispatch(probs, top_k, capacity)
+    dispatch = dispatch.astype(dt)
+    combine = combine.astype(dt)
+
+    expert_in = jnp.einsum("tec,th->ech", dispatch, xt)
+    expert_in = wlc(expert_in, "experts", None, "act_embed")
+    gate = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                  wg.astype(dt)))
+    up = jnp.einsum("ech,ehf->ecf", expert_in, wi.astype(dt))
+    expert_out = jnp.einsum("ecf,efh->ech", gate * up, wd.astype(dt))
+    expert_out = wlc(expert_out, "experts", None, "act_embed")
+    out = jnp.einsum("tec,ech->th", combine, expert_out)
+    return out.reshape(b, s, h), aux
