@@ -1,0 +1,53 @@
+"""Virtual multi-device CPU mesh bootstrap (the test-cluster equivalent).
+
+Reference parity: SURVEY.md §4 — multi-node simulation via
+``xla_force_host_platform_device_count``. One recipe, shared by
+``tests/conftest.py`` (in-process) and ``__graft_entry__.dryrun_multichip``
+(child process), so the two can't silently diverge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import MutableMapping
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def apply_cpu_mesh_env(env: MutableMapping[str, str],
+                       n_devices: int = 8,
+                       *,
+                       keep_existing_count: bool = False) -> MutableMapping[str, str]:
+    """Mutate *env* so a jax backend initialized under it boots a virtual
+    n-device CPU mesh.
+
+    Machine quirk handled here: this box's sitecustomize registers the axon
+    TPU PJRT plugin (which then forces ``jax_platforms='axon,cpu'``) whenever
+    ``PALLAS_AXON_POOL_IPS`` is set — clear it so fresh interpreters stay on
+    the plain CPU backend.
+    """
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    flags = env.get("XLA_FLAGS", "")
+    if keep_existing_count and _COUNT_FLAG in flags:
+        return env
+    flags = " ".join(f for f in flags.split() if not f.startswith(_COUNT_FLAG))
+    env["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    return env
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Apply the recipe to this process. If jax is already imported (on this
+    machine sitecustomize always imports it), also flip its platform config —
+    env alone is read only at backend init."""
+    import sys
+
+    # Respect an operator-set device count (e.g. a 16-device pytest run).
+    apply_cpu_mesh_env(os.environ, n_devices, keep_existing_count=True)
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass

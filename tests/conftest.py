@@ -6,26 +6,13 @@ multi-host simulation is `xla_force_host_platform_device_count=8`
 """
 
 import os
+import sys
 
-# Hard-set (not setdefault): the machine env presets JAX_PLATFORMS=axon (the
-# real TPU tunnel) and a sitecustomize registers the axon PJRT plugin at
-# interpreter start, which overrides JAX_PLATFORMS. Tests must run on the
-# virtual CPU mesh, so: (1) clear PALLAS_AXON_POOL_IPS so worker
-# subprocesses never register axon, (2) force this process's platform via
-# jax.config (env alone is ignored once the plugin registered).
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax
+from ray_tpu._private.cpu_mesh import force_cpu_mesh
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+force_cpu_mesh(8)
 
 import pytest
 
