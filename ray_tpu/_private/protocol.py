@@ -241,16 +241,28 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
         async with self._write_lock:
-            _write_frame(self._writer, KIND_REQUEST, msg_id, _method, kwargs)
-            await self._writer.drain()
+            writer = self._writer  # may go None concurrently on disconnect
+            if writer is None:
+                self._pending.pop(msg_id, None)
+                raise ConnectionError(f"connection closed before {_method}")
+            _write_frame(writer, KIND_REQUEST, msg_id, _method, kwargs)
+            await writer.drain()
         return await fut
 
     async def oneway(self, _method: str, **kwargs) -> None:
         if self._writer is None:
+            if self._closed:
+                return  # fire-and-forget during shutdown: drop silently
             await self.connect()
         async with self._write_lock:
-            _write_frame(self._writer, KIND_ONEWAY, 0, _method, kwargs)
-            await self._writer.drain()
+            writer = self._writer
+            if writer is None:
+                if self._closed:
+                    return  # shutdown race: drop silently
+                raise ConnectionError(
+                    f"connection lost before oneway {_method}")
+            _write_frame(writer, KIND_ONEWAY, 0, _method, kwargs)
+            await writer.drain()
 
     async def close(self) -> None:
         self._closed = True

@@ -196,6 +196,12 @@ class CompiledDAG:
         for leaf in leaves:
             consumers[id(leaf)] = consumers.get(id(leaf), 0) + 1  # driver
 
+        # distinct ack-bitmask slot per reader endpoint of each channel:
+        # consuming actors in sorted order, the driver (for leaves) last
+        reader_slots: Dict[int, Dict[str, int]] = {
+            key: {aid: i for i, aid in enumerate(sorted(actors))}
+            for key, actors in reader_actors.items()}
+
         def make_channel(n_readers: int) -> Channel:
             ch = Channel.create(num_readers=n_readers,
                                 capacity=self._buffer_size,
@@ -214,23 +220,43 @@ class CompiledDAG:
             # 0 readers is legal: a value consumed only by its own
             # actor's later specs never crosses the channel
             node_out[id(node)] = make_channel(consumers.get(id(node), 0))
-        self._output_channels = [node_out[id(leaf)] for leaf in leaves]
+        # the driver reads leaves through its own slot (after all actors)
+        self._output_channels = [
+            node_out[id(leaf)].for_reader(
+                len(reader_actors.get(id(leaf), ())))
+            for leaf in leaves]
         self._multi_output = isinstance(root, MultiOutputNode)
 
-        # group node specs per actor, preserving topo order
+        # group node specs per actor, preserving topo order. Each actor
+        # gets its OWN copy of every channel it touches, carrying that
+        # actor's reader slot; the copy is memoized per (actor, node) so
+        # a producer spec's output and same-actor consumer inputs stay
+        # one object (run_actor_loop dedups reads by object identity).
+        reader_copies: Dict[Any, Channel] = {}
+
+        def chan_for(actor_id: str, value_node) -> Channel:
+            memo_key = (actor_id, id(value_node))
+            ch = reader_copies.get(memo_key)
+            if ch is None:
+                slot = reader_slots.get(id(value_node), {}).get(actor_id, 0)
+                ch = node_out[id(value_node)].for_reader(slot)
+                reader_copies[memo_key] = ch
+            return ch
+
         per_actor: Dict[str, Dict[str, Any]] = {}
         for node in order:
+            aid = node.actor._actor_id
             entry = per_actor.setdefault(
-                node.actor._actor_id, {"actor": node.actor, "specs": []})
+                aid, {"actor": node.actor, "specs": []})
             inputs = []
             for a in node.args:
                 if isinstance(a, (InputNode, ClassMethodNode)):
-                    inputs.append(("chan", node_out[id(a)]))
+                    inputs.append(("chan", chan_for(aid, a)))
                 else:
                     inputs.append(("const", a))
             entry["specs"].append({"method": node.method_name,
                                    "inputs": inputs,
-                                   "output": node_out[id(node)]})
+                                   "output": chan_for(aid, node)})
 
         # launch the per-actor loops (long-running actor tasks)
         self._loop_refs = []

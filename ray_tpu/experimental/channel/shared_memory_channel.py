@@ -37,7 +37,7 @@ def _lib():
         lib.chan_write.restype = ctypes.c_int
         lib.chan_write.argtypes = [vp, cp, u64, dbl]
         lib.chan_read.restype = ctypes.c_int
-        lib.chan_read.argtypes = [vp, u64, ctypes.c_char_p, u64,
+        lib.chan_read.argtypes = [vp, u64, u64, ctypes.c_char_p, u64,
                                   ctypes.POINTER(u64), ctypes.POINTER(u64),
                                   dbl]
         lib.chan_capacity.restype = u64
@@ -53,10 +53,14 @@ class Channel:
     """create() on the driver; endpoints attach lazily on first use."""
 
     def __init__(self, name: str, capacity: int, num_readers: int,
-                 _creator: bool = False):
+                 reader_slot: int = 0, _creator: bool = False):
         self.name = name
         self.capacity = capacity
         self.num_readers = num_readers
+        # Identity of THIS endpoint among the channel's readers (bit index
+        # in the native ack bitmask). Distinct readers must hold distinct
+        # slots or the writer may overwrite before all of them consumed.
+        self.reader_slot = reader_slot
         self._h = None
         self._creator = _creator
         self._version = 0          # reader cursor
@@ -71,6 +75,11 @@ class Channel:
         if lib is None:
             raise RuntimeError(
                 "native channel lib unavailable (g++ build failed)")
+        if num_readers > 64:
+            raise ValueError(
+                f"channels support at most 64 readers (got {num_readers}): "
+                "reader acks live in one 64-bit bitmask; fan wider via a "
+                "tree of channels or the object store")
         name = name or f"rtpu_chan_{uuid.uuid4().hex[:16]}"
         h = lib.chan_create(name.encode(), capacity, num_readers)
         if not h:
@@ -78,6 +87,15 @@ class Channel:
         ch = cls(name, capacity, num_readers, _creator=True)
         ch._h = h
         return ch
+
+    def for_reader(self, slot: int) -> "Channel":
+        """A handle for reader endpoint *slot* (0 <= slot < num_readers)."""
+        if not 0 <= slot < max(self.num_readers, 1):
+            raise ValueError(
+                f"reader slot {slot} out of range for "
+                f"{self.num_readers}-reader channel {self.name}")
+        return Channel(self.name, self.capacity, self.num_readers,
+                       reader_slot=slot)
 
     def _handle(self):
         if self._h is None:
@@ -117,8 +135,8 @@ class Channel:
                 self.capacity)
         out_len = ctypes.c_uint64()
         out_ver = ctypes.c_uint64()
-        rc = lib.chan_read(self._handle(), self._version, buf,
-                           self.capacity, ctypes.byref(out_len),
+        rc = lib.chan_read(self._handle(), self.reader_slot, self._version,
+                           buf, self.capacity, ctypes.byref(out_len),
                            ctypes.byref(out_ver), timeout)
         if rc == -32:
             raise ChannelClosedError(self.name)
@@ -128,8 +146,12 @@ class Channel:
         if rc != 0:
             raise RuntimeError(f"chan_read rc={rc}")
         self._version = out_ver.value
-        return SerializedObject.from_flat(
-            memoryview(buf)[: out_len.value]).deserialize()
+        # Copy the payload out of the reused read buffer before
+        # deserializing: zero-copy views into `buf` would be silently
+        # overwritten by the next read on this channel, corrupting any
+        # numpy arrays still held by the caller.
+        payload = bytes(memoryview(buf)[: out_len.value])
+        return SerializedObject.from_flat(payload).deserialize()
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:
@@ -152,7 +174,8 @@ class Channel:
 
     # -- pickling: handle travels, mapping re-attaches ----------------------
     def __reduce__(self):
-        return (Channel, (self.name, self.capacity, self.num_readers))
+        return (Channel, (self.name, self.capacity, self.num_readers,
+                          self.reader_slot))
 
     def __repr__(self):
         return (f"Channel({self.name}, cap={self.capacity}, "

@@ -102,9 +102,10 @@ class Router:
         return a if na <= nb else b
 
     def _launch(self, meta: RequestMetadata, args, kwargs):
-        target = self._pick()
-        rid = target.replica_id
-        self.inflight[rid] = self.inflight.get(rid, 0) + 1
+        with self._lock:
+            target = self._pick()
+            rid = target.replica_id
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
         ref = target.actor_handle.handle_request.remote(
             meta.__dict__, *args, **kwargs)
 
@@ -115,7 +116,7 @@ class Router:
         try:
             ref.future().add_done_callback(_done)
         except Exception:
-            self.inflight[rid] = max(self.inflight.get(rid, 1) - 1, 0)
+            _done(None)
         return ref
 
     def assign_sync(self, meta, args, kwargs):

@@ -120,8 +120,13 @@ class Histogram(Metric):
 
 # ----------------------------------------------------------------- export
 
+def _esc_label(v: str) -> str:
+    # Prometheus text exposition: escape backslash, double-quote, newline.
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_tags(tags: Dict[str, str], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in tags.items()]
+    parts = [f'{k}="{_esc_label(v)}"' for k, v in tags.items()]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

@@ -70,6 +70,28 @@ struct Handle {
   char name[256];
 };
 
+BlockHeader* block_at(Handle* h, uint64_t off);
+void recover_sweep(Handle* h);
+
+class Locker {
+ public:
+  explicit Locker(Handle* h) : h_(h->header) {
+    int rc = pthread_mutex_lock(&h_->lock);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock — it may have died mid-mutation
+      // (e.g. between heap_alloc and the slot fill, or between heap_free
+      // and the slot-state update). Sweep the table/heap back to a
+      // consistent state, then mark the mutex consistent.
+      recover_sweep(h);
+      pthread_mutex_consistent(&h_->lock);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&h_->lock); }
+
+ private:
+  Header* h_;
+};
+
 uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) / a * a; }
 
 uint64_t hash_id(const char* id) {
@@ -81,23 +103,6 @@ uint64_t hash_id(const char* id) {
   }
   return h;
 }
-
-class Locker {
- public:
-  explicit Locker(Header* h) : h_(h) {
-    int rc = pthread_mutex_lock(&h_->lock);
-    if (rc == EOWNERDEAD) {
-      // a process died holding the lock; state is still consistent for
-      // our operations (every mutation below is lock-protected and
-      // individually atomic enough to survive), recover the mutex
-      pthread_mutex_consistent(&h_->lock);
-    }
-  }
-  ~Locker() { pthread_mutex_unlock(&h_->lock); }
-
- private:
-  Header* h_;
-};
 
 Slot* find_slot(Handle* h, const char* id, bool for_insert) {
   uint64_t cap = h->header->table_capacity;
@@ -118,6 +123,56 @@ BlockHeader* block_at(Handle* h, uint64_t off) {
       static_cast<char*>(h->base) + off);
 }
 
+// Restore table/heap invariants after a process died holding the lock.
+// Three partial-mutation windows are repaired: (1) a kUsed slot whose
+// block was already freed (death between heap_free and the slot-state
+// write) -> tombstone the slot; (2) an allocated block no kUsed slot
+// references (death between heap_alloc and the slot fill) -> free the
+// block; (3) recompute bytes_allocated / num_objects from scratch.
+void recover_sweep(Handle* h) {
+  Header* hd = h->header;
+  uint64_t cap = hd->table_capacity;
+  uint64_t heap_end = hd->heap_offset + hd->heap_size;
+
+  for (uint64_t i = 0; i < cap; i++) {
+    Slot* s = &h->table[i];
+    if (s->state != kUsed) continue;
+    if (s->offset < hd->heap_offset + sizeof(BlockHeader) ||
+        s->offset >= heap_end) {
+      s->state = kTombstone;
+      continue;
+    }
+    if (block_at(h, s->offset - sizeof(BlockHeader))->free)
+      s->state = kTombstone;
+  }
+
+  uint64_t off = hd->heap_offset;
+  uint64_t allocated = 0;
+  while (off < heap_end) {
+    BlockHeader* b = block_at(h, off);
+    if (b->size < sizeof(BlockHeader) || b->size % kAlign != 0 ||
+        off + b->size > heap_end)
+      break;  // chain corrupted beyond repair; leave the tail alone
+    if (!b->free) {
+      uint64_t data = off + sizeof(BlockHeader);
+      bool referenced = false;
+      for (uint64_t i = 0; i < cap && !referenced; i++) {
+        Slot* s = &h->table[i];
+        if (s->state == kUsed && s->offset == data) referenced = true;
+      }
+      if (referenced) allocated += b->size;
+      else b->free = 1;
+    }
+    off += b->size;
+  }
+  hd->bytes_allocated = allocated;
+
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < cap; i++)
+    if (h->table[i].state == kUsed) n++;
+  hd->num_objects = n;
+}
+
 // First-fit scan with inline coalescing of adjacent free blocks.
 int64_t heap_alloc(Handle* h, uint64_t need) {
   Header* hd = h->header;
@@ -136,10 +191,14 @@ int64_t heap_alloc(Handle* h, uint64_t need) {
       if (b->size >= total) {
         uint64_t remainder = b->size - total;
         if (remainder >= kAlign + sizeof(BlockHeader)) {
-          b->size = total;
+          // write the remainder header BEFORE shrinking this block: a
+          // death between the two writes must leave a walkable chain
+          // (recover_sweep trusts block headers), never an uninitialized
+          // header at off+total
           BlockHeader* rest = block_at(h, off + total);
           rest->size = remainder;
           rest->free = 1;
+          b->size = total;
         }
         b->free = 0;
         hd->bytes_allocated += b->size;
@@ -241,7 +300,7 @@ void* arena_attach(const char* name) {
 // duplicate id / table full).
 int64_t arena_alloc(void* handle, const char* id, uint64_t size) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   Slot* existing = find_slot(h, id, false);
   if (existing) return -1;
   Slot* s = find_slot(h, id, true);
@@ -249,20 +308,20 @@ int64_t arena_alloc(void* handle, const char* id, uint64_t size) {
   int64_t off = heap_alloc(h, size);
   if (off < 0) return -1;
   memcpy(s->id, id, kIdLen);
-  s->state = kUsed;
   s->sealed = 0;
   s->pending_delete = 0;
   s->offset = static_cast<uint64_t>(off);
   s->size = size;
   s->refcount = 0;
   s->lru_tick = ++h->header->lru_clock;
+  s->state = kUsed;  // last: recover_sweep keys referencedness on kUsed
   h->header->num_objects++;
   return off;
 }
 
 int arena_seal(void* handle, const char* id) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   Slot* s = find_slot(h, id, false);
   if (!s) return -1;
   s->sealed = 1;
@@ -274,7 +333,7 @@ int arena_seal(void* handle, const char* id) {
 int arena_get(void* handle, const char* id, uint64_t* offset,
               uint64_t* size) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   Slot* s = find_slot(h, id, false);
   if (!s || !s->sealed || s->pending_delete) return -1;
   s->refcount++;
@@ -286,14 +345,16 @@ int arena_get(void* handle, const char* id, uint64_t* offset,
 
 int arena_release(void* handle, const char* id) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   Slot* s = find_slot(h, id, false);
   if (!s) return -1;
   if (s->refcount > 0) s->refcount--;
   if (s->refcount == 0 && s->pending_delete) {
     // deferred delete: last pinned reader gone, reclaim now
-    heap_free(h, s->offset);
+    // (tombstone first so a death mid-sequence leaves an unreferenced
+    // allocated block, which recover_sweep reclaims)
     s->state = kTombstone;
+    heap_free(h, s->offset);
     h->header->num_objects--;
   }
   return 0;
@@ -304,15 +365,15 @@ int arena_release(void* handle, const char* id) {
 // a pinned reader would let the next allocation overwrite live data.
 int arena_delete(void* handle, const char* id) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   Slot* s = find_slot(h, id, false);
   if (!s) return -1;
   if (s->refcount > 0) {
     s->pending_delete = 1;   // invisible to new gets; freed on release
     return 0;
   }
-  heap_free(h, s->offset);
   s->state = kTombstone;
+  heap_free(h, s->offset);
   h->header->num_objects--;
   return 0;
 }
@@ -323,7 +384,7 @@ int arena_delete(void* handle, const char* id) {
 uint64_t arena_evict(void* handle, uint64_t needed, char* out_ids,
                      uint64_t max_ids, uint64_t* num_evicted) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   uint64_t reclaimed = 0, count = 0;
   while (reclaimed < needed) {
     Slot* victim = nullptr;
@@ -339,8 +400,8 @@ uint64_t arena_evict(void* handle, uint64_t needed, char* out_ids,
       memcpy(out_ids + count * kIdLen, victim->id, kIdLen);
     count++;
     reclaimed += victim->size;
-    heap_free(h, victim->offset);
     victim->state = kTombstone;
+    heap_free(h, victim->offset);
     h->header->num_objects--;
   }
   if (num_evicted) *num_evicted = count;
@@ -349,7 +410,7 @@ uint64_t arena_evict(void* handle, uint64_t needed, char* out_ids,
 
 int arena_contains(void* handle, const char* id) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   Slot* s = find_slot(h, id, false);
   return (s && s->sealed) ? 1 : 0;
 }
@@ -357,7 +418,7 @@ int arena_contains(void* handle, const char* id) {
 void arena_stats(void* handle, uint64_t* allocated, uint64_t* capacity,
                  uint64_t* num_objects) {
   Handle* h = static_cast<Handle*>(handle);
-  Locker lock(h->header);
+  Locker lock(h);
   *allocated = h->header->bytes_allocated;
   *capacity = h->header->heap_size;
   *num_objects = h->header->num_objects;

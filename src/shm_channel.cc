@@ -31,13 +31,19 @@ struct ChanHeader {
   uint64_t capacity;        // max message bytes
   uint64_t msg_len;         // current message length
   uint64_t version;         // 0 = nothing written yet
-  uint64_t num_readers;     // registered readers
-  uint64_t acks;            // readers that consumed current version
+  uint64_t num_readers;     // registered readers (<= 64)
+  uint64_t ack_mask;        // bit i set = reader slot i consumed current
+                            // version. Per-slot bits make acks idempotent:
+                            // a reader that re-attaches after a crash (or
+                            // re-reads the current version) can't double-ack
+                            // and let the writer overwrite early.
   uint32_t closed;
   pthread_mutex_t lock;
   pthread_cond_t can_write;
   pthread_cond_t can_read;
 };
+
+int popcount64(uint64_t x) { return __builtin_popcountll(x); }
 
 struct ChanHandle {
   void* base;
@@ -73,6 +79,7 @@ extern "C" {
 
 void* chan_create(const char* name, uint64_t capacity,
                   uint64_t num_readers) {
+  if (num_readers > 64) return nullptr;  // slots live in one ack bitmask
   uint64_t total = sizeof(ChanHeader) + capacity;
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
@@ -147,7 +154,9 @@ int chan_write(void* handle, const char* buf, uint64_t len,
   abs_deadline(&ts, timeout_s);
   if (lock_robust(h) != 0) return -EINVAL;
   int rc = 0;
-  while (h->version > 0 && h->acks < h->num_readers && !h->closed) {
+  while (h->version > 0 &&
+         popcount64(h->ack_mask) < static_cast<int>(h->num_readers) &&
+         !h->closed) {
     int w = pthread_cond_timedwait(&h->can_write, &h->lock, &ts);
     if (w == EOWNERDEAD) {
       // a peer died holding the lock; recover and re-evaluate
@@ -161,7 +170,7 @@ int chan_write(void* handle, const char* buf, uint64_t len,
     memcpy(hd->data, buf, len);
     h->msg_len = len;
     h->version++;
-    h->acks = 0;
+    h->ack_mask = 0;
     pthread_cond_broadcast(&h->can_read);
   }
   pthread_mutex_unlock(&h->lock);
@@ -169,11 +178,12 @@ int chan_write(void* handle, const char* buf, uint64_t len,
 }
 
 // Read the next message after `last_version`. On success copies up to
-// max_len bytes into out, stores the message length + new version, acks,
-// and returns 0. -ETIMEDOUT / -EPIPE (closed and nothing newer).
-int chan_read(void* handle, uint64_t last_version, char* out,
-              uint64_t max_len, uint64_t* out_len, uint64_t* out_version,
-              double timeout_s) {
+// max_len bytes into out, stores the message length + new version, acks
+// reader slot `reader_slot` (idempotently, via the ack bitmask), and
+// returns 0. -ETIMEDOUT / -EPIPE (closed and nothing newer).
+int chan_read(void* handle, uint64_t reader_slot, uint64_t last_version,
+              char* out, uint64_t max_len, uint64_t* out_len,
+              uint64_t* out_version, double timeout_s) {
   auto* hd = static_cast<ChanHandle*>(handle);
   ChanHeader* h = hd->h;
   timespec ts;
@@ -194,8 +204,9 @@ int chan_read(void* handle, uint64_t last_version, char* out,
     memcpy(out, hd->data, n);
     *out_len = h->msg_len;
     *out_version = h->version;
-    h->acks++;
-    if (h->acks >= h->num_readers) pthread_cond_broadcast(&h->can_write);
+    if (reader_slot < 64) h->ack_mask |= (1ULL << reader_slot);
+    if (popcount64(h->ack_mask) >= static_cast<int>(h->num_readers))
+      pthread_cond_broadcast(&h->can_write);
   }
   pthread_mutex_unlock(&h->lock);
   return rc;

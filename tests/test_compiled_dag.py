@@ -243,3 +243,44 @@ def test_same_actor_chain_uses_local_value(dag_actors):
         assert cd.execute(4).get() == 18
     finally:
         cd.teardown()
+
+
+def test_channel_read_does_not_corrupt_previous_value():
+    # Regression: read() used to hand out zero-copy views into a reused
+    # read buffer, so the next read silently overwrote arrays returned
+    # by the previous one.
+    import numpy as np
+
+    ch = Channel.create(num_readers=1, capacity=1 << 20)
+    try:
+        reader = Channel(ch.name, ch.capacity, 1)
+        ch.write(np.full(1000, 1, np.int64))
+        first = reader.read(timeout=5)
+        assert first.sum() == 1000
+        ch.write(np.full(1000, 7, np.int64))
+        second = reader.read(timeout=5)
+        assert second.sum() == 7000
+        assert first.sum() == 1000, "first read mutated by second read"
+    finally:
+        ch.destroy()
+
+
+def test_channel_per_reader_slots_no_double_ack():
+    # Two readers with distinct slots: one reader re-reading (simulating
+    # a re-attach after crash, cursor reset) must NOT double-ack and let
+    # the writer overwrite before the second reader consumed.
+    ch = Channel.create(num_readers=2, capacity=1 << 16)
+    try:
+        r0 = ch.for_reader(0)
+        r1 = ch.for_reader(1)
+        ch.write("v1")
+        assert r0.read(timeout=5) == "v1"
+        r0_again = ch.for_reader(0)        # fresh attach, cursor reset
+        assert r0_again.read(timeout=5) == "v1"
+        # both acks came from slot 0 -> writer must still be blocked
+        with pytest.raises(TimeoutError):
+            ch.write("v2", timeout=0.3)
+        assert r1.read(timeout=5) == "v1"  # second slot acks
+        ch.write("v2", timeout=5)          # now unblocked
+    finally:
+        ch.destroy()
