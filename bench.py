@@ -42,18 +42,20 @@ def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     if on_tpu:
+        # ~915M params: large enough to fill the chip's MXU (head_dim 128,
+        # 2048-wide matmuls) while params + adam state fit a 16 GiB HBM.
         cfg = llama.config(
-            "tiny", vocab_size=32768, hidden=1024, n_layers=16, n_heads=16,
-            n_kv_heads=8, head_dim=64, ffn=4096, max_seq=2048,
-            attention_impl="pallas")
-        batch, seq, iters = 8, 2048, 10
+            "tiny", vocab_size=32768, hidden=2048, n_layers=12, n_heads=16,
+            n_kv_heads=8, head_dim=128, ffn=8192, max_seq=2048,
+            attention_impl="pallas", remat_policy="nothing")
+        batch, seq, iters = 4, 2048, 10
     else:
         cfg = llama.config("debug")
         batch, seq, iters = 4, 256, 3
 
     mesh = MeshSpec(dp=1, fsdp=1, sp=1, tp=1).build([dev])
     bundle = TrainStepBundle(
-        cfg, mesh, optimizer=default_optimizer(total_steps=1000))
+        cfg, mesh, optimizer=default_optimizer(total_steps=1000, mu_dtype=jnp.bfloat16))
     state = bundle.init_state(0)
     rng = np.random.default_rng(0)
     tokens = bundle.shard_batch(jnp.asarray(

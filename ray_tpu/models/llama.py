@@ -42,6 +42,13 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32     # storage dtype
     attention_impl: str = "auto"       # auto | xla | pallas | ring
     remat: bool = True
+    # "dots_no_batch" saves matmul outputs (fastest when HBM allows);
+    # "nothing" fully rematerializes each layer in backward (~1B params on
+    # a 16 GiB chip needs this).
+    remat_policy: str = "dots_no_batch"
+    # Cross-entropy sequence chunk: bounds logits to (B, chunk, vocab) per
+    # step instead of materializing (B, S, vocab). 0 = unchunked.
+    loss_chunk: int = 512
 
     @property
     def q_dim(self) -> int:
@@ -201,9 +208,17 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
     return x
 
 
-def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
-            mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens: (B, S) int32 -> logits (B, S, vocab) float32."""
+_REMAT_POLICIES = {
+    "dots_no_batch":
+        lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing": lambda: None,
+}
+
+
+def hidden_states(cfg: LlamaConfig, params: Dict[str, Any],
+                  tokens: jax.Array,
+                  mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens: (B, S) int32 -> final-norm hidden states (B, S, hidden)."""
     b, s = tokens.shape
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]
@@ -215,29 +230,75 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
         decoder_layer(cfg, x, layer, cos, sin, mesh), None)
     if cfg.remat:
         layer_fn = jax.checkpoint(
-            layer_fn,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            layer_fn, policy=_REMAT_POLICIES[cfg.remat_policy]())
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def _head_logits(cfg: LlamaConfig, x: jax.Array, lm_head: jax.Array):
+    # bf16 operands + f32 accumulation: full-f32 operands would run the
+    # largest matmul in the model off the MXU fast path
+    logits = jnp.einsum("bsh,hv->bsv", x.astype(cfg.dtype),
+                        lm_head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
     return wlc(logits, "batch", "seq", "vocab")
+
+
+def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens: (B, S) int32 -> logits (B, S, vocab) float32."""
+    x = hidden_states(cfg, params, tokens, mesh)
+    return _head_logits(cfg, x, params["lm_head"])
 
 
 def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             mesh: Optional[Mesh] = None,
             mask: Optional[jax.Array] = None) -> Tuple[jax.Array, Dict]:
-    """Next-token cross entropy. tokens: (B, S); mask: (B, S) or None."""
-    logits = forward(cfg, params, tokens, mesh)           # (B, S, V) f32
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    """Next-token cross entropy. tokens: (B, S); mask: (B, S) or None.
+
+    The head matmul + softmax run in sequence chunks (cfg.loss_chunk) under
+    remat, so the (B, S, vocab) logits tensor never materializes — at Llama
+    vocab sizes it would dwarf every other activation.
+    """
+    b, s = tokens.shape
+    x = hidden_states(cfg, params, tokens, mesh)          # (B, S, h)
+    # shift: position i predicts token i+1; last position is masked out.
+    # The weight for position i is the TARGET's mask (mask[i+1]), so
+    # predictions of padding tokens never contribute.
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
     if mask is not None:
-        m = mask[:, 1:].astype(jnp.float32)
+        m = jnp.concatenate(
+            [mask[:, 1:].astype(jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
     else:
-        m = jnp.ones_like(nll)
+        m = jnp.ones((b, s), jnp.float32).at[:, -1].set(0.0)
+
+    chunk = cfg.loss_chunk
+    if chunk and s % chunk == 0 and s > chunk:
+        n = s // chunk
+
+        def chunk_nll(x_c, t_c):
+            logits = _head_logits(cfg, x_c, params["lm_head"])
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+            return lse - tgt                               # (B, chunk)
+
+        chunk_nll = jax.checkpoint(chunk_nll)              # drop chunk logits
+
+        def body(_, xc_tc):
+            return None, chunk_nll(*xc_tc)
+
+        xs = x.reshape(b, n, chunk, -1).transpose(1, 0, 2, 3)
+        ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+        _, nll = jax.lax.scan(body, None, (xs, ts))
+        nll = nll.transpose(1, 0, 2).reshape(b, s)
+    else:
+        logits = _head_logits(cfg, x, params["lm_head"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
     total = jnp.sum(nll * m)
     count = jnp.maximum(jnp.sum(m), 1.0)
     loss = total / count
