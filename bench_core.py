@@ -1,0 +1,166 @@
+"""Core runtime microbenchmarks (reference: python/ray/_private/ray_perf.py).
+
+Measures the task/actor hot paths against the reference's published
+numbers (BASELINE.md, single m5.16xlarge 64-vCPU). This box is a
+single-core VM, so absolute parity is not expected; per-core parity is
+the target. Prints one JSON line per metric plus a summary line.
+
+Usage: python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Sink:
+    def noop(self):
+        return None
+
+    def echo(self, x):
+        return x
+
+
+@ray_tpu.remote
+class AsyncSink:
+    async def noop(self):
+        return None
+
+
+@ray_tpu.remote
+def noop_task():
+    return None
+
+
+def rate(n, t):
+    return round(n / t, 1)
+
+
+def timed(fn, *args):
+    t0 = time.perf_counter()
+    fn(*args)
+    return time.perf_counter() - t0
+
+
+def bench_sync_actor_calls(actor, n):
+    def run():
+        for _ in range(n):
+            ray_tpu.get(actor.noop.remote())
+    return rate(n, timed(run))
+
+
+def bench_async_actor_calls(actor, n, window=1000):
+    def run():
+        done = 0
+        while done < n:
+            batch = min(window, n - done)
+            ray_tpu.get([actor.noop.remote() for _ in range(batch)])
+            done += batch
+    return rate(n, timed(run))
+
+
+def bench_1n_actor_calls(actors, n):
+    def run():
+        refs = []
+        for i in range(n):
+            refs.append(actors[i % len(actors)].noop.remote())
+        ray_tpu.get(refs)
+    return rate(n, timed(run))
+
+
+def bench_nn_actor_calls(actors, n, n_threads=4):
+    """n caller threads each driving all actors (the reference's n:n is
+    n drivers x n actors; threads stand in for extra driver cores)."""
+    per = n // n_threads
+
+    def worker(i):
+        refs = [actors[j % len(actors)].noop.remote() for j in range(per)]
+        ray_tpu.get(refs)
+
+    def run():
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    return rate(per * n_threads, timed(run))
+
+
+def bench_tasks(n, window=500):
+    def run():
+        done = 0
+        while done < n:
+            batch = min(window, n - done)
+            ray_tpu.get([noop_task.remote() for _ in range(batch)])
+            done += batch
+    return rate(n, timed(run))
+
+
+def bench_put_get(n, payload):
+    def run():
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(payload))
+    return rate(n, timed(run))
+
+
+def main():
+    quick = "--quick" in sys.argv
+    scale = 1 if quick else 5
+    ray_tpu.init(num_cpus=8)
+    results = {}
+
+    sink = Sink.remote()
+    asink = AsyncSink.options(max_concurrency=16).remote()
+    csink = Sink.options(max_concurrency=4).remote()
+    actors = [Sink.remote() for _ in range(4)]
+    ray_tpu.get(sink.noop.remote())
+    ray_tpu.get(asink.noop.remote())
+    ray_tpu.get(csink.noop.remote())
+    ray_tpu.get([a.noop.remote() for a in actors])
+
+    results["1_1_actor_calls_sync"] = bench_sync_actor_calls(sink, 200 * scale)
+    results["1_1_actor_calls_async"] = bench_async_actor_calls(
+        sink, 1000 * scale)
+    results["1_1_actor_calls_concurrent"] = bench_async_actor_calls(
+        csink, 1000 * scale)
+    results["1_1_async_actor_calls_sync"] = bench_sync_actor_calls(
+        asink, 200 * scale)
+    results["1_1_async_actor_calls_async"] = bench_async_actor_calls(
+        asink, 1000 * scale)
+    results["1_n_actor_calls_async"] = bench_1n_actor_calls(
+        actors, 1000 * scale)
+    results["n_n_actor_calls_async"] = bench_nn_actor_calls(
+        actors, 1000 * scale)
+    results["tasks_per_second"] = bench_tasks(500 * scale)
+    results["put_get_small_per_second"] = bench_put_get(
+        200 * scale, b"x" * 100)
+
+    for k, v in results.items():
+        print(json.dumps({"metric": k, "value": v, "unit": "calls/s"}))
+
+    baseline = {  # BASELINE.md, m5.16xlarge (64 vCPU)
+        "1_1_actor_calls_sync": 1959,
+        "1_1_actor_calls_async": 8174,
+        "1_1_actor_calls_concurrent": 5131,
+        "1_1_async_actor_calls_sync": 1426,
+        "1_1_async_actor_calls_async": 4284,
+        "1_n_actor_calls_async": 8061,
+        "n_n_actor_calls_async": 27210,
+        "tasks_per_second": 368,
+    }
+    summary = {k: {"ours": results[k], "ref": baseline[k],
+                   "ratio": round(results[k] / baseline[k], 3)}
+               for k in baseline}
+    print(json.dumps({"metric": "core_summary", "detail": summary}))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

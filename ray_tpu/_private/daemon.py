@@ -19,6 +19,7 @@ import os
 import subprocess
 import sys
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from .ids import NodeID, WorkerID
@@ -64,6 +65,15 @@ class NodeDaemon:
         self.object_store = NodeObjectStore(session_name)
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle: List[str] = []
+        # Tasks waiting for a worker take WHICHEVER worker frees first
+        # (released or freshly registered) — never block on one specific
+        # spawn: a worker boot costs seconds (interpreter + jax import)
+        # while a release is sub-millisecond. Spawns are capped so a burst
+        # can't fork-bomb a small host (reference parity: worker_pool.h:224
+        # maximum_startup_concurrency).
+        self._worker_waiters: "deque[asyncio.Future]" = deque()
+        self._spawning = 0
+        self._max_concurrent_spawns = max(2, (os.cpu_count() or 1) // 2)
         self._register_events: Dict[str, asyncio.Event] = {}
         self._monitor_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -88,6 +98,12 @@ class NodeDaemon:
 
     async def stop(self):
         self._closed = True
+        while self._worker_waiters:
+            fut = self._worker_waiters.popleft()
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("node daemon shut down while task waited "
+                                 "for a worker"))
         if self._monitor_task:
             self._monitor_task.cancel()
         for w in self.workers.values():
@@ -162,25 +178,67 @@ class NodeDaemon:
         return {"status": "ok"}
 
     async def _acquire_worker(self) -> WorkerHandle:
-        while self.idle:
-            worker_id = self.idle.pop()
-            handle = self.workers.get(worker_id)
-            if handle is not None and handle.state == "idle":
+        while True:
+            while self.idle:
+                worker_id = self.idle.pop()
+                handle = self.workers.get(worker_id)
+                if handle is not None and handle.state == "idle":
+                    return handle
+            fut = asyncio.get_running_loop().create_future()
+            self._worker_waiters.append(fut)
+            self._maybe_spawn()
+            handle = await fut
+            if handle.state == "idle":
                 return handle
-        return await self._spawn_worker()
+            # handed a worker that died in the window; go around again
+
+    def _maybe_spawn(self) -> None:
+        if self._closed:
+            return
+        deficit = len(self._worker_waiters) - self._spawning
+        room = self._max_concurrent_spawns - self._spawning
+        for _ in range(max(0, min(deficit, room))):
+            self._spawning += 1
+            asyncio.ensure_future(self._spawn_into_pool())
+
+    async def _spawn_into_pool(self) -> None:
+        try:
+            handle = await self._spawn_worker()
+            self._offer_worker(handle)
+        except Exception as e:
+            # surface the failure on one waiter instead of hanging it
+            while self._worker_waiters:
+                fut = self._worker_waiters.popleft()
+                if not fut.done():
+                    fut.set_exception(e)
+                    break
+        finally:
+            self._spawning -= 1
+            # waiters taken by actors never release a worker; keep
+            # spawning while a deficit remains
+            self._maybe_spawn()
+
+    def _offer_worker(self, handle: WorkerHandle) -> None:
+        """Hand an idle worker to the longest-waiting task, else pool it."""
+        while self._worker_waiters:
+            fut = self._worker_waiters.popleft()
+            if not fut.done():
+                fut.set_result(handle)
+                return
+        self.idle.append(handle.worker_id)
 
     def _release_worker(self, handle: WorkerHandle) -> None:
         if handle.state == "busy":
             handle.state = "idle"
             handle.current_task = None
-            self.idle.append(handle.worker_id)
+            self._offer_worker(handle)
 
     async def rpc_prestart_workers(self, count: int) -> int:
         started = 0
         for _ in range(count):
             try:
                 h = await self._spawn_worker()
-                self.idle.append(h.worker_id)
+                self._offer_worker(h)
                 started += 1
             except Exception:
                 break

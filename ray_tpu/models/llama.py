@@ -275,8 +275,16 @@ def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     else:
         m = jnp.ones((b, s), jnp.float32).at[:, -1].set(0.0)
 
-    chunk = cfg.loss_chunk
-    if chunk and s % chunk == 0 and s > chunk:
+    # Largest divisor of s within the configured chunk bound, so chunking
+    # never silently disables on awkward sequence lengths (a full-vocab
+    # (B, S, V) logits tensor is an OOM cliff, not a fallback).
+    chunk = 0
+    if cfg.loss_chunk:
+        c = min(cfg.loss_chunk, s)
+        while c > 1 and s % c:
+            c -= 1
+        chunk = c
+    if chunk and s > chunk:
         n = s // chunk
 
         def chunk_nll(x_c, t_c):

@@ -173,3 +173,34 @@ def test_num_returns(ray_start):
 
     with pytest.raises(TaskError, match="num_returns=2"):
         ray_tpu.get(bad.remote()[0])
+
+
+def test_task_burst_after_actor_creation(ray_start):
+    """Regression: tasks queued behind actor-occupied workers must take
+    the next FREED worker, not each block on a fresh worker spawn."""
+    import time
+
+    ray_tpu = ray_start
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return "ok"
+
+    ray_tpu.get([noop.remote() for _ in range(4)])
+    holders = [Holder.remote() for _ in range(3)]
+    assert ray_tpu.get([h.ping.remote() for h in holders]) == ["ok"] * 3
+
+    # warm the regrown pool (one-time spawn cost), then measure
+    ray_tpu.get([noop.remote() for _ in range(30)])
+    t0 = time.monotonic()
+    assert ray_tpu.get([noop.remote() for _ in range(100)]) == [None] * 100
+    elapsed = time.monotonic() - t0
+    # pre-fix this took >10s (serial spawn per waiting task)
+    assert elapsed < 8.0, f"task burst took {elapsed:.1f}s"
+    for h in holders:
+        ray_tpu.kill(h)

@@ -73,3 +73,48 @@ def test_num_params_8b_close():
     cfg = llama.config("8b")
     n = cfg.num_params()
     assert 7.5e9 < n < 8.5e9, n
+
+
+def test_chunked_loss_matches_unchunked():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models import llama
+
+    cfg_c = llama.config("debug", loss_chunk=64)
+    cfg_u = llama.config("debug", loss_chunk=0)
+    params = llama.init_params(cfg_u, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg_u.vocab_size, (2, 256)),
+        jnp.int32)
+    mask = jnp.asarray(
+        np.random.default_rng(1).integers(0, 2, (2, 256)), jnp.int32)
+
+    for m in (None, mask):
+        (lc, _), gc = jax.value_and_grad(
+            lambda p: llama.loss_fn(cfg_c, p, tokens, mask=m),
+            has_aux=True)(params)
+        (lu, _), gu = jax.value_and_grad(
+            lambda p: llama.loss_fn(cfg_u, p, tokens, mask=m),
+            has_aux=True)(params)
+        assert jnp.allclose(lc, lu, atol=1e-5)
+        diffs = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), gc, gu)
+        assert max(jax.tree.leaves(diffs)) < 1e-3
+
+
+def test_chunked_loss_awkward_seq_length():
+    # seq 192 with loss_chunk 128 -> largest divisor 96 is used; must not
+    # silently fall back to full-vocab logits nor error
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models import llama
+
+    cfg = llama.config("debug", loss_chunk=128, max_seq=512)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 192)),
+        jnp.int32)
+    loss, metrics = llama.loss_fn(cfg, params, tokens)
+    assert bool(jnp.isfinite(loss))
