@@ -21,8 +21,10 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import logging
+import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import state
@@ -38,6 +40,10 @@ from ..exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                           TaskError, WorkerCrashedError)
 
 logger = logging.getLogger(__name__)
+
+# Cross-node object transfer: chunk size + number of chunks in flight.
+FETCH_CHUNK_BYTES = int(os.environ.get("RAY_TPU_FETCH_CHUNK", 32 << 20))
+FETCH_CHUNK_WINDOW = int(os.environ.get("RAY_TPU_FETCH_WINDOW", 4))
 
 
 class LoopRunner:
@@ -208,6 +214,19 @@ class CoreClient:
         self._actor_seq_lock = threading.Lock()
         self._actor_resolve_locks: Dict[str, asyncio.Lock] = {}
         self._shm_keepalive: Dict[str, Any] = {}
+        # Pull dedup: object_id -> future resolved when the pull lands.
+        self._inflight_pulls: Dict[str, asyncio.Future] = {}
+        # Lineage (reference parity: task_manager.h:278 ResubmitTask):
+        # return object_id -> producing task spec, kept after completion so
+        # a lost object can be recomputed. Bounded FIFO.
+        self._lineage: "OrderedDict[str, dict]" = OrderedDict()
+        self._lineage_cap = int(os.environ.get("RAY_TPU_LINEAGE_CAP", 10000))
+        # byte bound too: specs retain args/fn blobs (reference parity:
+        # RayConfig max_lineage_bytes)
+        self._lineage_max_bytes = int(os.environ.get(
+            "RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20))
+        self._lineage_bytes = 0
+        self._reconstructing: Dict[str, asyncio.Future] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -234,6 +253,12 @@ class CoreClient:
     def _daemon(self):
         return self.pool.get(self.node_addr)
 
+    def arena_room(self, nbytes: int) -> None:
+        """Ask our node daemon to spill until ~nbytes of arena space are
+        free. Callable from any non-loop thread (write_to_shm hook)."""
+        self.loop_runner.run_sync(self._daemon().call(
+            "ensure_arena_room", nbytes=nbytes), timeout=60)
+
     # ----------------------------------------------------------- server rpcs
 
     async def rpc_object_ready(self, object_id: str = None, payload=None,
@@ -258,6 +283,12 @@ class CoreClient:
                 await self._controller().call("submit_task", spec=pending.spec)
                 return
             for oid in (object_ids or [object_id]):
+                if pending is None and self.memory_store.contains(oid):
+                    # Late failure report for a task whose result already
+                    # arrived (e.g. a daemon shutting down cancels its
+                    # long-completed run_task RPC and reports a spurious
+                    # crash) — never clobber a completed object.
+                    continue
                 self.memory_store.put_error(oid, err)
             self._unpin_args(pending)
             return
@@ -266,6 +297,8 @@ class CoreClient:
         else:
             self.memory_store.put_serialized(
                 object_id, SerializedObject.from_flat(payload))
+        if pending is not None:
+            self._record_lineage(pending.spec)
         self._unpin_args(pending)
 
     def _unpin_args(self, pending: Optional[PendingTask]) -> None:
@@ -273,6 +306,85 @@ class CoreClient:
             return
         for arg_id in pending.arg_ids:
             self.ref_counter.unpin(arg_id)
+
+    # ------------------------------------------------------------- lineage
+
+    @staticmethod
+    def _spec_bytes(spec: dict) -> int:
+        return (len(spec.get("args_blob") or b"")
+                + len(spec.get("fn_blob") or b""))
+
+    def _record_lineage(self, spec: dict) -> None:
+        """Remember which task produced each return object, so a lost copy
+        can be recomputed (reference: task_manager.h:278 ResubmitTask)."""
+        if spec.get("is_actor_creation"):
+            return
+        for rid in spec.get("return_ids") or [spec["return_id"]]:
+            if rid not in self._lineage:
+                self._lineage_bytes += self._spec_bytes(spec)
+            self._lineage[rid] = spec
+            self._lineage.move_to_end(rid)
+        while self._lineage and (
+                len(self._lineage) > self._lineage_cap
+                or self._lineage_bytes > self._lineage_max_bytes):
+            _, old = self._lineage.popitem(last=False)
+            self._lineage_bytes -= self._spec_bytes(old)
+
+    async def _reconstruct_object(self, object_id: str) -> bool:
+        """Re-execute the producing task of a lost owned object. Returns
+        True when the object is available again. Concurrent calls for the
+        same producing task share one resubmission."""
+        spec = self._lineage.get(object_id)
+        if spec is None:
+            return False
+        task_id = spec["task_id"]
+        fut = self._reconstructing.get(task_id)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._reconstructing[task_id] = fut
+
+            async def _run():
+                try:
+                    for rid in spec.get("return_ids") or [spec["return_id"]]:
+                        self.memory_store.delete(rid)
+                    # A hard node pin is meaningless on reconstruction —
+                    # the pinned node is typically the one that died.
+                    resubmit = spec
+                    sched = spec.get("scheduling") or {}
+                    if sched.get("type") == "node_affinity" \
+                            and not sched.get("soft"):
+                        resubmit = dict(spec)
+                        resubmit["scheduling"] = dict(sched, soft=True)
+                    self._pending_tasks[task_id] = PendingTask(resubmit, 1, ())
+                    logger.warning(
+                        "reconstructing lost object %s by re-executing %s",
+                        object_id[:12], spec.get("name"))
+                    try:
+                        await self._controller().call(
+                            "submit_task", spec=resubmit)
+                    except Exception:
+                        self._pending_tasks.pop(task_id, None)
+                        raise
+                    fut.set_result(True)
+                except Exception as e:
+                    fut.set_exception(e)
+                finally:
+                    self._reconstructing.pop(task_id, None)
+
+            asyncio.ensure_future(_run())
+        try:
+            await fut
+        except Exception:
+            return False
+        return await self.memory_store.wait_available(object_id, 120.0)
+
+    async def rpc_reconstruct_object(self, object_id: str) -> dict:
+        """A borrower observed our object's copy is gone; recompute it."""
+        try:
+            ok = await self._reconstruct_object(object_id)
+        except Exception as e:
+            return {"status": "failed", "error": repr(e)}
+        return {"status": "ok" if ok else "unavailable"}
 
     async def rpc_get_object(self, object_id: str, timeout: Optional[float] = None):
         """Serve one of our owned objects to a borrower."""
@@ -314,7 +426,9 @@ class CoreClient:
         if serialized.total_size <= INLINE_OBJECT_LIMIT or self.node_addr is None:
             self.memory_store.put_value(object_id, value, serialized)
         else:
-            shm_name, size = write_to_shm(object_id, serialized, self.session_name)
+            shm_name, size = write_to_shm(
+                object_id, serialized, self.session_name,
+                arena_room=self.arena_room)
             location = ShmLocation(self.node_addr, shm_name, size)
             self.loop_runner.run_sync(self._daemon().call(
                 "register_object", object_id=object_id,
@@ -339,11 +453,34 @@ class CoreClient:
 
     async def aio_get(self, ref: ObjectRef, deadline: Optional[float] = None):
         object_id = ref.id
+        lost_attempts = 0
         while True:
             entry = self.memory_store.get_entry(object_id)
-            if entry is not None:
-                return await self._materialize(object_id, entry)
             is_owner = ref.owner_addr == self.address or ref.owner_addr is None
+            if entry is not None:
+                try:
+                    return await self._materialize(object_id, entry)
+                except ObjectLostError:
+                    # A copy existed but is gone (node death, spill race,
+                    # freed shm). Try lineage reconstruction, ours or the
+                    # owner's, then loop to re-fetch.
+                    lost_attempts += 1
+                    if lost_attempts > 2:
+                        raise
+                    self.memory_store.delete(object_id)
+                    if is_owner:
+                        if not await self._reconstruct_object(object_id):
+                            raise
+                    else:
+                        try:
+                            reply = await self.pool.get(ref.owner_addr).call(
+                                "reconstruct_object", object_id=object_id)
+                        except (ConnectionLost, OSError):
+                            raise ObjectLostError(
+                                f"owner of {object_id[:12]} is gone")
+                        if reply.get("status") != "ok":
+                            raise
+                    continue
             if is_owner:
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -370,11 +507,9 @@ class CoreClient:
             if status == "error":
                 raise reply["error"]
             if status == "location":
-                entry = self.memory_store.get_entry(object_id)
-                if entry is None:
+                if self.memory_store.get_entry(object_id) is None:
                     self.memory_store.put_location(object_id, reply["location"])
-                    entry = self.memory_store.get_entry(object_id)
-                return await self._materialize(object_id, entry)
+                continue  # loop: materialize via the reconstruction-guarded path
             serialized = SerializedObject.from_flat(reply["payload"])
             value = serialized.deserialize()
             self.memory_store.put_value(object_id, value)
@@ -391,21 +526,80 @@ class CoreClient:
             entry.has_value = True
             return value
         if entry.location is not None:
-            loc: ShmLocation = entry.location
-            if self._shm_is_local(loc):
+            # Dedup concurrent pulls of the same object: one puller, the
+            # rest await its result (reference parity: pull_manager.h).
+            fut = self._inflight_pulls.get(object_id)
+            if fut is not None:
+                await fut
+                return await self._materialize(
+                    object_id, self.memory_store.get_entry(object_id) or entry)
+            fut = asyncio.get_running_loop().create_future()
+            self._inflight_pulls[object_id] = fut
+            try:
+                value = await self._pull_location(object_id, entry)
+                entry.value = value
+                entry.has_value = True
+                fut.set_result(None)
+                return value
+            except BaseException as e:
+                fut.set_exception(e)
+                fut.exception()  # consumed; don't warn on GC
+                raise
+            finally:
+                self._inflight_pulls.pop(object_id, None)
+        raise ObjectLostError(f"object {object_id[:12]} has no data")
+
+    async def _pull_location(self, object_id: str, entry):
+        loc: ShmLocation = entry.location
+        if self._shm_is_local(loc):
+            try:
                 value, shm = await asyncio.get_running_loop().run_in_executor(
                     None, read_from_shm, loc.shm_name, loc.size)
                 entry.shm_keepalive = shm
-            else:
-                reply = await self.pool.get(loc.node_addr).call(
-                    "fetch_object", object_id=object_id)
+                return value
+            except FileNotFoundError:
+                # shm copy gone (e.g. spilled to disk); the owning daemon
+                # can still serve the bytes
+                pass
+        return await self._fetch_from_node(object_id, loc)
+
+    async def _fetch_from_node(self, object_id: str, loc: ShmLocation):
+        """Pull an object's bytes from its node daemon — chunked above the
+        threshold so a multi-GiB object is never one RPC frame (reference
+        parity: ObjectManager chunked push/pull, object_manager.h:208-216)."""
+        node = self.pool.get(loc.node_addr)
+        try:
+            if loc.size <= FETCH_CHUNK_BYTES:
+                reply = await node.call("fetch_object", object_id=object_id)
                 if reply is None:
-                    raise ObjectLostError(f"object {object_id[:12]} not on node")
-                value = SerializedObject.from_flat(reply).deserialize()
-            entry.value = value
-            entry.has_value = True
-            return value
-        raise ObjectLostError(f"object {object_id[:12]} has no data")
+                    raise ObjectLostError(
+                        f"object {object_id[:12]} not on node")
+                return SerializedObject.from_flat(reply).deserialize()
+            meta = await node.call("fetch_object_meta", object_id=object_id)
+            if meta is None:
+                raise ObjectLostError(f"object {object_id[:12]} not on node")
+            size = meta["size"]
+            buf = bytearray(size)
+            sem = asyncio.Semaphore(FETCH_CHUNK_WINDOW)
+
+            async def pull(offset: int):
+                async with sem:
+                    chunk = await node.call(
+                        "fetch_object_chunk", object_id=object_id,
+                        offset=offset,
+                        length=min(FETCH_CHUNK_BYTES, size - offset))
+                if chunk is None:
+                    raise ObjectLostError(
+                        f"object {object_id[:12]} vanished mid-transfer")
+                buf[offset:offset + len(chunk)] = chunk
+
+            await asyncio.gather(*[
+                pull(off) for off in range(0, size, FETCH_CHUNK_BYTES)])
+            # from_flat wraps a memoryview: no second multi-GiB copy
+            return SerializedObject.from_flat(buf).deserialize()
+        except (ConnectionLost, OSError):
+            raise ObjectLostError(
+                f"node holding object {object_id[:12]} is gone")
 
     def _shm_is_local(self, loc: ShmLocation) -> bool:
         # Single-machine sessions: every daemon's shm is attachable. Probe by

@@ -312,6 +312,8 @@ class NodeDaemon:
                 await self.pool.get(handle.addr).call(
                     "run_task", spec=spec)
             except Exception as e:
+                if self._closed:
+                    return  # our own shutdown cancelled the call
                 await self._report_failure(
                     spec, f"worker crashed while running task: {e!r}")
                 if handle.state != "dead":
@@ -348,7 +350,33 @@ class NodeDaemon:
         self.object_store.register(object_id, shm_name, size)
 
     async def rpc_fetch_object(self, object_id: str) -> Optional[bytes]:
-        return self.object_store.read_bytes(object_id)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.object_store.read_bytes, object_id)
+
+    async def rpc_fetch_object_meta(self, object_id: str) -> Optional[dict]:
+        size = self.object_store.size_of(object_id)
+        return None if size is None else {"size": size}
+
+    async def rpc_fetch_object_chunk(self, object_id: str, offset: int,
+                                     length: int) -> Optional[bytes]:
+        """One chunk of a large object (reference parity: chunked
+        ObjectManager::Push/Pull, src/ray/object_manager/object_manager.h
+        :208-216 + object_buffer_pool.h)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.object_store.read_range, object_id, offset, length)
+
+    async def rpc_ensure_arena_room(self, nbytes: int) -> int:
+        """Spill until ~nbytes of arena space are free. Returns bytes
+        spilled (0 = nothing to spill; caller falls back to a segment)."""
+        pressure = self.object_store.arena_pressure()
+        if pressure is None:
+            return 0
+        allocated, capacity = pressure
+        deficit = nbytes - max(0, capacity - allocated)
+        if deficit <= 0:
+            return 0
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.object_store.spill_until, deficit)
 
     async def rpc_free_object(self, object_id: str) -> None:
         self.object_store.free(object_id)
@@ -369,18 +397,33 @@ class NodeDaemon:
             "num_idle": len(self.idle),
             "object_store_objects": self.object_store.num_objects,
             "object_store_bytes": self.object_store.bytes_used,
+            "bytes_spilled": self.object_store.bytes_spilled,
+            "objects_spilled": self.object_store.objects_spilled,
         }
 
     # ------------------------------------------------------------- monitor
 
     async def _monitor_loop(self) -> None:
         controller = self.pool.get(self.controller_addr)
+        high = float(os.environ.get("RAY_TPU_ARENA_SPILL_HIGH", 0.85))
+        low = float(os.environ.get("RAY_TPU_ARENA_SPILL_LOW", 0.65))
         while not self._closed:
             await asyncio.sleep(0.5)
             try:
                 await controller.oneway("heartbeat", node_id=self.node_id)
             except Exception:
                 pass
+            # arena pressure: spill LRU sealed objects down to the low
+            # water mark so allocations keep landing in shared memory.
+            # Bounded per tick (256 MB) so a huge arena drain can't pause
+            # this loop's heartbeats past the controller's node timeout.
+            pressure = self.object_store.arena_pressure()
+            if pressure is not None:
+                allocated, capacity = pressure
+                if capacity > 0 and allocated / capacity > high:
+                    target = min(int(allocated - low * capacity), 256 << 20)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.object_store.spill_until, target)
             for handle in list(self.workers.values()):
                 if handle.state == "dead":
                     self.workers.pop(handle.worker_id, None)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Dict, Optional, Tuple
 
@@ -257,12 +258,24 @@ class ShmStoreEntry:
 
 
 class NodeObjectStore:
-    """Node-daemon-side registry of shm segments holding sealed objects."""
+    """Node-daemon-side registry of shm segments holding sealed objects.
 
-    def __init__(self, session_name: str):
+    Spilling (reference parity: src/ray/raylet/local_object_manager.h:113
+    SpillObjects + python/ray/_private/external_storage.py): under arena
+    pressure, sealed objects are copied to disk files and their arena/
+    segment copies freed; entries become "spill:<path>" and reads restore
+    from disk transparently.
+    """
+
+    def __init__(self, session_name: str, spill_dir: Optional[str] = None):
         self.session_name = session_name
         self._entries: Dict[str, ShmStoreEntry] = {}
         self._seq = 0
+        self.spill_dir = spill_dir or os.path.join(
+            "/tmp/ray_tpu", session_name, "spill")
+        self.bytes_spilled = 0
+        self.objects_spilled = 0
+        self._spill_lock = threading.Lock()
         # plasma-equivalent arena: first daemon on the machine creates
         # it; lifetime is session-wide (unlink_session_arena at driver
         # shutdown), NOT tied to this daemon
@@ -283,31 +296,115 @@ class NodeObjectStore:
     def get(self, object_id: str) -> Optional[ShmStoreEntry]:
         return self._entries.get(object_id)
 
+    def size_of(self, object_id: str) -> Optional[int]:
+        e = self._entries.get(object_id)
+        return e.size if e is not None and e.sealed else None
+
     def read_bytes(self, object_id: str) -> Optional[bytes]:
         """Copy an object's flat bytes out (for cross-node transfer)."""
-        entry = self._entries.get(object_id)
-        if entry is None or not entry.sealed:
-            return None
-        if entry.shm_name.startswith("arena:"):
-            _, arena_seg, oid = entry.shm_name.split(":", 2)
-            arena = attach_arena(arena_seg)
-            ref = arena.get(oid) if arena is not None else None
-            if ref is None:
-                return None
-            try:
-                return bytes(ref.buf[: entry.size])
-            finally:
-                ref.release()
-        if entry.shm is None:
-            entry.shm = attach_shm(entry.shm_name)
-        return bytes(entry.shm.buf[: entry.size])
+        return self.read_range(object_id, 0, None)
 
-    def free(self, object_id: str) -> None:
-        entry = self._entries.pop(object_id, None)
-        if entry is None:
-            return
-        if entry.shm_name.startswith("arena:"):
-            _, arena_seg, oid = entry.shm_name.split(":", 2)
+    def read_range(self, object_id: str, offset: int,
+                   length: Optional[int]) -> Optional[bytes]:
+        """Copy out `length` bytes at `offset` (None = to the end) without
+        materializing the rest — the chunked-transfer read primitive.
+
+        Retries once on a miss: a concurrent spill may move the bytes from
+        shm to disk between the prefix check and the read."""
+        for _ in range(2):
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed:
+                return None
+            end = entry.size if length is None else min(offset + length,
+                                                        entry.size)
+            shm_name = entry.shm_name
+            if shm_name.startswith("spill:"):
+                path = shm_name[len("spill:"):]
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        return f.read(end - offset)
+                except OSError:
+                    return None
+            if shm_name.startswith("arena:"):
+                _, arena_seg, oid = shm_name.split(":", 2)
+                arena = attach_arena(arena_seg)
+                ref = arena.get(oid) if arena is not None else None
+                if ref is None:
+                    continue  # raced with a spill; re-read the entry
+                try:
+                    return bytes(ref.buf[offset:end])
+                finally:
+                    ref.release()
+            try:
+                if entry.shm is None:
+                    entry.shm = attach_shm(shm_name)
+                return bytes(entry.shm.buf[offset:end])
+            except FileNotFoundError:
+                continue  # raced with a spill
+        return None
+
+    # ------------------------------------------------------------- spilling
+
+    def spill(self, object_id: str) -> bool:
+        """Copy one sealed object to disk and free its shm copy."""
+        with self._spill_lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed \
+                    or entry.shm_name.startswith("spill:"):
+                return False
+            data = self.read_bytes(object_id)
+            if data is None:
+                return False
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, object_id)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            old_name = entry.shm_name
+            # publish the new location BEFORE freeing the shm copy so a
+            # concurrent reader either sees the old (still-valid) copy or
+            # the spill file, never neither
+            entry.shm_name = f"spill:{path}"
+            self._free_shm_copy(old_name, entry)
+            self.bytes_spilled += entry.size
+            self.objects_spilled += 1
+            return True
+
+    def spill_until(self, bytes_needed: int,
+                    arena_only: bool = True) -> int:
+        """Spill oldest-registered objects until roughly `bytes_needed`
+        bytes of shm have been released. arena_only counts (and spills)
+        only arena-backed entries — the deficit callers compute is arena
+        space, which freeing per-object segments can't satisfy. Returns
+        bytes spilled (approximate LRU: registration order)."""
+        released = 0
+        for object_id in list(self._entries):
+            if released >= bytes_needed:
+                break
+            entry = self._entries.get(object_id)
+            if entry is None or entry.shm_name.startswith("spill:"):
+                continue
+            if arena_only and not entry.shm_name.startswith("arena:"):
+                continue
+            if self.spill(object_id):
+                released += entry.size
+        return released
+
+    def arena_pressure(self):
+        """(allocated, capacity) of the arena, or None without one."""
+        if self.arena is None:
+            return None
+        try:
+            st = self.arena.stats()
+            return st["bytes_allocated"], st["heap_capacity"]
+        except Exception:
+            return None
+
+    def _free_shm_copy(self, shm_name: str, entry: ShmStoreEntry) -> None:
+        if shm_name.startswith("arena:"):
+            _, arena_seg, oid = shm_name.split(":", 2)
             arena = attach_arena(arena_seg)
             if arena is not None:
                 arena.delete(oid)
@@ -317,7 +414,20 @@ class NodeObjectStore:
                 entry.shm.close()
             except Exception:
                 pass
-        _unlink_shm(entry.shm_name)
+            entry.shm = None
+        _unlink_shm(shm_name)
+
+    def free(self, object_id: str) -> None:
+        entry = self._entries.pop(object_id, None)
+        if entry is None:
+            return
+        if entry.shm_name.startswith("spill:"):
+            try:
+                os.unlink(entry.shm_name[len("spill:"):])
+            except OSError:
+                pass
+            return
+        self._free_shm_copy(entry.shm_name, entry)
 
     def free_all(self) -> None:
         for object_id in list(self._entries):
@@ -333,11 +443,14 @@ class NodeObjectStore:
 
 
 def write_to_shm(object_id: str, serialized: SerializedObject,
-                 session_name: str) -> Tuple[str, int]:
+                 session_name: str,
+                 arena_room=None) -> Tuple[str, int]:
     """Write `serialized` into shared memory for other processes.
 
     Preferred path: allocate+seal inside the native arena (one mmap per
-    process for ALL objects). Fallback (native lib missing or arena
+    process for ALL objects). On a full arena, `arena_room(nbytes)` (if
+    given) may free space by asking the daemon to spill — the allocation
+    is retried once after it. Final fallback (native lib missing or still
     full): one POSIX segment per object. Returns (shm_name, size) where
     an arena-backed name is "arena:<segment>:<object_id>". Caller must
     register it with the node daemon.
@@ -345,13 +458,14 @@ def write_to_shm(object_id: str, serialized: SerializedObject,
     size = serialized.flat_size()
     arena = attach_arena(arena_name_for(session_name))
     if arena is not None:
-        # Policy note: a full arena falls back to per-object segments
-        # rather than evicting (Arena.evict). Evictable-looking objects
-        # (sealed, unpinned) are still owned by live ObjectRefs, and this
-        # runtime has task retries but no object reconstruction — evicting
-        # would turn "arena full" into ObjectLostError later. Eviction is
-        # reserved for a spill-to-disk layer that can restore.
         buf = arena.create_buffer(object_id, size)
+        if buf is None and arena_room is not None:
+            try:
+                arena_room(size)
+            except Exception:
+                pass
+            else:
+                buf = arena.create_buffer(object_id, size)
         if buf is not None:
             try:
                 serialized.write_flat(buf)

@@ -123,9 +123,11 @@ class WorkerRuntime:
             await owner.oneway("object_ready", object_id=object_id,
                                payload=serialized.to_flat(), task_id=task_id)
         else:
-            shm_name, size = await asyncio.get_running_loop().run_in_executor(
-                None, write_to_shm, object_id, serialized,
-                self.client.session_name)
+            loop = asyncio.get_running_loop()
+            shm_name, size = await loop.run_in_executor(
+                None, lambda: write_to_shm(
+                    object_id, serialized, self.client.session_name,
+                    arena_room=self.client.arena_room))
             await self.client.pool.get(self.daemon_addr).call(
                 "register_object", object_id=object_id,
                 shm_name=shm_name, size=size)
@@ -288,8 +290,9 @@ class WorkerRuntime:
         # (by return_id) reaches the right segment.
         object_id = return_id or os.urandom(16).hex()
         shm_name, size = await loop.run_in_executor(
-            None, write_to_shm, object_id, serialized,
-            self.client.session_name)
+            None, lambda: write_to_shm(
+                object_id, serialized, self.client.session_name,
+                arena_room=self.client.arena_room))
         await self.client.pool.get(self.daemon_addr).call(
             "register_object", object_id=object_id, shm_name=shm_name,
             size=size)
