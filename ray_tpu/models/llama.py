@@ -21,7 +21,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops.attention import attention as attention_op
+from ..ops.moe import moe_ffn
 from ..ops.ring_attention import ring_attention_sharded
+from ..ops.ulysses import ulysses_attention
 from ..parallel.mesh import AXIS_SP
 from ..parallel.sharding import with_logical_constraint as wlc
 
@@ -40,7 +42,16 @@ class LlamaConfig:
     max_seq: int = 8192
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32     # storage dtype
-    attention_impl: str = "auto"       # auto | xla | pallas | ring
+    attention_impl: str = "auto"       # auto | xla | pallas | ring | ulysses
+    # MoE (Mixtral-style): n_experts=0 -> dense SwiGLU; >0 -> every layer's
+    # MLP is a top-k expert mixture (ops/moe.py), experts sharded over ep.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # Pipeline parallelism: microbatch count used when the mesh has pp>1
+    # (models/pipeline.py). Must divide the per-step batch.
+    pp_microbatches: int = 4
     remat: bool = True
     # "dots_no_batch" saves matmul outputs (fastest when HBM allows);
     # "nothing" fully rematerializes each layer in backward (~1B params on
@@ -59,9 +70,11 @@ class LlamaConfig:
         return self.n_kv_heads * self.head_dim
 
     def num_params(self) -> int:
+        ffn_mult = max(self.n_experts, 1)
         per_layer = (self.hidden * (self.q_dim + 2 * self.kv_dim)
                      + self.q_dim * self.hidden
-                     + 3 * self.hidden * self.ffn
+                     + 3 * self.hidden * self.ffn * ffn_mult
+                     + (self.hidden * self.n_experts if self.n_experts else 0)
                      + 2 * self.hidden)
         return (self.vocab_size * self.hidden * 2
                 + self.n_layers * per_layer + self.hidden)
@@ -73,6 +86,13 @@ PRESETS: Dict[str, LlamaConfig] = {
                          n_kv_heads=2, head_dim=32, ffn=256, max_seq=256),
     "tiny": LlamaConfig(vocab_size=2048, hidden=512, n_layers=4, n_heads=8,
                         n_kv_heads=4, head_dim=64, ffn=1536, max_seq=2048),
+    # Mixtral-shaped MoE variants for tests/dryruns.
+    "debug_moe": LlamaConfig(vocab_size=256, hidden=128, n_layers=2,
+                             n_heads=4, n_kv_heads=2, head_dim=32, ffn=256,
+                             max_seq=256, n_experts=4, moe_top_k=2),
+    "8x7b": LlamaConfig(vocab_size=32000, hidden=4096, n_layers=32,
+                        n_heads=32, n_kv_heads=8, head_dim=128, ffn=14336,
+                        n_experts=8, moe_top_k=2),
     "1b": LlamaConfig(vocab_size=128256, hidden=2048, n_layers=16,
                       n_heads=32, n_kv_heads=8, head_dim=64, ffn=8192),
     "3b": LlamaConfig(vocab_size=128256, hidden=3072, n_layers=28,
@@ -93,6 +113,19 @@ def config(name_or_cfg, **overrides) -> LlamaConfig:
 
 def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
     """Pytree of logical-axis tuples mirroring init_params' structure."""
+    if cfg.n_experts:
+        mlp_axes = {
+            "router": ("layers", "embed", None),
+            "wi": ("layers", "experts", "embed", "mlp"),
+            "wg": ("layers", "experts", "embed", "mlp"),
+            "wd": ("layers", "experts", "mlp", "embed"),
+        }
+    else:
+        mlp_axes = {
+            "wi": ("layers", "embed", "mlp"),
+            "wg": ("layers", "embed", "mlp"),
+            "wd": ("layers", "mlp", "embed"),
+        }
     return {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -100,9 +133,7 @@ def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
             "wk": ("layers", "embed", "kv_heads"),
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
-            "wi": ("layers", "embed", "mlp"),
-            "wg": ("layers", "embed", "mlp"),
-            "wd": ("layers", "mlp", "embed"),
+            **mlp_axes,
             "ln1": ("layers", None),
             "ln2": ("layers", None),
         },
@@ -121,6 +152,20 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
         return (jax.random.normal(k, shape, jnp.float32)
                 * (1.0 / math.sqrt(fan_in))).astype(pd)
 
+    if cfg.n_experts:
+        E = cfg.n_experts
+        mlp = {
+            "router": dense(keys[9], (L, h, E), h),
+            "wi": dense(keys[5], (L, E, h, cfg.ffn), h),
+            "wg": dense(keys[6], (L, E, h, cfg.ffn), h),
+            "wd": dense(keys[7], (L, E, cfg.ffn, h), cfg.ffn),
+        }
+    else:
+        mlp = {
+            "wi": dense(keys[5], (L, h, cfg.ffn), h),
+            "wg": dense(keys[6], (L, h, cfg.ffn), h),
+            "wd": dense(keys[7], (L, cfg.ffn, h), cfg.ffn),
+        }
     return {
         "embed": dense(keys[0], (cfg.vocab_size, h), h),
         "layers": {
@@ -128,9 +173,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
             "wk": dense(keys[2], (L, h, cfg.kv_dim), h),
             "wv": dense(keys[3], (L, h, cfg.kv_dim), h),
             "wo": dense(keys[4], (L, cfg.q_dim, h), cfg.q_dim),
-            "wi": dense(keys[5], (L, h, cfg.ffn), h),
-            "wg": dense(keys[6], (L, h, cfg.ffn), h),
-            "wd": dense(keys[7], (L, cfg.ffn, h), cfg.ffn),
+            **mlp,
             "ln1": jnp.ones((L, h), pd),
             "ln2": jnp.ones((L, h), pd),
         },
@@ -179,12 +222,15 @@ def _attend(cfg: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
         if mesh is None:
             raise ValueError("ring attention requires a mesh")
         return ring_attention_sharded(q, k, v, mesh, causal=True)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, causal=True)
     return attention_op(q, k, v, causal=True, impl=impl)
 
 
 def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
                   cos: jax.Array, sin: jax.Array,
-                  mesh: Optional[Mesh]) -> jax.Array:
+                  mesh: Optional[Mesh]) -> Tuple[jax.Array, jax.Array]:
+    """Returns (x, aux) — aux is the MoE load-balance loss (0 when dense)."""
     b, s, h = x.shape
     dt = cfg.dtype
 
@@ -199,13 +245,19 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
     attn = _attend(cfg, q, k, v, mesh).reshape(b, s, cfg.q_dim)
     x = x + wlc(attn @ layer["wo"].astype(dt), "batch", "seq", "act_embed")
 
-    # SwiGLU MLP block
+    # MLP block: dense SwiGLU or top-k expert mixture
     y = rms_norm(x, layer["ln2"], cfg.norm_eps)
+    if cfg.n_experts:
+        out, aux = moe_ffn(
+            y, layer["router"], layer["wi"], layer["wg"], layer["wd"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor)
+        x = x + wlc(out, "batch", "seq", "act_embed")
+        return x, aux
     gate = jax.nn.silu(y @ layer["wg"].astype(dt))
     up = y @ layer["wi"].astype(dt)
     mlp = wlc(gate * up, "batch", "seq", "mlp")
     x = x + wlc(mlp @ layer["wd"].astype(dt), "batch", "seq", "act_embed")
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 _REMAT_POLICIES = {
@@ -215,10 +267,18 @@ _REMAT_POLICIES = {
 }
 
 
-def hidden_states(cfg: LlamaConfig, params: Dict[str, Any],
-                  tokens: jax.Array,
-                  mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens: (B, S) int32 -> final-norm hidden states (B, S, hidden)."""
+def hidden_states_with_aux(cfg: LlamaConfig, params: Dict[str, Any],
+                           tokens: jax.Array,
+                           mesh: Optional[Mesh] = None
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """tokens: (B, S) int32 -> (final-norm hidden states (B, S, hidden),
+    summed MoE aux loss). Dispatches to the GPipe pipeline when the mesh
+    has a pp axis > 1 (models/pipeline.py)."""
+    if mesh is not None and dict(
+            zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1) > 1:
+        from .pipeline import pipelined_hidden_states
+        return pipelined_hidden_states(cfg, params, tokens, mesh)
+
     b, s = tokens.shape
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]
@@ -226,14 +286,20 @@ def hidden_states(cfg: LlamaConfig, params: Dict[str, Any],
     positions = jnp.arange(s)
     cos, sin = rope_frequencies(cfg, positions)
 
-    layer_fn = lambda x, layer: (
-        decoder_layer(cfg, x, layer, cos, sin, mesh), None)
+    layer_fn = lambda x, layer: decoder_layer(cfg, x, layer, cos, sin, mesh)
     if cfg.remat:
         layer_fn = jax.checkpoint(
             layer_fn, policy=_REMAT_POLICIES[cfg.remat_policy]())
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x, aux = jax.lax.scan(layer_fn, x, params["layers"])
 
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), jnp.sum(aux)
+
+
+def hidden_states(cfg: LlamaConfig, params: Dict[str, Any],
+                  tokens: jax.Array,
+                  mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens: (B, S) int32 -> final-norm hidden states (B, S, hidden)."""
+    return hidden_states_with_aux(cfg, params, tokens, mesh)[0]
 
 
 def _head_logits(cfg: LlamaConfig, x: jax.Array, lm_head: jax.Array):
@@ -262,7 +328,7 @@ def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     vocab sizes it would dwarf every other activation.
     """
     b, s = tokens.shape
-    x = hidden_states(cfg, params, tokens, mesh)          # (B, S, h)
+    x, moe_aux = hidden_states_with_aux(cfg, params, tokens, mesh)  # (B,S,h)
     # shift: position i predicts token i+1; last position is masked out.
     # The weight for position i is the TARGET's mask (mask[i+1]), so
     # predictions of padding tokens never contribute.
@@ -309,13 +375,26 @@ def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
     total = jnp.sum(nll * m)
     count = jnp.maximum(jnp.sum(m), 1.0)
-    loss = total / count
-    return loss, {"loss": loss, "tokens": count,
-                  "ppl_proxy": jnp.exp(jnp.minimum(loss, 20.0))}
+    ce = total / count
+    loss = ce
+    metrics = {"loss": ce, "tokens": count,
+               "ppl_proxy": jnp.exp(jnp.minimum(ce, 20.0))}
+    if cfg.n_experts:
+        aux = moe_aux / cfg.n_layers
+        loss = ce + cfg.moe_aux_weight * aux
+        metrics["moe_aux"] = aux
+    return loss, metrics
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """Approximate training FLOPs/token (fwd+bwd = 6*N + attention terms)."""
+    """Approximate training FLOPs/token (fwd+bwd = 6*N_active + attention).
+
+    For MoE, only top_k of n_experts FFNs touch each token, so inactive
+    expert params are excluded from the 6N term.
+    """
     n = cfg.num_params()
+    if cfg.n_experts:
+        n -= (3 * cfg.hidden * cfg.ffn * cfg.n_layers
+              * max(cfg.n_experts - cfg.moe_top_k, 0))
     attn = 12 * cfg.n_layers * cfg.hidden * seq_len  # causal attn matmuls
     return 6.0 * n + attn

@@ -1,0 +1,155 @@
+"""Pipeline parallelism: GPipe microbatch schedule inside one pjit program.
+
+Net-new TPU design (SURVEY.md §2.4 lists PP as a first-class strategy; the
+reference realizes it with per-stage worker processes and NCCL p2p — here
+the whole pipeline is ONE SPMD program). Layers are stacked [L, ...] and
+re-viewed as [pp, L/pp, ...] with the stage dim sharded over the `pp` mesh
+axis; `jax.shard_map(..., axis_names={'pp'})` makes only that axis manual,
+so dp/fsdp/sp/tp/ep sharding inside each stage is still GSPMD-automatic.
+
+Schedule: classic GPipe. M microbatches flow through S stages over
+M + S - 1 ticks; every tick each stage applies its layer block to the
+activation it holds, then `ppermute` rotates activations one stage
+forward. Bubble ticks compute garbage on non-active stages (static
+shapes — the SPMD price for zero host control flow); outputs are
+collected at the last stage and psum-broadcast at the end. The backward
+schedule is the exact transpose: jax autodiff of scan+ppermute *is* the
+reverse pipeline, no hand-written backward pass.
+
+Throughput: bubble fraction = (S-1)/(M+S-1); pick cfg.pp_microbatches >=
+4*pp for <20% bubble, standard GPipe guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import llama
+from ..parallel.mesh import AXIS_PP, mesh_shape
+
+
+def _stage_params(params_layers: Dict[str, jax.Array], pp: int):
+    """[L, ...] stacked layer params -> [pp, L/pp, ...]."""
+    def reshape(a):
+        L = a.shape[0]
+        assert L % pp == 0, f"n_layers={L} not divisible by pp={pp}"
+        return a.reshape(pp, L // pp, *a.shape[1:])
+    return jax.tree.map(reshape, params_layers)
+
+
+def pipelined_hidden_states(cfg: "llama.LlamaConfig", params: Dict[str, Any],
+                            tokens: jax.Array, mesh: Mesh
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in replacement for the lax.scan layer stack in
+    llama.hidden_states_with_aux when mesh pp > 1.
+
+    tokens: (B, S) -> ((B, S, hidden) final-norm hidden states, aux).
+    Embedding, final norm and the LM head stay outside the pipeline
+    (replicated over pp, sharded over the other axes as usual).
+    """
+    pp = mesh_shape(mesh).get(AXIS_PP, 1)
+    b, s = tokens.shape
+    m = min(cfg.pp_microbatches or pp, b)
+    while b % m:
+        m -= 1
+    dt = cfg.dtype
+
+    x = params["embed"].astype(dt)[tokens]                # (B, S, h)
+    positions = jnp.arange(s)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+
+    staged = _stage_params(params["layers"], pp)
+    # bf16 tensors in (or crossing into) a partial-manual shard_map region
+    # check-fail both XLA SPMD partitioners on current jaxlib ("Invalid
+    # binary instruction opcode copy"; order-dependent, verified down to a
+    # 20-line repro). Workaround: carry activations through the pipeline
+    # in f32 — weights are still cast to cfg.dtype inside each matmul, so
+    # the MXU-side operand dtype survives; activations pay an f32 tax
+    # until the upstream bug is fixed, at which point `_act_dtype` can
+    # return cfg.dtype again.
+    staged = jax.tree.map(
+        lambda a: a.astype(jnp.float32) if a.dtype == jnp.bfloat16 else a,
+        staged)
+    h = x.shape[-1]
+    x_mb = x.astype(jnp.float32).reshape(m, b // m, s, h)  # (M, Bmb, S, h)
+
+    def stage_apply(layers_local, x_in):
+        layer_fn = lambda x, layer: llama.decoder_layer(
+            cfg, x, layer, cos, sin, mesh)
+        if cfg.remat:
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=llama._REMAT_POLICIES[cfg.remat_policy]())
+        x_out, aux = jax.lax.scan(layer_fn, x_in, layers_local)
+        return x_out, jnp.sum(aux)
+
+    def pipeline_body(staged_local, x_mb):
+        # staged_local: [1, L/pp, ...] (this stage's block); x_mb replicated
+        # over pp. Manual only over pp — everything inside is still GSPMD.
+        layers_local = jax.tree.map(lambda a: a[0], staged_local)
+        stage = jax.lax.axis_index(AXIS_PP)
+        nstages = jax.lax.axis_size(AXIS_PP)
+        last = nstages - 1
+        ticks = m + nstages - 1
+
+        if dt != jnp.bfloat16:      # see the f32-activations note above
+            x_mb = x_mb.astype(dt)
+
+        # Initial carries must already be pp-varying (each stage's loop
+        # state diverges immediately) or the scan carry types mismatch.
+        vary = lambda a: jax.lax.pcast(a, (AXIS_PP,), to="varying")
+        outputs = vary(jnp.zeros_like(x_mb))
+        carry = vary(jnp.zeros_like(x_mb[0]))
+        aux_total = vary(jnp.zeros((), jnp.float32))
+
+        def tick(state, t):
+            carry, outputs, aux_total = state
+            # Stage 0 ingests microbatch t (clamped during drain ticks);
+            # later stages consume what rotated in from the previous stage.
+            fresh = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m - 1), keepdims=False)
+            x_in = jnp.where(stage == 0, fresh, carry)
+            x_out, aux = stage_apply(layers_local, x_in)
+            # This tick was real work iff microbatch t-stage is in range.
+            mb = t - stage
+            valid = jnp.logical_and(mb >= 0, mb < m)
+            aux_total = aux_total + jnp.where(valid, aux, 0.0)
+            # Last stage banks finished microbatch t-last.
+            out_idx = jnp.clip(t - last, 0, m - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outputs, x_out, out_idx, axis=0)
+            bank = jnp.logical_and(stage == last,
+                                   jnp.logical_and(t - last >= 0,
+                                                   t - last < m))
+            outputs = jnp.where(bank, banked, outputs)
+            # Rotate forward one stage.
+            perm = [(i, (i + 1) % nstages) for i in range(nstages)]
+            carry = jax.lax.ppermute(x_out, AXIS_PP, perm)
+            return (carry, outputs, aux_total), None
+
+        (carry, outputs, aux_total), _ = jax.lax.scan(
+            tick, (carry, outputs, aux_total), jnp.arange(ticks))
+
+        # Outputs live on the last stage, aux on every stage for its own
+        # layers; psum makes both pp-invariant (replicated) again.
+        outputs = jax.lax.psum(
+            jnp.where(stage == last, outputs,
+                      jnp.zeros_like(outputs)).astype(jnp.float32),
+            AXIS_PP)                # f32 out: bf16 can't cross the boundary
+        # Mean over microbatches: each microbatch's aux is a statistic over
+        # B/M tokens; averaging matches the dense path's full-batch scale.
+        aux_total = jax.lax.psum(aux_total, AXIS_PP) / m
+        return outputs, aux_total
+
+    fn = jax.shard_map(
+        pipeline_body, mesh=mesh,
+        in_specs=(P(AXIS_PP), P()), out_specs=(P(), P()),
+        axis_names={AXIS_PP})
+    outputs, aux = fn(staged, x_mb)
+
+    x = outputs.astype(dt).reshape(b, s, h)
+    return llama.rms_norm(x, params["final_norm"], cfg.norm_eps), aux

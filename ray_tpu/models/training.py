@@ -117,15 +117,25 @@ class TrainStepBundle:
         return (params, opt_state), metrics
 
     # public API -----------------------------------------------------------
+    #
+    # Each call runs under `jax.set_mesh` so the model's logical-axis
+    # sharding constraints (with_logical_constraint) resolve against this
+    # bundle's mesh at trace time — without the context they silently
+    # no-op, which both loses the intended activation shardings and (for
+    # MoE-inside-pipeline programs) trips an XLA SPMD partitioner
+    # check-fail ("Invalid binary instruction opcode copy").
 
     def init_state(self, seed: int = 0):
-        return self._init(jax.random.PRNGKey(seed))
+        with jax.set_mesh(self.mesh):
+            return self._init(jax.random.PRNGKey(seed))
 
     def step(self, state, tokens):
-        return self._step(state, tokens)
+        with jax.set_mesh(self.mesh):
+            return self._step(state, tokens)
 
     def eval_loss(self, state, tokens):
-        return self._eval(state[0], tokens)
+        with jax.set_mesh(self.mesh):
+            return self._eval(state[0], tokens)
 
     def shard_batch(self, tokens):
         return jax.device_put(tokens, self.batch_sharding)

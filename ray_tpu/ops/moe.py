@@ -94,15 +94,20 @@ def moe_ffn(x: jax.Array, router_w: jax.Array,
 
     probs = router_probs(xt, router_w)
     dispatch, combine, aux = make_dispatch(probs, top_k, capacity)
-    dispatch = dispatch.astype(dt)
-    combine = combine.astype(dt)
+    # dispatch/combine einsums stay f32: they are one-hot gathers (tiny
+    # FLOPs next to the expert matmuls), f32 keeps the gate weighting
+    # exact, and bf16 one-hot contractions inside a partial-manual
+    # shard_map (PP+MoE) check-fail both XLA SPMD partitioners
+    # ("Invalid binary instruction opcode copy", jax 0.9/jaxlib).
 
-    expert_in = jnp.einsum("tec,th->ech", dispatch, xt)
+    expert_in = jnp.einsum("tec,th->ech", dispatch,
+                           xt.astype(jnp.float32)).astype(dt)
     expert_in = wlc(expert_in, "experts", None, "act_embed")
     gate = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in,
                                   wg.astype(dt)))
     up = jnp.einsum("ech,ehf->ecf", expert_in, wi.astype(dt))
     expert_out = jnp.einsum("ecf,efh->ech", gate * up, wd.astype(dt))
     expert_out = wlc(expert_out, "experts", None, "act_embed")
-    out = jnp.einsum("tec,ech->th", combine, expert_out)
-    return out.reshape(b, s, h), aux
+    out = jnp.einsum("tec,ech->th", combine,
+                     expert_out.astype(jnp.float32))
+    return out.reshape(b, s, h).astype(dt), aux

@@ -11,17 +11,26 @@ so a decode token's KV for every layer lands in ONE scatter at
 take along the page axis (XLA turns both into efficient dynamic-slice
 loops over HBM; no per-layer page tables needed).
 
-The XLA path gathers pages into dense [B, ctx] KV then runs masked
-attention — the standard fallback. A Pallas kernel can later stream pages
-block-by-block without materializing the gather.
+Two decode paths:
+- XLA fallback: gather pages into dense [B, ctx] KV then masked attention
+  (cost scales with max_pages, not actual context).
+- Pallas kernel (`paged_decode_attention`): stream each sequence's pages
+  through VMEM with online softmax. Page indices come from the
+  scalar-prefetched page table, so the BlockSpec DMAs exactly the pages a
+  sequence owns; grid steps past the end of a sequence re-map to the same
+  page (Pallas elides the repeat DMA) and skip compute — decode cost
+  scales with the tokens actually cached.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def gather_kv(k_pages: jax.Array, v_pages: jax.Array,
@@ -74,6 +83,105 @@ def paged_attention_on_gathered(q: jax.Array, k: jax.Array, v: jax.Array,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgc,bckd->bkgd", probs, vf)
     return out.reshape(b, h, d).astype(q.dtype)
+
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size: int,
+                         scale: float):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    live = j * page_size < seq_len
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)            # (group, D)
+        k = k_ref[0, :, 0].astype(jnp.float32)         # (page, D)
+        v = v_ref[0, :, 0].astype(jnp.float32)         # (page, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (group, page)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, -1e30)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:]
+                       / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_tables: jax.Array,
+                           seq_lens: jax.Array, *,
+                           interpret: bool = False) -> jax.Array:
+    """Pallas paged decode attention for one layer.
+
+    q: [B, H, D]; k_pages/v_pages: [num_pages, page_size, KVH, D]
+    (already sliced to the layer); page_tables: [B, max_pages] int32;
+    seq_lens: [B] int32. Returns [B, H, D].
+
+    The page-table BlockSpec index map clamps the page index for grid
+    steps past a sequence's last page to the sequence's final page:
+    consecutive identical block indices make Pallas skip the DMA, and
+    `pl.when` skips the compute, so per-sequence work is proportional to
+    ceil(seq_len / page_size), not max_pages.
+    """
+    b, h, d = q.shape
+    _, page_size, kvh, _ = k_pages.shape
+    max_pages = page_tables.shape[1]
+    group = h // kvh
+    scale = d ** -0.5
+    qg = q.reshape(b, kvh, group, d)
+
+    def page_index(bi, hi, j, tables, lens):
+        last = jnp.maximum((lens[bi] - 1) // page_size, 0)
+        return (tables[bi, jnp.minimum(j, last)], 0, hi, 0)
+
+    grid = (b, kvh, max_pages)
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page_size=page_size,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, group, d),
+                             lambda bi, hi, j, tables, lens: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, page_size, 1, d), page_index),
+                pl.BlockSpec((1, page_size, 1, d), page_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, group, d),
+                lambda bi, hi, j, tables, lens: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, 1), jnp.float32),
+                pltpu.VMEM((group, 1), jnp.float32),
+                pltpu.VMEM((group, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
 
 
 def scatter_kv(k_pages: jax.Array, v_pages: jax.Array,

@@ -1,5 +1,5 @@
 from .mesh import (MeshSpec, AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP,
-                   BATCH_AXES, batch_sharding, replicated, mesh_shape,
-                   single_device_mesh)
+                   AXIS_PP, BATCH_AXES, batch_sharding, replicated,
+                   mesh_shape, single_device_mesh)
 from .sharding import (DEFAULT_RULES, spec_for, named_sharding,
                        with_logical_constraint, tree_shardings, shard_tree)

@@ -5,14 +5,14 @@ Reference parity: where the reference wires NCCL process groups
 we declare a `MeshSpec` — named parallelism axes over a
 jax.sharding.Mesh — and let XLA compile collectives onto ICI. The axes:
 
+    pp     pipeline parallel (layer stages, GPipe microbatch rotation —
+           models/pipeline.py; outermost so stage hops can ride DCN)
     dp     data parallel (gradient allreduce / psum)
     fsdp   fully-sharded data parallel (params sharded, all-gather on use)
-    sp     sequence/context parallel (ring attention over ppermute)
+    sp     sequence/context parallel (ring attention over ppermute, or
+           Ulysses head-scatter all-to-all — ops/ulysses.py)
     tp     tensor parallel (heads/ffn sharded, psum on projections)
     ep     expert parallel (MoE expert sharding, all_to_all dispatch)
-
-Pipeline parallelism is expressed separately (stage meshes / collective
-permute), not as a mesh axis here.
 """
 
 from __future__ import annotations
@@ -25,12 +25,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+AXIS_PP = "pp"
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
 AXIS_EP = "ep"
-ALL_AXES = (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP)
+ALL_AXES = (AXIS_PP, AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP)
 # Activation batch is sharded over every data-like axis.
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)
 
@@ -44,6 +45,7 @@ class MeshSpec:
     sp: int = 1
     tp: int = 1
     ep: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         sizes = {f.name: getattr(self, f.name)
@@ -64,8 +66,8 @@ class MeshSpec:
         return MeshSpec(**sizes)
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {AXIS_DP: self.dp, AXIS_FSDP: self.fsdp, AXIS_SP: self.sp,
-                AXIS_TP: self.tp, AXIS_EP: self.ep}
+        return {AXIS_PP: self.pp, AXIS_DP: self.dp, AXIS_FSDP: self.fsdp,
+                AXIS_SP: self.sp, AXIS_TP: self.tp, AXIS_EP: self.ep}
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         devices = list(devices if devices is not None else jax.devices())
@@ -81,7 +83,8 @@ class MeshSpec:
 
 
 def single_device_mesh() -> Mesh:
-    return MeshSpec(dp=1, fsdp=1, sp=1, tp=1, ep=1).build(jax.devices()[:1])
+    return MeshSpec(dp=1, fsdp=1, sp=1, tp=1, ep=1, pp=1).build(
+        jax.devices()[:1])
 
 
 def mesh_shape(mesh: Mesh) -> Dict[str, int]:

@@ -14,7 +14,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from .mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP, BATCH_AXES
+from .mesh import (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP, AXIS_PP,
+                   BATCH_AXES)
 
 # Logical axis -> mesh axis (or tuple of mesh axes, or None = replicate).
 # The default table implements DP+FSDP+TP+SP for transformer LMs:
@@ -29,7 +30,10 @@ DEFAULT_RULES: Dict[str, Union[None, str, Tuple[str, ...]]] = {
     "head_dim": None,
     "mlp": AXIS_TP,
     "vocab": AXIS_TP,
-    "layers": None,
+    # Stacked layer dim sharded over pp: contiguous L/pp blocks land on
+    # their pipeline stage, so stage params (and optimizer state) never
+    # replicate across stages (models/pipeline.py).
+    "layers": AXIS_PP,
     "experts": AXIS_EP,
     "act_embed": None,       # activation feature dim stays unsharded
 }

@@ -49,7 +49,9 @@ def test_param_shardings_applied(tokens):
     state = bundle.init_state(0)
     wq = state[0]["layers"]["wq"]
     spec = wq.sharding.spec
-    assert spec == jax.sharding.PartitionSpec(None, "fsdp", "tp"), spec
+    # layer dim rides the pp axis (size 1 here — replicated; stage-sharded
+    # once the mesh has pp > 1)
+    assert spec == jax.sharding.PartitionSpec("pp", "fsdp", "tp"), spec
 
 
 def test_sp_ring_matches_dense(tokens):
