@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ._private import state as _state
 from ._private.object_ref import ObjectRef
+from ._private.streaming import ObjectRefGenerator
 from ._private.worker import (init, shutdown, current_runtime,
                               add_fake_node, remove_node)
 from .actor import ActorClass, ActorHandle
@@ -24,7 +25,8 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "get_actor", "aio_get_actor", "nodes", "cluster_resources",
-    "available_resources", "ObjectRef", "ActorHandle", "exceptions",
+    "available_resources", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle", "exceptions",
     "get_runtime_context", "method",
 ]
 

@@ -35,6 +35,7 @@ from .object_store import (MemoryStore, ShmLocation, read_from_shm,
 from .protocol import ClientPool, ConnectionLost, RpcServer
 from .serialization import (INLINE_OBJECT_LIMIT, SerializedObject,
                             serialize, serialize_code)
+from .streaming import ObjectRefGenerator, StreamState
 from ..exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                           ObjectLostError, RayTpuError, TaskCancelledError,
                           TaskError, WorkerCrashedError)
@@ -227,6 +228,8 @@ class CoreClient:
             "RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20))
         self._lineage_bytes = 0
         self._reconstructing: Dict[str, asyncio.Future] = {}
+        # Streaming generators we own: generator_id -> StreamState.
+        self._streams: Dict[str, "StreamState"] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -264,17 +267,43 @@ class CoreClient:
     async def rpc_object_ready(self, object_id: str = None, payload=None,
                                location=None, error=None,
                                task_id: Optional[str] = None,
-                               object_ids=None) -> None:
+                               object_ids=None, stream_of: str = None,
+                               stream_index: int = None,
+                               worker_addr=None) -> None:
         """A worker pushed a task result to us (we are the owner).
 
         Errors may carry `object_ids` (all return ids of a failed task) so a
         multi-return task fails every ref atomically — and a retry decision
         is made once, before anything is stored.
+
+        Streaming items carry `stream_of` (generator id) + `stream_index`;
+        they are stored like any owned object and indexed into the
+        generator's StreamState.
         """
+        if stream_of is not None:
+            if error is not None:
+                err = (error if isinstance(error, Exception)
+                       else RayTpuError(str(error)))
+                self.memory_store.put_error(object_id, err)
+            elif location is not None:
+                self.memory_store.put_location(object_id, location)
+            else:
+                self.memory_store.put_serialized(
+                    object_id, SerializedObject.from_flat(payload))
+            self.ref_counter.register_owned(object_id)
+            stream = self._streams.get(stream_of)
+            if stream is not None:
+                stream.put(stream_index, object_id, worker_addr)
+            return
         pending = self._pending_tasks.pop(task_id, None) if task_id else None
         if error is not None:
             err = error if isinstance(error, Exception) else RayTpuError(str(error))
-            retriable = isinstance(err, WorkerCrashedError)
+            # Streaming tasks are NOT retried wholesale: items 0..k may
+            # already be consumed and replaying them would double-register
+            # the same object ids. The stream fails instead.
+            retriable = (isinstance(err, WorkerCrashedError)
+                         and not (pending is not None and pending.spec.get(
+                             "num_returns") == "streaming"))
             if retriable and pending is not None and pending.retries_left > 0:
                 pending.retries_left -= 1
                 self._pending_tasks[task_id] = pending
@@ -290,6 +319,11 @@ class CoreClient:
                     # crash) — never clobber a completed object.
                     continue
                 self.memory_store.put_error(oid, err)
+                stream = self._streams.get(oid)
+                if stream is not None:
+                    # a streaming task failed wholesale (e.g. worker crash
+                    # before/while generating): wake its consumers
+                    stream.fail(err)
             self._unpin_args(pending)
             return
         if location is not None:
@@ -403,6 +437,16 @@ class CoreClient:
                 return {"status": "error", "error": entry.value}
             return {"status": "inline", "payload": serialize(entry.value).to_flat()}
         return {"status": "lost"}
+
+    async def rpc_stream_end(self, generator_id: str, count: int,
+                             task_id: Optional[str] = None) -> None:
+        """End-of-stream from the executing worker (a generator that
+        raised delivers the error as its final item first)."""
+        pending = self._pending_tasks.pop(task_id, None) if task_id else None
+        stream = self._streams.get(generator_id)
+        if stream is not None:
+            stream.finish(count)
+        self._unpin_args(pending)
 
     async def rpc_ref_event(self, object_id: str, delta: int) -> None:
         self.ref_counter.on_borrower_event(object_id, delta)
@@ -656,12 +700,67 @@ class CoreClient:
         not_ready = [r for r in refs if r.id not in ready_set]
         return ready, not_ready
 
+    # -------------------------------------------------------- streaming
+
+    async def aio_next_stream_item(self, generator_id: str, index: int,
+                                   timeout: float = 300.0):
+        stream = self._streams.get(generator_id)
+        if stream is None:
+            raise ValueError(f"unknown stream {generator_id[:12]}")
+        try:
+            oid = await asyncio.wait_for(stream.wait_for(index), timeout)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(
+                f"stream item {index} of {generator_id[:12]} timed out")
+        if oid is None:
+            self._streams.pop(generator_id, None)    # fully consumed
+            return None
+        if stream.wants_ack and stream.worker_addr is not None:
+            # ack for producer backpressure (fire-and-forget; only sent
+            # when the task was submitted with a backpressure bound)
+            addr = stream.worker_addr
+            try:
+                await self.pool.get(addr).oneway(
+                    "stream_ack", generator_id=generator_id, index=index)
+            except Exception:
+                pass
+        return ObjectRef(oid, self.address, _client=self)
+
+    def next_stream_item(self, generator_id: str, index: int,
+                         timeout: float = 300.0):
+        return self.loop_runner.run_sync(
+            self.aio_next_stream_item(generator_id, index, timeout))
+
+    def release_stream(self, generator_id: str, consumed: int) -> None:
+        """Drop an abandoned/finished generator: tell the producer to stop
+        (it may be blocked on backpressure or producing unboundedly) and
+        free unconsumed item objects this process owns."""
+        stream = self._streams.pop(generator_id, None)
+        if stream is None:
+            return
+
+        async def _release():
+            if stream.worker_addr is not None and stream.total is None:
+                try:
+                    await self.pool.get(stream.worker_addr).oneway(
+                        "stream_cancel", generator_id=generator_id)
+                except Exception:
+                    pass
+            for idx, oid in stream.items.items():
+                if idx >= consumed:
+                    self.memory_store.delete(oid)
+
+        self.loop_runner.call_soon(_release())
+
     # ------------------------------------------------------------ tasks
 
     def submit_task(self, fn, args: tuple, kwargs: dict, opts: dict,
                     fn_blob: Optional[bytes] = None):
         task_id = TaskID.generate().hex()
         num_returns = opts.get("num_returns") or 1
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1
         return_ids = [ObjectID.generate().hex() for _ in range(num_returns)]
         for rid in return_ids:
             self.ref_counter.register_owned(rid)
@@ -675,13 +774,18 @@ class CoreClient:
             "args_blob": serialize((args, kwargs)).to_flat(),
             "return_id": return_ids[0],
             "return_ids": return_ids,
-            "num_returns": num_returns,
+            "num_returns": "streaming" if streaming else num_returns,
             "owner_addr": self.address,
             "resources": _resources_from_opts(opts, default_cpu=1.0),
             "scheduling": opts.get("scheduling_strategy"),
             "is_actor_creation": False,
             "runtime_env": opts.get("runtime_env"),
         }
+        if streaming:
+            bp = opts.get("_generator_backpressure_num_objects")
+            spec["backpressure"] = bp
+            self._streams[return_ids[0]] = StreamState(
+                return_ids[0], wants_ack=bool(bp))
         retries = opts.get("max_retries", 0)
         pend = PendingTask(spec, retries, [r.id for r in arg_refs])
         self._pending_tasks[task_id] = pend
@@ -692,13 +796,17 @@ class CoreClient:
             try:
                 await self._controller().call("submit_task", spec=spec)
             except Exception as e:
+                err = TaskError(spec["name"], f"submission failed: {e!r}")
                 for rid in return_ids:
-                    self.memory_store.put_error(
-                        rid, TaskError(spec["name"],
-                                       f"submission failed: {e!r}"))
+                    self.memory_store.put_error(rid, err)
+                    stream = self._streams.get(rid)
+                    if stream is not None:
+                        stream.fail(err)
                 self._unpin_args(self._pending_tasks.pop(task_id, None))
 
         self.loop_runner.call_soon(_submit())
+        if streaming:
+            return ObjectRefGenerator(return_ids[0], self)
         return refs[0] if num_returns == 1 else refs
 
     # ------------------------------------------------------------ actors
@@ -768,8 +876,9 @@ class CoreClient:
         return addr
 
     def submit_actor_task(self, actor_id: str, method: str, args: tuple,
-                          kwargs: dict, opts: dict) -> ObjectRef:
+                          kwargs: dict, opts: dict):
         return_id = ObjectID.generate().hex()
+        streaming = opts.get("num_returns") == "streaming"
         self.ref_counter.register_owned(return_id)
         ref = ObjectRef(return_id, self.address, _client=self)
         arg_refs = _collect_refs(args) + _collect_refs(kwargs)
@@ -779,29 +888,48 @@ class CoreClient:
         with self._actor_seq_lock:
             seq = self._actor_seq.get(actor_id, 0)
             self._actor_seq[actor_id] = seq + 1
+        if streaming:
+            self._streams[return_id] = StreamState(
+                return_id, wants_ack=bool(opts.get(
+                    "_generator_backpressure_num_objects")))
 
         async def _call():
             try:
                 await self._call_actor_inner(
-                    actor_id, method, args_blob, return_id, seq)
+                    actor_id, method, args_blob, return_id, seq,
+                    streaming=streaming,
+                    backpressure=opts.get(
+                        "_generator_backpressure_num_objects"))
             finally:
                 for r in arg_refs:
                     self.ref_counter.unpin(r.id)
 
         self.loop_runner.call_soon(_call())
+        if streaming:
+            return ObjectRefGenerator(return_id, self)
         return ref
 
     async def _call_actor_inner(self, actor_id, method, args_blob,
-                                return_id, seq):
+                                return_id, seq, streaming=False,
+                                backpressure=None):
             addr = None
+            extra = ({"streaming": True, "owner_addr": self.address,
+                      "backpressure": backpressure} if streaming else {})
+
+            def _fail(err):
+                self.memory_store.put_error(return_id, err)
+                stream = self._streams.get(return_id)
+                if stream is not None:
+                    stream.fail(err)
+
             try:
                 addr = await self._resolve_actor(actor_id)
                 reply = await self.pool.get(addr).call(
                     "call_actor", actor_id=actor_id, method=method,
                     args_blob=args_blob, caller=self.worker_id, seq=seq,
-                    return_id=return_id)
+                    return_id=return_id, **extra)
             except ActorDiedError as e:
-                self.memory_store.put_error(return_id, e)
+                _fail(e)
                 return
             except (ConnectionLost, OSError):
                 # The actor may have restarted elsewhere: re-resolve once and
@@ -814,16 +942,14 @@ class CoreClient:
                     reply = await self.pool.get(addr).call(
                         "call_actor", actor_id=actor_id, method=method,
                         args_blob=args_blob, caller=self.worker_id, seq=seq2,
-                        return_id=return_id)
+                        return_id=return_id, **extra)
                 except Exception as e2:
-                    self.memory_store.put_error(
-                        return_id,
-                        e2 if isinstance(e2, ActorDiedError) else
-                        ActorDiedError(actor_id, f"actor connection lost: {e2!r}"))
+                    _fail(e2 if isinstance(e2, ActorDiedError) else
+                          ActorDiedError(actor_id,
+                                         f"actor connection lost: {e2!r}"))
                     return
             except Exception as e:
-                self.memory_store.put_error(
-                    return_id, ActorDiedError(actor_id, f"call failed: {e!r}"))
+                _fail(ActorDiedError(actor_id, f"call failed: {e!r}"))
                 # Don't stall later seqs behind this one.
                 if addr is not None:
                     try:
@@ -839,9 +965,14 @@ class CoreClient:
                     return_id, SerializedObject.from_flat(reply["payload"]))
             elif status == "location":
                 self.memory_store.put_location(return_id, reply["location"])
+            elif status == "streaming":
+                pass      # items arrive via object_ready / stream_end pushes
             else:
-                self.memory_store.put_error(
-                    return_id, ActorError(method, reply["error_tb"]))
+                err = ActorError(method, reply["error_tb"])
+                if streaming:
+                    _fail(err)
+                else:
+                    self.memory_store.put_error(return_id, err)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         self.loop_runner.run_sync(self._controller().call(

@@ -29,11 +29,22 @@ from .protocol import ClientPool, RpcServer
 logger = logging.getLogger(__name__)
 
 
+def runtime_env_key(runtime_env: Optional[dict]) -> str:
+    """Stable identity of a runtime env for worker reuse. Workers are only
+    shared between tasks with the SAME key (reference parity:
+    src/ray/raylet/worker_pool.h:224 — env-keyed idle pools)."""
+    if not runtime_env:
+        return ""
+    import json
+    return json.dumps(runtime_env, sort_keys=True, default=str)
+
+
 class WorkerHandle:
     __slots__ = ("worker_id", "addr", "pid", "proc", "state", "current_task",
-                 "actor_id", "spawn_time")
+                 "actor_id", "spawn_time", "env_key")
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    def __init__(self, worker_id: str, proc: subprocess.Popen,
+                 env_key: str = ""):
         self.worker_id = worker_id
         self.addr: Optional[Tuple[str, int]] = None
         self.proc = proc
@@ -42,6 +53,7 @@ class WorkerHandle:
         self.current_task: Optional[dict] = None
         self.actor_id: Optional[str] = None
         self.spawn_time = time.monotonic()
+        self.env_key = env_key
 
 
 class NodeDaemon:
@@ -64,15 +76,19 @@ class NodeDaemon:
         self.address: Optional[Tuple[str, int]] = None
         self.object_store = NodeObjectStore(session_name)
         self.workers: Dict[str, WorkerHandle] = {}
-        self.idle: List[str] = []
-        # Tasks waiting for a worker take WHICHEVER worker frees first
-        # (released or freshly registered) — never block on one specific
-        # spawn: a worker boot costs seconds (interpreter + jax import)
-        # while a release is sub-millisecond. Spawns are capped so a burst
-        # can't fork-bomb a small host (reference parity: worker_pool.h:224
-        # maximum_startup_concurrency).
-        self._worker_waiters: "deque[asyncio.Future]" = deque()
-        self._spawning = 0
+        # Idle pools and waiter queues are keyed by runtime-env identity:
+        # a task only reuses a worker whose env matches (reference parity:
+        # worker_pool.h:224 env-keyed reuse).
+        self.idle: Dict[str, List[str]] = {}
+        # Tasks waiting for a worker take WHICHEVER same-env worker frees
+        # first (released or freshly registered) — never block on one
+        # specific spawn: a worker boot costs seconds (interpreter + jax
+        # import) while a release is sub-millisecond. Spawns are capped so
+        # a burst can't fork-bomb a small host (reference parity:
+        # worker_pool.h maximum_startup_concurrency).
+        self._worker_waiters: Dict[str, "deque[asyncio.Future]"] = {}
+        self._spawning: Dict[str, int] = {}
+        self._runtime_envs: Dict[str, Optional[dict]] = {"": None}
         self._max_concurrent_spawns = max(2, (os.cpu_count() or 1) // 2)
         self._register_events: Dict[str, asyncio.Event] = {}
         self._monitor_task: Optional[asyncio.Task] = None
@@ -98,12 +114,13 @@ class NodeDaemon:
 
     async def stop(self):
         self._closed = True
-        while self._worker_waiters:
-            fut = self._worker_waiters.popleft()
-            if not fut.done():
-                fut.set_exception(
-                    RuntimeError("node daemon shut down while task waited "
-                                 "for a worker"))
+        for queue in self._worker_waiters.values():
+            while queue:
+                fut = queue.popleft()
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError("node daemon shut down while task "
+                                     "waited for a worker"))
         if self._monitor_task:
             self._monitor_task.cancel()
         for w in self.workers.values():
@@ -121,20 +138,98 @@ class NodeDaemon:
 
     # --------------------------------------------------------- worker pool
 
-    async def _spawn_worker(self) -> WorkerHandle:
+    async def _prepare_runtime_env(self, runtime_env: Optional[dict]):
+        """Materialize a runtime env (reference parity:
+        python/ray/_private/runtime_env/plugin.py:24,118 — env_vars,
+        working_dir, py_modules, pip plugins). Returns
+        (env_vars, extra_pythonpath, cwd)."""
+        if not runtime_env:
+            return {}, [], None
+        env_vars = dict(runtime_env.get("env_vars") or {})
+        extra_path: List[str] = []
+        cwd = None
+        wd = runtime_env.get("working_dir")
+        if wd:
+            wd = os.path.abspath(wd)
+            if not os.path.isdir(wd):
+                raise RuntimeError(f"runtime_env working_dir {wd!r} "
+                                   "does not exist on this node")
+            cwd = wd
+            extra_path.append(wd)
+        for mod in runtime_env.get("py_modules") or []:
+            mod = os.path.abspath(mod)
+            if not os.path.exists(mod):
+                raise RuntimeError(f"runtime_env py_module {mod!r} "
+                                   "does not exist on this node")
+            # a module's import root is its parent directory (works for
+            # both package dirs and single .py files)
+            extra_path.append(os.path.dirname(mod))
+        pip_pkgs = runtime_env.get("pip")
+        if pip_pkgs:
+            import fcntl
+            import hashlib
+            key = hashlib.sha1(
+                runtime_env_key({"pip": pip_pkgs}).encode()).hexdigest()[:16]
+            target = os.path.join(self.temp_dir, "runtime_envs", key)
+            marker = os.path.join(target, ".ready")
+            if not os.path.exists(marker):
+                os.makedirs(target, exist_ok=True)
+                # flock serializes concurrent installs of the SAME env —
+                # both across this daemon's parallel spawns and across
+                # daemons sharing the session temp dir (pip does not lock
+                # --target installs itself). Held in a thread so the
+                # event loop never blocks.
+                lock_path = target + ".lock"
+
+                def _locked_install():
+                    with open(lock_path, "w") as lock:
+                        fcntl.flock(lock, fcntl.LOCK_EX)
+                        if os.path.exists(marker):
+                            return 0, b""
+                        cmd = [sys.executable, "-m", "pip", "install",
+                               "--target", target, "--quiet"]
+                        find_links = os.environ.get(
+                            "RAY_TPU_PIP_FIND_LINKS")
+                        if find_links:
+                            cmd += ["--no-index", "--find-links",
+                                    find_links]
+                        cmd += list(pip_pkgs)
+                        proc = subprocess.run(
+                            cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+                        if proc.returncode == 0:
+                            with open(marker, "w") as f:
+                                f.write("ok")
+                        return proc.returncode, proc.stdout
+
+                rc, out = await asyncio.get_running_loop().run_in_executor(
+                    None, _locked_install)
+                if rc != 0:
+                    raise RuntimeError(
+                        f"runtime_env pip install failed (rc={rc}): "
+                        f"{out.decode(errors='replace')[-2000:]}")
+            extra_path.append(target)
+        return env_vars, extra_path, cwd
+
+    async def _spawn_worker(self, env_key: str = "") -> WorkerHandle:
         worker_id = WorkerID.generate().hex()
         log_path = os.path.join(self.temp_dir, "logs", f"worker-{worker_id[:12]}.log")
+        runtime_env = self._runtime_envs.get(env_key)
+        env_vars, extra_path, cwd = await self._prepare_runtime_env(
+            runtime_env)
         log_file = open(log_path, "ab")
         env = dict(os.environ)
         env.update(self.worker_env)
+        env.update(env_vars)
         env["RAY_TPU_SESSION"] = self.session_name
         # Workers must import ray_tpu (and the driver's user modules) even
         # when the package isn't installed: propagate the package parent dir
-        # plus the driver's sys.path entries.
+        # plus the driver's sys.path entries. Runtime-env paths go FIRST so
+        # they shadow driver-side modules.
         pkg_parent = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
-        extra = [pkg_parent] + [p for p in sys.path
-                                if p and os.path.isdir(p)]
+        extra = extra_path + [pkg_parent] + [p for p in sys.path
+                                             if p and os.path.isdir(p)]
         existing = env.get("PYTHONPATH", "")
         seen, parts = set(), []
         for p in extra + existing.split(os.pathsep):
@@ -150,9 +245,9 @@ class NodeDaemon:
              "--node-id", self.node_id,
              "--session", self.session_name],
             stdout=log_file, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True)
+            cwd=cwd, start_new_session=True)
         log_file.close()
-        handle = WorkerHandle(worker_id, proc)
+        handle = WorkerHandle(worker_id, proc, env_key)
         self.workers[worker_id] = handle
         ev = asyncio.Event()
         self._register_events[worker_id] = ev
@@ -177,55 +272,66 @@ class NodeDaemon:
             ev.set()
         return {"status": "ok"}
 
-    async def _acquire_worker(self) -> WorkerHandle:
+    async def _acquire_worker(self, env_key: str = "",
+                              runtime_env: Optional[dict] = None
+                              ) -> WorkerHandle:
+        self._runtime_envs.setdefault(env_key, runtime_env)
+        pool = self.idle.setdefault(env_key, [])
+        waiters = self._worker_waiters.setdefault(env_key, deque())
         while True:
-            while self.idle:
-                worker_id = self.idle.pop()
+            while pool:
+                worker_id = pool.pop()
                 handle = self.workers.get(worker_id)
                 if handle is not None and handle.state == "idle":
                     return handle
             fut = asyncio.get_running_loop().create_future()
-            self._worker_waiters.append(fut)
-            self._maybe_spawn()
+            waiters.append(fut)
+            self._maybe_spawn(env_key)
             handle = await fut
             if handle.state == "idle":
                 return handle
             # handed a worker that died in the window; go around again
 
-    def _maybe_spawn(self) -> None:
+    def _maybe_spawn(self, env_key: str = "") -> None:
         if self._closed:
             return
-        deficit = len(self._worker_waiters) - self._spawning
-        room = self._max_concurrent_spawns - self._spawning
+        spawning_here = self._spawning.get(env_key, 0)
+        spawning_total = sum(self._spawning.values())
+        deficit = (len(self._worker_waiters.get(env_key, ()))
+                   - spawning_here)
+        room = self._max_concurrent_spawns - spawning_total
         for _ in range(max(0, min(deficit, room))):
-            self._spawning += 1
-            asyncio.ensure_future(self._spawn_into_pool())
+            self._spawning[env_key] = self._spawning.get(env_key, 0) + 1
+            asyncio.ensure_future(self._spawn_into_pool(env_key))
 
-    async def _spawn_into_pool(self) -> None:
+    async def _spawn_into_pool(self, env_key: str = "") -> None:
+        waiters = self._worker_waiters.setdefault(env_key, deque())
         try:
-            handle = await self._spawn_worker()
+            handle = await self._spawn_worker(env_key)
             self._offer_worker(handle)
         except Exception as e:
             # surface the failure on one waiter instead of hanging it
-            while self._worker_waiters:
-                fut = self._worker_waiters.popleft()
+            while waiters:
+                fut = waiters.popleft()
                 if not fut.done():
                     fut.set_exception(e)
                     break
         finally:
-            self._spawning -= 1
+            self._spawning[env_key] = self._spawning.get(env_key, 1) - 1
             # waiters taken by actors never release a worker; keep
             # spawning while a deficit remains
-            self._maybe_spawn()
+            self._maybe_spawn(env_key)
 
     def _offer_worker(self, handle: WorkerHandle) -> None:
-        """Hand an idle worker to the longest-waiting task, else pool it."""
-        while self._worker_waiters:
-            fut = self._worker_waiters.popleft()
+        """Hand an idle worker to the longest-waiting same-env task, else
+        pool it under its env key."""
+        waiters = self._worker_waiters.setdefault(handle.env_key, deque())
+        while waiters:
+            fut = waiters.popleft()
             if not fut.done():
                 fut.set_result(handle)
                 return
-        self.idle.append(handle.worker_id)
+        self.idle.setdefault(handle.env_key, []).append(handle.worker_id)
 
     def _release_worker(self, handle: WorkerHandle) -> None:
         if handle.state == "busy":
@@ -237,7 +343,7 @@ class NodeDaemon:
         started = 0
         for _ in range(count):
             try:
-                h = await self._spawn_worker()
+                h = await self._spawn_worker("")
                 self._offer_worker(h)
                 started += 1
             except Exception:
@@ -266,8 +372,9 @@ class NodeDaemon:
     async def _run_task(self, spec: dict) -> None:
         controller = self.pool.get(self.controller_addr)
         self._assign_tpu_chips(spec)
+        renv = spec.get("runtime_env")
         try:
-            handle = await self._acquire_worker()
+            handle = await self._acquire_worker(runtime_env_key(renv), renv)
         except Exception as e:
             await self._report_failure(spec, f"worker spawn failed: {e!r}")
             self._release_tpu_chips(spec["task_id"])
@@ -394,7 +501,7 @@ class NodeDaemon:
             "node_id": self.node_id,
             "num_workers": len([w for w in self.workers.values()
                                 if w.state != "dead"]),
-            "num_idle": len(self.idle),
+            "num_idle": sum(len(v) for v in self.idle.values()),
             "object_store_objects": self.object_store.num_objects,
             "object_store_bytes": self.object_store.bytes_used,
             "bytes_spilled": self.object_store.bytes_spilled,
@@ -410,7 +517,21 @@ class NodeDaemon:
         while not self._closed:
             await asyncio.sleep(0.5)
             try:
-                await controller.oneway("heartbeat", node_id=self.node_id)
+                reply = await controller.call(
+                    "heartbeat", node_id=self.node_id)
+                if (reply or {}).get("status") == "unknown":
+                    # Controller restarted and lost volatile node state:
+                    # re-register and re-announce hosted actors so its
+                    # persisted actor table gets fresh addresses.
+                    await controller.call(
+                        "register_node", node_id=self.node_id,
+                        addr=self.address, resources=self.resources,
+                        labels=self.labels)
+                    for h in self.workers.values():
+                        if h.state == "actor" and h.actor_id:
+                            await controller.oneway(
+                                "actor_started", actor_id=h.actor_id,
+                                addr=h.addr, worker_id=h.worker_id)
             except Exception:
                 pass
             # arena pressure: spill LRU sealed objects down to the low
@@ -431,8 +552,9 @@ class NodeDaemon:
                 if handle.proc.poll() is not None:
                     prev_state = handle.state
                     handle.state = "dead"
-                    if handle.worker_id in self.idle:
-                        self.idle.remove(handle.worker_id)
+                    pool = self.idle.get(handle.env_key, [])
+                    if handle.worker_id in pool:
+                        pool.remove(handle.worker_id)
                     spec = handle.current_task
                     if spec is not None:
                         self._release_tpu_chips(spec["task_id"])

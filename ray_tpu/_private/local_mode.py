@@ -33,6 +33,37 @@ class LocalActorState:
         self.death_cause = ""
 
 
+class _LocalGenerator:
+    """Eager local-mode stand-in for ObjectRefGenerator: all items were
+    produced at submission; iteration just walks the refs."""
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._cursor >= len(self._refs):
+            raise StopIteration
+        ref = self._refs[self._cursor]
+        self._cursor += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return self.__next__()
+        except StopIteration:
+            raise StopAsyncIteration
+
+    def completed(self):
+        return list(self)
+
+
 class LocalModeClient:
     is_local_mode = True
 
@@ -91,13 +122,38 @@ class LocalModeClient:
             return self.get(obj)
         return obj
 
+    def _run_streaming(self, fn_name: str, result) -> "_LocalGenerator":
+        refs = []
+        try:
+            if hasattr(result, "__anext__"):     # async generator parity
+                async def _drain():
+                    return [item async for item in result]
+                items = asyncio.new_event_loop().run_until_complete(_drain())
+            else:
+                items = list(result)
+            for item in items:
+                oid = uuid.uuid4().hex
+                self.store[oid] = item
+                refs.append(ObjectRef(oid, None, _client=self))
+        except Exception:
+            oid = uuid.uuid4().hex
+            self.errors[oid] = TaskError(fn_name, traceback.format_exc())
+            refs.append(ObjectRef(oid, None, _client=self))
+        return _LocalGenerator(refs)
+
     def submit_task(self, fn, args, kwargs, opts, fn_blob=None):
         num_returns = opts.get("num_returns") or 1
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1
         oids = [uuid.uuid4().hex for _ in range(num_returns)]
         args = tuple(self._resolve(a) for a in args)
         kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
         try:
             result = fn(*args, **kwargs)
+            if streaming:
+                return self._run_streaming(
+                    getattr(fn, "__name__", "task"), result)
             if asyncio.iscoroutine(result):
                 result = asyncio.new_event_loop().run_until_complete(result)
             if num_returns == 1:
@@ -139,6 +195,7 @@ class LocalModeClient:
 
     def submit_actor_task(self, actor_id, method, args, kwargs, opts):
         oid = uuid.uuid4().hex
+        streaming = (opts or {}).get("num_returns") == "streaming"
         actor = self.actors.get(actor_id)
         if actor is None or actor.dead:
             self.errors[oid] = ActorDiedError(
@@ -148,6 +205,8 @@ class LocalModeClient:
         kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
         try:
             result = getattr(actor.instance, method)(*args, **kwargs)
+            if streaming:
+                return self._run_streaming(method, result)
             if asyncio.iscoroutine(result):
                 result = asyncio.new_event_loop().run_until_complete(result)
             self.store[oid] = result

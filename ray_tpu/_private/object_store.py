@@ -13,6 +13,7 @@ Reference parity:
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import os
 import threading
 from multiprocessing import resource_tracker, shared_memory
@@ -97,8 +98,11 @@ def unlink_session_arena(session_name: str) -> None:
 
 def segment_name(session_name: str, object_id: str) -> str:
     """Canonical shm segment name (POSIX shm names cap ~250 chars and must
-    be unique machine-wide)."""
-    return f"rtpu_{session_name[:8]}_{object_id[:20]}"
+    be unique machine-wide). Hash the id rather than truncate it:
+    structured ids (e.g. streaming items "<gen_id>_<n>") differ only past
+    the truncation point and would collide."""
+    digest = hashlib.sha1(object_id.encode()).hexdigest()[:24]
+    return f"rtpu_{session_name[:8]}_{digest}"
 
 
 def create_untracked_shm(name: str, size: int) -> shared_memory.SharedMemory:

@@ -99,6 +99,10 @@ class WorkerRuntime:
         client.server.register("call_actor", self.rpc_call_actor)
         client.server.register("shutdown_worker", self.rpc_shutdown_worker)
         client.server.register("skip_actor_seq", self.rpc_skip_actor_seq)
+        client.server.register("stream_ack", self.rpc_stream_ack)
+        client.server.register("stream_cancel", self.rpc_stream_cancel)
+        # generator_id -> [acked_count, waiter_event, cancelled]
+        self._stream_acks: Dict[str, list] = {}
 
     # ------------------------------------------------------------- helpers
 
@@ -116,12 +120,14 @@ class WorkerRuntime:
         return tuple(args), kwargs
 
     async def _push_result(self, owner_addr, object_id: str, value: Any,
-                           task_id: Optional[str] = None) -> None:
+                           task_id: Optional[str] = None,
+                           **stream_kw) -> None:
         serialized = serialize(value)
         owner = self.client.pool.get(tuple(owner_addr))
         if serialized.total_size <= INLINE_OBJECT_LIMIT:
             await owner.oneway("object_ready", object_id=object_id,
-                               payload=serialized.to_flat(), task_id=task_id)
+                               payload=serialized.to_flat(), task_id=task_id,
+                               **stream_kw)
         else:
             loop = asyncio.get_running_loop()
             shm_name, size = await loop.run_in_executor(
@@ -133,11 +139,12 @@ class WorkerRuntime:
                 shm_name=shm_name, size=size)
             location = ShmLocation(self.daemon_addr, shm_name, size)
             await owner.oneway("object_ready", object_id=object_id,
-                               location=location, task_id=task_id)
+                               location=location, task_id=task_id,
+                               **stream_kw)
 
     async def _push_error(self, owner_addr, object_id: str, error: Exception,
                           task_id: Optional[str] = None,
-                          object_ids=None) -> None:
+                          object_ids=None, **stream_kw) -> None:
         import pickle
         try:
             pickle.loads(pickle.dumps(error))
@@ -147,7 +154,7 @@ class WorkerRuntime:
         try:
             await self.client.pool.get(tuple(owner_addr)).oneway(
                 "object_ready", object_id=object_id, error=error,
-                task_id=task_id, object_ids=object_ids)
+                task_id=task_id, object_ids=object_ids, **stream_kw)
         except Exception:
             logger.exception("failed to push error to owner")
 
@@ -163,6 +170,7 @@ class WorkerRuntime:
     async def rpc_run_task(self, spec: dict) -> dict:
         from ..exceptions import TaskError
         loop = asyncio.get_running_loop()
+        streaming = spec.get("num_returns") == "streaming"
         try:
             self._apply_tpu_isolation(spec)
             fn = deserialize_code(spec["fn_blob"])
@@ -170,7 +178,11 @@ class WorkerRuntime:
             from ..util.tracing import span
             with span(spec.get("name", "task"), "task::execute",
                       task_id=spec.get("task_id", "")[:16]):
-                if inspect.iscoroutinefunction(fn):
+                if streaming:
+                    # The call itself must not block (generators return
+                    # instantly); iteration happens below, item by item.
+                    result = fn(*args, **kwargs)
+                elif inspect.iscoroutinefunction(fn):
                     result = await fn(*args, **kwargs)
                 else:
                     result = await loop.run_in_executor(
@@ -183,6 +195,8 @@ class WorkerRuntime:
                 task_id=spec["task_id"],
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
             return {"status": "error"}
+        if streaming:
+            return await self._stream_results(spec, result)
         num_returns = spec.get("num_returns", 1)
         if num_returns > 1:
             return_ids = spec["return_ids"]
@@ -205,6 +219,138 @@ class WorkerRuntime:
         else:
             await self._push_result(spec["owner_addr"], spec["return_id"],
                                     result, task_id=spec["task_id"])
+        return {"status": "ok"}
+
+    # ---------------------------------------------------------- streaming
+
+    async def rpc_stream_ack(self, generator_id: str, index: int) -> None:
+        entry = self._stream_acks.get(generator_id)
+        if entry is not None:
+            entry[0] = max(entry[0], index + 1)
+            entry[1].set()
+
+    async def rpc_stream_cancel(self, generator_id: str) -> None:
+        """Consumer abandoned the stream: stop producing and unblock any
+        backpressure wait."""
+        entry = self._stream_acks.get(generator_id)
+        if entry is not None:
+            entry[2] = True
+            entry[1].set()
+
+    async def _stream_results(self, spec: dict, result,
+                              executor=None) -> dict:
+        """Drive a streaming task: push each yielded item to the owner,
+        then an end-of-stream marker. Reference parity:
+        task_manager.h:364 (HandleReportGeneratorItemReturns) +
+        _raylet.pyx execute_streaming_generator.
+
+        Backpressure: with spec['backpressure'] = N, pause whenever more
+        than N pushed items are unconsumed; the owner acks each item its
+        consumer takes (rpc_stream_ack). For actor methods `executor` is
+        the actor's own executor, preserving the sync-actor serial
+        execution guarantee for the generator body.
+        """
+        from ..exceptions import TaskError
+        loop = asyncio.get_running_loop()
+        gen_id = spec["return_id"]
+        owner_addr = spec["owner_addr"]
+        backpressure = spec.get("backpressure")
+        # [acked_count, wake_event, cancelled]
+        self._stream_acks[gen_id] = [0, asyncio.Event(), False]
+        executor = executor or self.task_executor
+        name = spec.get("name", "task")
+
+        def _bad_type_err():
+            return TaskError(
+                name,
+                f'num_returns="streaming" requires the function to '
+                f"return a generator/iterable, got "
+                f"{type(result).__name__}")
+
+        async def wait_capacity(count: int) -> bool:
+            """True = produce the next item; False = consumer cancelled."""
+            entry = self._stream_acks[gen_id]
+            if backpressure:
+                while count - entry[0] >= backpressure and not entry[2]:
+                    entry[1].clear()
+                    await entry[1].wait()
+            return not entry[2]
+
+        async def push_item(count: int, item) -> None:
+            await self._push_result(
+                owner_addr, f"{gen_id}_{count}", item,
+                stream_of=gen_id, stream_index=count,
+                worker_addr=self.client.address)
+
+        async def push_err(count: int, err) -> None:
+            await self._push_error(
+                owner_addr, f"{gen_id}_{count}", err,
+                stream_of=gen_id, stream_index=count,
+                worker_addr=self.client.address)
+
+        def drive_sync() -> int:
+            """Drive a SYNC generator as ONE executor job: iteration,
+            pushes and backpressure waits all happen while holding the
+            executor slot, so a sync actor's streaming method occupies
+            the actor for the stream's whole life (reference semantics —
+            no other method interleaves between yields)."""
+            count = 0
+
+            def run(coro):
+                return asyncio.run_coroutine_threadsafe(coro, loop).result()
+
+            try:
+                it = iter(result)
+            except TypeError:
+                run(push_err(0, _bad_type_err()))
+                return 1
+            while True:
+                if not run(wait_capacity(count)):
+                    if hasattr(result, "close"):
+                        result.close()
+                    return count
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return count
+                except Exception:
+                    run(push_err(count, TaskError(
+                        name, traceback.format_exc())))
+                    return count + 1
+                run(push_item(count, item))
+                count += 1
+
+        async def drive_async() -> int:
+            count = 0
+            while True:
+                if not await wait_capacity(count):
+                    await result.aclose()
+                    return count
+                try:
+                    item = await result.__anext__()
+                except StopAsyncIteration:
+                    return count
+                except Exception:
+                    await push_err(count, TaskError(
+                        name, traceback.format_exc()))
+                    return count + 1
+                await push_item(count, item)
+                count += 1
+
+        count = 0
+        try:
+            if hasattr(result, "__anext__"):
+                count = await drive_async()
+            else:
+                count = await loop.run_in_executor(executor, drive_sync)
+        finally:
+            self._stream_acks.pop(gen_id, None)
+            try:
+                await self.client.pool.get(tuple(owner_addr)).oneway(
+                    "stream_end", generator_id=gen_id, count=count,
+                    task_id=spec["task_id"])
+            except Exception:
+                logger.exception("failed to push stream end")
         return {"status": "ok"}
 
     # ------------------------------------------------------------- actors
@@ -237,7 +383,8 @@ class WorkerRuntime:
 
     async def rpc_call_actor(self, actor_id: str, method: str,
                              args_blob: bytes, caller=None,
-                             seq=None, return_id=None) -> dict:
+                             seq=None, return_id=None, streaming=False,
+                             owner_addr=None, backpressure=None) -> dict:
         actor = self.actors.get(actor_id)
         if actor is None:
             return {"status": "error",
@@ -245,6 +392,25 @@ class WorkerRuntime:
         loop = asyncio.get_running_loop()
         try:
             args, kwargs = await self._resolve_args(args_blob)
+            if streaming:
+                # Call returns a generator immediately; items are pushed to
+                # the caller in a background task so the RPC (and the
+                # actor's admission queue) don't block for the stream's
+                # lifetime.
+                fn = getattr(actor.instance, method)
+                await actor.admit(caller, seq)
+                gen = fn(*args, **kwargs)
+                await actor.admitted(caller, seq)
+                spec = {"return_id": return_id, "owner_addr": owner_addr,
+                        "task_id": None, "backpressure": backpressure,
+                        "name": method}
+                # Drive the generator body on the ACTOR's executor so a
+                # sync actor's serial-execution guarantee holds for
+                # streaming methods too.
+                asyncio.ensure_future(
+                    self._stream_results(spec, gen,
+                                         executor=actor.executor))
+                return {"status": "streaming"}
             if method == "__rtpu_compiled_loop__":
                 # compiled-graph (ADAG) execution loop: a generic driver
                 # bound to this actor instance (ray_tpu/dag/compiled_dag.py).

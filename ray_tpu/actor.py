@@ -15,17 +15,23 @@ from .remote_function import normalize_scheduling, validate_options
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str):
+    def __init__(self, handle: "ActorHandle", name: str,
+                 opts: Optional[Dict[str, Any]] = None):
         self._handle = handle
         self._name = name
+        self._opts = opts or {}
 
     def remote(self, *args, **kwargs):
         client = state.current_client()
         return client.submit_actor_task(
-            self._handle._actor_id, self._name, args, kwargs, {})
+            self._handle._actor_id, self._name, args, kwargs, self._opts)
 
     def options(self, **opts):
-        return self  # per-call options are accepted but unused for now
+        """Per-call options; num_returns="streaming" returns an
+        ObjectRefGenerator of the method's yields."""
+        merged = dict(self._opts)
+        merged.update(validate_options(opts))
+        return ActorMethod(self._handle, self._name, merged)
 
     def bind(self, *args):
         """Build a compiled-graph node from this method (reference:

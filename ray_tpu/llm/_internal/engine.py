@@ -45,6 +45,9 @@ class EngineConfig:
     max_seq_len: Optional[int] = None    # default: model max_seq
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
     seed: int = 0
+    # "auto": Pallas paged-decode kernel on TPU, dense gather elsewhere.
+    # Also accepts "gather" | "pallas" | "pallas_interpret".
+    decode_impl: str = "auto"
 
     def resolve_model(self) -> LlamaConfig:
         return llama.config(self.model)
@@ -78,9 +81,16 @@ class _Slot:
         self.last_token = 0
 
 
-def _sample(logits, key, temps, top_ps):
-    """logits: (B, V) f32; temps/top_ps: (B,). Greedy where temp<=0."""
+def _sample(logits, key, temps, top_ps, all_greedy: bool = False):
+    """logits: (B, V) f32; temps/top_ps: (B,). Greedy where temp<=0.
+
+    all_greedy (static) skips the top-p machinery entirely — the argsort
+    over the vocab is the expensive part of sampling on TPU and pure
+    argmax decoding (the common batch-inference case) never needs it.
+    """
     greedy = jnp.argmax(logits, axis=-1)
+    if all_greedy:
+        return greedy.astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     # top-p: keep the smallest prefix of the sorted probs covering top_p
     sort_idx = jnp.argsort(-scaled, axis=-1)
@@ -107,8 +117,8 @@ class InferenceEngine:
         self.params = jax.device_put(params)
         self.allocator = PageAllocator(ec.num_pages, ec.page_size)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
-        kv_shape = (ec.num_pages, ec.page_size, cfg.n_layers,
-                    cfg.n_kv_heads, cfg.head_dim)
+        kv_shape = (cfg.n_layers, ec.num_pages, cfg.n_kv_heads,
+                    ec.page_size, cfg.head_dim)
         self.k_pages = jnp.zeros(kv_shape, cfg.dtype)
         self.v_pages = jnp.zeros(kv_shape, cfg.dtype)
         self._key = jax.random.PRNGKey(ec.seed + 1)
@@ -120,19 +130,26 @@ class InferenceEngine:
             (ec.max_batch_size, self.max_pages_per_seq), np.int32)
 
         self._decode_fn = jax.jit(
-            self._build_decode(), donate_argnums=(1, 2))
+            self._build_decode(), donate_argnums=(1, 2),
+            static_argnums=(10,))
+        self._d_tokens = None          # device-resident slot state
+        self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
 
     # -- compiled programs --------------------------------------------------
     def _build_decode(self):
         cfg = self.model_cfg
+        impl = self.config.decode_impl
+        if impl == "auto":
+            impl = ("pallas" if jax.devices()[0].platform == "tpu"
+                    else "gather")
 
         def step(params, k_pages, v_pages, tokens, positions, page_tables,
-                 active, key, temps, top_ps):
+                 active, key, temps, top_ps, all_greedy):
             logits, k_pages, v_pages = decode_step(
                 cfg, params, tokens, positions, k_pages, v_pages,
-                page_tables, active)
-            new_tokens = _sample(logits, key, temps, top_ps)
+                page_tables, active, impl=impl)
+            new_tokens = _sample(logits, key, temps, top_ps, all_greedy)
             return new_tokens, k_pages, v_pages
 
         return step
@@ -207,6 +224,7 @@ class InferenceEngine:
 
     # -- internals ----------------------------------------------------------
     def _admit(self, touched: List[Request]) -> None:
+        admitted = False
         for slot in self.slots:
             if not self.waiting:
                 break
@@ -224,6 +242,9 @@ class InferenceEngine:
             table[:len(slot.pages)] = slot.pages
             self._page_tables[slot.index] = table
             self._prefill(slot, touched)
+            admitted = True
+        if admitted:
+            self._refresh_device_state()
 
     def _prefill(self, slot: _Slot, touched: List[Request]) -> None:
         req = slot.request
@@ -243,7 +264,12 @@ class InferenceEngine:
         slot.last_token = tok
         self._append_token(slot, tok, touched)
 
-    def _decode(self, touched: List[Request]) -> None:
+    def _refresh_device_state(self) -> None:
+        """Re-upload slot state after an admit/finish. Between such
+        events the decode loop is device-resident: tokens feed back from
+        the previous step's output and positions advance on device, so a
+        steady-state step costs ONE dispatch + ONE small readback (this
+        matters doubly when the chip sits behind a network tunnel)."""
         B = self.config.max_batch_size
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
@@ -258,20 +284,40 @@ class InferenceEngine:
             active[s.index] = True
             temps[s.index] = s.request.params.temperature
             top_ps[s.index] = s.request.params.top_p
+        self._d_tokens = jnp.asarray(tokens)
+        self._d_positions = jnp.asarray(positions)
+        self._d_active = jnp.asarray(active)
+        self._d_temps = jnp.asarray(temps)
+        self._d_top_ps = jnp.asarray(top_ps)
+        self._d_tables = jnp.asarray(self._page_tables)
+        self._all_greedy = bool(np.all(temps <= 0.0))
+        self._host_active = active
+
+    def _decode(self, touched: List[Request]) -> None:
+        if self._d_tokens is None:
+            self._refresh_device_state()
         self._key, sub = jax.random.split(self._key)
         new_tokens, self.k_pages, self.v_pages = self._decode_fn(
             self.params, self.k_pages, self.v_pages,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(self._page_tables), jnp.asarray(active), sub,
-            jnp.asarray(temps), jnp.asarray(top_ps))
-        new_tokens = np.asarray(new_tokens)
+            self._d_tokens, self._d_positions, self._d_tables,
+            self._d_active, sub, self._d_temps, self._d_top_ps,
+            self._all_greedy)
+        # device-side feedback for the next step
+        self._d_tokens = new_tokens
+        self._d_positions = self._d_positions + self._d_active
+        host_tokens = np.asarray(new_tokens)      # the one readback
+        dirty = False
         for s in self.slots:
-            if s.request is None or not active[s.index]:
+            if s.request is None or not self._host_active[s.index]:
                 continue
             s.position += 1          # the fed token is now cached
-            tok = int(new_tokens[s.index])
+            tok = int(host_tokens[s.index])
             s.last_token = tok
             self._append_token(s, tok, touched)
+            if s.request is None:    # finished this step
+                dirty = True
+        if dirty:
+            self._refresh_device_state()
 
     def _append_token(self, slot: _Slot, tok: int,
                       touched: List[Request]) -> None:

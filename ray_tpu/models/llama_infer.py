@@ -5,7 +5,7 @@ Two entry points, both designed to jit once and stay compiled:
 
 - prefill: full-prompt forward that also emits every layer's K/V and
   scatters them into the shared page pool (ops/paged_attention.py layout:
-  [num_pages, page_size, n_layers, n_kv_heads, head_dim]).
+  [n_layers, num_pages, n_kv_heads, page_size, head_dim]).
 - decode_step: one token per active sequence, paged attention over the
   pool, new KV scattered in-place (donate the pools for true in-place
   HBM updates under jit).
@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention as attention_op
 from ..ops.paged_attention import (gather_kv, paged_attention_on_gathered,
-                                   scatter_kv)
+                                   paged_decode_with_new_token, scatter_kv)
 from .llama import LlamaConfig, rms_norm, rope_frequencies
 
 
@@ -106,7 +106,8 @@ def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 tokens: jax.Array, positions: jax.Array,
                 k_pages: jax.Array, v_pages: jax.Array,
-                page_tables: jax.Array, active: jax.Array
+                page_tables: jax.Array, active: jax.Array,
+                impl: str = "gather"
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole running batch.
 
@@ -114,16 +115,26 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
     absolute position (== number of cached tokens); active: (B,) bool.
     Returns (logits (B, V) f32, k_pages, v_pages) with the new token's KV
     scattered in.
+
+    impl:
+      "gather"            dense XLA fallback — gathers [B, max_ctx] KV up
+                          front; cost scales with max_pages.
+      "pallas"            stream pages through the Pallas decode kernel;
+                          cost scales with each sequence's actual length.
+      "pallas_interpret"  same kernel, interpreter mode (CPU tests).
     """
     b = tokens.shape[0]
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]          # (B, H)
     cos, sin = rope_frequencies(cfg, positions)     # (B, D/2)
 
-    # One gather of the whole context for all layers, layer-major for scan.
-    k_ctx, v_ctx = gather_kv(k_pages, v_pages, page_tables)
-    k_ctx = jnp.transpose(k_ctx, (2, 0, 1, 3, 4))   # (L, B, ctx, KVH, D)
-    v_ctx = jnp.transpose(v_ctx, (2, 0, 1, 3, 4))
+    use_kernel = impl in ("pallas", "pallas_interpret")
+    if use_kernel:
+        # Pool is layer-major already: scan slices (pages, KVH, page, D).
+        k_by_layer, v_by_layer = k_pages, v_pages
+    else:
+        # One gather of the whole context for all layers, layer-major.
+        k_by_layer, v_by_layer = gather_kv(k_pages, v_pages, page_tables)
 
     def layer_fn(x, inp):
         layer, k_l, v_l = inp
@@ -136,13 +147,18 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
             b, cfg.n_kv_heads, cfg.head_dim)
         q = _rope_single(q, cos, sin)
         k = _rope_single(k, cos, sin)
-        # context plus the just-computed token (not yet in pages): valid
-        # cached entries are [0, positions), and the appended tail slot
-        # is always attendable (append_len=1)
-        k_full = jnp.concatenate([k_l, k[:, None]], axis=1)
-        v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
-        attn = paged_attention_on_gathered(
-            q, k_full, v_full, positions, append_len=1)
+        # The just-computed token's KV is not yet in the pages: the
+        # kernel path merges it with one extra online-softmax step, the
+        # gather path appends it to the dense context (append_len=1).
+        if use_kernel:
+            attn = paged_decode_with_new_token(
+                q, k_l, v_l, page_tables, positions, k, v,
+                interpret=(impl == "pallas_interpret"))
+        else:
+            k_full = jnp.concatenate([k_l, k[:, None]], axis=1)
+            v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
+            attn = paged_attention_on_gathered(
+                q, k_full, v_full, positions, append_len=1)
         x = x + attn.reshape(b, cfg.q_dim) @ layer["wo"].astype(dt)
         y = rms_norm(x, layer["ln2"], cfg.norm_eps)
         gate = jax.nn.silu(y @ layer["wg"].astype(dt))
@@ -151,7 +167,7 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_ctx, v_ctx))
+        layer_fn, x, (params["layers"], k_by_layer, v_by_layer))
     k_rows = jnp.transpose(ks, (1, 0, 2, 3))        # (B, L, KVH, D)
     v_rows = jnp.transpose(vs, (1, 0, 2, 3))
     k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,

@@ -2,14 +2,17 @@
 
 Net-new for the TPU build (the reference delegates paged attention to
 external vLLM CUDA kernels; SURVEY.md §7 step 10). Layout decision
-(TPU-first): one page pool shared by ALL layers —
+(TPU-first): one page pool shared by ALL layers, layer-major + head-major —
 
-    k_pages, v_pages: [num_pages, page_size, n_layers, n_kv_heads, head_dim]
+    k_pages, v_pages: [n_layers, num_pages, n_kv_heads, page_size, head_dim]
 
-so a decode token's KV for every layer lands in ONE scatter at
-(page, offset), and the per-step gather of a sequence's context is one
-take along the page axis (XLA turns both into efficient dynamic-slice
-loops over HBM; no per-layer page tables needed).
+chosen for the two hot paths at once: the decode scan over layers slices
+dim 0 (no per-step transpose of the pool), and the Pallas kernel's page
+block [1, 1, page_size, head_dim] keeps the last two dims at
+(page_size, head_dim) — the TPU lowering requires last-two block dims
+divisible by (8, 128) or full, and page_size=16/head_dim=128 satisfy it
+natively. A decode token's KV for every layer still lands in ONE scatter
+at (page, offset).
 
 Two decode paths:
 - XLA fallback: gather pages into dense [B, ctx] KV then masked attention
@@ -36,26 +39,30 @@ from jax.experimental.pallas import tpu as pltpu
 def gather_kv(k_pages: jax.Array, v_pages: jax.Array,
               page_tables: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """page_tables: [B, max_pages] int32 →
-    k/v: [B, max_pages*page_size, n_layers, n_kv_heads, head_dim]."""
+    k/v: [n_layers, B, max_pages*page_size, n_kv_heads, head_dim]
+    (layer-major, ready for a scan over layers)."""
     def one(pages):
-        g = pages[page_tables]            # [B, P, page, L, KVH, D]
-        b, p, s, l, h, d = g.shape
-        return g.reshape(b, p * s, l, h, d)
+        g = pages[:, page_tables]          # [L, B, P, KVH, page, D]
+        l, b, p, h, s, d = g.shape
+        return g.transpose(0, 1, 2, 4, 3, 5).reshape(l, b, p * s, h, d)
     return one(k_pages), one(v_pages)
 
 
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_tables: jax.Array, seq_lens: jax.Array,
                     layer: int) -> jax.Array:
-    """Single-layer decode attention.
+    """Single-layer decode attention (dense-gather path).
 
     q: [B, n_heads, head_dim] (one new token per sequence)
     seq_lens: [B] number of valid cached tokens (including the new one)
     Returns [B, n_heads, head_dim].
     """
-    k, v = gather_kv(k_pages, v_pages, page_tables)
-    return paged_attention_on_gathered(
-        q, k[:, :, layer], v[:, :, layer], seq_lens)
+    g_k = k_pages[layer][page_tables]      # [B, P, KVH, page, D]
+    g_v = v_pages[layer][page_tables]
+    b, p, h, s, d = g_k.shape
+    k = g_k.transpose(0, 1, 3, 2, 4).reshape(b, p * s, h, d)
+    v = g_v.transpose(0, 1, 3, 2, 4).reshape(b, p * s, h, d)
+    return paged_attention_on_gathered(q, k, v, seq_lens)
 
 
 def paged_attention_on_gathered(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -86,11 +93,14 @@ def paged_attention_on_gathered(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, page_size: int,
-                         scale: float):
+                         m_ref, l_ref, m_scr, l_scr, acc_scr, *,
+                         page_size: int, scale: float, kvh: int):
+    """Grid (B, max_pages): each step consumes one page for ALL kv heads
+    (the per-head loop is unrolled — kvh is small and static), keeping the
+    grid shallow so dispatch overhead doesn't dominate decode."""
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    n_pages = pl.num_programs(2)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
@@ -103,40 +113,53 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)            # (group, D)
-        k = k_ref[0, :, 0].astype(jnp.float32)         # (page, D)
-        v = v_ref[0, :, 0].astype(jnp.float32)         # (page, D)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale    # (group, page)
         pos = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(pos < seq_len, s, -1e30)
-        m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
+            jnp.int32, (1, page_size), 1)
+        valid = pos < seq_len                          # (1, page)
+        group = q_ref.shape[2]
+        for h in range(kvh):
+            q = q_ref[0, h].astype(jnp.float32)        # (group, D)
+            k = k_ref[0, h].astype(jnp.float32)        # (page, D)
+            v = v_ref[0, h].astype(jnp.float32)        # (page, D)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (group, page)
+            s = jnp.where(valid, s, -1e30)
+            rows = slice(h * group, (h + 1) * group)
+            m_prev = m_scr[rows]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[rows] = (l_scr[rows] * corr
+                           + jnp.sum(p, axis=1, keepdims=True))
+            acc_scr[rows] = acc_scr[rows] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[rows] = m_new
 
     @pl.when(j == n_pages - 1)
     def _finish():
-        o_ref[0, 0] = (acc_scr[:]
-                       / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        group = q_ref.shape[2]
+        safe_l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / safe_l).reshape(
+            kvh, group, -1).astype(o_ref.dtype)
+        m_ref[0] = m_scr[:].reshape(kvh, group, 1)
+        l_ref[0] = l_scr[:].reshape(kvh, group, 1)
 
 
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_tables: jax.Array,
                            seq_lens: jax.Array, *,
-                           interpret: bool = False) -> jax.Array:
+                           return_stats: bool = False,
+                           interpret: bool = False):
     """Pallas paged decode attention for one layer.
 
-    q: [B, H, D]; k_pages/v_pages: [num_pages, page_size, KVH, D]
+    q: [B, H, D]; k_pages/v_pages: [num_pages, KVH, page_size, D]
     (already sliced to the layer); page_tables: [B, max_pages] int32;
-    seq_lens: [B] int32. Returns [B, H, D].
+    seq_lens: [B] int32. Returns [B, H, D], or with return_stats=True
+    (out, m, l) where m/l are the [B, H] online-softmax row max /
+    denominator — callers merge extra not-yet-paged KV (the token being
+    decoded) with one more online-softmax step.
 
     The page-table BlockSpec index map clamps the page index for grid
     steps past a sequence's last page to the sequence's final page:
@@ -145,43 +168,84 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     ceil(seq_len / page_size), not max_pages.
     """
     b, h, d = q.shape
-    _, page_size, kvh, _ = k_pages.shape
+    _, kvh, page_size, _ = k_pages.shape
     max_pages = page_tables.shape[1]
     group = h // kvh
     scale = d ** -0.5
     qg = q.reshape(b, kvh, group, d)
 
-    def page_index(bi, hi, j, tables, lens):
+    def page_index(bi, j, tables, lens):
         last = jnp.maximum((lens[bi] - 1) // page_size, 0)
-        return (tables[bi, jnp.minimum(j, last)], 0, hi, 0)
+        return (tables[bi, jnp.minimum(j, last)], 0, 0, 0)
 
-    grid = (b, kvh, max_pages)
-    out = pl.pallas_call(
+    grid = (b, max_pages)
+    out_spec = pl.BlockSpec(
+        (1, kvh, group, d), lambda bi, j, tables, lens: (bi, 0, 0, 0))
+    stat_spec = pl.BlockSpec(
+        (1, kvh, group, 1), lambda bi, j, tables, lens: (bi, 0, 0, 0))
+    out, m, l = pl.pallas_call(
         functools.partial(_paged_decode_kernel, page_size=page_size,
-                          scale=scale),
+                          scale=scale, kvh=kvh),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, group, d),
-                             lambda bi, hi, j, tables, lens: (bi, hi, 0, 0)),
-                pl.BlockSpec((1, page_size, 1, d), page_index),
-                pl.BlockSpec((1, page_size, 1, d), page_index),
+                pl.BlockSpec((1, kvh, group, d),
+                             lambda bi, j, tables, lens: (bi, 0, 0, 0)),
+                pl.BlockSpec((1, kvh, page_size, d), page_index),
+                pl.BlockSpec((1, kvh, page_size, d), page_index),
             ],
-            out_specs=pl.BlockSpec(
-                (1, 1, group, d),
-                lambda bi, hi, j, tables, lens: (bi, hi, 0, 0)),
+            out_specs=(out_spec, stat_spec, stat_spec),
             scratch_shapes=[
-                pltpu.VMEM((group, 1), jnp.float32),
-                pltpu.VMEM((group, 1), jnp.float32),
-                pltpu.VMEM((group, d), jnp.float32),
+                pltpu.VMEM((kvh * group, 1), jnp.float32),
+                pltpu.VMEM((kvh * group, 1), jnp.float32),
+                pltpu.VMEM((kvh * group, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+            jax.ShapeDtypeStruct((b, kvh, group, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, group, 1), jnp.float32),
+        ),
         interpret=interpret,
     )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       qg, k_pages, v_pages)
-    return out.reshape(b, h, d)
+    out = out.reshape(b, h, d)
+    if return_stats:
+        return out, m.reshape(b, h), l.reshape(b, h)
+    return out
+
+
+def paged_decode_with_new_token(q: jax.Array, k_pages: jax.Array,
+                                v_pages: jax.Array, page_tables: jax.Array,
+                                seq_lens: jax.Array, k_new: jax.Array,
+                                v_new: jax.Array, *,
+                                interpret: bool = False) -> jax.Array:
+    """Kernel decode over cached pages + one online-softmax merge step for
+    the current token's KV (not yet scattered into the pool).
+
+    q/k_new/v_new: [B, H, D] / [B, KVH, D] / [B, KVH, D];
+    seq_lens counts CACHED tokens only. Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    kvh = k_new.shape[1]
+    group = h // kvh
+    scale = d ** -0.5
+    out, m, l = paged_decode_attention(
+        q, k_pages, v_pages, page_tables, seq_lens,
+        return_stats=True, interpret=interpret)
+    # score of the new token against itself (always attendable)
+    qf = q.reshape(b, kvh, group, d).astype(jnp.float32)
+    kf = k_new.astype(jnp.float32)
+    s_new = jnp.einsum("bkgd,bkd->bkg", qf, kf).reshape(b, h) * scale
+    m_tot = jnp.maximum(m, s_new)
+    c_old = jnp.exp(m - m_tot)
+    c_new = jnp.exp(s_new - m_tot)
+    l_tot = l * c_old + c_new
+    vf = jnp.repeat(v_new.astype(jnp.float32), group, axis=1)  # [B, H, D]
+    num = (out.astype(jnp.float32) * (l * c_old)[..., None]
+           + vf * c_new[..., None])
+    return (num / jnp.maximum(l_tot, 1e-30)[..., None]).astype(q.dtype)
 
 
 def scatter_kv(k_pages: jax.Array, v_pages: jax.Array,
@@ -196,12 +260,15 @@ def scatter_kv(k_pages: jax.Array, v_pages: jax.Array,
     bool — invalid rows write to a scratch page (the last page, which the
     allocator never hands out) instead of branching.
     """
-    page_size = k_pages.shape[1]
-    scratch = k_pages.shape[0] - 1
+    page_size = k_pages.shape[3]
+    scratch = k_pages.shape[1] - 1
     page_idx = jnp.take_along_axis(
         page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
     page_idx = jnp.where(valid, page_idx, scratch)
     offset = positions % page_size
-    k_pages = k_pages.at[page_idx, offset].set(k_new)
-    v_pages = v_pages.at[page_idx, offset].set(v_new)
+    # Advanced indices (page_idx at dim 1, offset at dim 3) are separated
+    # by slices, so numpy semantics put the advanced axis FIRST: the
+    # updated view is [N, L, KVH, D] — exactly k_new's layout.
+    k_pages = k_pages.at[:, page_idx, :, offset].set(k_new)
+    v_pages = v_pages.at[:, page_idx, :, offset].set(v_new)
     return k_pages, v_pages

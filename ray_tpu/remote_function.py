@@ -16,6 +16,7 @@ _VALID_OPTS = {
     "max_retries", "num_returns", "scheduling_strategy", "runtime_env",
     "max_concurrency", "max_restarts", "lifetime", "namespace",
     "placement_group", "placement_group_bundle_index",
+    "_generator_backpressure_num_objects",
 }
 
 

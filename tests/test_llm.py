@@ -32,8 +32,8 @@ def test_paged_attention_matches_dense():
     rng = np.random.default_rng(0)
     B, CTX, L, KVH, H, D = 2, 24, 3, 2, 4, 16
     num_pages, page = 16, 8
-    k_pages = jnp.zeros((num_pages, page, L, KVH, D))
-    v_pages = jnp.zeros((num_pages, page, L, KVH, D))
+    k_pages = jnp.zeros((L, num_pages, KVH, page, D))
+    v_pages = jnp.zeros((L, num_pages, KVH, page, D))
     # seq 0 gets pages [0,1,2], seq 1 gets [3,4,5]
     tables = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
     lens = np.array([20, 13])
@@ -47,12 +47,11 @@ def test_paged_attention_matches_dense():
         k_pages, v_pages = scatter_kv(
             k_pages, v_pages, rows_k, rows_v, t, pos,
             jnp.ones(lens[b], bool))
-    gk, gv = gather_kv(k_pages, v_pages, tables)
+    gk, gv = gather_kv(k_pages, v_pages, tables)   # [L, B, ctx, KVH, D]
     q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
     for layer in range(L):
         out = paged_attention_on_gathered(
-            q, gk[:, :, layer], gv[:, :, layer],
-            jnp.asarray(lens, jnp.int32))
+            q, gk[layer], gv[layer], jnp.asarray(lens, jnp.int32))
         # dense reference with GQA repeat
         for b in range(B):
             kk = np.repeat(kd[b, :lens[b], layer], H // KVH, axis=1)
@@ -68,14 +67,14 @@ def test_paged_attention_matches_dense():
 
 def test_scatter_masks_invalid_rows_to_scratch():
     from ray_tpu.ops.paged_attention import scatter_kv
-    k_pages = jnp.zeros((4, 2, 1, 1, 2))
-    v_pages = jnp.zeros((4, 2, 1, 1, 2))
+    k_pages = jnp.zeros((1, 4, 1, 2, 2))           # [L, pages, KVH, page, D]
+    v_pages = jnp.zeros((1, 4, 1, 2, 2))
     rows = jnp.ones((1, 1, 1, 2))
     t = jnp.asarray([[0, 1]], jnp.int32)
     k2, v2 = scatter_kv(k_pages, v_pages, rows, rows, t,
                         jnp.asarray([0]), jnp.asarray([False]))
-    assert float(jnp.abs(k2[:3]).sum()) == 0.0     # real pages untouched
-    assert float(jnp.abs(k2[3]).sum()) > 0.0       # scratch page took it
+    assert float(jnp.abs(k2[:, :3]).sum()) == 0.0  # real pages untouched
+    assert float(jnp.abs(k2[:, 3]).sum()) > 0.0    # scratch page took it
 
 
 # ---------------------------------------------------------------- engine

@@ -1,0 +1,117 @@
+"""Runtime env system: env applied at worker spawn, env-keyed worker
+reuse. Reference parity: python/ray/_private/runtime_env/plugin.py:24,118
++ src/ray/raylet/worker_pool.h:224 (env-keyed idle pools)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+def test_env_vars_applied(ray_start):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_TEST_FLAG": "hello42"}})
+    def read_env():
+        import os
+        return os.environ.get("MY_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote()) == "hello42"
+
+
+def test_env_isolation_between_envs(ray_start):
+    """Tasks without the env never see its variables (distinct workers)."""
+    @ray_tpu.remote(runtime_env={"env_vars": {"ISOLATED_VAR": "yes"}})
+    def with_env():
+        import os
+        return os.environ.get("ISOLATED_VAR"), os.getpid()
+
+    @ray_tpu.remote
+    def without_env():
+        import os
+        return os.environ.get("ISOLATED_VAR"), os.getpid()
+
+    v1, pid1 = ray_tpu.get(with_env.remote())
+    v2, pid2 = ray_tpu.get(without_env.remote())
+    assert v1 == "yes" and v2 is None
+    assert pid1 != pid2
+
+
+def test_env_keyed_worker_reuse(ray_start):
+    """Same runtime env -> same worker reused; different env -> new one."""
+    env_a = {"env_vars": {"POOL_TAG": "a"}}
+
+    @ray_tpu.remote(runtime_env=env_a)
+    def pid_a():
+        import os
+        return os.getpid()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"POOL_TAG": "b"}})
+    def pid_b():
+        import os
+        return os.getpid()
+
+    a1 = ray_tpu.get(pid_a.remote())
+    a2 = ray_tpu.get(pid_a.remote())
+    b1 = ray_tpu.get(pid_b.remote())
+    assert a1 == a2            # env-keyed reuse
+    assert b1 != a1            # env mismatch -> different worker
+
+
+def test_py_modules_module_driver_lacks(ray_start, tmp_path):
+    """A task imports a module that does NOT exist on the driver's path —
+    delivered via runtime_env py_modules."""
+    mod_dir = tmp_path / "exotic_pkg"
+    mod_dir.mkdir()
+    (mod_dir / "__init__.py").write_text(
+        textwrap.dedent("""
+        SECRET = "from-runtime-env"
+        def double(x):
+            return 2 * x
+        """))
+
+    with pytest.raises(ImportError):
+        import exotic_pkg  # noqa: F401
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_it():
+        import exotic_pkg
+        return exotic_pkg.SECRET, exotic_pkg.double(21)
+
+    secret, doubled = ray_tpu.get(use_it.remote())
+    assert secret == "from-runtime-env" and doubled == 42
+
+
+def test_working_dir(ray_start, tmp_path):
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_file():
+        import os
+        with open("data.txt") as f:
+            return os.path.basename(os.getcwd()), f.read()
+
+    base, content = ray_tpu.get(read_file.remote())
+    assert content == "payload"
+    assert base == os.path.basename(str(tmp_path))
+
+
+def test_actor_runtime_env(ray_start):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "actorval"}})
+    class EnvActor:
+        def read(self):
+            import os
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote()) == "actorval"
+
+
+def test_bad_runtime_env_fails_task(ray_start):
+    @ray_tpu.remote(runtime_env={"working_dir": "/nonexistent/dir/xyz"})
+    def never_runs():
+        return 1
+
+    with pytest.raises(Exception, match="working_dir|spawn"):
+        ray_tpu.get(never_runs.remote(), timeout=60)

@@ -1,0 +1,12 @@
+"""Local-mode streaming generator parity."""
+
+import ray_tpu
+
+
+def test_local_mode_streaming(ray_local):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    assert [ray_tpu.get(r) for r in gen.remote(3)] == [0, 1, 2]
