@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -92,8 +93,21 @@ class ActorEntry:
 
 
 class Controller:
-    def __init__(self, session_name: str):
+    def __init__(self, session_name: str, persist_path: Optional[str] = None):
         self.session_name = session_name
+        # Durable control plane (reference parity: gcs_table_storage.h:213,
+        # redis_store_client.h:111): actors, named actors, KV, and PG
+        # definitions are written through to SQLite so a controller
+        # restart resumes with live actor addresses and named lookups
+        # intact. Disable with persist_path="" for throwaway controllers.
+        if persist_path is None:
+            persist_path = os.environ.get(
+                "RAY_TPU_GCS_PERSIST",
+                f"/tmp/ray_tpu/{session_name}/gcs.db")
+        self.store = None
+        if persist_path:
+            from .gcs_store import GcsStore
+            self.store = GcsStore(persist_path)
         self.server = RpcServer()
         self.server.register_object(self)
         self.pool = ClientPool()
@@ -119,6 +133,7 @@ class Controller:
         self._closed = False
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._restore_state()
         self.address = await self.server.start(host, port)
         self._sched_task = asyncio.ensure_future(self._schedule_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
@@ -132,6 +147,84 @@ class Controller:
             self._health_task.cancel()
         await self.server.stop()
         await self.pool.close_all()
+        if self.store is not None:
+            self.store.close()
+
+    # -------------------------------------------------------- persistence
+
+    def _persist_actor(self, entry: "ActorEntry",
+                       with_spec: bool = False) -> None:
+        """Write-through actor state. The creation spec (which embeds the
+        pickled class + args, potentially MBs) is immutable — it is
+        written ONCE (with_spec=True at registration) into its own row so
+        state transitions only re-serialize the small mutable fields."""
+        if self.store is None:
+            return
+        if with_spec:
+            self.store.put("actor_specs", entry.actor_id,
+                           entry.creation_spec)
+        self.store.put("actors", entry.actor_id, {
+            "actor_id": entry.actor_id, "name": entry.name,
+            "namespace": entry.namespace, "state": entry.state,
+            "addr": entry.addr, "node_id": entry.node_id,
+            "worker_id": entry.worker_id,
+            "max_restarts": entry.max_restarts, "restarts": entry.restarts,
+            "death_cause": entry.death_cause, "lifetime": entry.lifetime,
+        })
+
+    def _restore_state(self) -> None:
+        """Reload durable tables after a restart. ALIVE actors keep their
+        addresses (their worker processes are still up); PENDING/RESTARTING
+        actors re-enter the scheduling queue; nodes re-register themselves
+        via the heartbeat path (rpc_heartbeat -> 'unknown' -> daemon
+        re-registers)."""
+        if self.store is None:
+            return
+        specs = dict(self.store.items("actor_specs"))
+        for _, data in self.store.items("actors"):
+            entry = ActorEntry(data["actor_id"],
+                               specs.get(data["actor_id"], {}))
+            entry.name = data["name"]
+            entry.namespace = data["namespace"]
+            entry.state = data["state"]
+            entry.addr = tuple(data["addr"]) if data["addr"] else None
+            entry.node_id = data["node_id"]
+            entry.worker_id = data["worker_id"]
+            entry.max_restarts = data["max_restarts"]
+            entry.restarts = data["restarts"]
+            entry.death_cause = data["death_cause"]
+            entry.lifetime = data["lifetime"]
+            self.actors[entry.actor_id] = entry
+            if entry.name and entry.state != "DEAD":
+                self.named_actors[(entry.namespace, entry.name)] = \
+                    entry.actor_id
+            if entry.state in ("PENDING", "RESTARTING"):
+                spec = dict(entry.creation_spec)
+                spec["is_restart"] = entry.state == "RESTARTING"
+                self.pending.append(spec)
+        for key, actor_id in self.store.items("named_actors"):
+            ns, _, name = key.partition("\x00")
+            self.named_actors[(ns, name)] = actor_id
+        for key, value in self.store.items("kv"):
+            self.kv[key] = value
+        for pg_id, data in self.store.items("placement_groups"):
+            from .placement import PlacementGroupEntry
+            pg = PlacementGroupEntry(pg_id, data["bundles"],
+                                     data["strategy"], data["name"])
+            self.placement_groups[pg_id] = pg
+            self.pending_pgs.append(pg)   # re-place on live nodes
+        if self.pending or self.pending_pgs:
+            self._sched_event.set()
+
+    def _persist_named(self, namespace: str, name: str,
+                       actor_id: Optional[str]) -> None:
+        if self.store is None:
+            return
+        key = f"{namespace}\x00{name}"
+        if actor_id is None:
+            self.store.delete("named_actors", key)
+        else:
+            self.store.put("named_actors", key, actor_id)
 
     # ------------------------------------------------------------- nodes
 
@@ -149,7 +242,14 @@ class Controller:
         logger.info("node %s registered at %s with %s",
                     node_id[:8], addr, resources)
         self._sched_event.set()
-        return {"session_name": self.session_name}
+        # Actors this (possibly restarted) controller believes live on the
+        # node: the daemon compares against what it actually hosts and
+        # reports the dead ones — actors that died while the controller
+        # was down must not stay ALIVE forever.
+        expected = [a.actor_id for a in self.actors.values()
+                    if a.node_id == node_id and a.state == "ALIVE"]
+        return {"session_name": self.session_name,
+                "expected_actors": expected}
 
     async def rpc_unregister_node(self, node_id: str) -> None:
         node = self.nodes.get(node_id)
@@ -157,10 +257,14 @@ class Controller:
             node.alive = False
             await self._on_node_death(node_id)
 
-    async def rpc_heartbeat(self, node_id: str, num_workers: int = 0) -> None:
+    async def rpc_heartbeat(self, node_id: str, num_workers: int = 0) -> dict:
         node = self.nodes.get(node_id)
         if node:
             node.last_heartbeat = time.monotonic()
+            return {"status": "ok"}
+        # A restarted controller doesn't know this node yet: tell the
+        # daemon to re-register (controller-restart recovery path).
+        return {"status": "unknown"}
 
     async def _on_node_death(self, node_id: str) -> None:
         # Placement groups with a bundle on the dead node become FAILED:
@@ -256,6 +360,7 @@ class Controller:
                     f"in namespace {key[0]!r}"))
                 return {"status": "rejected"}
             self.named_actors[key] = spec["actor_id"]
+            self._persist_named(key[0], key[1], spec["actor_id"])
         self._task_event(spec["task_id"], "PENDING_SCHEDULING", spec=spec)
         self.pending.append(spec)
         self._sched_event.set()
@@ -475,10 +580,12 @@ class Controller:
         # Name uniqueness was checked/claimed at submission (rpc_submit_task).
         actor_id = spec["actor_id"]
         entry = self.actors.get(actor_id)
-        if entry is None:
+        created = entry is None
+        if created:
             entry = ActorEntry(actor_id, spec)
             self.actors[actor_id] = entry
         entry.node_id = node_id
+        self._persist_actor(entry, with_spec=created)
 
     async def rpc_actor_started(self, actor_id: str, addr,
                                 worker_id: str) -> None:
@@ -488,6 +595,7 @@ class Controller:
         entry.addr = tuple(addr)
         entry.worker_id = worker_id
         entry.state = "ALIVE"
+        self._persist_actor(entry)
         for ev in entry.waiters:
             ev.set()
         entry.waiters.clear()
@@ -511,6 +619,7 @@ class Controller:
             entry.restarts += 1
             entry.state = "RESTARTING"
             entry.addr = None
+            self._persist_actor(entry)
             logger.info("restarting actor %s (%d/%d): %s", actor_id[:8],
                         entry.restarts, entry.max_restarts, reason)
             spec = dict(entry.creation_spec)
@@ -520,11 +629,13 @@ class Controller:
         else:
             entry.state = "DEAD"
             entry.death_cause = reason
+            self._persist_actor(entry)
             for ev in entry.waiters:
                 ev.set()
             entry.waiters.clear()
             if entry.name:
                 self.named_actors.pop((entry.namespace, entry.name), None)
+                self._persist_named(entry.namespace, entry.name, None)
             if entry.addr is None:
                 # Never came up: resolve the owner's creation ref with the
                 # death cause so nothing blocks on it.
@@ -603,6 +714,10 @@ class Controller:
         pg = PlacementGroupEntry(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = pg
         self.pending_pgs.append(pg)
+        if self.store is not None:
+            self.store.put("placement_groups", pg_id,
+                           {"bundles": bundles, "strategy": strategy,
+                            "name": name})
         self._sched_event.set()
         return {"placement_group_id": pg_id}
 
@@ -646,6 +761,8 @@ class Controller:
         # (e.g. Tune sweeps) don't grow the table without bound. pop():
         # concurrent removals may race past the None check above.
         self.placement_groups.pop(pg_id, None)
+        if self.store is not None:
+            self.store.delete("placement_groups", pg_id)
         self._sched_event.set()
         return True
 
@@ -660,13 +777,18 @@ class Controller:
         if not overwrite and key in self.kv:
             return False
         self.kv[key] = value
+        if self.store is not None:
+            self.store.put("kv", key, value)
         return True
 
     async def rpc_kv_get(self, key: str) -> Optional[bytes]:
         return self.kv.get(key)
 
     async def rpc_kv_del(self, key: str) -> bool:
-        return self.kv.pop(key, None) is not None
+        existed = self.kv.pop(key, None) is not None
+        if existed and self.store is not None:
+            self.store.delete("kv", key)
+        return existed
 
     async def rpc_kv_keys(self, prefix: str = "") -> List[str]:
         return [k for k in self.kv if k.startswith(prefix)]

@@ -281,6 +281,18 @@ class CoreClient:
         generator's StreamState.
         """
         if stream_of is not None:
+            stream = self._streams.get(stream_of)
+            if stream is None or stream.cancelled:
+                # Abandoned stream: nothing will ever consume this item.
+                # Drop it immediately and (re)send the producer cancel —
+                # this is also how a producer whose first push raced the
+                # consumer's close() learns to stop.
+                self.memory_store.delete(object_id)
+                if stream is not None:
+                    if worker_addr is not None:
+                        stream.worker_addr = tuple(worker_addr)
+                    await self._send_stream_cancel(stream)
+                return
             if error is not None:
                 err = (error if isinstance(error, Exception)
                        else RayTpuError(str(error)))
@@ -291,9 +303,7 @@ class CoreClient:
                 self.memory_store.put_serialized(
                     object_id, SerializedObject.from_flat(payload))
             self.ref_counter.register_owned(object_id)
-            stream = self._streams.get(stream_of)
-            if stream is not None:
-                stream.put(stream_index, object_id, worker_addr)
+            stream.put(stream_index, object_id, worker_addr)
             return
         pending = self._pending_tasks.pop(task_id, None) if task_id else None
         if error is not None:
@@ -446,6 +456,8 @@ class CoreClient:
         stream = self._streams.get(generator_id)
         if stream is not None:
             stream.finish(count)
+            if stream.cancelled:
+                self._streams.pop(generator_id, None)
         self._unpin_args(pending)
 
     async def rpc_ref_event(self, object_id: str, delta: int) -> None:
@@ -734,23 +746,37 @@ class CoreClient:
     def release_stream(self, generator_id: str, consumed: int) -> None:
         """Drop an abandoned/finished generator: tell the producer to stop
         (it may be blocked on backpressure or producing unboundedly) and
-        free unconsumed item objects this process owns."""
-        stream = self._streams.pop(generator_id, None)
+        free unconsumed item objects this process owns.
+
+        The StreamState stays registered (marked cancelled) until the
+        producer's stream_end arrives: items still in flight are freed on
+        arrival, and a producer whose address we don't know yet (nothing
+        pushed so far) gets the cancel as soon as its first push lands."""
+        stream = self._streams.get(generator_id)
         if stream is None:
             return
+        stream.cancelled = True
+        if stream.total is not None:
+            self._streams.pop(generator_id, None)   # already ended
 
         async def _release():
-            if stream.worker_addr is not None and stream.total is None:
-                try:
-                    await self.pool.get(stream.worker_addr).oneway(
-                        "stream_cancel", generator_id=generator_id)
-                except Exception:
-                    pass
+            await self._send_stream_cancel(stream)
             for idx, oid in stream.items.items():
                 if idx >= consumed:
                     self.memory_store.delete(oid)
 
         self.loop_runner.call_soon(_release())
+
+    async def _send_stream_cancel(self, stream) -> None:
+        if (stream.cancel_sent or stream.worker_addr is None
+                or stream.total is not None):
+            return
+        stream.cancel_sent = True
+        try:
+            await self.pool.get(stream.worker_addr).oneway(
+                "stream_cancel", generator_id=stream.generator_id)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ tasks
 

@@ -319,8 +319,12 @@ class NodeDaemon:
         finally:
             self._spawning[env_key] = self._spawning.get(env_key, 1) - 1
             # waiters taken by actors never release a worker; keep
-            # spawning while a deficit remains
-            self._maybe_spawn(env_key)
+            # spawning while a deficit remains. Re-evaluate EVERY env's
+            # queue, not just ours: another env's waiters may have been
+            # blocked purely by the global spawn cap we just vacated.
+            for key in list(self._worker_waiters):
+                if self._worker_waiters.get(key):
+                    self._maybe_spawn(key)
 
     def _offer_worker(self, handle: WorkerHandle) -> None:
         """Hand an idle worker to the longest-waiting same-env task, else
@@ -521,17 +525,27 @@ class NodeDaemon:
                     "heartbeat", node_id=self.node_id)
                 if (reply or {}).get("status") == "unknown":
                     # Controller restarted and lost volatile node state:
-                    # re-register and re-announce hosted actors so its
-                    # persisted actor table gets fresh addresses.
-                    await controller.call(
+                    # re-register, re-announce hosted actors so its
+                    # persisted actor table gets fresh addresses, and
+                    # report actors it expects here that died during the
+                    # outage (their actor_died was lost).
+                    reg = await controller.call(
                         "register_node", node_id=self.node_id,
                         addr=self.address, resources=self.resources,
                         labels=self.labels)
+                    hosted = set()
                     for h in self.workers.values():
                         if h.state == "actor" and h.actor_id:
+                            hosted.add(h.actor_id)
                             await controller.oneway(
                                 "actor_started", actor_id=h.actor_id,
                                 addr=h.addr, worker_id=h.worker_id)
+                    for aid in (reg or {}).get("expected_actors", []):
+                        if aid not in hosted:
+                            await controller.oneway(
+                                "actor_died", actor_id=aid,
+                                reason="worker died while the controller "
+                                       "was down")
             except Exception:
                 pass
             # arena pressure: spill LRU sealed objects down to the low

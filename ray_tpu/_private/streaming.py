@@ -29,6 +29,8 @@ class StreamState:
         self.worker_addr: Optional[Tuple[str, int]] = None
         self.error: Optional[Exception] = None   # submission-level failure
         self.wants_ack = wants_ack               # backpressure requested
+        self.cancelled = False                   # consumer abandoned
+        self.cancel_sent = False
         self.event = asyncio.Event()
 
     def put(self, index: int, object_id: str,

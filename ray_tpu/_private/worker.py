@@ -90,7 +90,10 @@ def init(address: Optional[str] = None,
         atexit.register(shutdown)
         return _runtime
 
-    session_name = f"s{int(time.time())}_{os.getpid()}"
+    # urandom suffix: back-to-back init/shutdown/init in one process and
+    # second would otherwise reuse the session dir (and now its persisted
+    # gcs.db, resurrecting the previous session's actor table).
+    session_name = f"s{int(time.time())}_{os.getpid()}_{os.urandom(2).hex()}"
     loop_runner = LoopRunner()
 
     node_resources = dict(resources or {})

@@ -400,16 +400,21 @@ class WorkerRuntime:
                 fn = getattr(actor.instance, method)
                 await actor.admit(caller, seq)
                 gen = fn(*args, **kwargs)
-                await actor.admitted(caller, seq)
                 spec = {"return_id": return_id, "owner_addr": owner_addr,
                         "task_id": None, "backpressure": backpressure,
                         "name": method}
                 # Drive the generator body on the ACTOR's executor so a
                 # sync actor's serial-execution guarantee holds for
-                # streaming methods too.
+                # streaming methods too. The sleep(0) lets the stream
+                # task run to its run_in_executor submission BEFORE we
+                # mark this seq admitted (ready-queue order is FIFO) —
+                # otherwise the next call's executor job could be queued
+                # ahead of the generator body.
                 asyncio.ensure_future(
                     self._stream_results(spec, gen,
                                          executor=actor.executor))
+                await asyncio.sleep(0)
+                await actor.admitted(caller, seq)
                 return {"status": "streaming"}
             if method == "__rtpu_compiled_loop__":
                 # compiled-graph (ADAG) execution loop: a generic driver
