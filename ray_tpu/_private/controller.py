@@ -127,12 +127,15 @@ class Controller:
         self.node_timeout_s = 10.0
         self.placement_groups: Dict[str, Any] = {}
         self.pending_pgs: List[Any] = []
+        # With an autoscaler attached, infeasible demand queues (waiting
+        # for scale-up) instead of failing fast.
+        self.autoscaling_enabled = False
         self._sched_event = asyncio.Event()
         self._sched_task: Optional[asyncio.Task] = None
         self._health_task: Optional[asyncio.Task] = None
         self._closed = False
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0):
+    async def start(self, host=None, port: int = 0):
         self._restore_state()
         self.address = await self.server.start(host, port)
         self._sched_task = asyncio.ensure_future(self._schedule_loop())
@@ -212,9 +215,31 @@ class Controller:
             pg = PlacementGroupEntry(pg_id, data["bundles"],
                                      data["strategy"], data["name"])
             self.placement_groups[pg_id] = pg
-            self.pending_pgs.append(pg)   # re-place on live nodes
+            if data.get("state") == "CREATED":
+                # Keep the old placement: bundle reservations are
+                # re-acquired per node as each daemon re-registers
+                # (rpc_register_node) — re-placing would double-book.
+                pg.state = "CREATED"
+                for b, nid in zip(pg.bundles, data.get("assignments", [])):
+                    b.node_id = nid
+            elif data.get("state") in ("FAILED", "REMOVED"):
+                # restored only so status queries answer; no reservations,
+                # never re-placed
+                pg.state = data["state"]
+            else:
+                self.pending_pgs.append(pg)   # re-place on live nodes
         if self.pending or self.pending_pgs:
             self._sched_event.set()
+
+    def _persist_pg(self, pg) -> None:
+        if self.store is None:
+            return
+        self.store.put("placement_groups", pg.pg_id, {
+            "bundles": [dict(b.resources) for b in pg.bundles],
+            "strategy": pg.strategy, "name": pg.name,
+            "state": pg.state,
+            "assignments": [b.node_id for b in pg.bundles],
+        })
 
     def _persist_named(self, namespace: str, name: str,
                        actor_id: Optional[str]) -> None:
@@ -245,9 +270,34 @@ class Controller:
         # Actors this (possibly restarted) controller believes live on the
         # node: the daemon compares against what it actually hosts and
         # reports the dead ones — actors that died while the controller
-        # was down must not stay ALIVE forever.
-        expected = [a.actor_id for a in self.actors.values()
-                    if a.node_id == node_id and a.state == "ALIVE"]
+        # was down must not stay ALIVE forever. Their creation resources
+        # are re-acquired here (the fresh NodeEntry starts fully free) and
+        # the running-table entry rebuilt so a later death releases them.
+        expected = []
+        for a in self.actors.values():
+            if a.node_id == node_id and a.state == "ALIVE":
+                expected.append(a.actor_id)
+                task_id = a.creation_spec.get("task_id")
+                if task_id and task_id not in self.running:
+                    req = dict(a.creation_spec.get("resources") or {})
+                    sched = (a.creation_spec.get("scheduling") or {})
+                    pg = self.placement_groups.get(
+                        sched.get("placement_group") or "")
+                    if pg is None:
+                        node.acquire(req)
+                    elif pg.state == "CREATED" \
+                            and task_id not in pg.task_usage:
+                        # re-acquire bundle-internal usage so restored
+                        # bundles don't look empty and oversubscribe
+                        _, bidx = pg.resolve_bundle(
+                            sched.get("bundle_index", -1), req)
+                        if bidx is not None:
+                            pg.acquire_for_task(task_id, bidx, req)
+                        node.num_running += 1
+                    else:
+                        continue
+                    self.running[task_id] = (node_id, req,
+                                             a.creation_spec)
         return {"session_name": self.session_name,
                 "expected_actors": expected}
 
@@ -259,11 +309,13 @@ class Controller:
 
     async def rpc_heartbeat(self, node_id: str, num_workers: int = 0) -> dict:
         node = self.nodes.get(node_id)
-        if node:
+        if node and node.alive:
             node.last_heartbeat = time.monotonic()
             return {"status": "ok"}
-        # A restarted controller doesn't know this node yet: tell the
-        # daemon to re-register (controller-restart recovery path).
+        # Either a restarted controller doesn't know this node yet, or
+        # the health loop declared it dead during a blip — both ways the
+        # daemon must re-register to rejoin (a dead-marked entry must not
+        # swallow heartbeats forever).
         return {"status": "unknown"}
 
     async def _on_node_death(self, node_id: str) -> None:
@@ -279,6 +331,7 @@ class Controller:
                         if node is not None:
                             node.release(b.resources)
                 pg.fail(f"bundle node {node_id[:8]} died")
+                self._persist_pg(pg)
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state == "ALIVE":
                 await self._handle_actor_death(
@@ -360,7 +413,9 @@ class Controller:
                     f"in namespace {key[0]!r}"))
                 return {"status": "rejected"}
             self.named_actors[key] = spec["actor_id"]
-            self._persist_named(key[0], key[1], spec["actor_id"])
+            # NOT persisted here: the pending queue is volatile, so a
+            # restart before dispatch must drop the claim with the task —
+            # the name is written with the ActorEntry at registration.
         self._task_event(spec["task_id"], "PENDING_SCHEDULING", spec=spec)
         self.pending.append(spec)
         self._sched_event.set()
@@ -402,6 +457,45 @@ class Controller:
         if error is not None:
             ev["error"] = error
 
+    # --------------------------------------------------------- autoscaler
+
+    async def rpc_set_autoscaling(self, enabled: bool) -> None:
+        self.autoscaling_enabled = bool(enabled)
+        if enabled:
+            self._sched_event.set()
+
+    async def rpc_pending_demand(self) -> dict:
+        """Unmet demand + node load, the reconciler's input (reference
+        parity: autoscaler/v2 ResourceDemandScheduler inputs —
+        instance_manager/reconciler.py:53)."""
+        return {
+            # PG-scheduled tasks draw from their group's reservation, not
+            # cluster capacity — counting them would launch nodes they
+            # can never use.
+            "task_demands": [
+                dict(s.get("resources") or {}) for s in self.pending
+                if not (s.get("scheduling") or {}).get("placement_group")],
+            "pg_demands": [{
+                "pg_id": pg.pg_id,
+                "strategy": pg.strategy,
+                "bundles": [dict(b.resources) for b in pg.bundles],
+            } for pg in self.pending_pgs],
+            "nodes": [{
+                "node_id": n.node_id,
+                "alive": n.alive,
+                "num_running": n.num_running,
+                # placed PG bundles pin a node even when quiet: the gang
+                # reservation must survive until the PG is removed
+                "num_pg_bundles": sum(
+                    1 for pg in self.placement_groups.values()
+                    if pg.state == "CREATED"
+                    for b in pg.bundles if b.node_id == n.node_id),
+                "resources_total": dict(n.resources_total),
+                "resources_avail": dict(n.resources_avail),
+                "labels": dict(n.labels),
+            } for n in self.nodes.values()],
+        }
+
     async def rpc_list_tasks(self, filters: dict = None) -> List[dict]:
         events = list(self.task_events.values())
         for key, val in (filters or {}).items():
@@ -420,11 +514,14 @@ class Controller:
         for pg in self.pending_pgs:
             reason = pg.try_place(list(self.nodes.values()))
             if reason is None:
-                pass                      # committed
-            elif reason == "":
+                self._persist_pg(pg)      # committed: record assignments
+            elif reason == "" or self.autoscaling_enabled:
+                if reason:
+                    pg.failure_reason = reason   # surfaced to autoscaler
                 still_pg.append(pg)       # retry when resources free up
             else:
                 pg.fail(reason)
+                self._persist_pg(pg)
         self.pending_pgs = still_pg
 
         still_pending: List[dict] = []
@@ -455,6 +552,8 @@ class Controller:
                                            req)
         if not any(n.feasible(req) for n in candidates):
             if all(not n.feasible(req) for n in self.nodes.values() if n.alive):
+                if self.autoscaling_enabled:
+                    return None     # wait: the autoscaler may add a node
                 await self._fail_task(spec, InfeasibleResourceError(
                     f"no node can ever satisfy {req} "
                     f"(cluster: {await self.rpc_cluster_resources()})"))
@@ -586,12 +685,27 @@ class Controller:
             self.actors[actor_id] = entry
         entry.node_id = node_id
         self._persist_actor(entry, with_spec=created)
+        if created and entry.name:
+            self._persist_named(entry.namespace, entry.name, actor_id)
 
     async def rpc_actor_started(self, actor_id: str, addr,
-                                worker_id: str) -> None:
+                                worker_id: str) -> dict:
         entry = self.actors.get(actor_id)
         if entry is None or entry.state == "DEAD":
-            return  # never resurrect a DEAD actor (e.g. killed mid-restart)
+            # never resurrect a DEAD actor (e.g. killed mid-restart)
+            return {"status": "superseded"}
+        if entry.state == "RESTARTING" and worker_id == entry.worker_id:
+            # A pre-death incarnation re-announcing itself (e.g. its node
+            # rejoined after a blip) while a restart is already queued:
+            # accepting it would run two live instances. The daemon kills
+            # the stale worker on this reply.
+            return {"status": "superseded"}
+        if entry.state == "ALIVE" and entry.worker_id is not None \
+                and worker_id != entry.worker_id:
+            # Same race, later: the replacement is already ALIVE when the
+            # stale incarnation re-announces — it must not hijack the
+            # directory entry.
+            return {"status": "superseded"}
         entry.addr = tuple(addr)
         entry.worker_id = worker_id
         entry.state = "ALIVE"
@@ -599,6 +713,7 @@ class Controller:
         for ev in entry.waiters:
             ev.set()
         entry.waiters.clear()
+        return {"status": "ok"}
 
     async def rpc_actor_creation_failed(self, actor_id: str,
                                         reason: str) -> None:
@@ -714,10 +829,7 @@ class Controller:
         pg = PlacementGroupEntry(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = pg
         self.pending_pgs.append(pg)
-        if self.store is not None:
-            self.store.put("placement_groups", pg_id,
-                           {"bundles": bundles, "strategy": strategy,
-                            "name": name})
+        self._persist_pg(pg)
         self._sched_event.set()
         return {"placement_group_id": pg_id}
 

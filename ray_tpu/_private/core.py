@@ -806,6 +806,9 @@ class CoreClient:
             "scheduling": opts.get("scheduling_strategy"),
             "is_actor_creation": False,
             "runtime_env": opts.get("runtime_env"),
+            # surfaced so the daemon's OOM kill policy can prefer
+            # retriable victims (worker_killing_policy.h:39)
+            "max_retries": opts.get("max_retries", 0),
         }
         if streaming:
             bp = opts.get("_generator_backpressure_num_objects")

@@ -29,6 +29,41 @@ from .protocol import ClientPool, RpcServer
 logger = logging.getLogger(__name__)
 
 
+def system_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) from /proc/meminfo — the reference's
+    memory_monitor.h reads the same counters (MemAvailable-based, so page
+    cache doesn't count as pressure)."""
+    total = avail = 0
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1]) * 1024
+            if total and avail:
+                break
+    return total - avail, total
+
+
+def pick_worker_to_kill(workers) -> Optional["WorkerHandle"]:
+    """Worker-killing policy under memory pressure (reference parity:
+    src/ray/raylet/worker_killing_policy.h:39 GroupByOwner /
+    retriable-first): prefer killing retriable work, newest first, and
+    only fall back to actors (whose state dies with them) when no plain
+    task worker exists."""
+    tasks = [w for w in workers
+             if w.state == "busy" and w.current_task is not None]
+    if tasks:
+        retriable = [w for w in tasks
+                     if (w.current_task.get("max_retries") or 0) > 0]
+        pool = retriable or tasks      # retriable victims are cheap: they rerun
+        return max(pool, key=lambda w: w.spawn_time)     # newest first
+    actors = [w for w in workers if w.state == "actor"]
+    if actors:
+        return max(actors, key=lambda w: w.spawn_time)
+    return None
+
+
 def runtime_env_key(runtime_env: Optional[dict]) -> str:
     """Stable identity of a runtime env for worker reuse. Workers are only
     shared between tasks with the SAME key (reference parity:
@@ -41,7 +76,7 @@ def runtime_env_key(runtime_env: Optional[dict]) -> str:
 
 class WorkerHandle:
     __slots__ = ("worker_id", "addr", "pid", "proc", "state", "current_task",
-                 "actor_id", "spawn_time", "env_key")
+                 "actor_id", "spawn_time", "env_key", "oom_reason")
 
     def __init__(self, worker_id: str, proc: subprocess.Popen,
                  env_key: str = ""):
@@ -54,6 +89,10 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.spawn_time = time.monotonic()
         self.env_key = env_key
+        # set when the memory monitor kills this worker: the normal
+        # crash-report path then reports OutOfMemoryError ONCE instead of
+        # a second generic crash
+        self.oom_reason: Optional[str] = None
 
 
 class NodeDaemon:
@@ -100,10 +139,17 @@ class NodeDaemon:
         self._free_tpu_chips: List[int] = list(
             range(int(self.resources.get("TPU", 0))))
         self._task_tpu_chips: Dict[str, List[int]] = {}
+        # Memory monitor (reference parity: memory_monitor.h:52): kill a
+        # worker when node memory passes the threshold. usage fn is
+        # injectable for tests. Threshold <= 0 disables.
+        self.memory_usage_fn = system_memory_usage
+        self.memory_threshold = float(os.environ.get(
+            "RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95))
+        self.oom_kills = 0
 
     # ------------------------------------------------------------ lifecycle
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0):
+    async def start(self, host=None, port: int = 0):
         os.makedirs(os.path.join(self.temp_dir, "logs"), exist_ok=True)
         self.address = await self.server.start(host, port)
         await self.pool.get(self.controller_addr).call(
@@ -395,6 +441,12 @@ class NodeDaemon:
                     "create_actor", spec=spec)
             except Exception as e:
                 self._release_tpu_chips(spec["task_id"])
+                if handle.oom_reason:
+                    # The memory monitor killed this worker and already
+                    # sent actor_died (restart-eligible); reporting
+                    # creation-failed too would mark the actor DEAD and
+                    # burn the restart the first report queued.
+                    return
                 await controller.oneway(
                     "actor_creation_failed", actor_id=spec["actor_id"],
                     reason=f"worker died during actor creation: {e!r}")
@@ -425,8 +477,12 @@ class NodeDaemon:
             except Exception as e:
                 if self._closed:
                     return  # our own shutdown cancelled the call
+                from ..exceptions import OutOfMemoryError
+                err = (OutOfMemoryError(handle.oom_reason)
+                       if handle.oom_reason else None)
                 await self._report_failure(
-                    spec, f"worker crashed while running task: {e!r}")
+                    spec, f"worker crashed while running task: {e!r}",
+                    error=err)
                 if handle.state != "dead":
                     self._kill_proc(handle)
             else:
@@ -435,15 +491,55 @@ class NodeDaemon:
             await controller.oneway("task_finished", task_id=spec["task_id"],
                                     node_id=self.node_id)
 
-    async def _report_failure(self, spec: dict, reason: str) -> None:
+    async def _report_failure(self, spec: dict, reason: str,
+                              error: Optional[Exception] = None) -> None:
         from ..exceptions import WorkerCrashedError
         try:
             await self.pool.get(spec["owner_addr"]).oneway(
-                "object_ready", error=WorkerCrashedError(reason),
+                "object_ready", error=error or WorkerCrashedError(reason),
                 task_id=spec["task_id"],
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
         except Exception:
             pass
+
+    async def _check_memory_pressure(self) -> None:
+        """Kill one worker per tick while above the threshold (reference
+        parity: memory_monitor.h:52 + worker_killing_policy.h:39)."""
+        if self.memory_threshold <= 0:
+            return
+        try:
+            used, total = self.memory_usage_fn()
+        except Exception:
+            return
+        if total <= 0 or used / total < self.memory_threshold:
+            return
+        victim = pick_worker_to_kill(list(self.workers.values()))
+        if victim is None:
+            return
+        reason = (f"node memory pressure: {used / total:.0%} of "
+                  f"{total >> 20} MiB used (threshold "
+                  f"{self.memory_threshold:.0%}); killed "
+                  f"{'actor' if victim.state == 'actor' else 'task'} "
+                  f"worker {victim.pid} per the newest-retriable-first "
+                  f"policy")
+        logger.warning("OOM kill: %s", reason)
+        self.oom_kills += 1
+        spec = victim.current_task
+        is_actor = victim.state == "actor"
+        # Busy-task reporting happens exactly once, in _run_task's except
+        # branch when the killed worker's run_task RPC aborts — oom_reason
+        # upgrades that report to OutOfMemoryError. Reporting here too
+        # would double-consume retries and double-submit the task.
+        victim.oom_reason = reason
+        self._kill_proc(victim)
+        if is_actor and victim.actor_id:
+            if spec is not None:
+                self._release_tpu_chips(spec["task_id"])
+            try:
+                await self.pool.get(self.controller_addr).oneway(
+                    "actor_died", actor_id=victim.actor_id, reason=reason)
+            except Exception:
+                pass
 
     async def rpc_kill_actor_worker(self, actor_id: str) -> bool:
         for handle in self.workers.values():
@@ -510,6 +606,7 @@ class NodeDaemon:
             "object_store_bytes": self.object_store.bytes_used,
             "bytes_spilled": self.object_store.bytes_spilled,
             "objects_spilled": self.object_store.objects_spilled,
+            "oom_kills": self.oom_kills,
         }
 
     # ------------------------------------------------------------- monitor
@@ -534,12 +631,19 @@ class NodeDaemon:
                         addr=self.address, resources=self.resources,
                         labels=self.labels)
                     hosted = set()
-                    for h in self.workers.values():
+                    for h in list(self.workers.values()):
                         if h.state == "actor" and h.actor_id:
                             hosted.add(h.actor_id)
-                            await controller.oneway(
+                            ack = await controller.call(
                                 "actor_started", actor_id=h.actor_id,
                                 addr=h.addr, worker_id=h.worker_id)
+                            if (ack or {}).get("status") == "superseded":
+                                # a replacement is already queued/running;
+                                # two live incarnations must never coexist
+                                if h.current_task is not None:
+                                    self._release_tpu_chips(
+                                        h.current_task["task_id"])
+                                self._kill_proc(h)
                     for aid in (reg or {}).get("expected_actors", []):
                         if aid not in hosted:
                             await controller.oneway(
@@ -559,6 +663,7 @@ class NodeDaemon:
                     target = min(int(allocated - low * capacity), 256 << 20)
                     await asyncio.get_running_loop().run_in_executor(
                         None, self.object_store.spill_until, target)
+            await self._check_memory_pressure()
             for handle in list(self.workers.values()):
                 if handle.state == "dead":
                     self.workers.pop(handle.worker_id, None)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import struct
 import traceback
@@ -108,10 +109,32 @@ class RpcServer:
             if attr.startswith("rpc_"):
                 self._handlers[prefix + attr[4:]] = getattr(obj, attr)
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+    async def start(self, host: Optional[str] = None,
+                    port: int = 0) -> Tuple[str, int]:
+        # Every server in the process tree (controller, daemons, the
+        # driver's CoreClient, worker CoreClients) binds this default —
+        # multi-host clusters need ALL of them reachable (owner_addr /
+        # actor addrs cross hosts), not just the control plane.
+        if host is None:
+            host = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
         self._server = await asyncio.start_server(self._on_connection, host, port)
         sock = self._server.sockets[0]
         self.address = sock.getsockname()[:2]
+        if self.address[0] in ("0.0.0.0", "::"):
+            # Advertise a dialable address, not the wildcard bind: the
+            # host's primary outbound IP (RAY_TPU_ADVERTISE_HOST overrides).
+            adv = os.environ.get("RAY_TPU_ADVERTISE_HOST")
+            if not adv:
+                import socket as _socket
+                probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                try:
+                    probe.connect(("8.8.8.8", 80))
+                    adv = probe.getsockname()[0]
+                except Exception:
+                    adv = "127.0.0.1"
+                finally:
+                    probe.close()
+            self.address = (adv, self.address[1])
         return self.address
 
     async def stop(self) -> None:

@@ -110,6 +110,9 @@ def init(address: Optional[str] = None,
             node_resources.setdefault(k, v)
 
     async def _bootstrap():
+        # RAY_TPU_BIND_HOST=0.0.0.0 makes every server this session
+        # starts (controller, daemons, driver + worker CoreClients)
+        # reachable from other hosts — see protocol.RpcServer.start.
         controller = Controller(session_name)
         await controller.start()
         daemon = NodeDaemon(controller.address, session_name,

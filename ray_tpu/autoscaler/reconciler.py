@@ -1,0 +1,184 @@
+"""The reconciler: diff (demand, current nodes) -> launch/terminate.
+
+Reference parity: python/ray/autoscaler/v2/instance_manager/
+reconciler.py:53-61 (Reconciler.reconcile: sync-from-cloud, then
+step_next) and v2/scheduler.py (ResourceDemandScheduler). Simplified to
+the TPU-native shape: demand is the controller's pending task resources +
+pending PG bundles (gang slice demand rides the `TPU-...-head` marker
+resource in a bundle), supply is live nodes plus launches in flight.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .provider import NodeProvider, NodeType
+
+logger = logging.getLogger(__name__)
+
+_DEMAND_KV_KEY = "autoscaler/requested_resources"
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeType] = field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    max_launches_per_round: int = 8
+    # nodes never scaled down (the head node's id is added automatically)
+    protected_nodes: List[str] = field(default_factory=list)
+
+
+def request_resources(bundles: List[Dict[str, float]]) -> None:
+    """Explicit demand hint (reference: ray.autoscaler.sdk
+    request_resources): the autoscaler provisions for these bundles even
+    before tasks arrive. Overwrites the previous request; [] clears."""
+    import pickle
+
+    from ..experimental.internal_kv import _internal_kv_put
+    _internal_kv_put(_DEMAND_KV_KEY, pickle.dumps(list(bundles)))
+
+
+class Autoscaler:
+    """Poll demand, reconcile, repeat. One instance per cluster, usually
+    next to the head controller."""
+
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig,
+                 client=None):
+        from .._private import state
+        self.provider = provider
+        self.config = config
+        self.client = client or state.current_client()
+        self._idle_since: Dict[str, float] = {}
+        self._launched: Dict[str, NodeType] = {}   # node_id -> type
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.client.controller_rpc("set_autoscaling", enabled=True)
+
+    # ------------------------------------------------------------- control
+
+    def start(self, interval_s: float = 2.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    logger.exception("reconcile failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        try:
+            self.client.controller_rpc("set_autoscaling", enabled=False)
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- reconcile
+
+    def _requested_bundles(self) -> List[Dict[str, float]]:
+        import pickle
+
+        from ..experimental.internal_kv import _internal_kv_get
+        raw = _internal_kv_get(_DEMAND_KV_KEY)
+        return pickle.loads(raw) if raw else []
+
+    def reconcile_once(self) -> Dict[str, int]:
+        """One reconcile round. Returns {"launched": n, "terminated": n}."""
+        demand = self.client.controller_rpc("pending_demand")
+        bundles: List[Dict[str, float]] = []
+        bundles.extend(d for d in demand["task_demands"] if d)
+        for pg in demand["pg_demands"]:
+            bundles.extend(b for b in pg["bundles"] if b)
+        bundles.extend(b for b in self._requested_bundles() if b)
+
+        # Keyed by PROVIDER id: providers whose node ids aren't the
+        # daemon's node_id (e.g. GCP VM names) report theirs via the
+        # `autoscaler_node` label the node joins with.
+        nodes = {}
+        for n in demand["nodes"]:
+            if n["alive"]:
+                pid = n.get("labels", {}).get("autoscaler_node",
+                                              n["node_id"])
+                nodes[pid] = n
+
+        # ----- scale up: fit unmet bundles onto the actual free capacity
+        # of live nodes (busy nodes with a backlog still trigger growth)
+        # + in-flight launches, launch node types for the rest.
+        free: List[Dict[str, float]] = [
+            dict(n["resources_avail"]) for n in nodes.values()]
+        free += [dict(t.resources) for nid, t in self._launched.items()
+                 if nid not in nodes]          # still starting up
+        type_counts: Dict[str, int] = {}
+        for nid, t in self._launched.items():
+            type_counts[t.name] = type_counts.get(t.name, 0) + 1
+
+        to_launch: List[NodeType] = []
+        for bundle in bundles:
+            if _fit(bundle, free):
+                continue
+            chosen = None
+            for nt in self.config.node_types:
+                if nt.covers(bundle) \
+                        and type_counts.get(nt.name, 0) < nt.max_workers:
+                    chosen = nt
+                    break
+            if chosen is None:
+                logger.warning("no node type covers demand %s", bundle)
+                continue
+            type_counts[chosen.name] = type_counts.get(chosen.name, 0) + 1
+            cap = dict(chosen.resources)
+            _fit(bundle, [cap])     # bundle occupies part of the new node
+            free.append(cap)        # remainder can absorb later bundles
+            to_launch.append(chosen)
+            if len(to_launch) >= self.config.max_launches_per_round:
+                break
+
+        launched = 0
+        for nt in to_launch:
+            try:
+                node_id = self.provider.create_node(nt)
+                self._launched[node_id] = nt
+                launched += 1
+                logger.info("autoscaler launched %s as %s",
+                            nt.name, str(node_id)[:12])
+            except Exception:
+                logger.exception("launch of %s failed", nt.name)
+
+        # ----- scale down: nodes we launched, idle past the timeout.
+        now = time.monotonic()
+        terminated = 0
+        for node_id, info in nodes.items():
+            ours = node_id in self._launched
+            busy = (info["num_running"] > 0
+                    or info.get("num_pg_bundles", 0) > 0)
+            if not ours or busy or node_id in self.config.protected_nodes:
+                self._idle_since.pop(node_id, None)
+                continue
+            first_idle = self._idle_since.setdefault(node_id, now)
+            if now - first_idle >= self.config.idle_timeout_s:
+                if self.provider.terminate_node(node_id):
+                    terminated += 1
+                    self._launched.pop(node_id, None)
+                    self._idle_since.pop(node_id, None)
+                    logger.info("autoscaler terminated idle node %s",
+                                node_id[:12])
+        return {"launched": launched, "terminated": terminated}
+
+
+def _fit(bundle: Dict[str, float], capacities: List[Dict[str, float]]
+         ) -> bool:
+    """First-fit a bundle into one of the capacity dicts (mutating it)."""
+    for cap in capacities:
+        if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
+            for k, v in bundle.items():
+                cap[k] = cap.get(k, 0.0) - v
+            return True
+    return False

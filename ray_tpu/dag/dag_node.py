@@ -33,6 +33,30 @@ class InputNode(DAGNode):
         return "InputNode()"
 
 
+class FunctionNode(DAGNode):
+    """One bound remote-function call in a task DAG (reference parity:
+    python/ray/dag/function_node.py — `fn.bind(...)`). Used by
+    ray_tpu.workflow for durable execution."""
+
+    def __init__(self, remote_fn, args: Tuple[Any, ...],
+                 kwargs: Optional[dict] = None):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs or {}
+
+    @property
+    def name(self) -> str:
+        return getattr(self.remote_fn, "__name__", "fn")
+
+    def _upstream(self) -> List[DAGNode]:
+        ups = [a for a in self.args if isinstance(a, DAGNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def __repr__(self):
+        return f"FunctionNode({self.name})"
+
+
 class ClassMethodNode(DAGNode):
     """One bound actor-method call in the graph."""
 

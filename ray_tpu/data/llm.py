@@ -1,0 +1,103 @@
+"""ray_tpu.data.llm: batch LLM inference over Datasets.
+
+Reference parity: python/ray/llm/_internal/batch/processor/
+vllm_engine_proc.py (build_llm_processor + vLLMEngineProcessorConfig) —
+the external vLLM engine replaced by the in-repo paged-KV continuous
+batching engine (llm/_internal/engine.py). Each processor replica is a
+map_batches actor holding one engine; prompts in a batch run through the
+engine's continuous batching loop together.
+
+    config = LLMEngineProcessorConfig(model_source="debug",
+                                      batch_size=16, concurrency=1)
+    processor = build_llm_processor(
+        config,
+        preprocess=lambda row: {"prompt": f"Q: {row['question']}"},
+        postprocess=lambda row: {"answer": row["generated_text"]})
+    ds = processor(ds)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from .dataset import Dataset
+
+
+@dataclasses.dataclass
+class LLMEngineProcessorConfig:
+    """Reference: vLLMEngineProcessorConfig (pydantic there)."""
+
+    model_source: Any = "debug"          # preset name or LlamaConfig
+    tokenizer_source: Optional[str] = None
+    engine_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    sampling_params: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"max_tokens": 32})
+    batch_size: int = 16
+    concurrency: int = 1
+    num_tpus: Optional[float] = None     # per engine replica
+
+
+class _LLMBatchPredictor:
+    """One engine per map_batches replica; called with numpy batches."""
+
+    def __init__(self, config: LLMEngineProcessorConfig):
+        from ..llm._internal.engine import (EngineConfig, InferenceEngine,
+                                            SamplingParams)
+        from ..llm._internal.tokenizer import load_tokenizer
+        from ..models import llama
+
+        model = (llama.config(config.model_source)
+                 if isinstance(config.model_source, str)
+                 else config.model_source)
+        kwargs = dict(config.engine_kwargs)
+        kwargs.setdefault("max_batch_size", min(config.batch_size, 16))
+        self.engine = InferenceEngine(EngineConfig(model=model, **kwargs))
+        self.tokenizer = load_tokenizer(config.tokenizer_source,
+                                        vocab_size=model.vocab_size)
+        self.params = SamplingParams(**config.sampling_params)
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        if "prompt" in batch:
+            prompts = [self.tokenizer.encode(str(p))
+                       for p in batch["prompt"]]
+        elif "prompt_tokens" in batch:
+            prompts = [list(map(int, p)) for p in batch["prompt_tokens"]]
+        else:
+            raise ValueError(
+                "LLM processor batches need a 'prompt' (text) or "
+                "'prompt_tokens' column")
+        reqs = self.engine.generate(prompts, self.params)
+        batch = dict(batch)
+        batch["generated_tokens"] = [list(r.output_tokens) for r in reqs]
+        batch["generated_text"] = [
+            self.tokenizer.decode(r.output_tokens) for r in reqs]
+        return batch
+
+
+def build_llm_processor(
+        config: LLMEngineProcessorConfig,
+        preprocess: Optional[Callable[[dict], dict]] = None,
+        postprocess: Optional[Callable[[dict], dict]] = None,
+) -> Callable[[Dataset], Dataset]:
+    """Dataset -> Dataset stage running batch inference.
+
+    preprocess maps each input row to a row with a 'prompt' column;
+    postprocess maps each output row (input columns + generated_text /
+    generated_tokens) to the final row.
+    """
+
+    def apply(ds: Dataset) -> Dataset:
+        if preprocess is not None:
+            ds = ds.map(lambda row, _f=preprocess: {**row, **_f(row)})
+        ds = ds.map_batches(
+            _LLMBatchPredictor,
+            fn_constructor_args=(config,),
+            batch_size=config.batch_size,
+            concurrency=config.concurrency,
+            num_tpus=config.num_tpus)
+        if postprocess is not None:
+            ds = ds.map(lambda row, _f=postprocess: _f(row))
+        return ds
+
+    return apply

@@ -45,6 +45,13 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing a task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The node memory monitor killed this worker to relieve memory
+    pressure (reference parity: ray.exceptions.OutOfMemoryError /
+    src/ray/common/memory_monitor.h:52). Subclasses WorkerCrashedError so
+    the task-retry machinery treats OOM kills as retriable crashes."""
+
+
 class ObjectLostError(RayTpuError):
     """The object's value is unreachable (owner or storing node gone)."""
 

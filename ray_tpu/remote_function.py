@@ -63,6 +63,12 @@ class RemoteFunction:
         merged.update(validate_options(opts))
         return RemoteFunction(self._fn, merged)
 
+    def bind(self, *args, **kwargs):
+        """Build a task-DAG node (reference: fn.bind -> FunctionNode);
+        execute durably with ray_tpu.workflow.run(...)."""
+        from .dag.dag_node import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._fn.__name__!r} cannot be called "

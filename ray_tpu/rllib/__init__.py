@@ -6,17 +6,23 @@ compiled: pure-JAX envs scanned with the policy in one XLA program.
 """
 
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.impala import IMPALA, IMPALAConfig
 from .algorithms.ppo import PPO, PPOConfig
+from .algorithms.sac import SAC, SACConfig
 from .core.learner import Learner, LearnerGroup
 from .core.rl_module import DefaultRLModule, RLModule
 from .env.env_runner import SingleAgentEnvRunner
 from .env.env_runner_group import EnvRunnerGroup
 from .env.jax_env import CartPole, EnvSpec, JaxEnv, Pendulum, register_env
+from .offline import BC, BCConfig, OfflineData, record_samples
+from .utils.replay_buffers import ReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "Learner", "LearnerGroup", "RLModule",
+    "IMPALAConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+    "BC", "BCConfig", "OfflineData", "record_samples", "ReplayBuffer",
+    "Learner", "LearnerGroup", "RLModule",
     "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",
     "JaxEnv", "CartPole", "Pendulum", "EnvSpec", "register_env",
 ]

@@ -1,0 +1,216 @@
+"""DQN: Q-learning with replay + target network (double-Q by default).
+
+Reference parity: rllib/algorithms/dqn/dqn.py (training_step: sample ->
+replay add -> N replay updates -> periodic target sync) and
+dqn_rainbow_torch_learner (TD loss). The replay update — TD loss, grad,
+apply — compiles into one XLA program; the target network is a second
+params pytree carried in learner state and hard-synced every
+`target_network_update_freq` updates.
+
+Multi-learner note: DQN's update path is replay-driven with learner-held
+target params, so num_learners > 1 is rejected (the generic allreduce
+path can't see the target pytree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.learner import Learner
+from ..core.rl_module import RLModule
+from ..utils.replay_buffers import ReplayBuffer
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class _QNet(nn.Module):
+    hiddens: Sequence[int]
+    num_actions: int
+
+    @nn.compact
+    def __call__(self, x):
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_actions)(x)
+
+
+class QModule(RLModule):
+    """Q-network module with epsilon-greedy exploration. Epsilon is a
+    static model_config knob (it bakes into the compiled rollout); the
+    reference's per-step schedule would force a recompile per change."""
+
+    def __init__(self, spec, hiddens: Sequence[int] = (64, 64),
+                 epsilon: float = 0.1):
+        if not spec.discrete:
+            raise ValueError("DQN requires a discrete action space")
+        super().__init__(spec)
+        self.epsilon = float(epsilon)
+        self._net = _QNet(tuple(hiddens), spec.num_actions)
+
+    def init(self, key):
+        dummy = jnp.zeros((1, self.spec.obs_dim), jnp.float32)
+        return self._net.init(key, dummy)
+
+    def apply(self, params, obs):
+        q = self._net.apply(params, obs)
+        return {"action_dist_inputs": q, "vf": jnp.max(q, axis=-1)}
+
+    def forward_exploration(self, params, obs, key):
+        q = self._net.apply(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(key)
+        random_a = jax.random.randint(
+            k1, greedy.shape, 0, self.spec.num_actions)
+        explore = jax.random.uniform(k2, greedy.shape) < self.epsilon
+        action = jnp.where(explore, random_a, greedy)
+        # logp of the epsilon-greedy behavior policy (for the batch shape;
+        # DQN's TD loss never reads it)
+        logp = jnp.log(jnp.where(
+            action == greedy,
+            1 - self.epsilon + self.epsilon / self.spec.num_actions,
+            self.epsilon / self.spec.num_actions))
+        vf = jnp.max(q, axis=-1)
+        return action, logp, vf
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self.lr = 5e-4
+        self.buffer_size = 50_000
+        self.train_batch_size = 128
+        self.num_updates_per_iter = 8
+        self.target_network_update_freq = 100     # in learner updates
+        self.num_steps_before_learning = 1_000
+        self.double_q = True
+        self.epsilon = 0.1
+
+
+class DQNLearner(Learner):
+    def __init__(self, spec, config: DQNConfig):
+        self._gamma = config.gamma
+        self._double_q = config.double_q
+        self._target_freq = config.target_network_update_freq
+        super().__init__(spec, config.learner_hyperparams(),
+                         config.module_class, config.model_config,
+                         seed=config.seed)
+        self.target_params = self.params
+        self._updates = 0
+        self._td_jit = jax.jit(self._build_td_update())
+
+    def _build_td_update(self):
+        opt, module, gamma, double_q = (self.optimizer, self.module,
+                                        self._gamma, self._double_q)
+
+        def td_update(params, target_params, opt_state, batch):
+            def loss_fn(p):
+                q = module.apply(p, batch["obs"])["action_dist_inputs"]
+                q_sa = jnp.take_along_axis(
+                    q, batch["actions"][:, None].astype(jnp.int32),
+                    axis=-1)[:, 0]
+                q_next_t = module.apply(
+                    target_params,
+                    batch["next_obs"])["action_dist_inputs"]
+                if double_q:
+                    q_next_online = module.apply(
+                        p, batch["next_obs"])["action_dist_inputs"]
+                    a_star = jnp.argmax(q_next_online, axis=-1)
+                    v_next = jnp.take_along_axis(
+                        q_next_t, a_star[:, None], axis=-1)[:, 0]
+                else:
+                    v_next = jnp.max(q_next_t, axis=-1)
+                target = (batch["rewards"]
+                          + gamma * (1.0 - batch["dones"])
+                          * jax.lax.stop_gradient(v_next))
+                td = q_sa - jax.lax.stop_gradient(target)
+                loss = jnp.mean(td ** 2)
+                return loss, {"total_loss": loss,
+                              "qf_mean": jnp.mean(q_sa),
+                              "td_error_abs": jnp.mean(jnp.abs(td))}
+
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, aux
+
+        return td_update
+
+    # replaces the on-policy epoch machinery
+    def update(self, train_batch: Dict[str, Any]) -> Dict[str, float]:
+        batch = {k: jnp.asarray(v) for k, v in train_batch.items()}
+        self.params, self.opt_state, aux = self._td_jit(
+            self.params, self.target_params, self.opt_state, batch)
+        self._updates += 1
+        if self._updates % self._target_freq == 0:
+            self.target_params = self.params
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = jax.device_put(
+            state.get("target_params", state["params"]))
+        self._updates = state.get("updates", 0)
+
+
+def _to_transitions(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """[T, B, ...] rollout -> flat [T*B] transitions with next_obs."""
+    import numpy as np
+    obs, final_obs = batch["obs"], batch["final_obs"]
+    next_obs = np.concatenate([obs[1:], final_obs[None]], axis=0)
+    flat = lambda a: np.asarray(a).reshape((-1,) + np.asarray(a).shape[2:])
+    return {
+        "obs": flat(obs).astype(np.float32),
+        "actions": flat(batch["actions"]),
+        "rewards": flat(batch["rewards"]).astype(np.float32),
+        "dones": flat(batch["dones"]).astype(np.float32),
+        "next_obs": flat(next_obs).astype(np.float32),
+    }
+
+
+class DQN(Algorithm):
+    @classmethod
+    def default_config(cls) -> DQNConfig:
+        return DQNConfig()
+
+    @classmethod
+    def build_learner(cls, spec, config) -> DQNLearner:
+        return DQNLearner(spec, config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        algo_cfg = config.get("_algo_config")
+        if algo_cfg is None:
+            algo_cfg = type(self).default_config().update_from_dict(config)
+        if algo_cfg.num_learners > 1:
+            raise ValueError("DQN supports num_learners <= 1 (the target "
+                             "network lives in learner state)")
+        if algo_cfg.module_class is None:
+            algo_cfg.module_class = QModule
+            algo_cfg.model_config = dict(algo_cfg.model_config,
+                                         epsilon=algo_cfg.epsilon)
+        super().setup({"_algo_config": algo_cfg})
+        self.replay = ReplayBuffer(algo_cfg.buffer_size,
+                                   seed=algo_cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._config
+        result = self.env_runner_group.sample()
+        self.replay.add_batch(_to_transitions(result["batch"]))
+        learner_metrics: Dict[str, float] = {}
+        if len(self.replay) >= cfg.num_steps_before_learning:
+            for _ in range(cfg.num_updates_per_iter):
+                learner_metrics = self.learner_group.update(
+                    self.replay.sample(cfg.train_batch_size))
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return self._roll_metrics(result["stats"], learner_metrics)

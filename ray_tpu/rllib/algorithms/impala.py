@@ -92,7 +92,8 @@ class IMPALALearner(Learner):
 def _to_env_major(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     out = {}
     for k, v in batch.items():
-        out[k] = v if k == "final_vf" else np.swapaxes(v, 0, 1)
+        out[k] = v if k in ("final_vf", "final_obs") \
+            else np.swapaxes(v, 0, 1)
     return out
 
 

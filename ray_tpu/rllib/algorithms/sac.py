@@ -1,0 +1,263 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference parity: rllib/algorithms/sac/sac.py + sac_torch_learner (actor,
+twin-critic, and entropy-temperature losses; polyak-averaged target
+critics). The whole replay update — three losses, three grads, apply,
+polyak — is one XLA program.
+
+The policy is a tanh-squashed diagonal Gaussian; alpha is auto-tuned
+toward target entropy -action_dim (the standard heuristic).
+
+Like DQN, num_learners > 1 is rejected (target critics live in learner
+state, outside the generic allreduce path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.learner import Learner
+from ..core.rl_module import RLModule
+from ..utils.replay_buffers import ReplayBuffer
+from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import _to_transitions
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+class _SACNet(nn.Module):
+    """Policy head + twin Q heads in ONE params tree."""
+
+    hiddens: Sequence[int]
+    action_dim: int
+
+    def _mlp(self, x, out, name):
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"{name}_{i}")(x))
+        return nn.Dense(out, name=f"{name}_out")(x)
+
+    @nn.compact
+    def __call__(self, obs, action):
+        pi = self._mlp(obs, 2 * self.action_dim, "pi")
+        sa = jnp.concatenate([obs, action], axis=-1)
+        q1 = self._mlp(sa, 1, "q1")[..., 0]
+        q2 = self._mlp(sa, 1, "q2")[..., 0]
+        return pi, q1, q2
+
+
+def _squash(mean, log_std, key):
+    """Sample a tanh-squashed gaussian action + its log-prob."""
+    std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + std * eps
+    action = jnp.tanh(pre)
+    logp = (-0.5 * (eps ** 2 + jnp.log(2 * jnp.pi)) - jnp.log(std)
+            ).sum(-1)
+    # tanh change of variables
+    logp -= jnp.log(1 - action ** 2 + 1e-6).sum(-1)
+    return action, logp
+
+
+class SACModule(RLModule):
+    def __init__(self, spec, hiddens: Sequence[int] = (64, 64),
+                 action_scale: float = 1.0):
+        if spec.discrete:
+            raise ValueError("SAC requires a continuous action space")
+        super().__init__(spec)
+        self.action_scale = float(action_scale)
+        self._net = _SACNet(tuple(hiddens), spec.action_dim)
+
+    def init(self, key):
+        dummy_o = jnp.zeros((1, self.spec.obs_dim), jnp.float32)
+        dummy_a = jnp.zeros((1, self.spec.action_dim), jnp.float32)
+        return self._net.init(key, dummy_o, dummy_a)
+
+    def pi_and_q(self, params, obs, action):
+        return self._net.apply(params, obs, action)
+
+    def apply(self, params, obs):
+        dummy_a = jnp.zeros(obs.shape[:-1] + (self.spec.action_dim,),
+                            jnp.float32)
+        pi, q1, _ = self._net.apply(params, obs, dummy_a)
+        return {"action_dist_inputs": pi, "vf": q1}
+
+    def forward_exploration(self, params, obs, key):
+        out = self.apply(params, obs)
+        mean, log_std = jnp.split(out["action_dist_inputs"], 2, axis=-1)
+        action, logp = _squash(mean, log_std, key)
+        return action * self.action_scale, logp, out["vf"]
+
+    def forward_inference(self, params, obs):
+        out = self.apply(params, obs)
+        mean, _ = jnp.split(out["action_dist_inputs"], 2, axis=-1)
+        return jnp.tanh(mean) * self.action_scale
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self.lr = 3e-4
+        self.buffer_size = 100_000
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 8
+        self.tau = 0.005                     # polyak for target critics
+        self.initial_alpha = 0.2
+        self.target_entropy = None           # default: -action_dim
+        self.num_steps_before_learning = 1_000
+        self.action_scale = 1.0
+
+
+class SACLearner(Learner):
+    def __init__(self, spec, config: SACConfig):
+        self._gamma = config.gamma
+        self._tau = config.tau
+        self._target_entropy = (config.target_entropy
+                                if config.target_entropy is not None
+                                else -float(spec.action_dim))
+        if config.module_class is None:
+            config.module_class = SACModule
+            config.model_config = dict(
+                config.model_config, action_scale=config.action_scale)
+        super().__init__(spec, config.learner_hyperparams(),
+                         config.module_class, config.model_config,
+                         seed=config.seed)
+        self.target_params = self.params
+        self.log_alpha = jnp.asarray(np.log(config.initial_alpha),
+                                     jnp.float32)
+        self._alpha_opt = optax.adam(config.lr)
+        self._alpha_opt_state = self._alpha_opt.init(self.log_alpha)
+        self._sac_jit = jax.jit(self._build_sac_update())
+
+    def _build_sac_update(self):
+        opt, alpha_opt = self.optimizer, self._alpha_opt
+        module, gamma, tau = self.module, self._gamma, self._tau
+        target_entropy = self._target_entropy
+
+        def sac_update(params, target_params, opt_state,
+                       log_alpha, alpha_opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # --- critic + actor losses share one grad pass over params
+            def loss_fn(p):
+                pi_n, _, _ = module.pi_and_q(
+                    target_params, batch["next_obs"], batch["actions"])
+                mean_n, log_std_n = jnp.split(pi_n, 2, axis=-1)
+                a_next, logp_next = _squash(mean_n, log_std_n, k1)
+                _, tq1, tq2 = module.pi_and_q(
+                    target_params, batch["next_obs"], a_next)
+                v_next = jnp.minimum(tq1, tq2) - alpha * logp_next
+                target = jax.lax.stop_gradient(
+                    batch["rewards"]
+                    + gamma * (1.0 - batch["dones"]) * v_next)
+                _, q1, q2 = module.pi_and_q(
+                    p, batch["obs"], batch["actions"])
+                critic_loss = (jnp.mean((q1 - target) ** 2)
+                               + jnp.mean((q2 - target) ** 2))
+
+                pi, _, _ = module.pi_and_q(
+                    p, batch["obs"], batch["actions"])
+                mean, log_std = jnp.split(pi, 2, axis=-1)
+                a_pi, logp_pi = _squash(mean, log_std, k2)
+                _, q1_pi, q2_pi = module.pi_and_q(p, batch["obs"], a_pi)
+                q_pi = jnp.minimum(q1_pi, q2_pi)
+                actor_loss = jnp.mean(alpha * logp_pi - q_pi)
+
+                loss = critic_loss + actor_loss
+                return loss, (critic_loss, actor_loss, logp_pi, q_pi)
+
+            (_, (critic_loss, actor_loss, logp_pi, q_pi)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            # --- temperature
+            def alpha_loss_fn(la):
+                return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp_pi + target_entropy))
+
+            alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(
+                log_alpha)
+            a_updates, alpha_opt_state = alpha_opt.update(
+                alpha_grad, alpha_opt_state)
+            log_alpha = log_alpha + a_updates
+
+            # --- polyak target critics
+            target_params = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o,
+                target_params, params)
+            aux = {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                   "alpha": jnp.exp(log_alpha), "alpha_loss": alpha_loss,
+                   "q_mean": jnp.mean(q_pi),
+                   "entropy": -jnp.mean(logp_pi)}
+            return (params, target_params, opt_state, log_alpha,
+                    alpha_opt_state, aux)
+
+        return sac_update
+
+    def update(self, train_batch: Dict[str, Any]) -> Dict[str, float]:
+        self._key, sub = jax.random.split(self._key)
+        batch = {k: jnp.asarray(v) for k, v in train_batch.items()}
+        (self.params, self.target_params, self.opt_state, self.log_alpha,
+         self._alpha_opt_state, aux) = self._sac_jit(
+            self.params, self.target_params, self.opt_state,
+            self.log_alpha, self._alpha_opt_state, batch, sub)
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["log_alpha"] = float(self.log_alpha)
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = jax.device_put(
+            state.get("target_params", state["params"]))
+        if "log_alpha" in state:
+            self.log_alpha = jnp.asarray(state["log_alpha"], jnp.float32)
+
+
+class SAC(Algorithm):
+    @classmethod
+    def default_config(cls) -> SACConfig:
+        return SACConfig()
+
+    @classmethod
+    def build_learner(cls, spec, config) -> SACLearner:
+        return SACLearner(spec, config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        algo_cfg = config.get("_algo_config")
+        if algo_cfg is None:
+            algo_cfg = type(self).default_config().update_from_dict(config)
+        if algo_cfg.num_learners > 1:
+            raise ValueError("SAC supports num_learners <= 1 (target "
+                             "critics live in learner state)")
+        if algo_cfg.module_class is None:
+            algo_cfg.module_class = SACModule
+            algo_cfg.model_config = dict(
+                algo_cfg.model_config,
+                action_scale=algo_cfg.action_scale)
+        super().setup({"_algo_config": algo_cfg})
+        self.replay = ReplayBuffer(algo_cfg.buffer_size,
+                                   seed=algo_cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._config
+        result = self.env_runner_group.sample()
+        self.replay.add_batch(_to_transitions(result["batch"]))
+        learner_metrics: Dict[str, float] = {}
+        if len(self.replay) >= cfg.num_steps_before_learning:
+            for _ in range(cfg.num_updates_per_iter):
+                learner_metrics = self.learner_group.update(
+                    self.replay.sample(cfg.train_batch_size))
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+        return self._roll_metrics(result["stats"], learner_metrics)

@@ -59,7 +59,7 @@ class NormalizeAdvantages(ConnectorV2):
 class FlattenTimeEnv(ConnectorV2):
     """[T, B, ...] → [T*B, ...] train batch (drops rollout-only keys)."""
 
-    DROP = ("final_vf",)
+    DROP = ("final_vf", "final_obs")
 
     def __call__(self, batch, **kwargs):
         out = {}

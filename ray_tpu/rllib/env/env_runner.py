@@ -78,6 +78,9 @@ class SingleAgentEnvRunner:
             env_state, obs, ep_ret, ep_len, _ = carry
             final_out = module.forward_train(params, obs)
             batch["final_vf"] = final_out["vf"]
+            # the observation after the last step — off-policy algorithms
+            # reconstruct next_obs[t] as obs[t+1] (+ this for t = T-1)
+            batch["final_obs"] = obs
             return env_state, obs, ep_ret, ep_len, key, batch
 
         return sample

@@ -22,7 +22,7 @@ def _merge_batches(results: List[Dict[str, Any]]) -> Dict[str, Any]:
     batches = [r["batch"] for r in results]
     merged = {}
     for k in batches[0]:
-        axis = 0 if k == "final_vf" else 1
+        axis = 0 if k in ("final_vf", "final_obs") else 1
         merged[k] = np.concatenate([b[k] for b in batches], axis=axis)
     n_eps = sum(r["stats"]["num_episodes"] for r in results)
     ret_sum = sum(r["stats"]["episode_return_mean"]

@@ -1,0 +1,116 @@
+"""Offline RL: train from recorded experience, no live environment.
+
+Reference parity: rllib/offline/offline_data.py (dataset-backed input)
++ rllib/algorithms/bc (behavior cloning, the canonical offline baseline).
+Experiences are .npz shards of flat transition arrays; `record_samples`
+writes them from any on-policy rollout batch, `OfflineData` streams
+minibatches from a directory of shards (or a ray_tpu.data Dataset).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms.algorithm import Algorithm, AlgorithmConfig
+from ..core.learner import Learner
+
+__all__ = ["record_samples", "OfflineData", "BC", "BCConfig"]
+
+
+def record_samples(batch: Dict[str, np.ndarray], out_dir: str,
+                   shard_index: int = 0) -> str:
+    """Write one rollout batch ([T, B, ...]) as a flat .npz shard.
+    Per-rollout extras (final_obs/final_vf, shape [B]) are dropped —
+    shards hold per-TRANSITION arrays with one shared leading dim."""
+    os.makedirs(out_dir, exist_ok=True)
+    t, b = np.asarray(batch["obs"]).shape[:2]
+    flat = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if v.ndim < 2 or v.shape[:2] != (t, b):
+            continue
+        flat[k] = v.reshape((t * b,) + v.shape[2:])
+    path = os.path.join(out_dir, f"shard-{shard_index:05d}.npz")
+    np.savez(path, **flat)
+    return path
+
+
+class OfflineData:
+    """Minibatch source over .npz shards (reference: OfflineData)."""
+
+    def __init__(self, input_path: str, seed: int = 0):
+        paths = sorted(glob.glob(os.path.join(input_path, "*.npz"))) \
+            if os.path.isdir(input_path) else [input_path]
+        if not paths:
+            raise ValueError(f"no .npz shards under {input_path!r}")
+        arrays: Dict[str, List[np.ndarray]] = {}
+        for p in paths:
+            with np.load(p) as z:
+                for k in z.files:
+                    arrays.setdefault(k, []).append(z[k])
+        self.data = {k: np.concatenate(v) for k, v in arrays.items()}
+        self.size = len(next(iter(self.data.values())))
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self.size, size=n)
+        return {k: v[idx] for k, v in self.data.items()}
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(BC)
+        self.input_path: Optional[str] = None
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 16
+
+    def offline_data(self, *, input_path: str) -> "BCConfig":
+        self.input_path = input_path
+        return self
+
+
+class BCLearner(Learner):
+    """Maximize log-likelihood of the dataset's actions."""
+
+    def compute_loss(self, params, mb):
+        out = self.module.forward_train(params, mb["obs"])
+        logp = self.module.dist.log_prob(
+            out["action_dist_inputs"], mb["actions"])
+        loss = -jnp.mean(logp)
+        return loss, {"total_loss": loss, "bc_logp": jnp.mean(logp)}
+
+
+class BC(Algorithm):
+    @classmethod
+    def default_config(cls) -> BCConfig:
+        return BCConfig()
+
+    @classmethod
+    def build_learner(cls, spec, config) -> BCLearner:
+        return BCLearner(spec, config.learner_hyperparams(),
+                         config.module_class, config.model_config,
+                         seed=config.seed)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)      # env used for spec + evaluation rollouts
+        cfg = self._config
+        if not getattr(cfg, "input_path", None):
+            raise ValueError("BC requires .offline_data(input_path=...)")
+        self.offline = OfflineData(cfg.input_path, seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._config
+        learner_metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_updates_per_iter):
+            learner_metrics = self.learner_group.update(
+                self.offline.sample(cfg.train_batch_size))
+        # evaluation rollout with the learned policy (also refreshes the
+        # sampler weights so metrics reflect the current params)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        result = self.env_runner_group.sample()
+        return self._roll_metrics(result["stats"], learner_metrics)

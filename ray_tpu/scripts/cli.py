@@ -54,9 +54,11 @@ def cmd_start(args) -> None:
     import ray_tpu
 
     if not args.head:
-        sys.exit("joining an existing cluster as a worker node requires "
-                 "--head for now (single-host runtime); multi-host "
-                 "attach lands with the DCN transport")
+        if not args.address:
+            sys.exit("pass --head to start a cluster, or "
+                     "--address host:port to join one as a worker node")
+        _run_worker_node(args)
+        return
     rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
     controller_addr = rt.controller.address
     address = f"{controller_addr[0]}:{controller_addr[1]}"
@@ -83,6 +85,55 @@ def cmd_start(args) -> None:
             signal.pause()
         except KeyboardInterrupt:
             ray_tpu.shutdown()
+
+
+def _run_worker_node(args) -> None:
+    """Join an existing cluster as a worker node: a NodeDaemon whose
+    workers execute tasks/actors scheduled here (reference parity:
+    `ray start --address`). The controller address must be routable;
+    start the head with RAY_TPU_BIND_HOST=0.0.0.0 for multi-host."""
+    import asyncio
+    import json
+
+    from ray_tpu._private.daemon import NodeDaemon
+    from ray_tpu._private.protocol import ClientPool
+
+    host, _, port = args.address.rpartition(":")
+    if not host or not port.isdigit():
+        sys.exit(f"--address must be host:port (got {args.address!r})")
+    controller_addr = (host, int(port))
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+    labels = json.loads(args.labels) if args.labels else {}
+
+    # A joining worker's daemon AND its worker processes must be
+    # reachable from the head and from every other node (object pushes,
+    # actor calls). Default the whole process tree to wildcard binding;
+    # RpcServer advertises the primary outbound IP.
+    os.environ.setdefault("RAY_TPU_BIND_HOST", "0.0.0.0")
+
+    async def run():
+        pool = ClientPool()
+        info = await pool.get(controller_addr).call("get_session_info")
+        await pool.close_all()
+        daemon = NodeDaemon(controller_addr, info["session_name"],
+                            resources=resources or None, labels=labels)
+        await daemon.start()
+        print(f"ray_tpu worker node {daemon.node_id[:12]} joined "
+              f"{args.address} with {daemon.resources}", flush=True)
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await daemon.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_stop(args) -> None:
@@ -193,10 +244,16 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ray_tpu", description="ray_tpu cluster CLI")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sp = sub.add_parser("start", help="start a head node")
+    sp = sub.add_parser("start", help="start a head node or join as worker")
     sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None,
+                    help="controller host:port to join as a worker node")
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default=None,
+                    help='extra node resources as JSON, e.g. \'{"TPU": 4}\'')
+    sp.add_argument("--labels", default=None,
+                    help="node labels as JSON")
     sp.add_argument("--dashboard-port", type=int, default=8265)
     sp.add_argument("--no-dashboard", action="store_true")
     sp.add_argument("--block", action="store_true")

@@ -12,6 +12,7 @@ from .search.sample import (uniform, quniform, loguniform, qloguniform,
                             sample_from, grid_search)
 from .search.searcher import (Searcher, BasicVariantGenerator, RandomSearch,
                               ConcurrencyLimiter)
+from .search.tpe import TPESearch
 from .schedulers import (TrialScheduler, FIFOScheduler, MedianStoppingRule,
                          AsyncHyperBandScheduler, ASHAScheduler,
                          HyperBandScheduler, PopulationBasedTraining)
@@ -22,7 +23,7 @@ from .tuner import ResultGrid, TuneConfig, TuneResult, Tuner, run
 __all__ = [
     "uniform", "quniform", "loguniform", "qloguniform", "randint",
     "qrandint", "lograndint", "choice", "sample_from", "grid_search",
-    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearch",
     "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
     "MedianStoppingRule", "AsyncHyperBandScheduler", "ASHAScheduler",
     "HyperBandScheduler", "PopulationBasedTraining", "Trainable", "report",

@@ -1,0 +1,163 @@
+"""TPE: a native model-based searcher.
+
+Reference parity: the role of tune/search/optuna/optuna_search.py and
+hyperopt/hyperopt_search.py — both wrap external TPE implementations;
+this environment vendors none, so the Tree-structured Parzen Estimator
+is implemented directly (Bergstra et al. 2011) over the tune sample
+Domains: observed trials are split into good/bad by metric quantile,
+candidates are drawn from a KDE over the good set and ranked by the
+density ratio l(x)/g(x).
+
+Supports Float (linear + log), Integer, and Categorical dimensions;
+grid_search keys are rejected (use BasicVariantGenerator for grids).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .sample import Categorical, Domain, Float, GridSearch, Integer
+from .searcher import Searcher
+
+
+class TPESearch(Searcher):
+    def __init__(self, space: Dict[str, Any], metric: str, mode: str = "max",
+                 num_samples: int = 64, n_startup_trials: int = 10,
+                 n_candidates: int = 24, gamma: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space: Dict[str, Any] = {}
+        self.fixed: Dict[str, Any] = {}
+        for k, v in space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    "TPESearch does not take grid_search dimensions; "
+                    "use BasicVariantGenerator for grids")
+            if isinstance(v, Domain):
+                self.space[k] = v
+            else:
+                self.fixed[k] = v
+        self.num_samples = num_samples
+        self.n_startup = n_startup_trials
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.rng = np.random.default_rng(seed)
+        self.total = num_samples
+        self._suggested = 0
+        self._trials: Dict[str, Dict[str, Any]] = {}   # id -> config
+        self._latest: Dict[str, Dict[str, Any]] = {}   # id -> last result
+        self._scores: List[Tuple[Dict[str, Any], float]] = []
+
+    # ------------------------------------------------------------ observe
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        # intermediate reports carry the metric; completion may not (a
+        # function trainable's terminal result can be empty)
+        if result and self.metric in result:
+            self._latest[trial_id] = result
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        config = self._trials.pop(trial_id, None)
+        if not (result and self.metric in result):
+            result = self._latest.pop(trial_id, None)
+        else:
+            self._latest.pop(trial_id, None)
+        if config is None or error or not result \
+                or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._scores.append((config, score))
+
+    # ------------------------------------------------------------ suggest
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return Searcher.FINISHED
+        self._suggested += 1
+        if len(self._scores) < self.n_startup:
+            config = {k: d.sample(self.rng) for k, d in self.space.items()}
+        else:
+            config = {k: self._suggest_dim(k, d)
+                      for k, d in self.space.items()}
+        config.update(self.fixed)
+        self._trials[trial_id] = config
+        return dict(config)
+
+    def _split(self) -> Tuple[list, list]:
+        ranked = sorted(self._scores, key=lambda cs: -cs[1])
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        return ranked[:n_good], ranked[n_good:]
+
+    def _suggest_dim(self, key: str, dom: Domain) -> Any:
+        good, bad = self._split()
+        gvals = [c[key] for c, _ in good]
+        bvals = [c[key] for c, _ in bad] or gvals
+        if isinstance(dom, Categorical):
+            return self._categorical(dom, gvals, bvals)
+        if isinstance(dom, (Float, Integer)):
+            return self._numeric(dom, gvals, bvals)
+        return dom.sample(self.rng)
+
+    def _categorical(self, dom: Categorical, gvals, bvals) -> Any:
+        cats = list(dom.categories)
+        prior = 1.0
+
+        def weights(vals):
+            w = np.array([prior + sum(v == c for v in vals)
+                          for c in cats], float)
+            return w / w.sum()
+
+        wl, wg = weights(gvals), weights(bvals)
+        # sample candidates from l, keep the best l/g ratio
+        idx = self.rng.choice(len(cats), size=self.n_candidates, p=wl)
+        best = max(idx, key=lambda i: wl[i] / wg[i])
+        return cats[best]
+
+    def _numeric(self, dom, gvals, bvals) -> Any:
+        log = bool(getattr(dom, "log", False))
+        lo, hi = float(dom.lower), float(dom.upper)
+        if log:
+            lo, hi = math.log(lo), math.log(hi)
+            to_x = math.log
+            from_x = math.exp
+        else:
+            to_x = from_x = float
+        g = np.array([to_x(float(v)) for v in gvals])
+        b = np.array([to_x(float(v)) for v in bvals])
+        span = hi - lo
+
+        def bandwidth(data):
+            # Scott's rule halved: TPE wants the good-KDE peaky enough
+            # to refine below the incumbent, not a smooth density fit
+            return max(0.53 * (data.std() or span / 4)
+                       * len(data) ** -0.2, span * 1e-3)
+
+        def kde_logpdf(xs, data):
+            bw = bandwidth(data)
+            d = (xs[:, None] - data[None, :]) / bw
+            comp = -0.5 * d * d - math.log(bw * math.sqrt(2 * math.pi))
+            m = comp.max(axis=1, keepdims=True)
+            return (m[:, 0] + np.log(
+                np.exp(comp - m).sum(axis=1) / len(data)))
+
+        # candidates: perturbed good points (KDE sampling); the incumbent
+        # best (g[0] — good set is rank-sorted) is always a center so the
+        # search can keep drilling around it
+        centers = self.rng.choice(g, size=self.n_candidates)
+        centers[0] = g[0]
+        bw = bandwidth(g)
+        cand = np.clip(centers + self.rng.normal(0, bw, len(centers)),
+                       lo, hi)
+        score = kde_logpdf(cand, g) - kde_logpdf(cand, b)
+        x = from_x(float(cand[int(np.argmax(score))]))
+        if isinstance(dom, Integer):
+            return int(np.clip(round(x), dom.lower, dom.upper))
+        return float(np.clip(x, dom.lower, dom.upper))

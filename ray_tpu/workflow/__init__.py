@@ -1,0 +1,226 @@
+"""Workflows: durable task-DAG execution with checkpoint/resume.
+
+Reference parity: python/ray/workflow (workflow_executor.py:32
+WorkflowExecutor, workflow_state_from_dag.py, storage layer) — a task DAG
+built with `fn.bind(...)` runs with every step's result checkpointed, so
+a crashed/killed run resumes from the last completed step instead of
+recomputing.
+
+    @ray_tpu.remote
+    def add(a, b): return a + b
+
+    dag = add.bind(add.bind(1, 2), 10)
+    workflow.run(dag, workflow_id="my-flow")      # -> 13
+    workflow.resume("my-flow")                    # no-op: already done
+
+Step identity is structural (function name + position in the DAG), so a
+resumed run maps checkpoints back to the same steps. Steps with all
+dependencies ready execute in parallel as normal ray_tpu tasks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+
+import cloudpickle
+from typing import Any, Dict, List, Optional
+
+from ..dag.dag_node import DAGNode, FunctionNode
+
+__all__ = ["run", "resume", "get_output", "get_status", "list_all",
+           "delete", "storage_dir"]
+
+_STATUS = ("RUNNING", "SUCCESSFUL", "FAILED", "NOT_FOUND")
+
+
+def storage_dir(workflow_id: Optional[str] = None) -> str:
+    base = os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
+                          "/tmp/ray_tpu/workflows")
+    return os.path.join(base, workflow_id) if workflow_id else base
+
+
+# ---------------------------------------------------------------- planning
+
+def _topo_steps(dag: FunctionNode) -> List[FunctionNode]:
+    """Deterministic post-order of the DAG (dedup by identity): children
+    before parents, stable across runs of the same DAG shape."""
+    seen: Dict[int, FunctionNode] = {}
+    order: List[FunctionNode] = []
+
+    def visit(node: FunctionNode) -> None:
+        if id(node) in seen:
+            return
+        seen[id(node)] = node
+        for up in node._upstream():
+            if not isinstance(up, FunctionNode):
+                raise TypeError(
+                    f"workflow DAGs are built from fn.bind(...) nodes; "
+                    f"got {type(up).__name__}")
+            visit(up)
+        order.append(node)
+
+    visit(dag)
+    return order
+
+
+def _step_ids(steps: List[FunctionNode]) -> Dict[int, str]:
+    counts: Dict[str, int] = {}
+    ids: Dict[int, str] = {}
+    for s in steps:
+        n = counts.get(s.name, 0)
+        counts[s.name] = n + 1
+        ids[id(s)] = f"{s.name}_{n}"
+    return ids
+
+
+# ---------------------------------------------------------------- storage
+
+def _write_json(path: str, data: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+
+
+def _checkpoint(wf_dir: str, step_id: str, value: Any) -> None:
+    tmp = os.path.join(wf_dir, f"{step_id}.pkl.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f)
+    os.replace(tmp, os.path.join(wf_dir, f"{step_id}.pkl"))
+
+
+def _load_checkpoint(wf_dir: str, step_id: str):
+    path = os.path.join(wf_dir, f"{step_id}.pkl")
+    if not os.path.exists(path):
+        return False, None
+    with open(path, "rb") as f:
+        return True, pickle.load(f)
+
+
+# --------------------------------------------------------------- execution
+
+def run(dag: FunctionNode, workflow_id: Optional[str] = None) -> Any:
+    """Execute the DAG durably; returns the root step's result. Re-running
+    an existing workflow_id resumes it (completed steps are not re-run)."""
+    import ray_tpu
+
+    if not isinstance(dag, FunctionNode):
+        raise TypeError("workflow.run expects a fn.bind(...) DAG node")
+    workflow_id = workflow_id or f"wf-{int(time.time())}-{os.getpid()}"
+    wf_dir = storage_dir(workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+
+    steps = _topo_steps(dag)
+    ids = _step_ids(steps)
+    _write_json(os.path.join(wf_dir, "status.json"), {
+        "workflow_id": workflow_id, "status": "RUNNING",
+        "num_steps": len(steps), "start_time": time.time(),
+    })
+    # the DAG itself is persisted so resume() can re-execute it
+    # (cloudpickle: DAGs routinely close over locally-defined functions)
+    with open(os.path.join(wf_dir, "dag.pkl"), "wb") as f:
+        cloudpickle.dump(dag, f)
+
+    results: Dict[str, Any] = {}
+    pending: Dict[str, Any] = {}        # step_id -> (ref, node)
+    remaining = {ids[id(s)]: s for s in steps}
+
+    def resolve(v):
+        if isinstance(v, FunctionNode):
+            return results[ids[id(v)]]
+        return v
+
+    try:
+        while remaining or pending:
+            # launch every step whose deps are all materialized
+            for sid, node in list(remaining.items()):
+                deps = [ids[id(u)] for u in node._upstream()]
+                if any(d not in results for d in deps):
+                    continue
+                del remaining[sid]
+                done, value = _load_checkpoint(wf_dir, sid)
+                if done:
+                    results[sid] = value
+                    continue
+                ref = node.remote_fn.remote(
+                    *[resolve(a) for a in node.args],
+                    **{k: resolve(v) for k, v in node.kwargs.items()})
+                pending[sid] = ref
+            if not pending:
+                continue
+            by_oid = {ref.id: sid for sid, ref in pending.items()}
+            ready, _ = ray_tpu.wait(list(pending.values()), num_returns=1)
+            for r in ready:
+                sid = by_oid[r.id]
+                value = ray_tpu.get(r)
+                _checkpoint(wf_dir, sid, value)
+                results[sid] = value
+                del pending[sid]
+    except Exception as e:
+        _write_json(os.path.join(wf_dir, "status.json"), {
+            "workflow_id": workflow_id, "status": "FAILED",
+            "num_steps": len(steps), "num_done": len(results),
+            "error": repr(e), "end_time": time.time(),
+        })
+        raise
+
+    output = results[ids[id(dag)]]
+    _checkpoint(wf_dir, "__output__", output)
+    _write_json(os.path.join(wf_dir, "status.json"), {
+        "workflow_id": workflow_id, "status": "SUCCESSFUL",
+        "num_steps": len(steps), "num_done": len(results),
+        "end_time": time.time(),
+    })
+    return output
+
+
+def resume(workflow_id: str) -> Any:
+    """Resume a previously started workflow from its checkpoints."""
+    wf_dir = storage_dir(workflow_id)
+    dag_path = os.path.join(wf_dir, "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise ValueError(f"workflow {workflow_id!r} not found")
+    done, output = _load_checkpoint(wf_dir, "__output__")
+    if done:
+        return output
+    with open(dag_path, "rb") as f:
+        dag = pickle.load(f)
+    return run(dag, workflow_id=workflow_id)
+
+
+def get_output(workflow_id: str) -> Any:
+    done, output = _load_checkpoint(storage_dir(workflow_id), "__output__")
+    if not done:
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status: {get_status(workflow_id)})")
+    return output
+
+
+def get_status(workflow_id: str) -> str:
+    path = os.path.join(storage_dir(workflow_id), "status.json")
+    if not os.path.exists(path):
+        return "NOT_FOUND"
+    with open(path) as f:
+        return json.load(f)["status"]
+
+
+def list_all(status_filter: Optional[str] = None) -> List[tuple]:
+    base = storage_dir()
+    out = []
+    if not os.path.isdir(base):
+        return out
+    for wid in sorted(os.listdir(base)):
+        status = get_status(wid)
+        if status == "NOT_FOUND":
+            continue
+        if status_filter is None or status == status_filter:
+            out.append((wid, status))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+    shutil.rmtree(storage_dir(workflow_id), ignore_errors=True)

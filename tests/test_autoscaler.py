@@ -1,0 +1,114 @@
+"""Autoscaler reconciler + FakeMultiNode provider.
+
+Reference parity: autoscaler/v2 reconciler (instance_manager/
+reconciler.py:53) — infeasible PG gang demand triggers node launches and
+the PG then schedules; idle launched nodes are terminated."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (Autoscaler, AutoscalerConfig,
+                                FakeMultiNodeProvider, NodeType,
+                                request_resources)
+from ray_tpu.util.placement_group import placement_group
+
+
+@pytest.fixture()
+def scaled_cluster():
+    ray_tpu.init(num_cpus=1)
+    provider = FakeMultiNodeProvider()
+    config = AutoscalerConfig(
+        node_types=[
+            NodeType("cpu-worker", {"CPU": 4.0}, max_workers=4),
+            NodeType("tpu-v5-host", {"CPU": 4.0, "TPU": 4.0,
+                                     "TPU-v5litepod-8-head": 1.0},
+                     max_workers=2),
+        ],
+        idle_timeout_s=2.0)
+    scaler = Autoscaler(provider, config)
+    yield scaler, provider
+    scaler.stop()
+    ray_tpu.shutdown()
+
+
+def test_infeasible_pg_triggers_scale_up(scaled_cluster):
+    scaler, provider = scaled_cluster
+    # A TPU gang PG: infeasible on the CPU-only head node.
+    pg = placement_group([{"TPU": 4.0}, {"TPU": 4.0}], strategy="SPREAD")
+    stats = scaler.reconcile_once()
+    assert stats["launched"] == 2          # one TPU host per bundle
+    assert pg.ready(timeout=120) is True
+
+
+def test_pending_tasks_trigger_scale_up_and_idle_scale_down(scaled_cluster):
+    scaler, provider = scaled_cluster
+
+    @ray_tpu.remote(num_cpus=4)
+    def heavy(x):
+        return x * 2
+
+    refs = [heavy.remote(i) for i in range(2)]
+    stats = scaler.reconcile_once()
+    assert stats["launched"] >= 1
+    assert sorted(ray_tpu.get(refs, timeout=180)) == [0, 2]
+
+    # drain + idle: nodes we launched get terminated after the timeout
+    deadline = time.time() + 60
+    terminated = 0
+    while time.time() < deadline:
+        terminated += scaler.reconcile_once()["terminated"]
+        if terminated >= stats["launched"] :
+            break
+        time.sleep(0.5)
+    assert terminated >= stats["launched"]
+    assert provider.non_terminated_nodes() == []
+
+
+def test_request_resources_hint(scaled_cluster):
+    scaler, provider = scaled_cluster
+    request_resources([{"CPU": 4.0}, {"CPU": 4.0}])
+    stats = scaler.reconcile_once()
+    assert stats["launched"] == 2
+    request_resources([])                   # clear the hint
+    # hinted nodes idle out
+    deadline = time.time() + 60
+    while provider.non_terminated_nodes() and time.time() < deadline:
+        scaler.reconcile_once()
+        time.sleep(0.5)
+    assert provider.non_terminated_nodes() == []
+
+
+def test_uncoverable_demand_is_reported_not_looped(scaled_cluster):
+    scaler, provider = scaled_cluster
+
+    @ray_tpu.remote(resources={"GPU": 8.0})
+    def impossible():
+        return 1
+
+    ref = impossible.remote()
+    stats = scaler.reconcile_once()
+    assert stats["launched"] == 0           # no node type covers GPU
+    del ref
+
+
+def test_pg_pinned_node_not_scaled_down(scaled_cluster):
+    scaler, provider = scaled_cluster
+    pg = placement_group([{"TPU": 4.0}], strategy="PACK")
+    assert scaler.reconcile_once()["launched"] == 1
+    assert pg.ready(timeout=120) is True
+    # the PG holds its bundle but runs nothing: node must survive idling
+    deadline = time.time() + 6      # > idle_timeout_s (2s)
+    while time.time() < deadline:
+        stats = scaler.reconcile_once()
+        assert stats["terminated"] == 0
+        time.sleep(0.5)
+    assert len(provider.non_terminated_nodes()) == 1
+    from ray_tpu.util.placement_group import remove_placement_group
+    remove_placement_group(pg)
+    deadline = time.time() + 30
+    while provider.non_terminated_nodes() and time.time() < deadline:
+        scaler.reconcile_once()
+        time.sleep(0.5)
+    assert provider.non_terminated_nodes() == []

@@ -1,0 +1,44 @@
+"""Worker-node join via `ray_tpu start --address` (reference parity:
+`ray start --address`, cluster bootstrap)."""
+
+
+def test_cli_worker_node_join():
+    """`ray_tpu start --address` joins a real worker-node daemon from a
+    separate process; tasks requiring its resources run there."""
+    import json
+    import subprocess
+    import sys
+    import time as _t
+
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=1)
+    try:
+        addr = f"{rt.controller.address[0]}:{rt.controller.address[1]}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "start", "--address", addr,
+             "--resources", json.dumps({"CPU": 2, "joiner": 1}),
+             "--labels", json.dumps({"autoscaler_node": "vm-test-1"})],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            deadline = _t.time() + 60
+            while _t.time() < deadline:
+                if any(n.get("labels", {}).get("autoscaler_node") ==
+                       "vm-test-1" for n in ray_tpu.nodes()):
+                    break
+                _t.sleep(0.25)
+            else:
+                raise AssertionError(f"worker node never joined: "
+                                     f"{ray_tpu.nodes()}")
+
+            @ray_tpu.remote(resources={"joiner": 1})
+            def where():
+                import os
+                return os.getpid()
+
+            assert isinstance(ray_tpu.get(where.remote(), timeout=120), int)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,90 @@
+"""Memory monitor + worker killing policy (reference parity:
+src/ray/common/memory_monitor.h:52, raylet/worker_killing_policy.h:39)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.daemon import (pick_worker_to_kill,
+                                     system_memory_usage)
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+class _W:
+    def __init__(self, state, spawn_time, task=None, actor_id=None):
+        self.state = state
+        self.spawn_time = spawn_time
+        self.current_task = task
+        self.actor_id = actor_id
+        self.pid = 0
+
+
+def test_system_memory_usage_reads_meminfo():
+    used, total = system_memory_usage()
+    assert 0 < used < total
+
+
+def test_policy_prefers_retriable_then_newest():
+    old_nonretriable = _W("busy", 1.0, {"max_retries": 0, "task_id": "a"})
+    new_nonretriable = _W("busy", 3.0, {"max_retries": 0, "task_id": "b"})
+    retriable = _W("busy", 2.0, {"max_retries": 2, "task_id": "c"})
+    actor = _W("actor", 9.0, None, actor_id="x")
+    # retriable beats newer non-retriable; actors only as last resort
+    assert pick_worker_to_kill(
+        [old_nonretriable, new_nonretriable, retriable, actor]) is retriable
+    assert pick_worker_to_kill(
+        [old_nonretriable, new_nonretriable, actor]) is new_nonretriable
+    assert pick_worker_to_kill([actor]) is actor
+    assert pick_worker_to_kill([_W("idle", 0.0)]) is None
+
+
+def test_oom_kill_fails_task_with_oom_error():
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        daemon = rt.head_daemon
+        # drive "memory usage" above the threshold artificially
+        daemon.memory_usage_fn = lambda: (99, 100)
+        daemon.memory_threshold = 0.9
+
+        @ray_tpu.remote
+        def hog():
+            time.sleep(60)
+            return 1
+
+        ref = hog.remote()
+        with pytest.raises(OutOfMemoryError, match="memory pressure"):
+            ray_tpu.get(ref, timeout=90)
+        assert daemon.oom_kills >= 1
+        # stop killing so shutdown is clean
+        daemon.memory_usage_fn = lambda: (0, 100)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_killed_retriable_task_retries():
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        daemon = rt.head_daemon
+        kills = {"n": 0}
+
+        def usage():
+            # over-threshold exactly once: first victim dies, retry runs
+            if kills["n"] < 1 and any(
+                    w.state == "busy" for w in daemon.workers.values()):
+                kills["n"] += 1
+                return (99, 100)
+            return (0, 100)
+
+        daemon.memory_usage_fn = usage
+        daemon.memory_threshold = 0.9
+
+        @ray_tpu.remote(max_retries=2)
+        def flaky_mem(x):
+            time.sleep(1.0)
+            return x + 1
+
+        assert ray_tpu.get(flaky_mem.remote(1), timeout=180) == 2
+        assert daemon.oom_kills >= 1
+    finally:
+        ray_tpu.shutdown()

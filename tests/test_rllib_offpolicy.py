@@ -1,0 +1,116 @@
+"""DQN / SAC / offline BC (reference parity: rllib/algorithms/dqn, sac,
+bc + offline_data — the off-policy & offline side of RLlib)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (BC, DQN, SAC, BCConfig, DQNConfig, ReplayBuffer,
+                           SACConfig, record_samples)
+
+
+def test_replay_buffer_ring_and_sample():
+    buf = ReplayBuffer(capacity=100, seed=0)
+    for start in range(0, 250, 50):
+        buf.add_batch({"x": np.arange(start, start + 50),
+                       "y": np.ones(50)})
+    assert len(buf) == 100
+    s = buf.sample(32)
+    assert s["x"].shape == (32,)
+    # ring kept only the newest 100 values
+    assert s["x"].min() >= 150
+
+
+def test_dqn_learns_cartpole():
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                           rollout_fragment_length=64)
+              .training(lr=1e-3, buffer_size=20_000, train_batch_size=128,
+                        num_updates_per_iter=16,
+                        num_steps_before_learning=500,
+                        target_network_update_freq=50, epsilon=0.15)
+              .debugging(seed=0))
+    algo = config.build()
+    first = None
+    best = -np.inf
+    for i in range(30):
+        m = algo.step()
+        if not np.isnan(m["episode_return_mean"]):
+            if first is None:
+                first = m["episode_return_mean"]
+            best = max(best, m["episode_return_mean"])
+    algo.cleanup()
+    assert first is not None
+    assert best > first + 15, (first, best)
+
+
+def test_sac_learns_pendulum():
+    config = (SACConfig()
+              .environment("Pendulum-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                           rollout_fragment_length=64)
+              .training(lr=3e-3, buffer_size=50_000, train_batch_size=256,
+                        num_updates_per_iter=32,
+                        num_steps_before_learning=1_000,
+                        action_scale=2.0)
+              .debugging(seed=0))
+    algo = config.build()
+    returns = []
+    for i in range(25):
+        m = algo.step()
+        if not np.isnan(m["episode_return_mean"]):
+            returns.append(m["episode_return_mean"])
+    algo.cleanup()
+    # pendulum returns start ~-1200..-1600; learning shows clear movement up
+    assert returns, "no episodes finished"
+    assert max(returns[5:]) > returns[0] + 150, returns
+
+
+def test_bc_from_recorded_samples(tmp_path):
+    # record a few rollouts from a PPO-style default policy
+    from ray_tpu.rllib import PPOConfig
+    config = (PPOConfig().environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           rollout_fragment_length=32))
+    algo = config.build()
+    for i in range(3):
+        result = algo.env_runner_group.sample()
+        record_samples(result["batch"], str(tmp_path / "data"),
+                       shard_index=i)
+    algo.cleanup()
+
+    bc_cfg = (BCConfig().environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                           rollout_fragment_length=32)
+              .offline_data(input_path=str(tmp_path / "data"))
+              .training(lr=1e-3, num_updates_per_iter=8))
+    bc = bc_cfg.build()
+    m1 = bc.step()
+    m2 = bc.step()
+    bc.cleanup()
+    # the BC loss (negative data log-likelihood) must drop
+    assert m2["learner/total_loss"] < m1["learner/total_loss"]
+
+
+def test_tpe_searcher_optimizes(ray_start):
+    """TPE beats random given the same budget on a smooth 2-d bowl."""
+    from ray_tpu import tune
+    from ray_tpu.tune import TPESearch
+
+    def objective(config):
+        x, y = config["x"], config["y"]
+        tune.report({"loss": (x - 0.3) ** 2 + (y + 0.5) ** 2})
+
+    space = {"x": tune.uniform(-2.0, 2.0), "y": tune.uniform(-2.0, 2.0)}
+    tpe = TPESearch(space, metric="loss", mode="min", num_samples=40,
+                    n_startup_trials=8, seed=0)
+    result = tune.run(objective, config=space, search_alg=tpe,
+                      metric="loss", mode="min", verbose=0)
+    best_tpe = result.get_best_result().metrics["loss"]
+    assert best_tpe < 0.5, best_tpe
+    # the model phase concentrates samples near the optimum: the late
+    # trials must average far below the random startup phase (a random
+    # search would stay ~3.0 throughout this space)
+    losses = [t.last_result["loss"] for t in result._trials
+              if t.last_result and "loss" in t.last_result]
+    assert np.mean(losses[25:]) < np.mean(losses[:8]) / 3, losses
