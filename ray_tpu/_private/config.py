@@ -1,0 +1,74 @@
+"""RayTpuConfig: the one place runtime knobs live.
+
+Reference parity: src/ray/common/ray_config_def.h:18-224 (RayConfig) —
+every tunable is declared once with its default and env override, rather
+than scattered os.environ reads. Values are read at import (matching the
+reference's process-start semantics); tests monkeypatch the instance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default, cast=None):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    cast = cast or type(default)
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass
+class RayTpuConfig:
+    # -- object plane --------------------------------------------------
+    #: cross-node fetch chunk size (bytes); RAY_TPU_FETCH_CHUNK
+    fetch_chunk_bytes: int = _env("RAY_TPU_FETCH_CHUNK", 32 << 20)
+    #: chunks in flight per fetch; RAY_TPU_FETCH_WINDOW
+    fetch_chunk_window: int = _env("RAY_TPU_FETCH_WINDOW", 4)
+    #: arena spill high/low water marks (fractions)
+    arena_spill_high: float = _env("RAY_TPU_ARENA_SPILL_HIGH", 0.85)
+    arena_spill_low: float = _env("RAY_TPU_ARENA_SPILL_LOW", 0.65)
+
+    # -- lineage / recovery -------------------------------------------
+    #: max producing-task specs retained for object reconstruction
+    lineage_cap: int = _env("RAY_TPU_LINEAGE_CAP", 10000)
+    #: byte bound on retained lineage specs
+    lineage_max_bytes: int = _env("RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20)
+
+    # -- node daemon ---------------------------------------------------
+    #: node memory fraction that triggers the OOM killer (<=0 disables)
+    memory_usage_threshold: float = _env(
+        "RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95)
+    #: pip runtime_env local wheel index (offline installs)
+    pip_find_links: Optional[str] = os.environ.get(
+        "RAY_TPU_PIP_FIND_LINKS")
+
+    # -- control plane ---------------------------------------------------
+    #: GCS persistence path ("" disables); RAY_TPU_GCS_PERSIST
+    gcs_persist_path: Optional[str] = os.environ.get("RAY_TPU_GCS_PERSIST")
+    #: bind host for every server in the process tree
+    bind_host: str = _env("RAY_TPU_BIND_HOST", "127.0.0.1")
+    #: advertised host when binding a wildcard address
+    advertise_host: Optional[str] = os.environ.get(
+        "RAY_TPU_ADVERTISE_HOST")
+
+    # -- workflows -------------------------------------------------------
+    #: durable workflow storage root
+    workflow_storage: str = _env("RAY_TPU_WORKFLOW_STORAGE",
+                                 "/tmp/ray_tpu/workflows")
+
+
+_config: Optional[RayTpuConfig] = None
+
+
+def get_config() -> RayTpuConfig:
+    global _config
+    if _config is None:
+        _config = RayTpuConfig()
+    return _config

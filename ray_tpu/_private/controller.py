@@ -908,7 +908,9 @@ class Controller:
     # -------------------------------------------------------------- pubsub
 
     async def rpc_subscribe(self, topic: str, addr) -> None:
-        self.subscribers.setdefault(topic, []).append(tuple(addr))
+        subs = self.subscribers.setdefault(topic, [])
+        if tuple(addr) not in subs:        # idempotent: clients refresh
+            subs.append(tuple(addr))
 
     async def rpc_publish(self, topic: str, message) -> int:
         subs = self.subscribers.get(topic, [])

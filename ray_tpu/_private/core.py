@@ -42,9 +42,11 @@ from ..exceptions import (ActorDiedError, ActorError, GetTimeoutError,
 
 logger = logging.getLogger(__name__)
 
+from .config import get_config
+
 # Cross-node object transfer: chunk size + number of chunks in flight.
-FETCH_CHUNK_BYTES = int(os.environ.get("RAY_TPU_FETCH_CHUNK", 32 << 20))
-FETCH_CHUNK_WINDOW = int(os.environ.get("RAY_TPU_FETCH_WINDOW", 4))
+FETCH_CHUNK_BYTES = get_config().fetch_chunk_bytes
+FETCH_CHUNK_WINDOW = get_config().fetch_chunk_window
 
 
 class LoopRunner:
@@ -221,15 +223,16 @@ class CoreClient:
         # return object_id -> producing task spec, kept after completion so
         # a lost object can be recomputed. Bounded FIFO.
         self._lineage: "OrderedDict[str, dict]" = OrderedDict()
-        self._lineage_cap = int(os.environ.get("RAY_TPU_LINEAGE_CAP", 10000))
+        self._lineage_cap = get_config().lineage_cap
         # byte bound too: specs retain args/fn blobs (reference parity:
         # RayConfig max_lineage_bytes)
-        self._lineage_max_bytes = int(os.environ.get(
-            "RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20))
+        self._lineage_max_bytes = get_config().lineage_max_bytes
         self._lineage_bytes = 0
         self._reconstructing: Dict[str, asyncio.Future] = {}
         # Streaming generators we own: generator_id -> StreamState.
         self._streams: Dict[str, "StreamState"] = {}
+        # pubsub topic -> callbacks (messages arrive via rpc_pubsub_message)
+        self._subscriptions: Dict[str, list] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -459,6 +462,36 @@ class CoreClient:
             if stream.cancelled:
                 self._streams.pop(generator_id, None)
         self._unpin_args(pending)
+
+    # --------------------------------------------------------------- pubsub
+
+    async def rpc_pubsub_message(self, topic: str, message) -> None:
+        for cb in self._subscriptions.get(topic, []):
+            try:
+                cb(message)
+            except Exception:
+                logger.exception("pubsub callback for %r failed", topic)
+
+    def subscribe(self, topic: str, callback) -> None:
+        """Register a callback for a pubsub topic (controller-brokered).
+        Registration is refreshed periodically: a transient controller
+        outage or a controller RESTART (whose subscriber table is
+        volatile) must not silently end the stream. The controller
+        dedupes, so refreshes are idempotent."""
+        first = not self._subscriptions
+        self._subscriptions.setdefault(topic, []).append(callback)
+        if first:
+            self.loop_runner.call_soon(self._subscription_keeper())
+
+    async def _subscription_keeper(self) -> None:
+        while not self.is_shutdown:
+            for topic in list(self._subscriptions):
+                try:
+                    await self._controller().call(
+                        "subscribe", topic=topic, addr=self.address)
+                except Exception:
+                    pass
+            await asyncio.sleep(5.0)
 
     async def rpc_ref_event(self, object_id: str, delta: int) -> None:
         self.ref_counter.on_borrower_event(object_id, delta)
@@ -861,6 +894,7 @@ class CoreClient:
             "actor_name": opts.get("name"),
             "namespace": opts.get("namespace") or self.namespace,
             "max_concurrency": opts.get("max_concurrency"),
+            "concurrency_groups": opts.get("concurrency_groups"),
             "max_restarts": opts.get("max_restarts", 0),
             "lifetime": opts.get("lifetime"),
             "runtime_env": opts.get("runtime_env"),
@@ -928,7 +962,8 @@ class CoreClient:
                     actor_id, method, args_blob, return_id, seq,
                     streaming=streaming,
                     backpressure=opts.get(
-                        "_generator_backpressure_num_objects"))
+                        "_generator_backpressure_num_objects"),
+                    concurrency_group=opts.get("concurrency_group"))
             finally:
                 for r in arg_refs:
                     self.ref_counter.unpin(r.id)
@@ -940,10 +975,12 @@ class CoreClient:
 
     async def _call_actor_inner(self, actor_id, method, args_blob,
                                 return_id, seq, streaming=False,
-                                backpressure=None):
+                                backpressure=None, concurrency_group=None):
             addr = None
             extra = ({"streaming": True, "owner_addr": self.address,
                       "backpressure": backpressure} if streaming else {})
+            if concurrency_group:
+                extra["concurrency_group"] = concurrency_group
 
             def _fail(err):
                 self.memory_store.put_error(return_id, err)

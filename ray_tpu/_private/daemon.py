@@ -142,10 +142,14 @@ class NodeDaemon:
         # Memory monitor (reference parity: memory_monitor.h:52): kill a
         # worker when node memory passes the threshold. usage fn is
         # injectable for tests. Threshold <= 0 disables.
+        from .config import get_config
         self.memory_usage_fn = system_memory_usage
-        self.memory_threshold = float(os.environ.get(
-            "RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95))
+        self.memory_threshold = get_config().memory_usage_threshold
         self.oom_kills = 0
+        # Log monitor (reference parity: _private/log_monitor.py): tail
+        # each worker's log file and publish new lines on the controller
+        # pubsub so drivers can print them (`(worker pid=...) ...`).
+        self._log_offsets: Dict[str, int] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -234,8 +238,8 @@ class NodeDaemon:
                             return 0, b""
                         cmd = [sys.executable, "-m", "pip", "install",
                                "--target", target, "--quiet"]
-                        find_links = os.environ.get(
-                            "RAY_TPU_PIP_FIND_LINKS")
+                        from .config import get_config as _gc
+                        find_links = _gc().pip_find_links
                         if find_links:
                             cmd += ["--no-index", "--find-links",
                                     find_links]
@@ -502,6 +506,52 @@ class NodeDaemon:
         except Exception:
             pass
 
+    def _worker_log_path(self, worker_id: str) -> str:
+        return os.path.join(self.temp_dir, "logs",
+                            f"worker-{worker_id[:12]}.log")
+
+    async def _pump_worker_logs(self, controller) -> None:
+        """Publish new worker-log lines (bounded per tick) to the driver
+        log topic (reference parity: _private/log_monitor.py tailing)."""
+        for handle in list(self.workers.values()):
+            await self._pump_one_log(controller, handle)
+
+    async def _pump_one_log(self, controller, handle,
+                            final: bool = False) -> None:
+        """Tail one worker's log. `final` (worker died) drains to EOF
+        including an unterminated last line — the crash output matters
+        most; otherwise partial lines are held for the next tick."""
+        path = self._worker_log_path(handle.worker_id)
+        for _ in range(64 if final else 4):      # chunks per tick, bounded
+            offset = self._log_offsets.get(handle.worker_id, 0)
+            try:
+                if os.path.getsize(path) <= offset:
+                    return
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(64 << 10)
+            except OSError:
+                return
+            full = len(chunk) == (64 << 10)
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                if not (final or full):
+                    return                       # hold the partial line
+                cut = len(chunk) - 1
+            elif final and cut < len(chunk) - 1:
+                cut = len(chunk) - 1             # flush the tail too
+            self._log_offsets[handle.worker_id] = offset + cut + 1
+            text = chunk[:cut + 1].decode("utf-8", errors="replace")
+            try:
+                await controller.oneway(
+                    "publish", topic="__worker_logs__",
+                    message={"node_id": self.node_id, "pid": handle.pid,
+                             "worker_id": handle.worker_id, "data": text})
+            except Exception:
+                return
+            if not full:
+                return
+
     async def _check_memory_pressure(self) -> None:
         """Kill one worker per tick while above the threshold (reference
         parity: memory_monitor.h:52 + worker_killing_policy.h:39)."""
@@ -596,6 +646,24 @@ class NodeDaemon:
                              else "segment")}
                 for oid, e in self.object_store._entries.items()]
 
+    async def rpc_node_stacks(self) -> str:
+        """Thread stacks of this daemon's process and every live worker
+        (py-spy-equivalent; reference: reporter profile_manager)."""
+        from ..util.profiling import dump_stacks
+        parts = [f"=== daemon {self.node_id[:12]} (pid {os.getpid()}) ===",
+                 dump_stacks()]
+        for handle in list(self.workers.values()):
+            if handle.state == "dead" or handle.addr is None:
+                continue
+            parts.append(f"=== worker pid {handle.pid} "
+                         f"({handle.state}) ===")
+            try:
+                parts.append(await asyncio.wait_for(
+                    self.pool.get(handle.addr).call("dump_stacks"), 5.0))
+            except Exception as e:
+                parts.append(f"<unreachable: {e!r}>")
+        return "\n".join(parts)
+
     async def rpc_node_stats(self) -> dict:
         return {
             "node_id": self.node_id,
@@ -613,8 +681,9 @@ class NodeDaemon:
 
     async def _monitor_loop(self) -> None:
         controller = self.pool.get(self.controller_addr)
-        high = float(os.environ.get("RAY_TPU_ARENA_SPILL_HIGH", 0.85))
-        low = float(os.environ.get("RAY_TPU_ARENA_SPILL_LOW", 0.65))
+        from .config import get_config
+        high = get_config().arena_spill_high
+        low = get_config().arena_spill_low
         while not self._closed:
             await asyncio.sleep(0.5)
             try:
@@ -664,11 +733,18 @@ class NodeDaemon:
                     await asyncio.get_running_loop().run_in_executor(
                         None, self.object_store.spill_until, target)
             await self._check_memory_pressure()
+            await self._pump_worker_logs(controller)
             for handle in list(self.workers.values()):
                 if handle.state == "dead":
+                    await self._pump_one_log(controller, handle,
+                                             final=True)
+                    self._log_offsets.pop(handle.worker_id, None)
                     self.workers.pop(handle.worker_id, None)
                     continue
                 if handle.proc.poll() is not None:
+                    await self._pump_one_log(controller, handle,
+                                             final=True)
+                    self._log_offsets.pop(handle.worker_id, None)
                     prev_state = handle.state
                     handle.state = "dead"
                     pool = self.idle.get(handle.env_key, [])

@@ -45,6 +45,7 @@ def init(address: Optional[str] = None,
          namespace: str = "default",
          ignore_reinit_error: bool = False,
          local_mode: bool = False,
+         log_to_driver: Optional[bool] = None,
          _prestart_workers: int = 0,
          **_ignored) -> "Runtime":
     global _runtime
@@ -85,6 +86,11 @@ def init(address: Optional[str] = None,
                             namespace=namespace)
         client.start()
         state.set_client(client)
+        # Attached drivers share the cluster's single log topic with the
+        # head driver, so log streaming is opt-in for them (the head
+        # driver gets it by default).
+        if log_to_driver:
+            _attach_log_stream(client)
         _runtime = Runtime(client, None, None, loop_runner,
                            info["session_name"])
         atexit.register(shutdown)
@@ -127,6 +133,8 @@ def init(address: Optional[str] = None,
                         namespace=namespace)
     client.start()
     state.set_client(client)
+    if log_to_driver is None or log_to_driver:
+        _attach_log_stream(client)
     _runtime = Runtime(client, controller, head_daemon, loop_runner,
                        session_name)
     if _prestart_workers:
@@ -136,6 +144,24 @@ def init(address: Optional[str] = None,
     atexit.register(shutdown)
     return _runtime
 
+
+
+
+def _attach_log_stream(client) -> None:
+    """Print worker log lines on the driver (reference parity:
+    log_monitor + worker_process_out streaming to the driver)."""
+    import sys
+
+    def _print(message):
+        try:
+            pid = message.get("pid")
+            for line in message.get("data", "").splitlines():
+                sys.stderr.write(f"(worker pid={pid}) {line}\n")
+            sys.stderr.flush()
+        except Exception:
+            pass
+
+    client.subscribe("__worker_logs__", _print)
 
 def shutdown() -> None:
     global _runtime

@@ -38,7 +38,8 @@ logger = logging.getLogger(__name__)
 
 class ActorState:
     def __init__(self, actor_id: str, instance: Any,
-                 max_concurrency: Optional[int]):
+                 max_concurrency: Optional[int],
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.actor_id = actor_id
         self.instance = instance
         # Defaults mirror the reference: sync actors 1, async actors 1000 —
@@ -50,11 +51,40 @@ class ActorState:
             max_workers=self.max_concurrency,
             thread_name_prefix=f"actor-{actor_id[:8]}")
         self.async_semaphore = asyncio.Semaphore(self.max_concurrency)
+        # Concurrency groups (reference parity: core_worker concurrency
+        # groups / task_receiver.h ExecuteConcurrencyGroup): each named
+        # group gets its own executor of the declared width, so e.g. an
+        # "io" group keeps serving while the default group is saturated.
+        self.group_executors: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
+        self.group_semaphores: Dict[str, asyncio.Semaphore] = {}
+        for name, width in (concurrency_groups or {}).items():
+            width = max(1, int(width))
+            self.group_executors[name] = \
+                concurrent.futures.ThreadPoolExecutor(
+                    max_workers=width,
+                    thread_name_prefix=f"actor-{actor_id[:8]}-{name}")
+            self.group_semaphores[name] = asyncio.Semaphore(width)
         # Per-caller admission ordering (reference parity:
         # src/ray/core_worker/transport/actor_scheduling_queue.h): calls are
         # admitted to the executor strictly in the caller's submission order.
         self.next_seq: Dict[str, int] = {}
         self.seq_cond = asyncio.Condition()
+
+    def executor_for(self, group: Optional[str]):
+        if group:
+            ex = self.group_executors.get(group)
+            if ex is None:
+                raise ValueError(f"unknown concurrency group {group!r}")
+            return ex
+        return self.executor
+
+    def semaphore_for(self, group: Optional[str]):
+        if group:
+            sem = self.group_semaphores.get(group)
+            if sem is None:
+                raise ValueError(f"unknown concurrency group {group!r}")
+            return sem
+        return self.async_semaphore
 
     async def admit(self, caller: str, seq) -> None:
         if seq is None or caller is None:
@@ -101,8 +131,26 @@ class WorkerRuntime:
         client.server.register("skip_actor_seq", self.rpc_skip_actor_seq)
         client.server.register("stream_ack", self.rpc_stream_ack)
         client.server.register("stream_cancel", self.rpc_stream_cancel)
+        client.server.register("dump_stacks", self.rpc_dump_stacks)
+        client.server.register("memory_summary", self.rpc_memory_summary)
+        # Function cache (reference parity: function manager / fn export
+        # via GCS KV): the same task function is deserialized once per
+        # worker, not once per invocation — cloudpickle.loads of a big
+        # closure dominates small-task latency otherwise.
+        self._fn_cache: Dict[bytes, Any] = {}
         # generator_id -> [acked_count, waiter_event, cancelled]
         self._stream_acks: Dict[str, list] = {}
+
+    def _deserialize_fn(self, blob: bytes):
+        import hashlib
+        key = hashlib.sha1(blob).digest()
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            fn = deserialize_code(blob)
+            if len(self._fn_cache) >= 256:
+                self._fn_cache.pop(next(iter(self._fn_cache)))
+            self._fn_cache[key] = fn
+        return fn
 
     # ------------------------------------------------------------- helpers
 
@@ -173,7 +221,7 @@ class WorkerRuntime:
         streaming = spec.get("num_returns") == "streaming"
         try:
             self._apply_tpu_isolation(spec)
-            fn = deserialize_code(spec["fn_blob"])
+            fn = self._deserialize_fn(spec["fn_blob"])
             args, kwargs = await self._resolve_args(spec["args_blob"])
             from ..util.tracing import span
             with span(spec.get("name", "task"), "task::execute",
@@ -375,7 +423,8 @@ class WorkerRuntime:
                 task_id=spec["task_id"])
             return {"status": "error", "error_tb": tb}
         self.actors[actor_id] = ActorState(
-            actor_id, instance, spec.get("max_concurrency"))
+            actor_id, instance, spec.get("max_concurrency"),
+            spec.get("concurrency_groups"))
         if not spec.get("is_restart"):
             await self._push_result(spec["owner_addr"], spec["return_id"],
                                     None, task_id=spec["task_id"])
@@ -384,7 +433,8 @@ class WorkerRuntime:
     async def rpc_call_actor(self, actor_id: str, method: str,
                              args_blob: bytes, caller=None,
                              seq=None, return_id=None, streaming=False,
-                             owner_addr=None, backpressure=None) -> dict:
+                             owner_addr=None, backpressure=None,
+                             concurrency_group=None) -> dict:
         actor = self.actors.get(actor_id)
         if actor is None:
             return {"status": "error",
@@ -411,8 +461,9 @@ class WorkerRuntime:
                 # otherwise the next call's executor job could be queued
                 # ahead of the generator body.
                 asyncio.ensure_future(
-                    self._stream_results(spec, gen,
-                                         executor=actor.executor))
+                    self._stream_results(
+                        spec, gen,
+                        executor=actor.executor_for(concurrency_group)))
                 await asyncio.sleep(0)
                 await actor.admitted(caller, seq)
                 return {"status": "streaming"}
@@ -442,13 +493,16 @@ class WorkerRuntime:
                 fn = getattr(actor.instance, method)
                 await actor.admit(caller, seq)
                 if inspect.iscoroutinefunction(fn):
+                    sem = actor.semaphore_for(concurrency_group)
+
                     async def _run():
-                        async with actor.async_semaphore:
+                        async with sem:
                             return await fn(*args, **kwargs)
                     work = asyncio.ensure_future(_run())
                 else:
                     work = loop.run_in_executor(
-                        actor.executor, lambda: fn(*args, **kwargs))
+                        actor.executor_for(concurrency_group),
+                        lambda: fn(*args, **kwargs))
                 await actor.admitted(caller, seq)
                 result = await work
         except Exception:
@@ -475,6 +529,14 @@ class WorkerRuntime:
         actor = self.actors.get(actor_id)
         if actor is not None:
             await actor.admitted(caller, seq)
+
+    async def rpc_dump_stacks(self) -> str:
+        from ..util.profiling import dump_stacks
+        return dump_stacks()
+
+    async def rpc_memory_summary(self) -> dict:
+        from ..util.profiling import memory_summary
+        return memory_summary()
 
     async def rpc_shutdown_worker(self) -> dict:
         asyncio.get_running_loop().call_later(0.05, sys.exit, 0)

@@ -43,6 +43,7 @@ class DashboardHead:
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/placement_groups", self._pgs)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/api/profile/stacks", self._profile_stacks)
         app.router.add_post("/api/jobs", self._submit_job)
         app.router.add_get("/api/jobs", self._list_jobs)
         app.router.add_get("/api/jobs/{job_id}", self._get_job)
@@ -53,6 +54,10 @@ class DashboardHead:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
+        # port=0 binds an ephemeral port: report the one actually bound
+        sockets = getattr(site._server, "sockets", None) or []
+        if sockets:
+            self.port = sockets[0].getsockname()[1]
         return self.port
 
     async def stop(self) -> None:
@@ -75,20 +80,52 @@ class DashboardHead:
     # -- state routes -------------------------------------------------------
     async def _index(self, request):
         from aiohttp import web
-        return web.json_response({
-            "service": "ray_tpu dashboard",
-            "routes": ["/api/cluster_status", "/api/nodes", "/api/tasks",
-                       "/api/actors", "/api/objects",
-                       "/api/placement_groups", "/api/jobs", "/metrics"]})
+
+        from .ui import INDEX_HTML
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     async def _cluster_status(self, request):
         import ray_tpu
-        total = await self._in_thread(ray_tpu.cluster_resources)
-        avail = await self._in_thread(ray_tpu.available_resources)
-        nodes = await self._in_thread(ray_tpu.nodes)
-        return self._json({"cluster_resources": total,
-                           "available_resources": avail,
-                           "num_nodes": len(nodes)})
+
+        from ..util import state as state_api
+        # five independent control-plane reads, fetched concurrently
+        total, avail, nodes, actors, tasks = await asyncio.gather(
+            self._in_thread(ray_tpu.cluster_resources),
+            self._in_thread(ray_tpu.available_resources),
+            self._in_thread(ray_tpu.nodes),
+            self._in_thread(state_api.list_actors),
+            self._in_thread(state_api.list_tasks))
+        return self._json({
+            "cluster_resources": total,
+            "available_resources": avail,
+            "num_nodes": len(nodes),
+            "nodes_alive": sum(1 for n in nodes if n.get("alive")),
+            "num_actors": sum(1 for a in actors
+                              if a.get("state") == "ALIVE"),
+            "num_pending_tasks": sum(
+                1 for t in tasks
+                if t.get("state", "").startswith("PENDING")),
+        })
+
+    async def _profile_stacks(self, request):
+        """py-spy-equivalent: live thread stacks of the head + every
+        worker on every node (reference parity:
+        dashboard/modules/reporter/profile_manager.py)."""
+        from ray_tpu._private import state as pstate
+        client = pstate.current_client()
+        out = []
+        for node in await self._in_thread(
+                lambda: client.controller_rpc("list_nodes")):
+            if not node.get("alive") or not node.get("addr"):
+                continue
+            try:
+                stacks = await self._in_thread(
+                    lambda a=node["addr"]: client.daemon_rpc(
+                        a, "node_stacks"))
+            except Exception as e:
+                stacks = f"<unreachable: {e!r}>"
+            out.append({"node_id": node["node_id"], "stacks": stacks})
+        return self._json({"nodes": out})
 
     async def _nodes(self, request):
         from ..util import state as state_api

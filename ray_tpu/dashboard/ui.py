@@ -1,0 +1,197 @@
+"""Dashboard frontend: one self-contained HTML page (no external assets —
+this environment has zero egress, and one file keeps the dashboard
+deployable anywhere the head runs).
+
+Reference parity: python/ray/dashboard/client (the React SPA) reduced to
+the tables that matter: cluster summary, nodes, actors, tasks, placement
+groups, jobs, objects — live against the existing REST API — plus the
+stack-dump profiler view (reference: dashboard/modules/reporter).
+"""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { --bg:#0f1318; --panel:#171d26; --line:#2a3340; --fg:#dce3ec;
+          --dim:#8a96a8; --acc:#5aa9e6; --ok:#57c78a; --bad:#e66a6a; }
+  * { box-sizing:border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:13px/1.5 ui-monospace,Menlo,Consolas,monospace; }
+  header { display:flex; align-items:center; gap:16px;
+           padding:10px 18px; border-bottom:1px solid var(--line); }
+  header h1 { font-size:15px; margin:0; color:var(--acc); }
+  header .dim { color:var(--dim); font-size:12px; }
+  nav { display:flex; gap:4px; padding:8px 14px 0; }
+  nav button { background:none; border:1px solid var(--line);
+               border-bottom:none; border-radius:6px 6px 0 0;
+               color:var(--dim); padding:6px 14px; cursor:pointer;
+               font:inherit; }
+  nav button.on { color:var(--fg); background:var(--panel); }
+  main { padding:14px 18px; }
+  .cards { display:flex; gap:12px; flex-wrap:wrap; margin-bottom:14px; }
+  .card { background:var(--panel); border:1px solid var(--line);
+          border-radius:8px; padding:10px 16px; min-width:130px; }
+  .card .k { color:var(--dim); font-size:11px; text-transform:uppercase; }
+  .card .v { font-size:20px; margin-top:2px; }
+  table { width:100%; border-collapse:collapse; background:var(--panel);
+          border:1px solid var(--line); border-radius:8px; overflow:hidden; }
+  th, td { text-align:left; padding:6px 10px;
+           border-bottom:1px solid var(--line); font-size:12px; }
+  th { color:var(--dim); font-weight:normal; text-transform:uppercase;
+       font-size:11px; }
+  tr:last-child td { border-bottom:none; }
+  .ok { color:var(--ok); } .bad { color:var(--bad); }
+  pre { background:var(--panel); border:1px solid var(--line);
+        border-radius:8px; padding:12px; white-space:pre-wrap;
+        font-size:11px; max-height:70vh; overflow:auto; }
+  .dim { color:var(--dim); }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="dim" id="session"></span>
+  <span class="dim" id="updated" style="margin-left:auto"></span>
+</header>
+<nav id="tabs"></nav>
+<main id="main"></main>
+<script>
+const TABS = ["cluster","nodes","actors","tasks","placement_groups",
+              "jobs","objects","profile"];
+let tab = location.hash.slice(1) || "cluster";
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? "").replace(/[&<>]/g,
+    c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+
+function renderTabs() {
+  $("tabs").innerHTML = TABS.map(t =>
+    `<button class="${t===tab?"on":""}" onclick="setTab('${t}')">`
+    + `${t.replace("_"," ")}</button>`).join("");
+}
+function setTab(t) { tab = t; location.hash = t; renderTabs(); refresh(); }
+
+async function api(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: ${r.status}`);
+  return r.json();
+}
+function table(rows, cols) {
+  if (!rows || !rows.length) return `<p class="dim">none</p>`;
+  const head = cols.map(c => `<th>${c[0]}</th>`).join("");
+  const body = rows.map(r =>
+    `<tr>${cols.map(c => `<td>${c[1](r)}</td>`).join("")}</tr>`).join("");
+  return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+}
+const shortid = (s) => `<span title="${esc(s)}">${esc(String(s||"").slice(0,12))}</span>`;
+const alive = (a) => a ? `<span class="ok">ALIVE</span>`
+                       : `<span class="bad">DEAD</span>`;
+const fmtRes = (r) => esc(Object.entries(r||{})
+    .map(([k,v]) => `${k}:${Math.round(v*100)/100}`).join(" "));
+
+const VIEWS = {
+  async cluster() {
+    const s = await api("/api/cluster_status");
+    const cards = Object.entries({
+      "nodes": s.nodes_alive ?? (s.nodes||[]).length,
+      "CPUs": (s.cluster_resources||{}).CPU ?? "-",
+      "TPUs": (s.cluster_resources||{}).TPU ?? 0,
+      "CPUs free": (s.available_resources||{}).CPU ?? "-",
+      "actors": s.num_actors ?? "-",
+      "pending tasks": s.num_pending_tasks ?? "-",
+    }).map(([k,v]) =>
+      `<div class="card"><div class="k">${k}</div><div class="v">${v}</div></div>`);
+    return `<div class="cards">${cards.join("")}</div>`
+      + `<pre>${esc(JSON.stringify(s, null, 2))}</pre>`;
+  },
+  async nodes() {
+    const rows = await api("/api/nodes");
+    return table(rows, [
+      ["node", r => shortid(r.node_id)],
+      ["state", r => alive(r.alive)],
+      ["addr", r => esc((r.addr||[]).join(":"))],
+      ["total", r => fmtRes(r.resources_total)],
+      ["available", r => fmtRes(r.resources_available)],
+      ["labels", r => fmtRes(r.labels)],
+    ]);
+  },
+  async actors() {
+    const rows = await api("/api/actors");
+    return table(rows, [
+      ["actor", r => shortid(r.actor_id)],
+      ["class", r => esc(r.class_name)],
+      ["name", r => esc(r.name || "")],
+      ["state", r => r.state === "ALIVE" ? `<span class="ok">ALIVE</span>`
+          : r.state === "DEAD" ? `<span class="bad">DEAD</span>` : esc(r.state)],
+      ["node", r => shortid(r.node_id)],
+      ["restarts", r => r.restarts],
+    ]);
+  },
+  async tasks() {
+    const rows = await api("/api/tasks");
+    rows.sort((a,b) => (b.creation_time||0)-(a.creation_time||0));
+    return table(rows.slice(0,200), [
+      ["task", r => shortid(r.task_id)],
+      ["name", r => esc(r.name)],
+      ["type", r => esc(r.type)],
+      ["state", r => r.state === "FINISHED" ? `<span class="ok">FINISHED</span>`
+          : r.state === "FAILED" ? `<span class="bad">FAILED</span>` : esc(r.state)],
+      ["node", r => shortid(r.node_id)],
+    ]);
+  },
+  async placement_groups() {
+    const data = await api("/api/placement_groups");
+    const rows = Object.values(data);
+    return table(rows, [
+      ["pg", r => shortid(r.placement_group_id)],
+      ["name", r => esc(r.name)],
+      ["strategy", r => esc(r.strategy)],
+      ["state", r => r.state === "CREATED" ? `<span class="ok">CREATED</span>`
+          : esc(r.state)],
+      ["bundles", r => (r.bundles||[]).length],
+    ]);
+  },
+  async jobs() {
+    const rows = await api("/api/jobs");
+    return table(rows, [
+      ["job", r => shortid(r.job_id || r.submission_id)],
+      ["status", r => r.status === "SUCCEEDED" ? `<span class="ok">SUCCEEDED</span>`
+          : r.status === "FAILED" ? `<span class="bad">FAILED</span>` : esc(r.status)],
+      ["entrypoint", r => esc(String(r.entrypoint||"").slice(0,80))],
+    ]);
+  },
+  async objects() {
+    const rows = await api("/api/objects");
+    return table(rows.slice(0,200), [
+      ["object", r => shortid(r.object_id)],
+      ["size", r => `${Math.round((r.size||0)/1024)} KiB`],
+      ["backend", r => esc(r.backend)],
+      ["node", r => shortid(r.node_id)],
+    ]);
+  },
+  async profile() {
+    const data = await api("/api/profile/stacks");
+    const blocks = (data.nodes||[]).map(n =>
+      `<h3 class="dim">node ${esc(String(n.node_id).slice(0,12))}</h3>`
+      + `<pre>${esc(n.stacks)}</pre>`).join("");
+    return `<p class="dim">live thread stacks across the cluster
+      (py-spy-equivalent; refreshed on tab load)</p>` + blocks;
+  },
+};
+
+async function refresh() {
+  try {
+    $("main").innerHTML = await VIEWS[tab]();
+    $("updated").textContent = "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    $("main").innerHTML = `<p class="bad">${esc(e)}</p>`;
+  }
+}
+renderTabs();
+refresh();
+setInterval(() => { if (tab !== "profile") refresh(); }, 3000);
+</script>
+</body>
+</html>
+"""

@@ -17,6 +17,7 @@ _VALID_OPTS = {
     "max_concurrency", "max_restarts", "lifetime", "namespace",
     "placement_group", "placement_group_bundle_index",
     "_generator_backpressure_num_objects",
+    "concurrency_groups", "concurrency_group",
 }
 
 

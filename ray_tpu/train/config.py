@@ -22,6 +22,12 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"   # STRICT_SPREAD for multi-host TPU
     accelerator_type: Optional[str] = None   # e.g. "v5p-64"
+    # Elastic training (reference parity: Train v2 ScalingPolicy): when
+    # set, each (re)start runs with as many workers as the cluster can
+    # place in [min_workers, num_workers] instead of blocking on the full
+    # gang — shrink on failures/lost nodes, grow back on later restarts.
+    # The train loop must derive its data sharding from ctx.world_size.
+    min_workers: Optional[int] = None
 
     def worker_bundle(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -169,23 +169,83 @@ class JaxTrainer:
             starting_ckpt = manager.latest or starting_ckpt
             time.sleep(1.0)
 
+    def _reserve_workers(self):
+        """Reserve this attempt's worker gang. Fixed-size: the full
+        num_workers PG or error. Elastic (min_workers set): try sizes from
+        num_workers down to min_workers, running with the largest gang the
+        cluster can place NOW (reference parity: Train v2 ScalingPolicy
+        elastic resize). Returns (pg, n) or (None, error)."""
+        sc = self.scaling_config
+        sizes = [sc.num_workers]
+        if sc.min_workers is not None:
+            lo = max(1, sc.min_workers)
+            # Seed the probe at what the cluster can fit RIGHT NOW (cheap
+            # resource arithmetic), then step down a few sizes to absorb
+            # placement races — never a linear scan of 15s timeouts.
+            fit = self._max_placeable(sc.worker_bundle())
+            start = max(min(sc.num_workers, fit), lo)
+            sizes = sorted({start, max(start - 1, lo),
+                            max(start // 2, lo), lo}, reverse=True)
+        last_err: Optional[Exception] = None
+        for i, n in enumerate(sizes):
+            pg = placement_group([sc.worker_bundle() for _ in range(n)],
+                                 strategy=sc.placement_strategy)
+            # full size gets the patient timeout; elastic shrink probes
+            # must fail fast so recovery isn't serialized 120s per size
+            timeout = 120 if i == 0 and len(sizes) == 1 else 15
+            try:
+                if pg.ready(timeout=timeout):
+                    return (pg, n), None
+            except Exception as e:
+                last_err = e
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
+        return None, (last_err or RayTpuError(
+            f"no worker gang in [{sizes[-1]}, {sizes[0]}] x "
+            f"{self.scaling_config.worker_bundle()} placeable"))
+
+    def _max_placeable(self, bundle: Dict[str, float]) -> int:
+        """How many copies of `bundle` the cluster can place NOW under the
+        configured strategy: STRICT_PACK = all bundles on one node (max
+        per-node fit); STRICT_SPREAD = one bundle per node (count of
+        fitting nodes); PACK/SPREAD are soft (sum of per-node fits)."""
+        from ray_tpu._private import state
+        strategy = self.scaling_config.placement_strategy
+        per_node = []
+        try:
+            nodes = state.current_client().nodes()
+        except Exception:
+            return 1
+        for node in nodes:
+            if not node.get("alive", True):
+                continue
+            avail = node.get("resources_available") or {}
+            fits = min((int(avail.get(k, 0.0) // v)
+                        for k, v in bundle.items() if v > 0),
+                       default=0)
+            per_node.append(max(fits, 0))
+        if not per_node:
+            return 1
+        if strategy == "STRICT_PACK":
+            total = max(per_node)
+        elif strategy == "STRICT_SPREAD":
+            total = sum(1 for f in per_node if f > 0)
+        else:
+            total = sum(per_node)
+        return max(total, 1)
+
     def _run_attempt(self, manager: CheckpointManager,
                      starting_ckpt: Optional[Checkpoint],
                      history: List[Dict[str, Any]]) -> Optional[Exception]:
         sc = self.scaling_config
-        n = sc.num_workers
-        pg = placement_group([sc.worker_bundle() for _ in range(n)],
-                             strategy=sc.placement_strategy)
         workers = []
+        reserved, err = self._reserve_workers()
+        if reserved is None:
+            return err
+        pg, n = reserved
         try:
-            try:
-                if not pg.ready(timeout=120):
-                    return RayTpuError(
-                        f"placement group for {n} workers not placeable "
-                        f"within 120s (cluster short on "
-                        f"{sc.worker_bundle()})")
-            except Exception as e:
-                return e
             coordinator = "127.0.0.1:35123" if self.bootstrap_jax else None
             WorkerCls = ray_tpu.remote(TrainWorker)
             worker_res = sc.worker_bundle()

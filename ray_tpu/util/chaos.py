@@ -1,0 +1,140 @@
+"""Chaos / fault-injection utilities for resilience testing.
+
+Reference parity: src/ray/common/test/rpc_chaos.h:28 (RpcChaos) and the
+reference's chaos-testing harnesses (kill-raylet / kill-worker test
+utils) — first-class helpers so FT tests (and users validating their
+recovery stories) don't hand-roll process surgery.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import time
+from typing import List, Optional
+
+from .._private import state
+
+
+def _runtime():
+    from .._private.worker import current_runtime
+    rt = current_runtime()
+    if rt is None:
+        raise RuntimeError("chaos utils need an initialized session")
+    return rt
+
+
+def list_worker_pids(node_id: Optional[str] = None) -> List[int]:
+    """PIDs of live worker processes (optionally one node's)."""
+    rt = _runtime()
+    pids = []
+    for daemon in [rt.head_daemon] + list(rt.extra_daemons):
+        if daemon is None or (node_id and daemon.node_id != node_id):
+            continue
+        pids.extend(w.pid for w in daemon.workers.values()
+                    if w.state != "dead")
+    return pids
+
+
+def kill_worker(pid: Optional[int] = None, sig: int = signal.SIGKILL) -> int:
+    """Kill one worker process (random busy/actor worker by default).
+    Returns the pid killed."""
+    rt = _runtime()
+    if pid is None:
+        candidates = []
+        for daemon in [rt.head_daemon] + list(rt.extra_daemons):
+            if daemon is None:
+                continue
+            candidates.extend(w.pid for w in daemon.workers.values()
+                              if w.state in ("busy", "actor"))
+        if not candidates:
+            candidates = list_worker_pids()
+        if not candidates:
+            raise RuntimeError("no workers to kill")
+        pid = random.choice(candidates)
+    os.kill(pid, sig)
+    return pid
+
+
+def kill_actor_worker(actor_handle) -> bool:
+    """SIGKILL the worker hosting an actor (exercises max_restarts)."""
+    rt = _runtime()
+    actor_id = actor_handle._actor_id
+    for daemon in [rt.head_daemon] + list(rt.extra_daemons):
+        if daemon is None:
+            continue
+        for w in daemon.workers.values():
+            if w.actor_id == actor_id and w.state == "actor":
+                os.kill(w.pid, signal.SIGKILL)
+                return True
+    return False
+
+
+def kill_node(node_id: str) -> bool:
+    """Stop a fake node's daemon (workers die with it)."""
+    from .._private.worker import remove_node
+    return remove_node(node_id)
+
+
+def partition_node(node_id: str, duration_s: float) -> None:
+    """Simulate a network blip: pause the node's daemon heartbeats by
+    SIGSTOP/SIGCONT on its worker... the in-process daemon has no pid of
+    its own, so this simply blocks its monitor loop via a time fence."""
+    rt = _runtime()
+    for daemon in [rt.head_daemon] + list(rt.extra_daemons):
+        if daemon is not None and daemon.node_id == node_id:
+            import asyncio
+
+            # All asyncio mutation happens ON the loop (Task.cancel is
+            # not thread-safe): cancel the monitor loop, sleep, restart.
+            async def blip():
+                task = daemon._monitor_task
+                if task is not None:
+                    task.cancel()
+                await asyncio.sleep(duration_s)
+                daemon._monitor_task = asyncio.ensure_future(
+                    daemon._monitor_loop())
+
+            rt.loop_runner.call_soon(blip())
+            return
+    raise ValueError(f"unknown node {node_id!r}")
+
+
+class RpcChaos:
+    """Inject failures into the RPC layer (reference: rpc_chaos.h).
+
+    with RpcChaos(failure_rate=0.1): every 10th-ish outgoing call raises
+    ConnectionLost before hitting the wire — exercises retry paths.
+    """
+
+    def __init__(self, failure_rate: float = 0.1,
+                 methods: Optional[List[str]] = None,
+                 seed: Optional[int] = None):
+        self.failure_rate = failure_rate
+        self.methods = set(methods or [])
+        self.rng = random.Random(seed)
+        self._orig = None
+
+    def __enter__(self):
+        from . import chaos  # noqa: F401  (self-import keeps patch local)
+        from .._private import protocol
+
+        orig = protocol.RpcClient.call
+        chaos_self = self
+
+        async def chaotic_call(client_self, _method, **kwargs):
+            if (not chaos_self.methods or _method in chaos_self.methods) \
+                    and chaos_self.rng.random() < chaos_self.failure_rate:
+                raise protocol.ConnectionLost(
+                    f"chaos: injected failure for {_method!r}")
+            return await orig(client_self, _method, **kwargs)
+
+        self._orig = orig
+        protocol.RpcClient.call = chaotic_call
+        return self
+
+    def __exit__(self, *exc):
+        from .._private import protocol
+        protocol.RpcClient.call = self._orig
+        return False

@@ -1,0 +1,151 @@
+"""multiprocessing.Pool-compatible shim over ray_tpu tasks.
+
+Reference parity: python/ray/util/multiprocessing/pool.py — drop-in
+`Pool` so existing `multiprocessing` code scales across the cluster
+without rewrites.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+__all__ = ["Pool"]
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        results = ray_tpu.get(self._refs, timeout=timeout)
+        return results[0] if self._single else results
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            ray_tpu.get(self._refs)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Cluster-backed process pool. `processes` bounds in-flight tasks
+    (the cluster's CPU accounting does the real throttling)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._processes = processes or 8
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _remote_fn(self, func):
+        init, initargs = self._initializer, self._initargs
+
+        @ray_tpu.remote
+        def call(batch):
+            if init is not None:
+                init(*initargs)
+            return [func(*args) for args in batch]
+
+        return call
+
+    def _run(self, func, iterables, chunksize: Optional[int]):
+        if self._closed:
+            raise ValueError("Pool not running")
+        items = list(iterables)
+        chunksize = chunksize or max(len(items) // (self._processes * 4), 1)
+        call = self._remote_fn(func)
+        refs = [call.remote(items[i:i + chunksize])
+                for i in range(0, len(items), chunksize)]
+        return refs
+
+    # -- map family --------------------------------------------------------
+
+    def map(self, func, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        refs = self._run(func, [(x,) for x in iterable], chunksize)
+        return _FlattenedResult(refs)
+
+    def starmap(self, func, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None) -> AsyncResult:
+        refs = self._run(func, list(iterable), chunksize)
+        return _FlattenedResult(refs)
+
+    def imap(self, func, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        for ref in self._run(func, [(x,) for x in iterable],
+                             chunksize or 1):
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        pending = self._run(func, [(x,) for x in iterable], chunksize or 1)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in ready:
+                yield from ray_tpu.get(ref)
+
+    # -- apply family ------------------------------------------------------
+
+    def apply(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (),
+                    kwds: Optional[dict] = None) -> AsyncResult:
+        kwds = kwds or {}
+
+        @ray_tpu.remote
+        def call():
+            return func(*args, **kwds)
+
+        return AsyncResult([call.remote()], single=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _FlattenedResult(AsyncResult):
+    """map chunks return lists; get() flattens back to item order."""
+
+    def get(self, timeout: Optional[float] = None):
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return list(itertools.chain.from_iterable(chunks))

@@ -1,0 +1,75 @@
+"""Profiling utilities.
+
+Reference parity: dashboard/modules/reporter/profile_manager.py (py-spy
+stack dumps, memray memory reports) — implemented with the standard
+library (sys._current_frames / tracemalloc / /proc) so nothing external
+is shipped — plus the TPU-native piece the reference lacks: a
+jax.profiler trace context whose output feeds TensorBoard / xprof.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import traceback
+from typing import Iterator, Optional
+
+
+def dump_stacks() -> str:
+    """All threads' current stacks, py-spy style."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+def memory_summary() -> dict:
+    """Process memory: RSS from /proc plus tracemalloc top allocations
+    when tracing is active (start with tracemalloc.start())."""
+    import tracemalloc
+
+    summary = {"rss_bytes": None, "top_allocations": []}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    summary["rss_bytes"] = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
+    if tracemalloc.is_tracing():
+        snap = tracemalloc.take_snapshot()
+        for stat in snap.statistics("lineno")[:20]:
+            summary["top_allocations"].append(
+                {"where": str(stat.traceback), "bytes": stat.size,
+                 "count": stat.count})
+    return summary
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False
+          ) -> Iterator[None]:
+    """jax.profiler trace scope: XLA execution timeline + HLO ops land in
+    `log_dir` for TensorBoard/xprof (`tensorboard --logdir ...`)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_step(fn, *args, log_dir: str = "/tmp/ray_tpu/profile"):
+    """Run fn under a jax profiler trace; returns (result, log_dir)."""
+    with trace(log_dir):
+        result = fn(*args)
+        import jax
+
+        jax.block_until_ready(result)
+    return result, log_dir

@@ -148,3 +148,26 @@ def test_jax_training_loop_single_worker(ray_start, tmp_path):
     result = trainer.fit()
     assert result.error is None
     assert np.isfinite(result.metrics["loss"])
+
+
+def test_elastic_trainer_runs_with_available_workers(ray_start):
+    """ScalingConfig(min_workers=...) runs with the largest placeable gang
+    instead of blocking on the full one (Train v2 ScalingPolicy parity)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train import session as train_session
+
+    def loop(config=None):
+        from ray_tpu.train import session
+        ctx = session.get_context()
+        session.report({"world_size": ctx.world_size, "loss": 1.0})
+
+    # the 8-CPU test cluster cannot place 64 x 1-CPU workers; elastic
+    # shrinks until the gang fits
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=64, cpus_per_worker=1.0,
+                                     min_workers=1),
+        run_config=RunConfig(name="elastic-test"))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert 1 <= result.metrics["world_size"] < 64
