@@ -24,44 +24,51 @@ def _env(name: str, default, cast=None):
         return default
 
 
+def _f(name, default, cast=None):
+    """Deferred env read: evaluated when the config is INSTANTIATED (at
+    first get_config(), i.e. first real use — typically ray_tpu.init),
+    not at import. Preserves the set-env-after-import pattern."""
+    return dataclasses.field(
+        default_factory=lambda: _env(name, default, cast))
+
+
 @dataclasses.dataclass
 class RayTpuConfig:
     # -- object plane --------------------------------------------------
     #: cross-node fetch chunk size (bytes); RAY_TPU_FETCH_CHUNK
-    fetch_chunk_bytes: int = _env("RAY_TPU_FETCH_CHUNK", 32 << 20)
+    fetch_chunk_bytes: int = _f("RAY_TPU_FETCH_CHUNK", 32 << 20)
     #: chunks in flight per fetch; RAY_TPU_FETCH_WINDOW
-    fetch_chunk_window: int = _env("RAY_TPU_FETCH_WINDOW", 4)
+    fetch_chunk_window: int = _f("RAY_TPU_FETCH_WINDOW", 4)
     #: arena spill high/low water marks (fractions)
-    arena_spill_high: float = _env("RAY_TPU_ARENA_SPILL_HIGH", 0.85)
-    arena_spill_low: float = _env("RAY_TPU_ARENA_SPILL_LOW", 0.65)
+    arena_spill_high: float = _f("RAY_TPU_ARENA_SPILL_HIGH", 0.85)
+    arena_spill_low: float = _f("RAY_TPU_ARENA_SPILL_LOW", 0.65)
 
     # -- lineage / recovery -------------------------------------------
     #: max producing-task specs retained for object reconstruction
-    lineage_cap: int = _env("RAY_TPU_LINEAGE_CAP", 10000)
+    lineage_cap: int = _f("RAY_TPU_LINEAGE_CAP", 10000)
     #: byte bound on retained lineage specs
-    lineage_max_bytes: int = _env("RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20)
+    lineage_max_bytes: int = _f("RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20)
 
     # -- node daemon ---------------------------------------------------
     #: node memory fraction that triggers the OOM killer (<=0 disables)
-    memory_usage_threshold: float = _env(
+    memory_usage_threshold: float = _f(
         "RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95)
     #: pip runtime_env local wheel index (offline installs)
-    pip_find_links: Optional[str] = os.environ.get(
-        "RAY_TPU_PIP_FIND_LINKS")
+    pip_find_links: Optional[str] = _f(
+        "RAY_TPU_PIP_FIND_LINKS", None, str)
 
     # -- control plane ---------------------------------------------------
     #: GCS persistence path ("" disables); RAY_TPU_GCS_PERSIST
-    gcs_persist_path: Optional[str] = os.environ.get("RAY_TPU_GCS_PERSIST")
+    gcs_persist_path: Optional[str] = _f("RAY_TPU_GCS_PERSIST", None, str)
     #: bind host for every server in the process tree
-    bind_host: str = _env("RAY_TPU_BIND_HOST", "127.0.0.1")
+    bind_host: str = _f("RAY_TPU_BIND_HOST", "127.0.0.1")
     #: advertised host when binding a wildcard address
-    advertise_host: Optional[str] = os.environ.get(
-        "RAY_TPU_ADVERTISE_HOST")
+    advertise_host: Optional[str] = _f("RAY_TPU_ADVERTISE_HOST", None, str)
 
     # -- workflows -------------------------------------------------------
     #: durable workflow storage root
-    workflow_storage: str = _env("RAY_TPU_WORKFLOW_STORAGE",
-                                 "/tmp/ray_tpu/workflows")
+    workflow_storage: str = _f("RAY_TPU_WORKFLOW_STORAGE",
+                               "/tmp/ray_tpu/workflows")
 
 
 _config: Optional[RayTpuConfig] = None

@@ -101,9 +101,9 @@ class Controller:
         # restart resumes with live actor addresses and named lookups
         # intact. Disable with persist_path="" for throwaway controllers.
         if persist_path is None:
-            persist_path = os.environ.get(
-                "RAY_TPU_GCS_PERSIST",
-                f"/tmp/ray_tpu/{session_name}/gcs.db")
+            from .config import get_config
+            persist_path = (get_config().gcs_persist_path
+                            or f"/tmp/ray_tpu/{session_name}/gcs.db")
         self.store = None
         if persist_path:
             from .gcs_store import GcsStore

@@ -44,10 +44,6 @@ logger = logging.getLogger(__name__)
 
 from .config import get_config
 
-# Cross-node object transfer: chunk size + number of chunks in flight.
-FETCH_CHUNK_BYTES = get_config().fetch_chunk_bytes
-FETCH_CHUNK_WINDOW = get_config().fetch_chunk_window
-
 
 class LoopRunner:
     """An asyncio loop, either owned (background thread) or external."""
@@ -250,6 +246,9 @@ class CoreClient:
             pass
 
     async def _async_shutdown(self) -> None:
+        task = getattr(self, "_subscription_task", None)
+        if task is not None:
+            task.cancel()
         await self.server.stop()
         await self.pool.close_all()
 
@@ -481,17 +480,24 @@ class CoreClient:
         first = not self._subscriptions
         self._subscriptions.setdefault(topic, []).append(callback)
         if first:
-            self.loop_runner.call_soon(self._subscription_keeper())
+            async def _spawn():
+                self._subscription_task = asyncio.ensure_future(
+                    self._subscription_keeper())
+
+            self.loop_runner.call_soon(_spawn())
 
     async def _subscription_keeper(self) -> None:
-        while not self.is_shutdown:
-            for topic in list(self._subscriptions):
-                try:
-                    await self._controller().call(
-                        "subscribe", topic=topic, addr=self.address)
-                except Exception:
-                    pass
-            await asyncio.sleep(5.0)
+        try:
+            while not self.is_shutdown:
+                for topic in list(self._subscriptions):
+                    try:
+                        await self._controller().call(
+                            "subscribe", topic=topic, addr=self.address)
+                    except Exception:
+                        pass
+                await asyncio.sleep(5.0)
+        except asyncio.CancelledError:
+            pass
 
     async def rpc_ref_event(self, object_id: str, delta: int) -> None:
         self.ref_counter.on_borrower_event(object_id, delta)
@@ -658,7 +664,11 @@ class CoreClient:
         parity: ObjectManager chunked push/pull, object_manager.h:208-216)."""
         node = self.pool.get(loc.node_addr)
         try:
-            if loc.size <= FETCH_CHUNK_BYTES:
+            # Cross-node transfer knobs read at use time (config is
+            # instantiated on first use, honoring late env changes).
+            chunk_bytes = get_config().fetch_chunk_bytes
+            chunk_window = get_config().fetch_chunk_window
+            if loc.size <= chunk_bytes:
                 reply = await node.call("fetch_object", object_id=object_id)
                 if reply is None:
                     raise ObjectLostError(
@@ -669,21 +679,21 @@ class CoreClient:
                 raise ObjectLostError(f"object {object_id[:12]} not on node")
             size = meta["size"]
             buf = bytearray(size)
-            sem = asyncio.Semaphore(FETCH_CHUNK_WINDOW)
+            sem = asyncio.Semaphore(chunk_window)
 
             async def pull(offset: int):
                 async with sem:
                     chunk = await node.call(
                         "fetch_object_chunk", object_id=object_id,
                         offset=offset,
-                        length=min(FETCH_CHUNK_BYTES, size - offset))
+                        length=min(chunk_bytes, size - offset))
                 if chunk is None:
                     raise ObjectLostError(
                         f"object {object_id[:12]} vanished mid-transfer")
                 buf[offset:offset + len(chunk)] = chunk
 
             await asyncio.gather(*[
-                pull(off) for off in range(0, size, FETCH_CHUNK_BYTES)])
+                pull(off) for off in range(0, size, chunk_bytes)])
             # from_flat wraps a memoryview: no second multi-GiB copy
             return SerializedObject.from_flat(buf).deserialize()
         except (ConnectionLost, OSError):

@@ -263,7 +263,7 @@ class NodeDaemon:
 
     async def _spawn_worker(self, env_key: str = "") -> WorkerHandle:
         worker_id = WorkerID.generate().hex()
-        log_path = os.path.join(self.temp_dir, "logs", f"worker-{worker_id[:12]}.log")
+        log_path = self._worker_log_path(worker_id)
         runtime_env = self._runtime_envs.get(env_key)
         env_vars, extra_path, cwd = await self._prepare_runtime_env(
             runtime_env)

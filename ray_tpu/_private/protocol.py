@@ -116,14 +116,16 @@ class RpcServer:
         # multi-host clusters need ALL of them reachable (owner_addr /
         # actor addrs cross hosts), not just the control plane.
         if host is None:
-            host = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
+            from .config import get_config
+            host = get_config().bind_host
         self._server = await asyncio.start_server(self._on_connection, host, port)
         sock = self._server.sockets[0]
         self.address = sock.getsockname()[:2]
         if self.address[0] in ("0.0.0.0", "::"):
             # Advertise a dialable address, not the wildcard bind: the
             # host's primary outbound IP (RAY_TPU_ADVERTISE_HOST overrides).
-            adv = os.environ.get("RAY_TPU_ADVERTISE_HOST")
+            from .config import get_config
+            adv = get_config().advertise_host
             if not adv:
                 import socket as _socket
                 probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)

@@ -75,6 +75,18 @@ class ObjectRefGenerator:
         self._client = client
         self._cursor = 0
         self._closed = False
+        #: optional hook invoked exactly once when the stream ends
+        #: (exhaustion, close, or GC) — used e.g. by serve's router to
+        #: track per-replica live streams
+        self.on_finish = None
+
+    def _finish(self) -> None:
+        cb, self.on_finish = self.on_finish, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
 
     @property
     def generator_id(self) -> str:
@@ -90,6 +102,7 @@ class ObjectRefGenerator:
             self._client.release_stream(self._id, self._cursor)
         except Exception:
             pass
+        self._finish()
 
     def __del__(self):
         self.close()
@@ -102,6 +115,7 @@ class ObjectRefGenerator:
     def __next__(self) -> ObjectRef:
         ref = self._client.next_stream_item(self._id, self._cursor)
         if ref is None:
+            self._finish()
             raise StopIteration
         self._cursor += 1
         return ref
@@ -114,6 +128,7 @@ class ObjectRefGenerator:
     async def __anext__(self) -> ObjectRef:
         ref = await self._client.aio_next_stream_item(self._id, self._cursor)
         if ref is None:
+            self._finish()
             raise StopAsyncIteration
         self._cursor += 1
         return ref

@@ -339,6 +339,25 @@ class InferenceEngine:
         slot.position = 0
         self._page_tables[slot.index] = 0
 
+    def abort(self, request_id: str) -> bool:
+        """Stop a request (client disconnected / stream abandoned): free
+        its decode slot + KV pages, or drop it from the waiting queue
+        (reference parity: the engine-level abort every serving stack
+        needs once streams make client aborts routine)."""
+        for i, req in enumerate(self.waiting):
+            if req.request_id == request_id:
+                del self.waiting[i]
+                req.finished = True
+                req.finish_reason = "abort"
+                return True
+        for slot in self.slots:
+            if slot.request is not None \
+                    and slot.request.request_id == request_id:
+                self._finish(slot, "abort")
+                self._refresh_device_state()
+                return True
+        return False
+
     # -- introspection ------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         return {

@@ -78,6 +78,9 @@ class LLMServerImpl:
                     return req
         finally:
             self._queues.pop(rid, None)
+            if not req.finished:
+                # caller gone (timeout/cancel): stop decoding for nobody
+                self.engine.abort(rid)
 
     def _sampling(self, body: Dict[str, Any]) -> SamplingParams:
         eos = getattr(self.tokenizer, "eos_id",
@@ -132,6 +135,72 @@ class LLMServerImpl:
             },
         }
 
+    async def _generate_stream(self, prompt_tokens: List[int],
+                               params: SamplingParams):
+        """Yield (token_text, finished, finish_reason) as tokens land."""
+        self._ensure_pump()
+        rid = uuid.uuid4().hex[:16]
+        req = Request(rid, prompt_tokens, params)
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        try:
+            self.engine.add_request(req)
+            self._wake.set()
+            n_sent = 0
+            while True:
+                _, finished, reason = await asyncio.wait_for(q.get(),
+                                                             timeout=300)
+                # decode incrementally: whole-prefix decode keeps
+                # multi-byte tokenizations correct
+                text = self.tokenizer.decode(req.output_tokens)
+                delta, n_sent = text[n_sent:], len(text)
+                yield delta, finished, reason
+                if finished:
+                    return
+        finally:
+            self._queues.pop(rid, None)
+            if not req.finished:
+                # stream abandoned mid-generation: free the slot + pages
+                self.engine.abort(rid)
+
+    async def chat_stream(self, body: Dict[str, Any]):
+        """SSE chunks for stream=true chat completions (OpenAI format)."""
+        import json
+        prompt = self.tokenizer.apply_chat_template(
+            body.get("messages") or [])
+        toks = self.tokenizer.encode(prompt)
+        cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        async for delta, finished, reason in self._generate_stream(
+                toks, self._sampling(body)):
+            chunk = {
+                "id": cid, "object": "chat.completion.chunk",
+                "created": int(time.time()), "model": self.model_id,
+                "choices": [{
+                    "index": 0,
+                    "delta": ({"content": delta} if delta else {}),
+                    "finish_reason": reason if finished else None,
+                }],
+            }
+            yield f"data: {json.dumps(chunk)}\n\n"
+        yield "data: [DONE]\n\n"
+
+    async def completions_stream(self, body: Dict[str, Any]):
+        import json
+        toks = self.tokenizer.encode(str(body.get("prompt") or ""))
+        cid = f"cmpl-{uuid.uuid4().hex[:16]}"
+        async for delta, finished, reason in self._generate_stream(
+                toks, self._sampling(body)):
+            chunk = {
+                "id": cid, "object": "text_completion",
+                "created": int(time.time()), "model": self.model_id,
+                "choices": [{
+                    "index": 0, "text": delta,
+                    "finish_reason": reason if finished else None,
+                }],
+            }
+            yield f"data: {json.dumps(chunk)}\n\n"
+        yield "data: [DONE]\n\n"
+
     async def model_info(self) -> Dict[str, Any]:
         return {"id": self.model_id, "object": "model",
                 "owned_by": "ray_tpu",
@@ -184,9 +253,32 @@ class LLMRouterImpl:
             return Response(
                 {"error": f"model {body.get('model')!r} not found"},
                 status=404, content_type="application/json")
+        streaming = bool(body.get("stream"))
         if path.rstrip("/").endswith("/chat/completions"):
+            if streaming:
+                from ...serve import StreamingHint
+                return StreamingHint("stream_chat", body)
             return await server.chat.remote(body)
         if path.rstrip("/").endswith("/completions"):
+            if streaming:
+                from ...serve import StreamingHint
+                return StreamingHint("stream_completions", body)
             return await server.completions.remote(body)
         return Response({"error": f"no route {path}"}, status=404,
                         content_type="application/json")
+
+    async def stream_chat(self, body: Dict[str, Any]):
+        """Proxy-invoked SSE relay: streams from the model server
+        deployment through this ingress to the HTTP client."""
+        await self._resolve()
+        server = self._pick(body)
+        gen = server.chat_stream.options(stream=True).remote(body)
+        async for chunk in gen:
+            yield chunk
+
+    async def stream_completions(self, body: Dict[str, Any]):
+        await self._resolve()
+        server = self._pick(body)
+        gen = server.completions_stream.options(stream=True).remote(body)
+        async for chunk in gen:
+            yield chunk

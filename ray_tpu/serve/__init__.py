@@ -11,14 +11,17 @@ from .api import (Application, Deployment, delete, deployment,
                   start, status)
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
-from .handle import DeploymentHandle, DeploymentResponse
+from .handle import (DeploymentHandle, DeploymentResponse,
+                     DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
-from ._private.proxy import Request, Response
+from ._private.proxy import Request, Response, StreamingHint
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
-    "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
+    "DeploymentHandle", "DeploymentResponse",
+    "DeploymentResponseGenerator", "StreamingHint",
+    "AutoscalingConfig",
     "DeploymentConfig", "HTTPOptions", "batch", "multiplexed",
     "get_multiplexed_model_id", "Request", "Response",
 ]

@@ -57,6 +57,19 @@ class Response:
         self.content_type = content_type
 
 
+class StreamingHint:
+    """Returned by an ingress to switch the proxy to a streaming call:
+    the proxy re-invokes `call_method` on the SAME ingress with
+    stream=True and writes each yielded str/bytes chunk to the HTTP
+    response as it arrives (SSE and chunked responses ride this)."""
+
+    def __init__(self, call_method: str, payload: Any,
+                 content_type: str = "text/event-stream"):
+        self.call_method = call_method
+        self.payload = payload
+        self.content_type = content_type
+
+
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
@@ -142,7 +155,26 @@ class ProxyActor:
         except Exception as e:
             logger.exception("request to %s failed", hkey)
             return web.Response(status=500, text=repr(e))
+        if isinstance(result, StreamingHint):
+            return await self._stream_http(web, request, handle, result)
         return self._to_http(web, result)
+
+    async def _stream_http(self, web, request, handle, hint: StreamingHint):
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": hint.content_type,
+                                 "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        gen = handle.options(method_name=hint.call_method,
+                             stream=True).remote(hint.payload)
+        try:
+            async for chunk in gen:
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                await resp.write(chunk)
+        finally:
+            gen.close()
+            await resp.write_eof()
+        return resp
 
     @staticmethod
     def _to_http(web, result):

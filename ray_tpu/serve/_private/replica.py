@@ -77,6 +77,30 @@ class Replica:
             _request_context.reset(token)
             self._ongoing -= 1
 
+    async def handle_request_stream(self, meta: Dict[str, Any],
+                                    *args, **kwargs):
+        """Streaming twin of handle_request: the target user method is a
+        (sync or async) generator; items are re-yielded, so calling this
+        with num_returns="streaming" streams them to the consumer
+        (reference parity: replica.py handle_request_streaming)."""
+        self._ongoing += 1
+        self._total += 1
+        token = _request_context.set(meta)
+        try:
+            target = (self._instance if self._is_function else
+                      getattr(self._instance,
+                              meta.get("call_method") or "__call__"))
+            gen = target(*args, **kwargs)
+            if hasattr(gen, "__anext__"):
+                async for item in gen:
+                    yield item
+            else:
+                for item in gen:
+                    yield item
+        finally:
+            _request_context.reset(token)
+            self._ongoing -= 1
+
     # -- control plane ------------------------------------------------------
     def _reconfigure_sync(self, user_config: Any) -> None:
         if not self._is_function and hasattr(self._instance, "reconfigure"):

@@ -101,11 +101,29 @@ class Router:
         nb = self.inflight.get(b.replica_id, 0)
         return a if na <= nb else b
 
-    def _launch(self, meta: RequestMetadata, args, kwargs):
+    def _launch(self, meta: RequestMetadata, args, kwargs,
+                stream: bool = False):
         with self._lock:
             target = self._pick()
             rid = target.replica_id
             self.inflight[rid] = self.inflight.get(rid, 0) + 1
+        if stream:
+            gen = target.actor_handle.handle_request_stream.options(
+                num_returns="streaming").remote(
+                    meta.__dict__, *args, **kwargs)
+
+            def _stream_done():
+                with self._lock:
+                    n = self.inflight.get(rid, 1)
+                    self.inflight[rid] = max(n - 1, 0)
+
+            # decrement when the STREAM ends (exhaustion/close/GC), not at
+            # launch: long-lived streams must weigh in pow-2 routing
+            if hasattr(gen, "on_finish"):
+                gen.on_finish = _stream_done
+            else:                       # local-mode eager generator
+                _stream_done()
+            return gen
         ref = target.actor_handle.handle_request.remote(
             meta.__dict__, *args, **kwargs)
 
@@ -119,13 +137,13 @@ class Router:
             _done(None)
         return ref
 
-    def assign_sync(self, meta, args, kwargs):
+    def assign_sync(self, meta, args, kwargs, stream: bool = False):
         self.refresh_sync()
-        return self._launch(meta, args, kwargs)
+        return self._launch(meta, args, kwargs, stream)
 
-    async def assign_async(self, meta, args, kwargs):
+    async def assign_async(self, meta, args, kwargs, stream: bool = False):
         await self.refresh_async()
-        return self._launch(meta, args, kwargs)
+        return self._launch(meta, args, kwargs, stream)
 
 
 def _router_for(dep_key: str) -> Router:
@@ -168,22 +186,24 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str = "default",
                  *, method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
 
     # -- options / composition ---------------------------------------------
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name=method_name or self._method,
             multiplexed_model_id=(multiplexed_model_id
                                   if multiplexed_model_id is not None
-                                  else self._model_id))
+                                  else self._model_id),
+            stream=self._stream if stream is None else stream)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -196,7 +216,7 @@ class DeploymentHandle:
             request_id=uuid.uuid4().hex[:12], call_method=self._method,
             multiplexed_model_id=self._model_id)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = _router_for(
             deployment_key(self.app_name, self.deployment_name))
         meta = self._meta()
@@ -204,6 +224,15 @@ class DeploymentHandle:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             loop = None
+        if self._stream:
+            # streaming calls: resolve the replica + ObjectRefGenerator
+            # eagerly, wrap in a value-yielding generator
+            if loop is not None:
+                task = loop.create_task(
+                    router.assign_async(meta, args, kwargs, stream=True))
+                return DeploymentResponseGenerator(task=task)
+            return DeploymentResponseGenerator(
+                gen=router.assign_sync(meta, args, kwargs, stream=True))
         if loop is not None:
             task = loop.create_task(router.assign_async(meta, args, kwargs))
             return DeploymentResponse(task=task)
@@ -212,20 +241,58 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name),
-                {"_method": self._method, "_model_id": self._model_id})
+                {"_method": self._method, "_model_id": self._model_id,
+                 "_stream": self._stream})
 
     def __setstate__(self, state):
         self._method = state.get("_method", "__call__")
         self._model_id = state.get("_model_id", "")
+        self._stream = state.get("_stream", False)
 
     def __repr__(self):
         return (f"DeploymentHandle({self.app_name}#{self.deployment_name}"
                 f".{self._method})")
 
 
+class DeploymentResponseGenerator:
+    """Streaming counterpart of DeploymentResponse: iterates the replica
+    generator's VALUES (reference handle.py DeploymentResponseGenerator).
+    Sync iteration on drivers, async inside replicas."""
+
+    def __init__(self, gen=None, task: Optional[asyncio.Task] = None):
+        self._gen = gen
+        self._task = task
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._gen is None:
+            raise RuntimeError("created in an async context; use async for")
+        ref = next(self._gen)                     # raises StopIteration
+        return ray_tpu.get(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._gen is None:
+            self._gen = await self._task
+            self._task = None
+        ref = await self._gen.__anext__()         # StopAsyncIteration
+        return await ref
+
+    def close(self):
+        if self._gen is not None and hasattr(self._gen, "close"):
+            self._gen.close()
+
+
 class _MethodProxy:
     def __init__(self, handle: DeploymentHandle, method: str):
         self._handle = handle.options(method_name=method)
+
+    def options(self, **opts) -> "DeploymentHandle":
+        return self._handle.options(**opts)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._handle.remote(*args, **kwargs)

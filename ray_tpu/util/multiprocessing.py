@@ -42,8 +42,10 @@ class AsyncResult:
 
 
 class Pool:
-    """Cluster-backed process pool. `processes` bounds in-flight tasks
-    (the cluster's CPU accounting does the real throttling)."""
+    """Cluster-backed process pool. `processes` sizes the default
+    chunking (work splits into ~4 chunks per process, so at most
+    4*processes tasks are in flight); the cluster's CPU accounting does
+    the actual execution throttling."""
 
     def __init__(self, processes: Optional[int] = None,
                  initializer: Optional[Callable] = None,
@@ -60,9 +62,16 @@ class Pool:
 
         @ray_tpu.remote
         def call(batch):
-            if init is not None:
+            # run the initializer once per WORKER process, not per chunk:
+            # the deserialized function object is cached worker-side, so
+            # a flag on it survives across this pool's chunk tasks
+            if init is not None and not getattr(call_marker, "done", False):
                 init(*initargs)
+                call_marker.done = True
             return [func(*args) for args in batch]
+
+        def call_marker():       # closure cell shared by all chunk calls
+            pass
 
         return call
 

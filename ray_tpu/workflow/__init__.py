@@ -37,8 +37,10 @@ _STATUS = ("RUNNING", "SUCCESSFUL", "FAILED", "NOT_FOUND")
 
 
 def storage_dir(workflow_id: Optional[str] = None) -> str:
-    base = os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
-                          "/tmp/ray_tpu/workflows")
+    # env read per call: tests and tools point storage at temp dirs
+    base = os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE",
+        None) or "/tmp/ray_tpu/workflows"
     return os.path.join(base, workflow_id) if workflow_id else base
 
 

@@ -67,10 +67,10 @@ def cluster():
 
 
 def test_chunked_fetch_large_object(cluster, monkeypatch):
-    from ray_tpu._private import core as core_mod
     import ray_tpu._private.state as state
+    from ray_tpu._private.config import get_config
 
-    monkeypatch.setattr(core_mod, "FETCH_CHUNK_BYTES", 1 << 20)
+    monkeypatch.setattr(get_config(), "fetch_chunk_bytes", 1 << 20)
     client = state.current_client()
     # force the remote-fetch path even on one machine
     monkeypatch.setattr(client, "_shm_is_local", lambda loc: False)

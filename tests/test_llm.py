@@ -206,3 +206,41 @@ def test_openai_app_http(ray_start):
         assert r.status_code == 404
     finally:
         serve.shutdown()
+
+
+def test_openai_streaming_sse(ray_start):
+    """stream=true returns Server-Sent Events with incremental deltas,
+    relayed proxy -> router replica -> model server replica over the
+    actor streaming plane."""
+    import json
+
+    import requests
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, build_openai_app
+
+    app = build_openai_app({"llm_configs": [LLMConfig(
+        model_id="m0", model_source="debug",
+        engine_kwargs=dict(max_batch_size=4, page_size=8, num_pages=128,
+                           prefill_buckets=(32, 64)))]})
+    try:
+        serve.run(app, name="llm", route_prefix="/",
+                  http_options=serve.HTTPOptions(port=8127),
+                  timeout_s=180)
+        r = requests.post(
+            "http://127.0.0.1:8127/v1/chat/completions",
+            json={"model": "m0", "max_tokens": 5, "stream": True,
+                  "messages": [{"role": "user", "content": "hey"}]},
+            stream=True, timeout=120)
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        for line in r.iter_lines():
+            if line.startswith(b"data: "):
+                events.append(line[len(b"data: "):])
+        assert events[-1] == b"[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert 1 <= len(chunks) <= 6
+        assert chunks[0]["object"] == "chat.completion.chunk"
+        assert chunks[-1]["choices"][0]["finish_reason"] is not None
+    finally:
+        serve.shutdown()
