@@ -25,11 +25,11 @@ class ReduceOp:
     MAX = "max"
 
 
-_REDUCERS = {
-    ReduceOp.SUM: lambda parts: _tree_reduce(np.add, parts),
-    ReduceOp.PRODUCT: lambda parts: _tree_reduce(np.multiply, parts),
-    ReduceOp.MIN: lambda parts: _tree_reduce(np.minimum, parts),
-    ReduceOp.MAX: lambda parts: _tree_reduce(np.maximum, parts),
+_PAIRWISE = {
+    ReduceOp.SUM: np.add,
+    ReduceOp.PRODUCT: np.multiply,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.MAX: np.maximum,
 }
 
 
@@ -88,24 +88,50 @@ class _GroupActor:
 
     async def contribute(self, key: str, rank: int, payload,
                          op: Optional[str], mode: str):
-        """All ranks call; returns the collective result for this op key."""
+        """All ranks call; returns the collective result for this op key.
+
+        Reduction ops fold contributions into a running accumulator in
+        RANK order (deterministic fp results); out-of-order arrivals are
+        buffered until their turn, so memory is O(pending prefix gap),
+        typically one payload, instead of the old O(world x payload).
+        Only allgather inherently retains every part.
+        """
         import asyncio
         slot = await self._op_slot(key)
-        slot["parts"][rank] = payload
-        if len(slot["parts"]) == self.world_size:
-            ordered = [slot["parts"][r] for r in range(self.world_size)]
-            if mode == "allreduce":
-                slot["result"] = _REDUCERS[op or ReduceOp.SUM](ordered)
+        seen = slot.setdefault("seen", set())
+        if rank in seen:
+            raise ValueError(
+                f"rank {rank} contributed twice to collective {key!r} "
+                f"(duplicate rank assignment or replayed call)")
+        seen.add(rank)
+        if mode in ("allreduce", "reducescatter"):
+            reduce_op = _PAIRWISE[ReduceOp.SUM if mode == "reducescatter"
+                                  else (op or ReduceOp.SUM)]
+            buf = slot.setdefault("buffer", {})
+            buf[rank] = payload
+            nxt = slot.setdefault("next_rank", 0)
+            while nxt in buf:
+                part = buf.pop(nxt)
+                acc = slot.get("acc")
+                slot["acc"] = part if acc is None else _tree_reduce(
+                    reduce_op, [acc, part])
+                nxt += 1
+            slot["next_rank"] = nxt
+        elif mode == "allgather":
+            slot["parts"][rank] = payload
+        elif mode == "broadcast":
+            if rank == int(op or 0):
+                slot["acc"] = payload
+        if len(seen) == self.world_size:
+            if mode in ("allreduce", "reducescatter"):
+                slot["result"] = slot.pop("acc")
             elif mode == "allgather":
-                slot["result"] = ordered
+                slot["result"] = [slot["parts"][r]
+                                  for r in range(self.world_size)]
             elif mode == "broadcast":
-                src = int(op or 0)
-                slot["result"] = slot["parts"][src]
+                slot["result"] = slot.pop("acc")
             elif mode == "barrier":
                 slot["result"] = True
-            elif mode == "reducescatter":
-                reduced = _REDUCERS[ReduceOp.SUM](ordered)
-                slot["result"] = reduced
             slot["event"].set()
         await asyncio.wait_for(slot["event"].wait(), timeout=300.0)
         result = slot["result"]
