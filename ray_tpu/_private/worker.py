@@ -65,7 +65,10 @@ def init(address: Optional[str] = None,
     if address is not None:
         # attach to an existing cluster: address = "host:port" of the
         # controller (written to the cluster-address file by `ray_tpu
-        # start --head`)
+        # start --head`). The reference's client scheme "ray://host:port"
+        # is accepted as an alias.
+        if address.startswith("ray://"):
+            address = address[len("ray://"):]
         host, _, port = address.rpartition(":")
         controller_addr = (host or "127.0.0.1", int(port))
         loop_runner = LoopRunner()

@@ -243,7 +243,7 @@ import subprocess, sys
 child = subprocess.run(
     [sys.executable, "-c", f'''
 import ray_tpu
-ray_tpu.init(address={addr!r})
+ray_tpu.init(address="ray://" + {addr!r})  # client-scheme alias
 
 @ray_tpu.remote
 def f(x):
