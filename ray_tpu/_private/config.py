@@ -57,6 +57,17 @@ class RayTpuConfig:
     pip_find_links: Optional[str] = _f(
         "RAY_TPU_PIP_FIND_LINKS", None, str)
 
+    # -- function store --------------------------------------------------
+    #: code blobs larger than this are exported once to the controller KV
+    #: and referenced by content hash in task specs (function manager
+    #: parity); smaller blobs ride inline in the spec
+    fn_inline_limit: int = _f("RAY_TPU_FN_INLINE_LIMIT", 2048)
+    #: warn when controller-resident exported code blobs exceed this
+    #: (blobs are never evicted mid-session — queued specs may reference
+    #: any of them; growth past this means a driver re-captures fresh
+    #: state in a decorator loop)
+    fn_store_max_bytes: int = _f("RAY_TPU_FN_STORE_MAX_BYTES", 1 << 30)
+
     # -- control plane ---------------------------------------------------
     #: GCS persistence path ("" disables); RAY_TPU_GCS_PERSIST
     gcs_persist_path: Optional[str] = _f("RAY_TPU_GCS_PERSIST", None, str)
@@ -70,6 +81,11 @@ class RayTpuConfig:
     workflow_storage: str = _f("RAY_TPU_WORKFLOW_STORAGE",
                                "/tmp/ray_tpu/workflows")
 
+
+# KV key prefix for content-addressed exported code blobs (function
+# store). Lives here so client, worker, and controller share it without
+# import cycles.
+FN_STORE_PREFIX = "__fn__:"
 
 _config: Optional[RayTpuConfig] = None
 

@@ -18,6 +18,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .config import FN_STORE_PREFIX
 from .protocol import ClientPool, RpcServer
 from ..exceptions import ActorDiedError, InfeasibleResourceError, TaskError
 
@@ -891,7 +892,32 @@ class Controller:
         self.kv[key] = value
         if self.store is not None:
             self.store.put("kv", key, value)
+        if key.startswith(FN_STORE_PREFIX):
+            self._check_fn_store_growth()
         return True
+
+    def _check_fn_store_growth(self) -> None:
+        """Warn (once per doubling) when exported code blobs exceed
+        fn_store_max_bytes.
+
+        Exports are content-addressed and live for the session (reference
+        parity: function_manager.py — GCS fn exports are never evicted
+        mid-job, since queued specs may reference any of them). Unbounded
+        growth here means a driver is re-capturing fresh state inside a
+        decorator loop; surface that loudly instead of evicting blobs out
+        from under queued tasks."""
+        from .config import get_config
+        limit = get_config().fn_store_max_bytes
+        total = sum(len(v) for k, v in self.kv.items()
+                    if k.startswith(FN_STORE_PREFIX))
+        warned = getattr(self, "_fn_store_warned_at", 0)
+        if total > limit and total >= 2 * max(warned, limit // 2):
+            self._fn_store_warned_at = total
+            logger.warning(
+                "function store holds %.1f MB of exported code blobs "
+                "(> %.1f MB): a driver may be re-creating remote "
+                "functions with fresh captured state in a loop",
+                total / 1e6, limit / 1e6)
 
     async def rpc_kv_get(self, key: str) -> Optional[bytes]:
         return self.kv.get(key)

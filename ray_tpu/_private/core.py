@@ -42,7 +42,7 @@ from ..exceptions import (ActorDiedError, ActorError, GetTimeoutError,
 
 logger = logging.getLogger(__name__)
 
-from .config import get_config
+from .config import FN_STORE_PREFIX, get_config
 
 
 class LoopRunner:
@@ -229,6 +229,10 @@ class CoreClient:
         self._streams: Dict[str, "StreamState"] = {}
         # pubsub topic -> callbacks (messages arrive via rpc_pubsub_message)
         self._subscriptions: Dict[str, list] = {}
+        # Function store (reference parity: _private/function_manager.py —
+        # fn/class defs exported once to GCS KV, workers lazy-import):
+        # fn_hash -> asyncio.Future resolved when the KV export landed.
+        self._exported_fns: Dict[str, asyncio.Future] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -821,10 +825,58 @@ class CoreClient:
         except Exception:
             pass
 
+    # ----------------------------------------------------- function store
+
+    def _fn_ref(self, blob: bytes, fn_hash: Optional[str] = None):
+        """Split a code blob into task-spec fields.
+
+        Small blobs ride inline; large ones are referenced by content hash
+        and exported once to the controller KV (reference parity:
+        python/ray/_private/function_manager.py export + lazy import —
+        keeps hot-loop task specs and retained lineage small). Callers on
+        hot loops (RemoteFunction/ActorClass) pass the hash they computed
+        once at serialization time.
+        Returns (spec_fields, hash_to_export_or_None).
+        """
+        if len(blob) <= get_config().fn_inline_limit:
+            return {"fn_blob": blob}, None
+        if fn_hash is None:
+            import hashlib
+            fn_hash = hashlib.sha1(blob).hexdigest()
+        return {"fn_blob": None, "fn_hash": fn_hash}, fn_hash
+
+    async def _ensure_fn_exported(self, fn_hash: str, blob: bytes) -> None:
+        fut = self._exported_fns.get(fn_hash)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._exported_fns[fn_hash] = fut
+            try:
+                await self._controller().call(
+                    "kv_put", key=FN_STORE_PREFIX + fn_hash, value=blob,
+                    overwrite=False)
+            except Exception as e:
+                # Drop the memo so a later submit retries the export.
+                self._exported_fns.pop(fn_hash, None)
+                fut.set_exception(e)
+                fut.exception()      # mark retrieved for lone submitter
+                raise
+            else:
+                fut.set_result(None)
+                if len(self._exported_fns) > 4096:
+                    # Drop an old resolved memo: worst case a later submit
+                    # re-exports, and kv_put(overwrite=False) is idempotent.
+                    for k, f in self._exported_fns.items():
+                        if f.done():
+                            del self._exported_fns[k]
+                            break
+        else:
+            await asyncio.shield(fut)
+
     # ------------------------------------------------------------ tasks
 
     def submit_task(self, fn, args: tuple, kwargs: dict, opts: dict,
-                    fn_blob: Optional[bytes] = None):
+                    fn_blob: Optional[bytes] = None,
+                    fn_hash: Optional[str] = None):
         task_id = TaskID.generate().hex()
         num_returns = opts.get("num_returns") or 1
         streaming = num_returns == "streaming"
@@ -836,10 +888,12 @@ class CoreClient:
         arg_refs = _collect_refs(args) + _collect_refs(kwargs)
         for r in arg_refs:
             self.ref_counter.pin(r.id)
+        blob = fn_blob if fn_blob is not None else serialize_code(fn)
+        fn_fields, export_hash = self._fn_ref(blob, fn_hash)
         spec = {
             "task_id": task_id,
             "name": opts.get("name") or getattr(fn, "__name__", "task"),
-            "fn_blob": fn_blob if fn_blob is not None else serialize_code(fn),
+            **fn_fields,
             "args_blob": serialize((args, kwargs)).to_flat(),
             "return_id": return_ids[0],
             "return_ids": return_ids,
@@ -866,6 +920,8 @@ class CoreClient:
 
         async def _submit():
             try:
+                if export_hash is not None:
+                    await self._ensure_fn_exported(export_hash, blob)
                 await self._controller().call("submit_task", spec=spec)
             except Exception as e:
                 err = TaskError(spec["name"], f"submission failed: {e!r}")
@@ -884,16 +940,19 @@ class CoreClient:
     # ------------------------------------------------------------ actors
 
     def create_actor(self, cls, args: tuple, kwargs: dict, opts: dict,
-                     cls_blob: Optional[bytes] = None):
+                     cls_blob: Optional[bytes] = None,
+                     cls_hash: Optional[str] = None):
         actor_id = ActorID.generate().hex()
         task_id = TaskID.generate().hex()
         return_id = ObjectID.generate().hex()
         self.ref_counter.register_owned(return_id)
+        blob = cls_blob if cls_blob is not None else serialize_code(cls)
+        fn_fields, export_hash = self._fn_ref(blob, cls_hash)
         spec = {
             "task_id": task_id,
             "name": opts.get("name") or f"{cls.__name__}.__init__",
             "class_name": cls.__name__,
-            "fn_blob": cls_blob if cls_blob is not None else serialize_code(cls),
+            **fn_fields,
             "args_blob": serialize((args, kwargs)).to_flat(),
             "return_id": return_id,
             "owner_addr": self.address,
@@ -913,6 +972,8 @@ class CoreClient:
 
         async def _submit():
             try:
+                if export_hash is not None:
+                    await self._ensure_fn_exported(export_hash, blob)
                 await self._controller().call("submit_task", spec=spec)
             except Exception as e:
                 self.memory_store.put_error(

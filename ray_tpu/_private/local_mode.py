@@ -141,7 +141,8 @@ class LocalModeClient:
             refs.append(ObjectRef(oid, None, _client=self))
         return _LocalGenerator(refs)
 
-    def submit_task(self, fn, args, kwargs, opts, fn_blob=None):
+    def submit_task(self, fn, args, kwargs, opts, fn_blob=None,
+                    fn_hash=None):
         num_returns = opts.get("num_returns") or 1
         streaming = num_returns == "streaming"
         if streaming:
@@ -171,7 +172,8 @@ class LocalModeClient:
 
     # -- actors --
 
-    def create_actor(self, cls, args, kwargs, opts, cls_blob=None):
+    def create_actor(self, cls, args, kwargs, opts, cls_blob=None,
+                     cls_hash=None):
         actor_id = uuid.uuid4().hex
         oid = uuid.uuid4().hex
         args = tuple(self._resolve(a) for a in args)

@@ -27,7 +27,7 @@ import traceback
 from typing import Any, Dict, Optional, Tuple
 
 from . import state
-from .core import CoreClient, LoopRunner
+from .core import CoreClient, FN_STORE_PREFIX, LoopRunner
 from .object_ref import ObjectRef
 from .object_store import ShmLocation, write_to_shm
 from .serialization import (INLINE_OBJECT_LIMIT, SerializedObject,
@@ -138,6 +138,11 @@ class WorkerRuntime:
         # worker, not once per invocation — cloudpickle.loads of a big
         # closure dominates small-task latency otherwise.
         self._fn_cache: Dict[bytes, Any] = {}
+        # Function-store fetch plumbing: in-flight dedup (N concurrent
+        # tasks of one new fn -> one kv_get) and a small raw-blob LRU so
+        # actor creations can re-deserialize without re-fetching.
+        self._fn_fetches: Dict[str, asyncio.Future] = {}
+        self._code_blobs: Dict[str, bytes] = {}
         # generator_id -> [acked_count, waiter_event, cancelled]
         self._stream_acks: Dict[str, list] = {}
 
@@ -151,6 +156,47 @@ class WorkerRuntime:
                 self._fn_cache.pop(next(iter(self._fn_cache)))
             self._fn_cache[key] = fn
         return fn
+
+    async def _fetch_blob(self, fn_hash: str) -> bytes:
+        """Fetch a content-addressed code blob from the controller's
+        function store, deduping concurrent fetches of the same hash."""
+        blob = self._code_blobs.get(fn_hash)
+        if blob is not None:
+            return blob
+        fut = self._fn_fetches.get(fn_hash)
+        if fut is None:
+            fut = asyncio.ensure_future(self.client.pool.get(
+                self.client.controller_addr).call(
+                "kv_get", key=FN_STORE_PREFIX + fn_hash))
+            self._fn_fetches[fn_hash] = fut
+        try:
+            blob = await asyncio.shield(fut)
+        finally:
+            self._fn_fetches.pop(fn_hash, None)
+        if blob is None:
+            raise RuntimeError(
+                f"function {fn_hash} missing from the function store "
+                "(controller restarted without persistence?)")
+        if len(self._code_blobs) >= 16:
+            self._code_blobs.pop(next(iter(self._code_blobs)))
+        self._code_blobs[fn_hash] = blob
+        return blob
+
+    async def _load_fn(self, spec: dict):
+        """Resolve the task code object for a spec.
+
+        Small blobs ride inline (fn_blob); large ones arrive as a content
+        hash and are fetched once from the controller's function store,
+        then cached (reference parity: function_manager.py lazy import).
+        """
+        blob = spec.get("fn_blob")
+        if blob is not None:
+            return self._deserialize_fn(blob)
+        fn_hash = spec["fn_hash"]
+        fn = self._fn_cache.get(bytes.fromhex(fn_hash))
+        if fn is not None:
+            return fn
+        return self._deserialize_fn(await self._fetch_blob(fn_hash))
 
     # ------------------------------------------------------------- helpers
 
@@ -221,7 +267,7 @@ class WorkerRuntime:
         streaming = spec.get("num_returns") == "streaming"
         try:
             self._apply_tpu_isolation(spec)
-            fn = self._deserialize_fn(spec["fn_blob"])
+            fn = await self._load_fn(spec)
             args, kwargs = await self._resolve_args(spec["args_blob"])
             from ..util.tracing import span
             with span(spec.get("name", "task"), "task::execute",
@@ -408,7 +454,13 @@ class WorkerRuntime:
         actor_id = spec["actor_id"]
         try:
             self._apply_tpu_isolation(spec)
-            cls = deserialize_code(spec["fn_blob"])
+            # Deserialize a FRESH class object per actor creation (not via
+            # _fn_cache): class-attribute state must stay per-actor when
+            # several actors of one class share this worker process.
+            blob = spec.get("fn_blob")
+            if blob is None:
+                blob = await self._fetch_blob(spec["fn_hash"])
+            cls = deserialize_code(blob)
             args, kwargs = await self._resolve_args(spec["args_blob"])
             self.current_actor_id = actor_id
             instance = await loop.run_in_executor(

@@ -74,16 +74,19 @@ class ActorClass:
         self._cls = cls
         self._opts = validate_options(opts or {})
         self._cls_blob: Optional[bytes] = None   # cached cloudpickle of cls
+        self._cls_hash: Optional[str] = None     # sha1, computed with blob
         functools.update_wrapper(self, cls, updated=[])
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         client = state.current_client()
         if self._cls_blob is None and not getattr(client, "is_local_mode", False):
+            import hashlib
             from ._private.serialization import serialize_code
             self._cls_blob = serialize_code(self._cls)
+            self._cls_hash = hashlib.sha1(self._cls_blob).hexdigest()
         actor_id, creation_ref = client.create_actor(
             self._cls, args, kwargs, normalize_scheduling(self._opts),
-            cls_blob=self._cls_blob)
+            cls_blob=self._cls_blob, cls_hash=self._cls_hash)
         handle = ActorHandle(actor_id, self._cls.__name__)
         handle._creation_ref = creation_ref  # keeps creation errors reachable
         return handle

@@ -48,16 +48,20 @@ class RemoteFunction:
         self._fn = fn
         self._opts = validate_options(opts or {})
         self._fn_blob: Optional[bytes] = None   # cached cloudpickle of fn
+        self._fn_hash: Optional[str] = None     # sha1, computed with blob
         functools.update_wrapper(self, fn)
 
     def remote(self, *args, **kwargs):
         client = state.current_client()
         if self._fn_blob is None and not getattr(client, "is_local_mode", False):
+            import hashlib
             from ._private.serialization import serialize_code
             self._fn_blob = serialize_code(self._fn)
+            self._fn_hash = hashlib.sha1(self._fn_blob).hexdigest()
         return client.submit_task(self._fn, args, kwargs,
                                   normalize_scheduling(self._opts),
-                                  fn_blob=self._fn_blob)
+                                  fn_blob=self._fn_blob,
+                                  fn_hash=self._fn_hash)
 
     def options(self, **opts) -> "RemoteFunction":
         merged = dict(self._opts)

@@ -162,3 +162,7 @@ def test_live_cluster_controller_restart(tmp_path):
         await c2.stop()
 
     asyncio.run(main())
+    # raw CoreClient bypasses ray_tpu.shutdown(), which owns the
+    # session arena's lifecycle — unlink it here or it leaks in /dev/shm
+    from ray_tpu._private.object_store import unlink_session_arena
+    unlink_session_arena(session)

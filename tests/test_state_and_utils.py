@@ -240,10 +240,12 @@ import ray_tpu
 rt = ray_tpu.init(num_cpus=2)
 addr = f"{rt.controller.address[0]}:{rt.controller.address[1]}"
 import subprocess, sys
-child = subprocess.run(
-    [sys.executable, "-c", f'''
+# both accepted forms: bare host:port and the ray:// client-scheme alias
+for prefix in ("", "ray://"):
+    child = subprocess.run(
+        [sys.executable, "-c", f'''
 import ray_tpu
-ray_tpu.init(address="ray://" + {addr!r})  # client-scheme alias
+ray_tpu.init(address={prefix!r} + {addr!r})
 
 @ray_tpu.remote
 def f(x):
@@ -253,12 +255,13 @@ assert ray_tpu.get(f.remote(14)) == 42
 print("ATTACH_OK")
 ray_tpu.shutdown()
 '''], capture_output=True, text=True, timeout=120)
-sys.stdout.write(child.stdout)
-sys.stderr.write(child.stderr[-2000:])
+    sys.stdout.write(child.stdout)
+    sys.stderr.write(child.stderr[-2000:])
 ray_tpu.shutdown()
 """
     out = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=240, cwd=os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-    assert "ATTACH_OK" in out.stdout, (out.stdout, out.stderr[-2000:])
+    assert out.stdout.count("ATTACH_OK") == 2, (out.stdout,
+                                                out.stderr[-2000:])
