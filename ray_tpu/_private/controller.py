@@ -27,7 +27,9 @@ logger = logging.getLogger(__name__)
 
 class NodeEntry:
     __slots__ = ("node_id", "addr", "resources_total", "resources_avail",
-                 "labels", "alive", "num_running", "last_heartbeat")
+                 "labels", "alive", "num_running", "last_heartbeat",
+                 "sync_version", "view", "draining", "commands",
+                 "cmd_seq")
 
     def __init__(self, node_id: str, addr: Tuple[str, int],
                  resources: Dict[str, float], labels: Dict[str, str]):
@@ -39,6 +41,21 @@ class NodeEntry:
         self.alive = True
         self.num_running = 0
         self.last_heartbeat = time.monotonic()
+        # Resource/stats gossip (reference parity: ray_syncer.h:39-83 —
+        # versioned per-node snapshots, only deltas cross the wire):
+        # the daemon's last-synced view and the version we acked.
+        self.sync_version = 0
+        self.view: Dict[str, Any] = {}
+        # Draining: excluded from scheduling; terminated by the
+        # autoscaler once idle (reference: DrainRaylet / autoscaler v2
+        # drain-before-terminate).
+        self.draining = False
+        # Commands queued for the daemon, piggybacked on heartbeat
+        # replies (reference: syncer command channel). Sequence-numbered
+        # and re-delivered until the daemon acks — a dropped reply must
+        # not lose a drain/set_resource.
+        self.commands: List[dict] = []
+        self.cmd_seq = 0
 
     def fits(self, req: Dict[str, float]) -> bool:
         for k, v in req.items():
@@ -308,16 +325,78 @@ class Controller:
             node.alive = False
             await self._on_node_death(node_id)
 
-    async def rpc_heartbeat(self, node_id: str, num_workers: int = 0) -> dict:
+    async def rpc_heartbeat(self, node_id: str, num_workers: int = 0,
+                            sync_version: int = 0,
+                            view: Optional[dict] = None,
+                            cmd_ack: int = 0) -> dict:
         node = self.nodes.get(node_id)
         if node and node.alive:
             node.last_heartbeat = time.monotonic()
-            return {"status": "ok"}
+            if view is not None and sync_version > node.sync_version:
+                self._apply_node_view(node, view)
+                node.sync_version = sync_version
+            # at-least-once command delivery: drop acked, resend the rest
+            node.commands = [c for c in node.commands
+                             if c["seq"] > cmd_ack]
+            return {"status": "ok", "sync_ack": node.sync_version,
+                    "commands": list(node.commands)}
         # Either a restarted controller doesn't know this node yet, or
         # the health loop declared it dead during a blip — both ways the
         # daemon must re-register to rejoin (a dead-marked entry must not
         # swallow heartbeats forever).
         return {"status": "unknown"}
+
+    def _apply_node_view(self, node: NodeEntry, view: dict) -> None:
+        """Fold a daemon's versioned state snapshot into the cluster view
+        (reference parity: ray_syncer RESOURCE_VIEW receiver side)."""
+        node.view = view
+        if view.get("draining"):
+            # drain state gossiped back (e.g. after a controller restart
+            # re-registered the node with a fresh entry): never resume
+            # scheduling onto a node the operator drained
+            node.draining = True
+        new_total = view.get("resources_total")
+        if new_total is not None and new_total != node.resources_total:
+            # Dynamic resource change (chip lost, user set_resource):
+            # shift availability by the delta so in-flight acquisitions
+            # stay accounted.
+            for k in set(new_total) | set(node.resources_total):
+                delta = (new_total.get(k, 0.0)
+                         - node.resources_total.get(k, 0.0))
+                if delta:
+                    node.resources_avail[k] = \
+                        node.resources_avail.get(k, 0.0) + delta
+            node.resources_total = dict(new_total)
+            self._sched_event.set()   # freed capacity may place work
+
+    async def rpc_drain_node(self, node_id: str) -> dict:
+        """Start draining: no new work is scheduled here; the daemon is
+        told via the heartbeat command channel; the autoscaler terminates
+        it once idle. (Reference: autoscaler v2 drain-before-terminate.)"""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return {"status": "not_found"}
+        if not node.draining:
+            node.draining = True
+            self._queue_command(node, {"type": "drain"})
+        return {"status": "draining"}
+
+    def _queue_command(self, node: NodeEntry, cmd: dict) -> None:
+        node.cmd_seq += 1
+        cmd["seq"] = node.cmd_seq
+        node.commands.append(cmd)
+
+    async def rpc_set_node_resource(self, node_id: str, name: str,
+                                    capacity: float) -> dict:
+        """Route a dynamic resource update to a node via the command
+        channel; the daemon applies it locally and gossips the new totals
+        back on its next heartbeat."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return {"status": "not_found"}
+        self._queue_command(node, {"type": "set_resource", "name": name,
+                                   "capacity": float(capacity)})
+        return {"status": "queued"}
 
     async def _on_node_death(self, node_id: str) -> None:
         # Placement groups with a bundle on the dead node become FAILED:
@@ -384,6 +463,9 @@ class Controller:
             "resources_total": n.resources_total,
             "resources_available": n.resources_avail,
             "labels": n.labels,
+            "draining": n.draining,
+            # gossiped daemon-side stats (workers, store bytes, spills…)
+            "stats": n.view.get("stats", {}),
         } for n in self.nodes.values()]
 
     async def rpc_cluster_resources(self) -> Dict[str, float]:
@@ -494,6 +576,7 @@ class Controller:
                 "resources_total": dict(n.resources_total),
                 "resources_avail": dict(n.resources_avail),
                 "labels": dict(n.labels),
+                "draining": n.draining,
             } for n in self.nodes.values()],
         }
 
@@ -513,7 +596,8 @@ class Controller:
         # Placement groups first: gang reservations beat individual tasks.
         still_pg: List[Any] = []
         for pg in self.pending_pgs:
-            reason = pg.try_place(list(self.nodes.values()))
+            reason = pg.try_place([n for n in self.nodes.values()
+                                   if not n.draining])
             if reason is None:
                 self._persist_pg(pg)      # committed: record assignments
             elif reason == "" or self.autoscaling_enabled:
@@ -535,7 +619,8 @@ class Controller:
     async def _try_place(self, spec: dict) -> Optional[str]:
         req = dict(spec.get("resources") or {})
         strategy = spec.get("scheduling") or {}
-        candidates = [n for n in self.nodes.values() if n.alive]
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and not n.draining]
         if strategy.get("type") == "node_affinity":
             target = [n for n in candidates
                       if n.node_id == strategy.get("node_id")]
@@ -552,7 +637,13 @@ class Controller:
                                            strategy.get("bundle_index", -1),
                                            req)
         if not any(n.feasible(req) for n in candidates):
-            if all(not n.feasible(req) for n in self.nodes.values() if n.alive):
+            # Infeasible for the (possibly affinity-narrowed) candidates.
+            # Fail only if NO schedulable node could ever satisfy it —
+            # an affinity target that is currently too small may grow
+            # (set_resource) while other nodes stay feasible: keep waiting.
+            schedulable = [n for n in self.nodes.values()
+                           if n.alive and not n.draining]
+            if all(not n.feasible(req) for n in schedulable):
                 if self.autoscaling_enabled:
                     return None     # wait: the autoscaler may add a node
                 await self._fail_task(spec, InfeasibleResourceError(

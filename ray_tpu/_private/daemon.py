@@ -150,6 +150,14 @@ class NodeDaemon:
         # each worker's log file and publish new lines on the controller
         # pubsub so drivers can print them (`(worker pid=...) ...`).
         self._log_offsets: Dict[str, int] = {}
+        # Resource/stats gossip (reference parity: ray_syncer.h:39-83):
+        # a versioned local view piggybacks on heartbeats only when it
+        # changed since the controller's last ack.
+        self._sync_version = 1
+        self._sync_acked = 0
+        self._last_view: Optional[dict] = None
+        self._cmd_applied = 0    # highest command seq applied (acked back)
+        self.draining = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -679,6 +687,41 @@ class NodeDaemon:
 
     # ------------------------------------------------------------- monitor
 
+    def _build_view(self) -> dict:
+        """Local state snapshot for the gossip channel. Versioned: the
+        monitor loop only ships it when it differs from the last one."""
+        stats = {
+            "num_workers": len([w for w in self.workers.values()
+                                if w.state != "dead"]),
+            "num_idle": sum(len(v) for v in self.idle.values()),
+            "object_store_objects": self.object_store.num_objects,
+            "object_store_bytes": self.object_store.bytes_used,
+            "bytes_spilled": self.object_store.bytes_spilled,
+            "oom_kills": self.oom_kills,
+        }
+        return {"stats": stats,
+                "resources_total": dict(self.resources),
+                "draining": self.draining}
+
+    def _apply_commands(self, commands) -> None:
+        """Heartbeat-reply command channel (reference parity: ray_syncer
+        COMMANDS + raylet DrainRaylet)."""
+        for cmd in commands or []:
+            if cmd.get("seq", 0) <= self._cmd_applied:
+                continue          # redelivered duplicate
+            kind = cmd.get("type")
+            if kind == "drain":
+                self.draining = True
+            elif kind == "set_resource":
+                name, cap = cmd["name"], cmd["capacity"]
+                if cap <= 0:
+                    self.resources.pop(name, None)
+                else:
+                    self.resources[name] = cap
+            else:
+                logger.warning("unknown syncer command %r", kind)
+            self._cmd_applied = cmd["seq"]
+
     async def _monitor_loop(self) -> None:
         controller = self.pool.get(self.controller_addr)
         from .config import get_config
@@ -687,8 +730,20 @@ class NodeDaemon:
         while not self._closed:
             await asyncio.sleep(0.5)
             try:
+                view = self._build_view()
+                if view != self._last_view:
+                    self._sync_version += 1
+                    self._last_view = view
+                hb_kw = {"cmd_ack": self._cmd_applied}
+                if self._sync_version > self._sync_acked:
+                    hb_kw.update(sync_version=self._sync_version,
+                                 view=view)
                 reply = await controller.call(
-                    "heartbeat", node_id=self.node_id)
+                    "heartbeat", node_id=self.node_id, **hb_kw)
+                if (reply or {}).get("status") == "ok":
+                    self._sync_acked = reply.get(
+                        "sync_ack", self._sync_acked)
+                    self._apply_commands(reply.get("commands"))
                 if (reply or {}).get("status") == "unknown":
                     # Controller restarted and lost volatile node state:
                     # re-register, re-announce hosted actors so its
@@ -699,6 +754,10 @@ class NodeDaemon:
                         "register_node", node_id=self.node_id,
                         addr=self.address, resources=self.resources,
                         labels=self.labels)
+                    # fresh controller: resync view, restart command seqs
+                    # (its new NodeEntry numbers commands from 1 again)
+                    self._sync_acked = 0
+                    self._cmd_applied = 0
                     hosted = set()
                     for h in list(self.workers.values()):
                         if h.state == "actor" and h.actor_id:

@@ -112,8 +112,11 @@ class Autoscaler:
         # ----- scale up: fit unmet bundles onto the actual free capacity
         # of live nodes (busy nodes with a backlog still trigger growth)
         # + in-flight launches, launch node types for the rest.
+        # draining nodes are excluded: the scheduler won't place work on
+        # them, so counting their capacity would suppress needed launches
         free: List[Dict[str, float]] = [
-            dict(n["resources_avail"]) for n in nodes.values()]
+            dict(n["resources_avail"]) for n in nodes.values()
+            if not n.get("draining")]
         free += [dict(t.resources) for nid, t in self._launched.items()
                  if nid not in nodes]          # still starting up
         type_counts: Dict[str, int] = {}
@@ -164,7 +167,13 @@ class Autoscaler:
                 continue
             first_idle = self._idle_since.setdefault(node_id, now)
             if now - first_idle >= self.config.idle_timeout_s:
-                if self.provider.terminate_node(node_id):
+                if not info.get("draining"):
+                    # Drain first so the scheduler stops placing work;
+                    # terminate on a later round once still-idle
+                    # (reference: autoscaler v2 drain-before-terminate).
+                    self.client.controller_rpc(
+                        "drain_node", node_id=info["node_id"])
+                elif self.provider.terminate_node(node_id):
                     terminated += 1
                     self._launched.pop(node_id, None)
                     self._idle_since.pop(node_id, None)

@@ -1,0 +1,37 @@
+"""Experimental APIs (reference parity: ray.experimental)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def set_resource(resource_name: str, capacity: float,
+                 node_id: Optional[str] = None) -> None:
+    """Dynamically set a custom resource's capacity on a node
+    (reference parity: ray.experimental.set_resource — dynamic custom
+    resources). capacity <= 0 deletes the resource.
+
+    Routed over the controller's heartbeat command channel to the
+    daemon, which applies it locally and gossips the new totals back
+    (ray_syncer RESOURCE_VIEW path), so scheduling sees it within one
+    heartbeat round-trip (~1 s).
+    """
+    from .._private import state as _state
+    client = _state.current_client()
+    if node_id is None:
+        # inside a worker: default to the local node (reference
+        # semantics); drivers fall back to the head node
+        node_id = (getattr(client, "runtime_context", None)
+                   or {}).get("node_id")
+        if node_id is None:   # driver: first alive node (the head)
+            nodes = client.controller_rpc("list_nodes")
+            alive = [n for n in nodes if n["alive"]]
+            if not alive:
+                raise RuntimeError("no alive node to set the resource on")
+            node_id = alive[0]["node_id"]
+    reply = client.controller_rpc("set_node_resource", node_id=node_id,
+                                  name=resource_name,
+                                  capacity=float(capacity))
+    if reply.get("status") != "queued":
+        raise RuntimeError(
+            f"set_resource failed for node {node_id[:12]}: {reply}")

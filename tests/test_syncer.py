@@ -1,0 +1,101 @@
+"""Resource/stats gossip + drain (ray_syncer equivalent).
+
+Reference parity: src/ray/common/ray_syncer/ray_syncer.h:39-83
+(versioned per-node snapshots, command channel) and autoscaler v2
+drain-before-terminate.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.experimental
+from ray_tpu._private.state import current_client
+
+
+def _head_node(client):
+    nodes = client.controller_rpc("list_nodes")
+    return [n for n in nodes if n["alive"]][0]
+
+
+def test_gossiped_stats_reach_controller(ray_start):
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    assert ray_tpu.get(touch.remote()) == 1
+    client = current_client()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        stats = _head_node(client).get("stats") or {}
+        if stats.get("num_workers", 0) >= 1:
+            break
+        time.sleep(0.25)
+    stats = _head_node(client).get("stats") or {}
+    assert stats.get("num_workers", 0) >= 1, stats
+    assert "object_store_bytes" in stats
+
+
+def test_dynamic_set_resource(ray_start):
+    client = current_client()
+    ray_tpu.experimental.set_resource("widget", 3.0)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.cluster_resources().get("widget") == 3.0:
+            break
+        time.sleep(0.25)
+    assert ray_tpu.cluster_resources().get("widget") == 3.0
+
+    # schedulable against the new resource
+    @ray_tpu.remote(resources={"widget": 2.0})
+    def use():
+        return "ok"
+
+    assert ray_tpu.get(use.remote()) == "ok"
+
+    # capacity <= 0 deletes it again
+    ray_tpu.experimental.set_resource("widget", 0.0)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "widget" not in ray_tpu.cluster_resources():
+            break
+        time.sleep(0.25)
+    assert "widget" not in ray_tpu.cluster_resources()
+
+
+def test_drain_node_excluded_from_scheduling(ray_start):
+    client = current_client()
+    node_id = ray_tpu.add_fake_node(num_cpus=2.0,
+                                    resources={"special": 1.0})
+    try:
+        # schedulable before the drain
+        @ray_tpu.remote(resources={"special": 1.0})
+        def on_special():
+            return "placed"
+
+        assert ray_tpu.get(on_special.remote()) == "placed"
+
+        reply = client.controller_rpc("drain_node", node_id=node_id)
+        assert reply["status"] == "draining"
+
+        # the daemon learns it is draining via the command channel
+        rt = ray_tpu._private.worker._runtime
+        daemon = [d for d in rt.extra_daemons
+                  if d.node_id == node_id][0]
+        deadline = time.time() + 10
+        while time.time() < deadline and not daemon.draining:
+            time.sleep(0.25)
+        assert daemon.draining
+
+        # tasks needing its exclusive resource now fail as infeasible
+        # (no other node can ever satisfy them, autoscaling off)
+        from ray_tpu.exceptions import InfeasibleResourceError, TaskError
+        with pytest.raises((InfeasibleResourceError, TaskError)):
+            ray_tpu.get(on_special.remote(), timeout=30)
+
+        nodes = {n["node_id"]: n
+                 for n in client.controller_rpc("list_nodes")}
+        assert nodes[node_id]["draining"] is True
+    finally:
+        ray_tpu.remove_node(node_id)
