@@ -274,6 +274,14 @@ class Controller:
     async def rpc_register_node(self, node_id: str, addr, resources,
                                 labels=None) -> dict:
         node = NodeEntry(node_id, addr, resources, labels)
+        prior = self.nodes.get(node_id)
+        if prior is not None:
+            # A re-registering node (health-blip recovery) keeps its
+            # undelivered command queue and drain state — at-least-once
+            # delivery must survive the dead-mark/re-register cycle.
+            node.commands = prior.commands
+            node.cmd_seq = prior.cmd_seq
+            node.draining = prior.draining
         self.nodes[node_id] = node
         # A re-registering node (same id) gets live PG reservations
         # re-applied so PG tasks + new tasks can't oversubscribe it.
@@ -361,6 +369,9 @@ class Controller:
             # shift availability by the delta so in-flight acquisitions
             # stay accounted.
             for k in set(new_total) | set(node.resources_total):
+                if k not in new_total:
+                    node.resources_avail.pop(k, None)   # deleted resource
+                    continue
                 delta = (new_total.get(k, 0.0)
                          - node.resources_total.get(k, 0.0))
                 if delta:
@@ -391,6 +402,11 @@ class Controller:
         """Route a dynamic resource update to a node via the command
         channel; the daemon applies it locally and gossips the new totals
         back on its next heartbeat."""
+        if name in ("CPU", "TPU", "memory") or name.startswith("TPU-"):
+            # deleting/overriding builtin capacity would wedge scheduling
+            # cluster-wide (reference set_resource has the same guard)
+            return {"status": "rejected",
+                    "error": f"cannot override builtin resource {name!r}"}
         node = self.nodes.get(node_id)
         if node is None or not node.alive:
             return {"status": "not_found"}

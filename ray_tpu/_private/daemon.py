@@ -672,9 +672,10 @@ class NodeDaemon:
                 parts.append(f"<unreachable: {e!r}>")
         return "\n".join(parts)
 
-    async def rpc_node_stats(self) -> dict:
+    def _stats(self) -> dict:
+        """One stats block for both the node-stats RPC and the gossiped
+        view — a single source so the two can't drift."""
         return {
-            "node_id": self.node_id,
             "num_workers": len([w for w in self.workers.values()
                                 if w.state != "dead"]),
             "num_idle": sum(len(v) for v in self.idle.values()),
@@ -685,21 +686,15 @@ class NodeDaemon:
             "oom_kills": self.oom_kills,
         }
 
+    async def rpc_node_stats(self) -> dict:
+        return {"node_id": self.node_id, **self._stats()}
+
     # ------------------------------------------------------------- monitor
 
     def _build_view(self) -> dict:
         """Local state snapshot for the gossip channel. Versioned: the
         monitor loop only ships it when it differs from the last one."""
-        stats = {
-            "num_workers": len([w for w in self.workers.values()
-                                if w.state != "dead"]),
-            "num_idle": sum(len(v) for v in self.idle.values()),
-            "object_store_objects": self.object_store.num_objects,
-            "object_store_bytes": self.object_store.bytes_used,
-            "bytes_spilled": self.object_store.bytes_spilled,
-            "oom_kills": self.oom_kills,
-        }
-        return {"stats": stats,
+        return {"stats": self._stats(),
                 "resources_total": dict(self.resources),
                 "draining": self.draining}
 

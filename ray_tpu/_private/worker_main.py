@@ -182,13 +182,29 @@ class WorkerRuntime:
         self._code_blobs[fn_hash] = blob
         return blob
 
+    @staticmethod
+    def _resolve_descriptor(desc: dict):
+        """Cross-language function descriptor -> python callable
+        (reference parity: ray.cross_language / FunctionDescriptor —
+        non-Python drivers, e.g. the C++ API, name functions as
+        module + qualname instead of shipping pickled code)."""
+        import importlib
+        obj = importlib.import_module(desc["module"])
+        for part in desc["name"].split("."):
+            obj = getattr(obj, part)
+        return obj
+
     async def _load_fn(self, spec: dict):
         """Resolve the task code object for a spec.
 
         Small blobs ride inline (fn_blob); large ones arrive as a content
         hash and are fetched once from the controller's function store,
         then cached (reference parity: function_manager.py lazy import).
+        Cross-language callers send a descriptor instead (fn_desc).
         """
+        desc = spec.get("fn_desc")
+        if desc is not None:
+            return self._resolve_descriptor(desc)
         blob = spec.get("fn_blob")
         if blob is not None:
             return self._deserialize_fn(blob)
@@ -457,10 +473,16 @@ class WorkerRuntime:
             # Deserialize a FRESH class object per actor creation (not via
             # _fn_cache): class-attribute state must stay per-actor when
             # several actors of one class share this worker process.
-            blob = spec.get("fn_blob")
-            if blob is None:
-                blob = await self._fetch_blob(spec["fn_hash"])
-            cls = deserialize_code(blob)
+            # (Descriptor-named classes are imported, not deserialized —
+            # cross-language actors share the imported class object.)
+            desc = spec.get("fn_desc")
+            if desc is not None:
+                cls = self._resolve_descriptor(desc)
+            else:
+                blob = spec.get("fn_blob")
+                if blob is None:
+                    blob = await self._fetch_blob(spec["fn_hash"])
+                cls = deserialize_code(blob)
             args, kwargs = await self._resolve_args(spec["args_blob"])
             self.current_actor_id = actor_id
             instance = await loop.run_in_executor(

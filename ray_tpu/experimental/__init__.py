@@ -16,13 +16,13 @@ def set_resource(resource_name: str, capacity: float,
     (ray_syncer RESOURCE_VIEW path), so scheduling sees it within one
     heartbeat round-trip (~1 s).
     """
+    import ray_tpu
     from .._private import state as _state
     client = _state.current_client()
     if node_id is None:
         # inside a worker: default to the local node (reference
         # semantics); drivers fall back to the head node
-        node_id = (getattr(client, "runtime_context", None)
-                   or {}).get("node_id")
+        node_id = ray_tpu.get_runtime_context().get_node_id()
         if node_id is None:   # driver: first alive node (the head)
             nodes = client.controller_rpc("list_nodes")
             alive = [n for n in nodes if n["alive"]]

@@ -1,0 +1,39 @@
+"""Functions/classes callable from the C++ worker API by descriptor.
+
+The C++ API (src/cpp/ray_api.h) submits tasks as cross-language function
+descriptors — module + qualname — instead of pickled code (reference
+parity: ray.cross_language / FunctionDescriptor). This module doubles as
+the demo target and the test fixture for that path.
+"""
+
+from __future__ import annotations
+
+
+def add(a, b):
+    return a + b
+
+
+def concat(*parts):
+    return "".join(parts)
+
+
+def big_bytes(n: int) -> bytes:
+    """> INLINE_OBJECT_LIMIT results exercise the shm-location push and
+    the C++ side's daemon fetch."""
+    return b"x" * int(n)
+
+
+def echo(x):
+    return x
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def total(self):
+        return self.n

@@ -1,0 +1,1103 @@
+// ray_tpu C++ worker API implementation. See ray_api.h for the design
+// overview and ray_tpu/_private/protocol.py for the wire contract:
+//   u32 header_len | header(pickle) | payload buffers...
+//   header = (kind, msg_id, method, [buf lens]); bufs[0] = pickled
+//   payload (kwargs dict for requests, result for responses), bufs[1:]
+//   = pickle-5 out-of-band buffers.
+// Reference parity: cpp/include/ray/api/*.h + cpp/src/ray/runtime/.
+
+#include "ray_api.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <stdexcept>
+#include <thread>
+#include <unordered_map>
+
+namespace raytpu {
+namespace {
+
+// ============================================================ pickle emit
+// Protocol-3 subset: everything the runtime's handlers need from us.
+
+void PutU32(std::string& out, uint32_t v) {
+  char b[4];
+  b[0] = v & 0xff; b[1] = (v >> 8) & 0xff;
+  b[2] = (v >> 16) & 0xff; b[3] = (v >> 24) & 0xff;
+  out.append(b, 4);
+}
+
+void PutU64(std::string& out, uint64_t v) {
+  for (int i = 0; i < 8; i++) out.push_back(char((v >> (8 * i)) & 0xff));
+}
+
+void PickleValue(std::string& out, const Value& v);
+
+void PickleItems(std::string& out, const std::vector<Value>& items) {
+  for (const auto& it : items) PickleValue(out, it);
+}
+
+void PickleValue(std::string& out, const Value& v) {
+  switch (v.kind) {
+    case Value::NONE: out.push_back('N'); break;
+    case Value::BOOL: out.push_back(v.b ? '\x88' : '\x89'); break;
+    case Value::INT:
+      if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        out.push_back('J');
+        PutU32(out, (uint32_t)(int32_t)v.i);
+      } else {                       // LONG1: little-endian signed
+        out.push_back('\x8a');
+        out.push_back(8);
+        PutU64(out, (uint64_t)v.i);
+      }
+      break;
+    case Value::FLOAT: {
+      out.push_back('G');            // BINFLOAT is big-endian
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f), "");
+      std::memcpy(&bits, &v.f, 8);
+      for (int i = 7; i >= 0; i--)
+        out.push_back(char((bits >> (8 * i)) & 0xff));
+      break;
+    }
+    case Value::STR:
+      out.push_back('X');
+      PutU32(out, (uint32_t)v.s.size());
+      out.append(v.s);
+      break;
+    case Value::BYTES:
+      out.push_back('B');
+      PutU32(out, (uint32_t)v.s.size());
+      out.append(v.s);
+      break;
+    case Value::LIST:
+      out.push_back(']');
+      if (!v.items.empty()) {
+        out.push_back('(');
+        PickleItems(out, v.items);
+        out.push_back('e');
+      }
+      break;
+    case Value::TUPLE:
+      switch (v.items.size()) {
+        case 0: out.push_back(')'); break;
+        case 1: PickleItems(out, v.items); out.push_back('\x85'); break;
+        case 2: PickleItems(out, v.items); out.push_back('\x86'); break;
+        case 3: PickleItems(out, v.items); out.push_back('\x87'); break;
+        default:
+          out.push_back('(');
+          PickleItems(out, v.items);
+          out.push_back('t');
+      }
+      break;
+    case Value::DICT:
+      out.push_back('}');
+      if (!v.dict.empty()) {
+        out.push_back('(');
+        for (const auto& kv : v.dict) {
+          PickleValue(out, kv.first);
+          PickleValue(out, kv.second);
+        }
+        out.push_back('u');
+      }
+      break;
+    case Value::REF:
+      // GLOBAL _deserialize_ref + (id, (host, port)) + REDUCE — workers
+      // rebuild a borrowed ObjectRef pointing back at our owner server.
+      out.push_back('c');
+      out.append("ray_tpu._private.object_ref\n_deserialize_ref\n");
+      PickleValue(out, Value::Str(v.ref_id));
+      {
+        Value addr = Value::Tuple({Value::Str(v.ref_host),
+                                   Value::Int(v.ref_port)});
+        PickleValue(out, addr);
+      }
+      out.push_back('\x86');         // TUPLE2 -> the args tuple
+      out.push_back('R');
+      break;
+    case Value::OPAQUE:
+      throw std::runtime_error("cannot pickle an opaque value (" +
+                               v.opaque_name + ") back to Python");
+  }
+}
+
+std::string Pickle(const Value& v) {
+  std::string out;
+  out.push_back('\x80');
+  out.push_back('\x03');
+  PickleValue(out, v);
+  out.push_back('.');
+  return out;
+}
+
+// ========================================================== pickle parse
+// Enough of protocols 0-5 to read what CPython's pickler emits for the
+// runtime's replies and pushes. Unknown classes become OPAQUE nodes.
+
+class Unpickler {
+ public:
+  Unpickler(const std::string& data, const std::vector<std::string>* bufs)
+      : d_(data), bufs_(bufs) {}
+
+  Value Parse() {
+    while (true) {
+      if (p_ >= d_.size()) throw std::runtime_error("pickle truncated");
+      unsigned char op = d_[p_++];
+      switch (op) {
+        case 0x80: p_ += 1; break;                    // PROTO
+        case 0x95: p_ += 8; break;                    // FRAME
+        case '.': {                                   // STOP
+          if (stack_.empty()) throw std::runtime_error("pickle: empty stop");
+          return stack_.back();
+        }
+        case '(': marks_.push_back(stack_.size()); break;   // MARK
+        case '0': stack_.pop_back(); break;                 // POP
+        case '1': PopToMark(); break;                       // POP_MARK
+        case 'N': Push(Value::None_()); break;
+        case 0x88: Push(Value::Bool(true)); break;
+        case 0x89: Push(Value::Bool(false)); break;
+        case 'J': Push(Value::Int((int32_t)ReadU32())); break;
+        case 'K': Push(Value::Int((uint8_t)Read1())); break;
+        case 'M': {
+          uint16_t v = (uint8_t)Read1();
+          v |= ((uint16_t)(uint8_t)Read1()) << 8;
+          Push(Value::Int(v));
+          break;
+        }
+        case 0x8a: {                                   // LONG1
+          int n = (uint8_t)Read1();
+          Push(Value::Int(ReadLong(n)));
+          break;
+        }
+        case 0x8b: {                                   // LONG4
+          uint32_t n = ReadU32();
+          Push(Value::Int(ReadLong(n)));
+          break;
+        }
+        case 'G': {                                    // BINFLOAT (BE)
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; i++)
+            bits = (bits << 8) | (uint8_t)Read1();
+          double f;
+          std::memcpy(&f, &bits, 8);
+          Push(Value::Float(f));
+          break;
+        }
+        case 0x8c: Push(Value::Str(ReadStr((uint8_t)Read1()))); break;
+        case 'X': Push(Value::Str(ReadStr(ReadU32()))); break;
+        case 0x8d: Push(Value::Str(ReadStr(ReadU64()))); break;
+        case 'C': Push(Value::Bytes(ReadStr((uint8_t)Read1()))); break;
+        case 'B': Push(Value::Bytes(ReadStr(ReadU32()))); break;
+        case 0x8e: Push(Value::Bytes(ReadStr(ReadU64()))); break;
+        case 0x96: Push(Value::Bytes(ReadStr(ReadU64()))); break;  // BYTEARRAY8
+        case ']': case 0x8f: Push(Value::List({})); break;  // EMPTY_LIST/SET
+        case ')': Push(Value::Tuple({})); break;
+        case '}': Push(Value::Dict()); break;
+        case 'a': {                                    // APPEND
+          Value v = Pop();
+          stack_.back().items.push_back(std::move(v));
+          break;
+        }
+        case 'e': case 0x90: {                         // APPENDS/ADDITEMS
+          size_t m = PopMarkIndex();
+          Value& target = stack_[m - 1];
+          for (size_t i = m; i < stack_.size(); i++)
+            target.items.push_back(std::move(stack_[i]));
+          stack_.resize(m);
+          break;
+        }
+        case 's': {                                    // SETITEM
+          Value v = Pop(), k = Pop();
+          stack_.back().dict.emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 'u': {                                    // SETITEMS
+          size_t m = PopMarkIndex();
+          Value& target = stack_[m - 1];
+          for (size_t i = m; i + 1 < stack_.size() + 1; i += 2) {
+            if (i + 1 >= stack_.size()) break;
+            target.dict.emplace_back(std::move(stack_[i]),
+                                     std::move(stack_[i + 1]));
+          }
+          stack_.resize(m);
+          break;
+        }
+        case 't': {                                    // TUPLE
+          size_t m = PopMarkIndex();
+          Value t = Value::Tuple({});
+          for (size_t i = m; i < stack_.size(); i++)
+            t.items.push_back(std::move(stack_[i]));
+          stack_.resize(m);
+          Push(std::move(t));
+          break;
+        }
+        case 0x85: { Value a = Pop(); Push(Value::Tuple({a})); break; }
+        case 0x86: {
+          Value b2 = Pop(), a = Pop();
+          Push(Value::Tuple({a, b2}));
+          break;
+        }
+        case 0x87: {
+          Value c = Pop(), b2 = Pop(), a = Pop();
+          Push(Value::Tuple({a, b2, c}));
+          break;
+        }
+        case 0x91: {                                   // FROZENSET
+          size_t m = PopMarkIndex();
+          Value t = Value::List({});
+          for (size_t i = m; i < stack_.size(); i++)
+            t.items.push_back(std::move(stack_[i]));
+          stack_.resize(m);
+          Push(std::move(t));
+          break;
+        }
+        case 0x94: memo_[memo_next_++] = stack_.back(); break;  // MEMOIZE
+        case 'q': memo_[(uint8_t)Read1()] = stack_.back(); break;
+        case 'r': memo_[ReadU32()] = stack_.back(); break;
+        case 'h': Push(memo_.at((uint8_t)Read1())); break;      // BINGET
+        case 'j': Push(memo_.at(ReadU32())); break;
+        case 'c': {                                    // GLOBAL
+          std::string mod = ReadLine(), name = ReadLine();
+          Value g;
+          g.kind = Value::OPAQUE;
+          g.opaque_name = mod + "." + name;
+          Push(std::move(g));
+          break;
+        }
+        case 0x93: {                                   // STACK_GLOBAL
+          Value name = Pop(), mod = Pop();
+          Value g;
+          g.kind = Value::OPAQUE;
+          g.opaque_name = mod.s + "." + name.s;
+          Push(std::move(g));
+          break;
+        }
+        case 'R': case 0x81: {                         // REDUCE/NEWOBJ
+          Value args = Pop(), callable = Pop();
+          Push(ApplyCallable(std::move(callable), std::move(args)));
+          break;
+        }
+        case 0x92: {                                   // NEWOBJ_EX
+          Value kw = Pop(), args = Pop(), callable = Pop();
+          (void)kw;
+          Push(ApplyCallable(std::move(callable), std::move(args)));
+          break;
+        }
+        case 'b': Pop(); break;  // BUILD: drop state, keep object
+        case 0x97: {                                   // NEXT_BUFFER
+          if (bufs_ == nullptr || buf_next_ >= bufs_->size())
+            throw std::runtime_error("pickle: missing out-of-band buffer");
+          Push(Value::Bytes((*bufs_)[buf_next_++]));
+          break;
+        }
+        case 0x98: break;                              // READONLY_BUFFER
+        default: {
+          std::ostringstream os;
+          os << "pickle: unsupported opcode 0x" << std::hex << (int)op
+             << " at offset " << (p_ - 1);
+          throw std::runtime_error(os.str());
+        }
+      }
+    }
+  }
+
+ private:
+  Value ApplyCallable(Value callable, Value args) {
+    if (callable.kind == Value::OPAQUE &&
+        callable.opaque_name ==
+            "ray_tpu._private.object_ref._deserialize_ref" &&
+        args.items.size() == 2) {
+      // (object_id, (host, port)) -> first-class REF
+      const Value& addr = args.items[1];
+      return Value::Ref(args.items[0].s,
+                        addr.items.empty() ? "" : addr.items[0].s,
+                        addr.items.size() > 1 ? (int)addr.items[1].i : 0);
+    }
+    Value out;
+    out.kind = Value::OPAQUE;
+    out.opaque_name = callable.kind == Value::OPAQUE ? callable.opaque_name
+                                                     : "<value>";
+    out.opaque_args = std::make_shared<Value>(std::move(args));
+    return out;
+  }
+
+  char Read1() {
+    if (p_ >= d_.size()) throw std::runtime_error("pickle truncated");
+    return d_[p_++];
+  }
+  uint32_t ReadU32() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) v |= ((uint32_t)(uint8_t)Read1()) << (8 * i);
+    return v;
+  }
+  uint64_t ReadU64() {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v |= ((uint64_t)(uint8_t)Read1()) << (8 * i);
+    return v;
+  }
+  int64_t ReadLong(size_t n) {
+    if (n > 8) throw std::runtime_error("pickle: bigint > 64 bits");
+    uint64_t v = 0;
+    bool neg = false;
+    for (size_t i = 0; i < n; i++) {
+      uint8_t byte = (uint8_t)Read1();
+      v |= ((uint64_t)byte) << (8 * i);
+      if (i == n - 1) neg = byte & 0x80;
+    }
+    if (neg && n < 8) v |= ~((1ULL << (8 * n)) - 1);   // sign-extend
+    return (int64_t)v;
+  }
+  std::string ReadStr(uint64_t n) {
+    if (p_ + n > d_.size()) throw std::runtime_error("pickle truncated");
+    std::string s = d_.substr(p_, n);
+    p_ += n;
+    return s;
+  }
+  std::string ReadLine() {
+    std::string s;
+    while (true) {
+      char c = Read1();
+      if (c == '\n') return s;
+      s.push_back(c);
+    }
+  }
+  void Push(Value v) { stack_.push_back(std::move(v)); }
+  Value Pop() {
+    Value v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+  size_t PopMarkIndex() {
+    size_t m = marks_.back();
+    marks_.pop_back();
+    return m;
+  }
+  void PopToMark() { stack_.resize(PopMarkIndex()); }
+
+  const std::string& d_;
+  const std::vector<std::string>* bufs_;
+  size_t p_ = 0;
+  size_t buf_next_ = 0;
+  std::vector<Value> stack_;
+  std::vector<size_t> marks_;
+  std::unordered_map<uint32_t, Value> memo_;
+  uint32_t memo_next_ = 0;
+};
+
+Value Unpickle(const std::string& data,
+               const std::vector<std::string>* bufs = nullptr) {
+  return Unpickler(data, bufs).Parse();
+}
+
+// ================================================= SerializedObject flat
+// u32 nbuf | u64 len * (nbuf+1) | data | buffers...   (serialization.py)
+
+std::string FlatFromPickle(const std::string& pickled) {
+  std::string out;
+  PutU32(out, 0);
+  PutU64(out, pickled.size());
+  out.append(pickled);
+  return out;
+}
+
+Value ParseFlat(const std::string& flat) {
+  if (flat.size() < 12) throw std::runtime_error("flat object truncated");
+  uint32_t nbuf = 0;
+  std::memcpy(&nbuf, flat.data(), 4);
+  size_t off = 4;
+  std::vector<uint64_t> lens;
+  for (uint32_t i = 0; i < nbuf + 1; i++) {
+    uint64_t n = 0;
+    std::memcpy(&n, flat.data() + off, 8);
+    lens.push_back(n);
+    off += 8;
+  }
+  std::string data = flat.substr(off, lens[0]);
+  off += lens[0];
+  std::vector<std::string> bufs;
+  for (uint32_t i = 1; i <= nbuf; i++) {
+    bufs.push_back(flat.substr(off, lens[i]));
+    off += lens[i];
+  }
+  return Unpickle(data, &bufs);
+}
+
+// ================================================================ socket
+
+void WriteAll(int fd, const char* p, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) throw std::runtime_error("socket write failed");
+    p += w;
+    n -= (size_t)w;
+  }
+}
+
+bool ReadAll(int fd, char* p, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct Frame {
+  int kind;
+  int64_t msg_id;
+  std::string method;
+  std::vector<std::string> bufs;
+};
+
+bool ReadFrame(int fd, Frame* out) {
+  char lenb[4];
+  if (!ReadAll(fd, lenb, 4)) return false;
+  uint32_t hlen = 0;
+  std::memcpy(&hlen, lenb, 4);
+  std::string header(hlen, '\0');
+  if (!ReadAll(fd, header.data(), hlen)) return false;
+  Value h = Unpickle(header);
+  if (h.kind != Value::TUPLE || h.items.size() != 4)
+    throw std::runtime_error("bad frame header");
+  out->kind = (int)h.items[0].i;
+  out->msg_id = h.items[1].i;
+  out->method = h.items[2].s;
+  out->bufs.clear();
+  for (const auto& lv : h.items[3].items) {
+    std::string buf((size_t)lv.i, '\0');
+    if (!ReadAll(fd, buf.data(), (size_t)lv.i)) return false;
+    out->bufs.push_back(std::move(buf));
+  }
+  return true;
+}
+
+void WriteFrame(int fd, std::mutex& wmu, int kind, int64_t msg_id,
+                const std::string& method, const Value& payload) {
+  std::string body = Pickle(payload);
+  Value header = Value::Tuple(
+      {Value::Int(kind), Value::Int(msg_id), Value::Str(method),
+       Value::List({Value::Int((int64_t)body.size())})});
+  std::string h = Pickle(header);
+  std::lock_guard<std::mutex> lk(wmu);
+  char lenb[4];
+  uint32_t hlen = (uint32_t)h.size();
+  std::memcpy(lenb, &hlen, 4);
+  WriteAll(fd, lenb, 4);
+  WriteAll(fd, h.data(), h.size());
+  WriteAll(fd, body.data(), body.size());
+}
+
+constexpr int KIND_REQUEST = 0;
+constexpr int KIND_RESPONSE_OK = 1;
+constexpr int KIND_RESPONSE_ERR = 2;
+constexpr int KIND_ONEWAY = 3;
+
+int DialTcp(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("socket() failed");
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (::inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) {
+    ::close(fd);
+    throw std::runtime_error("bad host " + host);
+  }
+  if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) != 0) {
+    ::close(fd);
+    throw std::runtime_error("connect to " + host + " failed");
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// A connection to one peer: concurrent calls, one reader thread.
+class Conn {
+ public:
+  Conn(const std::string& host, int port) : fd_(DialTcp(host, port)) {
+    reader_ = std::thread([this] { ReadLoop(); });
+  }
+  ~Conn() { Close(); if (reader_.joinable()) reader_.join(); }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  Value Call(const std::string& method, const Value& kwargs,
+             double timeout_s = 120.0) {
+    auto pending = std::make_shared<Pending>();
+    int64_t id;
+    {
+      std::lock_guard<std::mutex> lk(pmu_);
+      if (dead_) throw std::runtime_error("connection lost");
+      id = next_id_++;
+      pending_[id] = pending;
+    }
+    WriteFrame(fd_, wmu_, KIND_REQUEST, id, method, kwargs);
+    std::unique_lock<std::mutex> lk(pending->mu);
+    if (!pending->cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                              [&] { return pending->done; })) {
+      lk.unlock();
+      std::lock_guard<std::mutex> plk(pmu_);
+      pending_.erase(id);            // don't leak entries on stuck peers
+      throw std::runtime_error("RPC " + method + " timed out");
+    }
+    if (!pending->ok)
+      throw std::runtime_error("RPC " + method + " failed remotely:\n" +
+                               pending->error);
+    return std::move(pending->result);
+  }
+
+  void Oneway(const std::string& method, const Value& kwargs) {
+    WriteFrame(fd_, wmu_, KIND_ONEWAY, 0, method, kwargs);
+  }
+
+ private:
+  struct Pending {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false, ok = false;
+    Value result;
+    std::string error;
+  };
+
+  void ReadLoop() {
+    Frame f;
+    while (true) {
+      bool got = false;
+      try {
+        got = ReadFrame(fd_, &f);
+      } catch (...) {
+        got = false;
+      }
+      if (!got) break;
+      if (f.kind != KIND_RESPONSE_OK && f.kind != KIND_RESPONSE_ERR)
+        continue;                    // peers never push requests to us here
+      std::shared_ptr<Pending> p;
+      {
+        std::lock_guard<std::mutex> lk(pmu_);
+        auto it = pending_.find(f.msg_id);
+        if (it == pending_.end()) continue;
+        p = it->second;
+        pending_.erase(it);
+      }
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->done = true;
+      try {
+        if (f.bufs.empty()) throw std::runtime_error("empty frame");
+        std::vector<std::string> oob(f.bufs.begin() + 1, f.bufs.end());
+        Value payload = Unpickle(f.bufs.at(0), &oob);
+        if (f.kind == KIND_RESPONSE_OK) {
+          p->ok = true;
+          p->result = std::move(payload);
+        } else {
+          p->error = payload.kind == Value::STR ? payload.s : payload.Repr();
+        }
+      } catch (const std::exception& e) {
+        p->error = std::string("payload decode failed: ") + e.what();
+      }
+      p->cv.notify_all();
+    }
+    std::lock_guard<std::mutex> lk(pmu_);
+    dead_ = true;
+    for (auto& kv : pending_) {
+      std::lock_guard<std::mutex> plk(kv.second->mu);
+      kv.second->done = true;
+      kv.second->error = "connection lost";
+      kv.second->cv.notify_all();
+    }
+    pending_.clear();
+  }
+
+  int fd_;
+  std::mutex wmu_, pmu_;
+  int64_t next_id_ = 0;
+  bool dead_ = false;
+  std::unordered_map<int64_t, std::shared_ptr<Pending>> pending_;
+  std::thread reader_;
+};
+
+std::string RandHex32() {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  static const char* hexd = "0123456789abcdef";
+  std::string s(32, '0');
+  for (int i = 0; i < 32; i++) s[i] = hexd[rng() & 0xf];
+  return s;
+}
+
+}  // namespace
+
+// ============================================================ Value repr
+
+std::string Value::Repr() const {
+  std::ostringstream os;
+  switch (kind) {
+    case NONE: os << "None"; break;
+    case BOOL: os << (b ? "True" : "False"); break;
+    case INT: os << i; break;
+    case FLOAT: os << f; break;
+    case STR: os << '\'' << s << '\''; break;
+    case BYTES: os << "b<" << s.size() << " bytes>"; break;
+    case LIST: case TUPLE: {
+      os << (kind == LIST ? '[' : '(');
+      for (size_t j = 0; j < items.size(); j++)
+        os << (j ? ", " : "") << items[j].Repr();
+      os << (kind == LIST ? ']' : ')');
+      break;
+    }
+    case DICT: {
+      os << '{';
+      for (size_t j = 0; j < dict.size(); j++)
+        os << (j ? ", " : "") << dict[j].first.Repr() << ": "
+           << dict[j].second.Repr();
+      os << '}';
+      break;
+    }
+    case REF: os << "ObjectRef(" << ref_id.substr(0, 12) << ")"; break;
+    case OPAQUE:
+      os << '<' << opaque_name;
+      if (opaque_args) os << ' ' << opaque_args->Repr();
+      os << '>';
+      break;
+  }
+  return os.str();
+}
+
+// ================================================================ Client
+
+struct Client::Impl {
+  // owner-side object table
+  struct ObjEntry {
+    bool ready = false;
+    bool is_error = false;
+    std::string error;
+    std::string flat;          // inline payload (serialized flat bytes)
+    bool has_location = false;
+    std::string loc_host, shm_name;
+    int loc_port = 0;
+    int64_t loc_size = 0;
+  };
+
+  std::string client_id = "cpp-driver-" + RandHex32().substr(0, 12);
+  std::string controller_host;
+  int controller_port = 0;
+  std::string self_host = "127.0.0.1";
+  int self_port = 0;
+
+  std::mutex cmu;                    // conn pool
+  std::map<std::pair<std::string, int>, std::shared_ptr<Conn>> conns;
+
+  std::mutex omu;
+  std::condition_variable ocv;
+  std::map<std::string, ObjEntry> objects;
+
+  std::mutex amu;                    // actor addr + seq cache
+  std::map<std::string, std::pair<std::string, int>> actor_addrs;
+  std::map<std::string, int64_t> actor_seq;
+
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex afd_mu;
+  std::vector<int> accepted_fds;     // shut down so ServeConn loops exit
+  std::atomic<bool> closing{false};
+
+  std::shared_ptr<Conn> Dial(const std::string& host, int port) {
+    std::lock_guard<std::mutex> lk(cmu);
+    auto key = std::make_pair(host, port);
+    auto it = conns.find(key);
+    if (it != conns.end()) return it->second;
+    auto c = std::make_shared<Conn>(host, port);
+    conns[key] = c;
+    return c;
+  }
+
+  std::shared_ptr<Conn> Controller() {
+    return Dial(controller_host, controller_port);
+  }
+
+  // ------------------------------------------------------- owner server
+
+  void StartServer() {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = 0;
+    if (::bind(listen_fd, (sockaddr*)&sa, sizeof(sa)) != 0 ||
+        ::listen(listen_fd, 64) != 0)
+      throw std::runtime_error("owner server bind/listen failed");
+    socklen_t len = sizeof(sa);
+    ::getsockname(listen_fd, (sockaddr*)&sa, &len);
+    self_port = ntohs(sa.sin_port);
+    accept_thread = std::thread([this] {
+      while (!closing) {
+        int cfd = ::accept(listen_fd, nullptr, nullptr);
+        if (cfd < 0) break;
+        {
+          std::lock_guard<std::mutex> lk(afd_mu);
+          accepted_fds.push_back(cfd);
+        }
+        conn_threads.emplace_back([this, cfd] { ServeConn(cfd); });
+      }
+    });
+  }
+
+  void ServeConn(int fd) {
+    auto wmu = std::make_shared<std::mutex>();
+    Frame f;
+    while (true) {
+      bool got = false;
+      try {
+        got = ReadFrame(fd, &f);
+      } catch (...) {
+        got = false;
+      }
+      if (!got) break;
+      Value kwargs;
+      try {
+        if (f.bufs.empty()) throw std::runtime_error("empty frame");
+        std::vector<std::string> oob(f.bufs.begin() + 1, f.bufs.end());
+        kwargs = Unpickle(f.bufs.at(0), &oob);
+      } catch (const std::exception& e) {
+        if (f.kind == KIND_REQUEST)
+          WriteFrame(fd, *wmu, KIND_RESPONSE_ERR, f.msg_id, f.method,
+                     Value::Str(std::string("decode failed: ") + e.what()));
+        continue;
+      }
+      try {
+        Value result = Dispatch(f.method, kwargs);
+        if (f.kind == KIND_REQUEST)
+          WriteFrame(fd, *wmu, KIND_RESPONSE_OK, f.msg_id, f.method, result);
+      } catch (const std::exception& e) {
+        if (f.kind == KIND_REQUEST)
+          WriteFrame(fd, *wmu, KIND_RESPONSE_ERR, f.msg_id, f.method,
+                     Value::Str(e.what()));
+      }
+    }
+    ::close(fd);
+  }
+
+  Value Dispatch(const std::string& method, const Value& kwargs) {
+    if (method == "ping") return Value::Str("pong");
+    if (method == "ref_event") return Value::None_();  // no distributed GC
+    if (method == "object_ready") {
+      OnObjectReady(kwargs);
+      return Value::None_();
+    }
+    if (method == "get_object") {
+      const Value* oid = kwargs.Find("object_id");
+      std::unique_lock<std::mutex> lk(omu);
+      auto it = objects.find(oid ? oid->s : "");
+      if (it == objects.end() || !it->second.ready) {
+        Value r = Value::Dict();
+        r.Set("status", Value::Str("lost"));
+        return r;
+      }
+      Value r = Value::Dict();
+      r.Set("status", Value::Str("inline"));
+      r.Set("payload", Value::Bytes(it->second.flat));
+      return r;
+    }
+    throw std::runtime_error("no handler for " + method);
+  }
+
+  void OnObjectReady(const Value& kwargs) {
+    const Value* oid = kwargs.Find("object_id");
+    if (oid == nullptr) return;
+    std::lock_guard<std::mutex> lk(omu);
+    ObjEntry& e = objects[oid->s];
+    const Value* err = kwargs.Find("error");
+    const Value* payload = kwargs.Find("payload");
+    const Value* loc = kwargs.Find("location");
+    if (err != nullptr && err->kind != Value::NONE) {
+      e.is_error = true;
+      e.error = ExtractErrorText(*err);
+    } else if (payload != nullptr && payload->kind == Value::BYTES) {
+      e.flat = payload->s;
+    } else if (loc != nullptr && loc->kind == Value::OPAQUE &&
+               loc->opaque_args && loc->opaque_args->items.size() >= 3) {
+      // ShmLocation reduces to (node_addr, shm_name, size)
+      const auto& args = loc->opaque_args->items;
+      e.has_location = true;
+      e.loc_host = args[0].items.at(0).s;
+      e.loc_port = (int)args[0].items.at(1).i;
+      e.shm_name = args[1].s;
+      e.loc_size = args[2].i;
+    }
+    e.ready = true;
+    ocv.notify_all();
+  }
+
+  static std::string ExtractErrorText(const Value& err) {
+    // a pickled exception reduces to Opaque(cls, args...) — surface the
+    // longest string argument (usually the traceback/message)
+    if (err.kind == Value::STR) return err.s;
+    std::string best = "remote error (" +
+        (err.kind == Value::OPAQUE ? err.opaque_name : "undecodable") + ")";
+    if (err.kind == Value::OPAQUE && err.opaque_args) {
+      for (const auto& a : err.opaque_args->items)
+        if (a.kind == Value::STR && a.s.size() > 0)
+          if (best.size() < a.s.size() + 16) best = a.s;
+    }
+    return best;
+  }
+
+  Value FetchAndParse(const std::string& object_id, const ObjEntry& e) {
+    if (!e.has_location) return ParseFlat(e.flat);
+    auto daemon = Dial(e.loc_host, e.loc_port);
+    Value kwargs = Value::Dict();
+    kwargs.Set("object_id", Value::Str(object_id));
+    Value reply = daemon->Call("fetch_object", kwargs, 300.0);
+    if (reply.kind != Value::BYTES)
+      throw std::runtime_error("daemon fetch returned " + reply.Repr());
+    return ParseFlat(reply.s);
+  }
+};
+
+Client::Client() : impl_(new Impl) {}
+Client::~Client() { Shutdown(); }
+
+void Client::Init(const std::string& address) {
+  std::string addr = address;
+  const std::string scheme = "ray://";
+  if (addr.rfind(scheme, 0) == 0) addr = addr.substr(scheme.size());
+  auto colon = addr.rfind(':');
+  if (colon == std::string::npos)
+    throw std::runtime_error("address must be host:port");
+  impl_->controller_host = addr.substr(0, colon);
+  impl_->controller_port = std::stoi(addr.substr(colon + 1));
+  impl_->StartServer();
+  // handshake: confirms protocol + cluster liveness
+  Value info = impl_->Controller()->Call("get_session_info", Value::Dict());
+  const Value* sess = info.Find("session_name");
+  if (sess == nullptr)
+    throw std::runtime_error("bad session info: " + info.Repr());
+}
+
+void Client::Shutdown() {
+  if (!impl_ || impl_->closing.exchange(true)) return;
+  if (impl_->listen_fd >= 0) {
+    ::shutdown(impl_->listen_fd, SHUT_RDWR);
+    ::close(impl_->listen_fd);
+  }
+  if (impl_->accept_thread.joinable()) impl_->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(impl_->afd_mu);
+    for (int fd : impl_->accepted_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : impl_->conn_threads)
+    if (t.joinable()) t.join();
+  std::lock_guard<std::mutex> lk(impl_->cmu);
+  for (auto& kv : impl_->conns) kv.second->Close();
+}
+
+ObjectRef Client::Put(const Value& v) {
+  std::string id = RandHex32();
+  std::string flat = FlatFromPickle(Pickle(v));
+  std::lock_guard<std::mutex> lk(impl_->omu);
+  auto& e = impl_->objects[id];
+  e.ready = true;
+  e.flat = std::move(flat);
+  return ObjectRef{id};
+}
+
+Value Client::MakeRef(const ObjectRef& ref) const {
+  return Value::Ref(ref.id, impl_->self_host, impl_->self_port);
+}
+
+bool Client::Wait(const ObjectRef& ref, double timeout_s) {
+  std::unique_lock<std::mutex> lk(impl_->omu);
+  return impl_->ocv.wait_for(
+      lk, std::chrono::duration<double>(timeout_s), [&] {
+        auto it = impl_->objects.find(ref.id);
+        return it != impl_->objects.end() && it->second.ready;
+      });
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  if (!Wait(ref, timeout_s))
+    throw std::runtime_error("Get timed out for " + ref.id.substr(0, 12));
+  Impl::ObjEntry e;
+  {
+    std::lock_guard<std::mutex> lk(impl_->omu);
+    e = impl_->objects[ref.id];
+  }
+  if (e.is_error) throw std::runtime_error("task failed:\n" + e.error);
+  return impl_->FetchAndParse(ref.id, e);
+}
+
+void Client::Free(const ObjectRef& ref) {
+  std::lock_guard<std::mutex> lk(impl_->omu);
+  impl_->objects.erase(ref.id);
+}
+
+ObjectRef Client::Task(const std::string& module, const std::string& qualname,
+                       std::vector<Value> args,
+                       std::map<std::string, double> resources) {
+  std::string task_id = RandHex32(), return_id = RandHex32();
+  {
+    std::lock_guard<std::mutex> lk(impl_->omu);
+    impl_->objects[return_id];      // registered, not ready
+  }
+  Value desc = Value::Dict();
+  desc.Set("module", Value::Str(module));
+  desc.Set("name", Value::Str(qualname));
+  Value res = Value::Dict();
+  for (const auto& kv : resources)
+    res.Set(kv.first, Value::Float(kv.second));
+  Value spec = Value::Dict();
+  spec.Set("task_id", Value::Str(task_id));
+  spec.Set("name", Value::Str(module + "." + qualname));
+  spec.Set("fn_desc", desc);
+  spec.Set("args_blob", Value::Bytes(FlatFromPickle(Pickle(Value::Tuple(
+      {Value::Tuple(std::move(args)), Value::Dict()})))));
+  spec.Set("return_id", Value::Str(return_id));
+  spec.Set("return_ids", Value::List({Value::Str(return_id)}));
+  spec.Set("num_returns", Value::Int(1));
+  spec.Set("owner_addr", Value::Tuple({Value::Str(impl_->self_host),
+                                       Value::Int(impl_->self_port)}));
+  spec.Set("resources", res);
+  spec.Set("scheduling", Value::None_());
+  spec.Set("is_actor_creation", Value::Bool(false));
+  spec.Set("runtime_env", Value::None_());
+  spec.Set("max_retries", Value::Int(0));
+  Value kwargs = Value::Dict();
+  kwargs.Set("spec", spec);
+  Value reply = impl_->Controller()->Call("submit_task", kwargs);
+  const Value* status = reply.Find("status");
+  if (status == nullptr ||
+      (status->s != "queued" && status->s != "ok"))
+    throw std::runtime_error("submit_task: " + reply.Repr());
+  return ObjectRef{return_id};
+}
+
+std::string Client::CreateActor(const std::string& module,
+                                const std::string& qualname,
+                                std::vector<Value> args) {
+  std::string actor_id = RandHex32(), return_id = RandHex32();
+  {
+    std::lock_guard<std::mutex> lk(impl_->omu);
+    impl_->objects[return_id];
+  }
+  Value desc = Value::Dict();
+  desc.Set("module", Value::Str(module));
+  desc.Set("name", Value::Str(qualname));
+  Value res = Value::Dict();
+  res.Set("CPU", Value::Float(0.0));
+  Value spec = Value::Dict();
+  spec.Set("task_id", Value::Str(RandHex32()));
+  spec.Set("name", Value::Str(module + "." + qualname + ".__init__"));
+  spec.Set("class_name", Value::Str(qualname));
+  spec.Set("fn_desc", desc);
+  spec.Set("args_blob", Value::Bytes(FlatFromPickle(Pickle(Value::Tuple(
+      {Value::Tuple(std::move(args)), Value::Dict()})))));
+  spec.Set("return_id", Value::Str(return_id));
+  spec.Set("owner_addr", Value::Tuple({Value::Str(impl_->self_host),
+                                       Value::Int(impl_->self_port)}));
+  spec.Set("resources", res);
+  spec.Set("scheduling", Value::None_());
+  spec.Set("is_actor_creation", Value::Bool(true));
+  spec.Set("actor_id", Value::Str(actor_id));
+  spec.Set("actor_name", Value::None_());
+  spec.Set("namespace", Value::Str("default"));
+  spec.Set("max_concurrency", Value::None_());
+  spec.Set("concurrency_groups", Value::None_());
+  spec.Set("max_restarts", Value::Int(0));
+  spec.Set("lifetime", Value::None_());
+  spec.Set("runtime_env", Value::None_());
+  Value kwargs = Value::Dict();
+  kwargs.Set("spec", spec);
+  Value reply = impl_->Controller()->Call("submit_task", kwargs);
+  const Value* status = reply.Find("status");
+  if (status == nullptr ||
+      (status->s != "queued" && status->s != "ok"))
+    throw std::runtime_error("create_actor: " + reply.Repr());
+  // block on the creation object so callers see init errors here
+  Get(ObjectRef{return_id}, 120.0);
+  return actor_id;
+}
+
+ObjectRef Client::CallActor(const std::string& actor_id,
+                            const std::string& method,
+                            std::vector<Value> args) {
+  std::pair<std::string, int> addr;
+  int64_t seq;
+  {
+    std::lock_guard<std::mutex> lk(impl_->amu);
+    seq = impl_->actor_seq[actor_id]++;
+    auto it = impl_->actor_addrs.find(actor_id);
+    if (it != impl_->actor_addrs.end()) addr = it->second;
+  }
+  if (addr.first.empty()) {
+    Value kwargs = Value::Dict();
+    kwargs.Set("actor_id", Value::Str(actor_id));
+    kwargs.Set("wait", Value::Bool(true));
+    Value info = impl_->Controller()->Call("get_actor_info", kwargs);
+    const Value* a = info.Find("addr");
+    const Value* st = info.Find("state");
+    if (a == nullptr || a->kind == Value::NONE ||
+        (st != nullptr && st->s == "DEAD"))
+      throw std::runtime_error("actor " + actor_id.substr(0, 12) +
+                               " unavailable: " + info.Repr());
+    addr = {a->items.at(0).s, (int)a->items.at(1).i};
+    std::lock_guard<std::mutex> lk(impl_->amu);
+    impl_->actor_addrs[actor_id] = addr;
+  }
+  std::string return_id = RandHex32();
+  Value kwargs = Value::Dict();
+  kwargs.Set("actor_id", Value::Str(actor_id));
+  kwargs.Set("method", Value::Str(method));
+  kwargs.Set("args_blob", Value::Bytes(FlatFromPickle(Pickle(Value::Tuple(
+      {Value::Tuple(std::move(args)), Value::Dict()})))));
+  kwargs.Set("caller", Value::Str(impl_->client_id));
+  kwargs.Set("seq", Value::Int(seq));
+  kwargs.Set("return_id", Value::Str(return_id));
+  Value reply = impl_->Dial(addr.first, addr.second)
+                    ->Call("call_actor", kwargs);
+  const Value* status = reply.Find("status");
+  std::lock_guard<std::mutex> lk(impl_->omu);
+  auto& e = impl_->objects[return_id];
+  e.ready = true;
+  if (status != nullptr && status->s == "ok") {
+    e.flat = reply.Find("payload")->s;
+  } else if (status != nullptr && status->s == "location") {
+    const Value* loc = reply.Find("location");
+    if (loc->opaque_args && loc->opaque_args->items.size() >= 3) {
+      const auto& la = loc->opaque_args->items;
+      e.has_location = true;
+      e.loc_host = la[0].items.at(0).s;
+      e.loc_port = (int)la[0].items.at(1).i;
+      e.shm_name = la[1].s;
+      e.loc_size = la[2].i;
+    }
+  } else {
+    e.is_error = true;
+    const Value* tb = reply.Find("error_tb");
+    e.error = tb != nullptr && tb->kind == Value::STR ? tb->s : reply.Repr();
+  }
+  impl_->ocv.notify_all();
+  return ObjectRef{return_id};
+}
+
+Value Client::ClusterResources() {
+  return impl_->Controller()->Call("cluster_resources", Value::Dict());
+}
+
+}  // namespace raytpu

@@ -1,0 +1,131 @@
+// ray_tpu C++ worker API (reference parity: cpp/include/ray/api/*.h —
+// the standalone C++ Ray API). A native client that speaks the
+// framework's length-prefixed pickle frame protocol (see
+// ray_tpu/_private/protocol.py) directly: it connects to a running
+// cluster, owns objects (serving them to borrowers), submits tasks to
+// Python workers by cross-language function descriptor (module +
+// qualname, like Ray's FunctionDescriptor for non-Python drivers),
+// and creates/calls actors the same way.
+//
+// Values crossing the language boundary are the pickle-representable
+// primitives: None, bool, int, double, str, bytes, list, tuple, dict
+// (the same restriction Ray's cross-language calls impose via
+// msgpack). Anything else arriving from Python decodes as an Opaque
+// node carrying its constructor name + args.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace raytpu {
+
+// ----------------------------------------------------------------- Value
+// A pickle-compatible value (both directions).
+struct Value {
+  enum Kind {
+    NONE, BOOL, INT, FLOAT, STR, BYTES, LIST, TUPLE, DICT,
+    REF,     // an ObjectRef (object id + owner address)
+    OPAQUE,  // a Python object we can name but not represent
+  };
+  Kind kind = NONE;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                                // STR/BYTES payload
+  std::vector<Value> items;                     // LIST/TUPLE elements
+  std::vector<std::pair<Value, Value>> dict;    // DICT entries
+  std::string ref_id;                           // REF object id (hex)
+  std::string ref_host; int ref_port = 0;       // REF owner address
+  std::string opaque_name;                      // OPAQUE "module.qualname"
+  std::shared_ptr<Value> opaque_args;           // OPAQUE ctor args (TUPLE)
+
+  static Value None_() { return Value{}; }
+  static Value Bool(bool v) { Value x; x.kind = BOOL; x.b = v; return x; }
+  static Value Int(int64_t v) { Value x; x.kind = INT; x.i = v; return x; }
+  static Value Float(double v) { Value x; x.kind = FLOAT; x.f = v; return x; }
+  static Value Str(std::string v) {
+    Value x; x.kind = STR; x.s = std::move(v); return x;
+  }
+  static Value Bytes(std::string v) {
+    Value x; x.kind = BYTES; x.s = std::move(v); return x;
+  }
+  static Value List(std::vector<Value> v) {
+    Value x; x.kind = LIST; x.items = std::move(v); return x;
+  }
+  static Value Tuple(std::vector<Value> v) {
+    Value x; x.kind = TUPLE; x.items = std::move(v); return x;
+  }
+  static Value Dict() { Value x; x.kind = DICT; return x; }
+  static Value Ref(const std::string& id, const std::string& host, int port) {
+    Value x; x.kind = REF; x.ref_id = id; x.ref_host = host;
+    x.ref_port = port; return x;
+  }
+
+  void Set(const std::string& key, Value v) {
+    dict.emplace_back(Str(key), std::move(v));
+  }
+  const Value* Find(const std::string& key) const {
+    for (const auto& kv : dict)
+      if (kv.first.kind == STR && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+  // Repr for demos/tests.
+  std::string Repr() const;
+};
+
+// ------------------------------------------------------------ ObjectRef
+struct ObjectRef {
+  std::string id;      // 32-hex object id
+  std::string Hex() const { return id; }
+};
+
+// --------------------------------------------------------------- Client
+class Client {
+ public:
+  Client();
+  ~Client();
+
+  // Connect to a running cluster ("host:port" of the controller, as
+  // written to the cluster address file by `ray_tpu start --head`, or
+  // with a "ray://" prefix). Starts the owner server (object pushes /
+  // borrower pulls land here).
+  void Init(const std::string& address);
+  void Shutdown();
+
+  // Object plane. Put stores the value in this process's owner store;
+  // borrowers (workers taking the ref as an arg) pull it from us.
+  ObjectRef Put(const Value& v);
+  // An argument Value referencing one of OUR objects (carries this
+  // client's owner-server address so workers can pull it).
+  Value MakeRef(const ObjectRef& ref) const;
+  Value Get(const ObjectRef& ref, double timeout_s = 60.0);
+  bool Wait(const ObjectRef& ref, double timeout_s);
+  void Free(const ObjectRef& ref);
+
+  // Task plane: submit a Python function by descriptor. Args may
+  // include Value::Ref(...) built from earlier refs.
+  ObjectRef Task(const std::string& module, const std::string& qualname,
+                 std::vector<Value> args,
+                 std::map<std::string, double> resources = {{"CPU", 1.0}});
+
+  // Actor plane: create a Python actor by class descriptor; call its
+  // methods. Calls are submitted in order (per-actor sequencing).
+  std::string CreateActor(const std::string& module,
+                          const std::string& qualname,
+                          std::vector<Value> args);
+  ObjectRef CallActor(const std::string& actor_id, const std::string& method,
+                      std::vector<Value> args);
+
+  // Cluster introspection.
+  Value ClusterResources();
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace raytpu

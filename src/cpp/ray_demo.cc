@@ -1,0 +1,94 @@
+// End-to-end exercise of the C++ worker API against a live cluster.
+// Usage: ray_demo <controller host:port>. Prints CPP_API_ALL_OK on
+// success; any failure aborts with a nonzero exit.
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "ray_api.h"
+
+using raytpu::Client;
+using raytpu::ObjectRef;
+using raytpu::Value;
+
+#define CHECK(cond, what)                                   \
+  do {                                                      \
+    if (!(cond)) {                                          \
+      std::fprintf(stderr, "CHECK failed: %s\n", what);     \
+      std::exit(1);                                         \
+    }                                                       \
+    std::printf("ok: %s\n", what);                          \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <controller host:port>\n", argv[0]);
+    return 2;
+  }
+  std::setvbuf(stdout, nullptr, _IONBF, 0);   // live progress when piped
+  Client client;
+  client.Init(argv[1]);
+
+  Value res = client.ClusterResources();
+  const Value* cpu = res.Find("CPU");
+  CHECK(cpu != nullptr && cpu->f > 0, "cluster_resources has CPU");
+
+  // object plane: put/get round trip of a composite value
+  Value v = Value::Dict();
+  v.Set("msg", Value::Str("hello"));
+  v.Set("xs", Value::List({Value::Int(1), Value::Int(2), Value::Int(3)}));
+  ObjectRef r = client.Put(v);
+  Value back = client.Get(r);
+  CHECK(back.Find("msg") != nullptr && back.Find("msg")->s == "hello",
+        "put/get round trip");
+
+  // task plane: stdlib function by descriptor
+  ObjectRef sq = client.Task("math", "sqrt", {Value::Float(16.0)});
+  Value sv = client.Get(sq);
+  CHECK(sv.kind == Value::FLOAT && std::fabs(sv.f - 4.0) < 1e-9,
+        "math.sqrt(16) == 4");
+
+  // framework demo module
+  ObjectRef sum = client.Task("ray_tpu.util.cpp_api_demo", "add",
+                              {Value::Int(2), Value::Int(40)});
+  CHECK(client.Get(sum).i == 42, "add(2, 40) == 42");
+
+  // ref passing: a C++-owned object as a task argument (worker borrows
+  // and pulls it from our owner server)
+  ObjectRef forty = client.Put(Value::Int(40));
+  ObjectRef sum2 = client.Task("ray_tpu.util.cpp_api_demo", "add",
+                               {client.MakeRef(forty), Value::Int(2)});
+  CHECK(client.Get(sum2).i == 42, "add(ref(40), 2) == 42");
+
+  ObjectRef big = client.Task("ray_tpu.util.cpp_api_demo", "big_bytes",
+                              {Value::Int(300000)});
+  Value bb = client.Get(big, 120.0);
+  CHECK(bb.kind == Value::BYTES && bb.s.size() == 300000,
+        "big_bytes(300000) via shm location fetch");
+
+  // actor plane
+  std::string counter = client.CreateActor("ray_tpu.util.cpp_api_demo",
+                                           "Counter", {Value::Int(100)});
+  CHECK(client.Get(client.CallActor(counter, "incr", {Value::Int(5)})).i ==
+            105, "counter.incr(5) == 105");
+  CHECK(client.Get(client.CallActor(counter, "incr", {Value::Int(5)})).i ==
+            110, "counter.incr(5) == 110");
+  CHECK(client.Get(client.CallActor(counter, "total", {})).i == 110,
+        "counter.total() == 110");
+
+  // error propagation
+  bool threw = false;
+  try {
+    client.Get(client.Task("math", "sqrt", {Value::Str("bad")}));
+  } catch (const std::exception& e) {
+    threw = true;
+    std::printf("ok: task error surfaced: %.60s...\n", e.what());
+  }
+  CHECK(threw, "task error raises");
+
+  client.Shutdown();
+  std::printf("CPP_API_ALL_OK\n");
+  return 0;
+}
