@@ -6,6 +6,8 @@ compiled: pure-JAX envs scanned with the policy in one XLA program.
 """
 
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.appo import APPO, APPOConfig
+from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.impala import IMPALA, IMPALAConfig
 from .algorithms.ppo import PPO, PPOConfig
@@ -20,7 +22,8 @@ from .utils.replay_buffers import ReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+    "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
+    "SAC", "SACConfig", "CQL", "CQLConfig",
     "BC", "BCConfig", "OfflineData", "record_samples", "ReplayBuffer",
     "Learner", "LearnerGroup", "RLModule",
     "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",

@@ -1,3 +1,5 @@
 from .algorithm import Algorithm, AlgorithmConfig
 from .ppo import PPO, PPOConfig, PPOLearner
 from .impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
+from .appo import APPO, APPOConfig, APPOLearner
+from .cql import CQL, CQLConfig, CQLLearner

@@ -1,0 +1,73 @@
+"""APPO: asynchronous PPO — IMPALA's architecture, PPO's loss.
+
+Reference parity: rllib/algorithms/appo/appo.py (APPO = IMPALA async
+sampling/aggregation with a clipped-surrogate policy loss over V-trace
+advantages instead of the plain importance-weighted PG loss). Everything
+async (one in-flight sample per runner, re-armed with fresh weights)
+is inherited from IMPALA; only the learner differs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.learner import Learner
+from .impala import IMPALA, IMPALAConfig, vtrace
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2         # PPO surrogate clip (reference: 0.4
+        #                               default for APPO; 0.2 matches our PPO)
+        self.num_epochs = 1           # async: each batch consumed once
+
+
+class APPOLearner(Learner):
+    """PPO clipped surrogate over V-trace targets; minibatches are
+    env-major [b, T, ...] like IMPALA's."""
+
+    def __init__(self, spec, config: APPOConfig):
+        self._cfg = config
+        super().__init__(spec, config.learner_hyperparams(),
+                         config.module_class, config.model_config,
+                         seed=config.seed)
+
+    def compute_loss(self, params, mb):
+        cfg = self._cfg
+        tm = lambda a: jnp.swapaxes(a, 0, 1)
+        obs, actions = tm(mb["obs"]), tm(mb["actions"])
+        out = self.module.forward_train(params, obs)
+        dist = self.module.dist
+        inputs = out["action_dist_inputs"]
+        target_logp = dist.log_prob(inputs, actions)
+        behavior_logp = tm(mb["logp"])
+        vs, pg_adv = vtrace(
+            behavior_logp, target_logp, tm(mb["rewards"]), out["vf"],
+            tm(mb["dones"]), mb["final_vf"], gamma=cfg.gamma,
+            rho_bar=cfg.rho_bar, c_bar=cfg.c_bar)
+        adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+        ratio = jnp.exp(target_logp - behavior_logp)
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_param,
+                           1.0 + cfg.clip_param)
+        policy_loss = -jnp.mean(jnp.minimum(ratio * adv, clipped * adv))
+        vf_loss = jnp.mean((out["vf"] - vs) ** 2)
+        entropy = jnp.mean(dist.entropy(inputs))
+        loss = (policy_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        return loss, {"total_loss": loss, "policy_loss": policy_loss,
+                      "vf_loss": vf_loss, "entropy": entropy,
+                      "clip_fraction": jnp.mean(
+                          (jnp.abs(ratio - 1.0) > cfg.clip_param)
+                          .astype(jnp.float32))}
+
+
+class APPO(IMPALA):
+    @classmethod
+    def default_config(cls) -> APPOConfig:
+        return APPOConfig()
+
+    @classmethod
+    def build_learner(cls, spec, config) -> APPOLearner:
+        return APPOLearner(spec, config)

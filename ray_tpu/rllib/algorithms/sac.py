@@ -134,14 +134,20 @@ class SACLearner(Learner):
         self._alpha_opt_state = self._alpha_opt.init(self.log_alpha)
         self._sac_jit = jax.jit(self._build_sac_update())
 
+    def _make_critic_penalty(self):
+        """Hook: extra critic regularizer (p, batch, key, alpha) ->
+        (penalty, aux dict). CQL overrides; plain SAC has none."""
+        return None
+
     def _build_sac_update(self):
         opt, alpha_opt = self.optimizer, self._alpha_opt
         module, gamma, tau = self.module, self._gamma, self._tau
         target_entropy = self._target_entropy
+        penalty_fn = self._make_critic_penalty()
 
         def sac_update(params, target_params, opt_state,
                        log_alpha, alpha_opt_state, batch, key):
-            k1, k2 = jax.random.split(key)
+            k1, k2, k3 = jax.random.split(key, 3)
             alpha = jnp.exp(log_alpha)
 
             # --- critic + actor losses share one grad pass over params
@@ -160,6 +166,10 @@ class SACLearner(Learner):
                     p, batch["obs"], batch["actions"])
                 critic_loss = (jnp.mean((q1 - target) ** 2)
                                + jnp.mean((q2 - target) ** 2))
+                pen_aux = {}
+                if penalty_fn is not None:
+                    penalty, pen_aux = penalty_fn(p, batch, k3, alpha)
+                    critic_loss = critic_loss + penalty
 
                 pi, _, _ = module.pi_and_q(
                     p, batch["obs"], batch["actions"])
@@ -170,10 +180,11 @@ class SACLearner(Learner):
                 actor_loss = jnp.mean(alpha * logp_pi - q_pi)
 
                 loss = critic_loss + actor_loss
-                return loss, (critic_loss, actor_loss, logp_pi, q_pi)
+                return loss, (critic_loss, actor_loss, logp_pi, q_pi,
+                              pen_aux)
 
-            (_, (critic_loss, actor_loss, logp_pi, q_pi)), grads = \
-                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            (_, (critic_loss, actor_loss, logp_pi, q_pi, pen_aux)), \
+                grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
 
@@ -195,7 +206,7 @@ class SACLearner(Learner):
             aux = {"critic_loss": critic_loss, "actor_loss": actor_loss,
                    "alpha": jnp.exp(log_alpha), "alpha_loss": alpha_loss,
                    "q_mean": jnp.mean(q_pi),
-                   "entropy": -jnp.mean(logp_pi)}
+                   "entropy": -jnp.mean(logp_pi), **pen_aux}
             return (params, target_params, opt_state, log_alpha,
                     alpha_opt_state, aux)
 

@@ -13,6 +13,8 @@ from .search.sample import (uniform, quniform, loguniform, qloguniform,
 from .search.searcher import (Searcher, BasicVariantGenerator, RandomSearch,
                               ConcurrencyLimiter)
 from .search.tpe import TPESearch
+from .search.bohb import BOHBSearch
+from .search.adapters import HyperOptSearch, OptunaSearch
 from .schedulers import (TrialScheduler, FIFOScheduler, MedianStoppingRule,
                          AsyncHyperBandScheduler, ASHAScheduler,
                          HyperBandScheduler, PopulationBasedTraining)
@@ -24,6 +26,7 @@ __all__ = [
     "uniform", "quniform", "loguniform", "qloguniform", "randint",
     "qrandint", "lograndint", "choice", "sample_from", "grid_search",
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearch",
+    "BOHBSearch", "OptunaSearch", "HyperOptSearch",
     "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
     "MedianStoppingRule", "AsyncHyperBandScheduler", "ASHAScheduler",
     "HyperBandScheduler", "PopulationBasedTraining", "Trainable", "report",

@@ -9,6 +9,7 @@
 #include "ray_api.h"
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -126,9 +127,23 @@ void PickleValue(std::string& out, const Value& v) {
       out.push_back('\x86');         // TUPLE2 -> the args tuple
       out.push_back('R');
       break;
-    case Value::OPAQUE:
-      throw std::runtime_error("cannot pickle an opaque value (" +
-                               v.opaque_name + ") back to Python");
+    case Value::OPAQUE: {
+      // GLOBAL module.name + args + REDUCE: round-trips reduced objects
+      // (e.g. a ShmLocation echoed back to a borrower) as long as the
+      // class is importable on the Python side.
+      auto dot = v.opaque_name.rfind('.');
+      if (dot == std::string::npos || !v.opaque_args)
+        throw std::runtime_error("cannot pickle opaque value (" +
+                                 v.opaque_name + ") back to Python");
+      out.push_back('c');
+      out.append(v.opaque_name.substr(0, dot));
+      out.push_back('\n');
+      out.append(v.opaque_name.substr(dot + 1));
+      out.push_back('\n');
+      PickleValue(out, *v.opaque_args);   // the args TUPLE
+      out.push_back('R');
+      break;
+    }
   }
 }
 
@@ -146,6 +161,14 @@ std::string Pickle(const Value& v) {
 // runtime's replies and pushes. Unknown classes become OPAQUE nodes.
 
 class Unpickler {
+  // The stack and memo hold shared_ptr<Value>: CPython memoizes a
+  // container BEFORE filling it (EMPTY_LIST, MEMOIZE, ..., APPENDS), so
+  // the memo must alias the live object, not copy a still-empty one —
+  // shared references like `(x, x)` then decode correctly. Cycles are
+  // not supported (a self-referential container decodes as a partial
+  // copy), which RPC payloads never contain.
+  using VP = std::shared_ptr<Value>;
+
  public:
   Unpickler(const std::string& data, const std::vector<std::string>* bufs)
       : d_(data), bufs_(bufs) {}
@@ -159,7 +182,7 @@ class Unpickler {
         case 0x95: p_ += 8; break;                    // FRAME
         case '.': {                                   // STOP
           if (stack_.empty()) throw std::runtime_error("pickle: empty stop");
-          return stack_.back();
+          return *stack_.back();
         }
         case '(': marks_.push_back(stack_.size()); break;   // MARK
         case '0': stack_.pop_back(); break;                 // POP
@@ -206,30 +229,27 @@ class Unpickler {
         case '}': Push(Value::Dict()); break;
         case 'a': {                                    // APPEND
           Value v = Pop();
-          stack_.back().items.push_back(std::move(v));
+          stack_.back()->items.push_back(std::move(v));
           break;
         }
         case 'e': case 0x90: {                         // APPENDS/ADDITEMS
           size_t m = PopMarkIndex();
-          Value& target = stack_[m - 1];
+          VP target = stack_[m - 1];
           for (size_t i = m; i < stack_.size(); i++)
-            target.items.push_back(std::move(stack_[i]));
+            target->items.push_back(*stack_[i]);
           stack_.resize(m);
           break;
         }
         case 's': {                                    // SETITEM
           Value v = Pop(), k = Pop();
-          stack_.back().dict.emplace_back(std::move(k), std::move(v));
+          stack_.back()->dict.emplace_back(std::move(k), std::move(v));
           break;
         }
         case 'u': {                                    // SETITEMS
           size_t m = PopMarkIndex();
-          Value& target = stack_[m - 1];
-          for (size_t i = m; i + 1 < stack_.size() + 1; i += 2) {
-            if (i + 1 >= stack_.size()) break;
-            target.dict.emplace_back(std::move(stack_[i]),
-                                     std::move(stack_[i + 1]));
-          }
+          VP target = stack_[m - 1];
+          for (size_t i = m; i + 1 < stack_.size(); i += 2)
+            target->dict.emplace_back(*stack_[i], *stack_[i + 1]);
           stack_.resize(m);
           break;
         }
@@ -237,7 +257,7 @@ class Unpickler {
           size_t m = PopMarkIndex();
           Value t = Value::Tuple({});
           for (size_t i = m; i < stack_.size(); i++)
-            t.items.push_back(std::move(stack_[i]));
+            t.items.push_back(*stack_[i]);
           stack_.resize(m);
           Push(std::move(t));
           break;
@@ -257,16 +277,17 @@ class Unpickler {
           size_t m = PopMarkIndex();
           Value t = Value::List({});
           for (size_t i = m; i < stack_.size(); i++)
-            t.items.push_back(std::move(stack_[i]));
+            t.items.push_back(*stack_[i]);
           stack_.resize(m);
           Push(std::move(t));
           break;
         }
+        // memo ALIASES the stack value (see class comment)
         case 0x94: memo_[memo_next_++] = stack_.back(); break;  // MEMOIZE
         case 'q': memo_[(uint8_t)Read1()] = stack_.back(); break;
         case 'r': memo_[ReadU32()] = stack_.back(); break;
-        case 'h': Push(memo_.at((uint8_t)Read1())); break;      // BINGET
-        case 'j': Push(memo_.at(ReadU32())); break;
+        case 'h': PushP(memo_.at((uint8_t)Read1())); break;     // BINGET
+        case 'j': PushP(memo_.at(ReadU32())); break;
         case 'c': {                                    // GLOBAL
           std::string mod = ReadLine(), name = ReadLine();
           Value g;
@@ -372,9 +393,13 @@ class Unpickler {
       s.push_back(c);
     }
   }
-  void Push(Value v) { stack_.push_back(std::move(v)); }
+  void Push(Value v) {
+    stack_.push_back(std::make_shared<Value>(std::move(v)));
+  }
+  void PushP(VP p) { stack_.push_back(std::move(p)); }
   Value Pop() {
-    Value v = std::move(stack_.back());
+    // COPY (not move): the popped slot may be aliased by the memo
+    Value v = *stack_.back();
     stack_.pop_back();
     return v;
   }
@@ -389,9 +414,9 @@ class Unpickler {
   const std::vector<std::string>* bufs_;
   size_t p_ = 0;
   size_t buf_next_ = 0;
-  std::vector<Value> stack_;
+  std::vector<VP> stack_;
   std::vector<size_t> marks_;
-  std::unordered_map<uint32_t, Value> memo_;
+  std::unordered_map<uint32_t, VP> memo_;
   uint32_t memo_next_ = 0;
 };
 
@@ -412,21 +437,26 @@ std::string FlatFromPickle(const std::string& pickled) {
 }
 
 Value ParseFlat(const std::string& flat) {
-  if (flat.size() < 12) throw std::runtime_error("flat object truncated");
+  auto fail = [] { throw std::runtime_error("flat object truncated"); };
+  if (flat.size() < 12) fail();
   uint32_t nbuf = 0;
   std::memcpy(&nbuf, flat.data(), 4);
   size_t off = 4;
+  if (nbuf > (flat.size() - 4) / 8) fail();   // bogus header
   std::vector<uint64_t> lens;
   for (uint32_t i = 0; i < nbuf + 1; i++) {
+    if (off + 8 > flat.size()) fail();
     uint64_t n = 0;
     std::memcpy(&n, flat.data() + off, 8);
     lens.push_back(n);
     off += 8;
   }
+  if (lens[0] > flat.size() - off) fail();
   std::string data = flat.substr(off, lens[0]);
   off += lens[0];
   std::vector<std::string> bufs;
   for (uint32_t i = 1; i <= nbuf; i++) {
+    if (lens[i] > flat.size() - off) fail();
     bufs.push_back(flat.substr(off, lens[i]));
     off += lens[i];
   }
@@ -505,19 +535,25 @@ constexpr int KIND_RESPONSE_ERR = 2;
 constexpr int KIND_ONEWAY = 3;
 
 int DialTcp(const std::string& host, int port) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) throw std::runtime_error("socket() failed");
-  sockaddr_in sa{};
-  sa.sin_family = AF_INET;
-  sa.sin_port = htons((uint16_t)port);
-  if (::inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) {
+  // getaddrinfo: hostnames and IPv6 literals resolve like IPv4 ones
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 || res == nullptr)
+    throw std::runtime_error("cannot resolve host " + host);
+  int fd = -1;
+  for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
     ::close(fd);
-    throw std::runtime_error("bad host " + host);
+    fd = -1;
   }
-  if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) != 0) {
-    ::close(fd);
+  ::freeaddrinfo(res);
+  if (fd < 0)
     throw std::runtime_error("connect to " + host + " failed");
-  }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return fd;
@@ -566,6 +602,11 @@ class Conn {
 
   void Oneway(const std::string& method, const Value& kwargs) {
     WriteFrame(fd_, wmu_, KIND_ONEWAY, 0, method, kwargs);
+  }
+
+  bool IsDead() {
+    std::lock_guard<std::mutex> lk(pmu_);
+    return dead_;
   }
 
  private:
@@ -722,8 +763,8 @@ struct Client::Impl {
     std::lock_guard<std::mutex> lk(cmu);
     auto key = std::make_pair(host, port);
     auto it = conns.find(key);
-    if (it != conns.end()) return it->second;
-    auto c = std::make_shared<Conn>(host, port);
+    if (it != conns.end() && !it->second->IsDead()) return it->second;
+    auto c = std::make_shared<Conn>(host, port);   // redial after a drop
     conns[key] = c;
     return c;
   }
@@ -802,17 +843,45 @@ struct Client::Impl {
       return Value::None_();
     }
     if (method == "get_object") {
+      // Mirror the Python owner's rpc_get_object contract
+      // (ray_tpu/_private/core.py:439): wait for availability (bounded),
+      // then answer inline / location / lost. Blocking this connection's
+      // thread is fine — one thread per inbound connection.
       const Value* oid = kwargs.Find("object_id");
+      const Value* tv = kwargs.Find("timeout");
+      double timeout = (tv != nullptr && tv->kind == Value::FLOAT)
+                           ? tv->f : 120.0;
+      std::string id = oid ? oid->s : "";
       std::unique_lock<std::mutex> lk(omu);
-      auto it = objects.find(oid ? oid->s : "");
+      ocv.wait_for(lk, std::chrono::duration<double>(
+                           std::min(timeout, 120.0)), [&] {
+        auto it = objects.find(id);
+        return it != objects.end() && it->second.ready;
+      });
+      auto it = objects.find(id);
+      Value r = Value::Dict();
       if (it == objects.end() || !it->second.ready) {
-        Value r = Value::Dict();
-        r.Set("status", Value::Str("lost"));
+        r.Set("status", Value::Str(it == objects.end() ? "lost"
+                                                       : "timeout"));
         return r;
       }
-      Value r = Value::Dict();
-      r.Set("status", Value::Str("inline"));
-      r.Set("payload", Value::Bytes(it->second.flat));
+      const ObjEntry& e = it->second;
+      if (e.is_error) {
+        r.Set("status", Value::Str("lost"));
+      } else if (e.has_location) {
+        Value loc;
+        loc.kind = Value::OPAQUE;
+        loc.opaque_name = "ray_tpu._private.object_store.ShmLocation";
+        loc.opaque_args = std::make_shared<Value>(Value::Tuple(
+            {Value::Tuple({Value::Str(e.loc_host),
+                           Value::Int(e.loc_port)}),
+             Value::Str(e.shm_name), Value::Int(e.loc_size)}));
+        r.Set("status", Value::Str("location"));
+        r.Set("location", loc);
+      } else {
+        r.Set("status", Value::Str("inline"));
+        r.Set("payload", Value::Bytes(e.flat));
+      }
       return r;
     }
     throw std::runtime_error("no handler for " + method);
@@ -1037,11 +1106,11 @@ std::string Client::CreateActor(const std::string& module,
 ObjectRef Client::CallActor(const std::string& actor_id,
                             const std::string& method,
                             std::vector<Value> args) {
+  // resolve the address BEFORE burning a sequence number: a failed
+  // resolution must not leave a hole the actor's admit queue waits on
   std::pair<std::string, int> addr;
-  int64_t seq;
   {
     std::lock_guard<std::mutex> lk(impl_->amu);
-    seq = impl_->actor_seq[actor_id]++;
     auto it = impl_->actor_addrs.find(actor_id);
     if (it != impl_->actor_addrs.end()) addr = it->second;
   }
@@ -1060,6 +1129,11 @@ ObjectRef Client::CallActor(const std::string& actor_id,
     std::lock_guard<std::mutex> lk(impl_->amu);
     impl_->actor_addrs[actor_id] = addr;
   }
+  int64_t seq;
+  {
+    std::lock_guard<std::mutex> lk(impl_->amu);
+    seq = impl_->actor_seq[actor_id]++;
+  }
   std::string return_id = RandHex32();
   Value kwargs = Value::Dict();
   kwargs.Set("actor_id", Value::Str(actor_id));
@@ -1069,8 +1143,24 @@ ObjectRef Client::CallActor(const std::string& actor_id,
   kwargs.Set("caller", Value::Str(impl_->client_id));
   kwargs.Set("seq", Value::Int(seq));
   kwargs.Set("return_id", Value::Str(return_id));
-  Value reply = impl_->Dial(addr.first, addr.second)
-                    ->Call("call_actor", kwargs);
+  Value reply;
+  try {
+    reply = impl_->Dial(addr.first, addr.second)
+                ->Call("call_actor", kwargs);
+  } catch (...) {
+    // plug the sequence hole so later calls aren't stalled behind this
+    // one (Python client parity: core.py skip_actor_seq on failure)
+    try {
+      Value skip = Value::Dict();
+      skip.Set("actor_id", Value::Str(actor_id));
+      skip.Set("caller", Value::Str(impl_->client_id));
+      skip.Set("seq", Value::Int(seq));
+      impl_->Dial(addr.first, addr.second)
+          ->Oneway("skip_actor_seq", skip);
+    } catch (...) {
+    }
+    throw;
+  }
   const Value* status = reply.Find("status");
   std::lock_guard<std::mutex> lk(impl_->omu);
   auto& e = impl_->objects[return_id];
