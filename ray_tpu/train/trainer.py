@@ -13,6 +13,7 @@ the host-side control collective is util.collective (object-store backed).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
@@ -43,7 +44,7 @@ class TrainWorker:
     """Actor hosting one rank of the SPMD group (max_concurrency=2 so the
     controller can drain reports while the user loop runs)."""
 
-    def __init__(self, rank: int, world_size: int, jax_coordinator: Optional[str]):
+    def __init__(self, rank: int, world_size: int):
         self.rank = rank
         self.world_size = world_size
         self.session: Optional[_Session] = None
@@ -55,11 +56,36 @@ class TrainWorker:
         self._ckpts: Dict[int, Checkpoint] = {}
         self._ckpt_seq = 0
         self._ckpt_keep = 64
-        if jax_coordinator is not None and world_size > 1:
-            import jax
-            jax.distributed.initialize(
-                coordinator_address=jax_coordinator,
-                num_processes=world_size, process_id=rank)
+    def prepare_coordinator(self) -> str:
+        """Rank 0 picks the coordination endpoint on ITS host (the jax
+        coordination service runs inside rank 0's initialize call, so
+        the address must be reachable from every other rank — the
+        driver's host would be wrong on multi-host clusters)."""
+        import socket
+
+        from .._private.state import current_client
+        host = current_client().address[0]
+        probe = socket.socket()
+        probe.bind((host, 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        return f"{host}:{port}"
+
+    def init_jax(self, jax_coordinator: str) -> None:
+        """jax.distributed across the gang: rank 0 hosts the coordination
+        service; every rank blocks here until the world is connected
+        (reference parity: train/torch/config.py:66 process-group setup;
+        ours federates the jax runtime over DCN instead of NCCL)."""
+        import jax
+        if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+            # CPU multi-process (the DCN test harness): collectives
+            # need the gloo backend; on TPU the ICI/DCN transport is
+            # native and this knob is irrelevant.
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            coordinator_address=jax_coordinator,
+            num_processes=self.world_size, process_id=self.rank)
 
     def run(self, train_loop_fn: Callable, loop_config: Optional[Dict],
             context: TrainContext,
@@ -246,7 +272,6 @@ class JaxTrainer:
             return err
         pg, n = reserved
         try:
-            coordinator = "127.0.0.1:35123" if self.bootstrap_jax else None
             WorkerCls = ray_tpu.remote(TrainWorker)
             worker_res = sc.worker_bundle()
             workers = [
@@ -257,9 +282,24 @@ class JaxTrainer:
                                if k != "CPU"},
                     scheduling_strategy=PlacementGroupSchedulingStrategy(
                         pg, i),
-                ).remote(i, n, coordinator)
+                ).remote(i, n)
                 for i in range(n)
             ]
+            if self.bootstrap_jax and n > 1:
+                # two-phase: rank 0 names the endpoint on its own host,
+                # then every rank joins (each init_jax blocks until the
+                # full world is connected). Failures — including the
+                # unavoidable probe-then-bind port race when several
+                # trainers bootstrap on one host — return as attempt
+                # errors so FailureConfig retries with a fresh port.
+                try:
+                    coordinator = ray_tpu.get(
+                        workers[0].prepare_coordinator.remote(),
+                        timeout=60)
+                    ray_tpu.get([w.init_jax.remote(coordinator)
+                                 for w in workers], timeout=300)
+                except Exception as e:
+                    return e
             contexts = [TrainContext(
                 world_rank=i, world_size=n, local_rank=0,
                 local_world_size=1, node_rank=i,
