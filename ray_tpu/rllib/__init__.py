@@ -17,14 +17,16 @@ from .core.rl_module import DefaultRLModule, RLModule
 from .env.env_runner import SingleAgentEnvRunner
 from .env.env_runner_group import EnvRunnerGroup
 from .env.jax_env import CartPole, EnvSpec, JaxEnv, Pendulum, register_env
-from .offline import BC, BCConfig, OfflineData, record_samples
+from .offline import (BC, BCConfig, MARWIL, MARWILConfig, OfflineData,
+                      record_samples)
 from .utils.replay_buffers import ReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
     "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "CQL", "CQLConfig",
-    "BC", "BCConfig", "OfflineData", "record_samples", "ReplayBuffer",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig", "OfflineData",
+    "record_samples", "ReplayBuffer",
     "Learner", "LearnerGroup", "RLModule",
     "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",
     "JaxEnv", "CartPole", "Pendulum", "EnvSpec", "register_env",

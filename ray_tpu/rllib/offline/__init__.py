@@ -13,20 +13,29 @@ import glob
 import os
 from typing import Any, Dict, Iterator, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..algorithms.algorithm import Algorithm, AlgorithmConfig
 from ..core.learner import Learner
 
-__all__ = ["record_samples", "OfflineData", "BC", "BCConfig"]
+__all__ = ["record_samples", "OfflineData", "BC", "BCConfig",
+           "MARWIL", "MARWILConfig"]
 
 
 def record_samples(batch: Dict[str, np.ndarray], out_dir: str,
-                   shard_index: int = 0) -> str:
+                   shard_index: int = 0,
+                   gamma: Optional[float] = None) -> str:
     """Write one rollout batch ([T, B, ...]) as a flat .npz shard.
     Per-rollout extras (final_obs/final_vf, shape [B]) are dropped —
-    shards hold per-TRANSITION arrays with one shared leading dim."""
+    shards hold per-TRANSITION arrays with one shared leading dim.
+
+    With gamma set, per-transition discounted reward-to-go is computed
+    while the [T, B] episode structure is still known (bootstrapped
+    from final_vf when present) and stored as 'returns' — the input
+    MARWIL's advantage weighting needs; flattened shards can't recover
+    it."""
     os.makedirs(out_dir, exist_ok=True)
     t, b = np.asarray(batch["obs"]).shape[:2]
     flat = {}
@@ -35,6 +44,15 @@ def record_samples(batch: Dict[str, np.ndarray], out_dir: str,
         if v.ndim < 2 or v.shape[:2] != (t, b):
             continue
         flat[k] = v.reshape((t * b,) + v.shape[2:])
+    if gamma is not None and "returns" not in flat:
+        rew = np.asarray(batch["rewards"], np.float32)
+        done = np.asarray(batch["dones"], np.float32)
+        acc = np.asarray(batch.get("final_vf", np.zeros(b)), np.float32)
+        rtg = np.zeros((t, b), np.float32)
+        for i in range(t - 1, -1, -1):
+            acc = rew[i] + gamma * (1.0 - done[i]) * acc
+            rtg[i] = acc
+        flat["returns"] = rtg.reshape(t * b)
     path = os.path.join(out_dir, f"shard-{shard_index:05d}.npz")
     np.savez(path, **flat)
     return path
@@ -55,6 +73,16 @@ class OfflineData:
                     arrays.setdefault(k, []).append(z[k])
         self.data = {k: np.concatenate(v) for k, v in arrays.items()}
         self.size = len(next(iter(self.data.values())))
+        ragged = {k: len(v) for k, v in self.data.items()
+                  if len(v) != self.size}
+        if ragged:
+            # e.g. a directory mixing shards recorded with and without
+            # gamma= (only some carry 'returns') — fail loudly here, not
+            # with a sporadic IndexError mid-training
+            raise ValueError(
+                f"shard keys have inconsistent row counts: {ragged} vs "
+                f"{self.size}; were some shards recorded with different "
+                "keys (e.g. only some with gamma=)?")
         self._rng = np.random.default_rng(seed)
 
     def sample(self, n: int) -> Dict[str, np.ndarray]:
@@ -114,3 +142,104 @@ class BC(Algorithm):
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         result = self.env_runner_group.sample()
         return self._roll_metrics(result["stats"], learner_metrics)
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0               # 0.0 degenerates to plain BC
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-7  # ref default-ish
+
+
+class MARWILLearner(Learner):
+    """Advantage-weighted behavior cloning (Wang et al. 2018; reference:
+    rllib/algorithms/marwil) — maximize exp(beta * A / c) * logp, where
+    A = returns - V(s) and c is a running norm of A^2, plus a value
+    loss fitting V to the recorded returns. beta=0 is exactly BC."""
+
+    def __init__(self, spec, config: "MARWILConfig"):
+        self._beta = config.beta
+        self._vf_coeff = config.vf_coeff
+        self._ma_rate = config.moving_average_sqd_adv_norm_update_rate
+        super().__init__(spec, config.learner_hyperparams(),
+                         config.module_class, config.model_config,
+                         seed=config.seed)
+        # running estimate of E[A^2]; lives in learner state like SAC's
+        # target params (single-learner algorithm)
+        self.ma_sqd_adv = jnp.asarray(1.0, jnp.float32)
+
+    def compute_loss(self, params, mb):
+        out = self.module.forward_train(params, mb["obs"])
+        logp = self.module.dist.log_prob(
+            out["action_dist_inputs"], mb["actions"])
+        returns = mb["returns"]
+        vf_loss = jnp.mean((out["vf"] - returns) ** 2)
+        adv = jax.lax.stop_gradient(returns - out["vf"])
+        if self._beta > 0.0:
+            # the running norm rides in as a batch operand — a closure
+            # read of self.ma_sqd_adv would be baked as a constant at
+            # first jit trace and never see later updates
+            c = jnp.sqrt(mb["_ma_sqd_adv"][0]) + 1e-8
+            weights = jnp.minimum(jnp.exp(self._beta * adv / c), 20.0)
+        else:
+            weights = jnp.ones_like(adv)
+        policy_loss = -jnp.mean(weights * logp)
+        loss = policy_loss + self._vf_coeff * vf_loss
+        return loss, {"total_loss": loss, "policy_loss": policy_loss,
+                      "vf_loss": vf_loss,
+                      "mean_weight": jnp.mean(weights),
+                      "sqd_adv": jnp.mean(adv ** 2)}
+
+    def update(self, train_batch):
+        if self._beta > 0.0:
+            n = len(next(iter(train_batch.values())))
+            train_batch = dict(train_batch)
+            train_batch["_ma_sqd_adv"] = np.full(
+                n, float(self.ma_sqd_adv), np.float32)
+        metrics = super().update(train_batch)
+        if self._beta > 0.0 and "sqd_adv" in metrics:
+            # fold the batch's observed E[A^2] into the running norm
+            # (reference: marwil update_averaged_weights)
+            n = len(next(iter(train_batch.values())))
+            rate = min(self._ma_rate * n, 1.0)
+            self.ma_sqd_adv = jnp.asarray(
+                (1.0 - rate) * float(self.ma_sqd_adv)
+                + rate * float(metrics["sqd_adv"]), jnp.float32)
+        return metrics
+
+    def get_state(self):
+        state = super().get_state()
+        state["ma_sqd_adv"] = float(self.ma_sqd_adv)
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        if "ma_sqd_adv" in state:
+            self.ma_sqd_adv = jnp.asarray(state["ma_sqd_adv"],
+                                          jnp.float32)
+
+
+class MARWIL(BC):
+    @classmethod
+    def default_config(cls) -> MARWILConfig:
+        return MARWILConfig()
+
+    @classmethod
+    def build_learner(cls, spec, config) -> MARWILLearner:
+        return MARWILLearner(spec, config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        algo_cfg = config.get("_algo_config")
+        if algo_cfg is not None and algo_cfg.num_learners > 1:
+            raise ValueError(
+                "MARWIL supports num_learners <= 1 (the advantage-norm "
+                "moving average lives in learner state, outside the "
+                "generic allreduce path)")
+        super().setup(config)
+        if "returns" not in self.offline.data:
+            raise ValueError(
+                "MARWIL shards need 'returns' — record with "
+                "record_samples(..., gamma=...) so reward-to-go is "
+                "computed while episode structure is known")
