@@ -281,7 +281,15 @@ def hidden_states_with_aux(cfg: LlamaConfig, params: Dict[str, Any],
 
     b, s = tokens.shape
     dt = cfg.dtype
-    x = params["embed"].astype(dt)[tokens]
+    # constrain BOTH gather operands: tokens to the activation layout,
+    # and the table's feature dim to replicated (act_embed) just for the
+    # lookup. Leaving the table's fsdp-sharded feature dim in place makes
+    # the partitioner emit the gather feature-sharded and then
+    # "involuntarily rematerialize" (replicate + repartition) it into the
+    # batch/seq activation layout the next constraint demands.
+    tokens = wlc(tokens, "batch", "seq")
+    table = wlc(params["embed"].astype(dt), "vocab", "act_embed")
+    x = table[tokens]
     x = wlc(x, "batch", "seq", "act_embed")
     positions = jnp.arange(s)
     cos, sin = rope_frequencies(cfg, positions)

@@ -33,12 +33,20 @@ NEG_INF = -1e30
 
 
 def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
-    """(B, S, KVH, D) -> (B, S, H, D) by repeating each kv head."""
+    """(B, S, KVH, D) -> (B, S, H, D) by repeating each kv head.
+
+    broadcast+reshape, NOT jnp.repeat: repeat lowers to a gather whose
+    sharding the SPMD partitioner can't propagate through a
+    head-sharded (tp) mesh — it falls back to full rematerialization
+    (replicate + repartition) of K/V. The broadcast form stays an
+    elementwise/layout op and partitions cleanly."""
     b, s, kvh, d = k.shape
     if kvh == num_heads:
         return k
     reps = num_heads // kvh
-    return jnp.repeat(k, reps, axis=2)
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, kvh, reps, d)
+    ).reshape(b, s, num_heads, d)
 
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
