@@ -451,17 +451,38 @@ class Controller:
 
     async def _health_loop(self) -> None:
         """Node failure detector (reference parity:
-        src/ray/gcs/gcs_server/gcs_health_check_manager.h:45)."""
+        src/ray/gcs/gcs_server/gcs_health_check_manager.h:45 — missed
+        heartbeats trigger an ACTIVE probe before the node is declared
+        dead: a daemon whose heartbeat path is wedged, e.g. its monitor
+        loop stuck behind a slow spill, is not the same as a dead
+        daemon, and killing its actors would be an unforced error)."""
         while not self._closed:
             await asyncio.sleep(2.0)
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > self.node_timeout_s:
-                    logger.warning("node %s missed heartbeats for %.0fs; "
-                                   "marking dead", node.node_id[:8],
+                    if await self._probe_node(node):
+                        logger.warning(
+                            "node %s missed heartbeats for %.0fs but "
+                            "answers probes; keeping alive",
+                            node.node_id[:8], now - node.last_heartbeat)
+                        node.last_heartbeat = time.monotonic()
+                        continue
+                    logger.warning("node %s missed heartbeats for %.0fs "
+                                   "and failed the probe; marking dead",
+                                   node.node_id[:8],
                                    now - node.last_heartbeat)
                     node.alive = False
                     await self._on_node_death(node.node_id)
+
+    async def _probe_node(self, node: NodeEntry) -> bool:
+        """One direct health probe of the daemon's RPC server."""
+        try:
+            await asyncio.wait_for(
+                self.pool.get(node.addr).call("node_stats"), timeout=2.0)
+            return True
+        except Exception:
+            return False
 
     async def rpc_get_session_info(self) -> dict:
         """Bootstrap info for drivers attaching via init(address=...)."""

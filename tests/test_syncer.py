@@ -99,3 +99,38 @@ def test_drain_node_excluded_from_scheduling(ray_start):
         assert nodes[node_id]["draining"] is True
     finally:
         ray_tpu.remove_node(node_id)
+
+
+def test_health_probe_saves_wedged_heartbeat_node(ray_start):
+    """Reference gcs_health_check_manager parity: missed heartbeats
+    trigger an active probe; a node whose RPC server still answers is
+    kept alive, a truly dead one is declared dead."""
+    import asyncio
+
+    import ray_tpu._private.worker as worker_mod
+    rt = worker_mod._runtime
+    controller = rt.controller
+    node_id = ray_tpu.add_fake_node(num_cpus=1.0)
+    daemon = [d for d in rt.extra_daemons if d.node_id == node_id][0]
+
+    async def wedge_and_check():
+        node = controller.nodes[node_id]
+        # simulate a wedged heartbeat path: stale timestamp, server alive
+        node.last_heartbeat -= controller.node_timeout_s + 100
+        for _ in range(40):
+            await asyncio.sleep(0.25)
+            if node.last_heartbeat > time.monotonic() - 5:
+                break
+        assert controller.nodes[node_id].alive
+        # now ACTUALLY kill the daemon's server: probe fails -> dead
+        await daemon.server.stop()
+        daemon._closed = True            # stop its heartbeat loop too
+        node.last_heartbeat = time.monotonic() - controller.node_timeout_s - 100
+        for _ in range(40):
+            await asyncio.sleep(0.25)
+            if not controller.nodes[node_id].alive:
+                break
+        assert not controller.nodes[node_id].alive
+
+    rt.loop_runner.run_sync(wedge_and_check(), timeout=60)
+    ray_tpu.remove_node(node_id)
