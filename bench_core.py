@@ -110,6 +110,48 @@ def bench_put_get(n, payload):
     return rate(n, timed(run))
 
 
+def bench_actor_creation(n, window=20):
+    """Actors created+ready per second (BASELINE many_actors row)."""
+    created = []
+
+    def run():
+        done = 0
+        while done < n:
+            batch = min(window, n - done)
+            actors = [Sink.options(num_cpus=0).remote()
+                      for _ in range(batch)]
+            ray_tpu.get([a.noop.remote() for a in actors])
+            created.extend(actors)
+            done += batch
+    r = rate(n, timed(run))
+    for a in created:
+        ray_tpu.kill(a)
+    return r
+
+
+def bench_placement_groups(n):
+    """PG create+ready / remove latency (BASELINE many_pgs +
+    stress_test_placement_group rows)."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    pgs = []
+
+    def create():
+        for _ in range(n):
+            pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+            pg.ready(timeout=30)
+            pgs.append(pg)
+
+    t_create = timed(create)
+
+    def remove():
+        for pg in pgs:
+            remove_placement_group(pg)
+
+    t_remove = timed(remove)
+    return rate(n, t_create), 1000.0 * t_remove / n
+
+
 def main():
     quick = "--quick" in sys.argv
     scale = 1 if quick else 5
@@ -141,9 +183,16 @@ def main():
     results["tasks_per_second"] = bench_tasks(500 * scale)
     results["put_get_small_per_second"] = bench_put_get(
         200 * scale, b"x" * 100)
+    results["actors_created_per_second"] = bench_actor_creation(
+        8 * scale)
+    pg_rate, pg_remove_ms = bench_placement_groups(10 * scale)
+    results["placement_groups_per_second"] = pg_rate
+    results["pg_remove_latency_ms"] = pg_remove_ms
 
+    units = {"pg_remove_latency_ms": "ms"}
     for k, v in results.items():
-        print(json.dumps({"metric": k, "value": v, "unit": "calls/s"}))
+        print(json.dumps({"metric": k, "value": v,
+                          "unit": units.get(k, "calls/s")}))
 
     baseline = {  # BASELINE.md, m5.16xlarge (64 vCPU)
         "1_1_actor_calls_sync": 1959,
@@ -154,6 +203,10 @@ def main():
         "1_n_actor_calls_async": 8061,
         "n_n_actor_calls_async": 27210,
         "tasks_per_second": 368,
+        # distributed rows measured on 64x64-core clusters; recorded for
+        # visibility, not parity on one core
+        "actors_created_per_second": 588,
+        "placement_groups_per_second": 13.6,
     }
     summary = {k: {"ours": results[k], "ref": baseline[k],
                    "ratio": round(results[k] / baseline[k], 3)}

@@ -459,21 +459,31 @@ class Controller:
         while not self._closed:
             await asyncio.sleep(2.0)
             now = time.monotonic()
-            for node in list(self.nodes.values()):
-                if node.alive and now - node.last_heartbeat > self.node_timeout_s:
-                    if await self._probe_node(node):
-                        logger.warning(
-                            "node %s missed heartbeats for %.0fs but "
-                            "answers probes; keeping alive",
-                            node.node_id[:8], now - node.last_heartbeat)
-                        node.last_heartbeat = time.monotonic()
-                        continue
-                    logger.warning("node %s missed heartbeats for %.0fs "
-                                   "and failed the probe; marking dead",
-                                   node.node_id[:8],
-                                   now - node.last_heartbeat)
-                    node.alive = False
-                    await self._on_node_death(node.node_id)
+            stale = [n for n in self.nodes.values()
+                     if n.alive and now - n.last_heartbeat
+                     > self.node_timeout_s]
+            if not stale:
+                continue
+            # probe concurrently: a correlated failure of many nodes
+            # must not serialize 2s timeouts per dead node
+            verdicts = await asyncio.gather(
+                *(self._probe_node(n) for n in stale))
+            for node, ok in zip(stale, verdicts):
+                if not node.alive:
+                    continue        # died during the probe round
+                if ok:
+                    logger.warning(
+                        "node %s missed heartbeats for %.0fs but "
+                        "answers probes; keeping alive",
+                        node.node_id[:8], now - node.last_heartbeat)
+                    node.last_heartbeat = time.monotonic()
+                    continue
+                logger.warning("node %s missed heartbeats for %.0fs "
+                               "and failed the probe; marking dead",
+                               node.node_id[:8],
+                               now - node.last_heartbeat)
+                node.alive = False
+                await self._on_node_death(node.node_id)
 
     async def _probe_node(self, node: NodeEntry) -> bool:
         """One direct health probe of the daemon's RPC server."""
@@ -522,6 +532,20 @@ class Controller:
         return out
 
     # ---------------------------------------------------------- scheduling
+
+    async def rpc_submit_tasks(self, specs: List[dict]) -> List[dict]:
+        """Batched submission: one RPC for a burst of specs (the client
+        coalesces same-tick submits). Per-spec error isolation: a spec
+        that fails mid-batch must not poison the already-queued ones."""
+        out = []
+        for spec in specs:
+            try:
+                out.append(await self.rpc_submit_task(spec))
+            except Exception as e:
+                logger.exception("batched submit of %s failed",
+                                 spec.get("task_id", "?")[:12])
+                out.append({"status": "error", "error": repr(e)})
+        return out
 
     async def rpc_submit_task(self, spec: dict) -> dict:
         if spec.get("is_actor_creation") and spec.get("actor_name") \

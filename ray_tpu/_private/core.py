@@ -233,6 +233,11 @@ class CoreClient:
         # fn/class defs exported once to GCS KV, workers lazy-import):
         # fn_hash -> asyncio.Future resolved when the KV export landed.
         self._exported_fns: Dict[str, asyncio.Future] = {}
+        # Submit batching (reference parity: the lease/fast-path goal of
+        # normal_task_submitter.h — amortize control-plane RPCs): specs
+        # issued in the same loop tick ride one controller call.
+        self._submit_batch: List[Tuple[dict, asyncio.Future]] = []
+        self._submit_flush_scheduled = False
 
     # ------------------------------------------------------------- lifecycle
 
@@ -872,6 +877,46 @@ class CoreClient:
         else:
             await asyncio.shield(fut)
 
+    # ----------------------------------------------------- submit batching
+
+    async def _submit_spec(self, spec: dict) -> dict:
+        """Queue a task spec; a burst submitted in one event-loop tick is
+        flushed as a single submit_tasks controller RPC."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._submit_batch.append((spec, fut))
+        if not self._submit_flush_scheduled:
+            self._submit_flush_scheduled = True
+            loop.call_soon(
+                lambda: asyncio.ensure_future(self._flush_submits()))
+        return await fut
+
+    async def _flush_submits(self) -> None:
+        batch, self._submit_batch = self._submit_batch, []
+        self._submit_flush_scheduled = False
+        if not batch:
+            return
+        try:
+            if len(batch) == 1:
+                replies = [await self._controller().call(
+                    "submit_task", spec=batch[0][0])]
+            else:
+                replies = await self._controller().call(
+                    "submit_tasks", specs=[s for s, _ in batch])
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), reply in zip(batch, replies):
+            if fut.done():
+                continue
+            if isinstance(reply, dict) and reply.get("status") == "error":
+                fut.set_exception(RuntimeError(
+                    reply.get("error", "submission failed")))
+            else:
+                fut.set_result(reply)
+
     # ------------------------------------------------------------ tasks
 
     def submit_task(self, fn, args: tuple, kwargs: dict, opts: dict,
@@ -922,7 +967,7 @@ class CoreClient:
             try:
                 if export_hash is not None:
                     await self._ensure_fn_exported(export_hash, blob)
-                await self._controller().call("submit_task", spec=spec)
+                await self._submit_spec(spec)
             except Exception as e:
                 err = TaskError(spec["name"], f"submission failed: {e!r}")
                 for rid in return_ids:
@@ -974,7 +1019,7 @@ class CoreClient:
             try:
                 if export_hash is not None:
                     await self._ensure_fn_exported(export_hash, blob)
-                await self._controller().call("submit_task", spec=spec)
+                await self._submit_spec(spec)
             except Exception as e:
                 self.memory_store.put_error(
                     return_id,

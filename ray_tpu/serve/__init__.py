@@ -7,6 +7,7 @@ model multiplexing, request-driven autoscaling.
 """
 
 from .api import (Application, Deployment, delete, deployment,
+                  start_grpc,
                   get_app_handle, get_deployment_handle, run, shutdown,
                   start, status)
 from .batching import batch
@@ -17,7 +18,8 @@ from .multiplex import get_multiplexed_model_id, multiplexed
 from ._private.proxy import Request, Response, StreamingHint
 
 __all__ = [
-    "deployment", "Deployment", "Application", "run", "start", "shutdown",
+    "deployment", "Deployment", "Application", "run", "start",
+    "start_grpc", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
     "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "StreamingHint",

@@ -11,6 +11,7 @@ from typing import Any, Dict, Optional
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 PROXY_NAME = "SERVE_PROXY"
+GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
 def deployment_key(app_name: str, deployment_name: str) -> str:

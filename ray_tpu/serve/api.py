@@ -16,7 +16,7 @@ import ray_tpu
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .handle import DeploymentHandle, _HandlePlaceholder
 from ._private.common import (ApplicationStatus, CONTROLLER_NAME,
-                              PROXY_NAME)
+                              GRPC_PROXY_NAME, PROXY_NAME)
 
 
 class Application:
@@ -206,6 +206,24 @@ def start(http_options: Optional[Union[HTTPOptions, Dict[str, Any]]] = None,
     return controller
 
 
+def start_grpc(host: str = "127.0.0.1", port: int = 9000) -> int:
+    """Start the gRPC ingress proxy (reference parity: the reference's
+    gRPCProxy runs beside the HTTP proxy). Returns the bound port.
+    Service raytpu.serve.Serve: Predict (unary bytes) / PredictStream
+    (server-streaming bytes), app selected by 'application' metadata."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    _get_controller()
+    try:
+        proxy = ray_tpu.get_actor(GRPC_PROXY_NAME)
+    except ValueError:
+        from ._private.grpc_proxy import GrpcProxyActor
+        cls = ray_tpu.remote(num_cpus=0)(GrpcProxyActor)
+        proxy = cls.options(name=GRPC_PROXY_NAME, lifetime="detached",
+                            max_concurrency=256).remote(host, port)
+    return ray_tpu.get(proxy.ready.remote(), timeout=60)
+
+
 def run(target: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
         _start_http: bool = True,
@@ -278,10 +296,10 @@ def shutdown() -> None:
         ray_tpu.get(controller.shutdown.remote(), timeout=60)
     except Exception:
         pass
-    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+    for actor_name in (PROXY_NAME, GRPC_PROXY_NAME, CONTROLLER_NAME):
         try:
             actor = ray_tpu.get_actor(actor_name)
-            if actor_name == PROXY_NAME:
+            if actor_name in (PROXY_NAME, GRPC_PROXY_NAME):
                 try:
                     ray_tpu.get(actor.shutdown.remote(), timeout=10)
                 except Exception:

@@ -115,16 +115,23 @@ def test_health_probe_saves_wedged_heartbeat_node(ray_start):
 
     async def wedge_and_check():
         node = controller.nodes[node_id]
-        # simulate a wedged heartbeat path: stale timestamp, server alive
-        node.last_heartbeat -= controller.node_timeout_s + 100
+        # ACTUALLY wedge the heartbeat path (cancel the monitor loop)
+        # while the daemon's RPC server stays up — only the probe can
+        # keep this node alive now
+        daemon._monitor_task.cancel()
+        await asyncio.sleep(0.1)
+        node.last_heartbeat = (time.monotonic()
+                               - controller.node_timeout_s - 100)
+        probed = False
         for _ in range(40):
             await asyncio.sleep(0.25)
             if node.last_heartbeat > time.monotonic() - 5:
+                probed = True     # refreshed by the probe, not a heartbeat
                 break
-        assert controller.nodes[node_id].alive
+        assert probed and controller.nodes[node_id].alive
         # now ACTUALLY kill the daemon's server: probe fails -> dead
         await daemon.server.stop()
-        daemon._closed = True            # stop its heartbeat loop too
+        daemon._closed = True
         node.last_heartbeat = time.monotonic() - controller.node_timeout_s - 100
         for _ in range(40):
             await asyncio.sleep(0.25)
