@@ -50,6 +50,9 @@ class RayTpuConfig:
     lineage_max_bytes: int = _f("RAY_TPU_LINEAGE_MAX_BYTES", 512 << 20)
 
     # -- node daemon ---------------------------------------------------
+    #: fork workers from a preloaded zygote (interpreter+imports paid
+    #: once per node; ~10ms/worker instead of 1-2s); 0 = cold Popen
+    worker_forkserver: int = _f("RAY_TPU_FORKSERVER", 1)
     #: node memory fraction that triggers the OOM killer (<=0 disables)
     memory_usage_threshold: float = _f(
         "RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95)

@@ -74,12 +74,42 @@ def runtime_env_key(runtime_env: Optional[dict]) -> str:
     return json.dumps(runtime_env, sort_keys=True, default=str)
 
 
+class _ForkedProc:
+    """Popen-like shim for zygote-forked workers. They are the ZYGOTE's
+    children (it reaps them), so poll() probes with signal 0 and the
+    exact exit code is unknowable (-1 once gone). kill() targets the
+    process group — the child setsid()s, so pgid == pid."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def kill(self) -> None:
+        import signal as _signal
+        for target in (lambda: os.killpg(self.pid, _signal.SIGKILL),
+                       lambda: os.kill(self.pid, _signal.SIGKILL)):
+            try:
+                target()
+                return
+            except Exception:
+                continue
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            self.returncode = -1
+            return -1
+
+
 class WorkerHandle:
     __slots__ = ("worker_id", "addr", "pid", "proc", "state", "current_task",
                  "actor_id", "spawn_time", "env_key", "oom_reason")
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen,
-                 env_key: str = ""):
+    def __init__(self, worker_id: str, proc, env_key: str = ""):
         self.worker_id = worker_id
         self.addr: Optional[Tuple[str, int]] = None
         self.proc = proc
@@ -158,6 +188,16 @@ class NodeDaemon:
         self._last_view: Optional[dict] = None
         self._cmd_applied = 0    # highest command seq applied (acked back)
         self.draining = False
+        # Worker forkserver (zygote.py): interpreter+imports paid once,
+        # workers fork in ~10ms. RAY_TPU_FORKSERVER=0 falls back to cold
+        # Popen per worker. Replies route by worker_id; child exits are
+        # pushed by the zygote's reaper (no pid-probe races).
+        self._zygote = None
+        self._zygote_lock = asyncio.Lock()
+        self._zygote_reader_task: Optional[asyncio.Task] = None
+        self._zygote_replies: Dict[str, asyncio.Future] = {}
+        self._forked_procs: Dict[int, _ForkedProc] = {}
+        self._early_exits: Dict[int, int] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -183,6 +223,11 @@ class NodeDaemon:
             self._monitor_task.cancel()
         for w in self.workers.values():
             self._kill_proc(w)
+        if self._zygote is not None and self._zygote.returncode is None:
+            try:
+                self._zygote.kill()
+            except Exception:
+                pass
         self.object_store.free_all()
         await self.server.stop()
         await self.pool.close_all()
@@ -269,42 +314,157 @@ class NodeDaemon:
             extra_path.append(target)
         return env_vars, extra_path, cwd
 
+    def _worker_pythonpath(self, extra_path,
+                           existing: Optional[str] = None) -> str:
+        """Workers must import ray_tpu (and the driver's user modules)
+        even when the package isn't installed: propagate the package
+        parent dir plus the driver's sys.path entries. Runtime-env paths
+        go FIRST so they shadow driver-side modules; a user-supplied
+        PYTHONPATH (worker_env / runtime_env env_vars) is preserved via
+        `existing`."""
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        extra = list(extra_path) + [pkg_parent] + [
+            p for p in sys.path if p and os.path.isdir(p)]
+        if existing is None:
+            existing = os.environ.get("PYTHONPATH", "")
+        seen, parts = set(), []
+        for p in extra + existing.split(os.pathsep):
+            if p and p not in seen:
+                seen.add(p)
+                parts.append(p)
+        return os.pathsep.join(parts)
+
+    def _worker_argv(self, worker_id: str) -> list:
+        return ["--controller",
+                f"{self.controller_addr[0]}:{self.controller_addr[1]}",
+                "--daemon", f"{self.address[0]}:{self.address[1]}",
+                "--worker-id", worker_id,
+                "--node-id", self.node_id,
+                "--session", self.session_name]
+
+    async def _ensure_zygote(self):
+        async with self._zygote_lock:    # one zygote, even under a burst
+            if self._zygote is not None and self._zygote.returncode is None:
+                return self._zygote
+            if self._zygote_reader_task is not None:
+                self._zygote_reader_task.cancel()
+            env = dict(os.environ)
+            env.update(self.worker_env)
+            env["RAY_TPU_SESSION"] = self.session_name
+            env["PYTHONPATH"] = self._worker_pythonpath(
+                [], env.get("PYTHONPATH"))
+            zlog = open(os.path.join(self.temp_dir, "logs",
+                                     f"zygote-{self.node_id[:8]}.log"),
+                        "ab")
+            self._zygote = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "ray_tpu._private.zygote",
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=zlog, env=env, start_new_session=True)
+            zlog.close()
+            self._zygote_reader_task = asyncio.ensure_future(
+                self._zygote_reader(self._zygote))
+            return self._zygote
+
+    async def _zygote_reader(self, zygote) -> None:
+        """Route zygote stdout lines: fork replies to their waiting
+        spawn (by worker_id), exit notices onto the forked proc."""
+        import json as _json
+        try:
+            while True:
+                line = await zygote.stdout.readline()
+                if not line:
+                    break
+                try:
+                    msg = _json.loads(line)
+                except Exception:
+                    continue
+                if "exited" in msg:
+                    pid = int(msg["exited"])
+                    proc = self._forked_procs.pop(pid, None)
+                    if proc is not None:
+                        proc.returncode = msg.get("code", -1)
+                    else:   # exit raced ahead of the fork reply
+                        if len(self._early_exits) > 4096:
+                            self._early_exits.clear()
+                        self._early_exits[pid] = msg.get("code", -1)
+                else:
+                    fut = self._zygote_replies.pop(
+                        msg.get("worker_id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            # zygote gone: anything still waiting must fall back
+            for fut in self._zygote_replies.values():
+                if not fut.done():
+                    fut.set_exception(RuntimeError("zygote died"))
+            self._zygote_replies.clear()
+
+    async def _fork_worker(self, worker_id: str, env_vars, extra_path,
+                           cwd, log_path: str) -> _ForkedProc:
+        import json as _json
+        zygote = await self._ensure_zygote()
+        # PYTHONPATH handed via env_vars cannot affect an already-running
+        # interpreter — translate it into sys.path prepends for the child
+        prepend = list(extra_path)
+        for src in (env_vars.get("PYTHONPATH", ""),
+                    self.worker_env.get("PYTHONPATH", "")):
+            prepend += [p for p in src.split(os.pathsep) if p]
+        req = {"worker_id": worker_id,
+               "argv": self._worker_argv(worker_id),
+               "env": dict(env_vars),
+               "path_prepend": prepend,
+               "log_path": log_path, "cwd": cwd}
+        fut = asyncio.get_running_loop().create_future()
+        self._zygote_replies[worker_id] = fut
+        try:
+            async with self._zygote_lock:
+                zygote.stdin.write((_json.dumps(req) + "\n").encode())
+                await zygote.stdin.drain()
+            # first fork pays the zygote's one-time import cost
+            reply = await asyncio.wait_for(fut, 90.0)
+        finally:
+            self._zygote_replies.pop(worker_id, None)
+        proc = _ForkedProc(int(reply["pid"]))
+        early = self._early_exits.pop(proc.pid, None)
+        if early is not None:
+            proc.returncode = early
+        else:
+            self._forked_procs[proc.pid] = proc
+        return proc
+
     async def _spawn_worker(self, env_key: str = "") -> WorkerHandle:
         worker_id = WorkerID.generate().hex()
         log_path = self._worker_log_path(worker_id)
         runtime_env = self._runtime_envs.get(env_key)
         env_vars, extra_path, cwd = await self._prepare_runtime_env(
             runtime_env)
-        log_file = open(log_path, "ab")
-        env = dict(os.environ)
-        env.update(self.worker_env)
-        env.update(env_vars)
-        env["RAY_TPU_SESSION"] = self.session_name
-        # Workers must import ray_tpu (and the driver's user modules) even
-        # when the package isn't installed: propagate the package parent dir
-        # plus the driver's sys.path entries. Runtime-env paths go FIRST so
-        # they shadow driver-side modules.
-        pkg_parent = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        extra = extra_path + [pkg_parent] + [p for p in sys.path
-                                             if p and os.path.isdir(p)]
-        existing = env.get("PYTHONPATH", "")
-        seen, parts = set(), []
-        for p in extra + existing.split(os.pathsep):
-            if p and p not in seen:
-                seen.add(p)
-                parts.append(p)
-        env["PYTHONPATH"] = os.pathsep.join(parts)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--controller", f"{self.controller_addr[0]}:{self.controller_addr[1]}",
-             "--daemon", f"{self.address[0]}:{self.address[1]}",
-             "--worker-id", worker_id,
-             "--node-id", self.node_id,
-             "--session", self.session_name],
-            stdout=log_file, stderr=subprocess.STDOUT, env=env,
-            cwd=cwd, start_new_session=True)
-        log_file.close()
+        from .config import get_config
+        proc = None
+        if get_config().worker_forkserver:
+            try:
+                proc = await self._fork_worker(
+                    worker_id, env_vars, extra_path, cwd, log_path)
+            except Exception:
+                logger.exception("zygote fork failed; cold-spawning")
+                proc = None
+        if proc is None:
+            log_file = open(log_path, "ab")
+            env = dict(os.environ)
+            env.update(self.worker_env)
+            env.update(env_vars)
+            env["RAY_TPU_SESSION"] = self.session_name
+            env["PYTHONPATH"] = self._worker_pythonpath(
+                extra_path, env.get("PYTHONPATH"))
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"]
+                + self._worker_argv(worker_id),
+                stdout=log_file, stderr=subprocess.STDOUT, env=env,
+                cwd=cwd, start_new_session=True)
+            log_file.close()
         handle = WorkerHandle(worker_id, proc, env_key)
         self.workers[worker_id] = handle
         ev = asyncio.Event()

@@ -50,7 +50,15 @@ def test_pending_tasks_trigger_scale_up_and_idle_scale_down(scaled_cluster):
         return x * 2
 
     refs = [heavy.remote(i) for i in range(2)]
-    stats = scaler.reconcile_once()
+    # submits land on the controller a loop tick after .remote() (batch
+    # flush) — reconcile like the real autoscaler loop: periodically
+    launched = 0
+    for _ in range(20):
+        launched += scaler.reconcile_once()["launched"]
+        if launched:
+            break
+        time.sleep(0.1)
+    stats = {"launched": launched}
     assert stats["launched"] >= 1
     assert sorted(ray_tpu.get(refs, timeout=180)) == [0, 2]
 
