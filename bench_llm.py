@@ -80,9 +80,9 @@ def bench_kernel_scaling(on_tpu: bool) -> dict:
     num_pages = B * max_pages + 1
     rng = np.random.default_rng(0)
     k_pages = jnp.asarray(
-        rng.normal(size=(num_pages, KVH, page_size, D)), jnp.bfloat16)
+        rng.normal(size=(num_pages, page_size, KVH, D)), jnp.bfloat16)
     v_pages = jnp.asarray(
-        rng.normal(size=(num_pages, KVH, page_size, D)), jnp.bfloat16)
+        rng.normal(size=(num_pages, page_size, KVH, D)), jnp.bfloat16)
     tables = jnp.asarray(
         np.arange(B * max_pages).reshape(B, max_pages), jnp.int32)
     q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.bfloat16)

@@ -85,6 +85,8 @@ class _ForkedProc:
         self.returncode: Optional[int] = None
 
     def kill(self) -> None:
+        if self.returncode is not None:
+            return   # already reaped: the pid may belong to someone else
         import signal as _signal
         for target in (lambda: os.killpg(self.pid, _signal.SIGKILL),
                        lambda: os.kill(self.pid, _signal.SIGKILL)):
@@ -444,7 +446,13 @@ class NodeDaemon:
             runtime_env)
         from .config import get_config
         proc = None
-        if get_config().worker_forkserver:
+        # Env vars that act at interpreter/import time (jax/XLA config,
+        # python startup) cannot take effect in a fork of the pre-warmed
+        # zygote — those workers must cold-spawn.
+        import_sensitive = any(
+            k.startswith(("JAX_", "XLA_", "PYTHON", "LD_", "TPU_"))
+            for k in env_vars)
+        if get_config().worker_forkserver and not import_sensitive:
             try:
                 proc = await self._fork_worker(
                     worker_id, env_vars, extra_path, cwd, log_path)

@@ -51,6 +51,7 @@ def zygote_main() -> None:
     # cannot reap them — they are OUR children) and push exit notices.
     threading.Thread(target=_reaper, args=(out_fd,), daemon=True).start()
 
+    protocol_fds = [stdin.fileno(), out_fd, devnull]
     for line in stdin:
         try:
             req = json.loads(line)
@@ -58,7 +59,7 @@ def zygote_main() -> None:
             continue
         pid = os.fork()
         if pid == 0:
-            _child(req)        # never returns
+            _child(req, protocol_fds)        # never returns
         _emit(out_fd, {"worker_id": req["worker_id"], "pid": pid})
 
 
@@ -77,7 +78,7 @@ def _reaper(out_fd: int) -> None:
         _emit(out_fd, {"exited": pid, "code": code})
 
 
-def _child(req: dict) -> None:
+def _child(req: dict, protocol_fds) -> None:
     try:
         os.setsid()
         signal.signal(signal.SIGCHLD, signal.SIG_DFL)
@@ -85,6 +86,15 @@ def _child(req: dict) -> None:
                          os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         os.dup2(log_fd, 1)
         os.dup2(log_fd, 2)
+        os.close(log_fd)
+        # Drop the zygote's protocol fds: a worker holding the stdout
+        # pipe's write end would keep the daemon from seeing EOF (and
+        # thus zygote death) for as long as the worker lives.
+        for fd in protocol_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         for key, val in (req.get("env") or {}).items():
             if val is None:
                 os.environ.pop(key, None)

@@ -89,6 +89,13 @@ const alive = (a) => a ? `<span class="ok">ALIVE</span>`
                        : `<span class="bad">DEAD</span>`;
 const fmtRes = (r) => esc(Object.entries(r||{})
     .map(([k,v]) => `${k}:${Math.round(v*100)/100}`).join(" "));
+const fmtBytes = (n) => {
+  if (n == null) return "";
+  const units = ["B","KB","MB","GB","TB"];
+  let i = 0, v = Number(n);
+  while (v >= 1024 && i < units.length - 1) { v /= 1024; i++; }
+  return esc(`${Math.round(v*10)/10}${units[i]}`);
+};
 
 const VIEWS = {
   async cluster() {
@@ -114,6 +121,12 @@ const VIEWS = {
       ["total", r => fmtRes(r.resources_total)],
       ["available", r => fmtRes(r.resources_available)],
       ["labels", r => fmtRes(r.labels)],
+      // gossiped daemon stats (syncer view): workers, store bytes, OOM
+      ["workers", r => esc(String((r.stats||{}).num_workers ?? ""))],
+      ["store", r => fmtBytes((r.stats||{}).object_store_bytes)],
+      ["spilled", r => fmtBytes((r.stats||{}).bytes_spilled)],
+      ["oom kills", r => esc(String((r.stats||{}).oom_kills ?? ""))],
+      ["draining", r => r.draining ? "yes" : ""],
     ]);
   },
   async actors() {

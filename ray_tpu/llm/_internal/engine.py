@@ -117,8 +117,8 @@ class InferenceEngine:
         self.params = jax.device_put(params)
         self.allocator = PageAllocator(ec.num_pages, ec.page_size)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
-        kv_shape = (cfg.n_layers, ec.num_pages, cfg.n_kv_heads,
-                    ec.page_size, cfg.head_dim)
+        kv_shape = (cfg.n_layers, ec.num_pages, ec.page_size,
+                    cfg.n_kv_heads, cfg.head_dim)
         self.k_pages = jnp.zeros(kv_shape, cfg.dtype)
         self.v_pages = jnp.zeros(kv_shape, cfg.dtype)
         self._key = jax.random.PRNGKey(ec.seed + 1)
@@ -141,8 +141,12 @@ class InferenceEngine:
         cfg = self.model_cfg
         impl = self.config.decode_impl
         if impl == "auto":
-            impl = ("pallas" if jax.devices()[0].platform == "tpu"
-                    else "gather")
+            # any non-CPU PJRT platform (tpu, or this machine's "axon"
+            # tunnel) runs the compiled Pallas kernel; CPU falls back to
+            # the dense gather (kernel correctness is covered in
+            # interpret-mode tests)
+            impl = ("gather" if jax.devices()[0].platform == "cpu"
+                    else "pallas")
 
         def step(params, k_pages, v_pages, tokens, positions, page_tables,
                  active, key, temps, top_ps, all_greedy):

@@ -130,7 +130,7 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
 
     use_kernel = impl in ("pallas", "pallas_interpret")
     if use_kernel:
-        # Pool is layer-major already: scan slices (pages, KVH, page, D).
+        # Pool is layer-major already: scan slices (pages, page, KVH, D).
         k_by_layer, v_by_layer = k_pages, v_pages
     else:
         # One gather of the whole context for all layers, layer-major.

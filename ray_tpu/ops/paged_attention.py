@@ -4,15 +4,16 @@ Net-new for the TPU build (the reference delegates paged attention to
 external vLLM CUDA kernels; SURVEY.md §7 step 10). Layout decision
 (TPU-first): one page pool shared by ALL layers, layer-major + head-major —
 
-    k_pages, v_pages: [n_layers, num_pages, n_kv_heads, page_size, head_dim]
+    k_pages, v_pages: [n_layers, num_pages, page_size, n_kv_heads, head_dim]
 
-chosen for the two hot paths at once: the decode scan over layers slices
-dim 0 (no per-step transpose of the pool), and the Pallas kernel's page
-block [1, 1, page_size, head_dim] keeps the last two dims at
-(page_size, head_dim) — the TPU lowering requires last-two block dims
-divisible by (8, 128) or full, and page_size=16/head_dim=128 satisfy it
-natively. A decode token's KV for every layer still lands in ONE scatter
-at (page, offset).
+chosen for the hot paths at once: the decode scan over layers slices
+dim 0 (no per-step transpose of the pool); (page, token) are adjacent so
+KV writes flatten the pool to [L, P*page_size, KVH, D] and scatter on a
+SINGLE index dim (row = page*page_size + offset) — the
+two-index-dim form (.at[:, page_idx, :, offset]) lowers to a
+pathologically slow XLA scatter on TPU; and the kernel block's last two
+dims stay (KVH, head_dim), which satisfies the TPU lowering's
+(8, 128)-divisibility natively.
 
 Two decode paths:
 - XLA fallback: gather pages into dense [B, ctx] KV then masked attention
@@ -42,9 +43,9 @@ def gather_kv(k_pages: jax.Array, v_pages: jax.Array,
     k/v: [n_layers, B, max_pages*page_size, n_kv_heads, head_dim]
     (layer-major, ready for a scan over layers)."""
     def one(pages):
-        g = pages[:, page_tables]          # [L, B, P, KVH, page, D]
-        l, b, p, h, s, d = g.shape
-        return g.transpose(0, 1, 2, 4, 3, 5).reshape(l, b, p * s, h, d)
+        g = pages[:, page_tables]          # [L, B, P, page, KVH, D]
+        l, b, p, s, h, d = g.shape
+        return g.reshape(l, b, p * s, h, d)
     return one(k_pages), one(v_pages)
 
 
@@ -57,11 +58,11 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     seq_lens: [B] number of valid cached tokens (including the new one)
     Returns [B, n_heads, head_dim].
     """
-    g_k = k_pages[layer][page_tables]      # [B, P, KVH, page, D]
+    g_k = k_pages[layer][page_tables]      # [B, P, page, KVH, D]
     g_v = v_pages[layer][page_tables]
-    b, p, h, s, d = g_k.shape
-    k = g_k.transpose(0, 1, 3, 2, 4).reshape(b, p * s, h, d)
-    v = g_v.transpose(0, 1, 3, 2, 4).reshape(b, p * s, h, d)
+    b, p, s, h, d = g_k.shape
+    k = g_k.reshape(b, p * s, h, d)
+    v = g_v.reshape(b, p * s, h, d)
     return paged_attention_on_gathered(q, k, v, seq_lens)
 
 
@@ -119,8 +120,8 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         group = q_ref.shape[2]
         for h in range(kvh):
             q = q_ref[0, h].astype(jnp.float32)        # (group, D)
-            k = k_ref[0, h].astype(jnp.float32)        # (page, D)
-            v = v_ref[0, h].astype(jnp.float32)        # (page, D)
+            k = k_ref[0, :, h].astype(jnp.float32)     # (page, D)
+            v = v_ref[0, :, h].astype(jnp.float32)     # (page, D)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # (group, page)
@@ -147,14 +148,139 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[0] = l_scr[:].reshape(kvh, group, 1)
 
 
+def _paged_decode_kernel_mp(tables_ref, lens_ref, q_ref, k_hbm, v_hbm,
+                            o_ref, m_ref, l_ref, k_vmem, v_vmem, sem,
+                            m_scr, l_scr, acc_scr, *,
+                            page_size: int, ppb: int, scale: float,
+                            kvh: int):
+    """Multi-page variant: grid (B, max_pages // ppb); each step manually
+    DMAs its block's ppb pages (all kv heads per page — our pool layout
+    keeps heads together) into VMEM and runs one online-softmax update
+    over ppb*page_size keys. 8x fewer grid steps and 8x larger matmuls
+    than the one-page-per-step BlockSpec kernel, whose per-step dispatch
+    overhead dominated decode (~5us x B x max_pages)."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    bk = page_size * ppb
+    length = jnp.maximum(lens_ref[b], 1)   # inactive rows attend 1 page
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(i * bk < length)
+    def _step():
+        last = jnp.maximum((length - 1) // page_size, 0)
+
+        def copies():
+            out = []
+            for t in range(ppb):
+                idx = tables_ref[b, jnp.minimum(i * ppb + t, last)]
+                out.append(pltpu.make_async_copy(
+                    k_hbm.at[idx], k_vmem.at[t], sem))
+                out.append(pltpu.make_async_copy(
+                    v_hbm.at[idx], v_vmem.at[t], sem))
+            return out
+
+        for c in copies():
+            c.start()
+        for c in copies():
+            c.wait()
+
+        pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        valid = pos < length                           # (1, bk)
+        group = q_ref.shape[2]
+        d = q_ref.shape[3]
+        # [ppb, page, kvh, D] -> per-head [bk, D]
+        kb = k_vmem[...].astype(jnp.float32)
+        vb = v_vmem[...].astype(jnp.float32)
+        for h in range(kvh):
+            q = q_ref[0, h].astype(jnp.float32)        # (group, D)
+            k = kb[:, :, h].reshape(bk, d)
+            v = vb[:, :, h].reshape(bk, d)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (group, bk)
+            s = jnp.where(valid, s, -1e30)
+            rows = slice(h * group, (h + 1) * group)
+            m_prev = m_scr[rows]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[rows] = (l_scr[rows] * corr
+                           + jnp.sum(p, axis=1, keepdims=True))
+            acc_scr[rows] = acc_scr[rows] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[rows] = m_new
+
+    @pl.when(i == n_blocks - 1)
+    def _finish():
+        group = q_ref.shape[2]
+        safe_l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / safe_l).reshape(
+            kvh, group, -1).astype(o_ref.dtype)
+        m_ref[0] = m_scr[:].reshape(kvh, group, 1)
+        l_ref[0] = l_scr[:].reshape(kvh, group, 1)
+
+
+def _paged_decode_multipage(q, k_pages, v_pages, page_tables, seq_lens,
+                            ppb: int, interpret: bool = False):
+    b, h, d = q.shape
+    _, page_size, kvh, _ = k_pages.shape
+    max_pages = page_tables.shape[1]
+    group = h // kvh
+    scale = d ** -0.5
+    qg = q.reshape(b, kvh, group, d)
+    n_blocks = max(-(-max_pages // ppb), 1)
+
+    fixed = lambda bi, i, tables, lens: (bi, 0, 0, 0)
+    out_spec = pl.BlockSpec((1, kvh, group, d), fixed)
+    stat_spec = pl.BlockSpec((1, kvh, group, 1), fixed)
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel_mp, page_size=page_size,
+                          ppb=ppb, scale=scale, kvh=kvh),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, kvh, group, d), fixed),
+                pl.BlockSpec(memory_space=pl.ANY),   # k pool stays in HBM
+                pl.BlockSpec(memory_space=pl.ANY),   # v pool stays in HBM
+            ],
+            out_specs=(out_spec, stat_spec, stat_spec),
+            scratch_shapes=[
+                pltpu.VMEM((ppb, page_size, kvh, d), k_pages.dtype),
+                pltpu.VMEM((ppb, page_size, kvh, d), v_pages.dtype),
+                pltpu.SemaphoreType.DMA,
+                pltpu.VMEM((kvh * group, 1), jnp.float32),
+                pltpu.VMEM((kvh * group, 1), jnp.float32),
+                pltpu.VMEM((kvh * group, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+            jax.ShapeDtypeStruct((b, kvh, group, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, group, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+
+
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_tables: jax.Array,
                            seq_lens: jax.Array, *,
                            return_stats: bool = False,
+                           pages_per_block: int = 16,
                            interpret: bool = False):
     """Pallas paged decode attention for one layer.
 
-    q: [B, H, D]; k_pages/v_pages: [num_pages, KVH, page_size, D]
+    q: [B, H, D]; k_pages/v_pages: [num_pages, page_size, KVH, D]
     (already sliced to the layer); page_tables: [B, max_pages] int32;
     seq_lens: [B] int32. Returns [B, H, D], or with return_stats=True
     (out, m, l) where m/l are the [B, H] online-softmax row max /
@@ -168,8 +294,15 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     ceil(seq_len / page_size), not max_pages.
     """
     b, h, d = q.shape
-    _, kvh, page_size, _ = k_pages.shape
+    _, page_size, kvh, _ = k_pages.shape
     max_pages = page_tables.shape[1]
+    if not interpret and max_pages >= pages_per_block > 1:
+        out, m, l = _paged_decode_multipage(
+            q, k_pages, v_pages, page_tables, seq_lens, pages_per_block)
+        out = out.reshape(b, h, d)
+        if return_stats:
+            return out, m.reshape(b, h), l.reshape(b, h)
+        return out
     group = h // kvh
     scale = d ** -0.5
     qg = q.reshape(b, kvh, group, d)
@@ -192,8 +325,8 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
             in_specs=[
                 pl.BlockSpec((1, kvh, group, d),
                              lambda bi, j, tables, lens: (bi, 0, 0, 0)),
-                pl.BlockSpec((1, kvh, page_size, d), page_index),
-                pl.BlockSpec((1, kvh, page_size, d), page_index),
+                pl.BlockSpec((1, page_size, kvh, d), page_index),
+                pl.BlockSpec((1, page_size, kvh, d), page_index),
             ],
             out_specs=(out_spec, stat_spec, stat_spec),
             scratch_shapes=[
@@ -259,16 +392,23 @@ def scatter_kv(k_pages: jax.Array, v_pages: jax.Array,
     table; positions: [N] absolute position of each token; valid: [N]
     bool — invalid rows write to a scratch page (the last page, which the
     allocator never hands out) instead of branching.
+
+    Single-index-dim scatter over the flattened [L, P*page_size, KVH, D]
+    view: row = page*page_size + offset. (The earlier two-index-dim form
+    .at[:, page_idx, :, offset] lowered to an XLA scatter that took
+    SECONDS per call on TPU.)
     """
-    page_size = k_pages.shape[3]
-    scratch = k_pages.shape[1] - 1
+    l, num_pages, page_size, kvh, d = k_pages.shape
+    scratch = num_pages - 1
     page_idx = jnp.take_along_axis(
         page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
     page_idx = jnp.where(valid, page_idx, scratch)
-    offset = positions % page_size
-    # Advanced indices (page_idx at dim 1, offset at dim 3) are separated
-    # by slices, so numpy semantics put the advanced axis FIRST: the
-    # updated view is [N, L, KVH, D] — exactly k_new's layout.
-    k_pages = k_pages.at[:, page_idx, :, offset].set(k_new)
-    v_pages = v_pages.at[:, page_idx, :, offset].set(v_new)
+    rows = page_idx * page_size + positions % page_size          # [N]
+    flat = lambda p: p.reshape(l, num_pages * page_size, kvh, d)
+    # a single advanced index keeps its position: the updated view is
+    # [L, N, KVH, D], so swap k_new's leading dims to match
+    k_rows = jnp.swapaxes(k_new, 0, 1)
+    v_rows = jnp.swapaxes(v_new, 0, 1)
+    k_pages = flat(k_pages).at[:, rows].set(k_rows).reshape(k_pages.shape)
+    v_pages = flat(v_pages).at[:, rows].set(v_rows).reshape(v_pages.shape)
     return k_pages, v_pages

@@ -32,8 +32,8 @@ def test_paged_attention_matches_dense():
     rng = np.random.default_rng(0)
     B, CTX, L, KVH, H, D = 2, 24, 3, 2, 4, 16
     num_pages, page = 16, 8
-    k_pages = jnp.zeros((L, num_pages, KVH, page, D))
-    v_pages = jnp.zeros((L, num_pages, KVH, page, D))
+    k_pages = jnp.zeros((L, num_pages, page, KVH, D))
+    v_pages = jnp.zeros((L, num_pages, page, KVH, D))
     # seq 0 gets pages [0,1,2], seq 1 gets [3,4,5]
     tables = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
     lens = np.array([20, 13])

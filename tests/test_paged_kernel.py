@@ -2,8 +2,8 @@
 kernel-backed decode_step vs the gather-backed one (interpret mode — the
 same kernel compiles on TPU).
 
-Pool layout: [n_layers, num_pages, KVH, page_size, D]; single-layer
-slices passed to the kernel are [num_pages, KVH, page_size, D].
+Pool layout: [n_layers, num_pages, page_size, KVH, D]; single-layer
+slices passed to the kernel are [num_pages, page_size, KVH, D].
 """
 
 import jax
@@ -16,18 +16,18 @@ from ray_tpu.ops import paged_attention as pa
 
 
 def _pool(rng, num_pages=32, page_size=16, kvh=4, d=64):
-    k = jnp.asarray(rng.normal(size=(num_pages, kvh, page_size, d)),
+    k = jnp.asarray(rng.normal(size=(num_pages, page_size, kvh, d)),
                     jnp.float32)
-    v = jnp.asarray(rng.normal(size=(num_pages, kvh, page_size, d)),
+    v = jnp.asarray(rng.normal(size=(num_pages, page_size, kvh, d)),
                     jnp.float32)
     return k, v
 
 
 def _dense(pages, tables):
-    """[pages, KVH, page, D] + [B, P] -> [B, P*page, KVH, D]"""
-    g = pages[tables]                       # [B, P, KVH, page, D]
-    b, p, h, s, d = g.shape
-    return g.transpose(0, 1, 3, 2, 4).reshape(b, p * s, h, d)
+    """[pages, page, KVH, D] + [B, P] -> [B, P*page, KVH, D]"""
+    g = pages[tables]                       # [B, P, page, KVH, D]
+    b, p, s, h, d = g.shape
+    return g.reshape(b, p * s, h, d)
 
 
 def test_kernel_matches_dense_gather():
@@ -79,7 +79,7 @@ def test_decode_step_kernel_matches_gather():
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(2)
     B, page_size, num_pages, max_pages = 2, 16, 16, 4
-    kv_shape = (cfg.n_layers, num_pages, cfg.n_kv_heads, page_size,
+    kv_shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads,
                 cfg.head_dim)
     k_pages = jnp.zeros(kv_shape, cfg.dtype)
     v_pages = jnp.zeros(kv_shape, cfg.dtype)
@@ -103,3 +103,33 @@ def test_decode_step_kernel_matches_gather():
                                np.asarray(out_logits), atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(rk), np.asarray(ok),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_multipage_kernel_matches_dense_gather():
+    """The multi-page manual-DMA kernel (the TPU decode hot path) in
+    interpret mode vs the dense reference — including partial blocks,
+    a zero-length row, and full-context rows."""
+    from ray_tpu.ops.paged_attention import _paged_decode_multipage
+
+    rng = np.random.default_rng(3)
+    B, H, KVH, D = 3, 8, 4, 64
+    num_pages, page_size, max_pages = 100, 8, 32
+    k_pages = jnp.asarray(
+        rng.normal(size=(num_pages, page_size, KVH, D)), jnp.float32)
+    v_pages = jnp.asarray(
+        rng.normal(size=(num_pages, page_size, KVH, D)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(num_pages - 1)[:B * max_pages].reshape(
+            B, max_pages), jnp.int32)
+    # 0 (inactive slot), mid partial block, exactly full context
+    seq_lens = jnp.asarray([0, 77, page_size * max_pages], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+
+    out, m, l = _paged_decode_multipage(
+        q, k_pages, v_pages, tables, seq_lens, ppb=4, interpret=True)
+    ref = pa.paged_attention_on_gathered(
+        q, _dense(k_pages, tables), _dense(v_pages, tables),
+        jnp.maximum(seq_lens, 1))   # kernel clamps 0 -> 1 page row
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(B, H, D)[1:], np.asarray(ref)[1:],
+        atol=2e-5, rtol=2e-5)
