@@ -62,6 +62,21 @@ def init(address: Optional[str] = None,
         atexit.register(shutdown)
         return _runtime
 
+    if address is not None and address.startswith("client://"):
+        # thin-client attach over ONE connection through the client
+        # proxy (reference parity: ray.init("ray://…") client mode);
+        # no cluster-routable addresses needed on this side.
+        from .client_proxy import ProxyModeClient
+        hostport = address[len("client://"):]
+        host, _, port = hostport.rpartition(":")
+        client = ProxyModeClient(host or "127.0.0.1", int(port),
+                                 namespace=namespace)
+        state.set_client(client)
+        _runtime = Runtime(client, None, None, client.loop_runner,
+                           f"client-{client.session_id[:8]}")
+        atexit.register(shutdown)
+        return _runtime
+
     if address is not None:
         # attach to an existing cluster: address = "host:port" of the
         # controller (written to the cluster-address file by `ray_tpu
@@ -187,6 +202,11 @@ def shutdown() -> None:
         if rt.controller is not None:
             await rt.controller.stop()
 
+    for proxy in getattr(rt, "client_proxies", []):
+        try:
+            proxy.stop()
+        except Exception:
+            pass
     try:
         rt.loop_runner.run_sync(_teardown(), timeout=10)
     except Exception:
@@ -212,6 +232,21 @@ def shutdown() -> None:
 
 def current_runtime() -> Optional[Runtime]:
     return _runtime
+
+
+def start_client_proxy(port: int = 10001, host: Optional[str] = None):
+    """Start the Ray-Client-equivalent proxy on this driver/head
+    (reference parity: the ray:// client server). Thin clients attach
+    with init(address="client://host:port"). Returns (host, port)."""
+    rt = _runtime
+    if rt is None or rt.loop_runner is None:
+        raise RuntimeError("init() a non-local session first")
+    from .client_proxy import ClientProxyServer
+    server = ClientProxyServer(rt.client, host=host, port=port)
+    addr = server.start()
+    rt.client_proxies = getattr(rt, "client_proxies", [])
+    rt.client_proxies.append(server)
+    return addr
 
 
 def add_fake_node(num_cpus: float = 1.0,

@@ -229,9 +229,40 @@ class WorkerRuntime:
                 kwargs[k] = await self.client.aio_get(v)
         return tuple(args), kwargs
 
+    def _grace_pin_result_refs(self, value: Any) -> None:
+        """ObjectRefs embedded in a result we own must survive the window
+        between this worker dropping ITS references (the task frame dies
+        right after the push) and the consumer registering as a borrower
+        on deserialize — otherwise the owner frees the object and a later
+        get hangs/fails (the classic borrowed-refs-in-return race; the
+        reference threads borrow metadata through the task reply,
+        reference_count.h borrower bookkeeping). A 120s grace pin covers
+        the handoff; the borrower's +1 arrives long before it expires."""
+        refs = []
+
+        def walk(obj, depth=0):
+            if isinstance(obj, ObjectRef):
+                refs.append(obj.id)
+            elif depth < 2 and isinstance(obj, (list, tuple)):
+                for x in obj:
+                    walk(x, depth + 1)
+            elif depth < 2 and isinstance(obj, dict):
+                for x in obj.values():
+                    walk(x, depth + 1)
+
+        walk(value)
+        if not refs:
+            return
+        counter = self.client.ref_counter
+        for rid in refs:
+            counter.pin(rid)
+        asyncio.get_running_loop().call_later(
+            120.0, lambda: [counter.unpin(r) for r in refs])
+
     async def _push_result(self, owner_addr, object_id: str, value: Any,
                            task_id: Optional[str] = None,
                            **stream_kw) -> None:
+        self._grace_pin_result_refs(value)
         serialized = serialize(value)
         owner = self.client.pool.get(tuple(owner_addr))
         if serialized.total_size <= INLINE_OBJECT_LIMIT:
@@ -582,6 +613,7 @@ class WorkerRuntime:
         except Exception:
             await actor.admitted(caller, seq)
             return {"status": "error", "error_tb": traceback.format_exc()}
+        self._grace_pin_result_refs(result)
         serialized = serialize(result)
         if serialized.total_size <= INLINE_OBJECT_LIMIT:
             return {"status": "ok", "payload": serialized.to_flat()}

@@ -67,10 +67,16 @@ def cmd_start(args) -> None:
         from ray_tpu.dashboard import start_dashboard
         dash = start_dashboard(port=args.dashboard_port)
         dashboard_addr = f"http://127.0.0.1:{dash.port}"
+    client_addr = ""
+    if args.client_proxy_port is not None:
+        from ray_tpu._private.worker import start_client_proxy
+        chost, cport = start_client_proxy(port=args.client_proxy_port)
+        client_addr = f"client://{chost}:{cport}"
     _write_cluster_file(address, dashboard_addr, os.getpid())
     print(f"ray_tpu head started.\n  address: {address}\n"
           f"  dashboard: {dashboard_addr or '(disabled)'}\n"
-          f"Attach with ray_tpu.init(address={address!r}); stop with "
+          + (f"  client proxy: {client_addr}\n" if client_addr else "")
+          + f"Attach with ray_tpu.init(address={address!r}); stop with "
           f"`ray_tpu stop`.")
     if args.block:
         try:
@@ -255,6 +261,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--labels", default=None,
                     help="node labels as JSON")
     sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--client-proxy-port", type=int, default=None,
+                    help="serve thin clients (ray_tpu.init(address="
+                         "'client://host:port')) on this port")
     sp.add_argument("--no-dashboard", action="store_true")
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
