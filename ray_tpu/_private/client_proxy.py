@@ -80,7 +80,10 @@ class ClientProxyServer:
         self.address: Optional[Tuple[str, int]] = None
         self.loop_runner = LoopRunner()      # dedicated thread + loop
         self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="client-proxy")
+            max_workers=32, thread_name_prefix="client-proxy")
+        # content-addressed fn blobs: thin clients send each unique
+        # function once (the in-cluster function store dedupes onward)
+        self._fn_blobs: Dict[str, bytes] = {}
 
     def start(self) -> Tuple[str, int]:
         self.address = self.loop_runner.run_sync(
@@ -126,6 +129,15 @@ class ClientProxyServer:
     async def rpc_client_bye(self, session_id: str) -> None:
         self.sessions.pop(session_id, None)
 
+    async def rpc_client_touch(self, session_id: str) -> bool:
+        """Keepalive: thin clients ping every ~60s so local compute or
+        one long blocking get cannot TTL-expire the session."""
+        s = self.sessions.get(session_id)
+        if s is None:
+            return False
+        s.last_seen = time.monotonic()
+        return True
+
     # ------------------------------------------------------------ helpers
 
     def _pin(self, session: _Session, ref: ObjectRef) -> str:
@@ -154,11 +166,22 @@ class ClientProxyServer:
 
     async def rpc_client_get(self, session_id: str, ref_ids: List[str],
                              timeout: Optional[float] = None) -> bytes:
+        import asyncio
         s = self._session(session_id)
         refs = [s.refs[i] for i in ref_ids]
+
+        async def gather():
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            return await asyncio.gather(
+                *[self.inner.aio_get(r, deadline=deadline) for r in refs])
+
         try:
-            values = await self._blocking(
-                lambda: self.inner.get(refs, timeout=timeout))
+            # no executor thread held: a long get parks a coroutine on
+            # the inner client's loop, so N waiting clients cost nothing
+            values = await asyncio.wrap_future(
+                asyncio.run_coroutine_threadsafe(
+                    gather(), self.inner.loop_runner.loop))
         except Exception as e:
             # exception-type parity: ship the typed error for the thin
             # client to re-raise (the RPC layer would flatten it into a
@@ -169,6 +192,7 @@ class ClientProxyServer:
                 raise e
         # nested refs inside returned values (e.g. a task returning
         # [ray_tpu.put(x)]) must be pinned or the client cannot use them
+        s.last_seen = time.monotonic()     # long get ≠ idle session
         _walk_replace(values, lambda r: (self._pin(s, r), r)[1])
         return serialize(("ok", values)).to_flat()
 
@@ -195,9 +219,16 @@ class ClientProxyServer:
     # ------------------------------------------------------------- tasks
 
     async def rpc_client_task(self, session_id: str, fn_blob: bytes,
-                              args_blob: bytes, opts: dict):
+                              args_blob: bytes, opts: dict,
+                              fn_hash: Optional[str] = None):
         s = self._session(session_id)
         from .serialization import deserialize_code
+        if fn_blob is None:
+            fn_blob = self._fn_blobs.get(fn_hash)
+            if fn_blob is None:
+                return {"need_blob": True}
+        elif fn_hash is not None:
+            self._fn_blobs[fn_hash] = fn_blob
         fn = deserialize_code(fn_blob)
         args, kwargs = SerializedObject.from_flat(args_blob).deserialize()
         args = self._real(s, tuple(args))
@@ -207,9 +238,10 @@ class ClientProxyServer:
                 "streaming generators are not proxied; attach a driver")
         out = await self._blocking(
             lambda: self.inner.submit_task(fn, args, kwargs, opts,
-                                           fn_blob=fn_blob))
+                                           fn_blob=fn_blob,
+                                           fn_hash=fn_hash))
         refs = out if isinstance(out, list) else [out]
-        return [self._pin(s, r) for r in refs]
+        return {"refs": [self._pin(s, r) for r in refs]}
 
     async def rpc_client_create_actor(self, session_id: str,
                                       cls_blob: bytes, args_blob: bytes,
@@ -314,17 +346,39 @@ class ProxyModeClient:
         self._rpc = RpcClient(host, port)
         self.ref_counter = _ProxyRefCounter(self)
         self.is_shutdown = False
-        hello = self._call("client_hello", namespace=namespace)
+        self._sent_fn_hashes = set()
+        hello = self._call("client_hello", _rpc_timeout=30,
+                           namespace=namespace)
         self.session_id = hello["session_id"]
+        self._start_keepalive()
 
     # ------------------------------------------------------------- plumbing
 
-    def _call(self, _method: str, **kwargs):
+    def _call(self, _method: str, _rpc_timeout: Optional[float] = None,
+              **kwargs):
+        # unbounded by default: get(timeout=None) must block like a
+        # driver attach, not fail at an arbitrary RPC ceiling
         return self.loop_runner.run_sync(
-            self._rpc.call(_method, **kwargs), timeout=3600)
+            self._rpc.call(_method, **kwargs), timeout=_rpc_timeout)
 
-    def _scall(self, _method: str, **kwargs):
-        return self._call(_method, session_id=self.session_id, **kwargs)
+    def _scall(self, _method: str, _rpc_timeout: Optional[float] = None,
+               **kwargs):
+        return self._call(_method, _rpc_timeout=_rpc_timeout,
+                          session_id=self.session_id, **kwargs)
+
+    def _start_keepalive(self) -> None:
+        import asyncio
+
+        async def beat():
+            while not self.is_shutdown:
+                await asyncio.sleep(60.0)
+                try:
+                    await self._rpc.call("client_touch",
+                                         session_id=self.session_id)
+                except Exception:
+                    pass
+
+        self.loop_runner.call_soon(beat())
 
     def _release(self, ref_id: str) -> None:
         if self.is_shutdown:
@@ -397,10 +451,23 @@ class ProxyModeClient:
     def submit_task(self, fn, args, kwargs, opts, fn_blob=None,
                     fn_hash=None):
         blob = fn_blob if fn_blob is not None else serialize_code(fn)
-        ids = self._scall("client_task", fn_blob=blob,
-                          args_blob=self._args_blob(args, kwargs),
-                          opts=_plain_opts(opts))
-        refs = [self._ref(i) for i in ids]
+        if fn_hash is None:
+            import hashlib
+            fn_hash = hashlib.sha1(blob).hexdigest()
+        args_blob = self._args_blob(args, kwargs)
+        # send each unique function's blob once; afterwards only the
+        # hash crosses the wire (server replies need_blob if it lost it)
+        send_blob = fn_hash not in self._sent_fn_hashes
+        reply = self._scall("client_task",
+                            fn_blob=blob if send_blob else None,
+                            fn_hash=fn_hash, args_blob=args_blob,
+                            opts=_plain_opts(opts))
+        if isinstance(reply, dict) and reply.get("need_blob"):
+            reply = self._scall("client_task", fn_blob=blob,
+                                fn_hash=fn_hash, args_blob=args_blob,
+                                opts=_plain_opts(opts))
+        self._sent_fn_hashes.add(fn_hash)
+        refs = [self._ref(i) for i in reply["refs"]]
         return refs[0] if len(refs) == 1 else refs
 
     def create_actor(self, cls, args, kwargs, opts, cls_blob=None,

@@ -84,23 +84,19 @@ class LoopRunner:
         except RuntimeError:
             pass  # loop already closed (interpreter shutdown)
 
-    def stop(self) -> None:
-        """Stop an OWNED loop thread (no-op for external loops)."""
-        if self._thread is not None:
-            try:
-                self.loop.call_soon_threadsafe(self.loop.stop)
-            except RuntimeError:
-                pass
-
     def on_loop_thread(self) -> bool:
         try:
             return asyncio.get_running_loop() is self.loop
         except RuntimeError:
             return False
 
-    def stop(self):
+    def stop(self) -> None:
+        """Stop an OWNED loop thread (no-op for external loops)."""
         if self._thread is not None:
-            self.loop.call_soon_threadsafe(self.loop.stop)
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+            except RuntimeError:
+                return   # loop already closed (interpreter shutdown)
             self._thread.join(timeout=5)
 
 

@@ -230,19 +230,21 @@ class WorkerRuntime:
         return tuple(args), kwargs
 
     def _grace_pin_result_refs(self, value: Any) -> None:
-        """ObjectRefs embedded in a result we own must survive the window
+        """ObjectRefs embedded in a result must survive the window
         between this worker dropping ITS references (the task frame dies
-        right after the push) and the consumer registering as a borrower
-        on deserialize — otherwise the owner frees the object and a later
-        get hangs/fails (the classic borrowed-refs-in-return race; the
-        reference threads borrow metadata through the task reply,
-        reference_count.h borrower bookkeeping). A 120s grace pin covers
-        the handoff; the borrower's +1 arrives long before it expires."""
-        refs = []
+        right after the push) and the consumer registering on
+        deserialize — otherwise the object is freed underneath and a
+        later get hangs/fails (the classic borrowed-refs-in-return race;
+        the reference threads borrow metadata through the task reply,
+        reference_count.h). Holding the ObjectRef OBJECTS for a 120s
+        grace covers both owned refs (local count delays the free) and
+        borrowed pass-through refs (the -1 borrower event to the true
+        owner is deferred until these are dropped)."""
+        held = []
 
         def walk(obj, depth=0):
             if isinstance(obj, ObjectRef):
-                refs.append(obj.id)
+                held.append(obj)
             elif depth < 2 and isinstance(obj, (list, tuple)):
                 for x in obj:
                     walk(x, depth + 1)
@@ -251,13 +253,8 @@ class WorkerRuntime:
                     walk(x, depth + 1)
 
         walk(value)
-        if not refs:
-            return
-        counter = self.client.ref_counter
-        for rid in refs:
-            counter.pin(rid)
-        asyncio.get_running_loop().call_later(
-            120.0, lambda: [counter.unpin(r) for r in refs])
+        if held:
+            asyncio.get_running_loop().call_later(120.0, held.clear)
 
     async def _push_result(self, owner_addr, object_id: str, value: Any,
                            task_id: Optional[str] = None,

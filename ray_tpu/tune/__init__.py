@@ -17,7 +17,7 @@ from .search.bohb import BOHBSearch
 from .search.adapters import HyperOptSearch, OptunaSearch
 from .schedulers import (TrialScheduler, FIFOScheduler, MedianStoppingRule,
                          AsyncHyperBandScheduler, ASHAScheduler,
-                         HyperBandScheduler, PopulationBasedTraining)
+                         HyperBandScheduler, PopulationBasedTraining, PB2)
 from .trainable import Trainable, report, get_checkpoint
 from .trial import Trial
 from .tuner import ResultGrid, TuneConfig, TuneResult, Tuner, run
@@ -29,7 +29,7 @@ __all__ = [
     "BOHBSearch", "OptunaSearch", "HyperOptSearch",
     "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
     "MedianStoppingRule", "AsyncHyperBandScheduler", "ASHAScheduler",
-    "HyperBandScheduler", "PopulationBasedTraining", "Trainable", "report",
+    "HyperBandScheduler", "PopulationBasedTraining", "PB2", "Trainable", "report",
     "get_checkpoint", "Trial", "ResultGrid", "TuneConfig", "TuneResult",
     "Tuner", "run",
 ]

@@ -3,9 +3,10 @@ from .trial_scheduler import (TrialScheduler, FIFOScheduler,
 from .asha import AsyncHyperBandScheduler, ASHAScheduler
 from .hyperband import HyperBandScheduler
 from .pbt import PopulationBasedTraining
+from .pb2 import PB2
 
 __all__ = [
     "TrialScheduler", "FIFOScheduler", "MedianStoppingRule",
     "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
-    "PopulationBasedTraining", "CONTINUE", "PAUSE", "STOP",
+    "PopulationBasedTraining", "PB2", "CONTINUE", "PAUSE", "STOP",
 ]

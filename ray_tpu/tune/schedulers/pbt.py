@@ -93,9 +93,14 @@ class PopulationBasedTraining(TrialScheduler):
                                       if hasattr(trial, "tune_trials") else [])
         if trial in bottom and top:
             source = self.rng.choice(top)
-            new_config = explore(source.config, self.mutations,
-                                 self.resample_probability, self.rng)
+            new_config = self._explore_config(source.config, step)
             self.pending_exploits[trial.trial_id] = (source.trial_id,
                                                      new_config)
             self.num_perturbations += 1
         return CONTINUE
+
+    def _explore_config(self, config: Dict[str, Any],
+                        step: int) -> Dict[str, Any]:
+        """Subclass hook: PBT perturbs randomly; PB2 fits a GP bandit."""
+        return explore(config, self.mutations,
+                       self.resample_probability, self.rng)

@@ -164,3 +164,53 @@ def test_hyperband_promotes(ray_start):
         scheduler=HyperBandScheduler(max_t=9, reduction_factor=3))
     best = results.get_best_result()
     assert best.config["delta"] == 2.0
+
+
+def test_pb2_gp_directed_explore(ray_start):
+    """PB2 (reference: tune/schedulers/pb2.py): exploit configs come
+    from the GP-UCB bandit within hyperparam_bounds, not random
+    perturbation; the experiment still improves the population."""
+    from ray_tpu.tune import PB2
+
+    scheduler = PB2(
+        metric="value", mode="max", perturbation_interval=2,
+        hyperparam_bounds={"delta": (0.5, 3.0)}, seed=0)
+
+    class Capped(_StepTrainable):
+        def step(self):
+            result = super().step()
+            result["done"] = self._iteration >= 7
+            return result
+
+    tuner = Tuner(
+        Capped,
+        param_space={"delta": tune.grid_search([0.01, 0.02, 2.0, 3.0])},
+        tune_config=TuneConfig(metric="value", mode="max",
+                               max_concurrent_trials=4,
+                               scheduler=scheduler,
+                               time_budget_s=60))
+    results = tuner.fit()
+    assert scheduler.num_perturbations >= 1
+    # every exploited config stays inside the declared bounds
+    for _, (_, cfg) in list(scheduler.pending_exploits.items()):
+        assert 0.5 <= cfg["delta"] <= 3.0
+    assert results.get_best_result().metrics["value"] > 2.0
+
+
+def test_pb2_gp_math():
+    """The internal GP interpolates a smooth function and UCB prefers
+    the known-good region once data exists."""
+    import numpy as np
+    from ray_tpu.tune.schedulers.pb2 import _GP
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(size=(40, 2))
+    y = np.sin(3 * x[:, 1]) + 0.01 * rng.normal(size=40)
+    gp = _GP()
+    gp.fit(x, (y - y.mean()) / y.std())
+    q = np.array([[0.5, 0.5], [0.5, 0.52]])
+    mu, sd = gp.predict(q)
+    assert np.all(sd >= 0)
+    # interpolation: prediction close to the true (normalized) function
+    true = (np.sin(3 * q[:, 1]) - y.mean()) / y.std()
+    assert np.all(np.abs(mu - true) < 0.35), (mu, true)
