@@ -89,6 +89,17 @@ class NodeEntry:
         return max(fracs) if fracs else 0.0
 
 
+def _pick_hybrid(fitting: List["NodeEntry"]) -> "NodeEntry":
+    """Hybrid-lite placement, shared by the scheduler and the lease
+    grantor: pack onto the most-utilized node below 50% utilization,
+    else spread to the least utilized (reference:
+    src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:61)."""
+    below = [n for n in fitting if n.utilization() < 0.5]
+    if below:
+        return max(below, key=lambda n: n.utilization())
+    return min(fitting, key=lambda n: n.utilization())
+
+
 class ActorEntry:
     __slots__ = ("actor_id", "name", "namespace", "state", "addr", "node_id",
                  "worker_id", "creation_spec", "max_restarts", "restarts",
@@ -134,6 +145,8 @@ class Controller:
         self.actors: Dict[str, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}
         self.kv: Dict[str, bytes] = {}
+        # worker leases: lease_id -> (node_id, resources, worker_id)
+        self.leases: Dict[str, Tuple[str, Dict[str, float], str]] = {}
         self.subscribers: Dict[str, List[Tuple[str, int]]] = {}
         self.pending: List[dict] = []          # specs waiting for resources
         # task_id -> (node_id, resources, spec)
@@ -415,6 +428,11 @@ class Controller:
         return {"status": "queued"}
 
     async def _on_node_death(self, node_id: str) -> None:
+        # leases on the dead node are void; clients discover via
+        # ConnectionLost and fall back to the scheduled path
+        for lease_id, (nid, _req, _wid) in list(self.leases.items()):
+            if nid == node_id:
+                del self.leases[lease_id]
         # Placement groups with a bundle on the dead node become FAILED:
         # their gang guarantee is broken. Reservations on surviving nodes
         # are returned.
@@ -715,14 +733,7 @@ class Controller:
         fitting = [n for n in candidates if n.fits(req)]
         if not fitting:
             return None
-        # Hybrid-lite: pack onto the most-utilized node below 50% utilization,
-        # else spread to the least utilized (reference:
-        # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:61).
-        below = [n for n in fitting if n.utilization() < 0.5]
-        if below:
-            node = max(below, key=lambda n: n.utilization())
-        else:
-            node = min(fitting, key=lambda n: n.utilization())
+        node = _pick_hybrid(fitting)
         node.acquire(req)
         return await self._dispatch(spec, node,
                                     lambda: node.release(req))
@@ -809,6 +820,68 @@ class Controller:
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
         except Exception:
             pass
+
+    # ------------------------------------------------------------- leases
+
+    async def rpc_lease_worker(self, resources: dict,
+                               runtime_env: Optional[dict] = None) -> dict:
+        """Grant a worker lease for client-direct task submission
+        (reference parity: lease-based dispatch,
+        normal_task_submitter.h:72-140). Resources stay acquired for the
+        lease's lifetime; release_lease (or node death) returns them."""
+        req = dict(resources or {})
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and not n.draining and n.fits(req)]
+        if not candidates:
+            return {"status": "unavailable"}
+        node = _pick_hybrid(candidates)
+        # acquire BEFORE awaiting the daemon: a concurrent lease request
+        # must not pass fits() against unreserved capacity (TOCTOU)
+        node.acquire(req)
+        try:
+            reply = await self.pool.get(node.addr).call(
+                "reserve_worker", runtime_env=runtime_env)
+        except Exception:
+            node.release(req)
+            node.alive = False
+            await self._on_node_death(node.node_id)
+            return {"status": "unavailable"}
+        if reply.get("status") != "ok":
+            node.release(req)
+            return {"status": "unavailable",
+                    "error": reply.get("error")}
+        import uuid
+        lease_id = uuid.uuid4().hex
+        self.leases[lease_id] = (node.node_id, req, reply["worker_id"])
+        return {"status": "ok", "lease_id": lease_id,
+                "worker_addr": list(reply["addr"]),
+                "worker_id": reply["worker_id"],
+                "daemon_addr": list(node.addr),
+                "node_id": node.node_id}
+
+    async def rpc_release_lease(self, lease_id: str) -> None:
+        ent = self.leases.pop(lease_id, None)
+        if ent is None:
+            return
+        node_id, req, worker_id = ent
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            node.release(req)
+            try:
+                await self.pool.get(node.addr).oneway(
+                    "release_worker", worker_id=worker_id)
+            except Exception:
+                pass
+        self._sched_event.set()
+
+    async def rpc_task_event_push(self, task_id: str, name: str,
+                                  state: str, node_id: str = None) -> None:
+        """Worker-pushed task events for lease-dispatched tasks (the
+        controller never sees those specs; reference parity:
+        task_event_buffer.h workers reporting to the GCS task manager)."""
+        self._task_event(task_id, state,
+                         spec={"name": name} if name else None,
+                         node_id=node_id)
 
     async def rpc_task_finished(self, task_id: str, node_id: str) -> None:
         self._task_event(task_id, "FINISHED")

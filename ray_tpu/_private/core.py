@@ -24,7 +24,7 @@ import logging
 import os
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import state
@@ -242,6 +242,15 @@ class CoreClient:
         # issued in the same loop tick ride one controller call.
         self._submit_batch: List[Tuple[dict, asyncio.Future]] = []
         self._submit_flush_scheduled = False
+        # Worker-lease fast path (reference parity:
+        # normal_task_submitter.h:72-140 lease caching): plain CPU tasks
+        # go client->worker directly on leased workers; leases scale
+        # with backlog and idle out. key -> _LeaseGroup.
+        self._lease_groups: Dict[tuple, "_LeaseGroup"] = {}
+        self._lease_pump_tasks: set = set()
+        # key -> monotonic time before which lease requests are skipped
+        # (set on an 'unavailable' miss; survives group teardown)
+        self._lease_cooldown_until: Dict[tuple, float] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -262,6 +271,8 @@ class CoreClient:
         task = getattr(self, "_subscription_task", None)
         if task is not None:
             task.cancel()
+        for pump in list(self._lease_pump_tasks):
+            pump.cancel()
         await self.server.stop()
         await self.pool.close_all()
 
@@ -881,6 +892,118 @@ class CoreClient:
         else:
             await asyncio.shield(fut)
 
+    # --------------------------------------------------- lease fast path
+
+    MAX_LEASES_PER_KEY = 8
+    LEASE_IDLE_S = 2.0
+
+    def _lease_key(self, spec: dict) -> Optional[tuple]:
+        """Fast-path eligibility: plain CPU-only tasks with default
+        scheduling. Everything else (placement, TPU chips, runtime envs,
+        streaming, actors) takes the scheduled path."""
+        if (spec.get("num_returns") == "streaming"
+                or spec.get("is_actor_creation")
+                or spec.get("scheduling")
+                or spec.get("runtime_env")):
+            return None
+        res = spec.get("resources") or {}
+        if any(k != "CPU" for k in res):
+            return None
+        return ("cpu", float(res.get("CPU", 1.0)))
+
+    async def _submit_via_lease(self, key: tuple, spec: dict) -> None:
+        if time.monotonic() < self._lease_cooldown_until.get(key, 0.0):
+            await self._submit_spec(spec)      # capacity miss: back off
+            return
+        group = self._lease_groups.get(key)
+        if group is None:
+            group = self._lease_groups[key] = _LeaseGroup(key)
+        # flag a COPY for the wire: pending.spec (used by retries) must
+        # stay clean or a retried task would double-report through the
+        # leased-death sweep
+        wire = dict(spec)
+        wire["_leased"] = True
+        group.queue.append(wire)
+        # scale pumps with backlog, one new pump per enqueue at most
+        if (len(group.queue) > group.num_pumps
+                and group.num_pumps < self.MAX_LEASES_PER_KEY):
+            group.num_pumps += 1
+            task = asyncio.ensure_future(self._lease_pump(key, group))
+            self._lease_pump_tasks.add(task)
+            task.add_done_callback(self._lease_pump_tasks.discard)
+
+    async def _lease_pump(self, key: tuple, group: "_LeaseGroup") -> None:
+        """One pump = one lease: acquire a worker, drain the shared queue
+        serially, idle out after LEASE_IDLE_S, release."""
+        lease_id = None
+        worker = None
+        try:
+            reply = await self._controller().call(
+                "lease_worker", resources={"CPU": key[1]})
+            if reply.get("status") != "ok":
+                # no capacity for MORE leases: existing pumps (if any)
+                # keep draining; without any, fall back to the scheduler
+                if group.num_pumps == 1:
+                    self._lease_cooldown_until[key] = (
+                        time.monotonic() + 5.0)
+                    while group.queue:
+                        s = group.queue.popleft()
+                        s.pop("_leased", None)
+                        await self._submit_spec(s)
+                return
+            lease_id = reply["lease_id"]
+            worker = self.pool.get(tuple(reply["worker_addr"]))
+            daemon_addr = tuple(reply["daemon_addr"])
+            worker_id = reply["worker_id"]
+            idle_since = None
+            while True:
+                if not group.queue:
+                    now = time.monotonic()
+                    if idle_since is None:
+                        idle_since = now
+                    elif now - idle_since >= self.LEASE_IDLE_S:
+                        return
+                    await asyncio.sleep(0.05)
+                    continue
+                idle_since = None
+                spec = group.queue.popleft()
+                try:
+                    await worker.call("run_task", spec=spec)
+                except Exception:
+                    # worker/conn gone. The daemon settles the in-flight
+                    # task's failure (incl. OOM attribution) exactly
+                    # once — only resubmit if it never saw it. The
+                    # backlog flows back through the scheduled path.
+                    reported = alive = False
+                    try:
+                        fate = await self.pool.get(daemon_addr).call(
+                            "leased_worker_fate", worker_id=worker_id,
+                            task_id=spec["task_id"])
+                        reported = bool(fate.get("reported"))
+                        alive = bool(fate.get("alive"))
+                    except Exception:
+                        pass
+                    if not reported and not alive:
+                        spec.pop("_leased", None)
+                        await self._submit_spec(spec)
+                    while group.queue:
+                        s = group.queue.popleft()
+                        s.pop("_leased", None)
+                        await self._submit_spec(s)
+                    return
+        except Exception:
+            logger.exception("lease pump failed")
+        finally:
+            group.num_pumps -= 1
+            if group.num_pumps == 0 and not group.queue:
+                self._lease_groups.pop(key, None)
+            if lease_id is not None:
+                try:
+                    await self._controller().oneway(
+                        "release_lease", lease_id=lease_id)
+                except Exception:
+                    pass
+
     # ----------------------------------------------------- submit batching
 
     async def _submit_spec(self, spec: dict) -> dict:
@@ -971,7 +1094,11 @@ class CoreClient:
             try:
                 if export_hash is not None:
                     await self._ensure_fn_exported(export_hash, blob)
-                await self._submit_spec(spec)
+                key = self._lease_key(spec)
+                if key is not None:
+                    await self._submit_via_lease(key, spec)
+                else:
+                    await self._submit_spec(spec)
             except Exception as e:
                 err = TaskError(spec["name"], f"submission failed: {e!r}")
                 for rid in return_ids:
@@ -1229,6 +1356,17 @@ class CoreClient:
 
             if not self.is_shutdown:
                 self.loop_runner.call_soon(_free())
+
+
+class _LeaseGroup:
+    """Shared backlog + pump bookkeeping for one lease key."""
+
+    __slots__ = ("key", "queue", "num_pumps")
+
+    def __init__(self, key: tuple):
+        self.key = key
+        self.queue: "deque[dict]" = deque()
+        self.num_pumps = 0
 
 
 def _collect_refs(obj, out=None) -> List[ObjectRef]:

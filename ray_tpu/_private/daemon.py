@@ -52,7 +52,8 @@ def pick_worker_to_kill(workers) -> Optional["WorkerHandle"]:
     only fall back to actors (whose state dies with them) when no plain
     task worker exists."""
     tasks = [w for w in workers
-             if w.state == "busy" and w.current_task is not None]
+             if w.state in ("busy", "leased")
+             and w.current_task is not None]
     if tasks:
         retriable = [w for w in tasks
                      if (w.current_task.get("max_retries") or 0) > 0]
@@ -116,7 +117,7 @@ class WorkerHandle:
         self.addr: Optional[Tuple[str, int]] = None
         self.proc = proc
         self.pid = proc.pid
-        self.state = "starting"      # starting | idle | busy | actor | dead
+        self.state = "starting"  # starting|idle|busy|leased|lease_draining|actor|dead
         self.current_task: Optional[dict] = None
         self.actor_id: Optional[str] = None
         self.spawn_time = time.monotonic()
@@ -569,6 +570,92 @@ class NodeDaemon:
             handle.current_task = None
             self._offer_worker(handle)
 
+    async def rpc_reserve_worker(self, runtime_env: Optional[dict] = None
+                                 ) -> dict:
+        """Lease a worker to a client for direct task submission
+        (reference parity: worker leases, normal_task_submitter.h:72-140
+        — the task fast path bypasses the control plane per-task)."""
+        key = runtime_env_key(runtime_env)
+        try:
+            handle = await self._acquire_worker(key, runtime_env)
+        except Exception as e:
+            return {"status": "error", "error": repr(e)}
+        handle.state = "leased"
+        handle.current_task = None
+        return {"status": "ok", "worker_id": handle.worker_id,
+                "addr": handle.addr}
+
+    async def rpc_release_worker(self, worker_id: str) -> None:
+        handle = self.workers.get(worker_id)
+        if handle is None or handle.state != "leased":
+            return
+        if handle.current_task is not None:
+            # lease released mid-task (client->worker blip): drain —
+            # the worker returns to the pool when the task finishes
+            handle.state = "lease_draining"
+            return
+        handle.state = "idle"
+        self._offer_worker(handle)
+
+    # Leased workers self-report their current task so the OOM killer /
+    # failure attribution work exactly like daemon-dispatched tasks
+    # (reference parity: the raylet always knows its workers' tasks).
+    async def rpc_leased_task_started(self, worker_id: str,
+                                      spec: dict) -> None:
+        handle = self.workers.get(worker_id)
+        if handle is not None:
+            handle.current_task = spec
+
+    async def rpc_leased_task_done(self, worker_id: str) -> None:
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return
+        if handle.state == "leased":
+            handle.current_task = None
+        elif handle.state == "lease_draining":
+            handle.current_task = None
+            handle.state = "idle"
+            self._offer_worker(handle)
+
+    async def _settle_leased_death(self, handle: WorkerHandle) -> bool:
+        """Report a dead leased worker's in-flight task to its owner
+        EXACTLY once (fate RPC and the monitor sweep both funnel here;
+        check-and-clear on the daemon loop makes it atomic)."""
+        spec = handle.current_task
+        if spec is None or not spec.get("_leased"):
+            return False
+        handle.current_task = None
+        from ..exceptions import OutOfMemoryError
+        err = (OutOfMemoryError(handle.oom_reason)
+               if handle.oom_reason else None)
+        await self._report_failure(
+            spec, "leased worker died while running task", error=err)
+        return True
+
+    async def rpc_leased_worker_fate(self, worker_id: str,
+                                     task_id: str) -> dict:
+        """The client's lease pump asks after a connection failure:
+        'did/will you report this task?' — settles on the spot so the
+        pump never double-submits and owners never hang. A worker that
+        is still ALIVE is a transient client->worker blip: the task
+        keeps executing and its result reaches the owner directly, so
+        nothing is settled and the pump must not resubmit."""
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return {"reported": False, "alive": False}
+        dead = handle.state == "dead" or handle.proc.poll() is not None
+        if not dead:
+            return {"reported": False, "alive": True}
+        spec = handle.current_task
+        if spec is not None and spec.get("task_id") == task_id:
+            await self._settle_leased_death(handle)
+            return {"reported": True, "alive": False}
+        # current_task gone: either the sweep settled it (reported) or
+        # the worker died before leased_task_started landed — report
+        # False so the pump resubmits (at-least-once)
+        return {"reported": handle.oom_reason is not None,
+                "alive": False}
+
     async def rpc_prestart_workers(self, count: int) -> int:
         started = 0
         for _ in range(count):
@@ -958,6 +1045,7 @@ class NodeDaemon:
             await self._pump_worker_logs(controller)
             for handle in list(self.workers.values()):
                 if handle.state == "dead":
+                    await self._settle_leased_death(handle)
                     await self._pump_one_log(controller, handle,
                                              final=True)
                     self._log_offsets.pop(handle.worker_id, None)

@@ -309,6 +309,23 @@ class WorkerRuntime:
         from ..exceptions import TaskError
         loop = asyncio.get_running_loop()
         streaming = spec.get("num_returns") == "streaming"
+        if spec.get("_leased"):
+            # self-report so the daemon's OOM killer / crash attribution
+            # know what this leased worker is running (slim spec: just
+            # what _report_failure needs)
+            try:
+                await self.client.pool.get(self.daemon_addr).oneway(
+                    "leased_task_started", worker_id=self.worker_id,
+                    spec={k: spec.get(k) for k in
+                          ("task_id", "name", "owner_addr", "return_id",
+                           "return_ids", "max_retries", "_leased")})
+                await self.client.pool.get(
+                    self.client.controller_addr).oneway(
+                    "task_event_push", task_id=spec["task_id"],
+                    name=spec.get("name", ""), state="RUNNING",
+                    node_id=self.node_id)
+            except Exception:
+                pass
         try:
             self._apply_tpu_isolation(spec)
             fn = await self._load_fn(spec)
@@ -332,6 +349,17 @@ class WorkerRuntime:
                 TaskError(spec.get("name", "task"), tb),
                 task_id=spec["task_id"],
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
+            if spec.get("_leased"):
+                try:
+                    await self.client.pool.get(self.daemon_addr).oneway(
+                        "leased_task_done", worker_id=self.worker_id)
+                    await self.client.pool.get(
+                        self.client.controller_addr).oneway(
+                        "task_event_push", task_id=spec["task_id"],
+                        name=spec.get("name", ""), state="FAILED",
+                        node_id=self.node_id)
+                except Exception:
+                    pass
             return {"status": "error"}
         if streaming:
             return await self._stream_results(spec, result)
@@ -357,6 +385,20 @@ class WorkerRuntime:
         else:
             await self._push_result(spec["owner_addr"], spec["return_id"],
                                     result, task_id=spec["task_id"])
+        if spec.get("_leased"):
+            try:
+                await self.client.pool.get(self.daemon_addr).oneway(
+                    "leased_task_done", worker_id=self.worker_id)
+                # lease-dispatched: the controller never saw this spec,
+                # so the worker reports the terminal task event
+                # (reference parity: task_event_buffer.h worker->GCS)
+                await self.client.pool.get(
+                    self.client.controller_addr).oneway(
+                    "task_event_push", task_id=spec["task_id"],
+                    name=spec.get("name", ""), state="FINISHED",
+                    node_id=self.node_id)
+            except Exception:
+                pass
         return {"status": "ok"}
 
     # ---------------------------------------------------------- streaming

