@@ -1,0 +1,75 @@
+"""Scale-envelope smoke tests (reference parity: release/benchmarks —
+many_tasks / many_actors / many_pgs / single_node rows, shrunk to
+1-core-box scale). These guard against queue/accounting blowups, not
+absolute throughput."""
+
+import time
+
+import ray_tpu
+
+
+def test_many_queued_tasks_drain(ray_start):
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    n = 2000
+    t0 = time.time()
+    refs = [nop.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.time() - t0
+    assert out == list(range(n))
+    assert dt < 300, f"{n} tasks took {dt:.0f}s"
+    # resource accounting returned to zero after the storm
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if (ray_tpu.available_resources().get("CPU")
+                == ray_tpu.cluster_resources().get("CPU")):
+            break
+        time.sleep(0.25)
+    assert (ray_tpu.available_resources().get("CPU")
+            == ray_tpu.cluster_resources().get("CPU"))
+
+
+def test_many_actors_lifecycle(ray_start):
+    @ray_tpu.remote
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    n = 40
+    actors = [A.options(num_cpus=0).remote(i) for i in range(n)]
+    assert ray_tpu.get([a.who.remote() for a in actors],
+                       timeout=300) == list(range(n))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_many_placement_groups(ray_start):
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pgs = []
+    for _ in range(100):
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        assert pg.ready(timeout=60)
+        pgs.append(pg)
+    for pg in pgs:
+        remove_placement_group(pg)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if (ray_tpu.available_resources().get("CPU")
+                == ray_tpu.cluster_resources().get("CPU")):
+            break
+        time.sleep(0.25)
+    assert (ray_tpu.available_resources().get("CPU")
+            == ray_tpu.cluster_resources().get("CPU"))
+
+
+def test_many_objects_put_get(ray_start):
+    refs = [ray_tpu.put(bytes([i % 256]) * 100) for i in range(1000)]
+    values = ray_tpu.get(refs, timeout=120)
+    assert all(values[i][0] == i % 256 for i in range(1000))
