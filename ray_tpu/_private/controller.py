@@ -145,8 +145,9 @@ class Controller:
         self.actors: Dict[str, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}
         self.kv: Dict[str, bytes] = {}
-        # worker leases: lease_id -> (node_id, resources, worker_id)
-        self.leases: Dict[str, Tuple[str, Dict[str, float], str]] = {}
+        # worker leases: lease_id -> {node_id, req, worker_id,
+        #                             owner_addr, granted_at}
+        self.leases: Dict[str, dict] = {}
         self.subscribers: Dict[str, List[Tuple[str, int]]] = {}
         self.pending: List[dict] = []          # specs waiting for resources
         # task_id -> (node_id, resources, spec)
@@ -430,8 +431,8 @@ class Controller:
     async def _on_node_death(self, node_id: str) -> None:
         # leases on the dead node are void; clients discover via
         # ConnectionLost and fall back to the scheduled path
-        for lease_id, (nid, _req, _wid) in list(self.leases.items()):
-            if nid == node_id:
+        for lease_id, lease in list(self.leases.items()):
+            if lease["node_id"] == node_id:
                 del self.leases[lease_id]
         # Placement groups with a bundle on the dead node become FAILED:
         # their gang guarantee is broken. Reservations on surviving nodes
@@ -476,6 +477,10 @@ class Controller:
         daemon, and killing its actors would be an unforced error)."""
         while not self._closed:
             await asyncio.sleep(2.0)
+            try:
+                await self._reap_dead_client_leases()
+            except Exception:
+                logger.exception("lease reap failed")
             now = time.monotonic()
             stale = [n for n in self.nodes.values()
                      if n.alive and now - n.last_heartbeat
@@ -824,7 +829,8 @@ class Controller:
     # ------------------------------------------------------------- leases
 
     async def rpc_lease_worker(self, resources: dict,
-                               runtime_env: Optional[dict] = None) -> dict:
+                               runtime_env: Optional[dict] = None,
+                               owner_addr=None) -> dict:
         """Grant a worker lease for client-direct task submission
         (reference parity: lease-based dispatch,
         normal_task_submitter.h:72-140). Resources stay acquired for the
@@ -852,7 +858,12 @@ class Controller:
                     "error": reply.get("error")}
         import uuid
         lease_id = uuid.uuid4().hex
-        self.leases[lease_id] = (node.node_id, req, reply["worker_id"])
+        self.leases[lease_id] = {
+            "node_id": node.node_id, "req": req,
+            "worker_id": reply["worker_id"],
+            "owner_addr": tuple(owner_addr) if owner_addr else None,
+            "granted_at": time.monotonic(),
+        }
         return {"status": "ok", "lease_id": lease_id,
                 "worker_addr": list(reply["addr"]),
                 "worker_id": reply["worker_id"],
@@ -860,19 +871,78 @@ class Controller:
                 "node_id": node.node_id}
 
     async def rpc_release_lease(self, lease_id: str) -> None:
+        await self._release_lease(lease_id, terminate=False)
+
+    async def _release_lease(self, lease_id: str,
+                             terminate: bool = False) -> None:
+        """terminate=True kills the leased worker instead of re-pooling
+        it: used when the OWNER (not the client's pump) initiates the
+        release, so a still-alive pump can never race a daemon dispatch
+        onto the same worker — its next run_task fails cleanly and falls
+        back through the fate RPC."""
         ent = self.leases.pop(lease_id, None)
         if ent is None:
             return
-        node_id, req, worker_id = ent
-        node = self.nodes.get(node_id)
+        node = self.nodes.get(ent["node_id"])
         if node is not None and node.alive:
-            node.release(req)
+            node.release(ent["req"])
             try:
                 await self.pool.get(node.addr).oneway(
-                    "release_worker", worker_id=worker_id)
+                    "destroy_worker" if terminate else "release_worker",
+                    worker_id=ent["worker_id"])
             except Exception:
                 pass
         self._sched_event.set()
+
+    LEASE_PROBE_AGE_S = 10.0
+    LEASE_RECLAIM_SCORE = 4    # dead probe = +2, slow probe = +1
+
+    async def _reap_dead_client_leases(self) -> None:
+        """A client that crashed (or whose pump teardown lost the
+        release_lease) must not pin CPU + a 'leased' worker forever
+        (reference parity: leased workers are returned when the owning
+        core worker dies, normal_task_submitter.h). Probe owners of
+        mature leases and reclaim on accumulated evidence: a REFUSED
+        connection means the process is gone (+2 per round — reclaim
+        after 2 rounds, ~12 s), while a TIMEOUT may just be a
+        GIL-starved-but-alive driver loop (+1 — reclaim only after ~4
+        unresponsive rounds, ~28 s). Reclaim kills the worker (see
+        _release_lease) so a zombie pump can never double-dispatch."""
+        now = time.monotonic()
+        mature = [(lid, l) for lid, l in self.leases.items()
+                  if l["owner_addr"] is not None
+                  and now - l["granted_at"] > self.LEASE_PROBE_AGE_S]
+        if not mature:
+            return
+        owners = {l["owner_addr"] for _, l in mature}
+        verdict: Dict[tuple, str] = {}
+
+        async def _probe(addr: tuple) -> None:
+            try:
+                await asyncio.wait_for(
+                    self.pool.get(addr).call("ping"), timeout=5.0)
+                verdict[addr] = "ok"
+            except (ConnectionError, OSError):
+                verdict[addr] = "dead"      # nothing listening: definitive
+            except Exception:
+                verdict[addr] = "slow"      # hang/timeout: ambiguous
+
+        await asyncio.gather(*(_probe(a) for a in owners))
+        for lease_id, lease in mature:
+            v = verdict.get(lease["owner_addr"], "ok")
+            if v == "ok":
+                lease["reclaim_score"] = 0
+                continue
+            lease["reclaim_score"] = (
+                lease.get("reclaim_score", 0) + (2 if v == "dead" else 1))
+            if lease["reclaim_score"] < self.LEASE_RECLAIM_SCORE:
+                continue
+            logger.warning(
+                "reclaiming lease %s: owner %s unreachable "
+                "(score %d, last probe %s)",
+                lease_id[:8], lease["owner_addr"],
+                lease["reclaim_score"], v)
+            await self._release_lease(lease_id, terminate=True)
 
     async def rpc_task_event_push(self, task_id: str, name: str,
                                   state: str, node_id: str = None) -> None:

@@ -271,8 +271,13 @@ class CoreClient:
         task = getattr(self, "_subscription_task", None)
         if task is not None:
             task.cancel()
-        for pump in list(self._lease_pump_tasks):
+        pumps = list(self._lease_pump_tasks)
+        for pump in pumps:
             pump.cancel()
+        if pumps:
+            # Reap them: a cancelled-but-unawaited task logs
+            # "Task was destroyed but it is pending" at loop close.
+            await asyncio.gather(*pumps, return_exceptions=True)
         await self.server.stop()
         await self.pool.close_all()
 
@@ -932,6 +937,26 @@ class CoreClient:
             self._lease_pump_tasks.add(task)
             task.add_done_callback(self._lease_pump_tasks.discard)
 
+    async def _resubmit_scheduled(self, spec: dict) -> None:
+        """Send a (possibly lease-flagged) spec through the scheduled path.
+        On failure the owners' refs are failed rather than stranded."""
+        spec.pop("_leased", None)
+        try:
+            await self._submit_spec(spec)
+        except Exception as e:
+            err = TaskError(spec.get("name", "task"),
+                            f"submission failed: {e!r}")
+            for rid in spec.get("return_ids") or [spec["return_id"]]:
+                self.memory_store.put_error(rid, err)
+                stream = self._streams.get(rid)
+                if stream is not None:
+                    stream.fail(err)
+            self._unpin_args(self._pending_tasks.pop(spec["task_id"], None))
+
+    async def _drain_lease_queue(self, group: "_LeaseGroup") -> None:
+        while group.queue:
+            await self._resubmit_scheduled(group.queue.popleft())
+
     async def _lease_pump(self, key: tuple, group: "_LeaseGroup") -> None:
         """One pump = one lease: acquire a worker, drain the shared queue
         serially, idle out after LEASE_IDLE_S, release."""
@@ -939,17 +964,15 @@ class CoreClient:
         worker = None
         try:
             reply = await self._controller().call(
-                "lease_worker", resources={"CPU": key[1]})
+                "lease_worker", resources={"CPU": key[1]},
+                owner_addr=list(self.address))
             if reply.get("status") != "ok":
                 # no capacity for MORE leases: existing pumps (if any)
                 # keep draining; without any, fall back to the scheduler
                 if group.num_pumps == 1:
                     self._lease_cooldown_until[key] = (
                         time.monotonic() + 5.0)
-                    while group.queue:
-                        s = group.queue.popleft()
-                        s.pop("_leased", None)
-                        await self._submit_spec(s)
+                    await self._drain_lease_queue(group)
                 return
             lease_id = reply["lease_id"]
             worker = self.pool.get(tuple(reply["worker_addr"]))
@@ -984,15 +1007,15 @@ class CoreClient:
                     except Exception:
                         pass
                     if not reported and not alive:
-                        spec.pop("_leased", None)
-                        await self._submit_spec(spec)
-                    while group.queue:
-                        s = group.queue.popleft()
-                        s.pop("_leased", None)
-                        await self._submit_spec(s)
+                        await self._resubmit_scheduled(spec)
+                    await self._drain_lease_queue(group)
                     return
         except Exception:
             logger.exception("lease pump failed")
+            # Never strand the backlog (a stranded spec hangs its owner's
+            # get() forever): push everything queued back through the
+            # scheduled path, failing the owners' refs as a last resort.
+            await self._drain_lease_queue(group)
         finally:
             group.num_pumps -= 1
             if group.num_pumps == 0 and not group.queue:

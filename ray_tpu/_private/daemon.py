@@ -77,17 +77,39 @@ def runtime_env_key(runtime_env: Optional[dict]) -> str:
 
 class _ForkedProc:
     """Popen-like shim for zygote-forked workers. They are the ZYGOTE's
-    children (it reaps them), so poll() probes with signal 0 and the
-    exact exit code is unknowable (-1 once gone). kill() targets the
-    process group — the child setsid()s, so pgid == pid."""
+    children (it reaps them), so poll() re-verifies process identity and
+    the exact exit code is unknowable (-1 once gone). kill() targets the
+    process group — the child setsid()s, so pgid == pid.
 
-    def __init__(self, pid: int):
+    Identity: the zygote is spawned with a unique RAY_TPU_ZYGOTE_TAG env
+    var. fork() inherits the exec-time environment, so every forked
+    worker's /proc/<pid>/environ carries the tag while a recycled pid
+    from an unrelated process does not — kill()/poll() check it before
+    acting on the raw pid (an exit notice can be lost if the zygote dies
+    before emitting it, and killing a reused pid would kill an innocent
+    process group)."""
+
+    def __init__(self, pid: int, tag: str = ""):
         self.pid = pid
+        self.tag = tag
         self.returncode: Optional[int] = None
+
+    def _is_ours(self) -> bool:
+        """True iff self.pid still names OUR forked worker."""
+        if not self.tag:
+            return True       # no tag (legacy): trust the exit notices
+        try:
+            with open(f"/proc/{self.pid}/environ", "rb") as f:
+                return self.tag.encode() in f.read()
+        except OSError:
+            return False      # gone, or not ours to inspect
 
     def kill(self) -> None:
         if self.returncode is not None:
             return   # already reaped: the pid may belong to someone else
+        if not self._is_ours():
+            self.returncode = -1
+            return
         import signal as _signal
         for target in (lambda: os.killpg(self.pid, _signal.SIGKILL),
                        lambda: os.kill(self.pid, _signal.SIGKILL)):
@@ -100,17 +122,16 @@ class _ForkedProc:
     def poll(self) -> Optional[int]:
         if self.returncode is not None:
             return self.returncode
-        try:
-            os.kill(self.pid, 0)
-            return None
-        except OSError:
+        if not self._is_ours():
             self.returncode = -1
             return -1
+        return None
 
 
 class WorkerHandle:
     __slots__ = ("worker_id", "addr", "pid", "proc", "state", "current_task",
-                 "actor_id", "spawn_time", "env_key", "oom_reason")
+                 "actor_id", "spawn_time", "env_key", "oom_reason",
+                 "last_settled_task")
 
     def __init__(self, worker_id: str, proc, env_key: str = ""):
         self.worker_id = worker_id
@@ -126,6 +147,10 @@ class WorkerHandle:
         # crash-report path then reports OutOfMemoryError ONCE instead of
         # a second generic crash
         self.oom_reason: Optional[str] = None
+        # task_id whose failure _settle_leased_death already reported to
+        # its owner — the fate RPC answers reported=True for it so the
+        # lease pump never resubmits an already-settled task
+        self.last_settled_task: Optional[str] = None
 
 
 class NodeDaemon:
@@ -196,6 +221,7 @@ class NodeDaemon:
         # Popen per worker. Replies route by worker_id; child exits are
         # pushed by the zygote's reaper (no pid-probe races).
         self._zygote = None
+        self._zygote_tag = ""
         self._zygote_lock = asyncio.Lock()
         self._zygote_reader_task: Optional[asyncio.Task] = None
         self._zygote_replies: Dict[str, asyncio.Future] = {}
@@ -355,6 +381,11 @@ class NodeDaemon:
             env = dict(os.environ)
             env.update(self.worker_env)
             env["RAY_TPU_SESSION"] = self.session_name
+            # identity tag inherited (in /proc/<pid>/environ) by every
+            # forked worker — see _ForkedProc._is_ours
+            import uuid as _uuid
+            self._zygote_tag = f"RAY_TPU_ZYGOTE_TAG={_uuid.uuid4().hex}"
+            env["RAY_TPU_ZYGOTE_TAG"] = self._zygote_tag.split("=", 1)[1]
             env["PYTHONPATH"] = self._worker_pythonpath(
                 [], env.get("PYTHONPATH"))
             zlog = open(os.path.join(self.temp_dir, "logs",
@@ -431,7 +462,8 @@ class NodeDaemon:
             reply = await asyncio.wait_for(fut, 90.0)
         finally:
             self._zygote_replies.pop(worker_id, None)
-        proc = _ForkedProc(int(reply["pid"]))
+        proc = _ForkedProc(int(reply["pid"]),
+                           tag=getattr(self, "_zygote_tag", ""))
         early = self._early_exits.pop(proc.pid, None)
         if early is not None:
             proc.returncode = early
@@ -556,6 +588,12 @@ class NodeDaemon:
     def _offer_worker(self, handle: WorkerHandle) -> None:
         """Hand an idle worker to the longest-waiting same-env task, else
         pool it under its env key."""
+        if handle.state == "dead" or handle.proc.poll() is not None:
+            # e.g. a lease released right after its worker died, before
+            # the monitor sweep noticed: never offer a corpse — a task
+            # dispatched to it burns a retry on ConnectionRefused. The
+            # sweep settles the death and removes the handle.
+            return
         waiters = self._worker_waiters.setdefault(handle.env_key, deque())
         while waiters:
             fut = waiters.popleft()
@@ -584,6 +622,19 @@ class NodeDaemon:
         handle.current_task = None
         return {"status": "ok", "worker_id": handle.worker_id,
                 "addr": handle.addr}
+
+    async def rpc_destroy_worker(self, worker_id: str) -> None:
+        """Kill a worker outright (controller-initiated lease reclaim:
+        the owner vanished, so the worker must not be re-pooled where a
+        zombie pump could still reach it). The monitor sweep settles any
+        in-flight task and removes the handle."""
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return
+        try:
+            handle.proc.kill()
+        except Exception:
+            pass
 
     async def rpc_release_worker(self, worker_id: str) -> None:
         handle = self.workers.get(worker_id)
@@ -625,6 +676,7 @@ class NodeDaemon:
         if spec is None or not spec.get("_leased"):
             return False
         handle.current_task = None
+        handle.last_settled_task = spec.get("task_id")
         from ..exceptions import OutOfMemoryError
         err = (OutOfMemoryError(handle.oom_reason)
                if handle.oom_reason else None)
@@ -650,10 +702,11 @@ class NodeDaemon:
         if spec is not None and spec.get("task_id") == task_id:
             await self._settle_leased_death(handle)
             return {"reported": True, "alive": False}
-        # current_task gone: either the sweep settled it (reported) or
-        # the worker died before leased_task_started landed — report
-        # False so the pump resubmits (at-least-once)
-        return {"reported": handle.oom_reason is not None,
+        # current_task gone: either the sweep already settled THIS task
+        # (reported=True — resubmitting would break at-most-once and race
+        # the owner-side retry) or the worker died before
+        # leased_task_started landed (reported=False: pump resubmits).
+        return {"reported": handle.last_settled_task == task_id,
                 "alive": False}
 
     async def rpc_prestart_workers(self, count: int) -> int:

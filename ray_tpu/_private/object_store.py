@@ -105,13 +105,43 @@ def segment_name(session_name: str, object_id: str) -> str:
     return f"rtpu_{session_name[:8]}_{digest}"
 
 
+class _ViewTolerantSharedMemory(shared_memory.SharedMemory):
+    """SharedMemory whose teardown tolerates live zero-copy views.
+
+    Readers deserialize directly out of the mapping (numpy arrays view
+    shm.buf), so at GC time the handle can be collected while exported
+    views still exist — stock close() then raises "BufferError: cannot
+    close exported pointers exist". Skipping the eager close is safe:
+    the OS mapping is released once the mmap object and every view into
+    it are collected.
+    """
+
+    def close(self):
+        try:
+            super().close()
+        except BufferError:
+            # Views still alive: the mmap must be left to the GC, but the
+            # fd is released NOW — mmap holds its own dup of it, so
+            # skipping os.close here would leak one fd per segment until
+            # EMFILE takes down the process's sockets.
+            self._buf = None
+            self._mmap = None
+            fd = getattr(self, "_fd", -1)
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                self._fd = -1
+
+
 def create_untracked_shm(name: str, size: int) -> shared_memory.SharedMemory:
     """Create a shm segment not owned by this process's resource tracker.
 
     Workers create segments but the node daemon owns their lifecycle; without
     unregistering, a worker exiting would unlink segments that must outlive it.
     """
-    shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+    shm = _ViewTolerantSharedMemory(name=name, create=True, size=size)
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
     except Exception:
@@ -138,7 +168,7 @@ def _unlink_shm(name: str) -> None:
 
 
 def attach_shm(name: str) -> shared_memory.SharedMemory:
-    shm = shared_memory.SharedMemory(name=name)
+    shm = _ViewTolerantSharedMemory(name=name)
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
     except Exception:

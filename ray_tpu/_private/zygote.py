@@ -27,7 +27,6 @@ import json
 import os
 import signal
 import sys
-import threading
 
 
 def _emit(out_fd: int, msg: dict) -> None:
@@ -47,9 +46,13 @@ def zygote_main() -> None:
     devnull = os.open(os.devnull, os.O_WRONLY)
     os.dup2(devnull, 1)
 
-    # Reap forked children in a blocking thread (no zombies; the daemon
-    # cannot reap them — they are OUR children) and push exit notices.
-    threading.Thread(target=_reaper, args=(out_fd,), daemon=True).start()
+    # Reap forked children ON THE MAIN THREAD via SIGCHLD (no zombies;
+    # the daemon cannot reap them — they are OUR children). Python runs
+    # signal handlers between bytecodes on the main thread, so a sweep
+    # can never overlap a fork: the process stays single-threaded and
+    # the fork-safety claim in the module docstring holds. PEP 475
+    # transparently restarts the interrupted stdin read.
+    signal.signal(signal.SIGCHLD, lambda _sig, _frm: _reap_sweep(out_fd))
 
     protocol_fds = [stdin.fileno(), out_fd, devnull]
     for line in stdin:
@@ -63,15 +66,15 @@ def zygote_main() -> None:
         _emit(out_fd, {"worker_id": req["worker_id"], "pid": pid})
 
 
-def _reaper(out_fd: int) -> None:
-    import time
+def _reap_sweep(out_fd: int) -> None:
+    """SIGCHLD handler body: drain every exited child (signals coalesce,
+    so one delivery may cover several exits) and push exit notices."""
     while True:
         try:
-            pid, status = os.waitpid(-1, 0)
-        except ChildProcessError:
-            time.sleep(0.2)
-            continue
-        except Exception:
+            pid, status = os.waitpid(-1, os.WNOHANG)
+        except (ChildProcessError, OSError):
+            return
+        if pid == 0:
             return
         code = (os.waitstatus_to_exitcode(status)
                 if hasattr(os, "waitstatus_to_exitcode") else -1)
