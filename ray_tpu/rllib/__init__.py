@@ -10,13 +10,20 @@ from .algorithms.appo import APPO, APPOConfig
 from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.impala import IMPALA, IMPALAConfig
+from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.sac import SAC, SACConfig
 from .core.learner import Learner, LearnerGroup
+from .core.multi_rl_module import MultiRLModule
 from .core.rl_module import DefaultRLModule, RLModule
 from .env.env_runner import SingleAgentEnvRunner
 from .env.env_runner_group import EnvRunnerGroup
 from .env.jax_env import CartPole, EnvSpec, JaxEnv, Pendulum, register_env
+from .env.multi_agent_env import (DualCartPole, MultiAgentJaxEnv,
+                                  RockPaperScissors,
+                                  register_multi_agent_env)
+from .env.multi_agent_env_runner import (MultiAgentEnvRunner,
+                                         MultiAgentEnvRunnerGroup)
 from .offline import (BC, BCConfig, MARWIL, MARWILConfig, OfflineData,
                       record_samples)
 from .utils.replay_buffers import ReplayBuffer
@@ -30,4 +37,8 @@ __all__ = [
     "Learner", "LearnerGroup", "RLModule",
     "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",
     "JaxEnv", "CartPole", "Pendulum", "EnvSpec", "register_env",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiRLModule",
+    "MultiAgentJaxEnv", "DualCartPole", "RockPaperScissors",
+    "register_multi_agent_env", "MultiAgentEnvRunner",
+    "MultiAgentEnvRunnerGroup",
 ]

@@ -1,0 +1,68 @@
+"""MultiRLModule: a dict of RLModules keyed by module id.
+
+Reference parity: rllib/core/rl_module/multi_rl_module.py (MultiRLModule
+holds sub-RLModules; get_module / add_module / params-per-module). The
+TPU-native shape keeps it functional: params are a plain
+{module_id: pytree} dict, so the whole thing jits and shards like any
+other pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+from .rl_module import RLModule, build_module
+
+
+class MultiRLModule:
+    """Container of per-policy modules. Stateless; params live outside."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self._modules = dict(modules)
+
+    @classmethod
+    def from_specs(cls, specs: Dict[str, Any],
+                   module_classes: Optional[Dict[str, type]] = None,
+                   model_configs: Optional[Dict[str, dict]] = None
+                   ) -> "MultiRLModule":
+        module_classes = module_classes or {}
+        model_configs = model_configs or {}
+        return cls({
+            mid: build_module(spec, module_classes.get(mid),
+                              model_configs.get(mid))
+            for mid, spec in specs.items()})
+
+    @property
+    def module_ids(self):
+        return tuple(self._modules)
+
+    def get_module(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def __contains__(self, module_id: str) -> bool:
+        return module_id in self._modules
+
+    def add_module(self, module_id: str, module: RLModule) -> None:
+        self._modules[module_id] = module
+
+    def init(self, key) -> Dict[str, Any]:
+        keys = jax.random.split(key, len(self._modules))
+        return {mid: m.init(k)
+                for (mid, m), k in zip(sorted(self._modules.items()), keys)}
+
+    # per-module forward_* (params is the {module_id: pytree} dict)
+    def forward_exploration(self, module_id: str, params, obs, key):
+        return self._modules[module_id].forward_exploration(
+            params[module_id], obs, key)
+
+    def forward_inference(self, module_id: str, params, obs):
+        return self._modules[module_id].forward_inference(
+            params[module_id], obs)
+
+    def forward_train(self, module_id: str, params, obs):
+        return self._modules[module_id].forward_train(params[module_id], obs)

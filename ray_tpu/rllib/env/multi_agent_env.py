@@ -1,0 +1,147 @@
+"""Pure-functional multi-agent JAX environments.
+
+Reference parity: rllib/env/multi_agent_env.py:32 (MultiAgentEnv — dict
+obs/action/reward spaces keyed by agent id). TPU-native inversion, same
+as jax_env.py: the env is a pure function of (state, action_dict), so a
+multi-agent rollout — every policy's forward, the joint physics, the
+per-agent bookkeeping — compiles into ONE `lax.scan` program.
+
+Design deltas from the reference (documented, deliberate):
+  * Simultaneous-move, static agent set. The reference supports agents
+    appearing/disappearing mid-episode (dict obs may omit agents per
+    step); that shape is dynamic and defeats XLA. Turn-based games are
+    expressed by masking (an agent whose turn it isn't receives reward 0
+    and its action is ignored).
+  * Episode termination is env-level (`done`), shared by all agents —
+    the common case in the reference's own multi-agent examples.
+
+Env protocol:
+  agents: tuple of agent-id strings (static)
+  specs:  {agent_id: EnvSpec}
+  reset(key) -> (state, obs_dict)
+  step(state, action_dict, key) -> (state, obs_dict, reward_dict, done)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .jax_env import CartPole, EnvSpec
+
+
+class MultiAgentJaxEnv:
+    """Base class; subclasses are stateless — state is in the pytree."""
+
+    agents: Tuple[str, ...]
+    specs: Dict[str, EnvSpec]
+
+    def reset(self, key):
+        raise NotImplementedError
+
+    def step(self, state, actions: Dict[str, jnp.ndarray], key):
+        raise NotImplementedError
+
+
+class DualCartPole(MultiAgentJaxEnv):
+    """Two independent cart-poles, one per agent, in a shared episode.
+
+    The episode ends when EITHER pole falls (or at truncation), so each
+    agent's return depends on both policies — the simplest env where
+    "both policies improving" is observable per agent. Physics are
+    exactly jax_env.CartPole's.
+    """
+
+    def __init__(self, max_episode_steps: int = 200):
+        self._cart = CartPole(max_episode_steps=max_episode_steps)
+        self.max_episode_steps = max_episode_steps
+        self.agents = ("cart_0", "cart_1")
+        spec = EnvSpec(obs_dim=4, num_actions=2,
+                       max_episode_steps=max_episode_steps)
+        self.specs = {aid: spec for aid in self.agents}
+
+    def reset(self, key):
+        k0, k1 = jax.random.split(key)
+        (s0, _), obs0 = self._cart.reset(k0)
+        (s1, _), obs1 = self._cart.reset(k1)
+        state = (s0, s1, jnp.zeros((), jnp.int32))
+        return state, {"cart_0": obs0, "cart_1": obs1}
+
+    def step(self, state, actions, key):
+        del key
+        s0, s1, t = state
+        # reuse the single-cart physics; its step tracks its own t — feed
+        # zero and keep the joint clock here
+        (s0n, _), obs0, _, d0 = self._cart.step(
+            (s0, jnp.zeros((), jnp.int32)), actions["cart_0"], None)
+        (s1n, _), obs1, _, d1 = self._cart.step(
+            (s1, jnp.zeros((), jnp.int32)), actions["cart_1"], None)
+        t2 = t + 1
+        done = d0 | d1 | (t2 >= self.max_episode_steps)
+        one = jnp.float32(1.0)
+        return ((s0n, s1n, t2),
+                {"cart_0": obs0, "cart_1": obs1},
+                {"cart_0": one, "cart_1": one},
+                done)
+
+
+class RockPaperScissors(MultiAgentJaxEnv):
+    """Iterated rock-paper-scissors, zero-sum, two agents.
+
+    Obs is the one-hot of the opponent's previous move (zeros on the
+    first step). Good for exercising competitive two-policy mechanics:
+    rewards sum to zero by construction.
+    """
+
+    def __init__(self, episode_len: int = 10):
+        self.episode_len = episode_len
+        self.agents = ("player_0", "player_1")
+        spec = EnvSpec(obs_dim=3, num_actions=3,
+                       max_episode_steps=episode_len)
+        self.specs = {aid: spec for aid in self.agents}
+
+    def reset(self, key):
+        del key
+        state = jnp.zeros((), jnp.int32)
+        obs = jnp.zeros((3,), jnp.float32)
+        return state, {"player_0": obs, "player_1": obs}
+
+    def step(self, state, actions, key):
+        del key
+        a0, a1 = actions["player_0"], actions["player_1"]
+        # 0 beats 2, 1 beats 0, 2 beats 1 (rock/paper/scissors)
+        win0 = ((a0 - a1) % 3) == 1
+        win1 = ((a1 - a0) % 3) == 1
+        r0 = jnp.where(win0, 1.0, jnp.where(win1, -1.0, 0.0))
+        t2 = state + 1
+        obs = {"player_0": jax.nn.one_hot(a1, 3),
+               "player_1": jax.nn.one_hot(a0, 3)}
+        return t2, obs, {"player_0": r0, "player_1": -r0}, (
+            t2 >= self.episode_len)
+
+
+_MA_ENV_REGISTRY: Dict[str, Callable[[], MultiAgentJaxEnv]] = {
+    "DualCartPole": DualCartPole,
+    "RockPaperScissors": RockPaperScissors,
+}
+
+
+def register_multi_agent_env(name: str,
+                             factory: Callable[[], MultiAgentJaxEnv]) -> None:
+    _MA_ENV_REGISTRY[name] = factory
+
+
+def make_multi_agent_env(name_or_env) -> MultiAgentJaxEnv:
+    if isinstance(name_or_env, MultiAgentJaxEnv):
+        return name_or_env
+    if isinstance(name_or_env, str):
+        if name_or_env not in _MA_ENV_REGISTRY:
+            raise ValueError(
+                f"unknown multi-agent env {name_or_env!r}; registered: "
+                f"{sorted(_MA_ENV_REGISTRY)}")
+        return _MA_ENV_REGISTRY[name_or_env]()
+    if callable(name_or_env):
+        return name_or_env()
+    raise TypeError(f"cannot build multi-agent env from {name_or_env!r}")

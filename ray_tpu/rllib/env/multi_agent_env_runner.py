@@ -1,0 +1,277 @@
+"""MultiAgentEnvRunner: compiled multi-agent rollouts.
+
+Reference parity: rllib/env/multi_agent_env_runner.py:67 (sample over a
+MultiAgentEnv with per-agent episodes and a policy-mapping fn) and
+multi_agent_episode.py. TPU-native inversion: agents are static, so the
+per-agent policy forwards unroll at trace time and the whole joint
+rollout is one `lax.scan` under jit.
+
+Policy mapping: the reference's `policy_mapping_fn(agent_id, episode)`
+may vary per episode; a compiled rollout needs it static, so the fn is
+evaluated ONCE per agent at construction (self-play = map every agent to
+the same module id). This covers the reference's tuned multi-agent
+examples, which all use episode-independent mappings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+
+from .multi_agent_env import MultiAgentJaxEnv, make_multi_agent_env
+from ..core.multi_rl_module import MultiRLModule
+
+
+def call_mapping_fn(fn: Callable, agent_id: str) -> str:
+    """Evaluate a policy-mapping fn, tolerating the reference's 2-arg
+    signature fn(agent_id, episode, **kw)."""
+    try:
+        return str(fn(agent_id))
+    except TypeError:
+        return str(fn(agent_id, None))
+
+
+class MultiAgentEnvRunner:
+    """Samples {module_id: [T, B_mod, ...]} batches from a multi-agent
+    env. Streams of agents mapped to the same module are concatenated
+    along the env axis, so each module's learner sees one batch."""
+
+    def __init__(self, env, policy_mapping_fn: Callable[[str], str],
+                 num_envs: int = 8, rollout_length: int = 128,
+                 seed: int = 0,
+                 module_classes: Optional[Dict[str, type]] = None,
+                 model_configs: Optional[Dict[str, dict]] = None):
+        self.env: MultiAgentJaxEnv = make_multi_agent_env(env)
+        self.agents = tuple(self.env.agents)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        # static mapping (see module docstring)
+        self.mapping: Dict[str, str] = {
+            aid: call_mapping_fn(policy_mapping_fn, aid)
+            for aid in self.agents}
+        module_specs: Dict[str, Any] = {}
+        for aid in self.agents:
+            mid = self.mapping[aid]
+            spec = self.env.specs[aid]
+            if mid in module_specs and module_specs[mid] != spec:
+                raise ValueError(
+                    f"agents mapped to module {mid!r} have different "
+                    f"EnvSpecs; use separate modules")
+            module_specs[mid] = spec
+        self.module_specs = module_specs
+        self.multi_module = MultiRLModule.from_specs(
+            module_specs, module_classes, model_configs)
+        self._key = jax.random.PRNGKey(seed)
+        self._key, init_key, reset_key = jax.random.split(self._key, 3)
+        self.params = self.multi_module.init(init_key)
+        self._env_state, self._obs = jax.vmap(self.env.reset)(
+            jax.random.split(reset_key, num_envs))
+        self._sample_jit = jax.jit(self._build_sample())
+
+    # -- compiled rollout ---------------------------------------------------
+    def _build_sample(self):
+        env, mm = self.env, self.multi_module
+        agents, mapping = self.agents, self.mapping
+        B, T = self.num_envs, self.rollout_length
+
+        def one_step(carry, step_key):
+            env_state, obs, ep_ret, ep_len, params = carry
+            act_key, env_keys, reset_keys = (
+                step_key[0], step_key[1], step_key[2])
+            actions, logps, vfs = {}, {}, {}
+            for i, aid in enumerate(agents):      # static unroll
+                a, lp, v = mm.forward_exploration(
+                    mapping[aid], params, obs[aid],
+                    jax.random.fold_in(act_key, i))
+                actions[aid], logps[aid], vfs[aid] = a, lp, v
+            next_state, next_obs, rewards, done = jax.vmap(env.step)(
+                env_state, actions, jax.random.split(env_keys, B))
+            ep_ret = {aid: ep_ret[aid] + rewards[aid] for aid in agents}
+            ep_len = ep_len + 1
+            reset_state, reset_obs = jax.vmap(env.reset)(
+                jax.random.split(reset_keys, B))
+            sel = lambda a, b: jnp.where(
+                jnp.reshape(done, (B,) + (1,) * (a.ndim - 1)), a, b)
+            next_state = jax.tree_util.tree_map(sel, reset_state, next_state)
+            next_obs = jax.tree_util.tree_map(sel, reset_obs, next_obs)
+            out = dict(
+                obs=obs, actions=actions, logp=logps, vf=vfs,
+                rewards=rewards, dones=done,
+                finished_return={aid: jnp.where(done, ep_ret[aid], 0.0)
+                                 for aid in agents},
+                finished_len=jnp.where(done, ep_len, 0))
+            ep_ret = {aid: jnp.where(done, 0.0, ep_ret[aid])
+                      for aid in agents}
+            ep_len = jnp.where(done, 0, ep_len)
+            return (next_state, next_obs, ep_ret, ep_len, params), out
+
+        def sample(params, env_state, obs, ep_ret, ep_len, key):
+            key, sub = jax.random.split(key)
+            step_keys = jax.random.split(sub, T * 3).reshape(T, 3, 2)
+            carry, batch = jax.lax.scan(
+                one_step, (env_state, obs, ep_ret, ep_len, params),
+                step_keys)
+            env_state, obs, ep_ret, ep_len, _ = carry
+            batch["final_vf"] = {
+                aid: mm.forward_train(mapping[aid], params, obs[aid])["vf"]
+                for aid in agents}
+            batch["final_obs"] = obs
+            return env_state, obs, ep_ret, ep_len, key, batch
+
+        return sample
+
+    # -- public API ---------------------------------------------------------
+    def sample(self) -> Dict[str, Any]:
+        if not hasattr(self, "_ep_ret"):
+            self._ep_ret = {aid: jnp.zeros(self.num_envs)
+                            for aid in self.agents}
+            self._ep_len = jnp.zeros(self.num_envs, jnp.int32)
+        (self._env_state, self._obs, self._ep_ret, self._ep_len,
+         self._key, batch) = self._sample_jit(
+            self.params, self._env_state, self._obs, self._ep_ret,
+            self._ep_len, self._key)
+        batch = jax.device_get(batch)
+        dones = np.asarray(batch.pop("dones"))           # [T, B]
+        fin_ret = batch.pop("finished_return")           # {aid: [T, B]}
+        fin_len = np.asarray(batch.pop("finished_len"))
+        n_done = int(dones.sum())
+        agent_returns = {
+            aid: float(np.asarray(fin_ret[aid]).sum() / max(n_done, 1))
+            for aid in self.agents}
+        stats = {
+            "num_episodes": n_done,
+            "episode_len_mean": float(fin_len.sum() / max(n_done, 1)),
+            "episode_return_mean": float(
+                sum(agent_returns.values())),      # sum-of-agents return
+            "agent_episode_returns": agent_returns,
+            "env_steps": self.num_envs * self.rollout_length,
+            "agent_steps": (self.num_envs * self.rollout_length
+                            * len(self.agents)),
+        }
+        # regroup per-agent streams into per-module batches, concat along
+        # the env axis ([T, B] -> [T, B * n_agents_of_module])
+        per_module: Dict[str, Dict[str, np.ndarray]] = {}
+        for mid in self.module_specs:
+            aids = [a for a in self.agents if self.mapping[a] == mid]
+            mb = {}
+            for k in ("obs", "actions", "logp", "vf", "rewards"):
+                mb[k] = np.concatenate(
+                    [np.asarray(batch[k][a]) for a in aids], axis=1)
+            mb["dones"] = np.concatenate([dones] * len(aids), axis=1)
+            mb["final_vf"] = np.concatenate(
+                [np.asarray(batch["final_vf"][a]) for a in aids], axis=0)
+            mb["final_obs"] = np.concatenate(
+                [np.asarray(batch["final_obs"][a]) for a in aids], axis=0)
+            per_module[mid] = mb
+        return {"batches": per_module, "stats": stats}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.device_put(params)
+
+    def ping(self) -> bool:
+        return True
+
+
+def _merge_ma(results):
+    """Merge remote runners' results: concat per-module env axes,
+    weight-average stats."""
+    merged: Dict[str, Dict[str, np.ndarray]] = {}
+    for mid in results[0]["batches"]:
+        mb = {}
+        for k in results[0]["batches"][mid]:
+            axis = 0 if k in ("final_vf", "final_obs") else 1
+            mb[k] = np.concatenate(
+                [r["batches"][mid][k] for r in results], axis=axis)
+        merged[mid] = mb
+    n_eps = sum(r["stats"]["num_episodes"] for r in results)
+    agents = list(results[0]["stats"]["agent_episode_returns"])
+    agent_returns = {
+        aid: sum(r["stats"]["agent_episode_returns"][aid]
+                 * r["stats"]["num_episodes"] for r in results)
+        / max(n_eps, 1)
+        for aid in agents}
+    stats = {
+        "num_episodes": n_eps,
+        "episode_len_mean": sum(
+            r["stats"]["episode_len_mean"] * r["stats"]["num_episodes"]
+            for r in results) / max(n_eps, 1),
+        "episode_return_mean": float(sum(agent_returns.values())),
+        "agent_episode_returns": agent_returns,
+        "env_steps": sum(r["stats"]["env_steps"] for r in results),
+        "agent_steps": sum(r["stats"]["agent_steps"] for r in results),
+    }
+    return {"batches": merged, "stats": stats}
+
+
+class MultiAgentEnvRunnerGroup:
+    """Local or remote fleet of MultiAgentEnvRunners (mirror of
+    env_runner_group.EnvRunnerGroup for the multi-agent path)."""
+
+    def __init__(self, env, policy_mapping_fn, num_env_runners: int = 0,
+                 num_envs_per_runner: int = 8, rollout_length: int = 128,
+                 seed: int = 0,
+                 module_classes: Optional[Dict[str, type]] = None,
+                 model_configs: Optional[Dict[str, dict]] = None,
+                 runner_resources: Optional[Dict[str, float]] = None):
+        self.num_env_runners = num_env_runners
+        # specs computed here (not via an actor round-trip): env + mapping
+        # fully determine them
+        probe = make_multi_agent_env(env)
+        mapping = {aid: call_mapping_fn(policy_mapping_fn, aid)
+                   for aid in probe.agents}
+        self._module_specs = {mapping[aid]: probe.specs[aid]
+                              for aid in probe.agents}
+        self.mapping = mapping
+        if num_env_runners == 0:
+            self._local = MultiAgentEnvRunner(
+                env, policy_mapping_fn, num_envs_per_runner,
+                rollout_length, seed, module_classes, model_configs)
+            self._remote = []
+        else:
+            self._local = None
+            remote_cls = ray_tpu.remote(
+                **(runner_resources or {"num_cpus": 1}))(MultiAgentEnvRunner)
+            self._remote = [
+                remote_cls.remote(env, policy_mapping_fn,
+                                  num_envs_per_runner, rollout_length,
+                                  seed + 1000 * (i + 1), module_classes,
+                                  model_configs)
+                for i in range(num_env_runners)]
+            ray_tpu.get([r.ping.remote() for r in self._remote])
+
+    def sample(self) -> Dict[str, Any]:
+        if self._local is not None:
+            return self._local.sample()
+        return _merge_ma(
+            ray_tpu.get([r.sample.remote() for r in self._remote]))
+
+    def sync_weights(self, params_by_module) -> None:
+        if self._local is not None:
+            self._local.set_weights(params_by_module)
+        else:
+            ref = ray_tpu.put(params_by_module)
+            ray_tpu.get([r.set_weights.remote(ref) for r in self._remote])
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        return ray_tpu.get(self._remote[0].get_weights.remote())
+
+    @property
+    def module_specs(self):
+        return self._module_specs
+
+    def stop(self) -> None:
+        for r in self._remote:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
