@@ -1,0 +1,154 @@
+"""Scale-envelope benchmark: where does the single controller saturate?
+
+Reference parity: release/benchmarks/README.md single-node rows
+(many queued tasks, many actors, many PGs, n:n actor calls) — shrunk to
+this box but 10x round-2's envelope. Prints one JSON line per row plus
+a summary; run standalone:  python bench_envelope.py [--quick]
+
+Rows (defaults):
+  tasks     50,000 queued no-op tasks: submit rate + drain rate
+  actors    500 zygote-forked actors: create + first-call + kill
+  pgs       1,000 placement groups: create/ready + remove
+  nn_storm  8 caller actors x 8 callee actors x 500 calls: n:n rate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_tasks(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    ray_tpu.get([nop.remote(i) for i in range(64)])   # warm pool
+    t0 = time.time()
+    refs = [nop.remote(i) for i in range(n)]
+    t_submit = time.time() - t0
+    out = ray_tpu.get(refs, timeout=1800)
+    t_total = time.time() - t0
+    assert out == list(range(n))
+    return {"row": "tasks", "n": n,
+            "submit_per_s": round(n / t_submit, 1),
+            "end_to_end_per_s": round(n / t_total, 1),
+            "total_s": round(t_total, 1)}
+
+
+def bench_actors(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    t0 = time.time()
+    actors = [A.options(num_cpus=0).remote(i) for i in range(n)]
+    got = ray_tpu.get([a.who.remote() for a in actors], timeout=1800)
+    t_ready = time.time() - t0
+    assert got == list(range(n))
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"row": "actors", "n": n,
+            "create_to_first_call_per_s": round(n / t_ready, 1),
+            "total_s": round(t_ready, 1)}
+
+
+def bench_pgs(n: int) -> dict:
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    t0 = time.time()
+    pgs = [placement_group([{"CPU": 0.001}], strategy="PACK")
+           for _ in range(n)]
+    for pg in pgs:
+        assert pg.ready(timeout=600)
+    t_ready = time.time() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    t_total = time.time() - t0
+    return {"row": "pgs", "n": n,
+            "create_ready_per_s": round(n / t_ready, 1),
+            "total_s": round(t_total, 1)}
+
+
+def bench_nn_storm(n_callers: int, n_callees: int, calls: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Callee:
+        def pong(self, x):
+            return x
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, callees):
+            self.callees = callees
+
+        def storm(self, calls):
+            refs = []
+            for i in range(calls):
+                refs.append(self.callees[i % len(self.callees)]
+                            .pong.remote(i))
+            return len(ray_tpu.get(refs))
+
+    callees = [Callee.options(num_cpus=0).remote()
+               for _ in range(n_callees)]
+    callers = [Caller.options(num_cpus=0).remote(callees)
+               for _ in range(n_callers)]
+    # warm
+    ray_tpu.get([c.storm.remote(4) for c in callers])
+    t0 = time.time()
+    done = ray_tpu.get([c.storm.remote(calls) for c in callers],
+                       timeout=1800)
+    dt = time.time() - t0
+    total = sum(done)
+    for a in callers + callees:
+        ray_tpu.kill(a)
+    return {"row": "nn_storm", "callers": n_callers,
+            "callees": n_callees, "total_calls": total,
+            "calls_per_s": round(total / dt, 1),
+            "total_s": round(dt, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="10x smaller rows (CI smoke)")
+    ap.add_argument("--rows", default="tasks,actors,pgs,nn_storm")
+    args = ap.parse_args()
+    scale = 10 if args.quick else 1
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=16)
+    rows = []
+    wanted = set(args.rows.split(","))
+    try:
+        if "tasks" in wanted:
+            rows.append(bench_tasks(50_000 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "actors" in wanted:
+            rows.append(bench_actors(500 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "pgs" in wanted:
+            rows.append(bench_pgs(1_000 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "nn_storm" in wanted:
+            rows.append(bench_nn_storm(8, 8, 500 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({"envelope": rows}))
+
+
+if __name__ == "__main__":
+    main()

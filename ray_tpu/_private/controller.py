@@ -953,6 +953,15 @@ class Controller:
                          spec={"name": name} if name else None,
                          node_id=node_id)
 
+    async def rpc_task_event_push_batch(self, events: list,
+                                        node_id: str = None) -> None:
+        """Batched form (one frame per lease-dispatched batch)."""
+        for ev in events:
+            self._task_event(ev["task_id"], ev["state"],
+                             spec={"name": ev["name"]}
+                             if ev.get("name") else None,
+                             node_id=node_id)
+
     async def rpc_task_finished(self, task_id: str, node_id: str) -> None:
         self._task_event(task_id, "FINISHED")
         entry = self.running.pop(task_id, None)

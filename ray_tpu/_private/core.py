@@ -901,6 +901,7 @@ class CoreClient:
 
     MAX_LEASES_PER_KEY = 8
     LEASE_IDLE_S = 2.0
+    LEASE_DISPATCH_BATCH = 16    # specs per run_task_batch frame
 
     def _lease_key(self, spec: dict) -> Optional[tuple]:
         """Fast-path eligibility: plain CPU-only tasks with default
@@ -989,25 +990,43 @@ class CoreClient:
                     await asyncio.sleep(0.05)
                     continue
                 idle_since = None
-                spec = group.queue.popleft()
+                batch = [group.queue.popleft()]
+                # Batch only what this pump's fair share of the backlog
+                # is: deep queues amortize to LEASE_DISPATCH_BATCH per
+                # frame, while a 2-task burst with 2 pumps still runs in
+                # parallel (no head-of-line blocking behind a slow task).
+                target = min(
+                    self.LEASE_DISPATCH_BATCH,
+                    max(1, (len(group.queue) + 1)
+                        // max(group.num_pumps, 1)))
+                while group.queue and len(batch) < target:
+                    batch.append(group.queue.popleft())
                 try:
-                    await worker.call("run_task", spec=spec)
+                    # one frame for the whole batch: tiny tasks are wire
+                    # (syscall) bound, not compute bound
+                    await worker.call("run_task_batch", specs=batch)
                 except Exception:
-                    # worker/conn gone. The daemon settles the in-flight
-                    # task's failure (incl. OOM attribution) exactly
-                    # once — only resubmit if it never saw it. The
-                    # backlog flows back through the scheduled path.
+                    # worker/conn gone. The daemon settles the STARTED
+                    # members' failures (incl. OOM attribution) exactly
+                    # once; never-started members come back as
+                    # "unstarted" for clean resubmission (no retry
+                    # consumed). The backlog flows back through the
+                    # scheduled path.
                     reported = alive = False
+                    unstarted: set = set()
                     try:
                         fate = await self.pool.get(daemon_addr).call(
                             "leased_worker_fate", worker_id=worker_id,
-                            task_id=spec["task_id"])
+                            task_ids=[s["task_id"] for s in batch])
                         reported = bool(fate.get("reported"))
                         alive = bool(fate.get("alive"))
+                        unstarted = set(fate.get("unstarted") or [])
                     except Exception:
                         pass
-                    if not reported and not alive:
-                        await self._resubmit_scheduled(spec)
+                    if not alive:
+                        for s in batch:
+                            if s["task_id"] in unstarted or not reported:
+                                await self._resubmit_scheduled(s)
                     await self._drain_lease_queue(group)
                     return
         except Exception:

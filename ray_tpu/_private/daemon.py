@@ -53,10 +53,16 @@ def pick_worker_to_kill(workers) -> Optional["WorkerHandle"]:
     task worker exists."""
     tasks = [w for w in workers
              if w.state in ("busy", "leased")
-             and w.current_task is not None]
+             and (w.current_task is not None
+                  or getattr(w, "current_batch", None))]
+
+    def _retriable(w) -> bool:
+        specs = getattr(w, "current_batch", None) or [w.current_task]
+        # a batch is cheap to kill only if EVERY member reruns
+        return all((s.get("max_retries") or 0) > 0 for s in specs)
+
     if tasks:
-        retriable = [w for w in tasks
-                     if (w.current_task.get("max_retries") or 0) > 0]
+        retriable = [w for w in tasks if _retriable(w)]
         pool = retriable or tasks      # retriable victims are cheap: they rerun
         return max(pool, key=lambda w: w.spawn_time)     # newest first
     actors = [w for w in workers if w.state == "actor"]
@@ -130,8 +136,9 @@ class _ForkedProc:
 
 class WorkerHandle:
     __slots__ = ("worker_id", "addr", "pid", "proc", "state", "current_task",
-                 "actor_id", "spawn_time", "env_key", "oom_reason",
-                 "last_settled_task")
+                 "current_batch", "batch_progress", "actor_id", "spawn_time",
+                 "env_key", "oom_reason", "last_settled_tasks",
+                 "last_unstarted_tasks")
 
     def __init__(self, worker_id: str, proc, env_key: str = ""):
         self.worker_id = worker_id
@@ -147,10 +154,19 @@ class WorkerHandle:
         # crash-report path then reports OutOfMemoryError ONCE instead of
         # a second generic crash
         self.oom_reason: Optional[str] = None
-        # task_id whose failure _settle_leased_death already reported to
-        # its owner — the fate RPC answers reported=True for it so the
-        # lease pump never resubmits an already-settled task
-        self.last_settled_task: Optional[str] = None
+        # slim specs of the lease-dispatched batch currently executing
+        # (client->worker direct; the worker self-reports per batch) and
+        # the index of the member currently running (members past it
+        # have not started)
+        self.current_batch: list = []
+        self.batch_progress: int = 0
+        # task_ids whose failures _settle_leased_death already reported
+        # to their owners — the fate RPC answers reported=True for them
+        # so the lease pump never resubmits an already-settled task —
+        # and task_ids it classified as never-started (the pump
+        # resubmits those without consuming retries)
+        self.last_settled_tasks: set = set()
+        self.last_unstarted_tasks: set = set()
 
 
 class NodeDaemon:
@@ -620,6 +636,7 @@ class NodeDaemon:
             return {"status": "error", "error": repr(e)}
         handle.state = "leased"
         handle.current_task = None
+        handle.current_batch = []
         return {"status": "ok", "worker_id": handle.worker_id,
                 "addr": handle.addr}
 
@@ -640,9 +657,9 @@ class NodeDaemon:
         handle = self.workers.get(worker_id)
         if handle is None or handle.state != "leased":
             return
-        if handle.current_task is not None:
+        if handle.current_task is not None or handle.current_batch:
             # lease released mid-task (client->worker blip): drain —
-            # the worker returns to the pool when the task finishes
+            # the worker returns to the pool when the batch finishes
             handle.state = "lease_draining"
             return
         handle.state = "idle"
@@ -668,46 +685,101 @@ class NodeDaemon:
             handle.state = "idle"
             self._offer_worker(handle)
 
+    async def rpc_leased_batch_started(self, worker_id: str,
+                                       specs: list) -> None:
+        """One self-report per dispatched BATCH (the lease fast path
+        amortizes per-task wire cost; reference parity intent unchanged:
+        the raylet always knows its workers' work)."""
+        handle = self.workers.get(worker_id)
+        if handle is not None:
+            handle.current_batch = list(specs)
+            handle.batch_progress = 0
+            handle.current_task = None
+
+    async def rpc_leased_batch_progress(self, worker_id: str,
+                                        index: int) -> None:
+        handle = self.workers.get(worker_id)
+        if handle is not None:
+            handle.batch_progress = max(handle.batch_progress, int(index))
+
+    async def rpc_leased_batch_done(self, worker_id: str) -> None:
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return
+        handle.current_batch = []
+        handle.batch_progress = 0
+        if handle.state == "lease_draining":
+            handle.state = "idle"
+            self._offer_worker(handle)
+
     async def _settle_leased_death(self, handle: WorkerHandle) -> bool:
-        """Report a dead leased worker's in-flight task to its owner
-        EXACTLY once (fate RPC and the monitor sweep both funnel here;
-        check-and-clear on the daemon loop makes it atomic)."""
-        spec = handle.current_task
-        if spec is None or not spec.get("_leased"):
+        """Report a dead leased worker's in-flight task(s) to their
+        owners EXACTLY once (fate RPC and the monitor sweep both funnel
+        here; check-and-clear on the daemon loop makes it atomic).
+
+        Batch nuance: only members the worker STARTED (index <=
+        batch_progress) are reported — the running one genuinely failed;
+        earlier ones completed, and their owners drop the report
+        (core.py rpc_object_ready late-failure guard) unless the result
+        push itself was lost, which the report then covers. Never-
+        started members are recorded in last_unstarted_tasks and handed
+        back to the pump via the fate RPC for clean resubmission with no
+        retry consumed."""
+        if handle.current_batch:
+            cut = handle.batch_progress + 1
+            started = [s for s in handle.current_batch[:cut]
+                       if s and s.get("_leased")]
+            unstarted = [s for s in handle.current_batch[cut:]
+                         if s and s.get("_leased")]
+        elif (handle.current_task is not None
+              and handle.current_task.get("_leased")):
+            started, unstarted = [handle.current_task], []
+        else:
             return False
         handle.current_task = None
-        handle.last_settled_task = spec.get("task_id")
+        handle.current_batch = []
+        handle.batch_progress = 0
+        handle.last_unstarted_tasks |= {
+            s.get("task_id") for s in unstarted}
         from ..exceptions import OutOfMemoryError
         err = (OutOfMemoryError(handle.oom_reason)
                if handle.oom_reason else None)
-        await self._report_failure(
-            spec, "leased worker died while running task", error=err)
+        for spec in started:
+            handle.last_settled_tasks.add(spec.get("task_id"))
+            await self._report_failure(
+                spec, "leased worker died while running task", error=err)
         return True
 
     async def rpc_leased_worker_fate(self, worker_id: str,
-                                     task_id: str) -> dict:
+                                     task_id: str = None,
+                                     task_ids: list = None) -> dict:
         """The client's lease pump asks after a connection failure:
-        'did/will you report this task?' — settles on the spot so the
+        'did/will you report these tasks?' — settles on the spot so the
         pump never double-submits and owners never hang. A worker that
-        is still ALIVE is a transient client->worker blip: the task
-        keeps executing and its result reaches the owner directly, so
+        is still ALIVE is a transient client->worker blip: the batch
+        keeps executing and its results reach the owner directly, so
         nothing is settled and the pump must not resubmit."""
+        ids = set(task_ids or ([task_id] if task_id else []))
         handle = self.workers.get(worker_id)
         if handle is None:
-            return {"reported": False, "alive": False}
+            return {"reported": False, "alive": False, "unstarted": []}
         dead = handle.state == "dead" or handle.proc.poll() is not None
         if not dead:
-            return {"reported": False, "alive": True}
-        spec = handle.current_task
-        if spec is not None and spec.get("task_id") == task_id:
+            return {"reported": False, "alive": True, "unstarted": []}
+        current = {s.get("task_id") for s in handle.current_batch}
+        if handle.current_task is not None:
+            current.add(handle.current_task.get("task_id"))
+        if ids & current:
             await self._settle_leased_death(handle)
-            return {"reported": True, "alive": False}
-        # current_task gone: either the sweep already settled THIS task
-        # (reported=True — resubmitting would break at-most-once and race
-        # the owner-side retry) or the worker died before
-        # leased_task_started landed (reported=False: pump resubmits).
-        return {"reported": handle.last_settled_task == task_id,
-                "alive": False}
+        # either settled just now or by the sweep (reported=True for the
+        # started members — resubmitting those would break at-most-once
+        # and race the owner-side retry) or the worker died before
+        # leased_batch_started landed (reported=False: pump resubmits
+        # everything). "unstarted" members never executed: the pump
+        # resubmits them through the scheduler, no retry consumed.
+        return {"reported": bool(ids & handle.last_settled_tasks),
+                "alive": False,
+                "unstarted": sorted(ids & handle.last_unstarted_tasks)}
 
     async def rpc_prestart_workers(self, count: int) -> int:
         started = 0

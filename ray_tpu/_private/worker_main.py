@@ -23,6 +23,7 @@ import logging
 import os
 import signal
 import sys
+import time
 import traceback
 from typing import Any, Dict, Optional, Tuple
 
@@ -125,6 +126,7 @@ class WorkerRuntime:
         self.task_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="task")
         client.server.register("run_task", self.rpc_run_task)
+        client.server.register("run_task_batch", self.rpc_run_task_batch)
         client.server.register("create_actor", self.rpc_create_actor)
         client.server.register("call_actor", self.rpc_call_actor)
         client.server.register("shutdown_worker", self.rpc_shutdown_worker)
@@ -306,26 +308,91 @@ class WorkerRuntime:
                 chips)
 
     async def rpc_run_task(self, spec: dict) -> dict:
+        if spec.get("_leased"):
+            return await self.rpc_run_task_batch([spec])
+        return await self._execute_task(spec)
+
+    async def rpc_run_task_batch(self, specs: list) -> dict:
+        """Lease-path batch dispatch: ONE wire frame carries K tasks and
+        the daemon/controller notifications are per-batch, so tiny tasks
+        cost ~1 frame each (the result push) instead of ~6. Results
+        still stream to the owner per task as they finish.
+
+        Reference parity intent: the raylet always knows its workers'
+        work (self-report) and workers feed the GCS task-event buffer
+        (task_event_buffer.h) — both preserved, amortized per batch."""
+        daemon = self.client.pool.get(self.daemon_addr)
+        controller = self.client.pool.get(self.client.controller_addr)
+        try:
+            # slim specs: just what the daemon's _report_failure needs
+            await daemon.oneway(
+                "leased_batch_started", worker_id=self.worker_id,
+                specs=[{k: s.get(k) for k in
+                        ("task_id", "name", "owner_addr", "return_id",
+                         "return_ids", "max_retries", "_leased")}
+                       for s in specs])
+            await controller.oneway(
+                "task_event_push_batch", node_id=self.node_id,
+                events=[{"task_id": s["task_id"],
+                         "name": s.get("name", ""), "state": "RUNNING"}
+                        for s in specs])
+        except Exception:
+            pass
+        states = []
+        last_progress = time.monotonic()
+        for i, spec in enumerate(specs):
+            if i > 0 and time.monotonic() - last_progress >= 0.05:
+                # progress marker: on worker death the daemon fails only
+                # members it believes started; the pump resubmits the
+                # rest without consuming retries. Time-throttled: a
+                # microseconds-per-task storm sends none (the whole
+                # batch is one blast-radius window anyway), while slow
+                # tasks get per-task attribution.
+                try:
+                    await daemon.oneway(
+                        "leased_batch_progress",
+                        worker_id=self.worker_id, index=i)
+                    last_progress = time.monotonic()
+                except Exception:
+                    pass
+            try:
+                reply = await self._execute_task(spec)
+                st = ("FAILED" if reply.get("status") == "error"
+                      else "FINISHED")
+            except Exception:
+                # e.g. result push raced a connection blip: confine the
+                # damage to THIS task (fail its refs if the owner is
+                # still reachable) and keep draining the batch — an
+                # escaping exception would strand every later member
+                from ..exceptions import TaskError
+                tb = traceback.format_exc()
+                try:
+                    await self._push_error(
+                        spec["owner_addr"], spec["return_id"],
+                        TaskError(spec.get("name", "task"), tb),
+                        task_id=spec["task_id"],
+                        object_ids=(spec.get("return_ids")
+                                    or [spec["return_id"]]))
+                except Exception:
+                    pass
+                st = "FAILED"
+            states.append(st)
+        try:
+            await daemon.oneway(
+                "leased_batch_done", worker_id=self.worker_id)
+            await controller.oneway(
+                "task_event_push_batch", node_id=self.node_id,
+                events=[{"task_id": s["task_id"],
+                         "name": s.get("name", ""), "state": st}
+                        for s, st in zip(specs, states)])
+        except Exception:
+            pass
+        return {"status": "ok"}
+
+    async def _execute_task(self, spec: dict) -> dict:
         from ..exceptions import TaskError
         loop = asyncio.get_running_loop()
         streaming = spec.get("num_returns") == "streaming"
-        if spec.get("_leased"):
-            # self-report so the daemon's OOM killer / crash attribution
-            # know what this leased worker is running (slim spec: just
-            # what _report_failure needs)
-            try:
-                await self.client.pool.get(self.daemon_addr).oneway(
-                    "leased_task_started", worker_id=self.worker_id,
-                    spec={k: spec.get(k) for k in
-                          ("task_id", "name", "owner_addr", "return_id",
-                           "return_ids", "max_retries", "_leased")})
-                await self.client.pool.get(
-                    self.client.controller_addr).oneway(
-                    "task_event_push", task_id=spec["task_id"],
-                    name=spec.get("name", ""), state="RUNNING",
-                    node_id=self.node_id)
-            except Exception:
-                pass
         try:
             self._apply_tpu_isolation(spec)
             fn = await self._load_fn(spec)
@@ -349,17 +416,6 @@ class WorkerRuntime:
                 TaskError(spec.get("name", "task"), tb),
                 task_id=spec["task_id"],
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
-            if spec.get("_leased"):
-                try:
-                    await self.client.pool.get(self.daemon_addr).oneway(
-                        "leased_task_done", worker_id=self.worker_id)
-                    await self.client.pool.get(
-                        self.client.controller_addr).oneway(
-                        "task_event_push", task_id=spec["task_id"],
-                        name=spec.get("name", ""), state="FAILED",
-                        node_id=self.node_id)
-                except Exception:
-                    pass
             return {"status": "error"}
         if streaming:
             return await self._stream_results(spec, result)
@@ -385,20 +441,6 @@ class WorkerRuntime:
         else:
             await self._push_result(spec["owner_addr"], spec["return_id"],
                                     result, task_id=spec["task_id"])
-        if spec.get("_leased"):
-            try:
-                await self.client.pool.get(self.daemon_addr).oneway(
-                    "leased_task_done", worker_id=self.worker_id)
-                # lease-dispatched: the controller never saw this spec,
-                # so the worker reports the terminal task event
-                # (reference parity: task_event_buffer.h worker->GCS)
-                await self.client.pool.get(
-                    self.client.controller_addr).oneway(
-                    "task_event_push", task_id=spec["task_id"],
-                    name=spec.get("name", ""), state="FINISHED",
-                    node_id=self.node_id)
-            except Exception:
-                pass
         return {"status": "ok"}
 
     # ---------------------------------------------------------- streaming

@@ -36,6 +36,25 @@ def call_mapping_fn(fn: Callable, agent_id: str) -> str:
         return str(fn(agent_id, None))
 
 
+def derive_module_specs(env: MultiAgentJaxEnv, policy_mapping_fn: Callable
+                        ) -> tuple:
+    """(agent->module mapping, module->EnvSpec) for an env + mapping fn,
+    validating that agents sharing a module share an EnvSpec. Single
+    source of truth for the runner and the runner group."""
+    mapping = {aid: call_mapping_fn(policy_mapping_fn, aid)
+               for aid in env.agents}
+    module_specs: Dict[str, Any] = {}
+    for aid in env.agents:
+        mid = mapping[aid]
+        spec = env.specs[aid]
+        if mid in module_specs and module_specs[mid] != spec:
+            raise ValueError(
+                f"agents mapped to module {mid!r} have different "
+                f"EnvSpecs; use separate modules")
+        module_specs[mid] = spec
+    return mapping, module_specs
+
+
 class MultiAgentEnvRunner:
     """Samples {module_id: [T, B_mod, ...]} batches from a multi-agent
     env. Streams of agents mapped to the same module are concatenated
@@ -51,19 +70,9 @@ class MultiAgentEnvRunner:
         self.num_envs = num_envs
         self.rollout_length = rollout_length
         # static mapping (see module docstring)
-        self.mapping: Dict[str, str] = {
-            aid: call_mapping_fn(policy_mapping_fn, aid)
-            for aid in self.agents}
-        module_specs: Dict[str, Any] = {}
-        for aid in self.agents:
-            mid = self.mapping[aid]
-            spec = self.env.specs[aid]
-            if mid in module_specs and module_specs[mid] != spec:
-                raise ValueError(
-                    f"agents mapped to module {mid!r} have different "
-                    f"EnvSpecs; use separate modules")
-            module_specs[mid] = spec
-        self.module_specs = module_specs
+        self.mapping, self.module_specs = derive_module_specs(
+            self.env, policy_mapping_fn)
+        module_specs = self.module_specs
         self.multi_module = MultiRLModule.from_specs(
             module_specs, module_classes, model_configs)
         self._key = jax.random.PRNGKey(seed)
@@ -224,12 +233,8 @@ class MultiAgentEnvRunnerGroup:
         self.num_env_runners = num_env_runners
         # specs computed here (not via an actor round-trip): env + mapping
         # fully determine them
-        probe = make_multi_agent_env(env)
-        mapping = {aid: call_mapping_fn(policy_mapping_fn, aid)
-                   for aid in probe.agents}
-        self._module_specs = {mapping[aid]: probe.specs[aid]
-                              for aid in probe.agents}
-        self.mapping = mapping
+        self.mapping, self._module_specs = derive_module_specs(
+            make_multi_agent_env(env), policy_mapping_fn)
         if num_env_runners == 0:
             self._local = MultiAgentEnvRunner(
                 env, policy_mapping_fn, num_envs_per_runner,

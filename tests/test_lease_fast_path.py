@@ -69,7 +69,8 @@ def test_leased_worker_death_recovers(ray_start):
     import ray_tpu._private.worker as worker_mod
     daemon = worker_mod._runtime.head_daemon
     victims = [w for w in daemon.workers.values()
-               if w.state in ("leased", "busy") and w.current_task]
+               if w.state in ("leased", "busy")
+               and (w.current_task or w.current_batch)]
     assert victims, "expected a worker running the task"
     for v in victims:
         daemon._kill_proc(v)

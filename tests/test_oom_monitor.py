@@ -73,7 +73,8 @@ def test_oom_killed_retriable_task_retries():
             # (tasks run on leased workers via the fast path, so the
             # trigger watches both dispatch modes)
             if kills["n"] < 1 and any(
-                    w.state in ("busy", "leased") and w.current_task
+                    w.state in ("busy", "leased")
+                    and (w.current_task or w.current_batch)
                     for w in daemon.workers.values()):
                 kills["n"] += 1
                 return (99, 100)

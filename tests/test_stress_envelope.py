@@ -13,7 +13,7 @@ def test_many_queued_tasks_drain(ray_start):
     def nop(i):
         return i
 
-    n = 2000
+    n = 10000     # full 50k envelope lives in bench_envelope.py
     t0 = time.time()
     refs = [nop.remote(i) for i in range(n)]
     out = ray_tpu.get(refs, timeout=600)
