@@ -39,10 +39,22 @@ class FunctionNode(DAGNode):
     ray_tpu.workflow for durable execution."""
 
     def __init__(self, remote_fn, args: Tuple[Any, ...],
-                 kwargs: Optional[dict] = None):
+                 kwargs: Optional[dict] = None,
+                 workflow_options: Optional[dict] = None):
         self.remote_fn = remote_fn
         self.args = args
         self.kwargs = kwargs or {}
+        # per-step workflow options (reference parity: workflow step
+        # options, python/ray/workflow/api.py options(**step_options) —
+        # max_retries / catch_exceptions)
+        self.workflow_options = dict(workflow_options or {})
+
+    def options(self, **workflow_options) -> "FunctionNode":
+        """Per-step options for workflow execution, e.g.
+        .options(max_retries=3, catch_exceptions=True)."""
+        merged = {**self.workflow_options, **workflow_options}
+        return FunctionNode(self.remote_fn, self.args, self.kwargs,
+                            workflow_options=merged)
 
     @property
     def name(self) -> str:

@@ -17,20 +17,36 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class Checkpoint:
-    """A handle to a checkpoint directory."""
+    """A handle to a checkpoint directory — local, or on any storage
+    the pyarrow-fs layer resolves (gs://, s3://, mock://; see
+    storage.py — reference parity: train/_checkpoint.py Checkpoint
+    (path, filesystem))."""
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        from .storage import is_uri
+        self.path = path if is_uri(path) else os.path.abspath(path)
+
+    @property
+    def is_remote(self) -> bool:
+        from .storage import is_uri
+        return is_uri(self.path)
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
 
     def as_directory(self) -> str:
+        """A LOCAL directory with this checkpoint's content (downloads
+        remote checkpoints to a temp dir)."""
+        if self.is_remote:
+            return self.to_directory()
         return self.path
 
     def to_directory(self, dest: Optional[str] = None) -> str:
         dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if self.is_remote:
+            from .storage import download_dir
+            return download_dir(self.path, dest)
         if os.path.abspath(dest) != self.path:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return dest
@@ -49,7 +65,7 @@ class Checkpoint:
     def load_pytree(self, abstract_tree: Any = None) -> Any:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        target = os.path.join(self.path, "pytree")
+        target = os.path.join(self.as_directory(), "pytree")
         if abstract_tree is not None:
             return ckptr.restore(target, item=abstract_tree)
         return ckptr.restore(target)
@@ -57,12 +73,14 @@ class Checkpoint:
     def pack(self) -> "PackedCheckpoint":
         """Serialize the directory to bytes so the checkpoint can cross
         host boundaries through the object store (workers may run on a
-        different machine than the driver)."""
+        different machine than the driver; remote checkpoints are
+        downloaded driver-side first, so workers never need storage
+        credentials — or, for mock://, the driver's in-memory fs)."""
         import io
         import tarfile
         buf = io.BytesIO()
         with tarfile.open(fileobj=buf, mode="w") as tar:
-            tar.add(self.path, arcname=".")
+            tar.add(self.as_directory(), arcname=".")
         return PackedCheckpoint(buf.getvalue())
 
     def __reduce__(self):
@@ -94,30 +112,51 @@ class CheckpointManager:
     def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None,
                  score_order: str = "max"):
+        from .storage import StorageContext
         self.storage_path = storage_path
+        self.storage = StorageContext(storage_path)
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
         self.checkpoints: List[Tuple[float, Checkpoint, Dict]] = []
         self._counter = 0
-        os.makedirs(storage_path, exist_ok=True)
+        self.storage.ensure_dir()
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[Dict] = None) -> Checkpoint:
         """Persist a reported checkpoint into storage and apply retention."""
+        from .storage import join
         metrics = metrics or {}
         self._counter += 1
-        dest = os.path.join(self.storage_path,
-                            f"checkpoint_{self._counter:06d}")
-        if isinstance(checkpoint, PackedCheckpoint):
-            persisted = checkpoint.unpack_into(dest)
+        name = f"checkpoint_{self._counter:06d}"
+        meta = {k: v for k, v in metrics.items()
+                if isinstance(v, (int, float, str, bool))}
+        if self.storage.is_remote:
+            # materialize locally, stamp metrics, upload as one unit
+            local = tempfile.mkdtemp(prefix="rtpu_ckpt_up_")
+            try:
+                if isinstance(checkpoint, PackedCheckpoint):
+                    checkpoint.unpack_into(local)
+                else:
+                    shutil.copytree(checkpoint.path, local,
+                                    dirs_exist_ok=True)
+                with open(os.path.join(local, ".metrics.json"), "w") as f:
+                    json.dump(meta, f)
+                persisted = Checkpoint(
+                    self.storage.persist_dir(local, name))
+            finally:
+                shutil.rmtree(local, ignore_errors=True)
         else:
-            if checkpoint.path != dest:
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
-            persisted = Checkpoint(dest)
-        with open(os.path.join(dest, ".metrics.json"), "w") as f:
-            json.dump({k: v for k, v in metrics.items()
-                       if isinstance(v, (int, float, str, bool))}, f)
+            dest = join(self.storage_path, name)
+            if isinstance(checkpoint, PackedCheckpoint):
+                persisted = checkpoint.unpack_into(dest)
+            else:
+                if checkpoint.path != dest:
+                    shutil.copytree(checkpoint.path, dest,
+                                    dirs_exist_ok=True)
+                persisted = Checkpoint(dest)
+            with open(os.path.join(dest, ".metrics.json"), "w") as f:
+                json.dump(meta, f)
         if self.score_attribute and self.score_attribute in metrics:
             score = float(metrics[self.score_attribute])
             if self.score_order == "min":
@@ -135,7 +174,7 @@ class CheckpointManager:
             worst_idx = min(range(len(self.checkpoints)),
                             key=lambda i: self.checkpoints[i][0])
             _, ckpt, _ = self.checkpoints.pop(worst_idx)
-            shutil.rmtree(ckpt.path, ignore_errors=True)
+            self.storage.delete(ckpt.path)
 
     @property
     def latest(self) -> Optional[Checkpoint]:

@@ -63,5 +63,9 @@ class RunConfig:
         default_factory=CheckpointConfig)
 
     def resolved_storage_path(self) -> str:
+        """storage_path may be a cloud URI (gs://bucket/dir, mock://...)
+        — everything downstream rides train.storage (reference parity:
+        train/_internal/storage.py StorageContext)."""
+        from .storage import join
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
-        return os.path.join(base, self.name or "train_run")
+        return join(base, self.name or "train_run")

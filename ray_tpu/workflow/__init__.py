@@ -1,10 +1,10 @@
 """Workflows: durable task-DAG execution with checkpoint/resume.
 
 Reference parity: python/ray/workflow (workflow_executor.py:32
-WorkflowExecutor, workflow_state_from_dag.py, storage layer) — a task DAG
-built with `fn.bind(...)` runs with every step's result checkpointed, so
-a crashed/killed run resumes from the last completed step instead of
-recomputing.
+WorkflowExecutor, workflow_state_from_dag.py, workflow_storage.py) — a
+task DAG built with `fn.bind(...)` runs with every step's result
+checkpointed, so a crashed/killed run resumes from the last completed
+step instead of recomputing.
 
     @ray_tpu.remote
     def add(a, b): return a + b
@@ -13,16 +13,33 @@ recomputing.
     workflow.run(dag, workflow_id="my-flow")      # -> 13
     workflow.resume("my-flow")                    # no-op: already done
 
+Dynamic workflows (reference: workflow/api.py:776 `continuation`): a
+step may return `workflow.continuation(another.bind(...))`; the
+returned sub-DAG is spliced into the run and its output becomes the
+step's result — recursion (factorial-style) expresses loops whose
+length is only known at runtime. Continuation checkpoints are
+namespaced under the parent step, so resume works mid-expansion.
+
+Per-step options (reference: step options) via
+`fn.bind(...).options(max_retries=2, catch_exceptions=True)`:
+max_retries re-runs a crashed/failed step; catch_exceptions makes the
+step's value `(result, None)` / `(None, exception)` instead of failing
+the workflow.
+
 Step identity is structural (function name + position in the DAG), so a
 resumed run maps checkpoints back to the same steps. Steps with all
 dependencies ready execute in parallel as normal ray_tpu tasks.
+
+Storage rides the train.storage pyarrow-fs layer, so
+RAY_TPU_WORKFLOW_STORAGE may be a local dir, gs://bucket/prefix, or
+mock:// (tests).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import pickle
+import posixpath
 import time
 
 import cloudpickle
@@ -31,17 +48,33 @@ from typing import Any, Dict, List, Optional
 from ..dag.dag_node import DAGNode, FunctionNode
 
 __all__ = ["run", "resume", "get_output", "get_status", "list_all",
-           "delete", "storage_dir"]
+           "delete", "storage_dir", "continuation", "Continuation"]
 
 _STATUS = ("RUNNING", "SUCCESSFUL", "FAILED", "NOT_FOUND")
 
 
 def storage_dir(workflow_id: Optional[str] = None) -> str:
     # env read per call: tests and tools point storage at temp dirs
+    from ..train.storage import join
     base = os.environ.get(
         "RAY_TPU_WORKFLOW_STORAGE",
         None) or "/tmp/ray_tpu/workflows"
-    return os.path.join(base, workflow_id) if workflow_id else base
+    return join(base, workflow_id) if workflow_id else base
+
+
+class Continuation:
+    """Marker a step returns to splice a sub-DAG into the workflow."""
+
+    def __init__(self, dag: FunctionNode):
+        if not isinstance(dag, FunctionNode):
+            raise TypeError("continuation expects a fn.bind(...) node")
+        self.dag = dag
+
+
+def continuation(dag: FunctionNode) -> Continuation:
+    """Return this from a workflow step to continue with a sub-DAG
+    (reference parity: python/ray/workflow/api.py:776)."""
+    return Continuation(dag)
 
 
 # ---------------------------------------------------------------- planning
@@ -68,112 +101,281 @@ def _topo_steps(dag: FunctionNode) -> List[FunctionNode]:
     return order
 
 
-def _step_ids(steps: List[FunctionNode]) -> Dict[int, str]:
+def _step_ids(steps: List[FunctionNode], prefix: str = "") -> Dict[int, str]:
     counts: Dict[str, int] = {}
     ids: Dict[int, str] = {}
     for s in steps:
         n = counts.get(s.name, 0)
         counts[s.name] = n + 1
-        ids[id(s)] = f"{s.name}_{n}"
+        ids[id(s)] = f"{prefix}{s.name}_{n}"
     return ids
 
 
 # ---------------------------------------------------------------- storage
+# All IO goes through the pyarrow-fs layer (train/storage.py) so the
+# base may be a cloud URI; local writes stay rename-atomic.
 
-def _write_json(path: str, data: dict) -> None:
+def _fs_and(path: str):
+    from ..train.storage import get_fs_and_path
+    return get_fs_and_path(path)
+
+
+_ENSURED_DIRS: set = set()
+
+
+def _write_bytes(path: str, data: bytes) -> None:
+    from ..train.storage import is_uri
+    if is_uri(path):
+        fs, p = _fs_and(path)
+        parent = posixpath.dirname(p)
+        # one create_dir per workflow dir per process, not per write
+        if parent and (id(fs), parent) not in _ENSURED_DIRS:
+            fs.create_dir(parent, recursive=True)
+            _ENSURED_DIRS.add((id(fs), parent))
+        with fs.open_output_stream(p) as f:
+            f.write(data)
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(data, f)
+    with open(tmp, "wb") as f:
+        f.write(data)
     os.replace(tmp, path)
 
 
+def _read_bytes(path: str) -> Optional[bytes]:
+    from ..train.storage import is_uri
+    if is_uri(path):
+        fs, p = _fs_and(path)
+        if fs.get_file_info([p])[0].type.name == "NotFound":
+            return None
+        with fs.open_input_stream(p) as f:
+            return f.read()
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _join(*parts: str) -> str:
+    from ..train.storage import join
+    return join(*parts)
+
+
+def _write_json(path: str, data: dict) -> None:
+    _write_bytes(path, json.dumps(data).encode())
+
+
 def _checkpoint(wf_dir: str, step_id: str, value: Any) -> None:
-    tmp = os.path.join(wf_dir, f"{step_id}.pkl.tmp")
-    with open(tmp, "wb") as f:
-        pickle.dump(value, f)
-    os.replace(tmp, os.path.join(wf_dir, f"{step_id}.pkl"))
+    _write_bytes(_join(wf_dir, f"{step_id}.pkl"), cloudpickle.dumps(value))
 
 
 def _load_checkpoint(wf_dir: str, step_id: str):
-    path = os.path.join(wf_dir, f"{step_id}.pkl")
-    if not os.path.exists(path):
+    blob = _read_bytes(_join(wf_dir, f"{step_id}.pkl"))
+    if blob is None:
         return False, None
-    with open(path, "rb") as f:
-        return True, pickle.load(f)
+    import pickle
+    return True, pickle.loads(blob)
 
 
 # --------------------------------------------------------------- execution
 
+class _StepFailed(Exception):
+    def __init__(self, step_id: str, cause: Exception):
+        super().__init__(f"workflow step {step_id!r} failed: {cause!r}")
+        self.step_id = step_id
+        self.cause = cause
+
+
+def _execute(wf_dir: str, dag: FunctionNode) -> Any:
+    """Event-driven scheduler over the DAG, with dynamic expansion.
+
+    State:
+      results[sid]  materialized step outputs (satisfy dependents)
+      pending[sid]  in-flight object refs
+      remaining     not yet launched
+      expansions    sid -> sub-root sid whose result resolves sid
+    """
+    import ray_tpu
+
+    steps = _topo_steps(dag)
+    ids = _step_ids(steps)
+    root_id = ids[id(dag)]
+    results: Dict[str, Any] = {}
+    pending: Dict[str, Any] = {}
+    retries_left: Dict[str, int] = {}
+    nodes: Dict[str, FunctionNode] = {}
+    remaining: Dict[str, FunctionNode] = {}
+    dep_ids: Dict[str, List[str]] = {}
+    expansions: Dict[str, str] = {}      # parent sid -> sub-root sid
+    cont_counts: Dict[str, int] = {}
+    progress = 0     # monotonic: launches + finishes + expansions
+
+    def add_steps(new_steps, new_ids):
+        for s in new_steps:
+            sid = new_ids[id(s)]
+            if sid in nodes:
+                continue
+            nodes[sid] = s
+            remaining[sid] = s
+            dep_ids[sid] = [new_ids[id(u)] for u in s._upstream()]
+            retries_left[sid] = int(
+                s.workflow_options.get("max_retries", 0))
+
+    add_steps(steps, ids)
+
+    def finish(sid: str, value: Any, error: Exception = None,
+               from_checkpoint: bool = False) -> None:
+        """A step's outcome arrived (raw value, continuation, or error):
+        expand, or materialize the (possibly catch-wrapped) result.
+
+        Owns ALL catch_exceptions handling so the option follows a step
+        through continuations: a sub-DAG failure walks up `expansions`
+        until a catching ancestor absorbs it (or fails the workflow),
+        and a sub-DAG success is wrapped at the catching parent — not
+        bypassed (checkpointed values are already final: no re-wrap)."""
+        nonlocal progress
+        progress += 1
+        node = nodes.get(sid)
+        catching = bool(node is not None
+                        and node.workflow_options.get("catch_exceptions"))
+        if error is not None and not (catching and not from_checkpoint):
+            parent = expansions.pop(sid, None)
+            if parent is not None:
+                finish(parent, None, error)
+                return
+            raise _StepFailed(sid, error)
+        if error is None and isinstance(value, Continuation):
+            n = cont_counts.get(sid, 0)
+            cont_counts[sid] = n + 1
+            # deterministic namespace so resume maps sub-checkpoints
+            prefix = f"{sid}+{n}."
+            sub_steps = _topo_steps(value.dag)
+            sub_ids = _step_ids(sub_steps, prefix=prefix)
+            # checkpoint the continuation itself: a resumed run
+            # re-expands without re-running the parent step
+            if not from_checkpoint:
+                _checkpoint(wf_dir, sid, value)
+            add_steps(sub_steps, sub_ids)
+            expansions[sub_ids[id(value.dag)]] = sid
+            return
+        if catching and not from_checkpoint:
+            final = (None, error) if error is not None else (value, None)
+        else:
+            final = value
+        if not from_checkpoint:
+            _checkpoint(wf_dir, sid, final)
+        results[sid] = final
+        # a completed sub-root resolves its parent: a catching sub-root
+        # absorbed/wrapped the outcome, so its FINAL is what the parent
+        # sees; otherwise the raw value flows up for the parent to apply
+        # its own policy
+        parent = expansions.pop(sid, None)
+        if parent is not None:
+            if catching and not from_checkpoint:
+                finish(parent, final)
+            else:
+                finish(parent, value)
+
+    def launch_ready() -> None:
+        for sid, node in list(remaining.items()):
+            if any(d not in results for d in dep_ids[sid]):
+                continue
+            del remaining[sid]
+            done, value = _load_checkpoint(wf_dir, sid)
+            if done:
+                finish(sid, value, from_checkpoint=True)
+                continue
+            fn = node.remote_fn
+
+            def resolve(v):
+                return results[ids_of(v)] if isinstance(v, FunctionNode) \
+                    else v
+
+            def ids_of(n):
+                # dep ids were precomputed; find via nodes mapping
+                for d in dep_ids[sid]:
+                    if nodes[d] is n:
+                        return d
+                raise KeyError(repr(n))
+
+            nonlocal progress
+            progress += 1
+            pending[sid] = fn.remote(
+                *[resolve(a) for a in node.args],
+                **{k: resolve(v) for k, v in node.kwargs.items()})
+
+    while True:
+        state = progress
+        launch_ready()
+        if root_id in results:
+            break
+        if not pending:
+            if not remaining:
+                break
+            if progress == state:
+                # no launch, no expansion, nothing in flight: the
+                # remaining steps' dependencies can never materialize —
+                # fail loudly instead of busy-spinning
+                raise RuntimeError(
+                    f"workflow deadlocked: steps {sorted(remaining)} "
+                    f"have unsatisfiable dependencies")
+            # resuming a chain of checkpointed continuations expands new
+            # steps inside launch_ready — give them a pass
+            continue
+        by_oid = {ref.id: sid for sid, ref in pending.items()}
+        ready, _ = ray_tpu.wait(list(pending.values()), num_returns=1)
+        for r in ready:
+            sid = by_oid[r.id]
+            node = nodes[sid]
+            del pending[sid]
+            try:
+                value = ray_tpu.get(r)
+            except Exception as e:
+                # step-level retries cover application exceptions AND
+                # worker crashes (reference: WorkflowStepRuntimeOptions
+                # max_retries)
+                if retries_left.get(sid, 0) > 0:
+                    retries_left[sid] -= 1
+                    remaining[sid] = node     # relaunch next pass
+                    continue
+                finish(sid, None, e)
+                continue
+            finish(sid, value)
+
+    return results[root_id]
+
+
 def run(dag: FunctionNode, workflow_id: Optional[str] = None) -> Any:
     """Execute the DAG durably; returns the root step's result. Re-running
     an existing workflow_id resumes it (completed steps are not re-run)."""
-    import ray_tpu
-
     if not isinstance(dag, FunctionNode):
         raise TypeError("workflow.run expects a fn.bind(...) DAG node")
     workflow_id = workflow_id or f"wf-{int(time.time())}-{os.getpid()}"
     wf_dir = storage_dir(workflow_id)
-    os.makedirs(wf_dir, exist_ok=True)
+    from ..train.storage import is_uri
+    if not is_uri(wf_dir):
+        os.makedirs(wf_dir, exist_ok=True)
 
-    steps = _topo_steps(dag)
-    ids = _step_ids(steps)
-    _write_json(os.path.join(wf_dir, "status.json"), {
+    _write_json(_join(wf_dir, "status.json"), {
         "workflow_id": workflow_id, "status": "RUNNING",
-        "num_steps": len(steps), "start_time": time.time(),
+        "start_time": time.time(),
     })
     # the DAG itself is persisted so resume() can re-execute it
     # (cloudpickle: DAGs routinely close over locally-defined functions)
-    with open(os.path.join(wf_dir, "dag.pkl"), "wb") as f:
-        cloudpickle.dump(dag, f)
-
-    results: Dict[str, Any] = {}
-    pending: Dict[str, Any] = {}        # step_id -> (ref, node)
-    remaining = {ids[id(s)]: s for s in steps}
-
-    def resolve(v):
-        if isinstance(v, FunctionNode):
-            return results[ids[id(v)]]
-        return v
+    _write_bytes(_join(wf_dir, "dag.pkl"), cloudpickle.dumps(dag))
 
     try:
-        while remaining or pending:
-            # launch every step whose deps are all materialized
-            for sid, node in list(remaining.items()):
-                deps = [ids[id(u)] for u in node._upstream()]
-                if any(d not in results for d in deps):
-                    continue
-                del remaining[sid]
-                done, value = _load_checkpoint(wf_dir, sid)
-                if done:
-                    results[sid] = value
-                    continue
-                ref = node.remote_fn.remote(
-                    *[resolve(a) for a in node.args],
-                    **{k: resolve(v) for k, v in node.kwargs.items()})
-                pending[sid] = ref
-            if not pending:
-                continue
-            by_oid = {ref.id: sid for sid, ref in pending.items()}
-            ready, _ = ray_tpu.wait(list(pending.values()), num_returns=1)
-            for r in ready:
-                sid = by_oid[r.id]
-                value = ray_tpu.get(r)
-                _checkpoint(wf_dir, sid, value)
-                results[sid] = value
-                del pending[sid]
+        output = _execute(wf_dir, dag)
     except Exception as e:
-        _write_json(os.path.join(wf_dir, "status.json"), {
+        _write_json(_join(wf_dir, "status.json"), {
             "workflow_id": workflow_id, "status": "FAILED",
-            "num_steps": len(steps), "num_done": len(results),
             "error": repr(e), "end_time": time.time(),
         })
         raise
 
-    output = results[ids[id(dag)]]
     _checkpoint(wf_dir, "__output__", output)
-    _write_json(os.path.join(wf_dir, "status.json"), {
+    _write_json(_join(wf_dir, "status.json"), {
         "workflow_id": workflow_id, "status": "SUCCESSFUL",
-        "num_steps": len(steps), "num_done": len(results),
         "end_time": time.time(),
     })
     return output
@@ -182,15 +384,14 @@ def run(dag: FunctionNode, workflow_id: Optional[str] = None) -> Any:
 def resume(workflow_id: str) -> Any:
     """Resume a previously started workflow from its checkpoints."""
     wf_dir = storage_dir(workflow_id)
-    dag_path = os.path.join(wf_dir, "dag.pkl")
-    if not os.path.exists(dag_path):
+    dag_blob = _read_bytes(_join(wf_dir, "dag.pkl"))
+    if dag_blob is None:
         raise ValueError(f"workflow {workflow_id!r} not found")
     done, output = _load_checkpoint(wf_dir, "__output__")
     if done:
         return output
-    with open(dag_path, "rb") as f:
-        dag = pickle.load(f)
-    return run(dag, workflow_id=workflow_id)
+    import pickle
+    return run(pickle.loads(dag_blob), workflow_id=workflow_id)
 
 
 def get_output(workflow_id: str) -> Any:
@@ -202,19 +403,29 @@ def get_output(workflow_id: str) -> Any:
 
 
 def get_status(workflow_id: str) -> str:
-    path = os.path.join(storage_dir(workflow_id), "status.json")
-    if not os.path.exists(path):
+    blob = _read_bytes(_join(storage_dir(workflow_id), "status.json"))
+    if blob is None:
         return "NOT_FOUND"
-    with open(path) as f:
-        return json.load(f)["status"]
+    return json.loads(blob)["status"]
 
 
 def list_all(status_filter: Optional[str] = None) -> List[tuple]:
+    from ..train.storage import is_uri
     base = storage_dir()
     out = []
-    if not os.path.isdir(base):
-        return out
-    for wid in sorted(os.listdir(base)):
+    if is_uri(base):
+        from pyarrow.fs import FileSelector
+        fs, p = _fs_and(base)
+        if fs.get_file_info([p])[0].type.name == "NotFound":
+            return out
+        wids = sorted(i.base_name for i in
+                      fs.get_file_info(FileSelector(p))
+                      if i.type.name == "Directory")
+    else:
+        if not os.path.isdir(base):
+            return out
+        wids = sorted(os.listdir(base))
+    for wid in wids:
         status = get_status(wid)
         if status == "NOT_FOUND":
             continue
@@ -224,5 +435,10 @@ def list_all(status_filter: Optional[str] = None) -> List[tuple]:
 
 
 def delete(workflow_id: str) -> None:
-    import shutil
-    shutil.rmtree(storage_dir(workflow_id), ignore_errors=True)
+    from ..train.storage import delete_dir, is_uri
+    path = storage_dir(workflow_id)
+    if is_uri(path):
+        delete_dir(path)
+    else:
+        import shutil
+        shutil.rmtree(path, ignore_errors=True)
