@@ -922,6 +922,11 @@ class Controller:
                 await asyncio.wait_for(
                     self.pool.get(addr).call("ping"), timeout=5.0)
                 verdict[addr] = "ok"
+            except (asyncio.TimeoutError, TimeoutError):
+                # Must precede OSError: on py>=3.11 asyncio.TimeoutError
+                # IS builtin TimeoutError, a subclass of OSError — a
+                # GIL-busy-but-alive driver would otherwise score 'dead'.
+                verdict[addr] = "slow"
             except (ConnectionError, OSError):
                 verdict[addr] = "dead"      # nothing listening: definitive
             except Exception:

@@ -100,22 +100,28 @@ class _ForkedProc:
         self.tag = tag
         self.returncode: Optional[int] = None
 
-    def _is_ours(self) -> bool:
-        """True iff self.pid still names OUR forked worker."""
+    def _identity(self) -> str:
+        """'ours' | 'not_ours' | 'unknown' for the current owner of
+        self.pid. 'unknown' covers zombies (environ reads empty — still
+        our child, awaiting the zygote's exit notice) and platforms
+        without /proc — never treat those as definitive either way."""
         if not self.tag:
-            return True       # no tag (legacy): trust the exit notices
+            return "ours"     # no tag (legacy): trust the exit notices
         try:
             with open(f"/proc/{self.pid}/environ", "rb") as f:
-                return self.tag.encode() in f.read()
+                data = f.read()
         except OSError:
-            return False      # gone, or not ours to inspect
+            return "unknown"
+        if not data:
+            return "unknown"  # zombie: environ is empty but pid is ours
+        return "ours" if self.tag.encode() in data else "not_ours"
 
     def kill(self) -> None:
         if self.returncode is not None:
             return   # already reaped: the pid may belong to someone else
-        if not self._is_ours():
+        if self._identity() == "not_ours":
             self.returncode = -1
-            return
+            return   # recycled pid: killing it would hit an innocent pg
         import signal as _signal
         for target in (lambda: os.killpg(self.pid, _signal.SIGKILL),
                        lambda: os.kill(self.pid, _signal.SIGKILL)):
@@ -128,7 +134,17 @@ class _ForkedProc:
     def poll(self) -> Optional[int]:
         if self.returncode is not None:
             return self.returncode
-        if not self._is_ours():
+        # Liveness comes from a signal-0 probe (works everywhere); the
+        # environ tag is only an identity guard on top — a recycled pid
+        # that probes alive but provably is not ours counts as dead.
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            self.returncode = -1
+            return -1
+        except OSError:
+            pass              # EPERM etc.: pid exists
+        if self._identity() == "not_ours":
             self.returncode = -1
             return -1
         return None
@@ -729,8 +745,20 @@ class NodeDaemon:
             cut = handle.batch_progress + 1
             started = [s for s in handle.current_batch[:cut]
                        if s and s.get("_leased")]
-            unstarted = [s for s in handle.current_batch[cut:]
+            # Members past the last DELIVERED marker are ambiguous: the
+            # worker sends a marker before every member, but the final
+            # oneway frame can die with the worker. Resubmission is only
+            # safe when re-execution is permitted — retriable members go
+            # back to the pump as unstarted (no retry consumed), while
+            # max_retries=0 members are failed like started ones:
+            # re-executing a possibly-started at-most-once task would
+            # break its guarantee.
+            ambiguous = [s for s in handle.current_batch[cut:]
                          if s and s.get("_leased")]
+            unstarted = [s for s in ambiguous
+                         if (s.get("max_retries") or 0) > 0]
+            started += [s for s in ambiguous
+                        if (s.get("max_retries") or 0) <= 0]
         elif (handle.current_task is not None
               and handle.current_task.get("_leased")):
             started, unstarted = [handle.current_task], []

@@ -339,15 +339,19 @@ class WorkerRuntime:
         except Exception:
             pass
         states = []
+        # Markers tell the daemon how far the batch got if this worker
+        # dies. For an all-retriable batch the daemon may safely resubmit
+        # ambiguous members, so a 50ms throttle keeps the tiny-task storm
+        # at ~zero marker frames; one max_retries=0 member forces a
+        # marker before EVERY member — a completed at-most-once member
+        # misclassified as unstarted would be re-executed. The final
+        # marker can still die with the worker; the daemon gates
+        # resubmission of ambiguous members on max_retries > 0.
+        has_amo = any((s.get("max_retries") or 0) <= 0 for s in specs)
         last_progress = time.monotonic()
         for i, spec in enumerate(specs):
-            if i > 0 and time.monotonic() - last_progress >= 0.05:
-                # progress marker: on worker death the daemon fails only
-                # members it believes started; the pump resubmits the
-                # rest without consuming retries. Time-throttled: a
-                # microseconds-per-task storm sends none (the whole
-                # batch is one blast-radius window anyway), while slow
-                # tasks get per-task attribution.
+            if i > 0 and (has_amo
+                          or time.monotonic() - last_progress >= 0.05):
                 try:
                     await daemon.oneway(
                         "leased_batch_progress",

@@ -16,6 +16,21 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
+# remote path -> downloaded local dir; one download per path per process,
+# all removed at interpreter exit
+_DOWNLOAD_CACHE: Dict[str, str] = {}
+
+
+def _purge_download_cache() -> None:
+    for local in _DOWNLOAD_CACHE.values():
+        shutil.rmtree(local, ignore_errors=True)
+    _DOWNLOAD_CACHE.clear()
+
+
+import atexit
+atexit.register(_purge_download_cache)
+
+
 class Checkpoint:
     """A handle to a checkpoint directory — local, or on any storage
     the pyarrow-fs layer resolves (gs://, s3://, mock://; see
@@ -36,11 +51,20 @@ class Checkpoint:
         return cls(path)
 
     def as_directory(self) -> str:
-        """A LOCAL directory with this checkpoint's content (downloads
-        remote checkpoints to a temp dir)."""
-        if self.is_remote:
-            return self.to_directory()
-        return self.path
+        """A LOCAL directory with this checkpoint's content.
+
+        Remote checkpoints are downloaded ONCE per remote path into a
+        process-wide cached temp dir removed at interpreter exit
+        (repeated pack()/load_pytree() calls must not re-download or
+        leak temp dirs; a per-instance finalizer would dangle the
+        returned path when the Checkpoint itself is short-lived)."""
+        if not self.is_remote:
+            return self.path
+        local = _DOWNLOAD_CACHE.get(self.path)
+        if local is None or not os.path.isdir(local):
+            local = self.to_directory()
+            _DOWNLOAD_CACHE[self.path] = local
+        return local
 
     def to_directory(self, dest: Optional[str] = None) -> str:
         dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")

@@ -235,11 +235,24 @@ def _execute(wf_dir: str, dag: FunctionNode) -> Any:
         bypassed (checkpointed values are already final: no re-wrap)."""
         nonlocal progress
         progress += 1
+        if sid in results:
+            # Already resolved — e.g. two failing sibling sub-steps both
+            # routing to the same catching ancestor: the first outcome
+            # won and was checkpointed; a second finish would overwrite
+            # it and diverge live-run vs resume.
+            return
         node = nodes.get(sid)
         catching = bool(node is not None
                         and node.workflow_options.get("catch_exceptions"))
         if error is not None and not (catching and not from_checkpoint):
             parent = expansions.pop(sid, None)
+            if parent is None and "+" in sid:
+                # `expansions` only maps sub-DAG ROOTS to their parent;
+                # a failing NON-root sub-step still has a catching
+                # chance at the expanding ancestor. Ids are namespaced
+                # `{parent}+{n}.{name}_{k}`, so the innermost expanding
+                # parent is everything before the last '+'.
+                parent = sid[:sid.rfind("+")]
             if parent is not None:
                 finish(parent, None, error)
                 return

@@ -200,3 +200,30 @@ def test_step_catch_exceptions(ray_start):
     r2 = workflow.run(ok.bind().options(catch_exceptions=True),
                       workflow_id="wf-catch2")
     assert r2 == (42, None)
+
+
+def test_catch_exceptions_absorbs_nonroot_substep_failure(ray_start):
+    """A failure in a NON-root step of a multi-step continuation must
+    route to the expanding parent's catch_exceptions policy (step ids
+    are namespaced `{parent}+{n}.`; only sub-DAG roots are in the
+    expansions map)."""
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("inner step failed")
+
+    @ray_tpu.remote
+    def mult(a, b):
+        return a * b
+
+    @ray_tpu.remote
+    def expand():
+        # boom.bind() is a NON-root dependency of the sub-DAG root
+        return workflow.continuation(mult.bind(2, boom.bind()))
+
+    result = workflow.run(expand.bind().options(catch_exceptions=True),
+                          workflow_id="wf-catch-sub")
+    assert result[0] is None and isinstance(result[1], Exception)
+
+    # without a catching ancestor the same failure fails the workflow
+    with pytest.raises(Exception, match="boom"):
+        workflow.run(expand.bind(), workflow_id="wf-catch-sub2")
