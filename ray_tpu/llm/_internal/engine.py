@@ -48,6 +48,11 @@ class EngineConfig:
     # "auto": Pallas paged-decode kernel on TPU, dense gather elsewhere.
     # Also accepts "gather" | "pallas" | "pallas_interpret".
     decode_impl: str = "auto"
+    # Tensor-parallel serving: a parallel.MeshSpec (tp>1) — params shard
+    # over heads/mlp/vocab, the KV page pool over kv_heads, and
+    # prefill/decode jit over the whole mesh (the reference reaches TP
+    # only by placing external vLLM workers, vllm_models.py:123-159).
+    mesh: Any = None
 
     def resolve_model(self) -> LlamaConfig:
         return llama.config(self.model)
@@ -112,16 +117,30 @@ class InferenceEngine:
         self.model_cfg = config.resolve_model()
         self.max_seq = config.max_seq_len or self.model_cfg.max_seq
         cfg, ec = self.model_cfg, config
+        self.mesh = self._build_mesh(ec.mesh, cfg)
         if params is None:
             params = llama.init_params(cfg, jax.random.PRNGKey(ec.seed))
-        self.params = jax.device_put(params)
+        if self.mesh is not None:
+            from ...parallel.sharding import shard_tree
+            self.params = shard_tree(
+                params, llama.param_logical_axes(cfg), self.mesh)
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._kv_sharding = NamedSharding(
+                self.mesh,
+                PartitionSpec(None, None, None, "tp", None))
+            self._repl = NamedSharding(self.mesh, PartitionSpec())
+        else:
+            self.params = jax.device_put(params)
+            self._kv_sharding = self._repl = None
         self.allocator = PageAllocator(ec.num_pages, ec.page_size)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
         kv_shape = (cfg.n_layers, ec.num_pages, ec.page_size,
                     cfg.n_kv_heads, cfg.head_dim)
-        self.k_pages = jnp.zeros(kv_shape, cfg.dtype)
-        self.v_pages = jnp.zeros(kv_shape, cfg.dtype)
-        self._key = jax.random.PRNGKey(ec.seed + 1)
+        self.k_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
+                                 self._kv_sharding)
+        self.v_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
+                                 self._kv_sharding)
+        self._key = self._dev(jax.random.PRNGKey(ec.seed + 1))
 
         self.slots = [_Slot(i) for i in range(ec.max_batch_size)]
         self.waiting: List[Request] = []
@@ -136,6 +155,47 @@ class InferenceEngine:
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
 
+    @staticmethod
+    def _build_mesh(spec, cfg: LlamaConfig):
+        """EngineConfig.mesh (MeshSpec | dict | None) -> jax Mesh | None."""
+        if spec is None:
+            return None
+        from ...parallel import MeshSpec
+        if isinstance(spec, dict):
+            spec = MeshSpec(**spec)
+        # Serving is TP-only today: resolve MeshSpec's training-oriented
+        # fsdp=-1 default to 1 and reject real parallelism on any other
+        # axis — replicated decode on dp>1 silently halves the fleet,
+        # and pp>1 would shard stacked layer params in a layout
+        # decode_step never consumes.
+        sizes = {k: (1 if v == -1 else v)
+                 for k, v in spec.axis_sizes().items()}
+        bad = {k: v for k, v in sizes.items() if k != "tp" and v > 1}
+        if bad:
+            raise ValueError(
+                f"engine mesh supports only the tp axis; got {bad}")
+        spec = MeshSpec(**sizes)
+        if spec.tp == 1:
+            return None
+        for name, dim in (("n_heads", cfg.n_heads),
+                          ("n_kv_heads", cfg.n_kv_heads),
+                          ("vocab_size", cfg.vocab_size)):
+            if dim % spec.tp:
+                raise ValueError(
+                    f"{name}={dim} not divisible by tp={spec.tp}")
+        devices = jax.devices()
+        if spec.tp > len(devices):
+            raise ValueError(
+                f"engine mesh needs {spec.tp} devices, have {len(devices)}")
+        return spec.build(devices[:spec.tp])
+
+    def _dev(self, x, sharding=None):
+        """device_put honoring the engine mesh (replicated by default)."""
+        if self.mesh is None:
+            return jax.device_put(x)
+        return jax.device_put(x, sharding if sharding is not None
+                              else self._repl)
+
     # -- compiled programs --------------------------------------------------
     def _build_decode(self):
         cfg = self.model_cfg
@@ -148,11 +208,13 @@ class InferenceEngine:
             impl = ("gather" if jax.devices()[0].platform == "cpu"
                     else "pallas")
 
+        mesh = self.mesh
+
         def step(params, k_pages, v_pages, tokens, positions, page_tables,
                  active, key, temps, top_ps, all_greedy):
             logits, k_pages, v_pages = decode_step(
                 cfg, params, tokens, positions, k_pages, v_pages,
-                page_tables, active, impl=impl)
+                page_tables, active, impl=impl, mesh=mesh)
             new_tokens = _sample(logits, key, temps, top_ps, all_greedy)
             return new_tokens, k_pages, v_pages
 
@@ -260,10 +322,12 @@ class InferenceEngine:
         p = req.params
         first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
             self.params, self.k_pages, self.v_pages,
-            jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
-            jnp.asarray(self._page_tables[slot.index:slot.index + 1]),
-            sub, jnp.asarray([p.temperature], jnp.float32),
-            jnp.asarray([p.top_p], jnp.float32))
+            self._dev(jnp.asarray(tokens)),
+            self._dev(jnp.asarray([n], jnp.int32)),
+            self._dev(jnp.asarray(
+                self._page_tables[slot.index:slot.index + 1])),
+            sub, self._dev(jnp.asarray([p.temperature], jnp.float32)),
+            self._dev(jnp.asarray([p.top_p], jnp.float32)))
         tok = int(first[0])
         slot.last_token = tok
         self._append_token(slot, tok, touched)
@@ -288,12 +352,12 @@ class InferenceEngine:
             active[s.index] = True
             temps[s.index] = s.request.params.temperature
             top_ps[s.index] = s.request.params.top_p
-        self._d_tokens = jnp.asarray(tokens)
-        self._d_positions = jnp.asarray(positions)
-        self._d_active = jnp.asarray(active)
-        self._d_temps = jnp.asarray(temps)
-        self._d_top_ps = jnp.asarray(top_ps)
-        self._d_tables = jnp.asarray(self._page_tables)
+        self._d_tokens = self._dev(jnp.asarray(tokens))
+        self._d_positions = self._dev(jnp.asarray(positions))
+        self._d_active = self._dev(jnp.asarray(active))
+        self._d_temps = self._dev(jnp.asarray(temps))
+        self._d_top_ps = self._dev(jnp.asarray(top_ps))
+        self._d_tables = self._dev(jnp.asarray(self._page_tables))
         self._all_greedy = bool(np.all(temps <= 0.0))
         self._host_active = active
 

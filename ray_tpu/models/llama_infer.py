@@ -13,6 +13,7 @@ Two entry points, both designed to jit once and stay compiled:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -107,7 +108,7 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 tokens: jax.Array, positions: jax.Array,
                 k_pages: jax.Array, v_pages: jax.Array,
                 page_tables: jax.Array, active: jax.Array,
-                impl: str = "gather"
+                impl: str = "gather", mesh=None
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole running batch.
 
@@ -122,6 +123,15 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
       "pallas"            stream pages through the Pallas decode kernel;
                           cost scales with each sequence's actual length.
       "pallas_interpret"  same kernel, interpreter mode (CPU tests).
+
+    mesh: a jax Mesh with a 'tp' axis for tensor-parallel serving
+    (params sharded on heads/mlp/vocab, KV pool on kv_heads — the
+    reference places external vLLM TP workers via PGs,
+    vllm_models.py:123-159; here TP is in-program GSPMD). The gather
+    impl partitions end-to-end via GSPMD; the Pallas kernel is wrapped
+    in shard_map over 'tp' (attention is per-head: no collectives
+    inside, psum on the projections happens in the surrounding GSPMD
+    program).
     """
     b = tokens.shape[0]
     dt = cfg.dtype
@@ -151,9 +161,25 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
         # kernel path merges it with one extra online-softmax step, the
         # gather path appends it to the dense context (append_len=1).
         if use_kernel:
-            attn = paged_decode_with_new_token(
-                q, k_l, v_l, page_tables, positions, k, v,
+            kernel = functools.partial(
+                paged_decode_with_new_token,
                 interpret=(impl == "pallas_interpret"))
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                # per-head attention: each tp shard runs the kernel on
+                # its local heads/kv-heads, no cross-shard comms
+                from jax.sharding import PartitionSpec as P
+                kernel = jax.shard_map(
+                    kernel, mesh=mesh,
+                    in_specs=(P(None, "tp", None),          # q (B,H,D)
+                              P(None, None, "tp", None),    # k pool
+                              P(None, None, "tp", None),    # v pool
+                              P(None, None),                # tables
+                              P(None),                      # positions
+                              P(None, "tp", None),          # new k
+                              P(None, "tp", None)),         # new v
+                    out_specs=P(None, "tp", None),
+                    check_vma=False)
+            attn = kernel(q, k_l, v_l, page_tables, positions, k, v)
         else:
             k_full = jnp.concatenate([k_l, k[:, None]], axis=1)
             v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
