@@ -243,6 +243,60 @@ def cmd_job(args) -> None:
         print(client.stop_job(args.job_id))
 
 
+def cmd_serve(args) -> None:
+    """`ray_tpu serve deploy/run/status/config/shutdown/delete`
+    (reference parity: serve/scripts.py CLI)."""
+    import ray_tpu
+    from ray_tpu import serve as serve_api
+    _attach()
+    try:
+        if args.serve_cmd == "deploy":
+            handles = serve_api.deploy_config(args.config_file)
+            for name in handles:
+                print(f"application {name!r} RUNNING")
+        elif args.serve_cmd == "run":
+            # import-path form: `serve run module:app`; YAML also accepted
+            if args.target.endswith((".yaml", ".yml")):
+                if args.name != "default" or args.route_prefix != "/":
+                    sys.exit("--name/--route-prefix apply to import-path "
+                             "targets only; set them inside the YAML")
+                serve_api.deploy_config(args.target)
+            else:
+                from ray_tpu.serve.schema import (ServeApplicationSchema,
+                                                  build_app_from_schema)
+                app = build_app_from_schema(
+                    ServeApplicationSchema(import_path=args.target,
+                                           name=args.name))
+                serve_api.run(app, name=args.name,
+                              route_prefix=args.route_prefix)
+            print("RUNNING (ctrl-c to exit)")
+            if args.blocking:
+                try:
+                    while True:
+                        time.sleep(3600)
+                except KeyboardInterrupt:
+                    pass
+        elif args.serve_cmd == "status":
+            print(json.dumps(serve_api.status(), indent=2, default=str))
+        elif args.serve_cmd == "config":
+            st = serve_api.status()
+            print(json.dumps(
+                {"applications": {
+                    name: {"route_prefix": app.get("route_prefix"),
+                           "deployments": sorted(app.get("deployments",
+                                                         {}))}
+                    for name, app in st.get("applications", {}).items()},
+                 }, indent=2, default=str))
+        elif args.serve_cmd == "delete":
+            serve_api.delete(args.name)
+            print(f"deleted application {args.name!r}")
+        elif args.serve_cmd == "shutdown":
+            serve_api.shutdown()
+            print("serve shut down")
+    finally:
+        ray_tpu.shutdown()
+
+
 # ------------------------------------------------------------------ parser
 
 def build_parser() -> argparse.ArgumentParser:
@@ -295,6 +349,22 @@ def build_parser() -> argparse.ArgumentParser:
         j.add_argument("job_id")
     jsub.add_parser("list")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("serve", help="declarative serve deploy/status")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("deploy", help="deploy applications from YAML")
+    s.add_argument("config_file")
+    s = ssub.add_parser("run", help="run an app (import path or YAML)")
+    s.add_argument("target", help="module:app import path or config.yaml")
+    s.add_argument("--name", default="default")
+    s.add_argument("--route-prefix", default="/")
+    s.add_argument("--blocking", action="store_true")
+    ssub.add_parser("status", help="application/deployment status")
+    ssub.add_parser("config", help="the running declarative config")
+    s = ssub.add_parser("delete", help="delete one application")
+    s.add_argument("name")
+    ssub.add_parser("shutdown", help="tear down all serve actors")
+    sp.set_defaults(fn=cmd_serve)
     return p
 
 

@@ -6,12 +6,14 @@ Reference parity: python/ray/serve — controller-reconciled deployments
 model multiplexing, request-driven autoscaling.
 """
 
-from .api import (Application, Deployment, delete, deployment,
-                  start_grpc,
+from .api import (Application, Deployment, delete, deploy_config,
+                  deployment, start_grpc,
                   get_app_handle, get_deployment_handle, run, shutdown,
                   start, status)
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .schema import (DeploymentSchema, ServeApplicationSchema,
+                     ServeDeploySchema)
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -20,7 +22,9 @@ from ._private.proxy import Request, Response, StreamingHint
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start",
     "start_grpc", "shutdown",
-    "delete", "status", "get_app_handle", "get_deployment_handle",
+    "delete", "deploy_config", "status", "get_app_handle",
+    "get_deployment_handle",
+    "ServeDeploySchema", "ServeApplicationSchema", "DeploymentSchema",
     "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "StreamingHint",
     "AutoscalingConfig",

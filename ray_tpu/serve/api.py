@@ -107,6 +107,39 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 
 # ---------------------------------------------------------------- app build
 
+def map_deployments(root: Application,
+                    fn: Callable[["Deployment"], "Deployment"]
+                    ) -> Application:
+    """Rebuild the bind graph with each node's Deployment mapped through
+    `fn`. The single graph walker shared by schema overrides and
+    runtime-env folding — handles Applications nested inside
+    tuple/list/dict args exactly like _build_app_specs.sub()."""
+    seen: Dict[int, Application] = {}
+
+    def sub(obj):
+        if isinstance(obj, Application):
+            return visit(obj)
+        if isinstance(obj, tuple):
+            return tuple(sub(x) for x in obj)
+        if isinstance(obj, list):
+            return [sub(x) for x in obj]
+        if isinstance(obj, dict):
+            return {k: sub(v) for k, v in obj.items()}
+        return obj
+
+    def visit(node: Application) -> Application:
+        if id(node) in seen:
+            return seen[id(node)]
+        new = Application(
+            fn(node._deployment),
+            tuple(sub(a) for a in node._args),
+            {k: sub(v) for k, v in node._kwargs.items()})
+        seen[id(node)] = new
+        return new
+
+    return visit(root)
+
+
 def _build_app_specs(root: Application, app_name: str
                      ) -> (str, List[Dict[str, Any]]):
     """Walk the bind graph; one spec per unique Application node, nested
@@ -228,12 +261,25 @@ def run(target: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
         _start_http: bool = True,
         http_options: Optional[HTTPOptions] = None,
-        timeout_s: float = 120.0) -> DeploymentHandle:
+        timeout_s: float = 120.0,
+        local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application and wait until it is RUNNING; returns the
-    ingress handle."""
+    ingress handle.
+
+    local_testing_mode=True runs every deployment in THIS process with
+    no cluster, controller, or proxy (reference parity:
+    serve/_private/local_testing_mode.py) — handle calls go straight to
+    in-process replicas; constructors run eagerly so init errors raise
+    here."""
     if not isinstance(target, Application):
         raise TypeError("serve.run expects a bound Application "
                         "(use MyDeployment.bind(...))")
+    if local_testing_mode:
+        from ._private import local_testing
+        ingress, specs = _build_app_specs(target, name)
+        local_testing.clear(name)
+        local_testing.deploy_local(name, ingress, specs)
+        return DeploymentHandle(ingress, name)
     if not ray_tpu.is_initialized():
         ray_tpu.init()
     if _start_http:
@@ -263,6 +309,50 @@ def run(target: Application, *, name: str = "default",
     return handle
 
 
+def deploy_config(config: Union[str, Dict[str, Any], "Any"], *,
+                  timeout_s: float = 120.0
+                  ) -> Dict[str, DeploymentHandle]:
+    """Declarative deploy (the `serve deploy app.yaml` path).
+
+    `config` is a YAML file path, a dict, or a ServeDeploySchema. Each
+    application's import_path is resolved, per-deployment overrides
+    applied, and the app deployed through the normal controller
+    reconcile; returns {app_name: ingress handle}. Reference parity:
+    serve/scripts.py `serve deploy` + schema.py ServeDeploySchema."""
+    from .schema import ServeDeploySchema, build_app_from_schema
+    if isinstance(config, str):
+        schema = ServeDeploySchema.from_yaml(config)
+    elif isinstance(config, dict):
+        schema = ServeDeploySchema.from_dict(config)
+    else:
+        schema = config
+    http = (HTTPOptions(**schema.http_options)
+            if schema.http_options else None)
+    handles: Dict[str, DeploymentHandle] = {}
+    for app in schema.applications:
+        target = build_app_from_schema(app)
+        if app.runtime_env:
+            target = _fold_runtime_env(target, app.runtime_env)
+        handles[app.name] = run(
+            target, name=app.name, route_prefix=app.route_prefix,
+            http_options=http, timeout_s=timeout_s)
+    return handles
+
+
+def _fold_runtime_env(root: Application, runtime_env: Dict[str, Any]
+                      ) -> Application:
+    """App-level runtime_env becomes the default for every deployment's
+    replica actors (per-deployment ray_actor_options.runtime_env wins)."""
+    def fold(dep: Deployment) -> Deployment:
+        opts = dict(dep.config.ray_actor_options)
+        if "runtime_env" in opts:
+            return dep
+        opts["runtime_env"] = dict(runtime_env)
+        return dep.options(ray_actor_options=opts)
+
+    return map_deployments(root, fold)
+
+
 def get_app_handle(name: str = "default") -> DeploymentHandle:
     controller = _get_controller(start=False)
     ingress = ray_tpu.get(controller.get_app_ingress.remote(name),
@@ -283,11 +373,19 @@ def status() -> Dict[str, Any]:
 
 
 def delete(name: str, _blocking: bool = True) -> None:
+    from ._private import local_testing
+    if local_testing.has_app(name):
+        local_testing.clear(name)
+        return
     controller = _get_controller(start=False)
     ray_tpu.get(controller.delete_application.remote(name), timeout=60)
 
 
 def shutdown() -> None:
+    from ._private import local_testing
+    local_testing.clear()
+    if not ray_tpu.is_initialized():
+        return
     try:
         controller = _get_controller(start=False)
     except ValueError:

@@ -217,8 +217,14 @@ class DeploymentHandle:
             multiplexed_model_id=self._model_id)
 
     def remote(self, *args, **kwargs):
-        router = _router_for(
-            deployment_key(self.app_name, self.deployment_name))
+        dep_key = deployment_key(self.app_name, self.deployment_name)
+        from ._private import local_testing
+        local = local_testing.get(dep_key)
+        if local is not None:
+            # local testing mode: straight to the in-process replica
+            return local.call(self._meta(), args, kwargs,
+                              stream=self._stream)
+        router = _router_for(dep_key)
         meta = self._meta()
         try:
             loop = asyncio.get_running_loop()
