@@ -40,28 +40,77 @@ def bench_engine(on_tpu: bool) -> dict:
                       num_pages=max(256, batch * 32), page_size=16)
     eng = InferenceEngine(ec)
     rng = np.random.default_rng(0)
+    reqs = []
     for i in range(batch):
-        eng.add_request(Request(
+        reqs.append(Request(
             request_id=f"r{i}",
             prompt_tokens=rng.integers(
                 1, cfg.vocab_size, prompt_len).tolist(),
             params=SamplingParams(max_tokens=gen)))
-    # Warm up: admit + prefill + first decode compile.
+        eng.add_request(reqs[-1])
+    # Warm up until the whole batch is decoding (all prefills done +
+    # first decode compiled) so the timed window is pure decode.
+    while any(not r.output_tokens for r in reqs):
+        eng.step()
     eng.step()
-    eng.step()
+    before = sum(len(r.output_tokens) for r in reqs)
     t0 = time.perf_counter()
     steps = 0
-    while steps < gen - 2 and eng.has_work():
+    while steps < gen - 8 and eng.has_work():
         eng.step()
         steps += 1
     dt = time.perf_counter() - t0
-    toks = steps * batch
+    toks = sum(len(r.output_tokens) for r in reqs) - before
     return {
         "decode_tokens_per_sec": round(toks / dt, 1),
         "decode_step_ms": round(dt / max(steps, 1) * 1e3, 2),
         "batch": batch, "prompt_len": prompt_len,
         "params": cfg.num_params(),
     }
+
+
+def bench_prefix_cache(on_tpu: bool) -> dict:
+    """Shared-prefix speedup: time-to-first-token of an identical prompt
+    when its prefix KV is cache-hot vs cold (VERDICT r3 #6)."""
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.config("tiny", vocab_size=32000, hidden=2048,
+                           n_layers=12, n_heads=16, n_kv_heads=8,
+                           head_dim=128, ffn=8192, max_seq=2048)
+        prompt_len, chunk = 1024, 256
+    else:
+        cfg = llama.config("debug")
+        prompt_len, chunk = 96, 32
+    eng = InferenceEngine(EngineConfig(
+        model=cfg, max_batch_size=2, num_pages=256,
+        max_prefill_tokens=chunk))
+    prompt = np.random.default_rng(1).integers(
+        1, cfg.vocab_size, prompt_len).tolist()
+
+    def ttft(rid):
+        req = Request(rid, list(prompt), SamplingParams(max_tokens=2))
+        eng.add_request(req)
+        t0 = time.perf_counter()
+        while not req.output_tokens:
+            eng.step()
+        dt = time.perf_counter() - t0
+        while not req.finished:
+            eng.step()
+        return dt
+
+    ttft("warmup")                       # compiles the cold chunk path
+    ttft("warmup-hot")                   # compiles the cache-hit suffix
+    eng.allocator.clear_cache()          # cold again (keep compiles)
+    cold = ttft("cold")
+    hot = ttft("hot")
+    return {"ttft_cold_ms": round(cold * 1e3, 2),
+            "ttft_cached_ms": round(hot * 1e3, 2),
+            "prefix_speedup": round(cold / max(hot, 1e-9), 2),
+            "hit_tokens": eng.allocator.cache_hit_tokens,
+            "prompt_len": prompt_len}
 
 
 def bench_kernel_scaling(on_tpu: bool) -> dict:
@@ -112,13 +161,15 @@ def main() -> None:
     on_tpu = dev.platform != "cpu"
     eng = bench_engine(on_tpu)
     scaling = bench_kernel_scaling(on_tpu)
+    prefix = bench_prefix_cache(on_tpu)
     print(json.dumps({
         "metric": "llm_decode_tokens_per_sec" if on_tpu
                   else "llm_decode_tokens_per_sec_cpu_fallback",
         "value": eng["decode_tokens_per_sec"],
         "unit": "tokens_per_sec",
         "detail": {"device": getattr(dev, "device_kind", str(dev)),
-                   **eng, "paged_kernel_scaling": scaling},
+                   **eng, "paged_kernel_scaling": scaling,
+                   "prefix_cache": prefix},
     }))
 
 

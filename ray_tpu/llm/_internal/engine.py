@@ -48,6 +48,12 @@ class EngineConfig:
     # "auto": Pallas paged-decode kernel on TPU, dense gather elsewhere.
     # Also accepts "gather" | "pallas" | "pallas_interpret".
     decode_impl: str = "auto"
+    # Chunked prefill: a prompt advances at most this many tokens per
+    # engine step, so one long prompt never stalls the running batch's
+    # decode ticks (SURVEY §7 hard part 1).
+    max_prefill_tokens: int = 512
+    # Hash-cons full prompt pages so shared prefixes skip re-prefill.
+    enable_prefix_caching: bool = True
     # Tensor-parallel serving: a parallel.MeshSpec (tp>1) — params shard
     # over heads/mlp/vocab, the KV page pool over kv_heads, and
     # prefill/decode jit over the whole mesh (the reference reaches TP
@@ -84,6 +90,8 @@ class _Slot:
         self.pages: List[int] = []
         self.position = 0        # tokens cached so far
         self.last_token = 0
+        self.prefill_pos = 0     # prompt tokens cached (< len => prefilling)
+        self.ready = False       # prompt fully prefilled, decoding
 
 
 def _sample(logits, key, temps, top_ps, all_greedy: bool = False):
@@ -132,7 +140,9 @@ class InferenceEngine:
         else:
             self.params = jax.device_put(params)
             self._kv_sharding = self._repl = None
-        self.allocator = PageAllocator(ec.num_pages, ec.page_size)
+        self.allocator = PageAllocator(
+            ec.num_pages, ec.page_size,
+            enable_prefix_caching=ec.enable_prefix_caching)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
         kv_shape = (cfg.n_layers, ec.num_pages, ec.page_size,
                     cfg.n_kv_heads, cfg.head_dim)
@@ -154,6 +164,8 @@ class InferenceEngine:
         self._d_tokens = None          # device-resident slot state
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
+        self._chunk_fns: Dict[int, Any] = {}
+        self._prefill_rr = 0           # round-robin cursor over slots
 
     @staticmethod
     def _build_mesh(spec, cfg: LlamaConfig):
@@ -167,10 +179,14 @@ class InferenceEngine:
         # fsdp=-1 default to 1 and reject real parallelism on any other
         # axis — replicated decode on dp>1 silently halves the fleet,
         # and pp>1 would shard stacked layer params in a layout
-        # decode_step never consumes.
-        sizes = {k: (1 if v == -1 else v)
-                 for k, v in spec.axis_sizes().items()}
-        bad = {k: v for k, v in sizes.items() if k != "tp" and v > 1}
+        # decode_step never consumes. tp=-1 keeps MeshSpec's documented
+        # "use remaining devices" meaning: all visible devices.
+        sizes = dict(spec.axis_sizes())
+        if sizes["tp"] == -1:
+            sizes["tp"] = len(jax.devices())
+        sizes["fsdp"] = 1 if sizes["fsdp"] == -1 else sizes["fsdp"]
+        bad = {k: v for k, v in sizes.items()
+               if k != "tp" and (v > 1 or v == -1)}
         if bad:
             raise ValueError(
                 f"engine mesh supports only the tp axis; got {bad}")
@@ -237,6 +253,35 @@ class InferenceEngine:
             self._prefill_fns[bucket] = fn
         return fn
 
+    def _chunk_fn(self, bucket: int, ctx_pages: int):
+        """Jitted prefill_chunk + first-token sampling, cached per
+        (chunk bucket, context-pages bucket) so dense-context cost
+        scales with the context that exists, not max_seq."""
+        fn = self._chunk_fns.get((bucket, ctx_pages))
+        if fn is None:
+            cfg = self.model_cfg
+            from ...models.llama_infer import prefill_chunk
+
+            def run(params, k_pages, v_pages, tokens, start_pos,
+                    chunk_lens, page_tables, key, temps, top_ps):
+                logits, k_pages, v_pages = prefill_chunk(
+                    cfg, params, tokens, start_pos, chunk_lens,
+                    k_pages, v_pages, page_tables, ctx_pages=ctx_pages)
+                first = _sample(logits, key, temps, top_ps)
+                return first, k_pages, v_pages
+
+            fn = jax.jit(run, donate_argnums=(1, 2))
+            self._chunk_fns[(bucket, ctx_pages)] = fn
+        return fn
+
+    def _ctx_bucket(self, start: int) -> int:
+        """Smallest power-of-two page count covering `start` tokens."""
+        need = self.allocator.pages_needed(start)
+        b = 1
+        while b < need:
+            b *= 2
+        return min(b, self.max_pages_per_seq) if need else 0
+
     def _bucket_for(self, n: int) -> int:
         for b in self.config.prefill_buckets:
             if n <= b and b <= self.max_seq:
@@ -267,12 +312,14 @@ class InferenceEngine:
         return sum(1 for s in self.slots if s.request is not None)
 
     def step(self) -> List[Request]:
-        """Admit + prefill new requests, one decode for the running
-        batch. Returns requests that produced a token this step (check
-        .finished / .output_tokens)."""
+        """Admit new requests, advance at most ONE prefill chunk, one
+        decode for the running batch — so a long prompt prefills across
+        steps while decode ticks keep flowing. Returns requests that
+        produced a token this step (check .finished / .output_tokens)."""
         touched: List[Request] = []
-        self._admit(touched)
-        if any(s.request is not None for s in self.slots):
+        self._admit()
+        self._advance_prefill(touched)
+        if any(s.ready for s in self.slots):
             self._decode(touched)
         return touched
 
@@ -289,8 +336,10 @@ class InferenceEngine:
         return reqs
 
     # -- internals ----------------------------------------------------------
-    def _admit(self, touched: List[Request]) -> None:
-        admitted = False
+    def _admit(self) -> None:
+        """Claim slots + KV pages for waiting requests (prefix-cache
+        match decides where their prefill starts); the prefill itself
+        advances chunk-by-chunk in _advance_prefill."""
         for slot in self.slots:
             if not self.waiting:
                 break
@@ -298,39 +347,93 @@ class InferenceEngine:
                 continue
             req = self.waiting[0]
             worst_case = len(req.prompt_tokens) + req.params.max_tokens
-            if not self.allocator.can_allocate(worst_case):
+            shared, matched = self.allocator.match_prefix(
+                req.prompt_tokens)
+            need = self.allocator.pages_needed(worst_case) - len(shared)
+            if need > self.allocator.free_pages:
+                self.allocator.free(shared)   # undo the match refs
                 break            # head-of-line admission control
             self.waiting.pop(0)
+            self.allocator.record_match(matched, len(req.prompt_tokens))
             slot.request = req
-            slot.pages = self.allocator.allocate(worst_case)
-            slot.position = len(req.prompt_tokens)
+            slot.pages = shared + self.allocator.allocate_pages(need)
+            slot.prefill_pos = matched
+            slot.ready = False
+            slot.position = 0
             table = np.zeros(self.max_pages_per_seq, np.int32)
             table[:len(slot.pages)] = slot.pages
             self._page_tables[slot.index] = table
-            self._prefill(slot, touched)
-            admitted = True
-        if admitted:
-            self._refresh_device_state()
 
-    def _prefill(self, slot: _Slot, touched: List[Request]) -> None:
+    def _advance_prefill(self, touched: List[Request]) -> None:
+        """Advance prefilling slots. While a decode batch is running,
+        ration to ONE chunk per step (the no-stall contract: decode
+        ticks keep flowing). With nothing decoding there is no cadence
+        to protect — drain every prefilling slot so a cold batch of
+        short prompts doesn't ramp one request per step."""
+        decoding = any(s.ready for s in self.slots)
+        B = len(self.slots)
+        for off in range(B):
+            slot = self.slots[(self._prefill_rr + off) % B]
+            if slot.request is not None and not slot.ready:
+                self._prefill_rr = (slot.index + 1) % B
+                self._prefill_one_chunk(slot, touched)
+                if decoding:
+                    return
+
+    def _prefill_one_chunk(self, slot: _Slot,
+                           touched: List[Request]) -> None:
         req = slot.request
         n = len(req.prompt_tokens)
-        bucket = self._bucket_for(n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = req.prompt_tokens
-        self._key, sub = jax.random.split(self._key)
         p = req.params
-        first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
+        self._key, sub = jax.random.split(self._key)
+        table = self._dev(jnp.asarray(
+            self._page_tables[slot.index:slot.index + 1]))
+        temps = self._dev(jnp.asarray([p.temperature], jnp.float32))
+        top_ps = self._dev(jnp.asarray([p.top_p], jnp.float32))
+
+        if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
+            # whole prompt in one go: the dense full-causal program
+            # (no pool gather — the common short-prompt fast path)
+            bucket = self._bucket_for(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_tokens
+            first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
+                self.params, self.k_pages, self.v_pages,
+                self._dev(jnp.asarray(tokens)),
+                self._dev(jnp.asarray([n], jnp.int32)),
+                table, sub, temps, top_ps)
+            self._finish_prefill(slot, int(first[0]), touched)
+            return
+
+        chunk = min(self.config.max_prefill_tokens, n - slot.prefill_pos)
+        bucket = self._bucket_for(chunk)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :chunk] = req.prompt_tokens[
+            slot.prefill_pos:slot.prefill_pos + chunk]
+        first, self.k_pages, self.v_pages = self._chunk_fn(
+            bucket, self._ctx_bucket(slot.prefill_pos))(
             self.params, self.k_pages, self.v_pages,
             self._dev(jnp.asarray(tokens)),
-            self._dev(jnp.asarray([n], jnp.int32)),
-            self._dev(jnp.asarray(
-                self._page_tables[slot.index:slot.index + 1])),
-            sub, self._dev(jnp.asarray([p.temperature], jnp.float32)),
-            self._dev(jnp.asarray([p.top_p], jnp.float32)))
-        tok = int(first[0])
-        slot.last_token = tok
-        self._append_token(slot, tok, touched)
+            self._dev(jnp.asarray([slot.prefill_pos], jnp.int32)),
+            self._dev(jnp.asarray([chunk], jnp.int32)),
+            table, sub, temps, top_ps)
+        slot.prefill_pos += chunk
+        if slot.prefill_pos >= n:
+            self._finish_prefill(slot, int(first[0]), touched)
+
+    def _finish_prefill(self, slot: _Slot, first_token: int,
+                        touched: List[Request]) -> None:
+        req = slot.request
+        n = len(req.prompt_tokens)
+        self.allocator.register_prefix(
+            req.prompt_tokens,
+            slot.pages[:n // self.allocator.page_size])
+        slot.prefill_pos = n
+        slot.position = n
+        slot.ready = True
+        slot.last_token = first_token
+        self._append_token(slot, first_token, touched)
+        self._refresh_device_state()
 
     def _refresh_device_state(self) -> None:
         """Re-upload slot state after an admit/finish. Between such
@@ -345,8 +448,8 @@ class InferenceEngine:
         temps = np.zeros(B, np.float32)
         top_ps = np.ones(B, np.float32)
         for s in self.slots:
-            if s.request is None:
-                continue
+            if s.request is None or not s.ready:
+                continue       # empty or still prefilling: inactive
             tokens[s.index] = s.last_token
             positions[s.index] = s.position
             active[s.index] = True
@@ -405,6 +508,8 @@ class InferenceEngine:
         slot.request = None
         slot.pages = []
         slot.position = 0
+        slot.prefill_pos = 0
+        slot.ready = False
         self._page_tables[slot.index] = 0
 
     def abort(self, request_id: str) -> bool:
@@ -433,4 +538,5 @@ class InferenceEngine:
             "waiting": len(self.waiting),
             "free_pages": self.allocator.free_pages,
             "total_pages": self.allocator.num_usable,
+            **self.allocator.stats(),
         }

@@ -102,6 +102,94 @@ def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     return logits, k_pages, v_pages
 
 
+def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
+                  tokens: jax.Array, start_pos: jax.Array,
+                  chunk_lens: jax.Array, k_pages: jax.Array,
+                  v_pages: jax.Array, page_tables: jax.Array,
+                  ctx_pages: int = -1
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill a CHUNK of each prompt against already-cached context.
+
+    Powers chunked prefill (long prompts advance max_prefill_tokens per
+    engine step so decode ticks never stall behind them) and prefix-
+    cache hits (the un-matched suffix prefills against the shared
+    pages). tokens: (B, C) padded chunk; start_pos: (B,) tokens already
+    in the pool; chunk_lens: (B,) valid tokens in this chunk.
+
+    Returns (last_logits (B, V) f32 — logits at the chunk's final valid
+    token, k_pages, v_pages) with the chunk's KV scattered in at
+    positions start_pos + [0, chunk_lens).
+
+    ctx_pages (static): gather/attend only the first ctx_pages table
+    entries — the caller buckets ceil(max(start_pos)/page_size) so the
+    dense context cost scales with the context that EXISTS, not
+    max_seq (-1 = the whole table). Scatter always uses the full table.
+    """
+    from ..ops.paged_attention import chunk_attention_on_gathered
+
+    b, c = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    positions = start_pos[:, None] + jnp.arange(c)[None, :]      # (B, C)
+    cos, sin = rope_frequencies(cfg, positions.reshape(-1))
+    cos = cos.reshape(b, c, -1)
+    sin = sin.reshape(b, c, -1)
+
+    def rope(t):
+        d = t.shape[-1]
+        t1, t2 = t[..., : d // 2], t[..., d // 2:]
+        c_, s_ = cos[:, :, None, :], sin[:, :, None, :]
+        tf1, tf2 = t1.astype(jnp.float32), t2.astype(jnp.float32)
+        return jnp.concatenate(
+            [tf1 * c_ - tf2 * s_, tf2 * c_ + tf1 * s_],
+            axis=-1).astype(t.dtype)
+
+    # one dense gather of the cached context for all layers (layer-major)
+    ctx_tables = (page_tables if ctx_pages < 0
+                  else page_tables[:, :ctx_pages])
+    k_ctx_all, v_ctx_all = gather_kv(k_pages, v_pages, ctx_tables)
+
+    def layer_fn(x, inp):
+        layer, k_ctx, v_ctx = inp
+        y = rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = (y @ layer["wq"].astype(dt)).reshape(
+            b, c, cfg.n_heads, cfg.head_dim)
+        k = (y @ layer["wk"].astype(dt)).reshape(
+            b, c, cfg.n_kv_heads, cfg.head_dim)
+        v = (y @ layer["wv"].astype(dt)).reshape(
+            b, c, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q)
+        k = rope(k)
+        attn = chunk_attention_on_gathered(
+            q, k_ctx, v_ctx, k, v, start_pos, chunk_lens)
+        x = x + attn.reshape(b, c, cfg.q_dim) @ layer["wo"].astype(dt)
+        y = rms_norm(x, layer["ln2"], cfg.norm_eps)
+        gate = jax.nn.silu(y @ layer["wg"].astype(dt))
+        up = y @ layer["wi"].astype(dt)
+        x = x + (gate * up) @ layer["wd"].astype(dt)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_ctx_all, v_ctx_all))
+    # ks/vs: (L, B, C, KVH, D) -> token-major (B*C, L, KVH, D)
+    k_rows = jnp.transpose(ks, (1, 2, 0, 3, 4)).reshape(
+        b * c, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+    v_rows = jnp.transpose(vs, (1, 2, 0, 3, 4)).reshape(
+        b * c, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+    flat_pos = positions.reshape(-1)
+    valid = (jnp.arange(c)[None, :] < chunk_lens[:, None]).reshape(-1)
+    tables = jnp.repeat(page_tables, c, axis=0)
+    k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
+                                  tables, flat_pos, valid)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(chunk_lens - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    logits = last.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, k_pages, v_pages
+
+
 # -------------------------------------------------------------------- decode
 
 def decode_step(cfg: LlamaConfig, params: Dict[str, Any],

@@ -93,6 +93,47 @@ def paged_attention_on_gathered(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, h, d).astype(q.dtype)
 
 
+def chunk_attention_on_gathered(q: jax.Array, k_ctx: jax.Array,
+                                v_ctx: jax.Array, k_chunk: jax.Array,
+                                v_chunk: jax.Array, start: jax.Array,
+                                chunk_lens: jax.Array) -> jax.Array:
+    """Multi-token-query attention over cached context + the chunk itself
+    (chunked prefill / prefix-cache suffix prefill).
+
+    q: [B, C, H, D] queries at absolute positions start[b]+i;
+    k_ctx/v_ctx: [B, ctx, KVH, D] gathered pool (valid: pos < start[b]);
+    k_chunk/v_chunk: [B, C, KVH, D] the chunk's own KV;
+    chunk_lens: [B] valid tokens in the chunk.
+    Query i attends ctx positions < start[b] and chunk positions j <= i
+    (j < chunk_lens[b]). Softmax in float32. Returns [B, C, H, D].
+    """
+    b, c, h, d = q.shape
+    ctx, kvh = k_ctx.shape[1], k_ctx.shape[2]
+    group = h // kvh
+    qf = q.reshape(b, c, kvh, group, d).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(d)
+    s_ctx = jnp.einsum("bikgd,bckd->bkgic", qf, k_ctx.astype(jnp.float32))
+    s_chk = jnp.einsum("bikgd,bjkd->bkgij", qf,
+                       k_chunk.astype(jnp.float32))
+    ctx_mask = (jnp.arange(ctx)[None, :] < start[:, None])     # [B, ctx]
+    i_idx = jnp.arange(c)[:, None]
+    j_idx = jnp.arange(c)[None, :]
+    chk_mask = ((j_idx <= i_idx)[None]
+                & (j_idx[None] < chunk_lens[:, None, None]))   # [B, C, C]
+    s_ctx = jnp.where(ctx_mask[:, None, None, None, :],
+                      s_ctx * scale, -jnp.inf)
+    s_chk = jnp.where(chk_mask[:, None, None, :, :],
+                      s_chk * scale, -jnp.inf)
+    scores = jnp.concatenate([s_ctx, s_chk], axis=-1)  # [B,KVH,G,C,ctx+C]
+    probs = jax.nn.softmax(scores, axis=-1)
+    p_ctx, p_chk = probs[..., :ctx], probs[..., ctx:]
+    out = (jnp.einsum("bkgic,bckd->bikgd", p_ctx,
+                      v_ctx.astype(jnp.float32))
+           + jnp.einsum("bkgij,bjkd->bikgd", p_chk,
+                        v_chunk.astype(jnp.float32)))
+    return out.reshape(b, c, h, d).astype(q.dtype)
+
+
 def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                          m_ref, l_ref, m_scr, l_scr, acc_scr, *,
                          page_size: int, scale: float, kvh: int):
