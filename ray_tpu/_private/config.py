@@ -59,6 +59,12 @@ class RayTpuConfig:
     #: pip runtime_env local wheel index (offline installs)
     pip_find_links: Optional[str] = _f(
         "RAY_TPU_PIP_FIND_LINKS", None, str)
+    #: command prefix (space-separated) wrapping worker spawns for
+    #: image_uri runtime envs, e.g. "podman run --rm -v /tmp:/tmp
+    #: {image}" — "{image}" substitutes the env's image_uri. Empty =
+    #: no container runtime on this node (image_uri envs fail to spawn).
+    container_run_prefix: Optional[str] = _f(
+        "RAY_TPU_CONTAINER_RUN_PREFIX", None, str)
 
     # -- function store --------------------------------------------------
     #: code blobs larger than this are exported once to the controller KV

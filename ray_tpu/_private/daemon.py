@@ -218,6 +218,7 @@ class NodeDaemon:
         self._worker_waiters: Dict[str, "deque[asyncio.Future]"] = {}
         self._spawning: Dict[str, int] = {}
         self._runtime_envs: Dict[str, Optional[dict]] = {"": None}
+        self._env_manager = None   # lazy RuntimeEnvPluginManager
         self._max_concurrent_spawns = max(2, (os.cpu_count() or 1) // 2)
         self._register_events: Dict[str, asyncio.Event] = {}
         self._monitor_task: Optional[asyncio.Task] = None
@@ -303,77 +304,15 @@ class NodeDaemon:
     # --------------------------------------------------------- worker pool
 
     async def _prepare_runtime_env(self, runtime_env: Optional[dict]):
-        """Materialize a runtime env (reference parity:
-        python/ray/_private/runtime_env/plugin.py:24,118 — env_vars,
-        working_dir, py_modules, pip plugins). Returns
-        (env_vars, extra_pythonpath, cwd)."""
-        if not runtime_env:
-            return {}, [], None
-        env_vars = dict(runtime_env.get("env_vars") or {})
-        extra_path: List[str] = []
-        cwd = None
-        wd = runtime_env.get("working_dir")
-        if wd:
-            wd = os.path.abspath(wd)
-            if not os.path.isdir(wd):
-                raise RuntimeError(f"runtime_env working_dir {wd!r} "
-                                   "does not exist on this node")
-            cwd = wd
-            extra_path.append(wd)
-        for mod in runtime_env.get("py_modules") or []:
-            mod = os.path.abspath(mod)
-            if not os.path.exists(mod):
-                raise RuntimeError(f"runtime_env py_module {mod!r} "
-                                   "does not exist on this node")
-            # a module's import root is its parent directory (works for
-            # both package dirs and single .py files)
-            extra_path.append(os.path.dirname(mod))
-        pip_pkgs = runtime_env.get("pip")
-        if pip_pkgs:
-            import fcntl
-            import hashlib
-            key = hashlib.sha1(
-                runtime_env_key({"pip": pip_pkgs}).encode()).hexdigest()[:16]
-            target = os.path.join(self.temp_dir, "runtime_envs", key)
-            marker = os.path.join(target, ".ready")
-            if not os.path.exists(marker):
-                os.makedirs(target, exist_ok=True)
-                # flock serializes concurrent installs of the SAME env —
-                # both across this daemon's parallel spawns and across
-                # daemons sharing the session temp dir (pip does not lock
-                # --target installs itself). Held in a thread so the
-                # event loop never blocks.
-                lock_path = target + ".lock"
-
-                def _locked_install():
-                    with open(lock_path, "w") as lock:
-                        fcntl.flock(lock, fcntl.LOCK_EX)
-                        if os.path.exists(marker):
-                            return 0, b""
-                        cmd = [sys.executable, "-m", "pip", "install",
-                               "--target", target, "--quiet"]
-                        from .config import get_config as _gc
-                        find_links = _gc().pip_find_links
-                        if find_links:
-                            cmd += ["--no-index", "--find-links",
-                                    find_links]
-                        cmd += list(pip_pkgs)
-                        proc = subprocess.run(
-                            cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT)
-                        if proc.returncode == 0:
-                            with open(marker, "w") as f:
-                                f.write("ok")
-                        return proc.returncode, proc.stdout
-
-                rc, out = await asyncio.get_running_loop().run_in_executor(
-                    None, _locked_install)
-                if rc != 0:
-                    raise RuntimeError(
-                        f"runtime_env pip install failed (rc={rc}): "
-                        f"{out.decode(errors='replace')[-2000:]}")
-            extra_path.append(target)
-        return env_vars, extra_path, cwd
+        """Materialize a runtime env through the plugin manager
+        (reference parity: python/ray/_private/runtime_env/plugin.py:118
+        RuntimeEnvPluginManager; built-ins env_vars/working_dir/
+        py_modules/pip/conda/uv/image_uri + externally registered
+        plugins). Returns a RuntimeEnvContext."""
+        from .runtime_env import RuntimeEnvPluginManager
+        if self._env_manager is None:
+            self._env_manager = RuntimeEnvPluginManager(self.temp_dir)
+        return await self._env_manager.build(runtime_env)
 
     def _worker_pythonpath(self, extra_path,
                            existing: Optional[str] = None) -> str:
@@ -507,17 +446,19 @@ class NodeDaemon:
         worker_id = WorkerID.generate().hex()
         log_path = self._worker_log_path(worker_id)
         runtime_env = self._runtime_envs.get(env_key)
-        env_vars, extra_path, cwd = await self._prepare_runtime_env(
-            runtime_env)
+        ctx = await self._prepare_runtime_env(runtime_env)
+        env_vars, extra_path, cwd = ctx.env_vars, ctx.extra_paths, ctx.cwd
         from .config import get_config
         proc = None
         # Env vars that act at interpreter/import time (jax/XLA config,
         # python startup) cannot take effect in a fork of the pre-warmed
-        # zygote — those workers must cold-spawn.
+        # zygote — those workers must cold-spawn. So must conda/uv envs
+        # (different interpreter) and containerized workers.
         import_sensitive = any(
             k.startswith(("JAX_", "XLA_", "PYTHON", "LD_", "TPU_"))
             for k in env_vars)
-        if get_config().worker_forkserver and not import_sensitive:
+        if (get_config().worker_forkserver and not import_sensitive
+                and ctx.py_executable is None and ctx.container is None):
             try:
                 proc = await self._fork_worker(
                     worker_id, env_vars, extra_path, cwd, log_path)
@@ -525,19 +466,32 @@ class NodeDaemon:
                 logger.exception("zygote fork failed; cold-spawning")
                 proc = None
         if proc is None:
-            log_file = open(log_path, "ab")
+            argv = ([ctx.py_executable or sys.executable, "-m",
+                     "ray_tpu._private.worker_main"]
+                    + self._worker_argv(worker_id))
+            if ctx.container is not None:
+                # image_uri stub: a configured container runtime wraps
+                # the spawn; bare nodes fail loudly (the GKE/KubeRay
+                # integration supplies the prefix in production)
+                prefix = get_config().container_run_prefix
+                if not prefix:
+                    raise RuntimeError(
+                        "runtime_env image_uri requires a container "
+                        "runtime (set RAY_TPU_CONTAINER_RUN_PREFIX or "
+                        "run under the KubeRay/GKE integration)")
+                argv = [p.replace("{image}", ctx.container["image_uri"])
+                        for p in prefix.split()] + argv
             env = dict(os.environ)
             env.update(self.worker_env)
             env.update(env_vars)
             env["RAY_TPU_SESSION"] = self.session_name
             env["PYTHONPATH"] = self._worker_pythonpath(
                 extra_path, env.get("PYTHONPATH"))
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"]
-                + self._worker_argv(worker_id),
-                stdout=log_file, stderr=subprocess.STDOUT, env=env,
-                cwd=cwd, start_new_session=True)
-            log_file.close()
+            with open(log_path, "ab") as log_file:
+                proc = subprocess.Popen(
+                    argv,
+                    stdout=log_file, stderr=subprocess.STDOUT, env=env,
+                    cwd=cwd, start_new_session=True)
         handle = WorkerHandle(worker_id, proc, env_key)
         self.workers[worker_id] = handle
         ev = asyncio.Event()

@@ -78,19 +78,26 @@ def cmd_start(args) -> None:
           + (f"  client proxy: {client_addr}\n" if client_addr else "")
           + f"Attach with ray_tpu.init(address={address!r}); stop with "
           f"`ray_tpu stop`.")
-    if args.block:
-        try:
+    # Install handlers EXPLICITLY: a head launched as a shell background
+    # job inherits SIGINT=SIG_IGN (POSIX), and CPython keeps an inherited
+    # SIG_IGN — `ray_tpu stop`'s SIGINT would be silently dropped and the
+    # head (plus its shm arena) would live forever.
+    def _graceful(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGINT, _graceful)
+    signal.signal(signal.SIGTERM, _graceful)
+    try:
+        if args.block:
             while True:
                 time.sleep(3600)
-        except KeyboardInterrupt:
-            pass
-        ray_tpu.shutdown()
-    else:
-        # stay alive as the head process in the background
-        try:
-            signal.pause()
-        except KeyboardInterrupt:
-            ray_tpu.shutdown()
+        else:
+            # stay alive as the head process in the background
+            while True:
+                signal.pause()
+    except KeyboardInterrupt:
+        pass
+    ray_tpu.shutdown()
 
 
 def _run_worker_node(args) -> None:
@@ -148,11 +155,30 @@ def cmd_stop(args) -> None:
         print("no cluster-address file; nothing to stop")
         return
     pid = info.get("pid")
-    try:
-        os.kill(pid, signal.SIGINT)
-        print(f"sent SIGINT to head process {pid}")
-    except ProcessLookupError:
-        print(f"head process {pid} already gone")
+
+    def _alive() -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+
+    # escalate INT -> TERM -> KILL so a head that inherited SIG_IGN (or
+    # wedged in shutdown) still dies and frees its shm arena
+    for sig, wait_s in ((signal.SIGINT, 5.0), (signal.SIGTERM, 5.0),
+                        (signal.SIGKILL, 2.0)):
+        if not _alive():
+            break
+        try:
+            os.kill(pid, sig)
+            print(f"sent {signal.Signals(sig).name} to head process {pid}")
+        except ProcessLookupError:
+            break
+        deadline = time.time() + wait_s
+        while _alive() and time.time() < deadline:
+            time.sleep(0.1)
+    if _alive():
+        print(f"warning: head process {pid} survived SIGKILL escalation")
     try:
         os.remove(ADDR_FILE)
     except FileNotFoundError:
