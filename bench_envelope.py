@@ -120,6 +120,63 @@ def bench_nn_storm(n_callers: int, n_callees: int, calls: int) -> dict:
             "total_s": round(dt, 1)}
 
 
+def bench_nn_multidaemon(n_nodes: int, n_callers: int, n_callees: int,
+                         calls: int) -> dict:
+    """The n:n storm with callers/callees SPREAD over real daemon
+    PROCESSES (VERDICT r3 #3): every pong crosses process + socket
+    boundaries, the shape where the single controller loop and the GIL
+    collide. Reference baseline: n_n_actor_calls_async 27,210/s on 64
+    cores (~425/s/core)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    # symmetric 4-CPU nodes: a pre-existing big head would skew the
+    # spread AND keep traffic in-process — this row must cross sockets
+    ray_tpu.shutdown()
+    with Cluster(head_cpus=4) as cluster:
+        for _ in range(n_nodes - 1):
+            cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(n_nodes)
+
+        @ray_tpu.remote(num_cpus=0.4, scheduling_strategy="SPREAD")
+        class Callee:
+            def pong(self, x):
+                return x
+
+            def where(self):
+                return ray_tpu.get_runtime_context().get_node_id()
+
+        @ray_tpu.remote(num_cpus=0.4, scheduling_strategy="SPREAD")
+        class Caller:
+            def __init__(self, callees):
+                self.callees = callees
+
+            def storm(self, calls):
+                refs = []
+                for i in range(calls):
+                    refs.append(self.callees[i % len(self.callees)]
+                                .pong.remote(i))
+                return len(ray_tpu.get(refs))
+
+        callees = [Callee.remote() for _ in range(n_callees)]
+        callers = [Caller.remote(callees) for _ in range(n_callers)]
+        nodes_used = len(set(ray_tpu.get([c.where.remote()
+                                          for c in callees])))
+        ray_tpu.get([c.storm.remote(4) for c in callers])   # warm
+        t0 = time.time()
+        done = ray_tpu.get([c.storm.remote(calls) for c in callers],
+                           timeout=1800)
+        dt = time.time() - t0
+        total = sum(done)
+        for a in callers + callees:
+            ray_tpu.kill(a)
+    return {"row": "nn_multidaemon", "nodes": n_nodes,
+            "callee_nodes_used": nodes_used,
+            "callers": n_callers, "callees": n_callees,
+            "total_calls": total, "calls_per_s": round(total / dt, 1),
+            "total_s": round(dt, 1)}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -144,6 +201,9 @@ def main() -> None:
             print(json.dumps(rows[-1]), flush=True)
         if "nn_storm" in wanted:
             rows.append(bench_nn_storm(8, 8, 500 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "nn_multi" in wanted:
+            rows.append(bench_nn_multidaemon(4, 8, 8, 500 // scale))
             print(json.dumps(rows[-1]), flush=True)
     finally:
         ray_tpu.shutdown()

@@ -150,6 +150,7 @@ class Controller:
         self.leases: Dict[str, dict] = {}
         self.subscribers: Dict[str, List[Tuple[str, int]]] = {}
         self.pending: List[dict] = []          # specs waiting for resources
+        self._spread_cursor = 0                # SPREAD round-robin state
         # task_id -> (node_id, resources, spec)
         self.running: Dict[str, Tuple[str, Dict[str, float], dict]] = {}
         # task-event table backing the state API (reference: GCS task
@@ -738,7 +739,16 @@ class Controller:
         fitting = [n for n in candidates if n.fits(req)]
         if not fitting:
             return None
-        node = _pick_hybrid(fitting)
+        if strategy.get("type") == "spread":
+            # SPREAD strategy: true round-robin over fitting nodes
+            # (reference scheduling_policy.h SpreadSchedulingPolicy) —
+            # utilization-based picks degenerate to packing for
+            # zero-resource requests, which never move utilization
+            fitting.sort(key=lambda n: n.node_id)
+            self._spread_cursor += 1
+            node = fitting[self._spread_cursor % len(fitting)]
+        else:
+            node = _pick_hybrid(fitting)
         node.acquire(req)
         return await self._dispatch(spec, node,
                                     lambda: node.release(req))

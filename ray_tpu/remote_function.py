@@ -33,10 +33,25 @@ def normalize_scheduling(opts: Dict[str, Any]) -> Dict[str, Any]:
     opts = dict(opts)
     strategy = opts.get("scheduling_strategy")
     pg = opts.pop("placement_group", None)
+    if pg is not None and strategy is not None:
+        raise ValueError(
+            "placement_group and scheduling_strategy are mutually "
+            "exclusive (use PlacementGroupSchedulingStrategy)")
     if pg is not None and strategy is None:
         strategy = {"type": "placement_group",
                     "placement_group": getattr(pg, "id", pg),
                     "bundle_index": opts.pop("placement_group_bundle_index", -1)}
+    elif isinstance(strategy, str):
+        # reference parity: the literals "DEFAULT" and "SPREAD"
+        # (python/ray/util/scheduling_strategies.py SchedulingStrategyT)
+        if strategy == "DEFAULT":
+            strategy = None
+        elif strategy == "SPREAD":
+            strategy = {"type": "spread"}
+        else:
+            raise ValueError(
+                f"unknown scheduling_strategy {strategy!r} "
+                f"(strings: 'DEFAULT' | 'SPREAD')")
     elif strategy is not None and not isinstance(strategy, dict):
         strategy = strategy.to_dict()
     opts["scheduling_strategy"] = strategy

