@@ -691,40 +691,14 @@ class CoreClient:
         """Pull an object's bytes from its node daemon — chunked above the
         threshold so a multi-GiB object is never one RPC frame (reference
         parity: ObjectManager chunked push/pull, object_manager.h:208-216)."""
-        node = self.pool.get(loc.node_addr)
+        from .transfer import fetch_flat
         try:
-            # Cross-node transfer knobs read at use time (config is
-            # instantiated on first use, honoring late env changes).
-            chunk_bytes = get_config().fetch_chunk_bytes
-            chunk_window = get_config().fetch_chunk_window
-            if loc.size <= chunk_bytes:
-                reply = await node.call("fetch_object", object_id=object_id)
-                if reply is None:
-                    raise ObjectLostError(
-                        f"object {object_id[:12]} not on node")
-                return SerializedObject.from_flat(reply).deserialize()
-            meta = await node.call("fetch_object_meta", object_id=object_id)
-            if meta is None:
-                raise ObjectLostError(f"object {object_id[:12]} not on node")
-            size = meta["size"]
-            buf = bytearray(size)
-            sem = asyncio.Semaphore(chunk_window)
-
-            async def pull(offset: int):
-                async with sem:
-                    chunk = await node.call(
-                        "fetch_object_chunk", object_id=object_id,
-                        offset=offset,
-                        length=min(chunk_bytes, size - offset))
-                if chunk is None:
-                    raise ObjectLostError(
-                        f"object {object_id[:12]} vanished mid-transfer")
-                buf[offset:offset + len(chunk)] = chunk
-
-            await asyncio.gather(*[
-                pull(off) for off in range(0, size, chunk_bytes)])
+            flat = await fetch_flat(self.pool.get(loc.node_addr),
+                                    object_id, loc.size)
             # from_flat wraps a memoryview: no second multi-GiB copy
-            return SerializedObject.from_flat(buf).deserialize()
+            return SerializedObject.from_flat(flat).deserialize()
+        except ConnectionError as e:
+            raise ObjectLostError(str(e))
         except (ConnectionLost, OSError):
             raise ObjectLostError(
                 f"node holding object {object_id[:12]} is gone")
@@ -1120,6 +1094,10 @@ class CoreClient:
             # surfaced so the daemon's OOM kill policy can prefer
             # retriable victims (worker_killing_policy.h:39)
             "max_retries": opts.get("max_retries", 0),
+            # top-level arg refs (the ones _resolve_args unwraps),
+            # surfaced so the daemon can prefetch them while the task
+            # waits for a worker (reference: raylet/dependency_manager.h)
+            "arg_refs": _top_level_arg_refs(args, kwargs),
         }
         if streaming:
             bp = opts.get("_generator_backpressure_num_objects")
@@ -1185,6 +1163,7 @@ class CoreClient:
             "max_restarts": opts.get("max_restarts", 0),
             "lifetime": opts.get("lifetime"),
             "runtime_env": opts.get("runtime_env"),
+            "arg_refs": _top_level_arg_refs(args, kwargs),
         }
         creation_ref = ObjectRef(return_id, self.address, _client=self)
 
@@ -1409,6 +1388,14 @@ class _LeaseGroup:
         self.key = key
         self.queue: "deque[dict]" = deque()
         self.num_pumps = 0
+
+
+def _top_level_arg_refs(args: tuple, kwargs: dict) -> List[tuple]:
+    """(object_id, owner_addr) for every DIRECT ObjectRef argument —
+    exactly the set _resolve_args unwraps; nested refs stay refs."""
+    refs = ([a for a in args if isinstance(a, ObjectRef)]
+            + [v for v in kwargs.values() if isinstance(v, ObjectRef)])
+    return [(r.id, r.owner_addr) for r in refs]
 
 
 def _collect_refs(obj, out=None) -> List[ObjectRef]:

@@ -219,6 +219,10 @@ class NodeDaemon:
         self._spawning: Dict[str, int] = {}
         self._runtime_envs: Dict[str, Optional[dict]] = {"": None}
         self._env_manager = None   # lazy RuntimeEnvPluginManager
+        # dependency prefetch bookkeeping (_stage_remote_object)
+        self._staging_inflight: Dict[str, asyncio.Future] = {}
+        from collections import OrderedDict as _OD
+        self._staged_lru: "_OD[str, int]" = _OD()
         self._max_concurrent_spawns = max(2, (os.cpu_count() or 1) // 2)
         self._register_events: Dict[str, asyncio.Event] = {}
         self._monitor_task: Optional[asyncio.Task] = None
@@ -793,13 +797,120 @@ class NodeDaemon:
         if chips:
             self._free_tpu_chips.extend(chips)
 
+    PREFETCH_DISPATCH_GRACE_S = 5.0    # max dispatch delay for staging
+    STAGED_CACHE_BYTES = 256 << 20     # staged foreign copies kept around
+
+    async def _prefetch_args(self, spec: dict) -> Dict[str, Any]:
+        """Stage the task's arg objects while it waits for a worker
+        (reference parity: raylet/dependency_manager.h pulls args to
+        local plasma before dispatch). Returns {object_id: ShmLocation}
+        or {object_id: ('payload', flat_bytes)} handed to the worker via
+        the spec, so arg resolution skips the owner round trip. Cross-
+        host objects are copied into this node's arena (deduped against
+        concurrent prefetches of the same object); same-host shm is
+        attachable directly, so only the location is recorded. Best
+        effort: any failure just leaves the worker on its normal path."""
+        arg_refs = dict(spec.get("arg_refs") or [])   # dedup repeat args
+        if not arg_refs:
+            return {}
+
+        async def one(oid: str, owner):
+            entry = self.object_store.get(oid)
+            if (entry is not None and entry.sealed
+                    and not entry.shm_name.startswith("spill:")):
+                # "spill:" names are daemon-internal — a worker cannot
+                # shm_open them; let it fetch through rpc_fetch_object
+                from .object_store import ShmLocation
+                return oid, ShmLocation(self.address, entry.shm_name,
+                                        entry.size)
+            if owner is None or tuple(owner) == self.address:
+                return oid, None
+            try:
+                reply = await asyncio.wait_for(
+                    self.pool.get(tuple(owner)).call(
+                        "get_object", object_id=oid, timeout=60.0),
+                    timeout=65.0)
+            except Exception:
+                return oid, None
+            status = reply.get("status")
+            if status == "location":
+                loc = reply["location"]
+                if loc.node_addr[0] == self.address[0]:
+                    return oid, loc   # same host: shm attaches directly
+                staged = await self._stage_remote_object(oid, loc)
+                return oid, staged or loc
+            if status == "inline" and reply.get("payload") is not None:
+                # small/inline object: forward the bytes — the daemon
+                # already paid the owner round trip, the worker must not
+                # pay it again
+                return oid, ("payload", reply["payload"])
+            return oid, None
+
+        results = await asyncio.gather(
+            *(one(oid, owner) for oid, owner in arg_refs.items()),
+            return_exceptions=True)
+        return {oid: loc for r in results
+                if not isinstance(r, BaseException)
+                for oid, loc in [r] if loc is not None}
+
+    async def _stage_remote_object(self, object_id: str, loc):
+        """Chunk-fetch a cross-host object into THIS node's arena and
+        register it; returns the local ShmLocation (None on failure).
+        Concurrent stagings of one object share a single pull, and
+        staged foreign copies are LRU-capped — the owner's free_object
+        never reaches this unsolicited copy, so the daemon bounds it."""
+        fut = self._staging_inflight.get(object_id)
+        if fut is not None:
+            try:
+                return await asyncio.shield(fut)
+            except Exception:
+                return None
+        fut = asyncio.get_running_loop().create_future()
+        self._staging_inflight[object_id] = fut
+        try:
+            result = await self._stage_remote_object_inner(object_id, loc)
+            fut.set_result(result)
+            return result
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()   # consumed
+            return None
+        finally:
+            self._staging_inflight.pop(object_id, None)
+
+    async def _stage_remote_object_inner(self, object_id: str, loc):
+        from .object_store import ShmLocation, write_to_shm
+        from .serialization import SerializedObject
+        from .transfer import fetch_flat
+        try:
+            flat = await fetch_flat(
+                self.pool.get(loc.node_addr), object_id, loc.size,
+                per_call_timeout=30.0)
+            shm_name, size = await asyncio.get_running_loop().run_in_executor(
+                None, write_to_shm, object_id,
+                SerializedObject.from_flat(flat), self.session_name,
+                self.object_store.spill_until)
+            self.object_store.register(object_id, shm_name, size)
+            self._staged_lru[object_id] = size
+            self._staged_lru.move_to_end(object_id)
+            total = sum(self._staged_lru.values())
+            while total > self.STAGED_CACHE_BYTES and len(self._staged_lru) > 1:
+                old_oid, old_size = self._staged_lru.popitem(last=False)
+                self.object_store.free(old_oid)
+                total -= old_size
+            return ShmLocation(self.address, shm_name, size)
+        except Exception:
+            return None
+
     async def _run_task(self, spec: dict) -> None:
         controller = self.pool.get(self.controller_addr)
         self._assign_tpu_chips(spec)
         renv = spec.get("runtime_env")
+        prefetch = asyncio.ensure_future(self._prefetch_args(spec))
         try:
             handle = await self._acquire_worker(runtime_env_key(renv), renv)
         except Exception as e:
+            prefetch.cancel()
             await self._report_failure(spec, f"worker spawn failed: {e!r}")
             self._release_tpu_chips(spec["task_id"])
             await controller.oneway("task_finished", task_id=spec["task_id"],
@@ -807,6 +918,20 @@ class NodeDaemon:
             return
         handle.state = "busy"
         handle.current_task = spec
+        try:
+            # Staging overlapped worker acquisition. A short grace keeps
+            # a warm-pool dispatch from waiting on a wedged peer — past
+            # it the worker fetches its own args (prefetch is best
+            # effort), and the abandoned staging is cancelled.
+            locs = await asyncio.wait_for(
+                prefetch, timeout=self.PREFETCH_DISPATCH_GRACE_S)
+            if locs:
+                spec["_arg_locations"] = locs
+        except asyncio.CancelledError:
+            prefetch.cancel()
+            raise            # _run_task itself was cancelled: unwind
+        except Exception:    # TimeoutError included
+            prefetch.cancel()
         if spec.get("is_actor_creation"):
             handle.state = "actor"
             handle.actor_id = spec["actor_id"]

@@ -1,0 +1,81 @@
+"""Dependency staging: parallel arg resolution + daemon-side prefetch
+(VERDICT r3 #8; reference parity: src/ray/raylet/dependency_manager.h —
+args are pulled to the node while the task waits for a worker)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.object_store import MemoryStore
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import serialize
+from ray_tpu._private.worker_main import WorkerRuntime
+
+
+class _SlowClient:
+    """aio_get with a fixed latency: serial resolution of k args costs
+    k*delay, overlapped resolution ~1*delay."""
+
+    def __init__(self, delay: float):
+        self.delay = delay
+        self.memory_store = MemoryStore()
+
+    async def aio_get(self, ref):
+        await asyncio.sleep(self.delay)
+        return ref.id
+
+
+def test_arg_resolution_overlaps_not_serial(ray_start):
+    """The latency proof: 4 ObjectRef args resolve in ~1x fetch latency,
+    not 4x (the old loop awaited one ref at a time)."""
+    rt = WorkerRuntime.__new__(WorkerRuntime)
+    rt.client = _SlowClient(delay=0.15)
+    refs = tuple(ObjectRef(f"{i:032x}", ("127.0.0.1", 1))
+                 for i in range(4))
+    blob = serialize((refs, {"k": refs[0]})).to_flat()
+
+    async def run():
+        t0 = time.perf_counter()
+        args, kwargs = await rt._resolve_args(blob)
+        return time.perf_counter() - t0, args, kwargs
+
+    dt, args, kwargs = asyncio.new_event_loop().run_until_complete(run())
+    assert args == tuple(r.id for r in refs)
+    assert kwargs == {"k": refs[0].id}
+    # 5 fetches x 0.15s = 0.75s serial; overlapped must stay well under
+    assert dt < 0.45, f"arg resolution looks serial: {dt:.2f}s"
+
+
+def test_daemon_prefetch_returns_locations(ray_start):
+    """The daemon stages a task's shm-backed args while it waits for a
+    worker: _prefetch_args resolves owner refs to shm locations that are
+    handed to the worker via spec['_arg_locations']."""
+    rt = ray_tpu.init(ignore_reinit_error=True)
+    big = np.zeros(2 << 20, np.uint8)          # forced past inline limit
+    ref = ray_tpu.put(big)
+    spec = {"arg_refs": [(ref.id, ref.owner_addr)]}
+    locs = rt.loop_runner.run_sync(
+        rt.head_daemon._prefetch_args(spec), timeout=30)
+    assert ref.id in locs
+    assert locs[ref.id].size >= big.nbytes
+
+
+def test_prefetched_multiarg_task_e2e(ray_start):
+    """Scheduled-path task (custom resource pins it to a fake node) with
+    multiple object args: prefetch + primed locations end-to-end."""
+    node_id = ray_tpu.add_fake_node(num_cpus=2,
+                                    resources={"prefetch_node": 2.0})
+    try:
+        arrs = [np.full(1 << 20, i, np.uint8) for i in range(3)]
+        refs = [ray_tpu.put(a) for a in arrs]
+
+        @ray_tpu.remote(num_cpus=0, resources={"prefetch_node": 1.0})
+        def combine(a, b, c):
+            return int(a[0]) + int(b[0]) + int(c[0])
+
+        assert ray_tpu.get(combine.remote(*refs), timeout=60) == 3
+    finally:
+        ray_tpu.remove_node(node_id)
