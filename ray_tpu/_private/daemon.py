@@ -878,6 +878,8 @@ class NodeDaemon:
         finally:
             self._staging_inflight.pop(object_id, None)
 
+    STAGED_PIN_S = 600.0    # staged copies safe from eviction this long
+
     async def _stage_remote_object_inner(self, object_id: str, loc):
         from .object_store import ShmLocation, write_to_shm
         from .serialization import SerializedObject
@@ -886,16 +888,31 @@ class NodeDaemon:
             flat = await fetch_flat(
                 self.pool.get(loc.node_addr), object_id, loc.size,
                 per_call_timeout=30.0)
+            # arena_room=None: a discardable cache copy must never force
+            # PRIMARY objects to spill (write_to_shm falls back to a
+            # per-object segment when the arena is full)
             shm_name, size = await asyncio.get_running_loop().run_in_executor(
                 None, write_to_shm, object_id,
                 SerializedObject.from_flat(flat), self.session_name,
-                self.object_store.spill_until)
+                None)
             self.object_store.register(object_id, shm_name, size)
-            self._staged_lru[object_id] = size
+            self._staged_lru[object_id] = (size, time.monotonic())
             self._staged_lru.move_to_end(object_id)
-            total = sum(self._staged_lru.values())
-            while total > self.STAGED_CACHE_BYTES and len(self._staged_lru) > 1:
-                old_oid, old_size = self._staged_lru.popitem(last=False)
+            # SOFT cap: entries younger than STAGED_PIN_S may hold
+            # ShmLocations already handed to dispatched-but-unresolved
+            # tasks — freeing those would fail the task (the owner can't
+            # 'reconstruct' a live put() object). Evict only aged
+            # entries; briefly exceeding the cap is the lesser evil.
+            now = time.monotonic()
+            total = sum(s for s, _ in self._staged_lru.values())
+            for old_oid in list(self._staged_lru):
+                if total <= self.STAGED_CACHE_BYTES:
+                    break
+                old_size, staged_at = self._staged_lru[old_oid]
+                if old_oid == object_id \
+                        or now - staged_at < self.STAGED_PIN_S:
+                    continue
+                del self._staged_lru[old_oid]
                 self.object_store.free(old_oid)
                 total -= old_size
             return ShmLocation(self.address, shm_name, size)
@@ -920,18 +937,22 @@ class NodeDaemon:
         handle.current_task = spec
         try:
             # Staging overlapped worker acquisition. A short grace keeps
-            # a warm-pool dispatch from waiting on a wedged peer — past
-            # it the worker fetches its own args (prefetch is best
-            # effort), and the abandoned staging is cancelled.
+            # a warm-pool dispatch from waiting on a wedged peer or a
+            # multi-GiB pull — past it the worker fetches its own args,
+            # while the staging keeps running DETACHED (deduped via
+            # _staging_inflight): cancelling would throw away the bytes
+            # already pulled AND could abandon a mid-write arena object
+            # with no owner; letting it land serves the next task.
             locs = await asyncio.wait_for(
-                prefetch, timeout=self.PREFETCH_DISPATCH_GRACE_S)
+                asyncio.shield(prefetch),
+                timeout=self.PREFETCH_DISPATCH_GRACE_S)
             if locs:
                 spec["_arg_locations"] = locs
         except asyncio.CancelledError:
             prefetch.cancel()
             raise            # _run_task itself was cancelled: unwind
-        except Exception:    # TimeoutError included
-            prefetch.cancel()
+        except Exception:    # TimeoutError included: leave it running
+            pass
         if spec.get("is_actor_creation"):
             handle.state = "actor"
             handle.actor_id = spec["actor_id"]
