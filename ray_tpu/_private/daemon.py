@@ -222,7 +222,7 @@ class NodeDaemon:
         # dependency prefetch bookkeeping (_stage_remote_object)
         self._staging_inflight: Dict[str, asyncio.Future] = {}
         from collections import OrderedDict as _OD
-        self._staged_lru: "_OD[str, int]" = _OD()
+        self._staged_lru: "_OD[str, Tuple[int, float]]" = _OD()
         self._max_concurrent_spawns = max(2, (os.cpu_count() or 1) // 2)
         self._register_events: Dict[str, asyncio.Event] = {}
         self._monitor_task: Optional[asyncio.Task] = None
@@ -878,7 +878,8 @@ class NodeDaemon:
         finally:
             self._staging_inflight.pop(object_id, None)
 
-    STAGED_PIN_S = 600.0    # staged copies safe from eviction this long
+    STAGED_PIN_S = 60.0     # staged copies safe from eviction this long
+    STAGED_HARD_CAP = 2      # x STAGED_CACHE_BYTES: pin yields to this
 
     async def _stage_remote_object_inner(self, object_id: str, loc):
         from .object_store import ShmLocation, write_to_shm
@@ -901,20 +902,27 @@ class NodeDaemon:
             # SOFT cap: entries younger than STAGED_PIN_S may hold
             # ShmLocations already handed to dispatched-but-unresolved
             # tasks — freeing those would fail the task (the owner can't
-            # 'reconstruct' a live put() object). Evict only aged
-            # entries; briefly exceeding the cap is the lesser evil.
+            # 'reconstruct' a live put() object), so the cap first
+            # evicts only aged entries. But the pin must not let a storm
+            # of young copies fill /dev/shm: past the HARD cap the
+            # oldest entries go regardless (a rare spurious task retry
+            # beats a node out of shm).
             now = time.monotonic()
             total = sum(s for s, _ in self._staged_lru.values())
+            hard = total > self.STAGED_CACHE_BYTES * self.STAGED_HARD_CAP
             for old_oid in list(self._staged_lru):
                 if total <= self.STAGED_CACHE_BYTES:
                     break
                 old_size, staged_at = self._staged_lru[old_oid]
-                if old_oid == object_id \
-                        or now - staged_at < self.STAGED_PIN_S:
+                if old_oid == object_id or (
+                        not hard
+                        and now - staged_at < self.STAGED_PIN_S):
                     continue
                 del self._staged_lru[old_oid]
                 self.object_store.free(old_oid)
                 total -= old_size
+                hard = (total
+                        > self.STAGED_CACHE_BYTES * self.STAGED_HARD_CAP)
             return ShmLocation(self.address, shm_name, size)
         except Exception:
             return None
@@ -1192,7 +1200,16 @@ class NodeDaemon:
             "bytes_spilled": self.object_store.bytes_spilled,
             "objects_spilled": self.object_store.objects_spilled,
             "oom_kills": self.oom_kills,
+            # allocated/capacity fraction of the shm arena: the memory
+            # signal data-executor backpressure keys on
+            "arena_pressure": self._arena_pressure_fraction(),
         }
+
+    def _arena_pressure_fraction(self) -> float:
+        p = self.object_store.arena_pressure()
+        if not p or not p[1]:
+            return 0.0
+        return p[0] / p[1]
 
     async def rpc_node_stats(self) -> dict:
         return {"node_id": self.node_id, **self._stats()}

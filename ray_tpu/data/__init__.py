@@ -12,12 +12,13 @@ from .block import Block, BlockAccessor
 from .dataset import Dataset, GroupedData, MaterializedDataset
 from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
                          range, read_binary_files, read_csv, read_datasource,
-                         read_json, read_numpy, read_parquet, read_text)
+                         read_images, read_json, read_numpy, read_parquet,
+                         read_text)
 
 __all__ = [
     "Dataset", "MaterializedDataset", "GroupedData", "Block",
     "BlockAccessor", "AggregateFn", "Count", "Sum", "Min", "Max", "Mean",
     "Std", "range", "from_items", "from_numpy", "from_arrow", "from_pandas",
     "read_parquet", "read_csv", "read_json", "read_text", "read_numpy",
-    "read_binary_files", "read_datasource",
+    "read_binary_files", "read_datasource", "read_images",
 ]

@@ -174,9 +174,16 @@ class Dataset:
                      batch_format: str = "numpy",
                      drop_last: bool = False,
                      prefetch_batches: int = 1) -> Iterator[Any]:
-        """Re-batch the output block stream to exactly batch_size rows."""
+        """Re-batch the output block stream to exactly batch_size rows
+        (batch_size=None: each output block is one batch, reference
+        iter_batches semantics)."""
         def gen():
             carry: Optional[Block] = None
+            if batch_size is None:
+                for blk in self._execute():
+                    if blk.num_rows:
+                        yield BlockAccessor(blk).to_batch(batch_format)
+                return
             for blk in self._execute():
                 carry = blk if carry is None else concat_blocks(
                     [carry, blk])

@@ -133,6 +133,48 @@ def read_binary_files(paths, **kw) -> Dataset:
     return _file_read(paths, "", reader, "Binary")
 
 
+IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images(paths, size=None, mode=None,
+                include_paths: bool = False) -> Dataset:
+    """Decode an image directory/file list into blocks with an "image"
+    column of HWC uint8 arrays (reference parity: data/read_api.py:956
+    read_images — size/mode/include_paths options).
+
+    size: (height, width) to resize every image to (required for
+    batching mixed-size images into one numpy batch). mode: PIL mode
+    conversion, e.g. "RGB"/"L". Row access yields the image as nested
+    lists (Arrow list column semantics) — np.asarray(row["image"])
+    restores the HWC array."""
+    def reader(f: str) -> Block:
+        from PIL import Image
+        img = Image.open(f)
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        # explicit Arrow list<list<list<uint8>>>: the generic tensor
+        # conversion flattens >1-D arrays to fixed-size lists, losing
+        # the H/W/C structure
+        arr = np.asarray(img)
+        cols = {"image": pa.array([arr.tolist()])}
+        if include_paths:
+            cols["path"] = [f]
+        return _to_table(cols)
+
+    files = _expand_paths(paths, "")
+    images = [f for f in files
+              if f.lower().endswith(IMAGE_SUFFIXES)]
+    if not images:
+        raise ValueError(f"no image files found under {paths!r}")
+
+    def make_task(f: str):
+        return lambda: reader(f)
+
+    return read_datasource([make_task(f) for f in images], name="Image")
+
+
 def read_datasource(read_tasks: List[Callable[[], Block]],
                     name: str = "Custom") -> Dataset:
     """Escape hatch: bring your own read tasks."""

@@ -143,7 +143,9 @@ class InlineBackend:
 
 
 class _InlinePool:
-    def __init__(self, backend: InlineBackend, stage_specs, size: int):
+    def __init__(self, backend: InlineBackend, stage_specs, size):
+        if isinstance(size, tuple):
+            size = size[1]          # inline threads are cheap: use max
         self._backend = backend
         self._workers = [_ActorTransform(stage_specs) for _ in range(size)]
         self._rr = 0
@@ -153,6 +155,9 @@ class _InlinePool:
         self._rr += 1
         block = self._backend.get(block_ref)
         return self._backend.submit(w, block)
+
+    def resolve(self, token, get) -> Any:
+        return get(token)
 
     def shutdown(self):
         pass
@@ -206,24 +211,91 @@ class ClusterBackend:
 
 
 class _ActorPool:
-    def __init__(self, backend: ClusterBackend, stage_specs, size: int):
+    """Map-actor pool that autoscales between (min_size, max_size)
+    (reference parity: data/_internal/execution/autoscaler/
+    autoscaling_actor_pool.py): a new actor is added when every actor
+    already has PER_ACTOR_BACKLOG submissions outstanding, and idle
+    surplus actors are released once their work drains."""
+
+    PER_ACTOR_BACKLOG = 2
+
+    def __init__(self, backend: ClusterBackend, stage_specs, size):
         import cloudpickle
         import ray_tpu
-        payload = cloudpickle.dumps(stage_specs)
-        cls = ray_tpu.remote(_MapWorkerActor)
-        self._actors = [cls.remote(payload) for _ in range(size)]
+        self._ray = ray_tpu
+        if isinstance(size, tuple):
+            self.min_size, self.max_size = size
+        else:
+            self.min_size = self.max_size = int(size)
+        self._payload = cloudpickle.dumps(stage_specs)
+        self._cls = ray_tpu.remote(_MapWorkerActor)
+        self._actors: List[Any] = []
+        self._inflight: Dict[int, int] = {}   # idx -> outstanding
+        self._idle_since: Dict[int, float] = {}
+        for _ in range(self.min_size):
+            self._add_actor()
         self._rr = 0
 
+    def _add_actor(self):
+        self._actors.append(self._cls.remote(self._payload))
+        self._inflight[len(self._actors) - 1] = 0
+
+    @property
+    def size(self) -> int:
+        return sum(1 for a in self._actors if a is not None)
+
     def submit(self, block_ref) -> Any:
-        a = self._actors[self._rr % len(self._actors)]
-        self._rr += 1
-        return a.apply.remote(block_ref)
+        live = [i for i, a in enumerate(self._actors) if a is not None]
+        if (all(self._inflight[i] >= self.PER_ACTOR_BACKLOG
+                for i in live)
+                and self.size < self.max_size):
+            self._add_actor()
+            live.append(len(self._actors) - 1)
+        idx = min((i for i in live),
+                  key=lambda i: (self._inflight[i], (i - self._rr) % max(
+                      len(self._actors), 1)))
+        self._rr = idx + 1
+        self._inflight[idx] += 1
+        ref = self._actors[idx].apply.remote(block_ref)
+        return (idx, ref)
+
+    IDLE_SHRINK_S = 2.0
+
+    def resolve(self, token, get) -> Any:
+        import time
+        idx, ref = token
+        try:
+            return get(ref)
+        finally:
+            self._inflight[idx] -= 1
+            if self._inflight[idx] == 0:
+                self._idle_since[idx] = time.monotonic()
+            self._maybe_shrink()
+
+    def _maybe_shrink(self):
+        """Release surplus actors idle longer than IDLE_SHRINK_S."""
+        import time
+        now = time.monotonic()
+        while self.size > self.min_size:
+            idle = [i for i, a in enumerate(self._actors)
+                    if a is not None and self._inflight[i] == 0
+                    and now - self._idle_since.get(i, now) >=
+                    self.IDLE_SHRINK_S]
+            if not idle:
+                return
+            idx = idle[-1]
+            try:
+                self._ray.kill(self._actors[idx])
+            except Exception:
+                pass
+            self._actors[idx] = None
 
     def shutdown(self):
-        import ray_tpu
         for a in self._actors:
+            if a is None:
+                continue
             try:
-                ray_tpu.kill(a)
+                self._ray.kill(a)
             except Exception:
                 pass
 
@@ -246,16 +318,67 @@ def pick_backend() -> Any:
 # streaming operator iterators
 # ---------------------------------------------------------------------------
 
+class MemoryBackpressure:
+    """Memory-keyed dynamic in-flight window (reference parity:
+    data/_internal/execution/backpressure_policy/
+    concurrency_cap_backpressure_policy.py + resource_manager.py).
+
+    The window is the fixed cap while the cluster's shm arenas are
+    comfortable, then shrinks linearly to 1 as the worst node's arena
+    fills past the low watermark — streaming a larger-than-arena dataset
+    throttles submission instead of drowning the store (the arena's own
+    spill loop drains what's already sealed)."""
+
+    LOW = 0.5
+    HIGH = 0.85
+    POLL_S = 0.25
+
+    def __init__(self, max_in_flight: int):
+        self.max_in_flight = max_in_flight
+        self._last_poll = 0.0
+        self._last_pressure = 0.0
+
+    def _pressure(self) -> float:
+        import time
+        now = time.monotonic()
+        if now - self._last_poll < self.POLL_S:
+            return self._last_pressure
+        self._last_poll = now
+        try:
+            from ray_tpu.util.state import list_nodes
+            self._last_pressure = max(
+                (n.get("stats", {}).get("arena_pressure", 0.0)
+                 for n in list_nodes() if n.get("alive")), default=0.0)
+        except Exception:
+            pass   # keep the last known value: a transient RPC failure
+                   # must not fling the window open under real pressure
+        return self._last_pressure
+
+    def window(self) -> int:
+        p = self._pressure()
+        if p <= self.LOW:
+            return self.max_in_flight
+        if p >= self.HIGH:
+            return 1
+        frac = (self.HIGH - p) / (self.HIGH - self.LOW)
+        return max(1, int(round(frac * self.max_in_flight)))
+
+
 def _windowed(upstream: Iterator[Any], submit: Callable[[Any], Any],
               resolve: Callable[[Any], Any],
-              max_in_flight: int) -> Iterator[Block]:
-    """Submit one task per upstream ref with bounded in-flight window;
-    yield each task's resulting blocks in order."""
+              max_in_flight: int,
+              policy: Optional[MemoryBackpressure] = None
+              ) -> Iterator[Block]:
+    """Submit one task per upstream ref with a bounded in-flight window
+    (memory-shrunk when a policy is given); yield each task's resulting
+    blocks in order."""
     pending: "collections.deque[Any]" = collections.deque()
     for ref in upstream:
-        while len(pending) >= max_in_flight:
+        cap = policy.window() if policy is not None else max_in_flight
+        while len(pending) >= cap:
             for blk in resolve(pending.popleft()):
                 yield blk
+            cap = policy.window() if policy is not None else max_in_flight
         pending.append(submit(ref))
     while pending:
         for blk in resolve(pending.popleft()):
@@ -344,13 +467,19 @@ def _as_blocks(result) -> List[Block]:
 
 
 def _map_iter(op: L.AbstractMap, upstream, backend, max_in_flight):
+    policy = (MemoryBackpressure(max_in_flight)
+              if getattr(backend, "name", "") == "cluster" else None)
     if op.uses_actors:
-        size = op.concurrency if isinstance(op.concurrency, int) else 2
+        # concurrency: int = fixed pool, (min, max) tuple = autoscaling
+        # actor pool (reference parity: ActorPoolStrategy min/max)
+        size = op.concurrency if isinstance(op.concurrency,
+                                            (int, tuple)) else 2
         pool = backend.make_pool(actor_stage_specs(op), size)
         try:
             yield from _windowed(
                 upstream, pool.submit,
-                lambda ref: _as_blocks(backend.get(ref)), max_in_flight)
+                lambda tok: _as_blocks(pool.resolve(tok, backend.get)),
+                max_in_flight, policy)
         finally:
             pool.shutdown()
         return
@@ -359,7 +488,7 @@ def _map_iter(op: L.AbstractMap, upstream, backend, max_in_flight):
         upstream,
         lambda block: backend.submit(
             transform, block, num_cpus=op.num_cpus, num_tpus=op.num_tpus),
-        lambda ref: _as_blocks(backend.get(ref)), max_in_flight)
+        lambda ref: _as_blocks(backend.get(ref)), max_in_flight, policy)
 
 
 def _limit_iter(op: L.Limit, upstream, backend):
