@@ -1,0 +1,78 @@
+"""Cross-host PP over compiled-DAG channels (VERDICT r3 #10): the
+channel layer carries real model parallelism — two transformer stage
+actors, activations hopping over shm channels, microbatches overlapped."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.models import llama
+from ray_tpu.models.pipeline_adag import (CompiledPipeline,
+                                          build_pipeline_stages)
+
+
+@pytest.fixture(scope="module")
+def ray_boot():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_two_stage_pipeline_matches_single_process(ray_boot):
+    """Correctness: the 2-actor pipeline's logits equal the plain
+    single-process forward of the same model."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = llama.config("debug", dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    tokens = [rng.integers(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+              for _ in range(3)]
+
+    stages = build_pipeline_stages(cfg, n_stages=2, seed=5)
+    pipe = CompiledPipeline(stages, cfg=cfg)
+    try:
+        outs = pipe.forward_batches(tokens)
+    finally:
+        pipe.teardown()
+        for s in stages:
+            ray_tpu.kill(s)
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    for tok, out in zip(tokens, outs):
+        ref = np.asarray(llama.forward(cfg, params, jnp.asarray(tok)))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_overlaps_stage_compute(ray_boot):
+    """The overlap proof: with per-stage compute time T and M
+    microbatches, a 2-stage pipeline costs ~(M+1)*T, not the serial
+    2*M*T — microbatch i+1 is inside stage 0 while i is in stage 1."""
+    import jax.numpy as jnp
+
+    cfg = llama.config("debug", dtype=jnp.float32)
+    T, M = 0.3, 8
+    rng = np.random.default_rng(1)
+    tokens = [rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+              for _ in range(M)]
+
+    stages = build_pipeline_stages(cfg, n_stages=2, seed=0,
+                                   compute_delay_s=T)
+    pipe = CompiledPipeline(stages, cfg=cfg)
+    try:
+        pipe.forward_batches(tokens[:1])        # warm both stage jits
+        t0 = time.perf_counter()
+        pipe.forward_batches(tokens)
+        dt = time.perf_counter() - t0
+    finally:
+        pipe.teardown()
+        for s in stages:
+            ray_tpu.kill(s)
+
+    serial = 2 * M * T
+    pipelined = (M + 1) * T
+    assert dt < serial * 0.85, (
+        f"no overlap: {dt:.2f}s vs serial {serial:.2f}s")
+    assert dt >= pipelined * 0.8                # sanity: not magic
