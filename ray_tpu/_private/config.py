@@ -32,6 +32,13 @@ def _f(name, default, cast=None):
         default_factory=lambda: _env(name, default, cast))
 
 
+def session_dir(session_name: str) -> str:
+    """The session's on-disk root (logs, spill, runtime envs, metrics
+    configs) — the ONE place the /tmp/ray_tpu/<session> convention
+    lives."""
+    return os.path.join("/tmp/ray_tpu", session_name)
+
+
 @dataclasses.dataclass
 class RayTpuConfig:
     # -- object plane --------------------------------------------------

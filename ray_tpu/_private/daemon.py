@@ -197,7 +197,8 @@ class NodeDaemon:
         self.session_name = session_name
         self.resources = dict(resources or {})
         self.labels = labels or {}
-        self.temp_dir = temp_dir or f"/tmp/ray_tpu/{session_name}"
+        from .config import session_dir
+        self.temp_dir = temp_dir or session_dir(session_name)
         self.worker_env = worker_env or {}
         self.server = RpcServer()
         self.server.register_object(self)

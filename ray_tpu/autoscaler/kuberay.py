@@ -1,0 +1,174 @@
+"""KubeRay/GKE integration: derive TPU slice resources from pod specs.
+
+Reference parity: python/ray/autoscaler/_private/kuberay/
+autoscaling_config.py:236-273 (+ utils.py:90 tpu_node_selectors_to_type)
+— the GKE story: a RayCluster CR's worker groups carry GKE node
+selectors (cloud.google.com/gke-tpu-accelerator + -topology) and a
+google.com/tpu container resource; the autoscaler must translate those
+into the runtime's resource vocabulary:
+
+    {"CPU": n, "TPU": chips_per_host, "TPU-v5p-16-head": 1}
+
+so pod-slice gang scheduling (util/placement_group.py slice helper) and
+scale-up decisions see whole slices, one head resource per replica.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional
+
+# GKE accelerator node-selector value -> TPU generation (reference
+# utils.py gke_tpu_accelerator_to_generation)
+GKE_TPU_GENERATIONS: Dict[str, str] = {
+    "tpu-v4-podslice": "v4",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v6e-slice": "v6e",
+}
+# generations with 2 TensorCores per chip: the accelerator_type counts
+# CORES (v4-8 = 4 chips), matching GCE machine naming
+_TWO_CORE_GENERATIONS = ("v4", "v5p")
+
+TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+K8S_TPU_RESOURCE = "google.com/tpu"
+
+
+def tpu_node_selectors_to_type(topology: Optional[str],
+                               accelerator: Optional[str]
+                               ) -> Optional[str]:
+    """("2x2x2", "tpu-v4-podslice") -> "v4-16" (cores, not chips)."""
+    if not topology or not accelerator:
+        return None
+    generation = GKE_TPU_GENERATIONS.get(accelerator)
+    if generation is None:
+        raise ValueError(
+            f"unknown GKE TPU accelerator {accelerator!r} "
+            f"(known: {sorted(GKE_TPU_GENERATIONS)})")
+    if not re.fullmatch(r"\d+(x\d+)*", topology):
+        raise ValueError(f"malformed TPU topology {topology!r}")
+    num_chips = math.prod(int(d) for d in topology.split("x"))
+    cores_per_chip = 2 if generation in _TWO_CORE_GENERATIONS else 1
+    return f"{generation}-{num_chips * cores_per_chip}"
+
+
+def _k8s_quantity_to_int(q: Any) -> int:
+    """K8s resource quantity -> int (ceiling), e.g. "4", 4, "4000m"."""
+    if isinstance(q, (int, float)):
+        return int(math.ceil(q))
+    s = str(q)
+    if s.endswith("m"):
+        return int(math.ceil(int(s[:-1]) / 1000))
+    return int(math.ceil(float(s)))
+
+
+def worker_group_resources(group_spec: Dict[str, Any],
+                           host_index: int = 0) -> Dict[str, float]:
+    """Ray resources for pod `host_index` of a RayCluster worker group
+    replica.
+
+    group_spec follows the KubeRay CR shape: template.spec.nodeSelector
+    + template.spec.containers[0].resources.{limits,requests}, optional
+    rayStartParams.resources overrides (highest priority). Matches what
+    a live node's TPUAcceleratorManager.autodetect_resources() would
+    advertise: generic "TPU", the typed per-chip "TPU-{accel_type}"
+    (what slice gang bundles demand, util/placement_group.py), and —
+    ONLY on worker 0 of each replica — the "TPU-{accel_type}-head" gang
+    anchor (accelerators/tpu.py:101-110: one anchor per slice)."""
+    import json
+    pod = group_spec.get("template", {}).get("spec", {})
+    selectors = pod.get("nodeSelector", {}) or {}
+    containers = pod.get("containers") or [{}]
+    k8s_resources = containers[0].get("resources", {}) or {}
+    start_params = group_spec.get("rayStartParams", {}) or {}
+    custom = start_params.get("resources")
+    custom = json.loads(custom) if isinstance(custom, str) else (custom or {})
+
+    resources: Dict[str, float] = {}
+    for typ in ("limits", "requests"):
+        cpu = k8s_resources.get(typ, {}).get("cpu")
+        if cpu is not None and "CPU" not in resources:
+            resources["CPU"] = float(_k8s_quantity_to_int(cpu))
+
+    num_tpus: Optional[int] = None
+    if "TPU" in custom:
+        num_tpus = int(custom["TPU"])
+    else:
+        for typ in ("limits", "requests"):
+            q = k8s_resources.get(typ, {}).get(K8S_TPU_RESOURCE)
+            if q is not None:
+                num_tpus = _k8s_quantity_to_int(q)
+                break
+    if num_tpus is not None:
+        resources["TPU"] = float(num_tpus)
+        accel_type = tpu_node_selectors_to_type(
+            selectors.get(TOPOLOGY_SELECTOR),
+            selectors.get(ACCELERATOR_SELECTOR))
+        if accel_type:
+            resources[f"TPU-{accel_type}"] = float(num_tpus)
+            if host_index == 0:
+                resources[f"TPU-{accel_type}-head"] = 1.0
+    for k, v in custom.items():
+        resources[k] = float(v)
+    return resources
+
+
+def autoscaling_config_from_ray_cluster(cr: Dict[str, Any]
+                                        ) -> Dict[str, Any]:
+    """RayCluster CR dict -> a plain summary of the cluster's groups:
+    per-pod resources (worker-0 vs other hosts), min/max worker counts,
+    slice replica accounting (NumOfHosts hosts per replica). Feed into
+    the reconciler via `node_types_from_ray_cluster`."""
+    spec = cr.get("spec", cr)
+    groups: List[Dict[str, Any]] = []
+    for g in spec.get("workerGroupSpecs", []) or []:
+        hosts_per_replica = int(g.get("numOfHosts", 1))
+        groups.append({
+            "name": g.get("groupName", "worker"),
+            "worker0_resources": worker_group_resources(g, host_index=0),
+            "resources": worker_group_resources(g, host_index=1),
+            "min_workers": int(g.get("minReplicas", 0)) * hosts_per_replica,
+            "max_workers": int(g.get("maxReplicas", 1)) * hosts_per_replica,
+            "hosts_per_replica": hosts_per_replica,
+        })
+    head = spec.get("headGroupSpec")
+    head_resources = (worker_group_resources(head)
+                      if head is not None else {"CPU": 1.0})
+    return {"head_resources": head_resources, "worker_groups": groups}
+
+
+def node_types_from_ray_cluster(cr: Dict[str, Any]) -> List[Any]:
+    """RayCluster CR -> the reconciler's NodeType list
+    (autoscaler/provider.py NodeType(name, resources, labels,
+    max_workers)). Multi-host groups contribute TWO node types per
+    group — the worker-0 shape carrying the slice-head anchor and the
+    other-hosts shape — so demand that rides the -head marker launches
+    exactly one anchor per replica."""
+    from .provider import NodeType
+
+    cfg = autoscaling_config_from_ray_cluster(cr)
+    out: List[Any] = []
+    for g in cfg["worker_groups"]:
+        hosts = g["hosts_per_replica"]
+        replicas = max(g["max_workers"] // max(hosts, 1), 1)
+        if hosts > 1:
+            out.append(NodeType(
+                name=f"{g['name']}-worker0",
+                resources=g["worker0_resources"],
+                labels={"kuberay-group": g["name"], "slice-host": "0"},
+                max_workers=replicas))
+            out.append(NodeType(
+                name=g["name"],
+                resources=g["resources"],
+                labels={"kuberay-group": g["name"]},
+                max_workers=replicas * (hosts - 1)))
+        else:
+            out.append(NodeType(
+                name=g["name"],
+                resources=g["worker0_resources"],
+                labels={"kuberay-group": g["name"]},
+                max_workers=g["max_workers"]))
+    return out

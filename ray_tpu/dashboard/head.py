@@ -20,7 +20,8 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from .job_manager import JobManager
 
@@ -43,6 +44,7 @@ class DashboardHead:
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/placement_groups", self._pgs)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/profile/stacks", self._profile_stacks)
         app.router.add_post("/api/jobs", self._submit_job)
         app.router.add_get("/api/jobs", self._list_jobs)
@@ -153,8 +155,79 @@ class DashboardHead:
 
         from ..util import metrics as metrics_api
         text = await self._in_thread(metrics_api.export_prometheus)
-        return web.Response(text=text,
+        node_text = await self._in_thread(self._node_metrics_text)
+        return web.Response(text=text + node_text,
                             content_type="text/plain")
+
+    @staticmethod
+    def _node_metrics_text() -> str:
+        """Per-node gauges synthesized from the controller's node views
+        (the per-node stats ride the resource gossip — this IS the
+        per-node metrics pipeline; reference parity role:
+        _private/metrics_agent.py:492 + dashboard metrics module)."""
+        from ..util import state as state_api
+        lines: List[str] = []
+
+        def gauge(name, help_, rows):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.extend(rows)
+
+        try:
+            nodes = [n for n in state_api.list_nodes() if n.get("alive")]
+        except Exception:
+            return ""
+        stats_fields = (
+            ("num_workers", "ray_tpu_node_workers", "workers per node"),
+            ("object_store_bytes", "ray_tpu_node_object_store_bytes",
+             "node object store bytes"),
+            ("bytes_spilled", "ray_tpu_node_bytes_spilled",
+             "cumulative spilled bytes"),
+            ("oom_kills", "ray_tpu_node_oom_kills",
+             "cumulative OOM kills"),
+            ("arena_pressure", "ray_tpu_node_arena_pressure",
+             "shm arena allocated/capacity"),
+        )
+        for field, metric, help_ in stats_fields:
+            gauge(metric, help_, [
+                f'{metric}{{node_id="{n["node_id"][:12]}"}} '
+                f'{n.get("stats", {}).get(field, 0)}'
+                for n in nodes])
+        for which in ("total", "available"):
+            metric = f"ray_tpu_node_resource_{which}"
+            gauge(metric, f"node resources {which}", [
+                f'{metric}{{node_id="{n["node_id"][:12]}",'
+                f'resource="{res}"}} {val}'
+                for n in nodes
+                for res, val in (n.get(f"resources_{which}") or {}).items()
+            ])
+        return "\n".join(lines) + "\n"
+
+    async def _timeline(self, request):
+        """Chrome-trace ("traceEvents") JSON of the task-event table —
+        load in Perfetto / chrome://tracing (reference parity: the
+        dashboard timeline built on task events)."""
+        from ..util import state as state_api
+        tasks = await self._in_thread(state_api.list_tasks)
+        events = []
+        for t in tasks:
+            start = t.get("start_time")
+            if start is None:
+                continue
+            end = t.get("end_time") or time.time()
+            events.append({
+                "name": t.get("name") or t["task_id"][:8],
+                "cat": t.get("type", "NORMAL_TASK"),
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max((end - start) * 1e6, 1.0),
+                "pid": (t.get("node_id") or "pending")[:12],
+                "tid": t["task_id"][:8],
+                "args": {"state": t.get("state"),
+                         "task_id": t["task_id"]},
+            })
+        return self._json({"traceEvents": events,
+                           "displayTimeUnit": "ms"})
 
     # -- job routes ---------------------------------------------------------
     async def _submit_job(self, request):
@@ -222,6 +295,19 @@ def start_dashboard(host: str = "127.0.0.1",
         raise TimeoutError("dashboard failed to start")
     _dashboard = dash
     _thread_loop = loop
+    # Materialize the Prometheus/Grafana provisioning configs beside the
+    # session (reference parity: dashboard metrics_head generation)
+    try:
+        from ray_tpu._private import state as _state
+        client = _state.current_client_or_none()
+        session = getattr(client, "session_name", None)
+        if session:
+            from ray_tpu._private.config import session_dir
+            from .metrics_config import write_metrics_configs
+            write_metrics_configs(session_dir(session),
+                                  f"{dash.host}:{dash.port}")
+    except Exception:
+        pass
     return dash
 
 

@@ -59,7 +59,7 @@ INDEX_HTML = """<!DOCTYPE html>
 <main id="main"></main>
 <script>
 const TABS = ["cluster","nodes","actors","tasks","placement_groups",
-              "jobs","objects","profile"];
+              "jobs","objects","profile","timeline"];
 let tab = location.hash.slice(1) || "cluster";
 const $ = (id) => document.getElementById(id);
 const esc = (s) => String(s ?? "").replace(/[&<>]/g,
@@ -182,6 +182,29 @@ const VIEWS = {
       ["backend", r => esc(r.backend)],
       ["node", r => shortid(r.node_id)],
     ]);
+  },
+  async timeline() {
+    const data = await api("/api/timeline");
+    const evs = (data.traceEvents||[]);
+    if (!evs.length) return `<p class="dim">no finished tasks yet</p>`;
+    const t0 = Math.min(...evs.map(e => e.ts));
+    const t1 = Math.max(...evs.map(e => e.ts + e.dur));
+    const span = Math.max(t1 - t0, 1);
+    const lanes = [...new Set(evs.map(e => e.pid))];
+    const rows = lanes.map(pid => {
+      const bars = evs.filter(e => e.pid === pid).map(e => {
+        const l = (e.ts - t0) / span * 100, w = Math.max(e.dur/span*100, 0.3);
+        return `<div title="${esc(e.name)} ${Math.round(e.dur/1000)}ms"` +
+          ` style="position:absolute;left:${l}%;width:${w}%;height:14px;` +
+          `background:var(--accent,#4c8);border-radius:2px;opacity:.8"></div>`;
+      }).join("");
+      return `<div style="margin:6px 0"><span class="dim">node ${esc(pid)}</span>` +
+        `<div style="position:relative;height:16px;background:var(--panel)">${bars}</div></div>`;
+    }).join("");
+    return `<p class="dim">task timeline (${evs.length} tasks, ` +
+      `${Math.round(span/1000)}ms) — ` +
+      `<a href="/api/timeline" download="timeline.json" style="color:inherit">` +
+      `download chrome-trace JSON</a> for Perfetto</p>` + rows;
   },
   async profile() {
     const data = await api("/api/profile/stacks");

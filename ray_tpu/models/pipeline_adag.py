@@ -156,7 +156,7 @@ class CompiledPipeline:
         the single-threaded driver against the full input channel."""
         token_batches = [np.asarray(t) for t in token_batches]
         if token_batches:
-            self._check_fits(token_batches[0])
+            self._check_fits(max(token_batches, key=lambda t: t.size))
         depth = len(self.stages) + 1
         out: List[np.ndarray] = []
         refs: List[Any] = []

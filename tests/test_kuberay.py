@@ -1,0 +1,96 @@
+"""GKE/KubeRay TPU derivation (VERDICT r3 missing #7; reference parity:
+autoscaler/_private/kuberay/autoscaling_config.py:236-273)."""
+
+import pytest
+
+from ray_tpu.autoscaler.kuberay import (autoscaling_config_from_ray_cluster,
+                                        tpu_node_selectors_to_type,
+                                        worker_group_resources)
+
+
+def _tpu_group(accelerator="tpu-v5p-slice", topology="2x2x2",
+               tpus="4", hosts=2, min_r=1, max_r=2):
+    return {
+        "groupName": "tpu-workers",
+        "minReplicas": min_r,
+        "maxReplicas": max_r,
+        "numOfHosts": hosts,
+        "rayStartParams": {},
+        "template": {"spec": {
+            "nodeSelector": {
+                "cloud.google.com/gke-tpu-accelerator": accelerator,
+                "cloud.google.com/gke-tpu-topology": topology,
+            },
+            "containers": [{"resources": {
+                "limits": {"cpu": "8", "google.com/tpu": tpus},
+            }}],
+        }},
+    }
+
+
+def test_selectors_to_type():
+    assert tpu_node_selectors_to_type("2x2x2", "tpu-v4-podslice") == "v4-16"
+    assert tpu_node_selectors_to_type("2x2x2", "tpu-v5p-slice") == "v5p-16"
+    assert tpu_node_selectors_to_type("2x4", "tpu-v5-lite-podslice") \
+        == "v5e-8"
+    assert tpu_node_selectors_to_type("4x4", "tpu-v6e-slice") == "v6e-16"
+    assert tpu_node_selectors_to_type(None, "tpu-v4-podslice") is None
+    with pytest.raises(ValueError, match="unknown GKE TPU"):
+        tpu_node_selectors_to_type("2x2", "tpu-v99")
+    with pytest.raises(ValueError, match="malformed"):
+        tpu_node_selectors_to_type("2xx2", "tpu-v4-podslice")
+
+
+def test_worker_group_resources_tpu_slice():
+    res0 = worker_group_resources(_tpu_group(), host_index=0)
+    assert res0 == {"CPU": 8.0, "TPU": 4.0, "TPU-v5p-16": 4.0,
+                    "TPU-v5p-16-head": 1.0}
+    # worker-0-only gang anchor (accelerators/tpu.py:101-110)
+    res1 = worker_group_resources(_tpu_group(), host_index=1)
+    assert res1 == {"CPU": 8.0, "TPU": 4.0, "TPU-v5p-16": 4.0}
+
+
+def test_ray_start_params_override_k8s_tpu():
+    g = _tpu_group()
+    g["rayStartParams"] = {"resources": '{"TPU": 8, "accel": 2}'}
+    res = worker_group_resources(g)
+    assert res["TPU"] == 8.0 and res["accel"] == 2.0
+
+
+def test_cpu_only_group():
+    g = {"groupName": "cpu", "template": {"spec": {"containers": [
+        {"resources": {"requests": {"cpu": "4000m"}}}]}}}
+    assert worker_group_resources(g) == {"CPU": 4.0}
+
+
+def test_autoscaling_config_counts_hosts_per_replica():
+    cr = {"spec": {
+        "headGroupSpec": {"template": {"spec": {"containers": [
+            {"resources": {"limits": {"cpu": "2"}}}]}}},
+        "workerGroupSpecs": [_tpu_group(hosts=4, min_r=1, max_r=3)],
+    }}
+    cfg = autoscaling_config_from_ray_cluster(cr)
+    assert cfg["head_resources"] == {"CPU": 2.0}
+    (g,) = cfg["worker_groups"]
+    assert g["min_workers"] == 4 and g["max_workers"] == 12
+    assert g["hosts_per_replica"] == 4
+    assert g["worker0_resources"]["TPU-v5p-16-head"] == 1.0
+    assert "TPU-v5p-16-head" not in g["resources"]
+
+
+def test_node_types_for_reconciler():
+    from ray_tpu.autoscaler.kuberay import node_types_from_ray_cluster
+    cr = {"spec": {"workerGroupSpecs": [
+        _tpu_group(hosts=4, min_r=1, max_r=3),
+        {"groupName": "cpu", "maxReplicas": 5, "template": {"spec": {
+            "containers": [{"resources": {"limits": {"cpu": "2"}}}]}}},
+    ]}}
+    types = node_types_from_ray_cluster(cr)
+    by_name = {t.name: t for t in types}
+    assert set(by_name) == {"tpu-workers-worker0", "tpu-workers", "cpu"}
+    w0 = by_name["tpu-workers-worker0"]
+    assert w0.resources["TPU-v5p-16-head"] == 1.0 and w0.max_workers == 3
+    rest = by_name["tpu-workers"]
+    assert "TPU-v5p-16-head" not in rest.resources
+    assert rest.max_workers == 9       # 3 replicas x 3 non-head hosts
+    assert by_name["cpu"].max_workers == 5
