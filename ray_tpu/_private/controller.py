@@ -130,9 +130,10 @@ class Controller:
         # restart resumes with live actor addresses and named lookups
         # intact. Disable with persist_path="" for throwaway controllers.
         if persist_path is None:
-            from .config import get_config
+            from .config import get_config, session_dir
             persist_path = (get_config().gcs_persist_path
-                            or f"/tmp/ray_tpu/{session_name}/gcs.db")
+                            or os.path.join(session_dir(session_name),
+                                            "gcs.db"))
         self.store = None
         if persist_path:
             from .gcs_store import GcsStore

@@ -1098,6 +1098,9 @@ class CoreClient:
             # surfaced so the daemon can prefetch them while the task
             # waits for a worker (reference: raylet/dependency_manager.h)
             "arg_refs": _top_level_arg_refs(args, kwargs),
+            # span context propagation (reference:
+            # tracing_helper.py:165 _DictPropagator in task specs)
+            "_trace_ctx": _inject_trace(),
         }
         if streaming:
             bp = opts.get("_generator_backpressure_num_objects")
@@ -1164,6 +1167,7 @@ class CoreClient:
             "lifetime": opts.get("lifetime"),
             "runtime_env": opts.get("runtime_env"),
             "arg_refs": _top_level_arg_refs(args, kwargs),
+            "_trace_ctx": _inject_trace(),
         }
         creation_ref = ObjectRef(return_id, self.address, _client=self)
 
@@ -1388,6 +1392,11 @@ class _LeaseGroup:
         self.key = key
         self.queue: "deque[dict]" = deque()
         self.num_pumps = 0
+
+
+def _inject_trace():
+    from ..util import tracing
+    return tracing.inject_context()
 
 
 def _top_level_arg_refs(args: tuple, kwargs: dict) -> List[tuple]:

@@ -305,8 +305,9 @@ class NodeObjectStore:
         self.session_name = session_name
         self._entries: Dict[str, ShmStoreEntry] = {}
         self._seq = 0
+        from .config import session_dir
         self.spill_dir = spill_dir or os.path.join(
-            "/tmp/ray_tpu", session_name, "spill")
+            session_dir(session_name), "spill")
         self.bytes_spilled = 0
         self.objects_spilled = 0
         self._spill_lock = threading.Lock()

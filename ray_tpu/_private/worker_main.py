@@ -427,9 +427,10 @@ class WorkerRuntime:
             fn = await self._load_fn(spec)
             args, kwargs = await self._resolve_args(
                 spec["args_blob"], spec.get("_arg_locations"))
-            from ..util.tracing import span
-            with span(spec.get("name", "task"), "task::execute",
-                      task_id=spec.get("task_id", "")[:16]):
+            from ..util import tracing
+            with tracing.span(spec.get("name", "task"), "task::execute",
+                              parent=spec.get("_trace_ctx"),
+                              task_id=spec.get("task_id", "")[:16]):
                 if streaming:
                     # The call itself must not block (generators return
                     # instantly); iteration happens below, item by item.
@@ -437,8 +438,14 @@ class WorkerRuntime:
                 elif inspect.iscoroutinefunction(fn):
                     result = await fn(*args, **kwargs)
                 else:
+                    # copy_context: the ambient trace span (and any other
+                    # contextvars) must be visible inside the user fn
+                    # even though it runs on the executor thread
+                    import contextvars
+                    cctx = contextvars.copy_context()
                     result = await loop.run_in_executor(
-                        self.task_executor, lambda: fn(*args, **kwargs))
+                        self.task_executor,
+                        lambda: cctx.run(fn, *args, **kwargs))
         except Exception:
             tb = traceback.format_exc()
             await self._push_error(
@@ -447,6 +454,10 @@ class WorkerRuntime:
                 task_id=spec["task_id"],
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
             return {"status": "error"}
+        if tracing.is_enabled():
+            # cluster-trace assembly: the driver reads these via
+            # collect_cluster() (rate-limited; see flush_to_kv)
+            tracing.flush_to_kv()
         if streaming:
             return await self._stream_results(spec, result)
         num_returns = spec.get("num_returns", 1)

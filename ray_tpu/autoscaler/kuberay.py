@@ -153,7 +153,9 @@ def node_types_from_ray_cluster(cr: Dict[str, Any]) -> List[Any]:
     out: List[Any] = []
     for g in cfg["worker_groups"]:
         hosts = g["hosts_per_replica"]
-        replicas = max(g["max_workers"] // max(hosts, 1), 1)
+        replicas = g["max_workers"] // max(hosts, 1)
+        if replicas <= 0:
+            continue          # CR caps this group at zero: not launchable
         if hosts > 1:
             out.append(NodeType(
                 name=f"{g['name']}-worker0",

@@ -62,8 +62,8 @@ const TABS = ["cluster","nodes","actors","tasks","placement_groups",
               "jobs","objects","profile","timeline"];
 let tab = location.hash.slice(1) || "cluster";
 const $ = (id) => document.getElementById(id);
-const esc = (s) => String(s ?? "").replace(/[&<>]/g,
-    c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+    c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 
 function renderTabs() {
   $("tabs").innerHTML = TABS.map(t =>

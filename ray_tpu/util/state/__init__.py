@@ -57,11 +57,34 @@ def list_objects() -> List[Dict[str, Any]]:
 
 
 def summarize_tasks() -> Dict[str, Any]:
+    """Grouped aggregation (reference parity: `ray summary tasks` /
+    dashboard state_aggregator TaskSummaries): per func name, the state
+    breakdown plus duration stats of finished runs."""
     tasks = list_tasks()
     by_state = Counter(t["state"] for t in tasks)
-    by_name = Counter(t["name"] for t in tasks)
+    groups: Dict[str, Dict[str, Any]] = {}
+    for t in tasks:
+        g = groups.setdefault(t["name"] or "?", {
+            "state_counts": Counter(), "durations": []})
+        g["state_counts"][t["state"]] += 1
+        start, end = t.get("start_time"), t.get("end_time")
+        if start and end:
+            g["durations"].append(end - start)
+
+    def _stats(ds):
+        if not ds:
+            return None
+        ds = sorted(ds)
+        return {"count": len(ds),
+                "mean_s": round(sum(ds) / len(ds), 4),
+                "min_s": round(ds[0], 4), "max_s": round(ds[-1], 4),
+                "p50_s": round(ds[len(ds) // 2], 4)}
+
     return {"total": len(tasks), "by_state": dict(by_state),
-            "by_func_name": dict(by_name)}
+            "by_func_name": {
+                name: {"state_counts": dict(g["state_counts"]),
+                       "duration": _stats(g["durations"])}
+                for name, g in groups.items()}}
 
 
 def summarize_actors() -> Dict[str, Any]:

@@ -1,16 +1,25 @@
-"""Task/actor tracing: spans + Chrome-trace export.
+"""Task/actor tracing: spans + Chrome-trace export + ctx propagation.
 
 Reference parity: python/ray/util/tracing/tracing_helper.py (opt-in
-OpenTelemetry spans around submit/execute with context propagated in
-task specs) and the dashboard's Chrome-trace timeline. Here spans are
-recorded in a process-local ring and exported as Chrome trace events
-(chrome://tracing / Perfetto "traceEvents" JSON); enable with
-RAY_TPU_TRACE=1 or tracing.enable().
+OpenTelemetry spans around submit/execute, with the span CONTEXT
+propagated inside task specs — _DictPropagator, tracing_helper.py:165)
+and the dashboard's Chrome-trace timeline. Spans are recorded in a
+process-local ring and exported as Chrome trace events ("traceEvents"
+JSON); enable with RAY_TPU_TRACE=1 or tracing.enable().
+
+Propagation: the submitting side calls inject_context() (the ambient
+span's ids + a Perfetto flow-start event) and ships the dict in the
+task spec; the executing worker wraps the task in
+span(..., parent=ctx), which emits the matching flow-finish — in
+Perfetto the driver's submit span gets an arrow to the worker's
+execute span, across processes and hosts.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import itertools
 import json
 import os
 import threading
@@ -21,6 +30,41 @@ _lock = threading.Lock()
 _events: List[Dict[str, Any]] = []
 _enabled = bool(os.environ.get("RAY_TPU_TRACE"))
 _MAX_EVENTS = 100_000
+_span_counter = itertools.count(1)
+# the ambient span: {"trace_id", "span_id"} (reference: the OTel
+# current-span context _DictPropagator serializes into task specs)
+_current: "contextvars.ContextVar[Optional[Dict[str, str]]]" = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The ambient span context ({"trace_id","span_id"}) or None."""
+    return _current.get()
+
+
+def _new_span_id() -> str:
+    return f"{os.getpid():x}.{next(_span_counter):x}"
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """Serialize the ambient context for a task spec; emits the
+    Perfetto flow-start so the consumer side can draw the arrow.
+    Returns None when tracing is off or no span is open."""
+    ctx = _current.get()
+    if not _enabled or ctx is None:
+        return None
+    # one flow id PER SUBMISSION: reusing the span id would chain every
+    # task submitted under one driver span into a single flow path
+    flow_id = _new_span_id()
+    now = time.time_ns() / 1e3
+    with _lock:
+        if len(_events) < _MAX_EVENTS:
+            _events.append({
+                "name": "submit", "cat": "flow", "ph": "s",
+                "id": flow_id, "ts": now,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000})
+    return {**ctx, "flow_id": flow_id}
 
 
 def enable() -> None:
@@ -38,24 +82,50 @@ def is_enabled() -> bool:
 
 
 @contextlib.contextmanager
-def span(name: str, category: str = "task", **attrs):
-    """Record one duration span (no-op unless tracing is enabled)."""
+def span(name: str, category: str = "task",
+         parent: Optional[Dict[str, str]] = None, **attrs):
+    """Record one duration span (no-op unless tracing is enabled).
+
+    `parent` is a propagated context from inject_context() (a task spec
+    crossing processes): the span joins that trace and emits the
+    Perfetto flow-finish binding it to the submitter's arrow. Without
+    `parent`, the span nests under the ambient span of this process.
+    Yields the span context dict when tracing is ON and None when OFF —
+    guard any use of the yielded value."""
     if not _enabled:
         yield
         return
-    start = time.perf_counter_ns()
+    prev = _current.get()
+    remote_parent = parent is not None
+    parent = parent or prev
+    ctx = {"trace_id": (parent or {}).get("trace_id") or _new_span_id(),
+           "span_id": _new_span_id()}
+    token = _current.set(ctx)
+    start = time.time_ns()      # epoch: cross-process events must align
     try:
-        yield
+        yield ctx
     finally:
-        end = time.perf_counter_ns()
+        end = time.time_ns()
+        _current.reset(token)
+        tid = threading.get_ident() % 100000
         with _lock:
             if len(_events) < _MAX_EVENTS:
+                if remote_parent:
+                    _events.append({
+                        "name": "submit", "cat": "flow", "ph": "f",
+                        "bp": "e",
+                        "id": parent.get("flow_id", parent["span_id"]),
+                        "ts": start / 1e3, "pid": os.getpid(),
+                        "tid": tid})
                 _events.append({
                     "name": name, "cat": category, "ph": "X",
                     "ts": start / 1e3, "dur": (end - start) / 1e3,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident() % 100000,
-                    "args": attrs,
+                    "pid": os.getpid(), "tid": tid,
+                    "args": {**attrs,
+                             "trace_id": ctx["trace_id"],
+                             "span_id": ctx["span_id"],
+                             **({"parent_span_id": parent["span_id"]}
+                                if parent else {})},
                 })
 
 
@@ -69,10 +139,63 @@ def clear() -> None:
         _events.clear()
 
 
-def export_chrome_trace(path: Optional[str] = None) -> str:
-    """Write (or return) the Chrome trace JSON for this process."""
-    doc = json.dumps({"traceEvents": get_events(),
-                      "displayTimeUnit": "ms"})
+_last_flush = 0.0
+
+
+def flush_to_kv(min_interval_s: float = 1.0) -> None:
+    """Publish this process's events to the controller KV so the driver
+    can assemble a CLUSTER trace (workers call this after traced task
+    executions, rate-limited; mirrors util.metrics.flush_to_kv)."""
+    global _last_flush
+    now = time.monotonic()
+    if now - _last_flush < min_interval_s:
+        return
+    from ..._private import state as _state
+    client = _state.current_client_or_none()
+    if client is None:
+        return
+    _last_flush = now
+    wid = getattr(client, "worker_id", None) or f"pid{os.getpid()}"
+    key = f"__trace__/{wid}"
+    blob = json.dumps(get_events()).encode()
+    try:
+        if client.loop_runner.on_loop_thread():
+            # worker RPC handlers run ON the loop: fire-and-forget the
+            # put (the sync kv_put would deadlock-guard and raise)
+            client.loop_runner.call_soon(client._controller().call(
+                "kv_put", key=key, value=blob, overwrite=True))
+        else:
+            client.kv_put(key, blob)
+    except Exception:
+        pass
+
+
+def collect_cluster() -> List[Dict[str, Any]]:
+    """This process's events merged with every flushed worker ring —
+    the cross-process trace (flow arrows pair up across pids because
+    timestamps are epoch-based)."""
+    from ..._private import state as _state
+    events = get_events()
+    client = _state.current_client_or_none()
+    if client is None:
+        return events
+    try:
+        for key in client.controller_rpc("kv_keys", prefix="__trace__/"):
+            blob = client.kv_get(key)
+            if blob:
+                events.extend(json.loads(blob))
+    except Exception:
+        pass
+    return events
+
+
+def export_chrome_trace(path: Optional[str] = None,
+                        cluster: bool = False) -> str:
+    """Write (or return) the Chrome trace JSON — this process's ring,
+    or (cluster=True) merged with every flushed worker's."""
+    doc = json.dumps({
+        "traceEvents": collect_cluster() if cluster else get_events(),
+        "displayTimeUnit": "ms"})
     if path:
         with open(path, "w") as f:
             f.write(doc)
@@ -80,4 +203,5 @@ def export_chrome_trace(path: Optional[str] = None) -> str:
 
 
 __all__ = ["enable", "disable", "is_enabled", "span", "get_events",
-           "clear", "export_chrome_trace"]
+           "clear", "export_chrome_trace", "inject_context",
+           "current_context", "flush_to_kv", "collect_cluster"]

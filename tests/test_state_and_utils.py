@@ -313,3 +313,70 @@ def test_metrics_plane_node_gauges_timeline_grafana(ray_start, tmp_path):
                    for t in p["targets"]]
     assert any("arena_pressure" in e for e in panel_exprs)
     assert open(arts["grafana_datasource"]).read().startswith("apiVersion")
+
+
+def test_trace_context_propagates_into_tasks(ray_start):
+    """Span context rides the task spec into the worker (reference
+    parity: tracing_helper.py:165 _DictPropagator): the worker's
+    execute span joins the driver's trace, and the user fn sees the
+    ambient context."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    tracing.clear()
+    try:
+        @ray_tpu.remote(runtime_env={"env_vars": {"RAY_TPU_TRACE": "1"}})
+        def traced():
+            from ray_tpu.util import tracing as t
+            return t.current_context()
+
+        with tracing.span("driver_work") as driver_ctx:
+            ref = traced.remote()
+        worker_ctx = ray_tpu.get(ref, timeout=60)
+        assert worker_ctx is not None, "worker saw no ambient span"
+        assert worker_ctx["trace_id"] == driver_ctx["trace_id"]
+        assert worker_ctx["span_id"] != driver_ctx["span_id"]
+        # the driver side emitted the Perfetto flow-start for the arrow
+        evs = tracing.get_events()
+        starts = [e for e in evs if e.get("ph") == "s"]
+        assert starts, evs
+        # cluster assembly: the worker's execute span + flow-finish
+        # arrive via the KV ring (flush_to_kv -> collect_cluster), the
+        # finish bound to the submission's flow id
+        deadline = _time.time() + 15
+        finishes = []
+        while _time.time() < deadline and not finishes:
+            cluster = tracing.collect_cluster()
+            finishes = [e for e in cluster if e.get("ph") == "f"]
+            _time.sleep(0.2)
+        assert finishes, "worker flow-finish never flushed"
+        assert {e["id"] for e in finishes} <= {e["id"] for e in starts}
+        assert any(e.get("cat") == "task::execute"
+                   for e in tracing.collect_cluster())
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+def test_summarize_tasks_duration_stats(ray_start):
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def napper():
+        _time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([napper.remote() for _ in range(3)])
+    summary = state_api.summarize_tasks()
+    group = summary["by_func_name"].get("napper")
+    assert group is not None, summary
+    assert group["state_counts"].get("FINISHED", 0) >= 3
+    dur = group["duration"]
+    assert dur and dur["count"] >= 3
+    assert dur["mean_s"] >= 0.03, dur
