@@ -428,6 +428,10 @@ class WorkerRuntime:
             args, kwargs = await self._resolve_args(
                 spec["args_blob"], spec.get("_arg_locations"))
             from ..util import tracing
+            if spec.get("_trace_ctx") and not tracing.is_enabled():
+                # the submitter traces: join without requiring every
+                # worker env to set RAY_TPU_TRACE independently
+                tracing.enable()
             with tracing.span(spec.get("name", "task"), "task::execute",
                               parent=spec.get("_trace_ctx"),
                               task_id=spec.get("task_id", "")[:16]):
@@ -455,9 +459,11 @@ class WorkerRuntime:
                 object_ids=spec.get("return_ids") or [spec["return_id"]])
             return {"status": "error"}
         if tracing.is_enabled():
-            # cluster-trace assembly: the driver reads these via
-            # collect_cluster() (rate-limited; see flush_to_kv)
+            # cluster-trace assembly: rate-limited incremental flush,
+            # plus a trailing flush so a burst's tail isn't stranded
+            # until the next traced task
             tracing.flush_to_kv()
+            loop.call_later(1.5, tracing.flush_to_kv, 0.0)
         if streaming:
             return await self._stream_results(spec, result)
         num_returns = spec.get("num_returns", 1)
@@ -639,8 +645,18 @@ class WorkerRuntime:
             args, kwargs = await self._resolve_args(
                 spec["args_blob"], spec.get("_arg_locations"))
             self.current_actor_id = actor_id
-            instance = await loop.run_in_executor(
-                None, lambda: cls(*args, **kwargs))
+            from ..util import tracing
+            if spec.get("_trace_ctx") and not tracing.is_enabled():
+                tracing.enable()
+            with tracing.span(spec.get("name", "actor"),
+                              "actor::create",
+                              parent=spec.get("_trace_ctx"),
+                              actor_id=actor_id[:16]):
+                instance = await loop.run_in_executor(
+                    None, lambda: cls(*args, **kwargs))
+            if tracing.is_enabled():
+                tracing.flush_to_kv()
+                loop.call_later(1.5, tracing.flush_to_kv, 0.0)
         except Exception:
             tb = traceback.format_exc()
             from ..exceptions import ActorDiedError
@@ -768,6 +784,9 @@ class WorkerRuntime:
         return memory_summary()
 
     async def rpc_shutdown_worker(self) -> dict:
+        from ..util import tracing
+        if tracing.is_enabled():
+            tracing.flush_to_kv(0.0)   # the ring's tail must not die here
         asyncio.get_running_loop().call_later(0.05, sys.exit, 0)
         return {"status": "ok"}
 

@@ -69,6 +69,8 @@ class SamplingParams:
     max_tokens: int = 64
     temperature: float = 0.0             # 0 → greedy
     top_p: float = 1.0
+    top_k: int = 0                       # 0 → off
+    repetition_penalty: float = 1.0      # 1.0 → off (CTRL-style)
     stop_token_ids: tuple = ()
 
 
@@ -94,23 +96,44 @@ class _Slot:
         self.ready = False       # prompt fully prefilled, decoding
 
 
-def _sample(logits, key, temps, top_ps, all_greedy: bool = False):
-    """logits: (B, V) f32; temps/top_ps: (B,). Greedy where temp<=0.
+def _sample(logits, key, temps, top_ps, top_ks=None, rep_pens=None,
+            seen=None, all_greedy: bool = False):
+    """logits: (B, V) f32; temps/top_ps/top_ks/rep_pens: (B,);
+    seen: (B, V) bool — tokens already in each sequence (prompt +
+    generated), the repetition-penalty support. Greedy where temp<=0.
 
-    all_greedy (static) skips the top-p machinery entirely — the argsort
+    Order mirrors the usual serving stacks (HF/vLLM): repetition
+    penalty on raw logits (CTRL: positive seen logits divided, negative
+    multiplied), then temperature, top-k, top-p, sample.
+
+    all_greedy (static) skips the sort machinery entirely — the argsort
     over the vocab is the expensive part of sampling on TPU and pure
-    argmax decoding (the common batch-inference case) never needs it.
+    argmax decoding (the common batch-inference case) never needs it
+    (the engine only sets it when every penalty is off too).
     """
+    if rep_pens is not None and seen is not None:
+        pen = jnp.where(logits > 0,
+                        logits / rep_pens[:, None],
+                        logits * rep_pens[:, None])
+        logits = jnp.where(seen, pen, logits)
     greedy = jnp.argmax(logits, axis=-1)
     if all_greedy:
         return greedy.astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    # top-p: keep the smallest prefix of the sorted probs covering top_p
     sort_idx = jnp.argsort(-scaled, axis=-1)
     sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    if top_ks is not None:
+        # keep ranks < top_k (0 = off): mask in SORTED space, before
+        # top-p renormalizes over what's left
+        rank = jnp.arange(logits.shape[-1])[None, :]
+        sorted_logits = jnp.where(
+            (top_ks[:, None] > 0) & (rank >= top_ks[:, None]),
+            -jnp.inf, sorted_logits)
+    # top-p: keep the smallest prefix of the sorted probs covering top_p
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = (cum - probs) < top_ps[:, None]   # always keeps rank 0
+    keep_sorted = ((cum - probs) < top_ps[:, None]) \
+        & jnp.isfinite(sorted_logits)               # always keeps rank 0
     keep = jnp.zeros_like(keep_sorted).at[
         jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
     filtered = jnp.where(keep, scaled, -jnp.inf)
@@ -159,8 +182,8 @@ class InferenceEngine:
             (ec.max_batch_size, self.max_pages_per_seq), np.int32)
 
         self._decode_fn = jax.jit(
-            self._build_decode(), donate_argnums=(1, 2),
-            static_argnums=(10,))
+            self._build_decode(), donate_argnums=(1, 2, 3),
+            static_argnums=(13,))
         self._d_tokens = None          # device-resident slot state
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
@@ -226,13 +249,23 @@ class InferenceEngine:
 
         mesh = self.mesh
 
-        def step(params, k_pages, v_pages, tokens, positions, page_tables,
-                 active, key, temps, top_ps, all_greedy):
+        def step(params, k_pages, v_pages, seen, tokens, positions,
+                 page_tables, active, key, temps, top_ps, top_ks,
+                 rep_pens, all_greedy):
             logits, k_pages, v_pages = decode_step(
                 cfg, params, tokens, positions, k_pages, v_pages,
                 page_tables, active, impl=impl, mesh=mesh)
-            new_tokens = _sample(logits, key, temps, top_ps, all_greedy)
-            return new_tokens, k_pages, v_pages
+            if all_greedy:
+                # static fast path: no penalties/seen bookkeeping — the
+                # common greedy batch-inference case stays argmax-only
+                new_tokens = _sample(logits, key, temps, top_ps,
+                                     all_greedy=True)
+                return new_tokens, k_pages, v_pages, seen
+            new_tokens = _sample(logits, key, temps, top_ps, top_ks,
+                                 rep_pens, seen, False)
+            b = tokens.shape[0]
+            seen = seen.at[jnp.arange(b), new_tokens].max(active)
+            return new_tokens, k_pages, v_pages, seen
 
         return step
 
@@ -242,11 +275,18 @@ class InferenceEngine:
             cfg = self.model_cfg
 
             def run(params, k_pages, v_pages, tokens, true_lens,
-                    page_tables, key, temps, top_ps):
+                    page_tables, key, temps, top_ps, top_ks, rep_pens):
                 logits, k_pages, v_pages = prefill(
                     cfg, params, tokens, true_lens, k_pages, v_pages,
                     page_tables)
-                first = _sample(logits, key, temps, top_ps)
+                # prompt tokens count as "seen" for the penalty (HF
+                # semantics penalize input_ids too); padding masked
+                b, bucket_len = tokens.shape
+                valid = jnp.arange(bucket_len)[None, :] < true_lens[:, None]
+                seen = jnp.zeros((b, cfg.vocab_size), bool)
+                seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+                first = _sample(logits, key, temps, top_ps, top_ks,
+                                rep_pens, seen)
                 return first, k_pages, v_pages
 
             fn = jax.jit(run, donate_argnums=(1, 2))
@@ -263,11 +303,16 @@ class InferenceEngine:
             from ...models.llama_infer import prefill_chunk
 
             def run(params, k_pages, v_pages, tokens, start_pos,
-                    chunk_lens, page_tables, key, temps, top_ps):
+                    chunk_lens, page_tables, key, temps, top_ps,
+                    top_ks, rep_pens, seen):
                 logits, k_pages, v_pages = prefill_chunk(
                     cfg, params, tokens, start_pos, chunk_lens,
                     k_pages, v_pages, page_tables, ctx_pages=ctx_pages)
-                first = _sample(logits, key, temps, top_ps)
+                b, bucket_len = tokens.shape
+                valid = jnp.arange(bucket_len)[None, :] < chunk_lens[:, None]
+                seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+                first = _sample(logits, key, temps, top_ps, top_ks,
+                                rep_pens, seen)
                 return first, k_pages, v_pages
 
             fn = jax.jit(run, donate_argnums=(1, 2))
@@ -390,6 +435,9 @@ class InferenceEngine:
             self._page_tables[slot.index:slot.index + 1]))
         temps = self._dev(jnp.asarray([p.temperature], jnp.float32))
         top_ps = self._dev(jnp.asarray([p.top_p], jnp.float32))
+        top_ks = self._dev(jnp.asarray([p.top_k], jnp.int32))
+        rep_pens = self._dev(jnp.asarray(
+            [p.repetition_penalty], jnp.float32))
 
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
             # whole prompt in one go: the dense full-causal program
@@ -401,7 +449,7 @@ class InferenceEngine:
                 self.params, self.k_pages, self.v_pages,
                 self._dev(jnp.asarray(tokens)),
                 self._dev(jnp.asarray([n], jnp.int32)),
-                table, sub, temps, top_ps)
+                table, sub, temps, top_ps, top_ks, rep_pens)
             self._finish_prefill(slot, int(first[0]), touched)
             return
 
@@ -410,13 +458,21 @@ class InferenceEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :chunk] = req.prompt_tokens[
             slot.prefill_pos:slot.prefill_pos + chunk]
+        # "seen" so far = prior chunks of this prompt (the fn adds the
+        # current chunk itself); rebuilt host-side per chunk
+        V = self.model_cfg.vocab_size
+        prior = np.zeros((1, V), bool)
+        if slot.prefill_pos:
+            prior[0, np.asarray(
+                req.prompt_tokens[:slot.prefill_pos], np.int64) % V] = True
         first, self.k_pages, self.v_pages = self._chunk_fn(
             bucket, self._ctx_bucket(slot.prefill_pos))(
             self.params, self.k_pages, self.v_pages,
             self._dev(jnp.asarray(tokens)),
             self._dev(jnp.asarray([slot.prefill_pos], jnp.int32)),
             self._dev(jnp.asarray([chunk], jnp.int32)),
-            table, sub, temps, top_ps)
+            table, sub, temps, top_ps, top_ks, rep_pens,
+            self._dev(jnp.asarray(prior)))
         slot.prefill_pos += chunk
         if slot.prefill_pos >= n:
             self._finish_prefill(slot, int(first[0]), touched)
@@ -442,37 +498,59 @@ class InferenceEngine:
         steady-state step costs ONE dispatch + ONE small readback (this
         matters doubly when the chip sits behind a network tunnel)."""
         B = self.config.max_batch_size
+        V = self.model_cfg.vocab_size
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         active = np.zeros(B, bool)
         temps = np.zeros(B, np.float32)
         top_ps = np.ones(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        rep_pens = np.ones(B, np.float32)
+        seen = np.zeros((B, V), bool)
+        need_seen = any(
+            s.request is not None
+            and s.request.params.repetition_penalty != 1.0
+            for s in self.slots)
         for s in self.slots:
             if s.request is None or not s.ready:
                 continue       # empty or still prefilling: inactive
+            p = s.request.params
             tokens[s.index] = s.last_token
             positions[s.index] = s.position
             active[s.index] = True
-            temps[s.index] = s.request.params.temperature
-            top_ps[s.index] = s.request.params.top_p
+            temps[s.index] = p.temperature
+            top_ps[s.index] = p.top_p
+            top_ks[s.index] = p.top_k
+            rep_pens[s.index] = p.repetition_penalty
+            if need_seen:
+                # the (B,V) rebuild+upload only when a penalty is live
+                seen[s.index, np.asarray(
+                    s.request.prompt_tokens + s.request.output_tokens,
+                    np.int64) % V] = True
         self._d_tokens = self._dev(jnp.asarray(tokens))
         self._d_positions = self._dev(jnp.asarray(positions))
         self._d_active = self._dev(jnp.asarray(active))
         self._d_temps = self._dev(jnp.asarray(temps))
         self._d_top_ps = self._dev(jnp.asarray(top_ps))
+        self._d_top_ks = self._dev(jnp.asarray(top_ks))
+        self._d_rep_pens = self._dev(jnp.asarray(rep_pens))
+        self._d_seen = self._dev(jnp.asarray(seen))
         self._d_tables = self._dev(jnp.asarray(self._page_tables))
-        self._all_greedy = bool(np.all(temps <= 0.0))
+        self._all_greedy = bool(np.all(temps <= 0.0)
+                                and np.all(rep_pens == 1.0))
         self._host_active = active
 
     def _decode(self, touched: List[Request]) -> None:
         if self._d_tokens is None:
             self._refresh_device_state()
         self._key, sub = jax.random.split(self._key)
-        new_tokens, self.k_pages, self.v_pages = self._decode_fn(
-            self.params, self.k_pages, self.v_pages,
-            self._d_tokens, self._d_positions, self._d_tables,
-            self._d_active, sub, self._d_temps, self._d_top_ps,
-            self._all_greedy)
+        new_tokens, self.k_pages, self.v_pages, self._d_seen = \
+            self._decode_fn(
+                self.params, self.k_pages, self.v_pages, self._d_seen,
+                self._d_tokens, self._d_positions, self._d_tables,
+                self._d_active, sub, self._d_temps, self._d_top_ps,
+                self._d_top_ks, self._d_rep_pens,
+                self._all_greedy)
         # device-side feedback for the next step
         self._d_tokens = new_tokens
         self._d_positions = self._d_positions + self._d_active

@@ -90,6 +90,10 @@ class LLMServerImpl:
             max_tokens=int(body.get("max_tokens") or 32),
             temperature=float(body.get("temperature") or 0.0),
             top_p=float(body.get("top_p") or 1.0),
+            # OpenAI-API extensions every serving stack grew (vLLM/TGI)
+            top_k=int(body.get("top_k") or 0),
+            repetition_penalty=float(
+                body.get("repetition_penalty") or 1.0),
             stop_token_ids=stop)
 
     async def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:

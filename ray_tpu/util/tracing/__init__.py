@@ -140,13 +140,18 @@ def clear() -> None:
 
 
 _last_flush = 0.0
+_flushed_upto = 0
+_flush_seq = 0
 
 
 def flush_to_kv(min_interval_s: float = 1.0) -> None:
-    """Publish this process's events to the controller KV so the driver
-    can assemble a CLUSTER trace (workers call this after traced task
-    executions, rate-limited; mirrors util.metrics.flush_to_kv)."""
-    global _last_flush
+    """Publish this process's NEW events to the controller KV so the
+    driver can assemble a CLUSTER trace. Incremental: each flush ships
+    only the events recorded since the last one (chunked keys
+    __trace__/{wid}/{seq}), so cost is O(new), not O(ring). Workers
+    call this after traced executions (rate-limited), on a trailing
+    timer, and at shutdown with min_interval_s=0."""
+    global _last_flush, _flushed_upto, _flush_seq
     now = time.monotonic()
     if now - _last_flush < min_interval_s:
         return
@@ -154,10 +159,17 @@ def flush_to_kv(min_interval_s: float = 1.0) -> None:
     client = _state.current_client_or_none()
     if client is None:
         return
+    with _lock:
+        new = _events[_flushed_upto:]
+        if not new:
+            return
+        _flushed_upto += len(new)
+        _flush_seq += 1
+        seq = _flush_seq
     _last_flush = now
     wid = getattr(client, "worker_id", None) or f"pid{os.getpid()}"
-    key = f"__trace__/{wid}"
-    blob = json.dumps(get_events()).encode()
+    key = f"__trace__/{wid}/{seq:06d}"
+    blob = json.dumps(new).encode()
     try:
         if client.loop_runner.on_loop_thread():
             # worker RPC handlers run ON the loop: fire-and-forget the
