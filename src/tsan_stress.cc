@@ -1,0 +1,186 @@
+// ThreadSanitizer stress for the native arena + shm channels.
+//
+// Reference parity: the reference runs its C++ unit tests under
+// TSAN/ASAN in CI (SURVEY.md §5 race detection; ci/ray_ci sanitizer
+// configs). This binary hammers the two native components' public C
+// APIs from many threads; the pytest wrapper builds it with
+// -fsanitize=thread and fails on any ThreadSanitizer report.
+//
+//   arena: N writer threads alloc/write/seal/get/verify/release/delete
+//          their own ids while CONTENDING on a shared id set, plus an
+//          evictor thread reclaiming LRU space (the spill path).
+//   chan:  1 writer, 3 readers over one channel; payload integrity
+//          checked per message.
+//
+// Build+run (tests/test_native_tsan.py):
+//   g++ -fsanitize=thread -O1 -g -std=c++17 -pthread \
+//       src/tsan_stress.cc src/arena_store.cc src/shm_channel.cc
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* arena_create(const char* name, uint64_t size, uint64_t capacity);
+void* arena_attach(const char* name);
+int64_t arena_alloc(void* handle, const char* id, uint64_t size);
+int arena_seal(void* handle, const char* id);
+int arena_get(void* handle, const char* id, uint64_t* offset,
+              uint64_t* size);
+int arena_release(void* handle, const char* id);
+int arena_delete(void* handle, const char* id);
+uint64_t arena_evict(void* handle, uint64_t needed, char* out_ids,
+                     uint64_t max_ids, uint64_t* num_evicted);
+void* arena_base(void* handle);
+void arena_detach(void* handle);
+int arena_unlink(const char* name);
+
+void* chan_create(const char* name, uint64_t capacity,
+                  uint64_t num_readers);
+void* chan_attach(const char* name);
+int chan_write(void* handle, const char* buf, uint64_t len,
+               double timeout_s);
+int chan_read(void* handle, uint64_t reader_slot, uint64_t last_version,
+              char* out, uint64_t max_len, uint64_t* out_len,
+              uint64_t* out_version, double timeout_s);
+void chan_close(void* handle);
+void chan_detach(void* handle);
+int chan_unlink(const char* name);
+}
+
+namespace {
+
+constexpr int kThreads = 4;
+constexpr int kIters = 300;
+constexpr int kSharedIds = 8;
+
+void arena_worker(void* h, int tid) {
+  char* base = static_cast<char*>(arena_base(h));
+  for (int i = 0; i < kIters; i++) {
+    // private object: full life cycle with payload verification
+    char id[64];
+    snprintf(id, sizeof(id), "t%d-obj%d", tid, i);
+    uint64_t size = 256 + (i % 7) * 64;
+    int64_t off = arena_alloc(h, id, size);
+    if (off >= 0) {
+      memset(base + off, 0x40 + tid, size);
+      assert(arena_seal(h, id) == 0);
+      uint64_t got_off = 0, got_size = 0;
+      if (arena_get(h, id, &got_off, &got_size) == 0) {
+        assert(got_size == size);
+        for (uint64_t b = 0; b < got_size; b += 37)
+          assert(base[got_off + b] == char(0x40 + tid));
+        arena_release(h, id);
+      }
+      if (i % 3 != 0) arena_delete(h, id);  // rest left for the evictor
+    }
+    // shared ids: every thread races alloc/get/release/delete on them
+    char sid[64];
+    snprintf(sid, sizeof(sid), "shared-%d", i % kSharedIds);
+    int64_t soff = arena_alloc(h, sid, 128);
+    if (soff >= 0) {
+      memset(base + soff, 0x7e, 128);
+      arena_seal(h, sid);
+    }
+    uint64_t o = 0, s = 0;
+    if (arena_get(h, sid, &o, &s) == 0) {
+      volatile char sink = base[o];
+      (void)sink;
+      arena_release(h, sid);
+    }
+    if (i % 5 == tid % 5) arena_delete(h, sid);
+  }
+}
+
+void evictor(void* h, std::atomic<bool>* stop) {
+  while (!stop->load(std::memory_order_relaxed)) {
+    uint64_t n = 0;
+    arena_evict(h, 4096, nullptr, 0, &n);
+    std::this_thread::yield();
+  }
+}
+
+int run_arena() {
+  const char* name = "/rtpu_tsan_arena";
+  arena_unlink(name);
+  void* h = arena_create(name, 4 << 20, 4096);
+  if (!h) {
+    fprintf(stderr, "arena_create failed\n");
+    return 1;
+  }
+  std::atomic<bool> stop{false};
+  std::thread ev(evictor, h, &stop);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) ts.emplace_back(arena_worker, h, t);
+  for (auto& t : ts) t.join();
+  stop.store(true);
+  ev.join();
+  arena_detach(h);
+  arena_unlink(name);
+  return 0;
+}
+
+void chan_reader(const char* name, int slot, int expect) {
+  void* h = chan_attach(name);
+  assert(h);
+  uint64_t version = 0;
+  std::string buf(1 << 16, '\0');
+  int got = 0;
+  while (got < expect) {
+    uint64_t len = 0, new_version = 0;
+    int rc = chan_read(h, slot, version, buf.data(), buf.size(), &len,
+                       &new_version, 10.0);
+    if (rc == -32 /*EPIPE*/) break;
+    assert(rc == 0);
+    version = new_version;
+    assert(len >= 8);
+    uint64_t seq = 0;
+    memcpy(&seq, buf.data(), 8);
+    for (uint64_t b = 8; b < len; b++)
+      assert(buf[b] == char('a' + seq % 26));
+    got++;
+  }
+  chan_detach(h);
+}
+
+int run_channel() {
+  const char* name = "/rtpu_tsan_chan";
+  chan_unlink(name);
+  constexpr int kMsgs = 200;
+  constexpr int kReaders = 3;
+  void* w = chan_create(name, 1 << 16, kReaders);
+  if (!w) {
+    fprintf(stderr, "chan_create failed\n");
+    return 1;
+  }
+  std::vector<std::thread> rs;
+  for (int r = 0; r < kReaders; r++)
+    rs.emplace_back(chan_reader, name, r, kMsgs);
+  std::string msg(1 << 12, '\0');
+  for (uint64_t i = 0; i < kMsgs; i++) {
+    uint64_t len = 8 + (i % 1000);
+    memcpy(msg.data(), &i, 8);
+    memset(msg.data() + 8, 'a' + i % 26, len - 8);
+    int rc = chan_write(w, msg.data(), len, 10.0);
+    assert(rc == 0);
+  }
+  for (auto& t : rs) t.join();
+  chan_close(w);
+  chan_detach(w);
+  chan_unlink(name);
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  int rc = run_arena();
+  rc |= run_channel();
+  if (rc == 0) printf("TSAN_STRESS_OK\n");
+  return rc;
+}

@@ -1,0 +1,36 @@
+"""ThreadSanitizer gate for the native arena + shm channels.
+
+Reference parity: the reference's C++ tests run under TSAN/ASAN in CI
+(SURVEY.md §5 race detection). Builds src/tsan_stress.cc with
+-fsanitize=thread and fails on any ThreadSanitizer report.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+SRC = os.path.join(os.path.dirname(__file__), "..", "src")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_arena_and_channels_race_free_under_tsan(tmp_path):
+    binary = tmp_path / "tsan_stress"
+    build = subprocess.run(
+        ["g++", "-fsanitize=thread", "-O1", "-g", "-std=c++17",
+         "-pthread", "-o", str(binary),
+         os.path.join(SRC, "tsan_stress.cc"),
+         os.path.join(SRC, "arena_store.cc"),
+         os.path.join(SRC, "shm_channel.cc")],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-3000:]
+
+    run = subprocess.run(
+        [str(binary)], capture_output=True, text=True, timeout=600,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=0"})
+    report = run.stdout + run.stderr
+    assert "WARNING: ThreadSanitizer" not in report, report[-6000:]
+    assert run.returncode == 0, report[-3000:]
+    assert "TSAN_STRESS_OK" in run.stdout
