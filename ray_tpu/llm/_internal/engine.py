@@ -59,6 +59,10 @@ class EngineConfig:
     # prefill/decode jit over the whole mesh (the reference reaches TP
     # only by placing external vLLM workers, vllm_models.py:123-159).
     mesh: Any = None
+    # Multi-LoRA capacity: adapter stacks are padded to this many slots
+    # so registering adapters never changes compiled shapes (one
+    # recompile when the FIRST adapter arrives, none after).
+    max_loras: int = 8
 
     def resolve_model(self) -> LlamaConfig:
         return llama.config(self.model)
@@ -79,6 +83,10 @@ class Request:
     request_id: str
     prompt_tokens: List[int]
     params: SamplingParams
+    # registered LoRA adapter name (multi-LoRA serving: slots in one
+    # decode batch may run different adapters; reference parity role:
+    # serve LLM LoRA multiplexing, deployments/llm/multiplex/)
+    lora: Optional[str] = None
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
     finish_reason: Optional[str] = None
@@ -175,6 +183,13 @@ class InferenceEngine:
                                  self._kv_sharding)
         self._key = self._dev(jax.random.PRNGKey(ec.seed + 1))
 
+        # multi-LoRA: name -> adapter index (0 = the zero adapter);
+        # stacks are {proj: {"a": (A, L, H, r), "b": (A, r, O)}} device
+        # arrays rebuilt on registration (first registration recompiles
+        # the decode/prefill programs once)
+        self._lora_names: Dict[Optional[str], int] = {None: 0}
+        self._lora_raw: Dict[str, dict] = {}
+        self._lora_stacks = None
         self.slots = [_Slot(i) for i in range(ec.max_batch_size)]
         self.waiting: List[Request] = []
         # host-side mirrors of the device-side slot state
@@ -183,7 +198,7 @@ class InferenceEngine:
 
         self._decode_fn = jax.jit(
             self._build_decode(), donate_argnums=(1, 2, 3),
-            static_argnums=(13,))
+            static_argnums=(15,))
         self._d_tokens = None          # device-resident slot state
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
@@ -251,10 +266,11 @@ class InferenceEngine:
 
         def step(params, k_pages, v_pages, seen, tokens, positions,
                  page_tables, active, key, temps, top_ps, top_ks,
-                 rep_pens, all_greedy):
+                 rep_pens, lora, lora_idx, all_greedy):
             logits, k_pages, v_pages = decode_step(
                 cfg, params, tokens, positions, k_pages, v_pages,
-                page_tables, active, impl=impl, mesh=mesh)
+                page_tables, active, impl=impl, mesh=mesh,
+                lora=lora, lora_idx=lora_idx)
             if all_greedy:
                 # static fast path: no penalties/seen bookkeeping — the
                 # common greedy batch-inference case stays argmax-only
@@ -275,10 +291,11 @@ class InferenceEngine:
             cfg = self.model_cfg
 
             def run(params, k_pages, v_pages, tokens, true_lens,
-                    page_tables, key, temps, top_ps, top_ks, rep_pens):
+                    page_tables, key, temps, top_ps, top_ks, rep_pens,
+                    lora, lora_idx):
                 logits, k_pages, v_pages = prefill(
                     cfg, params, tokens, true_lens, k_pages, v_pages,
-                    page_tables)
+                    page_tables, lora=lora, lora_idx=lora_idx)
                 # prompt tokens count as "seen" for the penalty (HF
                 # semantics penalize input_ids too); padding masked
                 b, bucket_len = tokens.shape
@@ -304,10 +321,11 @@ class InferenceEngine:
 
             def run(params, k_pages, v_pages, tokens, start_pos,
                     chunk_lens, page_tables, key, temps, top_ps,
-                    top_ks, rep_pens, seen):
+                    top_ks, rep_pens, seen, lora, lora_idx):
                 logits, k_pages, v_pages = prefill_chunk(
                     cfg, params, tokens, start_pos, chunk_lens,
-                    k_pages, v_pages, page_tables, ctx_pages=ctx_pages)
+                    k_pages, v_pages, page_tables, ctx_pages=ctx_pages,
+                    lora=lora, lora_idx=lora_idx)
                 b, bucket_len = tokens.shape
                 valid = jnp.arange(bucket_len)[None, :] < chunk_lens[:, None]
                 seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
@@ -334,7 +352,75 @@ class InferenceEngine:
         return self.max_seq
 
     # -- public API ---------------------------------------------------------
+    def register_lora(self, name: str, adapters: Dict[str, tuple],
+                      scale: float = 1.0) -> None:
+        """Register a LoRA adapter for multi-LoRA serving.
+
+        adapters: {proj: (A, B)} for proj in wq/wk/wv/wo, A shaped
+        (L, in_dim, r) and B (L, r, out_dim) (numpy/jax). Requests
+        select it via Request(lora=name); different slots of one decode
+        batch may run different adapters (per-slot gather + two rank-r
+        einsums). Stacks are padded to max_loras slots, so compiled
+        shapes change only when the FIRST adapter arrives. Validation
+        happens on a COPY — a bad registration leaves prior state
+        untouched. Re-registration refreshes device slot state so
+        in-flight requests keep their adapter."""
+        valid = {"wq", "wk", "wv", "wo"}
+        if not adapters or set(adapters) - valid:
+            raise ValueError(
+                f"adapters must map a subset of {sorted(valid)}")
+        new_raw = dict(self._lora_raw)
+        new_raw[name] = {
+            k: (np.asarray(a, np.float32) * scale,
+                np.asarray(b, np.float32))
+            for k, (a, b) in adapters.items()}
+        if len(new_raw) > self.config.max_loras:
+            raise ValueError(
+                f"at most max_loras={self.config.max_loras} adapters")
+        names = {None: 0}
+        for i, n in enumerate(sorted(new_raw), start=1):
+            names[n] = i
+        # union of projections; missing projections get zero adapters.
+        # Every adapter for one projection must agree on rank/shapes
+        # (they share one stacked array).
+        projs = sorted({p for ad in new_raw.values() for p in ad})
+        stacks = {}
+        n_slots = self.config.max_loras + 1
+        for p in projs:
+            shapes_a = {ad[p][0].shape for ad in new_raw.values()
+                        if p in ad}
+            shapes_b = {ad[p][1].shape for ad in new_raw.values()
+                        if p in ad}
+            if len(shapes_a) > 1 or len(shapes_b) > 1:
+                raise ValueError(
+                    f"adapters disagree on {p} shapes: "
+                    f"{sorted(shapes_a)} / {sorted(shapes_b)}")
+            a_stack = np.zeros((n_slots,) + next(iter(shapes_a)),
+                               np.float32)
+            b_stack = np.zeros((n_slots,) + next(iter(shapes_b)),
+                               np.float32)
+            for nm, idx in names.items():
+                if nm is None or p not in new_raw[nm]:
+                    continue
+                a, b = new_raw[nm][p]
+                a_stack[idx] = a
+                b_stack[idx] = b
+            stacks[p] = {"a": self._dev(jnp.asarray(a_stack)),
+                         "b": self._dev(jnp.asarray(b_stack))}
+        # commit only after everything validated/built
+        self._lora_raw = new_raw
+        self._lora_names = names
+        self._lora_stacks = stacks
+        # indices may have shifted: refresh device slot state so
+        # in-flight requests keep decoding with THEIR adapter
+        self._refresh_device_state()
+
     def add_request(self, request: Request) -> None:
+        if request.lora is not None \
+                and request.lora not in self._lora_names:
+            raise ValueError(
+                f"unknown LoRA adapter {request.lora!r} "
+                f"(registered: {sorted(self._lora_raw)})")
         worst_case = len(request.prompt_tokens) + request.params.max_tokens
         if worst_case > self.max_seq:
             raise ValueError(
@@ -445,11 +531,14 @@ class InferenceEngine:
             bucket = self._bucket_for(n)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :n] = req.prompt_tokens
+            lidx = self._dev(jnp.asarray(
+                [self._lora_names.get(req.lora, 0)], jnp.int32))
             first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
                 self.params, self.k_pages, self.v_pages,
                 self._dev(jnp.asarray(tokens)),
                 self._dev(jnp.asarray([n], jnp.int32)),
-                table, sub, temps, top_ps, top_ks, rep_pens)
+                table, sub, temps, top_ps, top_ks, rep_pens,
+                self._lora_stacks, lidx)
             self._finish_prefill(slot, int(first[0]), touched)
             return
 
@@ -465,6 +554,8 @@ class InferenceEngine:
         if slot.prefill_pos:
             prior[0, np.asarray(
                 req.prompt_tokens[:slot.prefill_pos], np.int64) % V] = True
+        lidx = self._dev(jnp.asarray(
+            [self._lora_names.get(req.lora, 0)], jnp.int32))
         first, self.k_pages, self.v_pages = self._chunk_fn(
             bucket, self._ctx_bucket(slot.prefill_pos))(
             self.params, self.k_pages, self.v_pages,
@@ -472,7 +563,8 @@ class InferenceEngine:
             self._dev(jnp.asarray([slot.prefill_pos], jnp.int32)),
             self._dev(jnp.asarray([chunk], jnp.int32)),
             table, sub, temps, top_ps, top_ks, rep_pens,
-            self._dev(jnp.asarray(prior)))
+            self._dev(jnp.asarray(prior)),
+            self._lora_stacks, lidx)
         slot.prefill_pos += chunk
         if slot.prefill_pos >= n:
             self._finish_prefill(slot, int(first[0]), touched)
@@ -534,6 +626,12 @@ class InferenceEngine:
         self._d_top_ps = self._dev(jnp.asarray(top_ps))
         self._d_top_ks = self._dev(jnp.asarray(top_ks))
         self._d_rep_pens = self._dev(jnp.asarray(rep_pens))
+        lora_idx = np.zeros(B, np.int32)
+        for s2 in self.slots:
+            if s2.request is not None and s2.ready:
+                lora_idx[s2.index] = self._lora_names.get(
+                    s2.request.lora, 0)
+        self._d_lora_idx = self._dev(jnp.asarray(lora_idx))
         self._d_seen = self._dev(jnp.asarray(seen))
         self._d_tables = self._dev(jnp.asarray(self._page_tables))
         self._all_greedy = bool(np.all(temps <= 0.0)
@@ -550,6 +648,7 @@ class InferenceEngine:
                 self._d_tokens, self._d_positions, self._d_tables,
                 self._d_active, sub, self._d_temps, self._d_top_ps,
                 self._d_top_ks, self._d_rep_pens,
+                self._lora_stacks, self._d_lora_idx,
                 self._all_greedy)
         # device-side feedback for the next step
         self._d_tokens = new_tokens

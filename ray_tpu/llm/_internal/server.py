@@ -62,10 +62,11 @@ class LLMServerImpl:
 
     # -- generation ---------------------------------------------------------
     async def _generate(self, prompt_tokens: List[int],
-                        params: SamplingParams) -> Request:
+                        params: SamplingParams,
+                        lora: "str | None" = None) -> Request:
         self._ensure_pump()
         rid = uuid.uuid4().hex[:16]
-        req = Request(rid, prompt_tokens, params)
+        req = Request(rid, prompt_tokens, params, lora=lora)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -81,6 +82,20 @@ class LLMServerImpl:
             if not req.finished:
                 # caller gone (timeout/cancel): stop decoding for nobody
                 self.engine.abort(rid)
+
+    def _lora_for(self, body: Dict[str, Any]) -> "str | None":
+        """LoRA multiplexing the vLLM way: requesting model=<adapter
+        name> routes onto the base model + that adapter. An unknown
+        model name is an ERROR (vLLM returns 404), not a silent
+        base-model fallback."""
+        model = body.get("model")
+        if not model or model == self.model_id:
+            return None
+        if model in getattr(self.engine, "_lora_raw", {}):
+            return model
+        raise ValueError(
+            f"unknown model {model!r} (base: {self.model_id!r}, "
+            f"adapters: {sorted(getattr(self.engine, '_lora_raw', {}))})")
 
     def _sampling(self, body: Dict[str, Any]) -> SamplingParams:
         eos = getattr(self.tokenizer, "eos_id",
@@ -100,7 +115,8 @@ class LLMServerImpl:
         prompt = self.tokenizer.apply_chat_template(
             body.get("messages") or [])
         toks = self.tokenizer.encode(prompt)
-        req = await self._generate(toks, self._sampling(body))
+        req = await self._generate(toks, self._sampling(body),
+                                   lora=self._lora_for(body))
         text = self.tokenizer.decode(req.output_tokens)
         return {
             "id": f"chatcmpl-{req.request_id}",
@@ -121,7 +137,8 @@ class LLMServerImpl:
 
     async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         toks = self.tokenizer.encode(str(body.get("prompt") or ""))
-        req = await self._generate(toks, self._sampling(body))
+        req = await self._generate(toks, self._sampling(body),
+                                   lora=self._lora_for(body))
         return {
             "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
@@ -140,11 +157,12 @@ class LLMServerImpl:
         }
 
     async def _generate_stream(self, prompt_tokens: List[int],
-                               params: SamplingParams):
+                               params: SamplingParams,
+                               lora: "str | None" = None):
         """Yield (token_text, finished, finish_reason) as tokens land."""
         self._ensure_pump()
         rid = uuid.uuid4().hex[:16]
-        req = Request(rid, prompt_tokens, params)
+        req = Request(rid, prompt_tokens, params, lora=lora)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -175,7 +193,7 @@ class LLMServerImpl:
         toks = self.tokenizer.encode(prompt)
         cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         async for delta, finished, reason in self._generate_stream(
-                toks, self._sampling(body)):
+                toks, self._sampling(body), lora=self._lora_for(body)):
             chunk = {
                 "id": cid, "object": "chat.completion.chunk",
                 "created": int(time.time()), "model": self.model_id,
@@ -193,7 +211,7 @@ class LLMServerImpl:
         toks = self.tokenizer.encode(str(body.get("prompt") or ""))
         cid = f"cmpl-{uuid.uuid4().hex[:16]}"
         async for delta, finished, reason in self._generate_stream(
-                toks, self._sampling(body)):
+                toks, self._sampling(body), lora=self._lora_for(body)):
             chunk = {
                 "id": cid, "object": "text_completion",
                 "created": int(time.time()), "model": self.model_id,

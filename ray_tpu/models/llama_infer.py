@@ -14,7 +14,7 @@ Two entry points, both designed to jit once and stay compiled:
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,7 +53,8 @@ def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             true_lens: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
-            page_tables: jax.Array
+            page_tables: jax.Array, lora: Optional[dict] = None,
+            lora_idx: Optional[jax.Array] = None
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """tokens: (B, S) padded prompts; true_lens: (B); page_tables:
     (B, max_pages). Returns (last_logits (B, V) f32, k_pages, v_pages).
@@ -63,27 +64,30 @@ def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     x = params["embed"].astype(dt)[tokens]
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
 
-    def layer_fn(x, layer):
+    def layer_fn(x, inp):
+        layer, lora_l = inp
         y = rms_norm(x, layer["ln1"], cfg.norm_eps)
-        q = (y @ layer["wq"].astype(dt)).reshape(
+        q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
             b, s, cfg.n_heads, cfg.head_dim)
-        k = (y @ layer["wk"].astype(dt)).reshape(
+        k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
             b, s, cfg.n_kv_heads, cfg.head_dim)
-        v = (y @ layer["wv"].astype(dt)).reshape(
+        v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
             b, s, cfg.n_kv_heads, cfg.head_dim)
         q = _rope_seq(q, cos, sin)
         k = _rope_seq(k, cos, sin)
         impl = "xla" if cfg.attention_impl in ("auto", "ring") \
             else cfg.attention_impl
         attn = attention_op(q, k, v, causal=True, impl=impl)
-        x = x + attn.reshape(b, s, cfg.q_dim) @ layer["wo"].astype(dt)
+        x = x + _proj(attn.reshape(b, s, cfg.q_dim), layer["wo"],
+                      lora_l, "wo", lora_idx, dt)
         y = rms_norm(x, layer["ln2"], cfg.norm_eps)
         gate = jax.nn.silu(y @ layer["wg"].astype(dt))
         up = y @ layer["wi"].astype(dt)
         x = x + (gate * up) @ layer["wd"].astype(dt)
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], lora_scan_xs(lora)))
     # ks/vs: (L, B, S, KVH, D) -> token-major (B*S, L, KVH, D)
     k_rows = jnp.transpose(ks, (1, 2, 0, 3, 4)).reshape(
         b * s, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
@@ -106,7 +110,8 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
                   tokens: jax.Array, start_pos: jax.Array,
                   chunk_lens: jax.Array, k_pages: jax.Array,
                   v_pages: jax.Array, page_tables: jax.Array,
-                  ctx_pages: int = -1
+                  ctx_pages: int = -1, lora: Optional[dict] = None,
+                  lora_idx: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill a CHUNK of each prompt against already-cached context.
 
@@ -150,19 +155,20 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
     k_ctx_all, v_ctx_all = gather_kv(k_pages, v_pages, ctx_tables)
 
     def layer_fn(x, inp):
-        layer, k_ctx, v_ctx = inp
+        layer, k_ctx, v_ctx, lora_l = inp
         y = rms_norm(x, layer["ln1"], cfg.norm_eps)
-        q = (y @ layer["wq"].astype(dt)).reshape(
+        q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
             b, c, cfg.n_heads, cfg.head_dim)
-        k = (y @ layer["wk"].astype(dt)).reshape(
+        k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
             b, c, cfg.n_kv_heads, cfg.head_dim)
-        v = (y @ layer["wv"].astype(dt)).reshape(
+        v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
             b, c, cfg.n_kv_heads, cfg.head_dim)
         q = rope(q)
         k = rope(k)
         attn = chunk_attention_on_gathered(
             q, k_ctx, v_ctx, k, v, start_pos, chunk_lens)
-        x = x + attn.reshape(b, c, cfg.q_dim) @ layer["wo"].astype(dt)
+        x = x + _proj(attn.reshape(b, c, cfg.q_dim), layer["wo"],
+                      lora_l, "wo", lora_idx, dt)
         y = rms_norm(x, layer["ln2"], cfg.norm_eps)
         gate = jax.nn.silu(y @ layer["wg"].astype(dt))
         up = y @ layer["wi"].astype(dt)
@@ -170,7 +176,8 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_ctx_all, v_ctx_all))
+        layer_fn, x,
+        (params["layers"], k_ctx_all, v_ctx_all, lora_scan_xs(lora)))
     # ks/vs: (L, B, C, KVH, D) -> token-major (B*C, L, KVH, D)
     k_rows = jnp.transpose(ks, (1, 2, 0, 3, 4)).reshape(
         b * c, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
@@ -190,13 +197,56 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
     return logits, k_pages, v_pages
 
 
+# ---------------------------------------------------------------------- lora
+
+def lora_delta(y, stack, idx):
+    """Per-slot low-rank delta for one projection at one layer.
+
+    y: (B, H) or (B, S, H) activations; stack: (A, Ha, r) down / up pair
+    packed as {"a": (Adapters, H, r), "b": (Adapters, r, O)} already
+    sliced to this layer; idx: (B,) adapter index per slot (0 = the
+    zero adapter -> exact no-op). Multi-LoRA batching the vLLM way:
+    gather each slot's adapter then two tiny einsums.
+    """
+    a = stack["a"][idx]          # (B, H, r)
+    b = stack["b"][idx]          # (B, r, O)
+    if y.ndim == 2:
+        mid = jnp.einsum("bh,bhr->br", y, a)
+        return jnp.einsum("br,bro->bo", mid, b)
+    mid = jnp.einsum("bsh,bhr->bsr", y, a)
+    return jnp.einsum("bsr,bro->bso", mid, b)
+
+
+def _proj(y, w, lora_layer, key, idx, dt):
+    """y @ w (+ the slot's LoRA delta for projection `key`, if any).
+
+    lora_layer: THIS layer's slice of the adapter stacks (rides the
+    layer scan as xs): {key: {"a": (A, H, r), "b": (A, r, O)}}."""
+    out = y @ w.astype(dt)
+    if lora_layer is not None and key in lora_layer:
+        stack = {"a": lora_layer[key]["a"].astype(dt),
+                 "b": lora_layer[key]["b"].astype(dt)}
+        out = out + lora_delta(y, stack, idx).astype(out.dtype)
+    return out
+
+
+def lora_scan_xs(lora: Optional[dict]):
+    """Adapter stacks {"wq": {"a": (A, L, H, r), ...}} -> per-layer xs
+    with the layer dim leading (what lax.scan slices), or None."""
+    if not lora:
+        return None
+    return jax.tree.map(lambda t: jnp.swapaxes(t, 0, 1), lora)
+
+
 # -------------------------------------------------------------------- decode
 
 def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 tokens: jax.Array, positions: jax.Array,
                 k_pages: jax.Array, v_pages: jax.Array,
                 page_tables: jax.Array, active: jax.Array,
-                impl: str = "gather", mesh=None
+                impl: str = "gather", mesh=None,
+                lora: Optional[dict] = None,
+                lora_idx: Optional[jax.Array] = None
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole running batch.
 
@@ -235,13 +285,13 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
         k_by_layer, v_by_layer = gather_kv(k_pages, v_pages, page_tables)
 
     def layer_fn(x, inp):
-        layer, k_l, v_l = inp
+        layer, k_l, v_l, lora_l = inp
         y = rms_norm(x, layer["ln1"], cfg.norm_eps)
-        q = (y @ layer["wq"].astype(dt)).reshape(
+        q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
             b, cfg.n_heads, cfg.head_dim)
-        k = (y @ layer["wk"].astype(dt)).reshape(
+        k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
             b, cfg.n_kv_heads, cfg.head_dim)
-        v = (y @ layer["wv"].astype(dt)).reshape(
+        v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
             b, cfg.n_kv_heads, cfg.head_dim)
         q = _rope_single(q, cos, sin)
         k = _rope_single(k, cos, sin)
@@ -273,7 +323,8 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
             v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
             attn = paged_attention_on_gathered(
                 q, k_full, v_full, positions, append_len=1)
-        x = x + attn.reshape(b, cfg.q_dim) @ layer["wo"].astype(dt)
+        x = x + _proj(attn.reshape(b, cfg.q_dim), layer["wo"],
+                      lora_l, "wo", lora_idx, dt)
         y = rms_norm(x, layer["ln2"], cfg.norm_eps)
         gate = jax.nn.silu(y @ layer["wg"].astype(dt))
         up = y @ layer["wi"].astype(dt)
@@ -281,7 +332,8 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_by_layer, v_by_layer))
+        layer_fn, x,
+        (params["layers"], k_by_layer, v_by_layer, lora_scan_xs(lora)))
     k_rows = jnp.transpose(ks, (1, 0, 2, 3))        # (B, L, KVH, D)
     v_rows = jnp.transpose(vs, (1, 0, 2, 3))
     k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,

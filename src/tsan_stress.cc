@@ -69,7 +69,9 @@ void arena_worker(void* h, int tid) {
     int64_t off = arena_alloc(h, id, size);
     if (off >= 0) {
       memset(base + off, 0x40 + tid, size);
-      assert(arena_seal(h, id) == 0);
+      int seal_rc = arena_seal(h, id);
+      assert(seal_rc == 0);
+      (void)seal_rc;
       uint64_t got_off = 0, got_size = 0;
       if (arena_get(h, id, &got_off, &got_size) == 0) {
         assert(got_size == size);
@@ -127,7 +129,7 @@ int run_arena() {
 
 void chan_reader(const char* name, int slot, int expect) {
   void* h = chan_attach(name);
-  assert(h);
+  if (!h) { fprintf(stderr, "chan_attach failed\n"); abort(); }
   uint64_t version = 0;
   std::string buf(1 << 16, '\0');
   int got = 0;
