@@ -3,10 +3,12 @@ python/ray/autoscaler/v2 — instance_manager/reconciler.py:53 Reconciler,
 _private/gcp/tpu_command_runner.py for the TPU provider story)."""
 
 from .provider import (FakeMultiNodeProvider, GcpTpuNodeProvider,
+                       ProcessNodeProvider,
                        NodeProvider, NodeType)
 from .reconciler import Autoscaler, AutoscalerConfig, request_resources
 
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "NodeProvider", "NodeType",
-    "FakeMultiNodeProvider", "GcpTpuNodeProvider", "request_resources",
+    "FakeMultiNodeProvider", "GcpTpuNodeProvider", "ProcessNodeProvider",
+    "request_resources",
 ]

@@ -49,6 +49,51 @@ class NodeProvider:
         raise NotImplementedError
 
 
+class ProcessNodeProvider(NodeProvider):
+    """Nodes as real OS processes via the CLI join path
+    (cluster_utils.Cluster -> `ray_tpu start --address`): scale-up
+    actually execs what a GKE pod or TPU-VM startup script runs, so the
+    autoscaler's multi-host slice join story is exercised end to end —
+    gang demand on a TPU-...-head marker becomes a separate daemon
+    process registering over the wire."""
+
+    def __init__(self):
+        self._cluster = None
+        self._nodes: Dict[str, NodeType] = {}
+        self._lock = threading.Lock()
+
+    def _ensure_cluster(self):
+        if self._cluster is None:
+            from ..cluster_utils import Cluster
+            self._cluster = Cluster()
+        return self._cluster
+
+    def create_node(self, node_type: NodeType) -> str:
+        cluster = self._ensure_cluster()
+        res = dict(node_type.resources)
+        cpus = res.pop("CPU", 1.0)
+        node_id = cluster.add_node(num_cpus=cpus, resources=res,
+                                   labels=dict(node_type.labels))
+        with self._lock:
+            self._nodes[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: str) -> bool:
+        try:
+            self._cluster.remove_node(node_id)
+        except KeyError:
+            pass           # process already gone (idempotent retry)
+        except Exception:
+            return False   # keep the node listed: the reconciler retries
+        with self._lock:
+            self._nodes.pop(node_id, None)
+        return True
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+
 class FakeMultiNodeProvider(NodeProvider):
     """In-process provider for tests: each node is a real NodeDaemon with
     real worker subprocesses (the add_fake_node machinery)."""

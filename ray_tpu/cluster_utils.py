@@ -105,7 +105,9 @@ class Cluster:
         """Kill a node's daemon process. graceful=False (default) is the
         chaos path: SIGKILL the whole process group, exactly like a node
         crash — the controller must detect it via health probes."""
-        proc = self._procs.pop(node_id)
+        proc = self._procs.pop(node_id, None)
+        if proc is None:
+            return     # already removed (idempotent)
         sig = signal.SIGTERM if graceful else signal.SIGKILL
         try:
             os.killpg(proc.pid, sig)
@@ -140,7 +142,9 @@ class Cluster:
     # ------------------------------------------------------------ teardown
     def shutdown(self) -> None:
         for node_id in list(self._procs):
-            proc = self._procs.pop(node_id)
+            proc = self._procs.pop(node_id, None)
+            if proc is None:
+                continue
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except ProcessLookupError:

@@ -360,11 +360,13 @@ class InferenceEngine:
         (L, in_dim, r) and B (L, r, out_dim) (numpy/jax). Requests
         select it via Request(lora=name); different slots of one decode
         batch may run different adapters (per-slot gather + two rank-r
-        einsums). Stacks are padded to max_loras slots, so compiled
-        shapes change only when the FIRST adapter arrives. Validation
-        happens on a COPY — a bad registration leaves prior state
-        untouched. Re-registration refreshes device slot state so
-        in-flight requests keep their adapter."""
+        einsums). Stacks are padded to max_loras slots AND to all four
+        projections, stored layer-major in compute dtype — compiled
+        shapes change only when the FIRST adapter arrives, or when a
+        later registration changes a projection's rank (documented
+        retrace). Validation happens on a COPY — a bad registration
+        leaves prior state untouched. Re-registration refreshes device
+        slot state so in-flight requests keep their adapter."""
         valid = {"wq", "wk", "wv", "wo"}
         if not adapters or set(adapters) - valid:
             raise ValueError(
@@ -380,13 +382,22 @@ class InferenceEngine:
         names = {None: 0}
         for i, n in enumerate(sorted(new_raw), start=1):
             names[n] = i
-        # union of projections; missing projections get zero adapters.
-        # Every adapter for one projection must agree on rank/shapes
-        # (they share one stacked array).
-        projs = sorted({p for ad in new_raw.values() for p in ad})
+        # ALL FOUR projections get stacks (zero rank-1 stubs where no
+        # adapter uses one) so a later registration introducing a new
+        # projection doesn't change the pytree structure. Every adapter
+        # for one projection must agree on rank/shapes (they share one
+        # stacked array). Stacks are stored LAYER-MAJOR (L, A, ...) in
+        # compute dtype: the layer scan slices them directly — no
+        # relayout or cast inside the per-token decode step.
+        cfg = self.model_cfg
+        out_dims = {"wq": cfg.q_dim, "wk": cfg.kv_dim,
+                    "wv": cfg.kv_dim, "wo": None}
+        in_dims = {"wq": cfg.hidden, "wk": cfg.hidden,
+                   "wv": cfg.hidden, "wo": cfg.q_dim}
         stacks = {}
         n_slots = self.config.max_loras + 1
-        for p in projs:
+        dt = cfg.dtype
+        for p in ("wq", "wk", "wv", "wo"):
             shapes_a = {ad[p][0].shape for ad in new_raw.values()
                         if p in ad}
             shapes_b = {ad[p][1].shape for ad in new_raw.values()
@@ -395,18 +406,25 @@ class InferenceEngine:
                 raise ValueError(
                     f"adapters disagree on {p} shapes: "
                     f"{sorted(shapes_a)} / {sorted(shapes_b)}")
-            a_stack = np.zeros((n_slots,) + next(iter(shapes_a)),
-                               np.float32)
-            b_stack = np.zeros((n_slots,) + next(iter(shapes_b)),
-                               np.float32)
+            if shapes_a:
+                sa, sb = next(iter(shapes_a)), next(iter(shapes_b))
+            else:
+                out = out_dims[p] or cfg.hidden
+                sa = (cfg.n_layers, in_dims[p], 1)
+                sb = (cfg.n_layers, 1, out)
+            a_stack = np.zeros((n_slots,) + sa, np.float32)
+            b_stack = np.zeros((n_slots,) + sb, np.float32)
             for nm, idx in names.items():
                 if nm is None or p not in new_raw[nm]:
                     continue
                 a, b = new_raw[nm][p]
                 a_stack[idx] = a
                 b_stack[idx] = b
-            stacks[p] = {"a": self._dev(jnp.asarray(a_stack)),
-                         "b": self._dev(jnp.asarray(b_stack))}
+            stacks[p] = {
+                "a": self._dev(jnp.asarray(
+                    np.swapaxes(a_stack, 0, 1), dt)),
+                "b": self._dev(jnp.asarray(
+                    np.swapaxes(b_stack, 0, 1), dt))}
         # commit only after everything validated/built
         self._lora_raw = new_raw
         self._lora_names = names

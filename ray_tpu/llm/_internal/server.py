@@ -34,6 +34,12 @@ class LLMServerImpl:
         self.tokenizer = load_tokenizer(
             self._config.get("tokenizer_source"),
             vocab_size=self.engine.model_cfg.vocab_size)
+        # LoRA adapters declared in the config load at construction
+        # (reference parity: serve LLM LoRA multiplex config); more can
+        # be added live via the register_lora deployment method
+        for name, adapters in (self._config.get("lora_adapters")
+                               or {}).items():
+            self.engine.register_lora(name, adapters)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pump: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None
@@ -226,7 +232,14 @@ class LLMServerImpl:
     async def model_info(self) -> Dict[str, Any]:
         return {"id": self.model_id, "object": "model",
                 "owned_by": "ray_tpu",
+                "adapters": sorted(self.engine._lora_raw),
                 "engine": self.engine.stats()}
+
+    async def register_lora(self, name: str,
+                            adapters: Dict[str, Any]) -> list:
+        """Live adapter registration through the deployment handle."""
+        self.engine.register_lora(name, adapters)
+        return sorted(self.engine._lora_raw)
 
     async def check_health(self) -> None:
         return None
@@ -245,6 +258,10 @@ class LLMRouterImpl:
             for h in self._handles:
                 info = await h.model_info.remote()
                 self._servers[info["id"]] = h
+                # adapter names route to their base model's server
+                # (vLLM convention: model=<adapter> selects base+LoRA)
+                for adapter in info.get("adapters") or []:
+                    self._servers.setdefault(adapter, h)
             self._resolved = True
 
     def _pick(self, body: Dict[str, Any]):
@@ -271,6 +288,12 @@ class LLMRouterImpl:
             return Response({"error": "invalid JSON body"}, status=400,
                             content_type="application/json")
         server = self._pick(body)
+        if server is None:
+            # a LoRA adapter may have been registered after the first
+            # resolve: refresh the model map once before 404ing
+            self._resolved = False
+            await self._resolve()
+            server = self._pick(body)
         if server is None:
             return Response(
                 {"error": f"model {body.get('model')!r} not found"},

@@ -221,21 +221,19 @@ def _proj(y, w, lora_layer, key, idx, dt):
     """y @ w (+ the slot's LoRA delta for projection `key`, if any).
 
     lora_layer: THIS layer's slice of the adapter stacks (rides the
-    layer scan as xs): {key: {"a": (A, H, r), "b": (A, r, O)}}."""
+    layer scan as xs): {key: {"a": (A, H, r), "b": (A, r, O)}},
+    already in compute dtype."""
     out = y @ w.astype(dt)
     if lora_layer is not None and key in lora_layer:
-        stack = {"a": lora_layer[key]["a"].astype(dt),
-                 "b": lora_layer[key]["b"].astype(dt)}
-        out = out + lora_delta(y, stack, idx).astype(out.dtype)
+        out = out + lora_delta(y, lora_layer[key], idx).astype(out.dtype)
     return out
 
 
 def lora_scan_xs(lora: Optional[dict]):
-    """Adapter stacks {"wq": {"a": (A, L, H, r), ...}} -> per-layer xs
-    with the layer dim leading (what lax.scan slices), or None."""
-    if not lora:
-        return None
-    return jax.tree.map(lambda t: jnp.swapaxes(t, 0, 1), lora)
+    """Adapter stacks are stored LAYER-MAJOR ((L, A, ...)) in compute
+    dtype at registration — they ride the layer scan as xs directly
+    (the old form relayouted + cast inside every compiled step)."""
+    return lora if lora else None
 
 
 # -------------------------------------------------------------------- decode
