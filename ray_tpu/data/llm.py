@@ -36,6 +36,10 @@ class LLMEngineProcessorConfig:
     batch_size: int = 16
     concurrency: int = 1
     num_tpus: Optional[float] = None     # per engine replica
+    # {adapter_name: {proj: (A, B)}} registered on every engine replica;
+    # rows may select one via a "lora" column (multi-LoRA batch
+    # inference over the base model)
+    lora_adapters: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 class _LLMBatchPredictor:
@@ -53,6 +57,8 @@ class _LLMBatchPredictor:
         kwargs = dict(config.engine_kwargs)
         kwargs.setdefault("max_batch_size", min(config.batch_size, 16))
         self.engine = InferenceEngine(EngineConfig(model=model, **kwargs))
+        if config.lora_adapters:
+            self.engine.register_loras(dict(config.lora_adapters))
         self.tokenizer = load_tokenizer(config.tokenizer_source,
                                         vocab_size=model.vocab_size)
         self.params = SamplingParams(**config.sampling_params)
@@ -67,7 +73,18 @@ class _LLMBatchPredictor:
             raise ValueError(
                 "LLM processor batches need a 'prompt' (text) or "
                 "'prompt_tokens' column")
-        reqs = self.engine.generate(prompts, self.params)
+        def _lora_of(x):
+            # None / "" / NaN -> base model; anything else by value
+            if x is None:
+                return None
+            if isinstance(x, float) and x != x:
+                return None
+            s = str(x)
+            return s if s else None
+
+        loras = ([_lora_of(x) for x in batch["lora"]]
+                 if "lora" in batch else None)
+        reqs = self.engine.generate(prompts, self.params, loras=loras)
         batch = dict(batch)
         batch["generated_tokens"] = [list(r.output_tokens) for r in reqs]
         batch["generated_text"] = [

@@ -367,15 +367,23 @@ class InferenceEngine:
         retrace). Validation happens on a COPY — a bad registration
         leaves prior state untouched. Re-registration refreshes device
         slot state so in-flight requests keep their adapter."""
+        self.register_loras({name: adapters}, scale=scale)
+
+    def register_loras(self, mapping: Dict[str, Dict[str, tuple]],
+                       scale: float = 1.0) -> None:
+        """Bulk form: stage every adapter, build + upload the padded
+        stacks ONCE (k adapters via the per-name API would rebuild and
+        transfer k times)."""
         valid = {"wq", "wk", "wv", "wo"}
-        if not adapters or set(adapters) - valid:
-            raise ValueError(
-                f"adapters must map a subset of {sorted(valid)}")
         new_raw = dict(self._lora_raw)
-        new_raw[name] = {
-            k: (np.asarray(a, np.float32) * scale,
-                np.asarray(b, np.float32))
-            for k, (a, b) in adapters.items()}
+        for name, adapters in mapping.items():
+            if not adapters or set(adapters) - valid:
+                raise ValueError(
+                    f"adapters must map a subset of {sorted(valid)}")
+            new_raw[name] = {
+                k: (np.asarray(a, np.float32) * scale,
+                    np.asarray(b, np.float32))
+                for k, (a, b) in adapters.items()}
         if len(new_raw) > self.config.max_loras:
             raise ValueError(
                 f"at most max_loras={self.config.max_loras} adapters")
@@ -473,10 +481,25 @@ class InferenceEngine:
         return touched
 
     def generate(self, prompts: List[List[int]],
-                 params: Optional[SamplingParams] = None) -> List[Request]:
-        """Synchronous batch completion (the ray_tpu.data.llm path)."""
+                 params: Optional[SamplingParams] = None,
+                 loras: Optional[List[Optional[str]]] = None
+                 ) -> List[Request]:
+        """Synchronous batch completion (the ray_tpu.data.llm path).
+        loras: optional per-prompt adapter names (multi-LoRA batches)."""
         params = params or SamplingParams()
-        reqs = [Request(f"gen-{i}-{id(prompts)}", list(p), params)
+        loras = loras or [None] * len(prompts)
+        if len(loras) != len(prompts):
+            raise ValueError("loras must match prompts in length")
+        unknown = {l for l in loras
+                   if l is not None and l not in self._lora_names}
+        if unknown:
+            # validate BEFORE queueing anything: a bad name mid-batch
+            # must not strand earlier requests in the waiting queue
+            raise ValueError(
+                f"unknown LoRA adapter(s) {sorted(unknown)} "
+                f"(registered: {sorted(self._lora_raw)})")
+        reqs = [Request(f"gen-{i}-{id(prompts)}", list(p), params,
+                        lora=loras[i])
                 for i, p in enumerate(prompts)]
         for r in reqs:
             self.add_request(r)

@@ -37,9 +37,9 @@ class LLMServerImpl:
         # LoRA adapters declared in the config load at construction
         # (reference parity: serve LLM LoRA multiplex config); more can
         # be added live via the register_lora deployment method
-        for name, adapters in (self._config.get("lora_adapters")
-                               or {}).items():
-            self.engine.register_lora(name, adapters)
+        if self._config.get("lora_adapters"):
+            self.engine.register_loras(
+                dict(self._config["lora_adapters"]))
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pump: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None

@@ -351,3 +351,33 @@ def test_multi_lora_batched_adapters():
 
     with pytest.raises(ValueError, match="unknown LoRA"):
         eng.add_request(Request("bad", [1, 2], sp, lora="nope"))
+
+
+def test_data_llm_batch_lora_column(ray_start):
+    """data.llm batch inference: rows pick adapters via a 'lora'
+    column, registered from the processor config."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu import data
+    from ray_tpu.data.llm import LLMEngineProcessorConfig, \
+        build_llm_processor
+    from ray_tpu.models import llama
+
+    cfg = llama.config("debug", dtype=jnp.float32)
+    L, h, q, r = cfg.n_layers, cfg.hidden, cfg.q_dim, 4
+    rng = np.random.default_rng(0)
+    proc = build_llm_processor(LLMEngineProcessorConfig(
+        model_source=cfg,
+        engine_kwargs={"num_pages": 64, "seed": 2},
+        sampling_params={"max_tokens": 4},
+        lora_adapters={"styleA": {
+            "wq": (rng.normal(0, 0.5, (L, h, r)),
+                   rng.normal(0, 0.5, (L, r, q)))}},
+        batch_size=4))
+    ds = data.from_items([
+        {"prompt": "hello", "lora": ""},
+        {"prompt": "hello", "lora": "styleA"},
+    ])
+    rows = proc(ds).take_all()
+    assert len(rows) == 2
+    assert rows[0]["generated_tokens"] != rows[1]["generated_tokens"]
