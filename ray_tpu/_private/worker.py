@@ -35,6 +35,7 @@ class Runtime:
         self.loop_runner = loop_runner
         self.session_name = session_name
         self.extra_daemons: List[NodeDaemon] = []
+        self.usage_reporter = None   # UsageReporter when stats enabled
 
 
 def init(address: Optional[str] = None,
@@ -155,6 +156,10 @@ def init(address: Optional[str] = None,
         _attach_log_stream(client)
     _runtime = Runtime(client, controller, head_daemon, loop_runner,
                        session_name)
+    from . import usage as _usage
+    if _usage.usage_stats_enabled():
+        _runtime.usage_reporter = _usage.UsageReporter(client, session_name)
+        _runtime.usage_reporter.start()
     if _prestart_workers:
         loop_runner.run_sync(
             client.pool.get(head_daemon.address).call(
@@ -187,6 +192,9 @@ def shutdown() -> None:
         return
     rt, _runtime = _runtime, None
     state.set_client(None)
+    if rt.usage_reporter is not None:
+        rt.usage_reporter.stop()           # join first: no tmp-file race
+        rt.usage_reporter.report_once()    # final snapshot
     if rt.loop_runner is None:   # local mode
         return
     rt.client.is_shutdown = True

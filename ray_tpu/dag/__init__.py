@@ -5,6 +5,11 @@ experimental_compile wires shared-memory channels between the actors,
 execute() streams through them without per-call task submission.
 """
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("dag")
+del _rlu
+
+
 from .compiled_dag import CompiledDAG, CompiledDAGRef, DagExecutionError
 from .dag_node import (ClassMethodNode, DAGNode, InputNode,
                        MultiOutputNode)

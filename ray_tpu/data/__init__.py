@@ -7,6 +7,11 @@ batches hand off to jax.Arrays placed on mesh shardings with
 prefetch (`Dataset.iter_jax_batches`).
 """
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("data")
+del _rlu
+
+
 from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
 from .block import Block, BlockAccessor
 from .dataset import Dataset, GroupedData, MaterializedDataset

@@ -8,6 +8,10 @@ the external vLLM engine replaced by the in-repo TPU engine
 
 from __future__ import annotations
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("llm")
+del _rlu
+
 import dataclasses
 from typing import Any, Dict, List, Optional
 

@@ -5,6 +5,11 @@ RLModule + Learner, rllib/algorithms/algorithm.py:198). Rollouts are
 compiled: pure-JAX envs scanned with the policy in one XLA program.
 """
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu
+
+
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.appo import APPO, APPOConfig
 from .algorithms.cql import CQL, CQLConfig

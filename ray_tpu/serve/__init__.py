@@ -6,6 +6,11 @@ Reference parity: python/ray/serve — controller-reconciled deployments
 model multiplexing, request-driven autoscaling.
 """
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("serve")
+del _rlu
+
+
 from .api import (Application, Deployment, delete, deploy_config,
                   deployment, start_grpc,
                   get_app_handle, get_deployment_handle, run, shutdown,

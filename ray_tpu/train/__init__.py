@@ -1,3 +1,7 @@
+from .._private.usage import record_library_usage as _rlu
+_rlu("train")
+del _rlu
+
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import (CheckpointConfig, FailureConfig, RunConfig,
                      ScalingConfig)

@@ -7,6 +7,11 @@ TPU-first: trials are gang-schedulable (a trial's trainable can itself be
 a JaxTrainer spanning a pod slice via placement groups).
 """
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("tune")
+del _rlu
+
+
 from .search.sample import (uniform, quniform, loguniform, qloguniform,
                             randint, qrandint, lograndint, choice,
                             sample_from, grid_search)

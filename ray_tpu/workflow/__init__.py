@@ -37,6 +37,10 @@ mock:// (tests).
 
 from __future__ import annotations
 
+from .._private.usage import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu
+
 import json
 import os
 import posixpath
