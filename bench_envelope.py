@@ -177,6 +177,57 @@ def bench_nn_multidaemon(n_nodes: int, n_callers: int, n_callees: int,
             "total_s": round(dt, 1)}
 
 
+def bench_lease_grant(n: int) -> dict:
+    """Per-grant latency: daemon-LOCAL lease grants (distributed
+    dispatch, no controller round-trip) vs controller grants — the
+    control-plane hop the local path removes."""
+    import ray_tpu
+    from ray_tpu._private.config import get_config
+    get_config().local_lease_enabled = "1"   # default auto = off on-box
+    import ray_tpu._private.worker as worker_mod
+    rt = worker_mod._runtime
+    daemon = rt.head_daemon
+    loop = rt.loop_runner
+    from ray_tpu._private.state import current_client
+    client = current_client()
+
+    async def grants_local() -> float:
+        # same wire cost as production: client -> daemon over a socket
+        d = client.pool.get(tuple(daemon.address))
+        # warm the worker pool + delegation block
+        r = await d.call("lease_worker_local", resources={"CPU": 1.0},
+                         owner_addr=list(client.address))
+        await d.call("release_lease_local", lease_id=r["lease_id"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = await d.call("lease_worker_local",
+                             resources={"CPU": 1.0},
+                             owner_addr=list(client.address))
+            assert r["status"] == "ok", r
+            await d.call("release_lease_local", lease_id=r["lease_id"])
+        return time.perf_counter() - t0
+
+    async def grants_controller() -> float:
+        ctrl = client.pool.get(client.controller_addr)
+        r = await ctrl.call("lease_worker", resources={"CPU": 1.0},
+                            owner_addr=list(client.address))
+        await ctrl.call("release_lease", lease_id=r["lease_id"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = await ctrl.call("lease_worker", resources={"CPU": 1.0},
+                                owner_addr=list(client.address))
+            assert r["status"] == "ok", r
+            await ctrl.call("release_lease", lease_id=r["lease_id"])
+        return time.perf_counter() - t0
+
+    t_local = loop.run_sync(grants_local(), timeout=600)
+    t_ctrl = loop.run_sync(grants_controller(), timeout=600)
+    return {"row": "lease_grant", "n": n,
+            "local_us_per_grant": round(t_local / n * 1e6, 1),
+            "controller_us_per_grant": round(t_ctrl / n * 1e6, 1),
+            "local_speedup": round(t_ctrl / max(t_local, 1e-9), 2)}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -204,6 +255,9 @@ def main() -> None:
             print(json.dumps(rows[-1]), flush=True)
         if "nn_multi" in wanted:
             rows.append(bench_nn_multidaemon(4, 8, 8, 500 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "lease_grant" in wanted:
+            rows.append(bench_lease_grant(2_000 // scale))
             print(json.dumps(rows[-1]), flush=True)
     finally:
         ray_tpu.shutdown()

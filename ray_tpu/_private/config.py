@@ -73,6 +73,20 @@ class RayTpuConfig:
     container_run_prefix: Optional[str] = _f(
         "RAY_TPU_CONTAINER_RUN_PREFIX", None, str)
 
+    # -- distributed dispatch (daemon-local lease granting) --------------
+    #: clients lease plain-CPU workers from their LOCAL daemon, which
+    #: grants from a controller-delegated resource block (reference
+    #: parity: raylet-local dispatch). "auto" enables it only when the
+    #: controller lives on a DIFFERENT host than the daemon — the
+    #: optimization removes a cross-host round-trip, and measurably
+    #: LOSES on loopback (delegation churn, no hop saved: see
+    #: BENCH_CORE round-4 A/B). "1"/"0" force it on/off.
+    local_lease_enabled: str = _f("RAY_TPU_LOCAL_LEASE", "auto", str)
+    #: slots per delegation request (block growth quantum)
+    lease_block_size: int = _f("RAY_TPU_LEASE_BLOCK", 4)
+    #: idle seconds before unused delegated slots return to the controller
+    lease_block_idle_s: float = _f("RAY_TPU_LEASE_BLOCK_IDLE_S", 10.0)
+
     # -- function store --------------------------------------------------
     #: code blobs larger than this are exported once to the controller KV
     #: and referenced by content hash in task specs (function manager

@@ -149,6 +149,13 @@ class Controller:
         # worker leases: lease_id -> {node_id, req, worker_id,
         #                             owner_addr, granted_at}
         self.leases: Dict[str, dict] = {}
+        # Resource blocks delegated to daemons for LOCAL lease granting
+        # (distributed dispatch — reference parity: the raylet owns its
+        # local dispatch, cluster_task_manager.h:45; here the controller
+        # pre-acquires a block so daemon grants never double-book
+        # against the scheduled path): (node_id, req_key) -> slot count.
+        self.delegations: Dict[tuple, int] = {}
+        self._reclaim_timer_armed = False   # re-pump while work pends
         self.subscribers: Dict[str, List[Tuple[str, int]]] = {}
         self.pending: List[dict] = []          # specs waiting for resources
         self._spread_cursor = 0                # SPREAD round-robin state
@@ -436,6 +443,10 @@ class Controller:
         for lease_id, lease in list(self.leases.items()):
             if lease["node_id"] == node_id:
                 del self.leases[lease_id]
+        # delegated lease blocks die with the node (their slots were
+        # acquired on the now-gone NodeEntry; nothing to release)
+        for key in [k for k in self.delegations if k[0] == node_id]:
+            del self.delegations[key]
         # Placement groups with a bundle on the dead node become FAILED:
         # their gang guarantee is broken. Reservations on surviving nodes
         # are returned.
@@ -702,6 +713,41 @@ class Controller:
                 still_pending.append(spec)
         self.pending = still_pending
 
+        if (still_pending or still_pg) and self.delegations:
+            # Scheduled work is waiting while daemons sit on delegated
+            # lease blocks: command them to return free slots now
+            # (spill-back pressure — the reference raylet spills queued
+            # work instead; here capacity flows back to the global
+            # scheduler). A single command only frees what is idle at
+            # that instant — slots still backing live leases free up
+            # later — so keep re-pumping (and re-commanding) on a short
+            # timer until either the work places or the delegations are
+            # gone.
+            for node_id in {k[0] for k in self.delegations}:
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    continue
+                # only command nodes whose daemons report FREE block
+                # slots (gossiped stat, ~0.5s stale; the re-pump timer
+                # re-checks): reclaiming from a daemon whose slots all
+                # back live leases frees nothing and just churns its
+                # delegate/return cycle
+                stats = (node.view or {}).get("stats", {})
+                if stats.get("lease_block_free", 1) <= 0:
+                    continue
+                if not any(c.get("type") == "reclaim_lease_blocks"
+                           for c in node.commands):
+                    self._queue_command(
+                        node, {"type": "reclaim_lease_blocks"})
+            if not self._reclaim_timer_armed:
+                self._reclaim_timer_armed = True
+
+                def _rearm() -> None:
+                    self._reclaim_timer_armed = False
+                    self._sched_event.set()
+
+                asyncio.get_running_loop().call_later(1.0, _rearm)
+
     async def _try_place(self, spec: dict) -> Optional[str]:
         req = dict(spec.get("resources") or {})
         strategy = spec.get("scheduling") or {}
@@ -880,6 +926,48 @@ class Controller:
                 "worker_id": reply["worker_id"],
                 "daemon_addr": list(node.addr),
                 "node_id": node.node_id}
+
+    @staticmethod
+    def _delegation_key(node_id: str, req: Dict[str, float]) -> tuple:
+        return (node_id, tuple(sorted(req.items())))
+
+    async def rpc_delegate_resources(self, node_id: str, resources: dict,
+                                     count: int) -> dict:
+        """Grant a daemon a block of resource slots for LOCAL lease
+        dispatch (distributed dispatch — reference parity: the raylet's
+        LocalTaskManager dispatches with no GCS round-trip,
+        local_task_manager.h:102; our daemon holds a pre-acquired block
+        instead, so the controller's scheduled path and the daemon's
+        local grants can never double-book one node)."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive or node.draining:
+            return {"granted": 0}
+        req = dict(resources or {})
+        granted = 0
+        while granted < count and node.fits(req):
+            node.acquire(req)
+            granted += 1
+        if granted:
+            key = self._delegation_key(node_id, req)
+            self.delegations[key] = self.delegations.get(key, 0) + granted
+        return {"granted": granted}
+
+    async def rpc_return_delegation(self, node_id: str, resources: dict,
+                                    count: int) -> None:
+        """A daemon hands back unused delegated slots (idle shrink)."""
+        req = dict(resources or {})
+        key = self._delegation_key(node_id, req)
+        n = min(int(count), self.delegations.get(key, 0))
+        if n <= 0:
+            return
+        self.delegations[key] -= n
+        if self.delegations[key] == 0:
+            del self.delegations[key]
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            for _ in range(n):
+                node.release(req)
+        self._sched_event.set()
 
     async def rpc_release_lease(self, lease_id: str) -> None:
         await self._release_lease(lease_id, terminate=False)

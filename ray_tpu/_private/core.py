@@ -251,6 +251,13 @@ class CoreClient:
         # key -> monotonic time before which lease requests are skipped
         # (set on an 'unavailable' miss; survives group teardown)
         self._lease_cooldown_until: Dict[tuple, float] = {}
+        # local-daemon grant path (distributed dispatch): after a
+        # 'spill' (or local-daemon connection failure), skip the local
+        # attempt for that lease key for a while so saturated nodes
+        # don't pay a wasted hop per pump; 'unsupported' (local leasing
+        # disabled by config) turns the path off for good
+        self._local_lease_skip_until: Dict[tuple, float] = {}
+        self._local_lease_unsupported = False
 
     # ------------------------------------------------------------- lifecycle
 
@@ -936,11 +943,38 @@ class CoreClient:
         """One pump = one lease: acquire a worker, drain the shared queue
         serially, idle out after LEASE_IDLE_S, release."""
         lease_id = None
+        lease_local = False
+        lease_daemon = None
         worker = None
         try:
-            reply = await self._controller().call(
-                "lease_worker", resources={"CPU": key[1]},
-                owner_addr=list(self.address))
+            reply = None
+            # Local-daemon grant first (distributed dispatch — reference
+            # parity: lease requests go to the LOCAL raylet,
+            # normal_task_submitter.h:189; its 'spill' sends us to the
+            # controller's global scheduler).
+            if (self.node_addr is not None
+                    and not self._local_lease_unsupported
+                    and time.monotonic()
+                    >= self._local_lease_skip_until.get(key, 0.0)):
+                try:
+                    reply = await self.pool.get(self.node_addr).call(
+                        "lease_worker_local", resources={"CPU": key[1]},
+                        owner_addr=list(self.address))
+                except Exception:
+                    reply = None
+                    self._local_lease_skip_until[key] = (
+                        time.monotonic() + 5.0)
+                if reply is not None and reply.get("status") != "ok":
+                    if reply.get("status") == "unsupported":
+                        self._local_lease_unsupported = True
+                    elif reply.get("status") == "spill":
+                        self._local_lease_skip_until[key] = (
+                            time.monotonic() + 5.0)
+                    reply = None
+            if reply is None:
+                reply = await self._controller().call(
+                    "lease_worker", resources={"CPU": key[1]},
+                    owner_addr=list(self.address))
             if reply.get("status") != "ok":
                 # no capacity for MORE leases: existing pumps (if any)
                 # keep draining; without any, fall back to the scheduler
@@ -950,8 +984,10 @@ class CoreClient:
                     await self._drain_lease_queue(group)
                 return
             lease_id = reply["lease_id"]
+            lease_local = bool(reply.get("local"))
             worker = self.pool.get(tuple(reply["worker_addr"]))
             daemon_addr = tuple(reply["daemon_addr"])
+            lease_daemon = daemon_addr
             worker_id = reply["worker_id"]
             idle_since = None
             while True:
@@ -1015,8 +1051,12 @@ class CoreClient:
                 self._lease_groups.pop(key, None)
             if lease_id is not None:
                 try:
-                    await self._controller().oneway(
-                        "release_lease", lease_id=lease_id)
+                    if lease_local and lease_daemon is not None:
+                        await self.pool.get(lease_daemon).oneway(
+                            "release_lease_local", lease_id=lease_id)
+                    else:
+                        await self._controller().oneway(
+                            "release_lease", lease_id=lease_id)
                 except Exception:
                     pass
 

@@ -254,6 +254,18 @@ class NodeDaemon:
         self._last_view: Optional[dict] = None
         self._cmd_applied = 0    # highest command seq applied (acked back)
         self.draining = False
+        # Daemon-local lease granting (distributed dispatch — reference
+        # parity: LocalTaskManager dispatch, local_task_manager.h:102):
+        # free slots per CPU size from controller-delegated blocks, plus
+        # the locally-granted leases themselves. The controller stays
+        # out of the per-lease critical path; it only sees block-sized
+        # delegate/return calls.
+        self._lease_blocks: Dict[float, int] = {}
+        self._local_leases: Dict[str, dict] = {}
+        self._lease_activity = 0.0       # last local grant/release
+        self._lease_probe_at = 0.0       # next owner-liveness sweep
+        self.local_leases_granted = 0    # counters for tests/stats
+        self.local_leases_spilled = 0
         # Worker forkserver (zygote.py): interpreter+imports paid once,
         # workers fork in ~10ms. RAY_TPU_FORKSERVER=0 falls back to cold
         # Popen per worker. Replies route by worker_id; child exits are
@@ -768,6 +780,234 @@ class NodeDaemon:
                 "alive": False,
                 "unstarted": sorted(ids & handle.last_unstarted_tasks)}
 
+    # ------------------------------------------------ local lease granting
+
+    LOCAL_LEASE_PROBE_AGE_S = 10.0     # lease age before owner probing
+    LOCAL_LEASE_PROBE_PERIOD_S = 5.0   # sweep cadence
+
+    async def rpc_lease_worker_local(self, resources: dict = None,
+                                     owner_addr=None) -> dict:
+        """Grant a worker lease WITHOUT a controller round-trip, from a
+        controller-delegated resource block (distributed dispatch —
+        reference parity: the raylet grants leases locally,
+        node_manager.cc HandleRequestWorkerLease; spillback here is the
+        'spill' reply, which sends the client to the controller's
+        global scheduler instead of a peer raylet — the controller is
+        this design's spill target).
+
+        Only plain-CPU requests are served locally (everything else
+        needs global placement state): others reply 'unsupported'."""
+        from .config import get_config
+        cfg = get_config()
+        res = dict(resources or {})
+        mode = str(cfg.local_lease_enabled).lower()
+        if mode in ("0", "false"):
+            enabled = False
+        elif mode in ("1", "true"):
+            enabled = True
+        else:   # auto: only worth it when the controller hop crosses
+            # hosts (loopback grants measurably lose to the controller
+            # path — delegation churn with no latency saved). Hosts are
+            # resolved so hostname-vs-IP spellings of the same machine
+            # still compare equal.
+            enabled = not self._controller_is_same_host()
+        if not enabled or any(k != "CPU" for k in res):
+            return {"status": "unsupported"}
+        cpu = float(res.get("CPU", 1.0))
+        if self.draining:
+            return {"status": "spill"}
+        while self._lease_blocks.get(cpu, 0) <= 0:
+            # grow the block; re-check after the await (a concurrent
+            # grant may have consumed what this call delegated)
+            try:
+                reply = await self.pool.get(self.controller_addr).call(
+                    "delegate_resources", node_id=self.node_id,
+                    resources={"CPU": cpu},
+                    count=max(1, cfg.lease_block_size))
+            except Exception:
+                reply = None
+            if not reply or reply.get("granted", 0) <= 0:
+                self.local_leases_spilled += 1
+                return {"status": "spill"}
+            self._lease_blocks[cpu] = (self._lease_blocks.get(cpu, 0)
+                                       + reply["granted"])
+        # slot claimed before the worker-acquire await (no double-grant)
+        self._lease_blocks[cpu] -= 1
+        reply = await self.rpc_reserve_worker()
+        if reply.get("status") != "ok":
+            self._lease_blocks[cpu] += 1
+            self.local_leases_spilled += 1
+            return {"status": "spill", "error": reply.get("error")}
+        import uuid as _uuid
+        lease_id = "ll-" + _uuid.uuid4().hex
+        self._local_leases[lease_id] = {
+            "cpu": cpu, "worker_id": reply["worker_id"],
+            "owner_addr": tuple(owner_addr) if owner_addr else None,
+            "granted_at": time.monotonic(), "score": 0,
+        }
+        self._lease_activity = time.monotonic()
+        self.local_leases_granted += 1
+        return {"status": "ok", "lease_id": lease_id, "local": True,
+                "worker_addr": list(reply["addr"]),
+                "worker_id": reply["worker_id"],
+                "daemon_addr": list(self.address),
+                "node_id": self.node_id}
+
+    def _controller_is_same_host(self) -> bool:
+        """True when the controller runs on this daemon's machine (so a
+        'local' grant would save no network hop). Resolves both
+        spellings once and caches — loopback literals, equal strings,
+        and hostname-vs-IP aliases all count as same-host; resolution
+        failure conservatively reports same-host (keeps auto OFF)."""
+        cached = getattr(self, "_same_host_cache", None)
+        if cached is not None:
+            return cached
+        chost, dhost = self.controller_addr[0], self.address[0]
+        same = True
+        try:
+            import socket
+            loop_names = {"127.0.0.1", "localhost", "::1", "0.0.0.0"}
+            if chost in loop_names or chost == dhost:
+                same = True
+            else:
+                cip = socket.gethostbyname(chost)
+                dip = socket.gethostbyname(dhost)
+                same = (cip == dip or cip in loop_names
+                        or dip in loop_names)
+        except OSError:
+            same = True
+        self._same_host_cache = same
+        return same
+
+    async def rpc_release_lease_local(self, lease_id: str,
+                                      terminate: bool = False) -> None:
+        ent = self._local_leases.pop(lease_id, None)
+        if ent is None:
+            return
+        if terminate:
+            await self.rpc_destroy_worker(ent["worker_id"])
+        else:
+            await self.rpc_release_worker(ent["worker_id"])
+        if not ent.get("unbacked"):
+            # unbacked = granted before a controller restart and not
+            # re-acquired since (_reconcile_delegations): its slot no
+            # longer exists controller-side, so nothing returns
+            self._lease_blocks[ent["cpu"]] = (
+                self._lease_blocks.get(ent["cpu"], 0) + 1)
+        self._lease_activity = time.monotonic()
+
+    async def _reconcile_delegations(self) -> None:
+        """After a controller restart (or dead-mark + re-register) the
+        fresh NodeEntry has no record of our delegated slots — neither
+        the free block nor the ones backing live local leases. Holding
+        them anyway would let the scheduled path double-book this node
+        forever. Re-acquire everything; what cannot be re-acquired is
+        shed: free slots are dropped, and uncovered live leases are
+        marked 'unbacked' (they run to completion but return no slot —
+        transient oversubscription bounded by the lease lifetime)."""
+        # Snapshot BOTH sides before any await: a lease granted while
+        # this coroutine awaits the controller is already backed by a
+        # fresh-controller delegation and must be neither covered here
+        # nor have its block slots clobbered (+= below, not =).
+        stale_free: Dict[float, int] = {
+            cpu: max(0, n) for cpu, n in self._lease_blocks.items()}
+        stale = list(self._local_leases.items())
+        self._lease_blocks = {}
+        # Pessimistic until re-acquired: a stale lease released DURING
+        # the awaits below must not credit a block slot from the dead
+        # controller epoch.
+        for _, ent in stale:
+            ent["unbacked"] = True
+        need: Dict[float, int] = dict(stale_free)
+        for _, ent in stale:
+            need[ent["cpu"]] = need.get(ent["cpu"], 0) + 1
+        controller = self.pool.get(self.controller_addr)
+        for cpu, count in need.items():
+            if count <= 0:
+                continue
+            granted = 0
+            try:
+                reply = await controller.call(
+                    "delegate_resources", node_id=self.node_id,
+                    resources={"CPU": cpu}, count=count)
+                granted = int((reply or {}).get("granted", 0))
+            except Exception:
+                granted = 0
+            # cover stale leases that are STILL live (a released one is
+            # skipped — its would-be slot flows into the block instead);
+            # leftovers join anything delegated concurrently (+=)
+            for lid, ent in stale:
+                if (ent["cpu"] != cpu or granted <= 0
+                        or self._local_leases.get(lid) is not ent):
+                    continue
+                ent["unbacked"] = False
+                granted -= 1
+            if granted > 0:
+                self._lease_blocks[cpu] = (
+                    self._lease_blocks.get(cpu, 0) + granted)
+
+    async def _local_lease_sweep(self) -> None:
+        """Monitor-loop duties for local leases: (a) reap leases whose
+        owner died (same refused/slow scoring as the controller's
+        reaper — a GIL-busy driver is 'slow', not dead), (b) return
+        idle delegated slots so the scheduled path can use them."""
+        from .config import get_config
+        cfg = get_config()
+        now = time.monotonic()
+        if self._local_leases and now >= self._lease_probe_at:
+            self._lease_probe_at = now + self.LOCAL_LEASE_PROBE_PERIOD_S
+            mature = [(lid, l) for lid, l in self._local_leases.items()
+                      if l["owner_addr"] is not None
+                      and (now - l["granted_at"]
+                           > self.LOCAL_LEASE_PROBE_AGE_S)]
+            owners = {l["owner_addr"] for _, l in mature}
+            verdict: Dict[tuple, int] = {}
+
+            async def _probe(addr: tuple) -> None:
+                # same classification as the controller's reaper
+                # (controller.py _reap_dead_client_leases): refused is
+                # definitive (+2); timeouts/odd errors are ambiguous
+                # (+1) — a GIL-starved driver must not lose its worker
+                try:
+                    await asyncio.wait_for(
+                        self.pool.get(addr).call("ping"), timeout=5.0)
+                    verdict[addr] = 0
+                except (asyncio.TimeoutError, TimeoutError):
+                    verdict[addr] = 1
+                except (ConnectionError, OSError):
+                    verdict[addr] = 2
+                except Exception:
+                    verdict[addr] = 1
+
+            await asyncio.gather(*(_probe(a) for a in owners))
+            for lid, lease in mature:
+                score = verdict.get(lease["owner_addr"], 0)
+                lease["score"] = (0 if score == 0
+                                  else lease["score"] + score)
+                if lease["score"] >= 4:
+                    # kill, don't re-pool: a zombie pump must never
+                    # share a worker with a daemon dispatch
+                    await self.rpc_release_lease_local(
+                        lid, terminate=True)
+        if (self._lease_blocks
+                and now - self._lease_activity > cfg.lease_block_idle_s):
+            for cpu, free in list(self._lease_blocks.items()):
+                if free > 0:
+                    # claim BEFORE the await: a grant racing this return
+                    # must see 0 and grow a fresh block, not consume a
+                    # slot the controller is about to release
+                    self._lease_blocks[cpu] -= free
+                    try:
+                        await self.pool.get(self.controller_addr).call(
+                            "return_delegation", node_id=self.node_id,
+                            resources={"CPU": cpu}, count=free)
+                    except Exception:
+                        self._lease_blocks[cpu] = (
+                            self._lease_blocks.get(cpu, 0) + free)
+                        continue
+                if self._lease_blocks.get(cpu) == 0:
+                    del self._lease_blocks[cpu]
+
     async def rpc_prestart_workers(self, count: int) -> int:
         started = 0
         for _ in range(count):
@@ -1201,6 +1441,11 @@ class NodeDaemon:
             "bytes_spilled": self.object_store.bytes_spilled,
             "objects_spilled": self.object_store.objects_spilled,
             "oom_kills": self.oom_kills,
+            # distributed dispatch: leases granted without a controller
+            # round-trip, spills to it, and currently-free block slots
+            "local_leases_granted": self.local_leases_granted,
+            "local_leases_spilled": self.local_leases_spilled,
+            "lease_block_free": sum(self._lease_blocks.values()),
             # allocated/capacity fraction of the shm arena: the memory
             # signal data-executor backpressure keys on
             "arena_pressure": self._arena_pressure_fraction(),
@@ -1239,6 +1484,10 @@ class NodeDaemon:
                     self.resources.pop(name, None)
                 else:
                     self.resources[name] = cap
+            elif kind == "reclaim_lease_blocks":
+                # scheduled work is pending cluster-side: force the next
+                # sweep tick (<=0.5s) to return all free delegated slots
+                self._lease_activity = 0.0
             else:
                 logger.warning("unknown syncer command %r", kind)
             self._cmd_applied = cmd["seq"]
@@ -1299,6 +1548,9 @@ class NodeDaemon:
                                 "actor_died", actor_id=aid,
                                 reason="worker died while the controller "
                                        "was down")
+                    # the fresh NodeEntry knows nothing of our delegated
+                    # lease slots: re-acquire or shed them
+                    await self._reconcile_delegations()
             except Exception:
                 pass
             # arena pressure: spill LRU sealed objects down to the low
@@ -1313,6 +1565,10 @@ class NodeDaemon:
                     await asyncio.get_running_loop().run_in_executor(
                         None, self.object_store.spill_until, target)
             await self._check_memory_pressure()
+            try:
+                await self._local_lease_sweep()
+            except Exception:
+                pass
             await self._pump_worker_logs(controller)
             for handle in list(self.workers.values()):
                 if handle.state == "dead":

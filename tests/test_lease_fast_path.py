@@ -26,11 +26,15 @@ def test_fast_path_used_and_leases_released(ray_start):
     from ray_tpu._private.state import current_client
     client = current_client()
     assert client._lease_groups or controller.leases or True  # racy peek
-    # ...and idle out afterwards (controller accounting returns to zero)
-    deadline = time.time() + 15
-    while time.time() < deadline and controller.leases:
+    # ...and idle out afterwards (controller accounting returns to
+    # zero — including lease blocks delegated to the daemon for local
+    # grants, which flow back after lease_block_idle_s)
+    deadline = time.time() + 25
+    while time.time() < deadline and (controller.leases
+                                      or controller.delegations):
         time.sleep(0.25)
     assert not controller.leases
+    assert not controller.delegations
     avail = ray_tpu.available_resources()
     total = ray_tpu.cluster_resources()
     assert avail.get("CPU") == total.get("CPU")

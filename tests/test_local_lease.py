@@ -1,0 +1,198 @@
+"""Daemon-local lease granting (distributed dispatch — reference
+parity: the raylet grants worker leases locally with no GCS round-trip,
+src/ray/raylet/local_task_manager.h:102; spillback routes the client to
+the controller's global scheduler, cluster_task_manager.h:45)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _runtime():
+    import ray_tpu._private.worker as worker_mod
+    return worker_mod._runtime
+
+
+@pytest.fixture()
+def fresh_cluster():
+    # Force-enable: the default "auto" turns local granting off when
+    # the controller shares the daemon's host (this box), since the
+    # path only pays off by removing a cross-host hop.
+    from ray_tpu._private.config import get_config
+    cfg = get_config()
+    prev = cfg.local_lease_enabled
+    cfg.local_lease_enabled = "1"
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+    cfg.local_lease_enabled = prev
+
+
+def test_local_grants_used_and_returned(fresh_cluster):
+    rt = fresh_cluster
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get([sq.remote(i) for i in range(100)]) == \
+        [i * i for i in range(100)]
+    daemon = rt.head_daemon
+    assert daemon.local_leases_granted > 0, \
+        "lease storm never used the local-daemon grant path"
+    # idle shrink: delegated slots flow back to the controller and the
+    # scheduled path sees full availability again
+    deadline = time.time() + 25
+    while time.time() < deadline and (
+            daemon._lease_blocks or rt.controller.delegations):
+        time.sleep(0.25)
+    assert not daemon._lease_blocks
+    assert not rt.controller.delegations
+    for n in rt.controller.nodes.values():
+        assert abs(n.resources_avail["CPU"]
+                   - n.resources_total["CPU"]) < 1e-6
+
+
+def test_spill_falls_back_and_completes(fresh_cluster):
+    """With every CPU consumed by delegation-ineligible work, local
+    grants spill; the storm still completes via the scheduled path."""
+    rt = fresh_cluster
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.8)
+        return 1
+
+    @ray_tpu.remote
+    def quick(x):
+        return x + 1
+
+    # 4 long tasks occupy all 4 CPUs through the normal paths, then a
+    # burst of quick tasks arrives: local grants must spill (no spare
+    # capacity to delegate) yet every task completes.
+    long_refs = [slow.remote() for _ in range(4)]
+    time.sleep(0.3)
+    assert ray_tpu.get([quick.remote(i) for i in range(40)],
+                       timeout=60) == list(range(1, 41))
+    assert ray_tpu.get(long_refs, timeout=60) == [1, 1, 1, 1]
+
+
+def test_dead_owner_lease_reaped(fresh_cluster):
+    """A locally-granted lease whose owner process vanished is reaped
+    by the daemon's sweep (worker killed, slot returned) — same
+    refused-scoring as the controller's reaper."""
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+    daemon.LOCAL_LEASE_PROBE_AGE_S = 0.5
+    daemon.LOCAL_LEASE_PROBE_PERIOD_S = 0.5
+    loop = rt.loop_runner
+
+    async def _grant():
+        # owner addr nobody listens on -> connection refused on probe
+        return await daemon.rpc_lease_worker_local(
+            resources={"CPU": 1.0}, owner_addr=["127.0.0.1", 1])
+
+    reply = loop.run_sync(_grant(), timeout=30)
+    assert reply["status"] == "ok"
+    worker_id = reply["worker_id"]
+    deadline = time.time() + 20
+    while time.time() < deadline and reply["lease_id"] in \
+            daemon._local_leases:
+        time.sleep(0.25)
+    assert reply["lease_id"] not in daemon._local_leases, \
+        "dead-owner lease never reaped"
+    # reaped via terminate: the worker must not return to the idle pool
+    handle = daemon.workers.get(worker_id)
+    assert handle is None or handle.state in ("dead", "leased") \
+        or handle.proc.poll() is not None
+
+
+def test_pending_task_reclaims_idle_blocks(fresh_cluster):
+    """A scheduled task that cannot fit while daemons hold free
+    delegated slots triggers the controller's reclaim command, freeing
+    the capacity well before the idle timer (spill-back pressure)."""
+    rt = fresh_cluster
+
+    @ray_tpu.remote
+    def quick(x):
+        return x + 1
+
+    # storm to leave delegated blocks hot (activity keeps refreshing,
+    # so the idle path alone would hold them ~10s)
+    assert ray_tpu.get([quick.remote(i) for i in range(50)]) == \
+        list(range(1, 51))
+    assert rt.controller.delegations, "no blocks delegated by storm"
+
+    @ray_tpu.remote(num_cpus=4)
+    def wide():
+        return "wide"
+
+    # needs every CPU: placeable only after the delegation is reclaimed
+    t0 = time.time()
+    assert ray_tpu.get(wide.remote(), timeout=30) == "wide"
+    assert time.time() - t0 < 9.0, \
+        "wide task waited for the idle timer instead of the reclaim"
+
+
+def test_controller_restart_reconciles_delegations(fresh_cluster):
+    """Simulated controller restart: the fresh NodeEntry has no
+    delegation record. The daemon re-acquires its slots (or sheds
+    them), so local grants never double-book against the scheduler."""
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+    loop = rt.loop_runner
+
+    async def _grant():
+        return await daemon.rpc_lease_worker_local(
+            resources={"CPU": 1.0}, owner_addr=list(rt.client.address))
+
+    reply = loop.run_sync(_grant(), timeout=30)
+    assert reply["status"] == "ok"
+    free_before = sum(daemon._lease_blocks.values())
+    assert free_before > 0
+
+    async def _wipe_and_reconcile():
+        # what a restart does to controller state: delegations gone,
+        # node availability rebuilt from scratch
+        ctrl = rt.controller
+        node = ctrl.nodes[daemon.node_id]
+        for _ in range(free_before + 1):     # +1 for the live lease
+            node.release({"CPU": 1.0})
+        ctrl.delegations.clear()
+        await daemon._reconcile_delegations()
+
+    loop.run_sync(_wipe_and_reconcile(), timeout=30)
+    ctrl = rt.controller
+    node = ctrl.nodes[daemon.node_id]
+    # invariant restored: controller-side acquisition == daemon-side
+    # (free slots + backed live leases)
+    backed = sum(1 for l in daemon._local_leases.values()
+                 if not l.get("unbacked"))
+    delegated = sum(ctrl.delegations.values())
+    assert delegated == sum(daemon._lease_blocks.values()) + backed
+    assert node.resources_total["CPU"] - node.resources_avail["CPU"] \
+        >= delegated - 1e-9
+    loop.run_sync(
+        daemon.rpc_release_lease_local(reply["lease_id"]), timeout=10)
+
+
+@pytest.mark.parametrize("mode", ["0", "auto"])
+def test_local_lease_off_modes(monkeypatch, mode):
+    """'0' disables outright; 'auto' disables here because controller
+    and daemon share a host (loopback grants lose — BENCH_CORE A/B)."""
+    from ray_tpu._private.config import get_config
+    monkeypatch.setattr(get_config(), "local_lease_enabled", mode)
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
+            list(range(20))
+        assert rt.head_daemon.local_leases_granted == 0
+    finally:
+        ray_tpu.shutdown()
