@@ -73,7 +73,18 @@ def _try_push_kv(key: str, value: str) -> None:
         client = _state.current_client_or_none()
         if client is None:
             return
-        client.kv_put(f"__usage__:{key}", value.encode(), overwrite=True)
+        kv_key = f"__usage__:{key}"
+        lr = getattr(client, "loop_runner", None)
+        if (lr is not None and lr.on_loop_thread()
+                and hasattr(client, "_controller")):
+            # worker RPC handlers run ON the loop: fire-and-forget the
+            # put (the sync kv_put would deadlock-guard and raise) —
+            # same pattern as util/tracing's flush
+            lr.call_soon(client._controller().call(
+                "kv_put", key=kv_key, value=value.encode(),
+                overwrite=True))
+        else:
+            client.kv_put(kv_key, value.encode(), overwrite=True)
     except Exception:
         pass
 

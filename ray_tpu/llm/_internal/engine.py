@@ -149,6 +149,39 @@ def _sample(logits, key, temps, top_ps, top_ks=None, rep_pens=None,
     return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+class _Stage:
+    """Device placement for ONE pipeline stage: a tp Mesh (tp>1) or a
+    single device, plus put() helpers. Pipeline-parallel serving splits
+    the stacked layer arrays (and the KV pools) into contiguous stage
+    slices over disjoint device groups — the reference places external
+    vLLM PP workers via PACK placement groups (vllm_models.py:127-139);
+    here stages are chained jit programs in one process, activations
+    crossing device groups via device_put (ICI on real hardware)."""
+
+    def __init__(self, devices, tp: int):
+        if tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ...parallel import MeshSpec
+            # full axis set (dp/fsdp/... sized 1) so the shared
+            # param-sharding rules resolve against a stage mesh exactly
+            # as they do against the tp-only engine mesh
+            self.mesh = MeshSpec(dp=1, fsdp=1, sp=1, tp=tp, ep=1,
+                                 pp=1).build(list(devices))
+            self.repl = NamedSharding(self.mesh, PartitionSpec())
+            self.kv_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, None, None, "tp", None))
+        else:
+            self.mesh = None
+            self.device = devices[0]
+            self.repl = self.kv_sharding = None
+
+    def put(self, x, sharding=None):
+        if self.mesh is None:
+            return jax.device_put(x, self.device)
+        return jax.device_put(x, sharding if sharding is not None
+                              else self.repl)
+
+
 class InferenceEngine:
     def __init__(self, config: EngineConfig,
                  params: Optional[Dict[str, Any]] = None):
@@ -156,10 +189,15 @@ class InferenceEngine:
         self.model_cfg = config.resolve_model()
         self.max_seq = config.max_seq_len or self.model_cfg.max_seq
         cfg, ec = self.model_cfg, config
-        self.mesh = self._build_mesh(ec.mesh, cfg)
+        self.mesh, self.stages = self._build_placement(ec.mesh, cfg)
+        self.pp = len(self.stages) if self.stages else 1
         if params is None:
             params = llama.init_params(cfg, jax.random.PRNGKey(ec.seed))
-        if self.mesh is not None:
+        if self.pp > 1:
+            self.params = None
+            self.stage_params = self._split_stage_params(params, cfg)
+            self._kv_sharding = self._repl = None
+        elif self.mesh is not None:
             from ...parallel.sharding import shard_tree
             self.params = shard_tree(
                 params, llama.param_logical_axes(cfg), self.mesh)
@@ -175,13 +213,28 @@ class InferenceEngine:
             ec.num_pages, ec.page_size,
             enable_prefix_caching=ec.enable_prefix_caching)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
-        kv_shape = (cfg.n_layers, ec.num_pages, ec.page_size,
-                    cfg.n_kv_heads, cfg.head_dim)
-        self.k_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
-                                 self._kv_sharding)
-        self.v_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
-                                 self._kv_sharding)
-        self._key = self._dev(jax.random.PRNGKey(ec.seed + 1))
+        if self.pp > 1:
+            per = cfg.n_layers // self.pp
+            kv_shape = (per, ec.num_pages, ec.page_size,
+                        cfg.n_kv_heads, cfg.head_dim)
+            self.k_pages = [
+                st.put(jnp.zeros(kv_shape, cfg.dtype), st.kv_sharding)
+                for st in self.stages]
+            self.v_pages = [
+                st.put(jnp.zeros(kv_shape, cfg.dtype), st.kv_sharding)
+                for st in self.stages]
+            # sampling state (key/temps/seen/...) lives with the LAST
+            # stage, where logits are produced
+            self._key = self.stages[-1].put(
+                jax.random.PRNGKey(ec.seed + 1))
+        else:
+            kv_shape = (cfg.n_layers, ec.num_pages, ec.page_size,
+                        cfg.n_kv_heads, cfg.head_dim)
+            self.k_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
+                                     self._kv_sharding)
+            self.v_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
+                                     self._kv_sharding)
+            self._key = self._dev(jax.random.PRNGKey(ec.seed + 1))
 
         # multi-LoRA: name -> adapter index (0 = the zero adapter);
         # stacks are {proj: {"a": (A, L, H, r), "b": (A, r, O)}} device
@@ -206,42 +259,87 @@ class InferenceEngine:
         self._prefill_rr = 0           # round-robin cursor over slots
 
     @staticmethod
-    def _build_mesh(spec, cfg: LlamaConfig):
-        """EngineConfig.mesh (MeshSpec | dict | None) -> jax Mesh | None."""
+    def _build_placement(spec, cfg: LlamaConfig):
+        """EngineConfig.mesh (MeshSpec | dict | None) ->
+        (tp Mesh | None, stage list | None).
+
+        Serving supports the tp and pp axes (the reference's vLLM
+        TP x PP placement, vllm_models.py:123-159): tp shards
+        heads/ffn/vocab inside each stage's GSPMD program; pp>1 splits
+        the layer stack into contiguous stage slices over disjoint
+        device groups (see _Stage). dp/fsdp/sp/ep stay rejected —
+        replicated decode on dp>1 silently halves the fleet. tp=-1
+        keeps MeshSpec's "use remaining devices" meaning: all visible
+        devices divided by pp."""
         if spec is None:
-            return None
+            return None, None
         from ...parallel import MeshSpec
         if isinstance(spec, dict):
             spec = MeshSpec(**spec)
-        # Serving is TP-only today: resolve MeshSpec's training-oriented
-        # fsdp=-1 default to 1 and reject real parallelism on any other
-        # axis — replicated decode on dp>1 silently halves the fleet,
-        # and pp>1 would shard stacked layer params in a layout
-        # decode_step never consumes. tp=-1 keeps MeshSpec's documented
-        # "use remaining devices" meaning: all visible devices.
         sizes = dict(spec.axis_sizes())
+        devices = jax.devices()
+        pp = sizes.get("pp", 1)
+        if pp == -1 and sizes["tp"] == -1:
+            raise ValueError(
+                "at most one of tp/pp may be -1 in an engine mesh")
         if sizes["tp"] == -1:
-            sizes["tp"] = len(jax.devices())
+            sizes["tp"] = max(1, len(devices) // max(pp, 1))
+        if pp == -1:    # MeshSpec semantics: use the remaining devices
+            pp = max(1, len(devices) // sizes["tp"])
         sizes["fsdp"] = 1 if sizes["fsdp"] == -1 else sizes["fsdp"]
         bad = {k: v for k, v in sizes.items()
-               if k != "tp" and (v > 1 or v == -1)}
+               if k not in ("tp", "pp") and (v > 1 or v == -1)}
         if bad:
             raise ValueError(
-                f"engine mesh supports only the tp axis; got {bad}")
-        spec = MeshSpec(**sizes)
-        if spec.tp == 1:
-            return None
-        for name, dim in (("n_heads", cfg.n_heads),
-                          ("n_kv_heads", cfg.n_kv_heads),
-                          ("vocab_size", cfg.vocab_size)):
-            if dim % spec.tp:
-                raise ValueError(
-                    f"{name}={dim} not divisible by tp={spec.tp}")
-        devices = jax.devices()
-        if spec.tp > len(devices):
+                f"engine mesh supports only tp/pp axes; got {bad}")
+        tp = sizes["tp"]
+        if tp > 1:
+            for name, dim in (("n_heads", cfg.n_heads),
+                              ("n_kv_heads", cfg.n_kv_heads),
+                              ("vocab_size", cfg.vocab_size)):
+                if dim % tp:
+                    raise ValueError(
+                        f"{name}={dim} not divisible by tp={tp}")
+        if tp * pp > len(devices):
             raise ValueError(
-                f"engine mesh needs {spec.tp} devices, have {len(devices)}")
-        return spec.build(devices[:spec.tp])
+                f"engine mesh needs {tp * pp} devices, "
+                f"have {len(devices)}")
+        if pp > 1:
+            if cfg.n_layers % pp:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+            stages = [_Stage(devices[i * tp:(i + 1) * tp], tp)
+                      for i in range(pp)]
+            return None, stages
+        if tp == 1:
+            return None, None
+        return MeshSpec(**{**sizes, "pp": 1}).build(devices[:tp]), None
+
+    def _split_stage_params(self, params: Dict[str, Any],
+                            cfg: LlamaConfig) -> List[Dict[str, Any]]:
+        """Slice the stacked layer arrays into per-stage params placed
+        on each stage's devices (tp-sharded inside a stage)."""
+        from ...parallel.sharding import shard_tree
+        per = cfg.n_layers // self.pp
+        axes = llama.param_logical_axes(cfg)
+        out = []
+        for i, stage in enumerate(self.stages):
+            p = {"layers": jax.tree.map(
+                lambda a: a[i * per:(i + 1) * per], params["layers"])}
+            ax = {"layers": axes["layers"]}
+            if i == 0:
+                p["embed"] = params["embed"]
+                ax["embed"] = axes["embed"]
+            if i == self.pp - 1:
+                p["final_norm"] = params["final_norm"]
+                p["lm_head"] = params["lm_head"]
+                ax["final_norm"] = axes["final_norm"]
+                ax["lm_head"] = axes["lm_head"]
+            if stage.mesh is not None:
+                out.append(shard_tree(p, ax, stage.mesh))
+            else:
+                out.append(jax.device_put(p, stage.device))
+        return out
 
     def _dev(self, x, sharding=None):
         """device_put honoring the engine mesh (replicated by default)."""
@@ -253,15 +351,7 @@ class InferenceEngine:
     # -- compiled programs --------------------------------------------------
     def _build_decode(self):
         cfg = self.model_cfg
-        impl = self.config.decode_impl
-        if impl == "auto":
-            # any non-CPU PJRT platform (tpu, or this machine's "axon"
-            # tunnel) runs the compiled Pallas kernel; CPU falls back to
-            # the dense gather (kernel correctness is covered in
-            # interpret-mode tests)
-            impl = ("gather" if jax.devices()[0].platform == "cpu"
-                    else "pallas")
-
+        impl = self._resolve_impl()
         mesh = self.mesh
 
         def step(params, k_pages, v_pages, seen, tokens, positions,
@@ -337,6 +427,271 @@ class InferenceEngine:
             self._chunk_fns[(bucket, ctx_pages)] = fn
         return fn
 
+    # -- pipeline-parallel programs (pp > 1) -------------------------------
+    # Each stage runs its slice of the layer stack as its own jit
+    # program on its own device group; activations hop between groups
+    # via device_put. Sampling (and the seen/penalty state) lives with
+    # the last stage, where logits exist.
+
+    def _resolve_impl(self) -> str:
+        """decode_impl with "auto" resolved: any non-CPU PJRT platform
+        (tpu, or this machine's "axon" tunnel) runs the compiled Pallas
+        kernel; CPU falls back to the dense gather (kernel correctness
+        is covered in interpret-mode tests). One resolver for the pp
+        and non-pp programs so they can never diverge."""
+        impl = self.config.decode_impl
+        if impl == "auto":
+            impl = ("gather" if jax.devices()[0].platform == "cpu"
+                    else "pallas")
+        return impl
+
+    def _pp_decode_fn(self, i: int):
+        fns = getattr(self, "_pp_decode_cache", None)
+        if fns is None:
+            fns = self._pp_decode_cache = {}
+        if i in fns:
+            return fns[i]
+        cfg = self.model_cfg
+        impl = self._resolve_impl()
+        stage = self.stages[i]
+        first, last = i == 0, i == self.pp - 1
+        if not last:
+            def run(params, k_pages, v_pages, xin, positions,
+                    page_tables, active):
+                tokens = (xin if first
+                          else jnp.zeros(xin.shape[0], jnp.int32))
+                h, k_pages, v_pages = decode_step(
+                    cfg, params, tokens, positions, k_pages, v_pages,
+                    page_tables, active, impl=impl, mesh=stage.mesh,
+                    hidden=None if first else xin, emit="hidden")
+                return h, k_pages, v_pages
+
+            fns[i] = jax.jit(run, donate_argnums=(1, 2))
+            return fns[i]
+
+        def run_last(params, k_pages, v_pages, hidden, seen, positions,
+                     page_tables, active, key, temps, top_ps, top_ks,
+                     rep_pens, all_greedy):
+            tokens = jnp.zeros(hidden.shape[0], jnp.int32)
+            logits, k_pages, v_pages = decode_step(
+                cfg, params, tokens, positions, k_pages, v_pages,
+                page_tables, active, impl=impl, mesh=stage.mesh,
+                hidden=hidden, emit="logits")
+            if all_greedy:
+                new_tokens = _sample(logits, key, temps, top_ps,
+                                     all_greedy=True)
+                return new_tokens, k_pages, v_pages, seen
+            new_tokens = _sample(logits, key, temps, top_ps, top_ks,
+                                 rep_pens, seen, False)
+            b = hidden.shape[0]
+            seen = seen.at[jnp.arange(b), new_tokens].max(active)
+            return new_tokens, k_pages, v_pages, seen
+
+        fns[i] = jax.jit(run_last, donate_argnums=(1, 2, 4),
+                         static_argnums=(13,))
+        return fns[i]
+
+    def _pp_prefill_fns(self, bucket: int):
+        cache = getattr(self, "_pp_prefill_cache", None)
+        if cache is None:
+            cache = self._pp_prefill_cache = {}
+        if bucket in cache:
+            return cache[bucket]
+        cfg = self.model_cfg
+        out = []
+        for i, stage in enumerate(self.stages):
+            first, last = i == 0, i == self.pp - 1
+            if not last:
+                def run(params, k_pages, v_pages, xin, true_lens,
+                        page_tables, _first=first):
+                    tokens = (xin if _first
+                              else jnp.zeros(xin.shape[:2], jnp.int32))
+                    h, k_pages, v_pages = prefill(
+                        cfg, params, tokens, true_lens, k_pages,
+                        v_pages, page_tables,
+                        hidden=None if _first else xin, emit="hidden")
+                    return h, k_pages, v_pages
+
+                out.append(jax.jit(run, donate_argnums=(1, 2)))
+                continue
+
+            def run_last(params, k_pages, v_pages, hidden, tokens,
+                         true_lens, page_tables, key, temps, top_ps,
+                         top_ks, rep_pens):
+                logits, k_pages, v_pages = prefill(
+                    cfg, params, tokens, true_lens, k_pages, v_pages,
+                    page_tables, hidden=hidden, emit="logits")
+                b, bucket_len = tokens.shape
+                valid = (jnp.arange(bucket_len)[None, :]
+                         < true_lens[:, None])
+                seen = jnp.zeros((b, cfg.vocab_size), bool)
+                seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+                first_tok = _sample(logits, key, temps, top_ps, top_ks,
+                                    rep_pens, seen)
+                return first_tok, k_pages, v_pages
+
+            out.append(jax.jit(run_last, donate_argnums=(1, 2)))
+        cache[bucket] = out
+        return out
+
+    def _pp_chunk_fns(self, bucket: int, ctx_pages: int):
+        cache = getattr(self, "_pp_chunk_cache", None)
+        if cache is None:
+            cache = self._pp_chunk_cache = {}
+        if (bucket, ctx_pages) in cache:
+            return cache[(bucket, ctx_pages)]
+        cfg = self.model_cfg
+        from ...models.llama_infer import prefill_chunk
+        out = []
+        for i, stage in enumerate(self.stages):
+            first, last = i == 0, i == self.pp - 1
+            if not last:
+                def run(params, k_pages, v_pages, xin, start_pos,
+                        chunk_lens, page_tables, _first=first):
+                    tokens = (xin if _first
+                              else jnp.zeros(xin.shape[:2], jnp.int32))
+                    h, k_pages, v_pages = prefill_chunk(
+                        cfg, params, tokens, start_pos, chunk_lens,
+                        k_pages, v_pages, page_tables,
+                        ctx_pages=ctx_pages,
+                        hidden=None if _first else xin, emit="hidden")
+                    return h, k_pages, v_pages
+
+                out.append(jax.jit(run, donate_argnums=(1, 2)))
+                continue
+
+            def run_last(params, k_pages, v_pages, hidden, tokens,
+                         start_pos, chunk_lens, page_tables, key, temps,
+                         top_ps, top_ks, rep_pens, seen):
+                logits, k_pages, v_pages = prefill_chunk(
+                    cfg, params, tokens, start_pos, chunk_lens,
+                    k_pages, v_pages, page_tables, ctx_pages=ctx_pages,
+                    hidden=hidden, emit="logits")
+                b, bucket_len = tokens.shape
+                valid = (jnp.arange(bucket_len)[None, :]
+                         < chunk_lens[:, None])
+                seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+                first_tok = _sample(logits, key, temps, top_ps, top_ks,
+                                    rep_pens, seen)
+                return first_tok, k_pages, v_pages
+
+            out.append(jax.jit(run_last, donate_argnums=(1, 2)))
+        cache[(bucket, ctx_pages)] = out
+        return out
+
+    def _prep_full_prompt(self, req: Request):
+        """Host-side prep for the whole-prompt fast path, shared by the
+        pp and non-pp paths (they must stay in lockstep — a bucketing
+        or padding fix applied to one would silently diverge the
+        other's tokens)."""
+        n = len(req.prompt_tokens)
+        bucket = self._bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        return tokens, bucket
+
+    def _prep_chunk(self, slot: "_Slot", req: Request):
+        """Host-side prep for one prefill chunk (tokens, prior 'seen'
+        for the penalty — prompt tokens count as seen, HF semantics),
+        shared by the pp and non-pp paths."""
+        n = len(req.prompt_tokens)
+        chunk = min(self.config.max_prefill_tokens, n - slot.prefill_pos)
+        bucket = self._bucket_for(chunk)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :chunk] = req.prompt_tokens[
+            slot.prefill_pos:slot.prefill_pos + chunk]
+        V = self.model_cfg.vocab_size
+        prior = np.zeros((1, V), bool)
+        if slot.prefill_pos:
+            prior[0, np.asarray(
+                req.prompt_tokens[:slot.prefill_pos], np.int64) % V] = True
+        return tokens, chunk, bucket, prior
+
+    def _pp_prefill_one_chunk(self, slot: "_Slot",
+                              touched: List[Request]) -> None:
+        req = slot.request
+        n = len(req.prompt_tokens)
+        p = req.params
+        self._key, sub = jax.random.split(self._key)
+        tables = [st.put(jnp.asarray(
+            self._page_tables[slot.index:slot.index + 1]))
+            for st in self.stages]
+        sl = self.stages[-1]
+        temps = sl.put(jnp.asarray([p.temperature], jnp.float32))
+        top_ps = sl.put(jnp.asarray([p.top_p], jnp.float32))
+        top_ks = sl.put(jnp.asarray([p.top_k], jnp.int32))
+        rep_pens = sl.put(jnp.asarray(
+            [p.repetition_penalty], jnp.float32))
+
+        if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
+            tokens, bucket = self._prep_full_prompt(req)
+            fns = self._pp_prefill_fns(bucket)
+            x = self.stages[0].put(jnp.asarray(tokens))
+            lens = [st.put(jnp.asarray([n], jnp.int32))
+                    for st in self.stages]
+            for i in range(self.pp - 1):
+                x, self.k_pages[i], self.v_pages[i] = fns[i](
+                    self.stage_params[i], self.k_pages[i],
+                    self.v_pages[i],
+                    x if i == 0 else self.stages[i].put(x),
+                    lens[i], tables[i])
+            i = self.pp - 1
+            first, self.k_pages[i], self.v_pages[i] = fns[i](
+                self.stage_params[i], self.k_pages[i], self.v_pages[i],
+                sl.put(x), sl.put(jnp.asarray(tokens)), lens[i],
+                tables[i], sub, temps, top_ps, top_ks, rep_pens)
+            self._finish_prefill(slot, int(first[0]), touched)
+            return
+
+        tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
+        fns = self._pp_chunk_fns(bucket,
+                                 self._ctx_bucket(slot.prefill_pos))
+        start = [st.put(jnp.asarray([slot.prefill_pos], jnp.int32))
+                 for st in self.stages]
+        clens = [st.put(jnp.asarray([chunk], jnp.int32))
+                 for st in self.stages]
+        x = self.stages[0].put(jnp.asarray(tokens))
+        for i in range(self.pp - 1):
+            x, self.k_pages[i], self.v_pages[i] = fns[i](
+                self.stage_params[i], self.k_pages[i], self.v_pages[i],
+                x if i == 0 else self.stages[i].put(x),
+                start[i], clens[i], tables[i])
+        i = self.pp - 1
+        first, self.k_pages[i], self.v_pages[i] = fns[i](
+            self.stage_params[i], self.k_pages[i], self.v_pages[i],
+            sl.put(x), sl.put(jnp.asarray(tokens)), start[i], clens[i],
+            tables[i], sub, temps, top_ps, top_ks, rep_pens,
+            sl.put(jnp.asarray(prior)))
+        slot.prefill_pos += chunk
+        if slot.prefill_pos >= n:
+            self._finish_prefill(slot, int(first[0]), touched)
+
+    def _pp_decode(self, touched: List[Request]) -> None:
+        if self._d_tokens is None:
+            self._refresh_device_state()
+        self._key, sub = jax.random.split(self._key)
+        x = self._d_tokens
+        for i in range(self.pp - 1):
+            x, self.k_pages[i], self.v_pages[i] = self._pp_decode_fn(i)(
+                self.stage_params[i], self.k_pages[i], self.v_pages[i],
+                x if i == 0 else self.stages[i].put(x),
+                self._d_positions[i], self._d_tables[i],
+                self._d_active[i])
+        i = self.pp - 1
+        sl = self.stages[i]
+        new_tokens, self.k_pages[i], self.v_pages[i], self._d_seen = \
+            self._pp_decode_fn(i)(
+                self.stage_params[i], self.k_pages[i], self.v_pages[i],
+                sl.put(x), self._d_seen, self._d_positions[i],
+                self._d_tables[i], self._d_active[i], sub,
+                self._d_temps, self._d_top_ps, self._d_top_ks,
+                self._d_rep_pens, self._all_greedy)
+        self._d_tokens = self.stages[0].put(new_tokens)
+        for j in range(self.pp):
+            self._d_positions[j] = (self._d_positions[j]
+                                    + self._d_active[j])
+        self._post_decode(np.asarray(new_tokens), touched)
+
     def _ctx_bucket(self, start: int) -> int:
         """Smallest power-of-two page count covering `start` tokens."""
         need = self.allocator.pages_needed(start)
@@ -374,6 +729,10 @@ class InferenceEngine:
         """Bulk form: stage every adapter, build + upload the padded
         stacks ONCE (k adapters via the per-name API would rebuild and
         transfer k times)."""
+        if self.pp > 1:
+            raise NotImplementedError(
+                "multi-LoRA is not supported with pipeline-parallel "
+                "serving (pp>1); use tp-only meshes for LoRA")
         valid = {"wq", "wk", "wv", "wo"}
         new_raw = dict(self._lora_raw)
         for name, adapters in mapping.items():
@@ -554,6 +913,8 @@ class InferenceEngine:
 
     def _prefill_one_chunk(self, slot: _Slot,
                            touched: List[Request]) -> None:
+        if self.pp > 1:
+            return self._pp_prefill_one_chunk(slot, touched)
         req = slot.request
         n = len(req.prompt_tokens)
         p = req.params
@@ -569,9 +930,7 @@ class InferenceEngine:
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
             # whole prompt in one go: the dense full-causal program
             # (no pool gather — the common short-prompt fast path)
-            bucket = self._bucket_for(n)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_tokens
+            tokens, bucket = self._prep_full_prompt(req)
             lidx = self._dev(jnp.asarray(
                 [self._lora_names.get(req.lora, 0)], jnp.int32))
             first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
@@ -583,18 +942,7 @@ class InferenceEngine:
             self._finish_prefill(slot, int(first[0]), touched)
             return
 
-        chunk = min(self.config.max_prefill_tokens, n - slot.prefill_pos)
-        bucket = self._bucket_for(chunk)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :chunk] = req.prompt_tokens[
-            slot.prefill_pos:slot.prefill_pos + chunk]
-        # "seen" so far = prior chunks of this prompt (the fn adds the
-        # current chunk itself); rebuilt host-side per chunk
-        V = self.model_cfg.vocab_size
-        prior = np.zeros((1, V), bool)
-        if slot.prefill_pos:
-            prior[0, np.asarray(
-                req.prompt_tokens[:slot.prefill_pos], np.int64) % V] = True
+        tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
         lidx = self._dev(jnp.asarray(
             [self._lora_names.get(req.lora, 0)], jnp.int32))
         first, self.k_pages, self.v_pages = self._chunk_fn(
@@ -660,26 +1008,47 @@ class InferenceEngine:
                 seen[s.index, np.asarray(
                     s.request.prompt_tokens + s.request.output_tokens,
                     np.int64) % V] = True
-        self._d_tokens = self._dev(jnp.asarray(tokens))
-        self._d_positions = self._dev(jnp.asarray(positions))
-        self._d_active = self._dev(jnp.asarray(active))
-        self._d_temps = self._dev(jnp.asarray(temps))
-        self._d_top_ps = self._dev(jnp.asarray(top_ps))
-        self._d_top_ks = self._dev(jnp.asarray(top_ks))
-        self._d_rep_pens = self._dev(jnp.asarray(rep_pens))
-        lora_idx = np.zeros(B, np.int32)
-        for s2 in self.slots:
-            if s2.request is not None and s2.ready:
-                lora_idx[s2.index] = self._lora_names.get(
-                    s2.request.lora, 0)
-        self._d_lora_idx = self._dev(jnp.asarray(lora_idx))
-        self._d_seen = self._dev(jnp.asarray(seen))
-        self._d_tables = self._dev(jnp.asarray(self._page_tables))
+        if self.pp > 1:
+            # per-stage copies: tokens feed stage 0; positions/active/
+            # tables drive rope+scatter in EVERY stage; sampling state
+            # lives with the last stage (where logits exist)
+            sl = self.stages[-1]
+            self._d_tokens = self.stages[0].put(jnp.asarray(tokens))
+            self._d_positions = [st.put(jnp.asarray(positions))
+                                 for st in self.stages]
+            self._d_active = [st.put(jnp.asarray(active))
+                              for st in self.stages]
+            self._d_tables = [st.put(jnp.asarray(self._page_tables))
+                              for st in self.stages]
+            self._d_temps = sl.put(jnp.asarray(temps))
+            self._d_top_ps = sl.put(jnp.asarray(top_ps))
+            self._d_top_ks = sl.put(jnp.asarray(top_ks))
+            self._d_rep_pens = sl.put(jnp.asarray(rep_pens))
+            self._d_seen = sl.put(jnp.asarray(seen))
+            self._d_lora_idx = None
+        else:
+            self._d_tokens = self._dev(jnp.asarray(tokens))
+            self._d_positions = self._dev(jnp.asarray(positions))
+            self._d_active = self._dev(jnp.asarray(active))
+            self._d_temps = self._dev(jnp.asarray(temps))
+            self._d_top_ps = self._dev(jnp.asarray(top_ps))
+            self._d_top_ks = self._dev(jnp.asarray(top_ks))
+            self._d_rep_pens = self._dev(jnp.asarray(rep_pens))
+            lora_idx = np.zeros(B, np.int32)
+            for s2 in self.slots:
+                if s2.request is not None and s2.ready:
+                    lora_idx[s2.index] = self._lora_names.get(
+                        s2.request.lora, 0)
+            self._d_lora_idx = self._dev(jnp.asarray(lora_idx))
+            self._d_seen = self._dev(jnp.asarray(seen))
+            self._d_tables = self._dev(jnp.asarray(self._page_tables))
         self._all_greedy = bool(np.all(temps <= 0.0)
                                 and np.all(rep_pens == 1.0))
         self._host_active = active
 
     def _decode(self, touched: List[Request]) -> None:
+        if self.pp > 1:
+            return self._pp_decode(touched)
         if self._d_tokens is None:
             self._refresh_device_state()
         self._key, sub = jax.random.split(self._key)
@@ -694,7 +1063,11 @@ class InferenceEngine:
         # device-side feedback for the next step
         self._d_tokens = new_tokens
         self._d_positions = self._d_positions + self._d_active
-        host_tokens = np.asarray(new_tokens)      # the one readback
+        self._post_decode(np.asarray(new_tokens), touched)
+
+    def _post_decode(self, host_tokens: "np.ndarray",
+                     touched: List[Request]) -> None:
+        """Shared decode tail: fold the one readback into slot state."""
         dirty = False
         for s in self.slots:
             if s.request is None or not self._host_active[s.index]:

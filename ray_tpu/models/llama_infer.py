@@ -54,14 +54,23 @@ def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             true_lens: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
             page_tables: jax.Array, lora: Optional[dict] = None,
-            lora_idx: Optional[jax.Array] = None
+            lora_idx: Optional[jax.Array] = None,
+            hidden: Optional[jax.Array] = None, emit: str = "logits"
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """tokens: (B, S) padded prompts; true_lens: (B); page_tables:
     (B, max_pages). Returns (last_logits (B, V) f32, k_pages, v_pages).
+
+    Pipeline-parallel serving (engine pp>1) runs this per STAGE:
+    `params["layers"]` holds only the stage's slice of the stack (the
+    KV pools likewise), `hidden` carries the previous stage's (B, S, H)
+    activations in place of embedding (params then needs no "embed"),
+    and emit="hidden" returns the full activations for the next stage
+    instead of head logits (no "final_norm"/"lm_head" needed).
     """
     b, s = tokens.shape
     dt = cfg.dtype
-    x = params["embed"].astype(dt)[tokens]
+    x = (params["embed"].astype(dt)[tokens] if hidden is None
+         else hidden.astype(dt))
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
 
     def layer_fn(x, inp):
@@ -88,17 +97,21 @@ def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
     x, (ks, vs) = jax.lax.scan(
         layer_fn, x, (params["layers"], lora_scan_xs(lora)))
-    # ks/vs: (L, B, S, KVH, D) -> token-major (B*S, L, KVH, D)
+    # ks/vs: (L, B, S, KVH, D) -> token-major (B*S, L, KVH, D);
+    # L from the stack itself (a pp stage carries n_layers // pp)
+    n_l = ks.shape[0]
     k_rows = jnp.transpose(ks, (1, 2, 0, 3, 4)).reshape(
-        b * s, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+        b * s, n_l, cfg.n_kv_heads, cfg.head_dim)
     v_rows = jnp.transpose(vs, (1, 2, 0, 3, 4)).reshape(
-        b * s, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+        b * s, n_l, cfg.n_kv_heads, cfg.head_dim)
     positions = jnp.tile(jnp.arange(s), b)
     valid = positions < jnp.repeat(true_lens, s)
     tables = jnp.repeat(page_tables, s, axis=0)
     k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
                                   tables, positions, valid)
 
+    if emit == "hidden":
+        return x, k_pages, v_pages
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = jnp.take_along_axis(
         x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
@@ -111,7 +124,8 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
                   chunk_lens: jax.Array, k_pages: jax.Array,
                   v_pages: jax.Array, page_tables: jax.Array,
                   ctx_pages: int = -1, lora: Optional[dict] = None,
-                  lora_idx: Optional[jax.Array] = None
+                  lora_idx: Optional[jax.Array] = None,
+                  hidden: Optional[jax.Array] = None, emit: str = "logits"
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill a CHUNK of each prompt against already-cached context.
 
@@ -134,7 +148,8 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
 
     b, c = tokens.shape
     dt = cfg.dtype
-    x = params["embed"].astype(dt)[tokens]
+    x = (params["embed"].astype(dt)[tokens] if hidden is None
+         else hidden.astype(dt))
     positions = start_pos[:, None] + jnp.arange(c)[None, :]      # (B, C)
     cos, sin = rope_frequencies(cfg, positions.reshape(-1))
     cos = cos.reshape(b, c, -1)
@@ -178,17 +193,21 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
     x, (ks, vs) = jax.lax.scan(
         layer_fn, x,
         (params["layers"], k_ctx_all, v_ctx_all, lora_scan_xs(lora)))
-    # ks/vs: (L, B, C, KVH, D) -> token-major (B*C, L, KVH, D)
+    # ks/vs: (L, B, C, KVH, D) -> token-major (B*C, L, KVH, D);
+    # L from the stack itself (a pp stage carries n_layers // pp)
+    n_l = ks.shape[0]
     k_rows = jnp.transpose(ks, (1, 2, 0, 3, 4)).reshape(
-        b * c, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+        b * c, n_l, cfg.n_kv_heads, cfg.head_dim)
     v_rows = jnp.transpose(vs, (1, 2, 0, 3, 4)).reshape(
-        b * c, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)
+        b * c, n_l, cfg.n_kv_heads, cfg.head_dim)
     flat_pos = positions.reshape(-1)
     valid = (jnp.arange(c)[None, :] < chunk_lens[:, None]).reshape(-1)
     tables = jnp.repeat(page_tables, c, axis=0)
     k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
                                   tables, flat_pos, valid)
 
+    if emit == "hidden":
+        return x, k_pages, v_pages
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = jnp.take_along_axis(
         x, jnp.maximum(chunk_lens - 1, 0)[:, None, None].astype(jnp.int32),
@@ -244,7 +263,8 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 page_tables: jax.Array, active: jax.Array,
                 impl: str = "gather", mesh=None,
                 lora: Optional[dict] = None,
-                lora_idx: Optional[jax.Array] = None
+                lora_idx: Optional[jax.Array] = None,
+                hidden: Optional[jax.Array] = None, emit: str = "logits"
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole running batch.
 
@@ -271,7 +291,8 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
     """
     b = tokens.shape[0]
     dt = cfg.dtype
-    x = params["embed"].astype(dt)[tokens]          # (B, H)
+    x = (params["embed"].astype(dt)[tokens] if hidden is None
+         else hidden.astype(dt))                    # (B, H)
     cos, sin = rope_frequencies(cfg, positions)     # (B, D/2)
 
     use_kernel = impl in ("pallas", "pallas_interpret")
@@ -336,6 +357,8 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
     v_rows = jnp.transpose(vs, (1, 0, 2, 3))
     k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
                                   page_tables, positions, active)
+    if emit == "hidden":
+        return x, k_pages, v_pages
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     return logits, k_pages, v_pages
