@@ -41,6 +41,7 @@ def _lib():
         lib.arena_stats.argtypes = [vp, ctypes.POINTER(u64),
                                     ctypes.POINTER(u64),
                                     ctypes.POINTER(u64)]
+        lib.arena_stats_ext.argtypes = [vp, ctypes.POINTER(u64)]
         lib.arena_base.restype = vp
         lib.arena_base.argtypes = [vp]
         lib.arena_detach.argtypes = [vp]
@@ -178,8 +179,12 @@ class Arena:
         return reclaimed, ids
 
     def stats(self) -> dict:
-        a, c, n = (ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64())
-        _lib().arena_stats(self._h, ctypes.byref(a), ctypes.byref(c),
-                           ctypes.byref(n))
-        return {"bytes_allocated": a.value, "heap_capacity": c.value,
-                "num_objects": n.value}
+        """Native counters maintained inside the C++ arena (reference
+        parity role: src/ray/stats/metric_defs.h — the native stats
+        source feeding the per-node metrics pipeline)."""
+        out = (ctypes.c_uint64 * 8)()
+        _lib().arena_stats_ext(self._h, out)
+        return {"bytes_allocated": out[0], "heap_capacity": out[1],
+                "num_objects": out[2], "allocs": out[3],
+                "alloc_fails": out[4], "frees": out[5],
+                "coalesces": out[6], "crash_sweeps": out[7]}

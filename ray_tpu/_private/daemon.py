@@ -1446,16 +1446,24 @@ class NodeDaemon:
             "local_leases_granted": self.local_leases_granted,
             "local_leases_spilled": self.local_leases_spilled,
             "lease_block_free": sum(self._lease_blocks.values()),
-            # allocated/capacity fraction of the shm arena: the memory
-            # signal data-executor backpressure keys on
-            "arena_pressure": self._arena_pressure_fraction(),
+            # one native snapshot (one arena-mutex acquisition) feeds
+            # both the pressure fraction and the flat arena_* counters
+            # that ride gossip into the /metrics node gauges — the
+            # native stats pipeline (reference role:
+            # src/ray/stats/metric_defs.h)
+            **self._arena_stat_block(),
         }
 
-    def _arena_pressure_fraction(self) -> float:
-        p = self.object_store.arena_pressure()
-        if not p or not p[1]:
-            return 0.0
-        return p[0] / p[1]
+    def _arena_stat_block(self) -> dict:
+        ns = self.object_store.native_stats()
+        cap = ns.get("heap_capacity", 0)
+        return {
+            "arena_pressure": (ns.get("bytes_allocated", 0) / cap
+                               if cap else 0.0),
+            **{f"arena_{k}": v for k, v in ns.items()
+               if k in ("allocs", "alloc_fails", "frees", "coalesces",
+                        "crash_sweeps")},
+        }
 
     async def rpc_node_stats(self) -> dict:
         return {"node_id": self.node_id, **self._stats()}

@@ -437,6 +437,17 @@ class NodeObjectStore:
         except Exception:
             return None
 
+    def native_stats(self) -> dict:
+        """Operation counters maintained INSIDE the C++ arena (allocs,
+        failures, coalesces, crash sweeps) — the native end of the
+        metrics pipeline. Empty without a native arena."""
+        if self.arena is None:
+            return {}
+        try:
+            return self.arena.stats()
+        except Exception:
+            return {}
+
     def _free_shm_copy(self, shm_name: str, entry: ShmStoreEntry) -> None:
         if shm_name.startswith("arena:"):
             _, arena_seg, oid = shm_name.split(":", 2)

@@ -187,6 +187,17 @@ class DashboardHead:
              "cumulative OOM kills"),
             ("arena_pressure", "ray_tpu_node_arena_pressure",
              "shm arena allocated/capacity"),
+            # native C++ arena operation counters
+            ("arena_allocs", "ray_tpu_node_arena_allocs",
+             "cumulative native arena allocations"),
+            ("arena_alloc_fails", "ray_tpu_node_arena_alloc_fails",
+             "native arena allocation failures (pressure signal)"),
+            ("arena_frees", "ray_tpu_node_arena_frees",
+             "cumulative native arena frees"),
+            ("arena_coalesces", "ray_tpu_node_arena_coalesces",
+             "native arena free-block coalesces"),
+            ("arena_crash_sweeps", "ray_tpu_node_arena_crash_sweeps",
+             "native arena crash-recovery sweeps"),
         )
         for field, metric, help_ in stats_fields:
             gauge(metric, help_, [

@@ -26,7 +26,10 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545055'41524541ULL;  // "RTPUAREA"
+// "RTPUAREB": bumped from ...AREA when the counter fields widened the
+// header — an old-layout segment must fail the magic check, not lock
+// garbage at the moved mutex offset.
+constexpr uint64_t kMagic = 0x52545055'41524542ULL;
 constexpr uint32_t kIdLen = 32;                      // hex object id
 constexpr uint64_t kAlign = 64;
 
@@ -59,6 +62,14 @@ struct Header {
   uint64_t bytes_allocated;
   uint64_t num_objects;
   uint64_t lru_clock;
+  // native operation counters (reference parity role: the C++ stats
+  // registry, src/ray/stats/metric_defs.h — these flow up through the
+  // daemon's gossip into the /metrics node gauges)
+  uint64_t n_allocs;
+  uint64_t n_alloc_fails;
+  uint64_t n_frees;
+  uint64_t n_coalesces;
+  uint64_t n_sweeps;
   pthread_mutex_t lock;
 };
 
@@ -131,6 +142,7 @@ BlockHeader* block_at(Handle* h, uint64_t off) {
 // block; (3) recompute bytes_allocated / num_objects from scratch.
 void recover_sweep(Handle* h) {
   Header* hd = h->header;
+  hd->n_sweeps++;
   uint64_t cap = hd->table_capacity;
   uint64_t heap_end = hd->heap_offset + hd->heap_size;
 
@@ -187,6 +199,7 @@ int64_t heap_alloc(Handle* h, uint64_t need) {
         BlockHeader* nxt = block_at(h, off + b->size);
         if (!nxt->free) break;
         b->size += nxt->size;
+        hd->n_coalesces++;
       }
       if (b->size >= total) {
         uint64_t remainder = b->size - total;
@@ -202,11 +215,13 @@ int64_t heap_alloc(Handle* h, uint64_t need) {
         }
         b->free = 0;
         hd->bytes_allocated += b->size;
+        hd->n_allocs++;
         return static_cast<int64_t>(off + sizeof(BlockHeader));
       }
     }
     off += b->size;
   }
+  hd->n_alloc_fails++;
   return -1;
 }
 
@@ -214,6 +229,7 @@ void heap_free(Handle* h, uint64_t data_off) {
   BlockHeader* b = block_at(h, data_off - sizeof(BlockHeader));
   if (!b->free) {
     h->header->bytes_allocated -= b->size;
+    h->header->n_frees++;
     b->free = 1;
   }
 }
@@ -422,6 +438,23 @@ void arena_stats(void* handle, uint64_t* allocated, uint64_t* capacity,
   *allocated = h->header->bytes_allocated;
   *capacity = h->header->heap_size;
   *num_objects = h->header->num_objects;
+}
+
+// Extended native counters: out must hold 8 uint64s —
+// {allocated, capacity, num_objects, allocs, alloc_fails, frees,
+//  coalesces, sweeps}.
+void arena_stats_ext(void* handle, uint64_t* out) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Header* hd = h->header;
+  out[0] = hd->bytes_allocated;
+  out[1] = hd->heap_size;
+  out[2] = hd->num_objects;
+  out[3] = hd->n_allocs;
+  out[4] = hd->n_alloc_fails;
+  out[5] = hd->n_frees;
+  out[6] = hd->n_coalesces;
+  out[7] = hd->n_sweeps;
 }
 
 void* arena_base(void* handle) {

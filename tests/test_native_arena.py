@@ -111,6 +111,31 @@ def test_stats_track_allocation(arena):
     assert after["bytes_allocated"] > before["bytes_allocated"]
 
 
+def test_native_operation_counters(arena):
+    """The C++ side maintains operation counters (native stats source
+    feeding the /metrics node gauges — reference role:
+    src/ray/stats/metric_defs.h)."""
+    before = arena.stats()
+    a_id, b_id = oid(40), oid(41)
+    for i in (a_id, b_id):
+        arena.create_buffer(i, 4096).release()
+        arena.seal(i)
+    arena.delete(a_id)
+    arena.delete(b_id)
+    # a fresh alloc after two adjacent frees exercises coalescing
+    arena.create_buffer(oid(42), 8192).release()
+    after = arena.stats()
+    assert after["allocs"] >= before["allocs"] + 3
+    assert after["frees"] >= before["frees"] + 2
+    # fresh per-test arena: a+b sit adjacent at the heap start, so the
+    # 8192 alloc MUST have merged their freed blocks
+    assert after["coalesces"] > before["coalesces"]
+    assert after["alloc_fails"] == before["alloc_fails"]
+    # an impossible allocation bumps the failure counter, not a crash
+    assert arena.create_buffer(oid(43), 1 << 40) is None
+    assert arena.stats()["alloc_fails"] > after["alloc_fails"]
+
+
 def test_runtime_integration_put_get_numpy():
     """Objects over the inline limit must travel through the arena and
     deserialize zero-copy."""

@@ -293,6 +293,9 @@ def test_metrics_plane_node_gauges_timeline_grafana(ray_start, tmp_path):
         assert "ray_tpu_node_workers{" in text
         assert "ray_tpu_node_arena_pressure{" in text
         assert 'ray_tpu_node_resource_total{node_id=' in text
+        # native C++ arena counters flow through gossip into the gauges
+        assert "ray_tpu_node_arena_allocs{" in text
+        assert "ray_tpu_node_arena_crash_sweeps{" in text
 
         tl = _json.loads(urllib.request.urlopen(
             f"{base}/api/timeline", timeout=30).read())
