@@ -18,7 +18,7 @@ from .dataset import Dataset, GroupedData, MaterializedDataset
 from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
                          range, read_binary_files, read_csv, read_datasource,
                          read_images, read_json, read_numpy, read_parquet,
-                         read_text)
+                         read_text, read_webdataset)
 
 __all__ = [
     "Dataset", "MaterializedDataset", "GroupedData", "Block",
@@ -26,4 +26,5 @@ __all__ = [
     "Std", "range", "from_items", "from_numpy", "from_arrow", "from_pandas",
     "read_parquet", "read_csv", "read_json", "read_text", "read_numpy",
     "read_binary_files", "read_datasource", "read_images",
+    "read_webdataset",
 ]

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import builtins
 import glob
+import io
 import json
 import os
 from typing import Any, Callable, Dict, Iterable, List, Optional
@@ -134,6 +135,73 @@ def read_binary_files(paths, **kw) -> Dataset:
 
 
 IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def _wds_decode(ext: str, raw: bytes) -> Any:
+    """Standard WebDataset field decoding by extension."""
+    if ext in ("txt", "text"):
+        return raw.decode()
+    if ext in ("cls", "id", "index"):
+        return int(raw.decode().strip())
+    if ext == "json":
+        return json.loads(raw.decode())
+    if ext == "npy":
+        return np.load(io.BytesIO(raw)).tolist()
+    if f".{ext.lower()}" in IMAGE_SUFFIXES:
+        from PIL import Image
+        # nested lists keep the H/W/C structure in Arrow (same choice
+        # as read_images)
+        return np.asarray(Image.open(io.BytesIO(raw))).tolist()
+    return raw
+
+
+def read_webdataset(paths, *, decode: bool = True) -> Dataset:
+    """WebDataset tar shards (reference parity: data/read_api.py
+    read_webdataset): one row per SAMPLE — files sharing a basename up
+    to the first dot ("0001.jpg" + "0001.cls" + "0001.json" form the
+    sample keyed "0001") — with one column per extension plus
+    "__key__". decode=True applies the standard field decoders
+    (txt->str, cls->int, json->dict, npy->array, images->HWC arrays);
+    decode=False keeps raw bytes. One read task per shard so the
+    streaming executor parallelizes across shards."""
+    import tarfile
+
+    def reader(f: str) -> Block:
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(f) as tf:
+            for m in tf:
+                if not m.isfile():
+                    continue
+                # sample key = full path up to the first dot of the
+                # BASENAME (webdataset semantics: "train/0001.jpg" and
+                # "val/0001.jpg" are distinct samples)
+                dirname, base = os.path.split(m.name)
+                stem, _, ext = base.partition(".")
+                key = os.path.join(dirname, stem) if dirname else stem
+                if key not in samples:
+                    samples[key] = {}
+                    order.append(key)
+                raw = tf.extractfile(m).read()
+                samples[key][ext] = (_wds_decode(ext, raw)
+                                     if decode else raw)
+        rows = [{"__key__": k, **samples[k]} for k in order]
+        if not rows:
+            return pa.table({"__key__": pa.array([], pa.string())})
+        # explicit pa.array per column: the generic tensor conversion
+        # in _to_table flattens nested lists (decoded images must stay
+        # list<list<list<uint8>>> — same choice as read_images). Column
+        # set is the UNION across samples (a field absent from the
+        # first sample must not vanish from the shard).
+        names: List[str] = []
+        for r in rows:
+            for name in r:
+                if name not in names:
+                    names.append(name)
+        return pa.table({name: pa.array([r.get(name) for r in rows])
+                         for name in names})
+
+    return _file_read(paths, ".tar", reader, "WebDataset")
 
 
 def read_images(paths, size=None, mode=None,

@@ -137,3 +137,69 @@ def test_read_images(tmp_path, ray_start):
 
     with pytest.raises(ValueError, match="no image files"):
         data.read_images(str(tmp_path / "notes.txt"))
+
+
+# --------------------------------------------------------- read_webdataset
+
+def test_read_webdataset(tmp_path, ray_start):
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    def add(tf, name, raw):
+        info = tarfile.TarInfo(name)
+        info.size = len(raw)
+        tf.addfile(info, io.BytesIO(raw))
+
+    # two shards x two samples each: jpg? use png (lossless) + cls +
+    # json + txt per sample
+    for shard in range(2):
+        with tarfile.open(tmp_path / f"shard-{shard}.tar", "w") as tf:
+            for i in range(2):
+                key = f"{shard}{i:03d}"
+                img = np.full((4, 5, 3), shard * 100 + i, np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(img).save(buf, format="PNG")
+                add(tf, f"{key}.png", buf.getvalue())
+                add(tf, f"{key}.cls", str(i).encode())
+                add(tf, f"{key}.json",
+                    ('{"shard": %d}' % shard).encode())
+                add(tf, f"{key}.txt", f"caption {key}".encode())
+
+    ds = data.read_webdataset(str(tmp_path))
+    rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert len(rows) == 4
+    assert [r["cls"] for r in rows] == [0, 1, 0, 1]
+    assert rows[0]["txt"] == "caption 0000"
+    assert rows[3]["json"]["shard"] == 1
+    img = np.asarray(rows[2]["png"], np.uint8)
+    assert img.shape == (4, 5, 3) and img[0, 0, 0] == 100
+
+    # raw mode keeps bytes
+    raw_rows = data.read_webdataset(
+        str(tmp_path / "shard-0.tar"), decode=False).take_all()
+    assert isinstance(raw_rows[0]["cls"], bytes)
+
+
+def test_read_webdataset_dir_keys_and_union_columns(tmp_path, ray_start):
+    import io
+    import tarfile
+
+    def add(tf, name, raw):
+        info = tarfile.TarInfo(name)
+        info.size = len(raw)
+        tf.addfile(info, io.BytesIO(raw))
+
+    with tarfile.open(tmp_path / "s.tar", "w") as tf:
+        # same basename under two dirs = two distinct samples
+        add(tf, "train/0001.cls", b"1")
+        add(tf, "val/0001.cls", b"2")
+        # .txt first appears on the SECOND sample: column must survive
+        add(tf, "val/0001.txt", b"late column")
+
+    rows = sorted(data.read_webdataset(str(tmp_path / "s.tar")).take_all(),
+                  key=lambda r: r["__key__"])
+    assert [r["__key__"] for r in rows] == ["train/0001", "val/0001"]
+    assert [r["cls"] for r in rows] == [1, 2]
+    assert rows[0]["txt"] is None and rows[1]["txt"] == "late column"
