@@ -134,11 +134,18 @@ def run_inner() -> None:
     if on_tpu:
         # ~915M params: large enough to fill the chip's MXU (head_dim 128,
         # 2048-wide matmuls) while params + adam state fit a 16 GiB HBM.
+        # Sweep knobs (defaults = the committed 0.5592-MFU config):
+        # RAY_TPU_BENCH_BATCH / _SEQ / _REMAT let a tunnel-up window be
+        # used for quick MFU sweeps without editing this file.
+        batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "4"))
+        seq = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
+        remat = os.environ.get("RAY_TPU_BENCH_REMAT", "nothing")
         cfg = llama.config(
             "tiny", vocab_size=32768, hidden=2048, n_layers=12, n_heads=16,
-            n_kv_heads=8, head_dim=128, ffn=8192, max_seq=2048,
-            attention_impl="pallas", remat_policy="nothing")
-        batch, seq, iters = 4, 2048, 10
+            n_kv_heads=8, head_dim=128, ffn=8192,
+            max_seq=max(seq, 2048),
+            attention_impl="pallas", remat_policy=remat)
+        iters = 10
     else:
         cfg = llama.config("debug")
         batch, seq, iters = 4, 256, 3

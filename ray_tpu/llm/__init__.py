@@ -50,7 +50,27 @@ def build_llm_deployment(llm_config: LLMConfig):
     dep_cfg.setdefault("max_ongoing_requests", 64)
     if llm_config.accelerator_type:
         opts = dict(dep_cfg.get("ray_actor_options") or {})
-        opts.setdefault("num_tpus", 1)
+        # chips follow the engine mesh: a tp x pp engine needs tp*pp
+        # chips on its replica (reference sizes vLLM worker placement
+        # the same way, vllm_models.py:123-139)
+        mesh = (llm_config.engine_kwargs or {}).get("mesh")
+        chips = 1
+        if mesh is not None:
+            sizes = (mesh if isinstance(mesh, dict)
+                     else {"tp": getattr(mesh, "tp", 1),
+                           "pp": getattr(mesh, "pp", 1)})
+            tp = sizes.get("tp", 1)
+            pp = sizes.get("pp", 1)
+            if tp == -1 or pp == -1:
+                # -1 resolves against VISIBLE devices inside the
+                # replica; here we must size the reservation itself, so
+                # wildcards would silently under-provision to 1 chip
+                raise ValueError(
+                    "give explicit tp/pp sizes in engine_kwargs.mesh "
+                    "when accelerator_type is set (wildcard -1 cannot "
+                    "size the replica's chip reservation)")
+            chips = max(1, tp * pp)
+        opts.setdefault("num_tpus", chips)
         dep_cfg["ray_actor_options"] = opts
     return serve.deployment(**dep_cfg)(LLMServerImpl).bind(
         llm_config.to_dict())

@@ -1,0 +1,86 @@
+"""joblib backend: run joblib/scikit-learn `Parallel` on the cluster.
+
+Reference parity: python/ray/util/joblib — `register_ray()` registers a
+joblib parallel backend so existing sklearn/joblib code scales out with
+only a `with joblib.parallel_backend("ray_tpu"):` wrapper. Like the
+reference, the backend rides the multiprocessing Pool shim
+(util/multiprocessing.py), so batches execute as cluster tasks.
+
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel()(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+__all__ = ["RayTpuBackend", "register_ray_tpu"]
+
+
+def _make_backend_class():
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    class RayTpuBackend(MultiprocessingBackend):
+        """MultiprocessingBackend whose pool is the cluster Pool shim.
+
+        The stock daemon/nesting guards in effective_n_jobs don't apply:
+        cluster workers are real processes owned by the node daemon, not
+        multiprocessing children, so nesting is safe."""
+
+        def effective_n_jobs(self, n_jobs: int) -> int:
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if n_jobs is None:
+                return 1
+            if n_jobs < 0:
+                # connect first: n_jobs=-1 on a fresh process must see
+                # the cluster's CPUs, not default to 1 job
+                import ray_tpu
+                total = 1
+                try:
+                    ray_tpu.init(ignore_reinit_error=True)
+                    total = int(
+                        ray_tpu.cluster_resources().get("CPU", 1))
+                except Exception:
+                    pass
+                n_jobs = max(total + 1 + n_jobs, 1)
+            return n_jobs
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **memmapping_pool_kwargs):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            from .multiprocessing import Pool
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+    return RayTpuBackend
+
+
+# resolved lazily so importing ray_tpu.util never hard-requires joblib;
+# module __getattr__ keeps `from ...joblib import RayTpuBackend` working
+# without exporting a None placeholder
+_backend_cls = None
+
+
+def _resolve_backend():
+    global _backend_cls
+    if _backend_cls is None:
+        _backend_cls = _make_backend_class()
+    return _backend_cls
+
+
+def __getattr__(name: str):
+    if name == "RayTpuBackend":
+        return _resolve_backend()
+    raise AttributeError(name)
+
+
+def register_ray_tpu() -> None:
+    """Register the "ray_tpu" joblib parallel backend."""
+    from joblib import register_parallel_backend
+    register_parallel_backend("ray_tpu", _resolve_backend())
+
+
+# reference-compatible alias (ray.util.joblib.register_ray)
+register_ray = register_ray_tpu

@@ -8,6 +8,7 @@ without rewrites.
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu
@@ -23,6 +24,7 @@ class AsyncResult:
     def get(self, timeout: Optional[float] = None):
         results = ray_tpu.get(self._refs, timeout=timeout)
         return results[0] if self._single else results
+
 
     def wait(self, timeout: Optional[float] = None) -> None:
         ray_tpu.wait(self._refs, num_returns=len(self._refs),
@@ -56,6 +58,47 @@ class Pool:
         self._initializer = initializer
         self._initargs = initargs
         self._closed = False
+        # ONE watcher thread per pool multiplexes every pending
+        # callback over ray_tpu.wait (a thread per apply_async would
+        # scale threads with in-flight joblib batches)
+        self._cb_lock = threading.Lock()
+        self._cb_pending: dict = {}     # ref -> (callback, error_cb)
+        self._cb_wake = threading.Event()
+        self._cb_thread: Optional[threading.Thread] = None
+
+    def _register_callback(self, ref, callback, error_callback) -> None:
+        with self._cb_lock:
+            self._cb_pending[ref] = (callback, error_callback)
+            if self._cb_thread is None:
+                self._cb_thread = threading.Thread(
+                    target=self._callback_loop, daemon=True,
+                    name="pool-callbacks")
+                self._cb_thread.start()
+        self._cb_wake.set()
+
+    def _callback_loop(self) -> None:
+        while True:
+            with self._cb_lock:
+                refs = list(self._cb_pending)
+            if not refs:
+                self._cb_wake.wait(timeout=1.0)
+                self._cb_wake.clear()
+                continue
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+            for ref in ready:
+                with self._cb_lock:
+                    cbs = self._cb_pending.pop(ref, None)
+                if cbs is None:
+                    continue
+                callback, error_callback = cbs
+                try:
+                    out = ray_tpu.get(ref)
+                except Exception as e:          # noqa: BLE001
+                    if error_callback is not None:
+                        error_callback(e)
+                    continue
+                if callback is not None:
+                    callback(out)
 
     def _remote_fn(self, func):
         init, initargs = self._initializer, self._initargs
@@ -125,14 +168,22 @@ class Pool:
         return self.apply_async(func, args, kwds).get()
 
     def apply_async(self, func, args: tuple = (),
-                    kwds: Optional[dict] = None) -> AsyncResult:
+                    kwds: Optional[dict] = None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None
+                    ) -> AsyncResult:
+        if self._closed:
+            raise ValueError("Pool not running")
         kwds = kwds or {}
 
         @ray_tpu.remote
         def call():
             return func(*args, **kwds)
 
-        return AsyncResult([call.remote()], single=True)
+        ref = call.remote()
+        if callback is not None or error_callback is not None:
+            self._register_callback(ref, callback, error_callback)
+        return AsyncResult([ref], single=True)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -381,3 +381,18 @@ def test_data_llm_batch_lora_column(ray_start):
     rows = proc(ds).take_all()
     assert len(rows) == 2
     assert rows[0]["generated_tokens"] != rows[1]["generated_tokens"]
+
+
+def test_deployment_chips_follow_engine_mesh():
+    """accelerator_type replicas request tp*pp chips (the reference
+    sizes vLLM worker placement the same way, vllm_models.py:123-139)."""
+    from ray_tpu.llm import LLMConfig, build_llm_deployment
+
+    app = build_llm_deployment(LLMConfig(
+        model_id="m", accelerator_type="TPU-V5E",
+        engine_kwargs={"mesh": {"tp": 2, "pp": 2, "fsdp": 1}}))
+    assert app._deployment.config.ray_actor_options["num_tpus"] == 4
+
+    app1 = build_llm_deployment(LLMConfig(
+        model_id="m2", accelerator_type="TPU-V5E"))
+    assert app1._deployment.config.ray_actor_options["num_tpus"] == 1

@@ -383,3 +383,54 @@ def test_summarize_tasks_duration_stats(ray_start):
     dur = group["duration"]
     assert dur and dur["count"] >= 3
     assert dur["mean_s"] >= 0.03, dur
+
+
+def test_joblib_backend(ray_start):
+    """sklearn/joblib Parallel over the cluster (reference parity:
+    ray.util.joblib register_ray)."""
+    import joblib
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+
+    def work(i):
+        import os
+        return i * i, os.getpid()
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = Parallel()(delayed(work)(i) for i in range(20))
+    vals = [v for v, _ in out]
+    pids = {p for _, p in out}
+    assert vals == [i * i for i in range(20)]
+    # ran in cluster workers, not this process
+    import os as _os
+    assert _os.getpid() not in pids
+
+
+def test_pool_apply_async_callbacks(ray_start):
+    """std multiprocessing.Pool callback semantics on the shim."""
+    import threading
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    done = threading.Event()
+    got = []
+    with Pool(processes=2) as p:
+        p.apply_async(lambda: 21 * 2,
+                      callback=lambda r: (got.append(r), done.set()))
+        assert done.wait(30)
+    assert got == [42]
+
+    errs = []
+    edone = threading.Event()
+
+    def boom():
+        raise RuntimeError("nope")
+
+    with Pool(processes=2) as p:
+        p.apply_async(boom,
+                      error_callback=lambda e: (errs.append(e),
+                                                edone.set()))
+        assert edone.wait(30)
+    assert errs and "nope" in str(errs[0])
