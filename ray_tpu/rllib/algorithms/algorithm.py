@@ -33,6 +33,9 @@ class AlgorithmConfig:
         self.num_env_runners = 0
         self.num_envs_per_env_runner = 8
         self.rollout_fragment_length = 128
+        #: None | "mean_std" — running obs normalization inside the
+        #: compiled rollout (reference: connectors mean_std_filter)
+        self.observation_filter: Optional[str] = None
         # training
         self.lr = 3e-4
         self.gamma = 0.99
@@ -53,7 +56,8 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
+                    rollout_fragment_length: Optional[int] = None,
+                    observation_filter: Optional[str] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -61,6 +65,8 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if observation_filter is not None:
+            self.observation_filter = observation_filter
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -149,7 +155,8 @@ class Algorithm(Trainable):
             cfg.env, num_env_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_env_runner,
             rollout_length=cfg.rollout_fragment_length, seed=cfg.seed,
-            module_class=cfg.module_class, model_config=cfg.model_config)
+            module_class=cfg.module_class, model_config=cfg.model_config,
+            obs_filter=cfg.observation_filter)
         cls = type(self)
         self.learner_group = LearnerGroup(
             lambda: cls.build_learner(spec, cfg),
@@ -171,11 +178,15 @@ class Algorithm(Trainable):
 
     def save_checkpoint(self) -> Any:
         return {"learner": self.learner_group.get_state(),
-                "lifetime_env_steps": self._lifetime_env_steps}
+                "lifetime_env_steps": self._lifetime_env_steps,
+                # a restored policy must see obs normalized by the
+                # stats its weights were trained against
+                "obs_filter": self.env_runner_group.get_filter_state()}
 
     def load_checkpoint(self, state: Any) -> None:
         self.learner_group.set_state(state["learner"])
         self._lifetime_env_steps = state.get("lifetime_env_steps", 0)
+        self.env_runner_group.set_filter_state(state.get("obs_filter"))
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     def cleanup(self) -> None:

@@ -87,6 +87,11 @@ class MultiAgentPPO(Algorithm):
                 module_classes[pid] = pcfg.module_class
             if pcfg.model_config:
                 model_configs[pid] = pcfg.model_config
+        if getattr(cfg, "observation_filter", None):
+            raise ValueError(
+                "observation_filter is not supported by the multi-agent "
+                "env runner (per-agent obs spaces would each need their "
+                "own running stats); unset it for MultiAgentPPO")
         self.env_runner_group = MultiAgentEnvRunnerGroup(
             cfg.env, mapping_fn, num_env_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_env_runner,

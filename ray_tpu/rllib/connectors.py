@@ -5,7 +5,12 @@ rllib/connectors/{env_to_module,module_to_env,learner}/. A connector is a
 callable batch transform; pipelines compose them. The compiled rollout
 (env_runner.py) fuses the env/module connectors' hot work into XLA, so the
 default pipelines here carry the learner-side transforms: flatten
-time×env, GAE, advantage normalization.
+time×env, GAE, advantage normalization. The env→module mean-std
+observation filter (reference: env_to_module/mean_std_filter.py) also
+lives in the compiled rollout — `AlgorithmConfig.env_runners(
+observation_filter="mean_std")` normalizes obs in-program with running
+Welford stats merged host-side and synchronized across remote runners
+on every weight sync.
 """
 
 from __future__ import annotations

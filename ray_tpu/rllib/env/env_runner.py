@@ -20,6 +20,23 @@ from .jax_env import JaxEnv, make_env
 from ..core.rl_module import RLModule, build_module
 
 
+def merge_moments(a, b):
+    """Chan parallel-Welford combine of two (count, mean, M2) states —
+    the ONE implementation shared by the runner's per-batch merge and
+    the group's cross-runner merge (numerically delicate; keep single)."""
+    ca, ma, sa = a
+    cb, mb, sb = b
+    if cb <= 0:
+        return a
+    if ca <= 0:
+        return b
+    delta = mb - ma
+    tot = ca + cb
+    mean = ma + delta * (cb / tot)
+    m2 = sa + sb + delta * delta * (ca * cb / tot)
+    return (tot, mean, m2)
+
+
 class SingleAgentEnvRunner:
     """Owns a vectorized env + module params; sample() returns a batch of
     shape [T, B, ...] plus episode stats. Runs as a plain object in-driver
@@ -27,7 +44,8 @@ class SingleAgentEnvRunner:
 
     def __init__(self, env, num_envs: int = 8, rollout_length: int = 128,
                  seed: int = 0, module_class: Optional[type] = None,
-                 model_config: Optional[Dict[str, Any]] = None):
+                 model_config: Optional[Dict[str, Any]] = None,
+                 obs_filter: Optional[str] = None):
         self.env: JaxEnv = make_env(env)
         self.num_envs = num_envs
         self.rollout_length = rollout_length
@@ -38,19 +56,42 @@ class SingleAgentEnvRunner:
         self.params = self.module.init(init_key)
         self._env_state, self._obs = jax.vmap(self.env.reset)(
             jax.random.split(reset_key, num_envs))
+        # env->module mean-std observation filter (reference parity:
+        # rllib/connectors/env_to_module/mean_std_filter.py). The
+        # normalization runs INSIDE the compiled rollout ((obs-mean)/std
+        # clipped to ±10, applied before the policy and recorded as the
+        # batch's obs); raw-obs sum/sumsq accumulate in-scan (no [T,B]
+        # raw transfer) and fold into the running Welford state
+        # host-side after each sample(). A DELTA buffer accumulates in
+        # parallel so the group's cross-runner sync merges only what is
+        # new since the last sync — re-merging full states would
+        # double-count history and freeze the stats.
+        if obs_filter not in (None, "mean_std"):
+            raise ValueError(f"unknown obs_filter {obs_filter!r}")
+        self.obs_filter = obs_filter
+        if obs_filter:
+            shape = tuple(np.asarray(self._obs).shape[1:])
+            self._filt_state = (0.0, np.zeros(shape, np.float64),
+                                np.zeros(shape, np.float64))
+            self._filt_delta = (0.0, np.zeros(shape, np.float64),
+                                np.zeros(shape, np.float64))
         self._sample_jit = jax.jit(self._build_sample())
 
     # -- compiled rollout ---------------------------------------------------
     def _build_sample(self):
         env, module = self.env, self.module
         B, T = self.num_envs, self.rollout_length
+        use_filter = self.obs_filter is not None
 
         def one_step(carry, step_key):
-            env_state, obs, ep_ret, ep_len, params = carry
+            (env_state, obs, ep_ret, ep_len, params,
+             fmean, fstd, fsum_in, fsq_in) = carry
             act_key, step_keys, reset_keys = (
                 step_key[0], step_key[1], step_key[2])
+            fobs = (jnp.clip((obs - fmean) / fstd, -10.0, 10.0)
+                    if use_filter else obs)
             action, logp, vf = module.forward_exploration(
-                params, obs, act_key)
+                params, fobs, act_key)
             next_state, next_obs, reward, done = jax.vmap(env.step)(
                 env_state, action, jax.random.split(step_keys, B))
             ep_ret = ep_ret + reward
@@ -62,39 +103,107 @@ class SingleAgentEnvRunner:
                 jnp.reshape(done, (B,) + (1,) * (a.ndim - 1)), a, b)
             next_state = jax.tree_util.tree_map(sel, reset_state, next_state)
             next_obs = sel(reset_obs, next_obs)
-            out = dict(obs=obs, actions=action, logp=logp, vf=vf,
+            out = dict(obs=fobs, actions=action, logp=logp, vf=vf,
                        rewards=reward, dones=done,
                        finished_return=jnp.where(done, ep_ret, 0.0),
                        finished_len=jnp.where(done, ep_len, 0))
             ep_ret = jnp.where(done, 0.0, ep_ret)
             ep_len = jnp.where(done, 0, ep_len)
-            return (next_state, next_obs, ep_ret, ep_len, params), out
+            if use_filter:
+                # raw-obs moments accumulate in the carry: only two
+                # obs-shaped arrays leave the device, not [T,B,obs]
+                fsum = fsum_in + obs.sum(axis=0)
+                fsq = fsq_in + (obs * obs).sum(axis=0)
+            else:
+                fsum, fsq = fsum_in, fsq_in
+            return (next_state, next_obs, ep_ret, ep_len, params,
+                    fmean, fstd, fsum, fsq), out
 
-        def sample(params, env_state, obs, ep_ret, ep_len, key):
+        def sample(params, env_state, obs, ep_ret, ep_len, key,
+                   fmean, fstd):
             key, sub = jax.random.split(key)
             step_keys = jax.random.split(sub, T * 3).reshape(T, 3, 2)
+            zeros = jnp.zeros(obs.shape[1:], jnp.float32)
             carry, batch = jax.lax.scan(
-                one_step, (env_state, obs, ep_ret, ep_len, params), step_keys)
-            env_state, obs, ep_ret, ep_len, _ = carry
-            final_out = module.forward_train(params, obs)
+                one_step, (env_state, obs, ep_ret, ep_len, params,
+                           fmean, fstd, zeros, zeros), step_keys)
+            env_state, obs, ep_ret, ep_len = carry[:4]
+            batch["filt_sum"], batch["filt_sumsq"] = carry[7], carry[8]
+            ffinal = (jnp.clip((obs - fmean) / fstd, -10.0, 10.0)
+                      if use_filter else obs)
+            final_out = module.forward_train(params, ffinal)
             batch["final_vf"] = final_out["vf"]
             # the observation after the last step — off-policy algorithms
-            # reconstruct next_obs[t] as obs[t+1] (+ this for t = T-1)
-            batch["final_obs"] = obs
+            # reconstruct next_obs[t] as obs[t+1] (+ this for t = T-1);
+            # filtered like every obs the learner sees
+            batch["final_obs"] = ffinal
             return env_state, obs, ep_ret, ep_len, key, batch
 
         return sample
 
     # -- public API ---------------------------------------------------------
+    def _filter_std(self) -> np.ndarray:
+        count, _, m2 = self._filt_state
+        if count < 1.0:
+            # no data yet: identity scaling, NOT std->0 (which would
+            # saturate the whole first rollout to ±10 sign patterns)
+            return np.ones(m2.shape, np.float32)
+        return np.sqrt(np.maximum(m2 / count, 1e-12)).astype(np.float32)
+
+    def _fold_filter_batch(self, fsum: np.ndarray, fsq: np.ndarray,
+                           n: int) -> None:
+        """Fold one rollout's in-scan (sum, sumsq) into BOTH the running
+        state and the since-last-sync delta buffer."""
+        fsum = fsum.astype(np.float64)
+        mean = fsum / n
+        m2 = np.maximum(fsq.astype(np.float64) - n * mean * mean, 0.0)
+        batch = (float(n), mean, m2)
+        self._filt_state = merge_moments(self._filt_state, batch)
+        self._filt_delta = merge_moments(self._filt_delta, batch)
+
+    def get_filter_state(self):
+        if not self.obs_filter:
+            return None
+        c, m, s = self._filt_state
+        return (c, m.copy(), s.copy())
+
+    def set_filter_state(self, state) -> None:
+        if not self.obs_filter or state is None:
+            return
+        self._filt_state = (float(state[0]),
+                            np.asarray(state[1], np.float64).copy(),
+                            np.asarray(state[2], np.float64).copy())
+
+    def get_filter_delta(self):
+        """Moments accumulated since the last call — the group's sync
+        merges ONLY deltas, so history is never double-counted."""
+        if not self.obs_filter:
+            return None
+        delta, self._filt_delta = self._filt_delta, (
+            0.0, np.zeros_like(self._filt_delta[1]),
+            np.zeros_like(self._filt_delta[2]))
+        return delta
+
     def sample(self) -> Dict[str, Any]:
         if not hasattr(self, "_ep_ret"):
             self._ep_ret = jnp.zeros(self.num_envs)
             self._ep_len = jnp.zeros(self.num_envs, jnp.int32)
+        if self.obs_filter:
+            fmean = jnp.asarray(self._filt_state[1], jnp.float32)
+            fstd = jnp.asarray(self._filter_std())
+        else:
+            fmean, fstd = jnp.float32(0.0), jnp.float32(1.0)
         (self._env_state, self._obs, self._ep_ret, self._ep_len,
          self._key, batch) = self._sample_jit(
             self.params, self._env_state, self._obs, self._ep_ret,
-            self._ep_len, self._key)
+            self._ep_len, self._key, fmean, fstd)
         batch = jax.device_get(batch)
+        fsum = batch.pop("filt_sum")
+        fsq = batch.pop("filt_sumsq")
+        if self.obs_filter:
+            self._fold_filter_batch(
+                np.asarray(fsum), np.asarray(fsq),
+                self.num_envs * self.rollout_length)
         done_mask = batch.pop("dones")
         fin_ret = batch.pop("finished_return")
         fin_len = batch.pop("finished_len")

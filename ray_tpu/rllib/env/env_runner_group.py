@@ -43,13 +43,16 @@ class EnvRunnerGroup:
                  int = 8, rollout_length: int = 128, seed: int = 0,
                  module_class: Optional[type] = None,
                  model_config: Optional[Dict[str, Any]] = None,
-                 runner_resources: Optional[Dict[str, float]] = None):
+                 runner_resources: Optional[Dict[str, float]] = None,
+                 obs_filter: Optional[str] = None):
         self.num_env_runners = num_env_runners
+        self.obs_filter = obs_filter
+        self._filter_global = None      # merged cross-runner state
         self._inflight: Dict[Any, Any] = {}   # sample ref -> runner
         if num_env_runners == 0:
             self._local = SingleAgentEnvRunner(
                 env, num_envs_per_runner, rollout_length, seed,
-                module_class, model_config)
+                module_class, model_config, obs_filter=obs_filter)
             self._remote = []
         else:
             self._local = None
@@ -58,7 +61,7 @@ class EnvRunnerGroup:
             self._remote = [
                 remote_cls.remote(env, num_envs_per_runner, rollout_length,
                                   seed + 1000 * (i + 1), module_class,
-                                  model_config)
+                                  model_config, obs_filter=obs_filter)
                 for i in range(num_env_runners)]
             ray_tpu.get([r.ping.remote() for r in self._remote])
 
@@ -85,6 +88,18 @@ class EnvRunnerGroup:
         result = ray_tpu.get(ready[0])
         ref = ray_tpu.put(weights)
         runner.set_weights.remote(ref)
+        if self.obs_filter:
+            # per-runner filter sync on the async cadence: fold THIS
+            # runner's delta into the global state and hand the merged
+            # state back before re-arming (the sync sync_weights path
+            # never runs under IMPALA/APPO)
+            from .env_runner import merge_moments
+            d = ray_tpu.get(runner.get_filter_delta.remote())
+            if d is not None:
+                self._filter_global = (
+                    d if self._filter_global is None
+                    else merge_moments(self._filter_global, d))
+                runner.set_filter_state.remote(self._filter_global)
         self._inflight[runner.sample.remote()] = runner
         return result
 
@@ -95,11 +110,57 @@ class EnvRunnerGroup:
             # one put, fanned out by reference — the object store dedups
             ref = ray_tpu.put(params)
             ray_tpu.get([r.set_weights.remote(ref) for r in self._remote])
+            # filter state rides the weight-sync cadence (reference
+            # parity: connector states synchronize with weights)
+            self.sync_filters()
 
     def get_weights(self):
         if self._local is not None:
             return self._local.get_weights()
         return ray_tpu.get(self._remote[0].get_weights.remote())
+
+    def sync_filters(self) -> None:
+        """Merge every runner's since-last-sync filter DELTA into the
+        group-held global state and push that back (reference parity:
+        the filter-synchronization step of RLlib's connector pipelines).
+        Deltas, not full states: re-merging full states would count the
+        shared history once per runner per sync, growing the count
+        ~R^k and freezing the stats."""
+        if not self.obs_filter or self._local is not None:
+            return
+        deltas = [d for d in ray_tpu.get(
+            [r.get_filter_delta.remote() for r in self._remote])
+            if d is not None]
+        from .env_runner import merge_moments
+        for d in deltas:
+            self._filter_global = (
+                d if self._filter_global is None
+                else merge_moments(self._filter_global, d))
+        if self._filter_global is not None:
+            ray_tpu.get([r.set_filter_state.remote(self._filter_global)
+                         for r in self._remote])
+
+    def get_filter_state(self):
+        """Checkpointable filter state (a restored policy must see obs
+        normalized by the stats it was trained against)."""
+        if not self.obs_filter:
+            return None
+        if self._local is not None:
+            return self._local.get_filter_state()
+        self.sync_filters()
+        return self._filter_global
+
+    def set_filter_state(self, state) -> None:
+        if not self.obs_filter or state is None:
+            return
+        if self._local is not None:
+            self._local.set_filter_state(state)
+            return
+        self._filter_global = state
+        ray_tpu.get([r.set_filter_state.remote(state)
+                     for r in self._remote])
+        # runner deltas predate the restored state: drop them
+        ray_tpu.get([r.get_filter_delta.remote() for r in self._remote])
 
     @property
     def module(self):
