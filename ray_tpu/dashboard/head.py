@@ -154,10 +154,68 @@ class DashboardHead:
         from aiohttp import web
 
         from ..util import metrics as metrics_api
-        text = await self._in_thread(metrics_api.export_prometheus)
-        node_text = await self._in_thread(self._node_metrics_text)
-        return web.Response(text=text + node_text,
+        text, node_text, serve_text = await asyncio.gather(
+            self._in_thread(metrics_api.export_prometheus),
+            self._in_thread(self._node_metrics_text),
+            self._in_thread(self._serve_metrics_text))
+        return web.Response(text=text + node_text + serve_text,
                             content_type="text/plain")
+
+    @staticmethod
+    def _serve_metrics_text() -> str:
+        """Per-deployment serve gauges from the controller's aggregated
+        replica polls (reference parity role: serve's autoscaling/
+        request metrics surfaced to Prometheus). Empty when serve is
+        not running."""
+        try:
+            from .. import serve
+            status = serve.status()
+        except Exception:
+            return ""
+        lines: List[str] = []
+
+        def gauge(name, help_):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+
+        def esc(v: str) -> str:
+            # Prometheus label-value escaping: an unescaped quote or
+            # newline in an app name would corrupt the whole exposition
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        rows = []
+        for app_name, app in status.get("applications", {}).items():
+            for dep, info in app.get("deployments", {}).items():
+                m = info.get("metrics", {})
+                running = sum(1 for s in info.get(
+                    "replica_states", {}).values() if s == "RUNNING")
+                rows.append((esc(app_name), esc(dep), running,
+                             info.get("target", 0), m))
+        if not rows:
+            return ""
+        for field, metric, help_ in (
+                (None, "ray_tpu_serve_replicas_running",
+                 "running replicas per deployment"),
+                (None, "ray_tpu_serve_replicas_target",
+                 "target replicas per deployment"),
+                ("ongoing", "ray_tpu_serve_ongoing_requests",
+                 "in-flight requests per deployment"),
+                ("qps_10s", "ray_tpu_serve_qps",
+                 "requests/s over the last 10s per deployment"),
+                ("total_requests", "ray_tpu_serve_total_requests",
+                 "cumulative requests per deployment")):
+            gauge(metric, help_)
+            for app_name, dep, running, target, m in rows:
+                if field is None:
+                    val = (running
+                           if metric.endswith("running") else target)
+                else:
+                    val = m.get(field, 0)
+                lines.append(
+                    f'{metric}{{app="{app_name}",deployment="{dep}"}} '
+                    f'{val}')
+        return "\n".join(lines) + "\n"
 
     @staticmethod
     def _node_metrics_text() -> str:

@@ -38,7 +38,19 @@ class _ReplicaInfo:
         self.state = ReplicaState.STARTING
         self.last_health = time.time()
         self.ongoing = 0.0
+        self.qps = 0.0
+        self.total_requests = 0.0
         self.health_task: Optional[asyncio.Task] = None
+
+
+def _retire_replica(info: "_DeploymentInfo", replica_id: str):
+    """Remove a replica, folding its request count into the
+    deployment's retired total (cumulative metrics must not drop when
+    replicas churn)."""
+    rep = info.replicas.pop(replica_id, None)
+    if rep is not None:
+        info.retired_requests += getattr(rep, "total_requests", 0.0)
+    return rep
 
 
 class _DeploymentInfo:
@@ -57,6 +69,10 @@ class _DeploymentInfo:
         # consecutive replica-start failures → exponential respawn backoff
         self.start_failures = 0
         self.next_start_at = 0.0
+        # requests served by replicas that have since been removed
+        # (downscale/health-kill/update) — keeps the deployment's
+        # total_requests metric genuinely cumulative
+        self.retired_requests = 0.0
         self.apply_spec(spec)
 
     def apply_spec(self, spec: Dict[str, Any]) -> None:
@@ -227,12 +243,25 @@ class ServeController:
                     deployment_key(app_name, name))
                 if info is None:
                     continue
+                running = [r for r in info.replicas.values()
+                           if r.state == ReplicaState.RUNNING]
                 deps[name] = {
                     "status": info.status,
                     "replica_states": {
                         rid: r.state for rid, r in info.replicas.items()},
                     "target": info.target_count(),
                     "version": info.version,
+                    # request metrics aggregated from the controller's
+                    # replica polls (powers serve gauges on /metrics)
+                    "metrics": {
+                        "ongoing": sum(r.ongoing for r in running),
+                        "qps_10s": sum(r.qps for r in running),
+                        # cumulative: live replicas (any state) plus
+                        # everything retired replicas ever served
+                        "total_requests": info.retired_requests + sum(
+                            r.total_requests
+                            for r in info.replicas.values()),
+                    },
                 }
             out["applications"][app_name] = {
                 "status": app["status"],
@@ -329,7 +358,7 @@ class ServeController:
         except Exception as e:
             logger.warning("replica %s failed to start: %r",
                            rep.replica_id, e)
-            info.replicas.pop(rep.replica_id, None)
+            _retire_replica(info, rep.replica_id)
             await self._kill(rep.handle)
             info.status = DeploymentStatus.UNHEALTHY
             info.start_failures += 1
@@ -357,7 +386,7 @@ class ServeController:
             except Exception:
                 pass
             await self._kill(rep.handle)
-            info.replicas.pop(rep.replica_id, None)
+            _retire_replica(info, rep.replica_id)
 
         asyncio.create_task(_drain_and_kill())
 
@@ -382,11 +411,13 @@ class ServeController:
                     self._as_coro(rep.handle.metrics.remote()),
                     timeout=info.config.health_check_timeout_s)
                 rep.ongoing = float(metrics.get("ongoing", 0))
+                rep.qps = float(metrics.get("qps_10s", 0.0))
+                rep.total_requests = float(metrics.get("total", 0))
                 rep.last_health = now
             except Exception as e:
                 logger.warning("replica %s failed health check: %r",
                                rep.replica_id, e)
-                info.replicas.pop(rep.replica_id, None)
+                _retire_replica(info, rep.replica_id)
                 await self._kill(rep.handle)
                 self._bump(info)
 

@@ -285,3 +285,43 @@ def test_delete_application(serve_cluster):
     assert "todelete" in serve.status()["applications"]
     serve.delete("todelete")
     assert "todelete" not in serve.status()["applications"]
+
+
+def test_serve_metrics_on_dashboard(ray_start):
+    """Per-deployment request gauges reach /metrics (controller polls
+    replica metrics; dashboard surfaces them)."""
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.dashboard import start_dashboard
+
+    @serve.deployment(name="MetricsApp")
+    class MetricsApp:
+        def __call__(self):
+            return "ok"
+
+    serve.run(MetricsApp.bind(), name="mx", _start_http=False)
+    handle = serve.get_app_handle("mx")
+    for _ in range(5):
+        assert handle.remote().result(timeout_s=30) == "ok"
+
+    dash = start_dashboard(port=0)
+    deadline = time.time() + 30
+    text = ""
+    while time.time() < deadline:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}/metrics",
+            timeout=15).read().decode()
+        if ('ray_tpu_serve_total_requests{app="mx",'
+                'deployment="MetricsApp"}' in text
+                and "ray_tpu_serve_replicas_running" in text):
+            row = [l for l in text.splitlines()
+                   if l.startswith("ray_tpu_serve_total_requests{")]
+            if row and float(row[0].rsplit(" ", 1)[1]) >= 5:
+                break
+        time.sleep(1.0)
+    assert 'ray_tpu_serve_replicas_running{app="mx"' in text
+    row = [l for l in text.splitlines()
+           if l.startswith("ray_tpu_serve_total_requests{")]
+    assert row and float(row[0].rsplit(" ", 1)[1]) >= 5, row
+    serve.shutdown()
