@@ -36,6 +36,9 @@ class AlgorithmConfig:
         #: None | "mean_std" — running obs normalization inside the
         #: compiled rollout (reference: connectors mean_std_filter)
         self.observation_filter: Optional[str] = None
+        #: frames concatenated feature-wise for the module (reference:
+        #: connectors frame stacking); 1 = off
+        self.framestack: int = 1
         # training
         self.lr = 3e-4
         self.gamma = 0.99
@@ -57,7 +60,8 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
-                    observation_filter: Optional[str] = None
+                    observation_filter: Optional[str] = None,
+                    framestack: Optional[int] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -67,6 +71,8 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if observation_filter is not None:
             self.observation_filter = observation_filter
+        if framestack is not None:
+            self.framestack = framestack
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -150,13 +156,16 @@ class Algorithm(Trainable):
         cfg = self._config
         if cfg.env is None:
             raise ValueError("no environment configured")
-        spec = make_env(cfg.env).spec
+        from ..env.jax_env import stacked_spec
+        # the learner's module must match the runner's stacked width
+        spec = stacked_spec(make_env(cfg.env).spec, cfg.framestack)
         self.env_runner_group = EnvRunnerGroup(
             cfg.env, num_env_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_env_runner,
             rollout_length=cfg.rollout_fragment_length, seed=cfg.seed,
             module_class=cfg.module_class, model_config=cfg.model_config,
-            obs_filter=cfg.observation_filter)
+            obs_filter=cfg.observation_filter,
+            framestack=getattr(cfg, "framestack", 1))
         cls = type(self)
         self.learner_group = LearnerGroup(
             lambda: cls.build_learner(spec, cfg),

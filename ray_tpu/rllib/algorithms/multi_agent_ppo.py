@@ -92,6 +92,10 @@ class MultiAgentPPO(Algorithm):
                 "observation_filter is not supported by the multi-agent "
                 "env runner (per-agent obs spaces would each need their "
                 "own running stats); unset it for MultiAgentPPO")
+        if getattr(cfg, "framestack", 1) > 1:
+            raise ValueError(
+                "framestack is not supported by the multi-agent env "
+                "runner; unset it for MultiAgentPPO")
         self.env_runner_group = MultiAgentEnvRunnerGroup(
             cfg.env, mapping_fn, num_env_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_env_runner,

@@ -45,17 +45,31 @@ class SingleAgentEnvRunner:
     def __init__(self, env, num_envs: int = 8, rollout_length: int = 128,
                  seed: int = 0, module_class: Optional[type] = None,
                  model_config: Optional[Dict[str, Any]] = None,
-                 obs_filter: Optional[str] = None):
+                 obs_filter: Optional[str] = None,
+                 framestack: int = 1):
         self.env: JaxEnv = make_env(env)
         self.num_envs = num_envs
         self.rollout_length = rollout_length
+        # env->module frame stacking (reference parity: rllib/connectors
+        # env_to_module frame-stacking): the module sees the last N
+        # frames concatenated feature-wise; the rolling buffer lives in
+        # the compiled rollout's carry and refills with the reset obs
+        # when an episode ends.
+        self.framestack = int(framestack)
+        if self.framestack < 1:
+            raise ValueError("framestack must be >= 1")
+        from .jax_env import stacked_spec
         self.module: RLModule = build_module(
-            self.env.spec, module_class, model_config)
+            stacked_spec(self.env.spec, self.framestack),
+            module_class, model_config)
         self._key = jax.random.PRNGKey(seed)
         self._key, init_key, reset_key = jax.random.split(self._key, 3)
         self.params = self.module.init(init_key)
         self._env_state, self._obs = jax.vmap(self.env.reset)(
             jax.random.split(reset_key, num_envs))
+        if self.framestack > 1:
+            self._stack = jnp.repeat(self._obs[:, None],
+                                     self.framestack, axis=1)
         # env->module mean-std observation filter (reference parity:
         # rllib/connectors/env_to_module/mean_std_filter.py). The
         # normalization runs INSIDE the compiled rollout ((obs-mean)/std
@@ -82,16 +96,25 @@ class SingleAgentEnvRunner:
         env, module = self.env, self.module
         B, T = self.num_envs, self.rollout_length
         use_filter = self.obs_filter is not None
+        N = self.framestack
+        use_stack = N > 1
+
+        def filt(x, fmean, fstd):
+            # broadcasts over a (B, D) obs or a (B, N, D) stack
+            return (jnp.clip((x - fmean) / fstd, -10.0, 10.0)
+                    if use_filter else x)
 
         def one_step(carry, step_key):
-            (env_state, obs, ep_ret, ep_len, params,
+            (env_state, obs, stack, ep_ret, ep_len, params,
              fmean, fstd, fsum_in, fsq_in) = carry
             act_key, step_keys, reset_keys = (
                 step_key[0], step_key[1], step_key[2])
-            fobs = (jnp.clip((obs - fmean) / fstd, -10.0, 10.0)
-                    if use_filter else obs)
+            if use_stack:
+                net_in = filt(stack, fmean, fstd).reshape(B, -1)
+            else:
+                net_in = filt(obs, fmean, fstd)
             action, logp, vf = module.forward_exploration(
-                params, fobs, act_key)
+                params, net_in, act_key)
             next_state, next_obs, reward, done = jax.vmap(env.step)(
                 env_state, action, jax.random.split(step_keys, B))
             ep_ret = ep_ret + reward
@@ -103,7 +126,16 @@ class SingleAgentEnvRunner:
                 jnp.reshape(done, (B,) + (1,) * (a.ndim - 1)), a, b)
             next_state = jax.tree_util.tree_map(sel, reset_state, next_state)
             next_obs = sel(reset_obs, next_obs)
-            out = dict(obs=fobs, actions=action, logp=logp, vf=vf,
+            if use_stack:
+                # slide the window; a finished episode refills the
+                # whole buffer with its fresh reset obs
+                rolled = jnp.concatenate(
+                    [stack[:, 1:], next_obs[:, None]], axis=1)
+                next_stack = sel(
+                    jnp.repeat(next_obs[:, None], N, axis=1), rolled)
+            else:
+                next_stack = stack
+            out = dict(obs=net_in, actions=action, logp=logp, vf=vf,
                        rewards=reward, dones=done,
                        finished_return=jnp.where(done, ep_ret, 0.0),
                        finished_len=jnp.where(done, ep_len, 0))
@@ -116,28 +148,30 @@ class SingleAgentEnvRunner:
                 fsq = fsq_in + (obs * obs).sum(axis=0)
             else:
                 fsum, fsq = fsum_in, fsq_in
-            return (next_state, next_obs, ep_ret, ep_len, params,
-                    fmean, fstd, fsum, fsq), out
+            return (next_state, next_obs, next_stack, ep_ret, ep_len,
+                    params, fmean, fstd, fsum, fsq), out
 
-        def sample(params, env_state, obs, ep_ret, ep_len, key,
+        def sample(params, env_state, obs, stack, ep_ret, ep_len, key,
                    fmean, fstd):
             key, sub = jax.random.split(key)
             step_keys = jax.random.split(sub, T * 3).reshape(T, 3, 2)
             zeros = jnp.zeros(obs.shape[1:], jnp.float32)
             carry, batch = jax.lax.scan(
-                one_step, (env_state, obs, ep_ret, ep_len, params,
-                           fmean, fstd, zeros, zeros), step_keys)
-            env_state, obs, ep_ret, ep_len = carry[:4]
-            batch["filt_sum"], batch["filt_sumsq"] = carry[7], carry[8]
-            ffinal = (jnp.clip((obs - fmean) / fstd, -10.0, 10.0)
-                      if use_filter else obs)
+                one_step, (env_state, obs, stack, ep_ret, ep_len,
+                           params, fmean, fstd, zeros, zeros), step_keys)
+            env_state, obs, stack, ep_ret, ep_len = carry[:5]
+            batch["filt_sum"], batch["filt_sumsq"] = carry[8], carry[9]
+            if use_stack:
+                ffinal = filt(stack, fmean, fstd).reshape(B, -1)
+            else:
+                ffinal = filt(obs, fmean, fstd)
             final_out = module.forward_train(params, ffinal)
             batch["final_vf"] = final_out["vf"]
             # the observation after the last step — off-policy algorithms
             # reconstruct next_obs[t] as obs[t+1] (+ this for t = T-1);
-            # filtered like every obs the learner sees
+            # filtered/stacked like every obs the learner sees
             batch["final_obs"] = ffinal
-            return env_state, obs, ep_ret, ep_len, key, batch
+            return env_state, obs, stack, ep_ret, ep_len, key, batch
 
         return sample
 
@@ -193,10 +227,14 @@ class SingleAgentEnvRunner:
             fstd = jnp.asarray(self._filter_std())
         else:
             fmean, fstd = jnp.float32(0.0), jnp.float32(1.0)
-        (self._env_state, self._obs, self._ep_ret, self._ep_len,
+        stack = (self._stack if self.framestack > 1
+                 else jnp.float32(0.0))
+        (self._env_state, self._obs, stack, self._ep_ret, self._ep_len,
          self._key, batch) = self._sample_jit(
-            self.params, self._env_state, self._obs, self._ep_ret,
-            self._ep_len, self._key, fmean, fstd)
+            self.params, self._env_state, self._obs, stack,
+            self._ep_ret, self._ep_len, self._key, fmean, fstd)
+        if self.framestack > 1:
+            self._stack = stack
         batch = jax.device_get(batch)
         fsum = batch.pop("filt_sum")
         fsq = batch.pop("filt_sumsq")

@@ -44,7 +44,8 @@ class EnvRunnerGroup:
                  module_class: Optional[type] = None,
                  model_config: Optional[Dict[str, Any]] = None,
                  runner_resources: Optional[Dict[str, float]] = None,
-                 obs_filter: Optional[str] = None):
+                 obs_filter: Optional[str] = None,
+                 framestack: int = 1):
         self.num_env_runners = num_env_runners
         self.obs_filter = obs_filter
         self._filter_global = None      # merged cross-runner state
@@ -52,7 +53,8 @@ class EnvRunnerGroup:
         if num_env_runners == 0:
             self._local = SingleAgentEnvRunner(
                 env, num_envs_per_runner, rollout_length, seed,
-                module_class, model_config, obs_filter=obs_filter)
+                module_class, model_config, obs_filter=obs_filter,
+                framestack=framestack)
             self._remote = []
         else:
             self._local = None
@@ -61,7 +63,8 @@ class EnvRunnerGroup:
             self._remote = [
                 remote_cls.remote(env, num_envs_per_runner, rollout_length,
                                   seed + 1000 * (i + 1), module_class,
-                                  model_config, obs_filter=obs_filter)
+                                  model_config, obs_filter=obs_filter,
+                                  framestack=framestack)
                 for i in range(num_env_runners)]
             ray_tpu.get([r.ping.remote() for r in self._remote])
 

@@ -37,6 +37,15 @@ class EnvSpec:
         return self.num_actions > 0
 
 
+def stacked_spec(spec: "EnvSpec", framestack: int) -> "EnvSpec":
+    """The spec a module sees under feature-wise frame stacking — ONE
+    definition used by both the runner and the learner builder, so
+    their module widths can never desynchronize."""
+    if framestack <= 1:
+        return spec
+    return dataclasses.replace(spec, obs_dim=spec.obs_dim * framestack)
+
+
 class JaxEnv:
     """Base class; subclasses are stateless — all state is in the pytree."""
 

@@ -125,6 +125,13 @@ class BC(Algorithm):
                          seed=config.seed)
 
     def setup(self, config: Dict[str, Any]) -> None:
+        pre = config.get("_algo_config")
+        if pre is not None and getattr(pre, "framestack", 1) > 1 or \
+                config.get("framestack", 1) > 1:
+            raise ValueError(
+                "framestack is not supported by offline algorithms: "
+                "recorded datasets carry single-frame observations, "
+                "which would mismatch a stacked learner module")
         super().setup(config)      # env used for spec + evaluation rollouts
         cfg = self._config
         if not getattr(cfg, "input_path", None):

@@ -336,3 +336,55 @@ def test_impala_async_filter_sync(ray_start):
     assert grp._filter_global is not None
     assert grp._filter_global[0] >= 4 * 16   # at least one batch merged
     algo.stop()
+
+
+# ---------------------------------------------------------------- framestack
+
+def test_framestack_rollout_semantics():
+    """Stacked obs carry the last N frames: within an episode frame
+    t's window ends with obs[t] and starts with obs[t-N+1]; on reset
+    the window refills with the fresh obs (reference parity:
+    env_to_module frame-stacking connector, fused into the rollout)."""
+    N = 4
+    T, B = 64, 2     # 64 steps: random-policy CartPole episodes end
+    #                  well within this, so reset-refill IS exercised
+    r = SingleAgentEnvRunner("CartPole-v1", num_envs=B,
+                             rollout_length=T, seed=0, framestack=N)
+    out = r.sample()
+    b = out["batch"]
+    D = 4
+    assert b["obs"].shape == (T, B, N * D)
+    obs = b["obs"].reshape(T, B, N, D)
+    dones = b["dones"]
+    # pick steps with no done in the last N-1 steps: window must be a
+    # shifted copy of the previous step's
+    for t in range(1, T):
+        for e in range(B):
+            if dones[max(0, t - N):t + 1, e].any():
+                continue
+            np.testing.assert_allclose(obs[t, e, :-1], obs[t - 1, e, 1:],
+                                       rtol=1e-6)
+    # after a done at t, the stack at t+1 is N copies of the reset obs
+    hits = 0
+    for t in range(T - 1):
+        for e in range(B):
+            if dones[t, e]:
+                first = obs[t + 1, e]
+                np.testing.assert_allclose(
+                    first, np.tile(first[-1], (N, 1)), rtol=1e-6)
+                hits += 1
+    assert hits > 0, "no episode ended: reset-refill never exercised"
+    assert b["final_obs"].shape == (B, N * D)
+
+
+def test_framestack_ppo_trains():
+    algo = (PPOConfig().environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=8,
+                         rollout_fragment_length=32, framestack=4)
+            .training(minibatch_size=64, num_epochs=1)
+            .build())
+    m = algo.train()
+    assert np.isfinite(m["learner/total_loss"])
+    m = algo.train()
+    assert np.isfinite(m["learner/total_loss"])
+    algo.stop()
