@@ -195,9 +195,9 @@ def test_dashboard_and_job_submission(ray_start):
     from ray_tpu.dashboard.head import stop_dashboard
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
 
-    dash = start_dashboard(port=8267)
-    try:
-        base = "http://127.0.0.1:8267"
+    dash = start_dashboard(port=0)   # dynamic: a fixed port can race
+    try:                              # parallel sessions on this box
+        base = f"http://127.0.0.1:{dash.port}"
         r = requests.get(f"{base}/api/cluster_status", timeout=15)
         assert r.status_code == 200 and r.json()["num_nodes"] >= 1
         r = requests.get(f"{base}/api/nodes", timeout=15)
