@@ -63,6 +63,16 @@ class EngineConfig:
     # so registering adapters never changes compiled shapes (one
     # recompile when the FIRST adapter arrives, none after).
     max_loras: int = 8
+    # Speculative decoding (vLLM-class; net-new — the reference only
+    # places vLLM): {"draft_model": preset|LlamaConfig,
+    # "num_speculative_tokens": k}. A small draft proposes k tokens in
+    # ONE compiled program; the target verifies all of them in one
+    # chunk forward, so a decode round costs 2 dispatches for up to
+    # k+1 tokens — this amortizes per-dispatch overhead, the dominant
+    # decode cost on dispatch-latency-bound links. Greedy requests
+    # only (temperature 0, no penalties); requires
+    # enable_prefix_caching=False and no tp/pp mesh.
+    speculative: Optional[Dict[str, Any]] = None
 
     def resolve_model(self) -> LlamaConfig:
         return llama.config(self.model)
@@ -249,6 +259,40 @@ class InferenceEngine:
         self._page_tables = np.zeros(
             (ec.max_batch_size, self.max_pages_per_seq), np.int32)
 
+        # speculative decoding state (see EngineConfig.speculative)
+        self._spec = None
+        if ec.speculative:
+            if self.mesh is not None or self.pp > 1:
+                raise ValueError(
+                    "speculative decoding requires a single-device "
+                    "engine (no tp/pp mesh)")
+            if ec.enable_prefix_caching:
+                raise ValueError(
+                    "speculative decoding requires "
+                    "enable_prefix_caching=False (the draft KV pool "
+                    "shares page ids and cannot honor shared pages)")
+            draft_cfg = llama.config(ec.speculative["draft_model"])
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft and target must share a vocab")
+            k = int(ec.speculative.get("num_speculative_tokens", 4))
+            if k < 2:
+                raise ValueError("num_speculative_tokens must be >= 2")
+            dparams = ec.speculative.get("draft_params")
+            if dparams is None:
+                dparams = llama.init_params(
+                    draft_cfg, jax.random.PRNGKey(ec.seed + 7))
+            dkv = (draft_cfg.n_layers, ec.num_pages, ec.page_size,
+                   draft_cfg.n_kv_heads, draft_cfg.head_dim)
+            self._spec = {
+                "cfg": draft_cfg, "k": k,
+                "params": jax.device_put(dparams),
+                "dk": jax.device_put(jnp.zeros(dkv, draft_cfg.dtype)),
+                "dv": jax.device_put(jnp.zeros(dkv, draft_cfg.dtype)),
+                # per-slot: canonical tokens whose KV the draft holds
+                "draft_pos": np.zeros(ec.max_batch_size, np.int64),
+                "accepted": 0, "rounds": 0, "emitted": 0,
+                "draft_fns": {}, "verify_fns": {}, "prefill_fns": {},
+            }
         self._decode_fn = jax.jit(
             self._build_decode(), donate_argnums=(1, 2, 3),
             static_argnums=(15,))
@@ -692,6 +736,259 @@ class InferenceEngine:
                                     + self._d_active[j])
         self._post_decode(np.asarray(new_tokens), touched)
 
+    # -- speculative decoding ----------------------------------------------
+    # Round invariant: canonical tokens [0..P) with target KV written
+    # for [0..P-1) and the newest token t_last = canonical[P-1] still
+    # KV-pending (exactly decode_step's input shape). One round:
+    #   1. draft program (1 dispatch): chunk-prefill the canonical
+    #      delta it hasn't seen, then scan k-2 decode steps -> proposes
+    #      d1..d_{k-1}
+    #   2. target verify (1 dispatch): chunk [t_last, d1..d_{k-1}]
+    #      with per-position logits -> greedy predictions at P..P+k-1
+    #   3. host: accept the longest matching prefix (n), emit n+1
+    #      tokens (accepted + the target's bonus), P += n+1
+    # Rejected candidates leave garbage KV at [P+n..P+k-1), but the
+    # next round's verify chunk starts at P+n and rewrites that span
+    # before attention can ever read it (context is bounded by start).
+
+    def _spec_draft_fn(self, delta_bucket: int, ctx_pages: int):
+        s = self._spec
+        fn = s["draft_fns"].get((delta_bucket, ctx_pages))
+        if fn is not None:
+            return fn
+        dcfg, k = s["cfg"], s["k"]
+        impl = self._resolve_impl()
+        from ...models.llama_infer import prefill_chunk
+
+        def run(params, dk, dv, delta_tokens, start, lens, tables,
+                active, limit):
+            logits, dk, dv = prefill_chunk(
+                dcfg, params, delta_tokens, start, lens, dk, dv,
+                tables, ctx_pages=ctx_pages)
+            d1 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pos0 = (start + lens).astype(jnp.int32)
+
+            def body(carry, i):
+                dk, dv, tok, pos = carry
+                # never scatter past the slot's allocated pages: a
+                # zero page-table entry there is a REAL page that may
+                # belong to another request
+                lg, dk, dv = decode_step(
+                    dcfg, params, tok, pos, dk, dv, tables,
+                    active & (pos < limit), impl=impl)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (dk, dv, nxt, pos + 1), nxt
+
+            (dk, dv, _, _), rest = jax.lax.scan(
+                body, (dk, dv, d1, pos0), jnp.arange(k - 2))
+            # (B, k-1) candidates d1..d_{k-1}
+            cands = jnp.concatenate(
+                [d1[:, None], jnp.transpose(rest)], axis=1)
+            return cands, dk, dv
+
+        fn = jax.jit(run, donate_argnums=(1, 2))
+        s["draft_fns"][(delta_bucket, ctx_pages)] = fn
+        return fn
+
+    def _spec_sync_fn(self, bucket: int):
+        """Draft catch-up: chunk-prefill canonical tokens into the
+        draft pools with no drafting (used when regular-decode
+        fallback let the delta outgrow the round buffer)."""
+        s = self._spec
+        fn = s["draft_fns"].get(("sync", bucket))
+        if fn is not None:
+            return fn
+        dcfg = s["cfg"]
+        from ...models.llama_infer import prefill_chunk
+
+        def run(params, dk, dv, tokens, start, lens, tables):
+            _, dk, dv = prefill_chunk(
+                dcfg, params, tokens, start, lens, dk, dv, tables,
+                ctx_pages=-1, emit="hidden")
+            return dk, dv
+
+        fn = jax.jit(run, donate_argnums=(1, 2))
+        s["draft_fns"][("sync", bucket)] = fn
+        return fn
+
+    def _spec_verify_fn(self, ctx_pages: int):
+        s = self._spec
+        fn = s["verify_fns"].get(ctx_pages)
+        if fn is not None:
+            return fn
+        cfg = self.model_cfg
+        from ...models.llama_infer import prefill_chunk
+
+        def run(params, k_pages, v_pages, tokens, start, lens, tables):
+            logits_all, k_pages, v_pages = prefill_chunk(
+                cfg, params, tokens, start, lens, k_pages, v_pages,
+                tables, ctx_pages=ctx_pages, emit="logits_all")
+            preds = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+            return preds, k_pages, v_pages
+
+        fn = jax.jit(run, donate_argnums=(1, 2))
+        s["verify_fns"][ctx_pages] = fn
+        return fn
+
+    def _spec_prefill_draft(self, slot: "_Slot") -> None:
+        """Admission: give the draft the whole prompt's KV in one shot
+        (the draft is small; chunking it buys nothing)."""
+        s = self._spec
+        req = slot.request
+        n = len(req.prompt_tokens)
+        bucket = self._bucket_for(n)
+        fn = s["prefill_fns"].get(bucket)
+        if fn is None:
+            dcfg = s["cfg"]
+
+            def run(params, dk, dv, tokens, true_lens, tables):
+                h, dk, dv = prefill(
+                    dcfg, params, tokens, true_lens, dk, dv, tables,
+                    emit="hidden")
+                return dk, dv
+
+            fn = jax.jit(run, donate_argnums=(1, 2))
+            s["prefill_fns"][bucket] = fn
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        table = jnp.asarray(
+            self._page_tables[slot.index:slot.index + 1])
+        s["dk"], s["dv"] = fn(
+            s["params"], s["dk"], s["dv"], jnp.asarray(tokens),
+            jnp.asarray([n], jnp.int32), table)
+        s["draft_pos"][slot.index] = n
+
+    def _spec_ready(self) -> bool:
+        """Speculative rounds run only for an all-greedy decode batch
+        (temperature 0, no penalties — the acceptance rule is exact
+        token match). Computed from host-side slot state so the check
+        runs BEFORE any device-state refresh: back-to-back rounds must
+        not pay a re-upload."""
+        if self._spec is None:
+            return False
+        ready = [s for s in self.slots
+                 if s.request is not None and s.ready]
+        if not ready:
+            return False
+        return all(s.request.params.temperature <= 0.0
+                   and s.request.params.repetition_penalty == 1.0
+                   for s in ready)
+
+    def _spec_decode(self, touched: List[Request]) -> None:
+        s = self._spec
+        k = s["k"]
+        B = self.config.max_batch_size
+        active = [sl for sl in self.slots
+                  if sl.request is not None and sl.ready]
+        # canonical token list per slot
+        def canon(sl):
+            return sl.request.prompt_tokens + sl.request.output_tokens
+
+        tables = jnp.asarray(self._page_tables)
+        delta_bucket = k + 1
+
+        # 0. draft catch-up: regular-decode fallback steps (a mixed
+        # greedy/sampling batch) can let the canonical delta outgrow
+        # the round buffer — sync it down in bucket-sized chunks first
+        while True:
+            over = [sl for sl in active
+                    if len(canon(sl)) - int(s["draft_pos"][sl.index])
+                    > delta_bucket]
+            if not over:
+                break
+            ct = np.zeros((B, delta_bucket), np.int32)
+            cstart = np.zeros(B, np.int32)
+            clens = np.zeros(B, np.int32)
+            for sl in over:
+                seq = canon(sl)
+                dp = int(s["draft_pos"][sl.index])
+                # leave at least one delta token for the round itself
+                take = min(delta_bucket, len(seq) - dp - 1)
+                ct[sl.index, :take] = seq[dp:dp + take]
+                cstart[sl.index] = dp
+                clens[sl.index] = take
+                s["draft_pos"][sl.index] = dp + take
+            s["dk"], s["dv"] = self._spec_sync_fn(delta_bucket)(
+                s["params"], s["dk"], s["dv"], jnp.asarray(ct),
+                jnp.asarray(cstart), jnp.asarray(clens), tables)
+
+        # 1. draft: delta-prefill + scan (one dispatch for the batch)
+        dt = np.zeros((B, delta_bucket), np.int32)
+        dstart = np.zeros(B, np.int32)
+        dlens = np.zeros(B, np.int32)
+        act = np.zeros(B, bool)
+        limit = np.zeros(B, np.int32)
+        page = self.allocator.page_size
+        for sl in active:
+            seq = canon(sl)
+            dp = int(s["draft_pos"][sl.index])
+            delta = seq[dp:]
+            assert 0 < len(delta) <= delta_bucket, (dp, len(seq))
+            dt[sl.index, :len(delta)] = delta
+            dstart[sl.index] = dp
+            dlens[sl.index] = len(delta)
+            act[sl.index] = True
+            limit[sl.index] = len(sl.pages) * page
+        ctx = self._ctx_bucket(max(len(canon(sl)) for sl in active) + k)
+        cands, s["dk"], s["dv"] = self._spec_draft_fn(
+            delta_bucket, ctx)(
+            s["params"], s["dk"], s["dv"], jnp.asarray(dt),
+            jnp.asarray(dstart), jnp.asarray(dlens), tables,
+            jnp.asarray(act), jnp.asarray(limit))
+        cands = np.asarray(cands)            # (B, k-1)
+
+        # 2. target verify: chunk [t_last, d1..] per slot, lens clamped
+        # so no write can pass the slot's allocated pages / max_tokens
+        vt = np.zeros((B, k), np.int32)
+        vstart = np.zeros(B, np.int32)
+        vlens = np.zeros(B, np.int32)
+        for sl in active:
+            seq = canon(sl)
+            P = len(seq)
+            remaining = sl.request.params.max_tokens - len(
+                sl.request.output_tokens)
+            use = 1 + min(k - 1, max(remaining - 1, 0))
+            vt[sl.index, 0] = seq[-1]
+            vt[sl.index, 1:use] = cands[sl.index, :use - 1]
+            vstart[sl.index] = P - 1
+            vlens[sl.index] = use
+        preds, self.k_pages, self.v_pages = self._spec_verify_fn(ctx)(
+            self.params, self.k_pages, self.v_pages, jnp.asarray(vt),
+            jnp.asarray(vstart), jnp.asarray(vlens), tables)
+        preds = np.asarray(preds)            # (B, k) greedy per position
+
+        # 3. host acceptance + bookkeeping
+        for sl in active:
+            i = sl.index
+            use = int(vlens[i])
+            P = int(vstart[i]) + 1
+            n_acc = 0
+            while (n_acc < use - 1
+                   and preds[i, n_acc] == vt[i, n_acc + 1]):
+                n_acc += 1
+            new_tokens = list(vt[i, 1:1 + n_acc]) + [preds[i, n_acc]]
+            s["rounds"] += 1
+            s["accepted"] += n_acc
+            # draft re-syncs from the pre-round canonical length: its
+            # in-flight drafts may be wrong past the accepted prefix
+            s["draft_pos"][i] = P
+            # position counts CACHED tokens (the pending newest token
+            # is excluded, matching the decode-loop invariant): t_last
+            # plus each accepted candidate gained KV this round
+            sl.position = P - 1
+            for tok in new_tokens:
+                s["emitted"] += 1
+                sl.position += 1
+                sl.last_token = int(tok)
+                self._append_token(sl, int(tok), touched)
+                if sl.request is None:       # finished mid-round
+                    break
+        # positions/actives changed: lazily invalidate so a fallback
+        # to the regular decode path refreshes, while back-to-back
+        # speculative rounds (which read host state only) skip the
+        # re-upload entirely
+        self._d_tokens = None
+
     def _ctx_bucket(self, start: int) -> int:
         """Smallest power-of-two page count covering `start` tokens."""
         need = self.allocator.pages_needed(start)
@@ -733,6 +1030,11 @@ class InferenceEngine:
             raise NotImplementedError(
                 "multi-LoRA is not supported with pipeline-parallel "
                 "serving (pp>1); use tp-only meshes for LoRA")
+        if self._spec is not None:
+            raise NotImplementedError(
+                "multi-LoRA is not supported with speculative decoding "
+                "(the draft/verify programs run base weights; a greedy "
+                "adapter request would silently lose its adapter)")
         valid = {"wq", "wk", "wv", "wo"}
         new_raw = dict(self._lora_raw)
         for name, adapters in mapping.items():
@@ -969,6 +1271,8 @@ class InferenceEngine:
         slot.position = n
         slot.ready = True
         slot.last_token = first_token
+        if self._spec is not None:
+            self._spec_prefill_draft(slot)
         self._append_token(slot, first_token, touched)
         self._refresh_device_state()
 
@@ -1049,6 +1353,8 @@ class InferenceEngine:
     def _decode(self, touched: List[Request]) -> None:
         if self.pp > 1:
             return self._pp_decode(touched)
+        if self._spec_ready():       # before the refresh: spec rounds
+            return self._spec_decode(touched)   # read host state only
         if self._d_tokens is None:
             self._refresh_device_state()
         self._key, sub = jax.random.split(self._key)
@@ -1124,10 +1430,18 @@ class InferenceEngine:
 
     # -- introspection ------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "active": self.num_active(),
             "waiting": len(self.waiting),
             "free_pages": self.allocator.free_pages,
             "total_pages": self.allocator.num_usable,
             **self.allocator.stats(),
         }
+        if self._spec is not None and self._spec["rounds"]:
+            s = self._spec
+            out["spec_rounds"] = s["rounds"]
+            out["spec_acceptance_rate"] = round(
+                s["accepted"] / (s["rounds"] * (s["k"] - 1)), 3)
+            out["spec_tokens_per_round"] = round(
+                s["emitted"] / s["rounds"], 2)
+        return out

@@ -209,6 +209,12 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
     if emit == "hidden":
         return x, k_pages, v_pages
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if emit == "logits_all":
+        # per-position logits over the whole chunk — speculative
+        # decoding's verify step greedy-checks every candidate token
+        logits_all = (x.astype(jnp.float32)
+                      @ params["lm_head"].astype(jnp.float32))
+        return logits_all, k_pages, v_pages
     last = jnp.take_along_axis(
         x, jnp.maximum(chunk_lens - 1, 0)[:, None, None].astype(jnp.int32),
         axis=1)[:, 0]
