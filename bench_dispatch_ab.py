@@ -1,0 +1,168 @@
+"""A/B probes for the axon tunnel's per-dispatch cost model.
+
+Round-4 measurement (BENCH_CORE.md "tunnel per-call overhead"): a jitted
+x+1 round-trips in 0.02 ms while a 48-weight (1.6 GB) matmul chain costs
+~91 ms/call.  Unknown: does the per-call cost scale with the number of
+argument HANDLES or with the argument BYTES?  The answer decides whether
+restructuring the LLM engine around stacked scanned weight superarrays
+(one handle instead of ~100) can recover the ~45x decode gap.
+
+Probes (each timed steady-state, host-sync via a scalar device->host copy,
+which on the axon platform is the only reliable completion barrier):
+
+  A. list48   — 48 separate (2048, 4096->2048 alternating) bf16 weights
+                passed as a list of args.
+  B. stacked  — the SAME compute with weights stacked into one
+                (48, 2048, 2048) superarray consumed via lax.scan.
+  C. donated  — B with the activation donated (buffer-reuse signal).
+  D. count-sweep — N tiny (8,) args for N in 1/8/48/96: pure handle cost.
+  E. bytes-sweep — ONE arg of 8/128/512 MiB: pure byte cost.
+
+Prints one JSON line per row:  {"probe": ..., "ms_per_call": ...}
+and a final {"probe": "ab_summary", ...} line with the inferred model.
+Runs in a watchdogged subprocess like bench.py (the tunnel can wedge
+mid-run); on outage prints {"probe": "skipped"} and exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+TIMEOUT_S = int(os.environ.get("RAY_TPU_AB_TIMEOUT", "600"))
+
+
+def _sync(x) -> float:
+    # device->host copy: cannot return before remote execution finishes
+    # (block_until_ready can, on the axon platform).
+    return float(x.reshape(-1)[0])
+
+
+def _time_call(fn, args, iters: int = 8) -> float:
+    out = fn(*args)
+    _sync(out if not isinstance(out, tuple) else out[0])  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out if not isinstance(out, tuple) else out[0])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def run_inner() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rows = []
+
+    def emit(probe: str, ms: float, **extra):
+        row = {"probe": probe, "ms_per_call": round(ms, 3), **extra}
+        rows.append(row)
+        print("AB_JSON " + json.dumps(row), flush=True)
+
+    dev = jax.devices()[0]
+    emit("platform", 0.0, platform=dev.platform,
+         kind=getattr(dev, "device_kind", str(dev)))
+
+    # ---- A/B/C: 48-layer matmul chain, list args vs stacked scan ----
+    H = 2048
+    L = 48
+    key = jax.random.PRNGKey(0)
+    ws_list = [jax.device_put(jax.random.normal(jax.random.fold_in(key, i),
+                                                (H, H), jnp.bfloat16) * 0.02)
+               for i in range(L)]
+    w_stack = jax.device_put(jnp.stack(ws_list))          # (48, H, H) = 384 MiB
+    x = jax.device_put(jnp.ones((8, H), jnp.bfloat16))
+
+    @jax.jit
+    def chain_list(x, *ws):
+        for w in ws:
+            x = jnp.tanh(x @ w)
+        return x
+
+    def _chain_stacked(x, ws):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+        h, _ = jax.lax.scan(body, x, ws)
+        return h
+
+    chain_stacked = jax.jit(_chain_stacked)
+
+    emit("list48", _time_call(chain_list, (x, *ws_list)),
+         n_args=L + 1, arg_mib=round(L * H * H * 2 / 2**20))
+    emit("stacked", _time_call(chain_stacked, (x, w_stack)),
+         n_args=2, arg_mib=round(L * H * H * 2 / 2**20))
+
+    chain_don = jax.jit(_chain_stacked, donate_argnums=(0,))
+    emit("stacked_donated_x", _time_call(
+        lambda w: chain_don(jax.device_put(jnp.ones((8, H), jnp.bfloat16)), w),
+        (w_stack,)), n_args=2)
+
+    # ---- D: handle-count sweep with tiny args ----
+    for n in (1, 8, 48, 96):
+        tiny = [jax.device_put(jnp.full((8,), float(i), jnp.float32))
+                for i in range(n)]
+
+        @jax.jit
+        def add_all(*xs):
+            s = xs[0]
+            for t in xs[1:]:
+                s = s + t
+            return s
+
+        emit(f"count_{n}", _time_call(add_all, tuple(tiny)), n_args=n)
+
+    # ---- E: byte sweep with one handle ----
+    for mib in (8, 128, 512):
+        n_el = mib * 2**20 // 2
+        big = jax.device_put(jnp.ones((n_el,), jnp.bfloat16))
+
+        @jax.jit
+        def touch(b):
+            return b[:8].astype(jnp.float32) + 1.0
+
+        emit(f"bytes_{mib}mib", _time_call(touch, (big,)), arg_mib=mib)
+
+    # ---- summary: infer the dominant axis ----
+    by = {r["probe"]: r["ms_per_call"] for r in rows}
+    handle_slope = (by.get("count_96", 0) - by.get("count_1", 0)) / 95.0
+    byte_slope = (by.get("bytes_512mib", 0) - by.get("bytes_8mib", 0)) / 504.0
+    summary = {
+        "probe": "ab_summary",
+        "list48_ms": by.get("list48"),
+        "stacked_ms": by.get("stacked"),
+        "stack_speedup": round(by["list48"] / by["stacked"], 2)
+        if by.get("stacked") else None,
+        "ms_per_extra_handle": round(handle_slope, 4),
+        "ms_per_arg_mib": round(byte_slope, 4),
+    }
+    print("AB_JSON " + json.dumps(summary), flush=True)
+
+
+def main() -> None:
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            capture_output=True, text=True, timeout=TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"probe": "skipped", "reason": "tunnel wedged"}))
+        return
+    got = False
+    for line in out.stdout.splitlines():
+        if line.startswith("AB_JSON "):
+            print(line[len("AB_JSON "):])
+            got = True
+    if not got:
+        print(json.dumps({"probe": "skipped",
+                          "reason": f"rc={out.returncode}",
+                          "stderr": out.stderr[-500:]}))
+
+
+if __name__ == "__main__":
+    if "--inner" in sys.argv:
+        run_inner()
+    else:
+        main()

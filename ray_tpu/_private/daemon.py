@@ -287,6 +287,9 @@ class NodeDaemon:
             "register_node", node_id=self.node_id, addr=self.address,
             resources=self.resources, labels=self.labels)
         self._monitor_task = asyncio.ensure_future(self._monitor_loop())
+        # warm the same-host check now (off-loop DNS): by the first
+        # local-lease RPC the tri-state is usually already resolved
+        self._controller_same_host_tristate()
         return self.address
 
     async def stop(self):
@@ -810,7 +813,15 @@ class NodeDaemon:
             # path — delegation churn with no latency saved). Hosts are
             # resolved so hostname-vs-IP spellings of the same machine
             # still compare equal.
-            enabled = not self._controller_is_same_host()
+            if any(k != "CPU" for k in res):
+                return {"status": "unsupported"}
+            same = self._controller_same_host_tristate()
+            if same is None:
+                # resolution still in flight (off-loop DNS): 'spill'
+                # routes this one call to the controller WITHOUT the
+                # client latching local-lease-unsupported for good
+                return {"status": "spill"}
+            enabled = not same
         if not enabled or any(k != "CPU" for k in res):
             return {"status": "unsupported"}
         cpu = float(res.get("CPU", 1.0))
@@ -853,31 +864,53 @@ class NodeDaemon:
                 "daemon_addr": list(self.address),
                 "node_id": self.node_id}
 
-    def _controller_is_same_host(self) -> bool:
-        """True when the controller runs on this daemon's machine (so a
-        'local' grant would save no network hop). Resolves both
-        spellings once and caches — loopback literals, equal strings,
+    def _controller_same_host_tristate(self):
+        """True/False once known, None while resolving.
+
+        True when the controller runs on this daemon's machine (so a
+        'local' grant would save no network hop). DNS resolution runs
+        OFF the event loop (a slow resolver must not stall daemon RPCs
+        — heartbeats ride this loop). Loopback literals, equal strings,
         and hostname-vs-IP aliases all count as same-host; resolution
-        failure conservatively reports same-host (keeps auto OFF)."""
+        failure conservatively reports same-host."""
         cached = getattr(self, "_same_host_cache", None)
         if cached is not None:
             return cached
         chost, dhost = self.controller_addr[0], self.address[0]
-        same = True
-        try:
+        loop_names = {"127.0.0.1", "localhost", "::1", "0.0.0.0"}
+        if chost in loop_names or chost == dhost:
+            self._same_host_cache = True
+            return True
+        if getattr(self, "_same_host_resolving", False):
+            return None   # resolution in flight
+        self._same_host_resolving = True
+
+        def _resolve() -> bool:
             import socket
-            loop_names = {"127.0.0.1", "localhost", "::1", "0.0.0.0"}
-            if chost in loop_names or chost == dhost:
-                same = True
-            else:
+            try:
                 cip = socket.gethostbyname(chost)
                 dip = socket.gethostbyname(dhost)
-                same = (cip == dip or cip in loop_names
+                return (cip == dip or cip in loop_names
                         or dip in loop_names)
-        except OSError:
-            same = True
-        self._same_host_cache = same
-        return same
+            except OSError:
+                return True
+
+        import asyncio
+
+        def _store(fut):
+            try:
+                self._same_host_cache = bool(fut.result())
+            except Exception:
+                self._same_host_cache = True
+
+        try:
+            task = asyncio.get_running_loop().run_in_executor(
+                None, _resolve)
+            task.add_done_callback(_store)
+        except RuntimeError:     # no running loop (unit-test direct call)
+            self._same_host_cache = _resolve()
+            return self._same_host_cache
+        return None
 
     async def rpc_release_lease_local(self, lease_id: str,
                                       terminate: bool = False) -> None:

@@ -138,7 +138,11 @@ IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
 
 
 def _wds_decode(ext: str, raw: bytes) -> Any:
-    """Standard WebDataset field decoding by extension."""
+    """Standard WebDataset field decoding by extension. Arrays stay
+    numpy here (compact); the nested-list form Arrow stores (same
+    choice as read_images) is produced per-chunk at table build time —
+    holding a whole image shard as Python lists was the memory
+    blowup."""
     if ext in ("txt", "text"):
         return raw.decode()
     if ext in ("cls", "id", "index"):
@@ -146,12 +150,10 @@ def _wds_decode(ext: str, raw: bytes) -> Any:
     if ext == "json":
         return json.loads(raw.decode())
     if ext == "npy":
-        return np.load(io.BytesIO(raw)).tolist()
+        return np.load(io.BytesIO(raw))
     if f".{ext.lower()}" in IMAGE_SUFFIXES:
         from PIL import Image
-        # nested lists keep the H/W/C structure in Arrow (same choice
-        # as read_images)
-        return np.asarray(Image.open(io.BytesIO(raw))).tolist()
+        return np.asarray(Image.open(io.BytesIO(raw)))
     return raw
 
 
@@ -165,6 +167,13 @@ def read_webdataset(paths, *, decode: bool = True) -> Dataset:
     decode=False keeps raw bytes. One read task per shard so the
     streaming executor parallelizes across shards."""
     import tarfile
+
+    # Samples accumulate as numpy/scalars for the WHOLE shard (so
+    # fields of one sample merge even when its tar entries are not
+    # adjacent), then convert to Arrow in CHUNK-row batches: the
+    # nested-Python-list working set — the actual memory blowup on
+    # image shards — stays bounded at CHUNK rows.
+    CHUNK = 256
 
     def reader(f: str) -> Block:
         samples: Dict[str, Dict[str, Any]] = {}
@@ -185,21 +194,42 @@ def read_webdataset(paths, *, decode: bool = True) -> Dataset:
                 raw = tf.extractfile(m).read()
                 samples[key][ext] = (_wds_decode(ext, raw)
                                      if decode else raw)
-        rows = [{"__key__": k, **samples[k]} for k in order]
-        if not rows:
+        if not order:
             return pa.table({"__key__": pa.array([], pa.string())})
-        # explicit pa.array per column: the generic tensor conversion
-        # in _to_table flattens nested lists (decoded images must stay
-        # list<list<list<uint8>>> — same choice as read_images). Column
-        # set is the UNION across samples (a field absent from the
-        # first sample must not vanish from the shard).
-        names: List[str] = []
-        for r in rows:
-            for name in r:
+        # column set = UNION across ALL samples (a field absent from
+        # the first sample must not vanish from the shard)
+        names: List[str] = ["__key__"]
+        for k in order:
+            for name in samples[k]:
                 if name not in names:
                     names.append(name)
-        return pa.table({name: pa.array([r.get(name) for r in rows])
-                         for name in names})
+
+        def to_cell(v):
+            return v.tolist() if isinstance(v, np.ndarray) else v
+
+        tables: List[Any] = []
+        for at in range(0, len(order), CHUNK):
+            keys = order[at:at + CHUNK]
+            # explicit pa.array per column: the generic tensor
+            # conversion in _to_table flattens nested lists (decoded
+            # images must stay list<list<list<uint8>>> — same choice
+            # as read_images)
+            cols = {}
+            for name in names:
+                if name == "__key__":
+                    cols[name] = pa.array(keys)
+                else:
+                    cols[name] = pa.array(
+                        [to_cell(samples[k].get(name)) for k in keys])
+            tables.append(pa.table(cols))
+            for k in keys:          # free converted rows eagerly
+                samples[k] = {}
+        if len(tables) == 1:
+            return tables[0]
+        # "permissive": per-chunk inference differences unify
+        # (int64 + double -> double) and all-null chunks take the
+        # typed column's type
+        return pa.concat_tables(tables, promote_options="permissive")
 
     return _file_read(paths, ".tar", reader, "WebDataset")
 

@@ -73,8 +73,19 @@ class EngineConfig:
     # only (temperature 0, no penalties); requires
     # enable_prefix_caching=False and no tp/pp mesh.
     speculative: Optional[Dict[str, Any]] = None
+    # Real-checkpoint path: directory holding an HF-layout safetensors
+    # checkpoint (model.safetensors[.index.json] + config.json). Params
+    # load through models/checkpoint_io.py — sharding-aware windowed
+    # reads straight onto the serving mesh. With model=None the
+    # architecture comes from the checkpoint's config.json.
+    checkpoint: Optional[str] = None
 
     def resolve_model(self) -> LlamaConfig:
+        if self.model is None:
+            if not self.checkpoint:
+                raise ValueError("model=None requires checkpoint=")
+            from ...models import checkpoint_io
+            return checkpoint_io.load_config(self.checkpoint)
         return llama.config(self.model)
 
 
@@ -201,7 +212,14 @@ class InferenceEngine:
         cfg, ec = self.model_cfg, config
         self.mesh, self.stages = self._build_placement(ec.mesh, cfg)
         self.pp = len(self.stages) if self.stages else 1
-        if params is None:
+        if params is None and ec.checkpoint:
+            from ...models import checkpoint_io
+            # sharded load: each device's shard is a windowed mmap read
+            # (pp stages split host-side below, so they load unsharded)
+            params = checkpoint_io.load_llama_params(
+                cfg, ec.checkpoint,
+                mesh=self.mesh if self.pp == 1 else None)
+        elif params is None:
             params = llama.init_params(cfg, jax.random.PRNGKey(ec.seed))
         if self.pp > 1:
             self.params = None
@@ -952,6 +970,14 @@ class InferenceEngine:
             vt[sl.index, 1:use] = cands[sl.index, :use - 1]
             vstart[sl.index] = P - 1
             vlens[sl.index] = use
+            # the max_tokens clamp above is only safe because _admit
+            # preallocates worst-case (prompt+max_tokens) pages; fail
+            # loudly if admission ever gets lazier, instead of letting
+            # verify scatter through page-table zero entries into
+            # another request's KV
+            assert P - 1 + use <= len(sl.pages) * page, (
+                "verify write past allocated pages", sl.index, P, use,
+                len(sl.pages), page)
         preds, self.k_pages, self.v_pages = self._spec_verify_fn(ctx)(
             self.params, self.k_pages, self.v_pages, jnp.asarray(vt),
             jnp.asarray(vstart), jnp.asarray(vlens), tables)

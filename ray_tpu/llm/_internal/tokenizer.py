@@ -46,8 +46,26 @@ class ByteTokenizer:
 
 
 def load_tokenizer(source: Optional[str] = None, vocab_size: int = 259):
-    """source: local path to a HF tokenizer dir, else byte-level."""
+    """source: local path to a HF tokenizer dir (or tokenizer.json file),
+    else byte-level. A ``tokenizer.json`` loads through the NATIVE BPE
+    implementation (bpe.py — no transformers on the serving path);
+    other HF formats fall back to transformers."""
     if source:
+        import os
+        tj = (source if source.endswith("tokenizer.json")
+              else os.path.join(source, "tokenizer.json"))
+        from . import bpe
+        # Only byte-level BPE goes native — sentencepiece-style
+        # tokenizer.json (Llama-2/Mistral: byte_fallback + ▁
+        # vocab) would tokenize silently wrong here; transformers
+        # handles those.
+        if os.path.exists(tj) and bpe.is_byte_level_spec(tj):
+            return bpe.load(tj)
         from transformers import AutoTokenizer
-        return AutoTokenizer.from_pretrained(source, local_files_only=True)
+        # AutoTokenizer wants the DIRECTORY even when the caller handed
+        # us a direct tokenizer.json path
+        hf_source = (os.path.dirname(source) or "."
+                     if source.endswith("tokenizer.json") else source)
+        return AutoTokenizer.from_pretrained(
+            hf_source, local_files_only=True)
     return ByteTokenizer(vocab_size)

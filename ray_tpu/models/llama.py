@@ -49,9 +49,19 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Dropless dispatch (ops/moe.py): sort + ragged_dot grouped matmuls,
+    # ragged_all_to_all over the ep axis — no capacity drops; the
+    # capacity_factor knob is ignored when True.
+    moe_dropless: bool = False
     # Pipeline parallelism: microbatch count used when the mesh has pp>1
     # (models/pipeline.py). Must divide the per-step batch.
     pp_microbatches: int = 4
+    # Schedule for the pp training step: "gpipe" (models/pipeline.py,
+    # autodiff backward, supports MoE) or "1f1b" (models/pipeline_1f1b.py
+    # hand-scheduled interleaved 1F1B: O(stages) activation stash,
+    # fill/drain bubble shrunk by pp_interleave; dense layers only).
+    pp_schedule: str = "gpipe"
+    pp_interleave: int = 2          # model chunks per device under 1f1b
     remat: bool = True
     # "dots_no_batch" saves matmul outputs (fastest when HBM allows);
     # "nothing" fully rematerializes each layer in backward (~1B params on
@@ -250,7 +260,8 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
     if cfg.n_experts:
         out, aux = moe_ffn(
             y, layer["router"], layer["wi"], layer["wg"], layer["wd"],
-            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor)
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            dropless=cfg.moe_dropless, mesh=mesh)
         x = x + wlc(out, "batch", "seq", "act_embed")
         return x, aux
     gate = jax.nn.silu(y @ layer["wg"].astype(dt))

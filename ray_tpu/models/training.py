@@ -18,7 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from . import llama
-from ..parallel.mesh import BATCH_AXES, AXIS_SP
+from ..parallel.mesh import BATCH_AXES, AXIS_SP, AXIS_PP, mesh_shape
 from ..parallel.sharding import spec_for, tree_shardings
 
 
@@ -106,10 +106,16 @@ class TrainStepBundle:
 
     def _step_impl(self, state, tokens):
         params, opt_state = state
-        grad_fn = jax.value_and_grad(
-            lambda p: llama.loss_fn(self.cfg, p, tokens, self.mesh),
-            has_aux=True)
-        (loss, metrics), grads = grad_fn(params)
+        if (self.cfg.pp_schedule == "1f1b"
+                and mesh_shape(self.mesh).get(AXIS_PP, 1) > 1):
+            from . import pipeline_1f1b
+            loss, metrics, grads = pipeline_1f1b.loss_and_grads(
+                self.cfg, params, tokens, self.mesh)
+        else:
+            grad_fn = jax.value_and_grad(
+                lambda p: llama.loss_fn(self.cfg, p, tokens, self.mesh),
+                has_aux=True)
+            (loss, metrics), grads = grad_fn(params)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = dict(metrics)
@@ -128,6 +134,20 @@ class TrainStepBundle:
     def init_state(self, seed: int = 0):
         with jax.set_mesh(self.mesh):
             return self._init(jax.random.PRNGKey(seed))
+
+    def init_state_from_checkpoint(self, ckpt_dir: str):
+        """Init train state from an HF-layout safetensors checkpoint:
+        params stream in pre-sharded (checkpoint_io windowed per-shard
+        reads onto this bundle's mesh), optimizer state inits jitted
+        under the same shardings."""
+        from . import checkpoint_io
+        params = checkpoint_io.load_llama_params(
+            self.cfg, ckpt_dir, mesh=self.mesh)
+        with jax.set_mesh(self.mesh):
+            opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=self.opt_shardings)(params)
+        return params, opt_state
 
     def step(self, state, tokens):
         with jax.set_mesh(self.mesh):

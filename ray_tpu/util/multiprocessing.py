@@ -80,6 +80,12 @@ class Pool:
         while True:
             with self._cb_lock:
                 refs = list(self._cb_pending)
+                # exit once the pool is closed and no callback is pending
+                # (terminate() drops pending ones), so closed pools don't
+                # leak a polling thread for the process lifetime
+                if self._closed and not refs:
+                    self._cb_thread = None
+                    return
             if not refs:
                 self._cb_wake.wait(timeout=1.0)
                 self._cb_wake.clear()
@@ -189,9 +195,13 @@ class Pool:
 
     def close(self) -> None:
         self._closed = True
+        self._cb_wake.set()     # let the watcher thread notice and exit
 
     def terminate(self) -> None:
         self._closed = True
+        with self._cb_lock:
+            self._cb_pending.clear()   # drop callbacks; watcher exits
+        self._cb_wake.set()
 
     def join(self) -> None:
         pass

@@ -1,0 +1,323 @@
+"""Real-model path: safetensors loader + native BPE tokenizer.
+
+Covers the role of the reference's vLLM/transformers delegation
+(/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:57-63) rebuilt natively: HF-layout checkpoints load
+shape/dtype-exact onto a sharded mesh, the trainer and the engine both
+consume them, and decode through the loaded engine is token-exact
+against the source params (the golden-token gate)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+
+from ray_tpu.models import checkpoint_io, llama
+from ray_tpu.parallel import MeshSpec
+
+
+# --------------------------------------------------------------- safetensors
+
+def test_safetensors_roundtrip_and_slicing(tmp_path):
+    path = str(tmp_path / "t.safetensors")
+    tensors = {
+        "a": np.arange(24, dtype=np.float32).reshape(4, 6),
+        "b": np.arange(10, dtype=np.int32),
+        "c": (np.ones((3, 2)) * 0.5).astype(ml_dtypes.bfloat16),
+    }
+    checkpoint_io.write_safetensors(path, tensors, metadata={"format": "pt"})
+    f = checkpoint_io.SafeTensorsFile(path)
+    assert sorted(f.keys()) == ["a", "b", "c"]
+    assert f.metadata == {"format": "pt"}
+    for name, t in tensors.items():
+        shape, dtype = f.info(name)
+        assert shape == t.shape and dtype == t.dtype
+        np.testing.assert_array_equal(np.asarray(f.read(name)), t)
+    # windowed read touches only the slice
+    np.testing.assert_array_equal(
+        np.asarray(f.read("a", (slice(1, 3), slice(2, 5)))),
+        tensors["a"][1:3, 2:5])
+
+
+def _write_debug_ckpt(tmp_path, cfg, seed=0, max_shard_bytes=4 << 30):
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint_io.save_llama_checkpoint(
+        cfg, params, ckpt, max_shard_bytes=max_shard_bytes)
+    checkpoint_io.save_config(cfg, ckpt)
+    return params, ckpt
+
+
+def _assert_tree_equal(a, b):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32),
+            rtol=0, atol=0)
+
+
+def test_hf_layout_roundtrip(tmp_path):
+    cfg = llama.config("debug")
+    params, ckpt = _write_debug_ckpt(tmp_path, cfg)
+    loaded = checkpoint_io.load_llama_params(cfg, ckpt)
+    _assert_tree_equal(params, loaded)
+    # config.json round-trips the architecture
+    cfg2 = checkpoint_io.load_config(ckpt)
+    assert (cfg2.hidden, cfg2.n_layers, cfg2.n_heads, cfg2.n_kv_heads,
+            cfg2.ffn, cfg2.vocab_size) == (
+        cfg.hidden, cfg.n_layers, cfg.n_heads, cfg.n_kv_heads,
+        cfg.ffn, cfg.vocab_size)
+
+
+def test_hf_layout_roundtrip_sharded_files(tmp_path):
+    """Tiny max_shard_bytes forces the multi-file + index.json path."""
+    cfg = llama.config("debug")
+    params, ckpt = _write_debug_ckpt(tmp_path, cfg,
+                                     max_shard_bytes=64 * 1024)
+    assert os.path.exists(
+        os.path.join(ckpt, "model.safetensors.index.json"))
+    loaded = checkpoint_io.load_llama_params(cfg, ckpt)
+    _assert_tree_equal(params, loaded)
+
+
+def test_hf_layout_roundtrip_moe(tmp_path):
+    cfg = llama.config("debug_moe")
+    params, ckpt = _write_debug_ckpt(tmp_path, cfg)
+    loaded = checkpoint_io.load_llama_params(cfg, ckpt)
+    _assert_tree_equal(params, loaded)
+
+
+def test_tied_embeddings_fallback(tmp_path):
+    """No lm_head tensor (Llama-3.2-style tying) -> embed.T is used."""
+    cfg = llama.config("debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint_io.save_llama_checkpoint(cfg, params, ckpt)
+    # rewrite the single shard without lm_head.weight
+    f = checkpoint_io.SafeTensorsFile(
+        os.path.join(ckpt, "model.safetensors"))
+    # materialize copies: read() returns mmap VIEWS into the very file
+    # the next line overwrites (SIGBUS otherwise)
+    tensors = {k: np.array(f.read(k)) for k in f.keys()
+               if k != "lm_head.weight"}
+    checkpoint_io.write_safetensors(
+        os.path.join(ckpt, "model.safetensors"), tensors)
+    loaded = checkpoint_io.load_llama_params(cfg, ckpt)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["lm_head"], np.float32),
+        np.asarray(params["embed"], np.float32).T)
+
+
+def test_sharded_load_on_mesh(tmp_path):
+    """fsdp x tp mesh: values identical to the unsharded load and every
+    leaf lands under its logical-axis NamedSharding."""
+    cfg = llama.config("debug")
+    params, ckpt = _write_debug_ckpt(tmp_path, cfg)
+    mesh = MeshSpec(dp=1, fsdp=2, sp=1, tp=4).build(jax.devices()[:8])
+    loaded = checkpoint_io.load_llama_params(cfg, ckpt, mesh=mesh)
+    _assert_tree_equal(params, loaded)
+    from ray_tpu.parallel.sharding import tree_shardings
+    expect = tree_shardings(llama.param_logical_axes(cfg), mesh)
+    got_ok = jax.tree.map(
+        lambda arr, sh: arr.sharding.is_equivalent_to(sh, arr.ndim),
+        loaded, expect)
+    assert all(jax.tree.leaves(got_ok)), got_ok
+
+
+def test_llama38b_layout_shape_exact(tmp_path):
+    """The Llama-3-8B architecture (depth truncated to keep the file
+    small — every tensor ROLE and orientation is exercised) loads
+    shape/dtype-exact on the virtual fsdp x tp mesh: the VERDICT r4
+    north-star gate for the real-model path."""
+    cfg = llama.config("8b", n_layers=2, max_seq=256)
+    rng = np.random.default_rng(0)
+    # synthetic bf16 weights in true HF layout/orientation
+    tensors = {}
+
+    def t(shape):
+        return rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+
+    tensors["model.embed_tokens.weight"] = t((cfg.vocab_size, cfg.hidden))
+    tensors["model.norm.weight"] = t((cfg.hidden,))
+    tensors["lm_head.weight"] = t((cfg.vocab_size, cfg.hidden))
+    for l in range(cfg.n_layers):
+        p = f"model.layers.{l}."
+        tensors[p + "self_attn.q_proj.weight"] = t((cfg.q_dim, cfg.hidden))
+        tensors[p + "self_attn.k_proj.weight"] = t((cfg.kv_dim, cfg.hidden))
+        tensors[p + "self_attn.v_proj.weight"] = t((cfg.kv_dim, cfg.hidden))
+        tensors[p + "self_attn.o_proj.weight"] = t((cfg.hidden, cfg.q_dim))
+        tensors[p + "mlp.gate_proj.weight"] = t((cfg.ffn, cfg.hidden))
+        tensors[p + "mlp.up_proj.weight"] = t((cfg.ffn, cfg.hidden))
+        tensors[p + "mlp.down_proj.weight"] = t((cfg.hidden, cfg.ffn))
+        tensors[p + "input_layernorm.weight"] = t((cfg.hidden,))
+        tensors[p + "post_attention_layernorm.weight"] = t((cfg.hidden,))
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    checkpoint_io.write_safetensors(
+        os.path.join(ckpt, "model.safetensors"), tensors)
+    checkpoint_io.save_config(cfg, ckpt)
+
+    mesh = MeshSpec(dp=1, fsdp=2, sp=1, tp=4).build(jax.devices()[:8])
+    loaded = checkpoint_io.load_llama_params(
+        cfg, ckpt, mesh=mesh, dtype=jnp.bfloat16)
+    axes = llama.param_logical_axes(cfg)
+    shapes = jax.tree.map(lambda a: a.shape, loaded)
+    assert shapes["layers"]["wq"] == (cfg.n_layers, cfg.hidden, cfg.q_dim)
+    assert shapes["lm_head"] == (cfg.hidden, cfg.vocab_size)
+    assert all(a.dtype == jnp.bfloat16 for a in jax.tree.leaves(loaded))
+    # orientation check: wq row 0 of layer 0 == HF q_proj column 0
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layers"]["wq"][0, 0], ml_dtypes.bfloat16),
+        tensors["model.layers.0.self_attn.q_proj.weight"][:, 0])
+    del axes
+
+
+# ------------------------------------------------------------ consumers
+
+def test_trainer_consumes_checkpoint(tmp_path):
+    from ray_tpu.models.training import TrainStepBundle
+    cfg = llama.config("debug")
+    params, ckpt = _write_debug_ckpt(tmp_path, cfg)
+    mesh = MeshSpec(dp=2, fsdp=2, sp=1, tp=2).build(jax.devices()[:8])
+    bundle = TrainStepBundle(cfg, mesh)
+    state = bundle.init_state_from_checkpoint(ckpt)
+    tokens = bundle.shard_batch(jnp.zeros((4, 64), jnp.int32))
+    state, metrics = bundle.step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_engine_golden_token_decode(tmp_path):
+    """Engine built from the CHECKPOINT decodes token-exact against the
+    engine built from the source params."""
+    from ray_tpu.llm import (EngineConfig, InferenceEngine, Request,
+                             SamplingParams)
+    cfg = llama.config("debug", dtype=jnp.float32)
+    params, ckpt = _write_debug_ckpt(tmp_path, cfg)
+
+    def run(engine):
+        req = Request("g", list(range(5, 29)),
+                      SamplingParams(max_tokens=12, temperature=0.0))
+        engine.add_request(req)
+        while not req.finished:
+            engine.step()
+        return list(req.output_tokens)
+
+    base = run(InferenceEngine(EngineConfig(model=cfg), params=params))
+    # same compute dtype both sides (config.json does not carry dtype;
+    # architecture-from-config is asserted in test_hf_layout_roundtrip)
+    from_ckpt = run(InferenceEngine(
+        EngineConfig(model=cfg, checkpoint=ckpt)))
+    assert base == from_ckpt and len(base) == 12
+    # model=None resolves the architecture from the checkpoint config
+    eng = InferenceEngine(EngineConfig(model=None, checkpoint=ckpt))
+    assert eng.model_cfg.hidden == cfg.hidden
+
+
+# ------------------------------------------------------------------- BPE
+
+SAMPLES = [
+    "Hello, world!",
+    "The quick brown fox jumps over 1234 lazy dogs.",
+    "  leading spaces and\nnewlines\t tabs",
+    "unicode: café — über 寿司 \U0001f680",
+    "don't stop, it's fine; we'll see...",
+    "CamelCase snake_case kebab-case 42x",
+]
+
+
+def _train_tiny_tokenizer(tmp_path):
+    """Train a real byte-level BPE with the tokenizers library (the
+    Rust reference implementation) to act as an exactness oracle."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders
+    from tokenizers.trainers import BpeTrainer
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False,
+                                                 use_regex=True)
+    tok.decoder = decoders.ByteLevel()
+    trainer = BpeTrainer(
+        vocab_size=500, special_tokens=["<|bos|>", "<|eos|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    corpus = SAMPLES * 20 + ["the and of to in is was for on hello world"]
+    tok.train_from_iterator(corpus, trainer)
+    path = str(tmp_path / "tokenizer.json")
+    tok.save(path)
+    return tok, path
+
+
+def test_bpe_matches_rust_reference(tmp_path):
+    rust, path = _train_tiny_tokenizer(tmp_path)
+    from ray_tpu.llm._internal import bpe
+    ours = bpe.load(path)
+    for s in SAMPLES:
+        expect = rust.encode(s).ids
+        got = ours.encode(s, add_bos=False)
+        assert got == expect, (s, got, expect)
+        assert ours.decode(got) == rust.decode(expect)
+
+
+def test_bpe_special_tokens_and_chat(tmp_path):
+    _, path = _train_tiny_tokenizer(tmp_path)
+    from ray_tpu.llm._internal import bpe
+    tok = bpe.load(path)
+    bos = tok.special["<|bos|>"]
+    eos = tok.special["<|eos|>"]
+    ids = tok.encode("<|bos|>hi<|eos|>", add_bos=False)
+    assert ids[0] == bos and ids[-1] == eos
+    assert tok.decode(ids) == "hi"
+    assert tok.decode(ids, skip_special_tokens=False) == (
+        "<|bos|>hi<|eos|>")
+    out = tok.apply_chat_template(
+        [{"role": "user", "content": "hello"}])
+    assert "user" in out and out.endswith("\n")
+
+
+def test_load_tokenizer_prefers_native_bpe(tmp_path):
+    _, path = _train_tiny_tokenizer(tmp_path)
+    from ray_tpu.llm._internal.tokenizer import load_tokenizer
+    from ray_tpu.llm._internal.bpe import BPETokenizer
+    tok = load_tokenizer(str(tmp_path))
+    assert isinstance(tok, BPETokenizer)
+
+
+def test_sentencepiece_style_spec_rejected(tmp_path):
+    """Llama-2/Mistral-style tokenizer.json (byte_fallback, \\u2581
+    vocab, no ByteLevel) must NOT route to the native byte-level
+    encoder — it would silently tokenize wrong."""
+    from ray_tpu.llm._internal import bpe
+    spec = {
+        "model": {"type": "BPE", "byte_fallback": True,
+                  "vocab": {"▁the": 5, "a": 6}, "merges": []},
+        "pre_tokenizer": None,
+        "added_tokens": [],
+    }
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    assert not bpe.is_byte_level_spec(str(p))
+    # byte-level spec accepted
+    sub = tmp_path / "bl"
+    sub.mkdir()
+    _, path = _train_tiny_tokenizer(sub)
+    assert bpe.is_byte_level_spec(path)
+
+
+def test_bpe_no_double_bos_on_chat_template(tmp_path):
+    """apply_chat_template embeds the BOS literal; encode must not
+    prepend a second one."""
+    _, path = _train_tiny_tokenizer(tmp_path)
+    from ray_tpu.llm._internal import bpe
+    tok = bpe.load(path)
+    # force llama-3-style naming onto the trained specials
+    tok.bos_token = "<|bos|>"
+    tok.bos_id = tok.special["<|bos|>"]
+    ids = tok.encode("<|bos|>hello", add_bos=True)
+    assert ids.count(tok.bos_id) == 1
+    # plain text still gets exactly one
+    ids = tok.encode("hello", add_bos=True)
+    assert ids.count(tok.bos_id) == 1 and ids[0] == tok.bos_id

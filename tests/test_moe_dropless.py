@@ -1,0 +1,127 @@
+"""Dropless MoE (VERDICT r4 weak #7 / SURVEY §2.4 EP target).
+
+Gates: (1) dropless output matches a naive per-token expert-mixture
+reference exactly (zero drops by construction, where the capacity path
+provably drops); (2) the ep-sharded ragged-exchange path is bit-equal
+to the single-shard sort+ragged_dot path; (3) dropless trains end-to-end
+on the dp/fsdp/ep virtual-mesh config."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.ops import moe
+from ray_tpu.parallel import MeshSpec
+
+
+def _naive_reference(x, router_w, wi, wg, wd, top_k):
+    """Per-token dense mixture: every routed token computes — the
+    definition of dropless."""
+    b, s, h = x.shape
+    xt = np.asarray(x, np.float32).reshape(-1, h)
+    probs = np.asarray(moe.router_probs(jnp.asarray(xt),
+                                        jnp.asarray(router_w)))
+    out = np.zeros_like(xt)
+    for t in range(xt.shape[0]):
+        order = np.argsort(-probs[t])[:top_k]
+        gates = probs[t][order]
+        gates = gates / max(gates.sum(), 1e-9)
+        for g, e in zip(gates, order):
+            gate = np.asarray(jax.nn.silu(
+                jnp.asarray(xt[t] @ np.asarray(wg, np.float32)[e])))
+            up = xt[t] @ np.asarray(wi, np.float32)[e]
+            out[t] += g * ((gate * up) @ np.asarray(wd, np.float32)[e])
+    return out.reshape(b, s, h)
+
+
+def _toy(seed=0, b=2, s=8, h=16, e=4, f=32):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((b, s, h)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((h, e)) * 0.5, jnp.float32)
+    wi = jnp.asarray(rng.standard_normal((e, h, f)) * 0.1, jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, h, f)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((e, f, h)) * 0.1, jnp.float32)
+    return x, router, wi, wg, wd
+
+
+def test_dropless_matches_naive_reference():
+    x, router, wi, wg, wd = _toy()
+    out, aux = jax.jit(
+        lambda *a: moe.moe_ffn(*a, top_k=2, dropless=True))(
+        x, router, wi, wg, wd)
+    ref = _naive_reference(x, router, wi, wg, wd, top_k=2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(aux))
+
+
+def test_capacity_path_drops_where_dropless_does_not():
+    """Skewed routing: capacity_factor=1 demonstrably drops tokens
+    (output == residual 0 for the dropped ones), dropless never does."""
+    x, router, wi, wg, wd = _toy(seed=3)
+    # bias the router hard toward expert 0 so capacity overflows
+    router = router.at[:, 0].add(8.0)
+    cap_out, _ = jax.jit(
+        lambda *a: moe.moe_ffn(*a, top_k=1, capacity_factor=1.0))(
+        x, router, wi, wg, wd)
+    free_out, _ = jax.jit(
+        lambda *a: moe.moe_ffn(*a, top_k=1, dropless=True))(
+        x, router, wi, wg, wd)
+    ref = _naive_reference(x, router, wi, wg, wd, top_k=1)
+    np.testing.assert_allclose(np.asarray(free_out), ref,
+                               rtol=2e-4, atol=2e-4)
+    # the capacity path must differ somewhere (= dropped tokens)
+    assert np.abs(np.asarray(cap_out) - ref).max() > 1e-3
+
+
+def test_dropless_ep_sharded_matches_local():
+    """ragged-exchange dispatch over ep=4 == single-shard dispatch."""
+    x, router, wi, wg, wd = _toy(b=2, s=16, e=8, f=24)
+    local, aux_l = jax.jit(
+        lambda *a: moe.moe_ffn(*a, top_k=2, dropless=True))(
+        x, router, wi, wg, wd)
+    mesh = MeshSpec(dp=2, fsdp=1, sp=1, tp=1, ep=4).build(jax.devices()[:8])
+    with jax.set_mesh(mesh):
+        ep_out, aux_e = jax.jit(
+            lambda *a: moe.moe_ffn(*a, top_k=2, dropless=True,
+                                   mesh=mesh))(x, router, wi, wg, wd)
+    np.testing.assert_allclose(np.asarray(ep_out), np.asarray(local),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_e), float(aux_l), rtol=1e-6)
+
+
+def test_dropless_grad_flows():
+    x, router, wi, wg, wd = _toy()
+
+    def loss(router, wi, wg, wd):
+        out, aux = moe.moe_ffn(x, router, wi, wg, wd,
+                               top_k=2, dropless=True)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(
+        router, wi, wg, wd)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+    # expert weights actually receive gradient
+    assert np.abs(np.asarray(grads[1])).max() > 0
+
+
+def test_dropless_llama_trains_on_ep_mesh():
+    """dp/fsdp/ep mesh + moe_dropless llama: loss finite and decreasing
+    over a few steps (the dryrun config's training gate)."""
+    from ray_tpu.models.training import TrainStepBundle
+    cfg = llama.config("debug_moe", moe_dropless=True)
+    mesh = MeshSpec(dp=2, fsdp=2, sp=1, tp=1, ep=2).build(jax.devices()[:8])
+    bundle = TrainStepBundle(cfg, mesh)
+    state = bundle.init_state(0)
+    rng = np.random.default_rng(0)
+    tokens = bundle.shard_batch(jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (4, 64)), jnp.int32))
+    losses = []
+    for _ in range(4):
+        state, metrics = bundle.step(state, tokens)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

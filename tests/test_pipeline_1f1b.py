@@ -1,0 +1,115 @@
+"""Interleaved 1F1B pipeline (VERDICT r4 weak #6 / next-round #5).
+
+Gates: (1) the schedule builder emits valid dependency-respecting
+tables and the interleaved async bubble beats GPipe at pp=4;
+(2) hand-scheduled loss AND grads match the dense single-device
+autodiff path; (3) the 1f1b train step runs end-to-end on the pp=4
+virtual mesh and learns."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama, pipeline_1f1b
+from ray_tpu.models.pipeline_1f1b import (build_schedule,
+                                          gpipe_bubble_fraction)
+from ray_tpu.parallel import MeshSpec
+
+
+def _check_valid(s):
+    """Every op exactly once; F/B dependency order respected."""
+    svc = s.n_chunks * s.pp
+    f_at = np.full((s.n_micro, svc), -1)
+    b_at = np.full((s.n_micro, svc), -1)
+    for t in range(s.ticks):
+        for d in range(s.pp):
+            if s.f_valid[t, d]:
+                m, c = int(s.f_mb[t, d]), int(s.f_chunk[t, d])
+                sv = c * s.pp + d
+                assert f_at[m, sv] == -1
+                f_at[m, sv] = t
+            if s.b_valid[t, d]:
+                m, c = int(s.b_mb[t, d]), int(s.b_chunk[t, d])
+                sv = c * s.pp + d
+                assert b_at[m, sv] == -1
+                b_at[m, sv] = t
+    assert (f_at >= 0).all() and (b_at >= 0).all()
+    for m in range(s.n_micro):
+        for sv in range(1, svc):
+            assert f_at[m, sv] > f_at[m, sv - 1]
+        assert b_at[m, svc - 1] > f_at[m, svc - 1]
+        for sv in range(svc - 1):
+            assert b_at[m, sv] > b_at[m, sv + 1]
+
+
+@pytest.mark.parametrize("m,pp,v", [(8, 4, 1), (8, 4, 2), (16, 4, 2),
+                                    (8, 2, 2), (5, 4, 1)])
+def test_schedule_valid(m, pp, v):
+    _check_valid(build_schedule(m, pp, v))
+
+
+def test_interleaved_bubble_beats_gpipe_at_pp4():
+    """The r4-verdict gate: measured bubble (async dependency timing,
+    F=1/B=2 cost) < GPipe's at pp=4."""
+    for m in (8, 16):
+        s = build_schedule(m, 4, 2)
+        assert s.async_bubble_fraction() < gpipe_bubble_fraction(m, 4), m
+    # interleaving deeper shrinks it further
+    assert (build_schedule(8, 4, 4).async_bubble_fraction()
+            < build_schedule(8, 4, 2).async_bubble_fraction())
+
+
+def _dense_loss_and_grads(cfg, params, tokens):
+    (loss, metrics), grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(cfg, p, tokens, None), has_aux=True)(params)
+    return loss, grads
+
+
+@pytest.mark.parametrize("v", [1, 2])
+def test_1f1b_grads_match_dense(v):
+    cfg = llama.config(
+        "debug", dtype=jnp.float32, n_layers=2 * v, pp_microbatches=8,
+        pp_schedule="1f1b", pp_interleave=v, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(2, cfg.vocab_size, (8, 32)), jnp.int32)
+
+    dense_loss, dense_grads = _dense_loss_and_grads(cfg, params, tokens)
+
+    mesh = MeshSpec(dp=1, fsdp=1, sp=1, tp=1, pp=2).build(jax.devices()[:2])
+    with jax.set_mesh(mesh):
+        loss, metrics, grads = jax.jit(
+            lambda p, t: pipeline_1f1b.loss_and_grads(cfg, p, t, mesh)
+        )(params, tokens)
+
+    np.testing.assert_allclose(float(loss), float(dense_loss),
+                               rtol=1e-5, atol=1e-6)
+    flat_d, tree_d = jax.tree.flatten(dense_grads)
+    flat_p, tree_p = jax.tree.flatten(grads)
+    assert tree_d == tree_p
+    for gd, gp in zip(flat_d, flat_p):
+        np.testing.assert_allclose(
+            np.asarray(gp, np.float32), np.asarray(gd, np.float32),
+            rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_trains_on_pp4_mesh():
+    from ray_tpu.models.training import TrainStepBundle
+    cfg = llama.config(
+        "debug", dtype=jnp.float32, n_layers=8, pp_microbatches=8,
+        pp_schedule="1f1b", pp_interleave=2, remat=False)
+    mesh = MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4).build(jax.devices()[:8])
+    bundle = TrainStepBundle(cfg, mesh)
+    state = bundle.init_state(0)
+    rng = np.random.default_rng(0)
+    tokens = bundle.shard_batch(jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32))
+    losses = []
+    for _ in range(4):
+        state, metrics = bundle.step(state, tokens)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses

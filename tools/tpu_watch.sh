@@ -1,0 +1,32 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU tunnel every PERIOD seconds; on the
+# first green probe, run the A/B dispatch probes + bench.py + bench_llm.py,
+# save outputs under tpu_watch/, and exit 0 (signals the driver session).
+# Exits 3 after MAX_LOOPS fruitless probes.
+cd /root/repo || exit 1
+mkdir -p tpu_watch
+PERIOD=${PERIOD:-1080}
+MAX_LOOPS=${MAX_LOOPS:-40}
+PROBE='
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = (jnp.ones((128,128), jnp.bfloat16) @ jnp.ones((128,128), jnp.bfloat16))
+float(x[0,0])
+print("PROBE_OK", d.platform, getattr(d, "device_kind", str(d)), flush=True)
+'
+for i in $(seq 1 "$MAX_LOOPS"); do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout 90 python -c "$PROBE" 2>&1)
+  if echo "$out" | grep -q PROBE_OK; then
+    echo "$ts GREEN loop=$i: $out" >> tpu_watch/watch.log
+    echo "$ts" > tpu_watch/GREEN_AT
+    timeout 700 python bench_dispatch_ab.py > tpu_watch/ab_results.jsonl 2> tpu_watch/ab_stderr.log
+    timeout 900 python bench.py > tpu_watch/bench_mfu.json 2> tpu_watch/bench_mfu.stderr
+    timeout 900 python bench_llm.py > tpu_watch/bench_llm.json 2> tpu_watch/bench_llm.stderr
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) done green-window runs" >> tpu_watch/watch.log
+    exit 0
+  fi
+  echo "$ts down loop=$i: $(echo "$out" | tail -1 | cut -c1-120)" >> tpu_watch/watch.log
+  sleep "$PERIOD"
+done
+exit 3
