@@ -62,6 +62,59 @@ def bench_actors(n: int) -> dict:
             "total_s": round(t_ready, 1)}
 
 
+def bench_actor_storm_local(n: int) -> dict:
+    """Actor-creation storm through DAEMON-LOCAL creation grants vs the
+    controller-scheduled path (distributed dispatch for actors —
+    create_actor_local; controller registration rides actor_started
+    asynchronously). Same workload both ways; rate = create -> first
+    method result for all n actors."""
+    import ray_tpu
+    from ray_tpu._private.config import get_config
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    def run_storm():
+        t0 = time.time()
+        actors = [A.options(num_cpus=0).remote(i) for i in range(n)]
+        got = ray_tpu.get([a.who.remote() for a in actors], timeout=1800)
+        dt = time.time() - t0
+        assert got == list(range(n))
+        for a in actors:
+            ray_tpu.kill(a)
+        time.sleep(1.0)
+        return n / dt
+
+    import ray_tpu._private.worker as worker_mod
+    rt = worker_mod._runtime
+    cfg = get_config()
+    prev = cfg.local_lease_enabled
+    try:
+        cfg.local_lease_enabled = "0"
+        run_storm()                      # warm the worker pool (both
+        # runs below then reuse it — creation rate, not spawn rate)
+        scheduled = run_storm()
+        cfg.local_lease_enabled = "1"
+        # the disabled-mode probe latched local-lease-unsupported on
+        # the client; reset so the local path actually runs
+        rt.client._local_lease_unsupported = False
+        before = rt.head_daemon.local_leases_granted
+        local = run_storm()
+        grants = rt.head_daemon.local_leases_granted - before
+    finally:
+        cfg.local_lease_enabled = prev
+    return {"row": "actor_storm_local", "n": n,
+            "local_creates_per_s": round(local, 1),
+            "scheduled_creates_per_s": round(scheduled, 1),
+            "speedup": round(local / scheduled, 2),
+            "local_grants": grants}
+
+
 def bench_pgs(n: int) -> dict:
     import ray_tpu
     from ray_tpu.util.placement_group import (placement_group,
@@ -249,6 +302,9 @@ def main() -> None:
             print(json.dumps(rows[-1]), flush=True)
         if "pgs" in wanted:
             rows.append(bench_pgs(1_000 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "actor_storm_local" in wanted:
+            rows.append(bench_actor_storm_local(200 // scale))
             print(json.dumps(rows[-1]), flush=True)
         if "nn_storm" in wanted:
             rows.append(bench_nn_storm(8, 8, 500 // scale))

@@ -156,6 +156,7 @@ class Controller:
         # against the scheduled path): (node_id, req_key) -> slot count.
         self.delegations: Dict[tuple, int] = {}
         self._reclaim_timer_armed = False   # re-pump while work pends
+        self._pg_reserve_tasks: set = set()  # in-flight bundle 2PCs
         self.subscribers: Dict[str, List[Tuple[str, int]]] = {}
         self.pending: List[dict] = []          # specs waiting for resources
         self._spread_cursor = 0                # SPREAD round-robin state
@@ -295,7 +296,7 @@ class Controller:
     # ------------------------------------------------------------- nodes
 
     async def rpc_register_node(self, node_id: str, addr, resources,
-                                labels=None) -> dict:
+                                labels=None, pg_bundles=None) -> dict:
         node = NodeEntry(node_id, addr, resources, labels)
         prior = self.nodes.get(node_id)
         if prior is not None:
@@ -306,13 +307,51 @@ class Controller:
             node.cmd_seq = prior.cmd_seq
             node.draining = prior.draining
         self.nodes[node_id] = node
-        # A re-registering node (same id) gets live PG reservations
-        # re-applied so PG tasks + new tasks can't oversubscribe it.
-        for pg in self.placement_groups.values():
-            if pg.state == "CREATED":
+        # Delegated-bundle reconciliation against the daemon's committed
+        # ledger (pg_bundles; None = old-protocol daemon, trust our own
+        # table). Three cases:
+        #  - both sides agree -> re-acquire the reservation;
+        #  - we expect a bundle the daemon does NOT hold (daemon process
+        #    restarted) -> the group lost state: re-place the whole PG;
+        #  - the daemon holds bundles for a PG we dropped/never created
+        #    (removed while it was partitioned) -> tell it to release.
+        release_pgs: List[str] = []
+        reported = None if pg_bundles is None else set(pg_bundles)
+        for pg in list(self.placement_groups.values()):
+            if pg.state == "RESERVING":
+                # mid-2PC: the pump already deducted these bundles from
+                # the OLD NodeEntry; the fresh one must carry the same
+                # deduction or a later commit/rollback corrupts the
+                # books (daemon ledger audit doesn't apply — nothing is
+                # committed daemon-side yet)
                 for b in pg.bundles:
                     if b.node_id == node_id:
                         node.acquire(b.resources)
+                continue
+            if pg.state != "CREATED":
+                continue
+            on_node = [b for b in pg.bundles if b.node_id == node_id]
+            if not on_node:
+                continue
+            if reported is not None and pg.pg_id not in reported:
+                # daemon lost the reservation: release every node's part
+                # and send the group back through placement
+                for b in pg.bundles:
+                    holder = self.nodes.get(b.node_id or "")
+                    if holder is not None and b.node_id != node_id:
+                        holder.release(b.resources)
+                    b.node_id = None
+                pg.state = "PENDING"
+                if pg not in self.pending_pgs:
+                    self.pending_pgs.append(pg)
+                self._persist_pg(pg)
+                continue
+            for b in on_node:
+                node.acquire(b.resources)
+        if reported:
+            known = {pg.pg_id for pg in self.placement_groups.values()
+                     if pg.state in ("CREATED", "RESERVING")}
+            release_pgs = [pid for pid in reported if pid not in known]
         logger.info("node %s registered at %s with %s",
                     node_id[:8], addr, resources)
         self._sched_event.set()
@@ -348,7 +387,8 @@ class Controller:
                     self.running[task_id] = (node_id, req,
                                              a.creation_spec)
         return {"session_name": self.session_name,
-                "expected_actors": expected}
+                "expected_actors": expected,
+                "release_pgs": release_pgs}
 
     async def rpc_unregister_node(self, node_id: str) -> None:
         node = self.nodes.get(node_id)
@@ -693,10 +733,20 @@ class Controller:
         # Placement groups first: gang reservations beat individual tasks.
         still_pg: List[Any] = []
         for pg in self.pending_pgs:
-            reason = pg.try_place([n for n in self.nodes.values()
-                                   if not n.draining])
-            if reason is None:
-                self._persist_pg(pg)      # committed: record assignments
+            nodes = [n for n in self.nodes.values() if not n.draining]
+            chosen, reason = pg.choose_nodes(nodes)
+            if chosen is not None:
+                # Optimistically deduct controller-side availability NOW
+                # (nothing else may hand these resources out during the
+                # daemon round-trips), then confirm the reservation on
+                # the owning daemons in the background so one slow
+                # daemon never stalls task scheduling.
+                pg.commit(chosen, {n.node_id: n for n in nodes})
+                pg.state = "RESERVING"
+                task = asyncio.ensure_future(
+                    self._finish_pg_reserve(pg, chosen))
+                self._pg_reserve_tasks.add(task)
+                task.add_done_callback(self._pg_reserve_tasks.discard)
             elif reason == "" or self.autoscaling_enabled:
                 if reason:
                     pg.failure_reason = reason   # surfaced to autoscaler
@@ -708,6 +758,13 @@ class Controller:
 
         still_pending: List[dict] = []
         for spec in self.pending:
+            # PG tasks wait until their PG's daemon reservation confirms
+            sched = spec.get("scheduling") or {}
+            pg = self.placement_groups.get(
+                sched.get("placement_group") or "")
+            if pg is not None and pg.state in ("PENDING", "RESERVING"):
+                still_pending.append(spec)
+                continue
             placed = await self._try_place(spec)
             if placed is None:
                 still_pending.append(spec)
@@ -1098,8 +1155,17 @@ class Controller:
             self._persist_named(entry.namespace, entry.name, actor_id)
 
     async def rpc_actor_started(self, actor_id: str, addr,
-                                worker_id: str) -> dict:
+                                worker_id: str, spec: dict = None,
+                                node_id: str = None) -> dict:
         entry = self.actors.get(actor_id)
+        if entry is None and spec is not None and node_id is not None:
+            # daemon-local creation (distributed dispatch): the daemon
+            # granted from its delegated block and this report is the
+            # controller's FIRST sight of the actor — registration is
+            # off the creation critical path (reference parity:
+            # gcs_actor_scheduler learns lease results after the fact)
+            self._register_pending_actor(spec, node_id)
+            entry = self.actors.get(actor_id)
         if entry is None or entry.state == "DEAD":
             # never resurrect a DEAD actor (e.g. killed mid-restart)
             return {"status": "superseded"}
@@ -1231,6 +1297,84 @@ class Controller:
 
     # --------------------------------------------------------- placement groups
 
+    async def _finish_pg_reserve(self, pg, chosen: List[str]) -> None:
+        """Background completion of a PG reservation: two-phase
+        prepare/commit on every owning daemon (reference parity: GCS
+        drives PrepareBundleResources/CommitBundleResources on raylets,
+        gcs_placement_group_scheduler; the daemons' committed ledgers
+        are what controller-restart reconciliation audits). On refusal
+        the optimistic controller-side deduction rolls back and the PG
+        re-enters the pending queue."""
+        ok = await self._reserve_bundles_2pc(pg, chosen)
+        if pg.state != "RESERVING":
+            # removed/failed while we were on the wire: undo daemon state
+            for nid in set(chosen):
+                node = self.nodes.get(nid)
+                if node is not None:
+                    try:
+                        await self.pool.get(node.addr).oneway(
+                            "release_bundles", pg_id=pg.pg_id)
+                    except Exception:
+                        pass
+            return
+        if ok:
+            pg.state = "CREATED"
+            pg._wake()
+            self._persist_pg(pg)
+        else:
+            # roll back the optimistic deduction WITHOUT mark_removed
+            # (that would wake ready() waiters with a dead state)
+            for b in pg.bundles:
+                node = self.nodes.get(b.node_id)
+                if node is not None:
+                    node.release(b.resources)
+                b.node_id = None
+            pg.state = "PENDING"
+            self.pending_pgs.append(pg)
+        self._sched_event.set()
+
+    async def _reserve_bundles_2pc(self, pg, chosen: List[str]) -> bool:
+        by_node: Dict[str, list] = {}
+        for b, nid in zip(pg.bundles, chosen):
+            by_node.setdefault(nid, []).append(
+                {"index": b.index, "resources": b.resources})
+
+        async def _rpc(nid: str, method: str, **kw) -> bool:
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                return False
+            try:
+                reply = await asyncio.wait_for(
+                    self.pool.get(node.addr).call(
+                        method, pg_id=pg.pg_id, **kw),
+                    timeout=10.0)
+                return bool(reply and reply.get("ok"))
+            except Exception:
+                return False
+
+        nids = list(by_node)
+        # concurrent prepares: serial rounds would add O(nodes) latency
+        # AND let early prepares expire their daemon-side TTL before
+        # the commit round reaches them
+        acks = await asyncio.gather(
+            *(_rpc(nid, "prepare_bundles", bundles=by_node[nid])
+              for nid in nids))
+        if not all(acks):
+            await asyncio.gather(
+                *(_rpc(nid, "release_bundles")
+                  for nid, ok in zip(nids, acks) if ok))
+            return False
+        commits = await asyncio.gather(
+            *(_rpc(nid, "commit_bundles") for nid in nids))
+        if not all(commits):
+            # a daemon died or expired its prepare mid-2PC: tear the
+            # whole reservation down (committed daemons drop their
+            # ledger entries) and let the pump retry
+            await asyncio.gather(*(_rpc(nid, "release_bundles")
+                                   for nid in nids))
+            return False
+        return True
+
     async def rpc_create_placement_group(self, pg_id: str, bundles,
                                          strategy: str = "PACK",
                                          name: str = "") -> dict:
@@ -1247,7 +1391,7 @@ class Controller:
         pg = self.placement_groups.get(pg_id)
         if pg is None:
             return {"state": "NOT_FOUND"}
-        while pg.state == "PENDING":
+        while pg.state in ("PENDING", "RESERVING"):
             ev = asyncio.Event()
             pg.waiters.append(ev)
             try:
@@ -1274,8 +1418,18 @@ class Controller:
             if sched.get("placement_group") == pg_id \
                     and actor.state in ("ALIVE", "PENDING", "RESTARTING"):
                 await self.rpc_kill_actor(actor.actor_id, no_restart=True)
-        if pg.state == "CREATED":
+        if pg.state in ("CREATED", "RESERVING"):
+            # RESERVING: the in-flight _finish_pg_reserve sees the state
+            # change and tells the daemons to drop their ledger entries
             pg.release_all(self.nodes)
+            for b in pg.bundles:
+                node = self.nodes.get(b.node_id or "")
+                if node is not None:
+                    try:
+                        await self.pool.get(node.addr).oneway(
+                            "release_bundles", pg_id=pg_id)
+                    except Exception:
+                        pass
         else:
             pg.mark_removed()       # wakes any pg.ready() waiters
         # Drop the entry so long-lived drivers creating/removing many PGs

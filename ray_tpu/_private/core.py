@@ -885,18 +885,25 @@ class CoreClient:
     LEASE_DISPATCH_BATCH = 16    # specs per run_task_batch frame
 
     def _lease_key(self, spec: dict) -> Optional[tuple]:
-        """Fast-path eligibility: plain CPU-only tasks with default
-        scheduling. Everything else (placement, TPU chips, runtime envs,
-        streaming, actors) takes the scheduled path."""
+        """Fast-path eligibility: CPU-only or CPU+TPU tasks with
+        default scheduling (TPU leases are granted by the local daemon
+        with chips pinned to the lease). Everything else (placement,
+        custom resources, runtime envs, streaming, actors) takes the
+        scheduled path."""
         if (spec.get("num_returns") == "streaming"
                 or spec.get("is_actor_creation")
                 or spec.get("scheduling")
                 or spec.get("runtime_env")):
             return None
         res = spec.get("resources") or {}
-        if any(k != "CPU" for k in res):
+        # CPU-only and CPU+TPU qualify: TPU leases are granted by the
+        # LOCAL daemon with specific chips pinned to the lease (the
+        # daemon owns chip assignment); other custom resources need
+        # global placement.
+        if any(k not in ("CPU", "TPU") for k in res):
             return None
-        return ("cpu", float(res.get("CPU", 1.0)))
+        return ("cpu", float(res.get("CPU", 1.0)),
+                float(res.get("TPU", 0.0)))
 
     async def _submit_via_lease(self, key: tuple, spec: dict) -> None:
         if time.monotonic() < self._lease_cooldown_until.get(key, 0.0):
@@ -957,8 +964,11 @@ class CoreClient:
                     and time.monotonic()
                     >= self._local_lease_skip_until.get(key, 0.0)):
                 try:
+                    req = {"CPU": key[1]}
+                    if key[2]:
+                        req["TPU"] = key[2]
                     reply = await self.pool.get(self.node_addr).call(
-                        "lease_worker_local", resources={"CPU": key[1]},
+                        "lease_worker_local", resources=req,
                         owner_addr=list(self.address))
                 except Exception:
                     reply = None
@@ -972,6 +982,14 @@ class CoreClient:
                             time.monotonic() + 5.0)
                     reply = None
             if reply is None:
+                if key[2]:
+                    # TPU leases are local-daemon only (chip pinning);
+                    # without a local grant the tasks take the scheduled
+                    # path, where the daemon assigns chips per task
+                    self._lease_cooldown_until[key] = (
+                        time.monotonic() + 5.0)
+                    await self._drain_lease_queue(group)
+                    return
                 reply = await self._controller().call(
                     "lease_worker", resources={"CPU": key[1]},
                     owner_addr=list(self.address))
@@ -985,6 +1003,7 @@ class CoreClient:
                 return
             lease_id = reply["lease_id"]
             lease_local = bool(reply.get("local"))
+            lease_chips = reply.get("tpu_chips")
             worker = self.pool.get(tuple(reply["worker_addr"]))
             daemon_addr = tuple(reply["daemon_addr"])
             lease_daemon = daemon_addr
@@ -1011,6 +1030,9 @@ class CoreClient:
                         // max(group.num_pumps, 1)))
                 while group.queue and len(batch) < target:
                     batch.append(group.queue.popleft())
+                if lease_chips:
+                    for s in batch:      # lease-pinned chip isolation
+                        s["_tpu_chips"] = lease_chips
                 try:
                     # one frame for the whole batch: tiny tasks are wire
                     # (syscall) bound, not compute bound
@@ -1215,6 +1237,8 @@ class CoreClient:
             try:
                 if export_hash is not None:
                     await self._ensure_fn_exported(export_hash, blob)
+                if await self._try_create_actor_local(spec):
+                    return
                 await self._submit_spec(spec)
             except Exception as e:
                 self.memory_store.put_error(
@@ -1223,6 +1247,41 @@ class CoreClient:
 
         self.loop_runner.call_soon(_submit())
         return actor_id, creation_ref
+
+    async def _try_create_actor_local(self, spec: dict) -> bool:
+        """Daemon-local actor creation (distributed dispatch): ask the
+        LOCAL daemon to grant the creation from its delegated resource
+        block, keeping the controller off the per-actor critical path
+        (reference parity: raylet-granted actor leases,
+        gcs_actor_scheduler.h). Returns True when fully handled —
+        including a failed __init__, which the worker reports to us
+        directly. Ineligible/declined creations return False and take
+        the scheduled path."""
+        if (spec.get("scheduling") or spec.get("runtime_env")
+                or spec.get("actor_name")
+                or spec.get("lifetime") == "detached"
+                or any(k not in ("CPU", "TPU")
+                       for k in (spec.get("resources") or {}))):
+            return False
+        if (self.node_addr is None or self._local_lease_unsupported):
+            return False
+        try:
+            reply = await self.pool.get(self.node_addr).call(
+                "create_actor_local", spec=spec)
+        except Exception:
+            return False
+        status = (reply or {}).get("status")
+        if status == "unsupported":
+            self._local_lease_unsupported = True
+            return False
+        if status == "ok":
+            # the grant reply carries the worker address: first method
+            # call skips the controller directory resolve entirely
+            self._actor_addrs[spec["actor_id"]] = tuple(reply["addr"])
+            return True
+        if status == "created_failed":
+            return True          # worker already pushed us the error
+        return False             # spill/error: scheduled path
 
     async def _reresolve_actor(self, actor_id: str, old_addr):
         lock = self._actor_resolve_locks.setdefault(actor_id, asyncio.Lock())

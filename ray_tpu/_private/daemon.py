@@ -260,8 +260,25 @@ class NodeDaemon:
         # the locally-granted leases themselves. The controller stays
         # out of the per-lease critical path; it only sees block-sized
         # delegate/return calls.
-        self._lease_blocks: Dict[float, int] = {}
+        # delegated-block free slots, keyed by the canonical resource
+        # tuple (e.g. (("CPU", 1.0),) or (("CPU", 1.0), ("TPU", 2.0)))
+        self._lease_blocks: Dict[tuple, int] = {}
         self._local_leases: Dict[str, dict] = {}
+        # actor_id -> delegated-block key claimed by a daemon-local
+        # actor creation; credited back on actor death
+        self._local_actor_slots: Dict[str, tuple] = {}
+        # actor slots not re-acquired after a controller restart: their
+        # death returns no block slot (controller's fresh view already
+        # owns that capacity)
+        self._unbacked_actor_slots: set = set()
+        # Placement-group bundle ledger (two-phase reservation —
+        # reference parity: the raylet's PrepareBundleResources /
+        # CommitBundleResources, driven by the GCS scheduler). The
+        # COMMITTED map is this daemon's authoritative statement of
+        # which bundles it hosts; controller-restart reconciliation
+        # audits it via the register_node payload.
+        self._pg_prepared: Dict[str, tuple] = {}   # pg_id -> (bundles, ts)
+        self._pg_bundles: Dict[str, list] = {}     # pg_id -> bundles
         self._lease_activity = 0.0       # last local grant/release
         self._lease_probe_at = 0.0       # next owner-liveness sweep
         self.local_leases_granted = 0    # counters for tests/stats
@@ -285,7 +302,8 @@ class NodeDaemon:
         self.address = await self.server.start(host, port)
         await self.pool.get(self.controller_addr).call(
             "register_node", node_id=self.node_id, addr=self.address,
-            resources=self.resources, labels=self.labels)
+            resources=self.resources, labels=self.labels,
+            pg_bundles=self._pg_bundles)
         self._monitor_task = asyncio.ensure_future(self._monitor_loop())
         # warm the same-host check now (off-loop DNS): by the first
         # local-lease RPC the tri-state is usually already resolved
@@ -788,6 +806,159 @@ class NodeDaemon:
     LOCAL_LEASE_PROBE_AGE_S = 10.0     # lease age before owner probing
     LOCAL_LEASE_PROBE_PERIOD_S = 5.0   # sweep cadence
 
+    async def _claim_local_slot(self, resources: dict):
+        """Shared gate + slot claim for daemon-local grants (worker
+        leases AND actor creations). Returns (cpu, None) with one
+        delegated-block slot claimed, or (None, refusal_reply).
+
+        Only plain-CPU requests are served locally (everything else
+        needs global placement state)."""
+        from .config import get_config
+        cfg = get_config()
+        res = dict(resources or {})
+        mode = str(cfg.local_lease_enabled).lower()
+        if mode in ("0", "false"):
+            return None, {"status": "unsupported"}
+        # CPU and TPU serve locally (TPU chips are node-local hardware
+        # this daemon already owns the assignment of); anything else
+        # needs global placement state
+        if any(k not in ("CPU", "TPU") for k in res):
+            return None, {"status": "unsupported"}
+        if mode not in ("1", "true"):
+            # auto: only worth it when the controller hop crosses hosts
+            # (loopback grants measurably lose to the controller path —
+            # delegation churn with no latency saved). Hosts are
+            # resolved so hostname-vs-IP spellings of the same machine
+            # still compare equal.
+            same = self._controller_same_host_tristate()
+            if same is None:
+                # resolution still in flight (off-loop DNS): 'spill'
+                # routes this one call to the controller WITHOUT the
+                # client latching local-lease-unsupported for good
+                return None, {"status": "spill"}
+            if same:
+                return None, {"status": "unsupported"}
+        req = {k: float(v) for k, v in res.items() if float(v) > 0}
+        req["CPU"] = float(res.get("CPU", 1.0))
+        key = tuple(sorted(req.items()))
+        if self.draining:
+            return None, {"status": "spill"}
+        while self._lease_blocks.get(key, 0) <= 0:
+            # grow the block; re-check after the await (a concurrent
+            # grant may have consumed what this call delegated)
+            try:
+                reply = await self.pool.get(self.controller_addr).call(
+                    "delegate_resources", node_id=self.node_id,
+                    resources=dict(key),
+                    count=max(1, cfg.lease_block_size))
+            except Exception:
+                reply = None
+            if not reply or reply.get("granted", 0) <= 0:
+                self.local_leases_spilled += 1
+                return None, {"status": "spill"}
+            self._lease_blocks[key] = (self._lease_blocks.get(key, 0)
+                                       + reply["granted"])
+        # slot claimed before any worker-acquire await (no double-grant)
+        self._lease_blocks[key] -= 1
+        return key, None
+
+    async def rpc_create_actor_local(self, spec: dict) -> dict:
+        """Create an actor WITHOUT the controller on the critical path
+        (distributed dispatch for actors — reference parity: the GCS
+        actor scheduler leases workers through raylets and learns the
+        result afterwards, gcs_actor_scheduler.h; here the daemon
+        grants from its controller-delegated block and the controller's
+        directory is updated by the actor_started report, which carries
+        the creation spec so registration is ASYNC).
+
+        Serves plain-CPU, unnamed, non-detached, default-scheduled
+        creations; everything else replies 'unsupported' and takes the
+        scheduled path. The claimed slot is held until actor death
+        (credited back by the worker monitor)."""
+        if (spec.get("scheduling") or spec.get("runtime_env")
+                or spec.get("actor_name")
+                or spec.get("lifetime") == "detached"):
+            return {"status": "unsupported"}
+        claimed, refusal = await self._claim_local_slot(
+            spec.get("resources"))
+        if refusal is not None:
+            return refusal
+        cpu = claimed
+        actor_id = spec["actor_id"]
+        tpu_n = int((spec.get("resources") or {}).get("TPU", 0))
+        if tpu_n and len(self._free_tpu_chips) < tpu_n:
+            self._lease_blocks[cpu] = self._lease_blocks.get(cpu, 0) + 1
+            self.local_leases_spilled += 1
+            return {"status": "spill", "error": "tpu chips busy"}
+        self._assign_tpu_chips(spec)   # chips held until actor death
+        try:
+            handle = await self._acquire_worker()
+        except Exception as e:
+            self._release_tpu_chips(spec["task_id"])
+            self._lease_blocks[cpu] = self._lease_blocks.get(cpu, 0) + 1
+            self.local_leases_spilled += 1
+            return {"status": "spill", "error": repr(e)}
+        handle.state = "actor"
+        handle.actor_id = actor_id
+        handle.current_task = spec
+        self._local_actor_slots[actor_id] = cpu
+        try:
+            reply = await self.pool.get(handle.addr).call(
+                "create_actor", spec=spec)
+        except Exception as e:
+            self._local_actor_slots.pop(actor_id, None)
+            self._lease_blocks[cpu] = self._lease_blocks.get(cpu, 0) + 1
+            self._release_tpu_chips(spec["task_id"])
+            # transport error mid-create: __init__ MAY have succeeded
+            # in a still-alive worker — kill it, or the client's
+            # scheduled-path resubmission could start a SECOND live
+            # incarnation of this actor_id (the monitor reaps the proc;
+            # its actor_died for a never-registered actor is a no-op)
+            self._kill_proc(handle)
+            return {"status": "error", "error": repr(e)}
+        if reply.get("status") != "ok":
+            # __init__ raised: worker already pushed the owner the
+            # error; reusable worker goes back to the pool
+            self._local_actor_slots.pop(actor_id, None)
+            self._lease_blocks[cpu] = self._lease_blocks.get(cpu, 0) + 1
+            self._release_tpu_chips(spec["task_id"])
+            handle.state = "busy"
+            handle.actor_id = None
+            handle.current_task = None
+            self._release_worker(handle)
+            return {"status": "created_failed"}
+        self.local_leases_granted += 1
+        self._lease_activity = time.monotonic()
+        # async directory registration: the spec rides actor_started so
+        # the controller can build the ActorEntry it never saw; retried
+        # off the grant path — an actor the controller never learns is
+        # unkillable/unrestartable, so after the retries fail the actor
+        # is destroyed rather than left as a ghost
+        asyncio.ensure_future(
+            self._announce_local_actor(actor_id, handle, spec))
+        return {"status": "ok", "addr": list(handle.addr),
+                "worker_id": handle.worker_id}
+
+    async def _announce_local_actor(self, actor_id: str, handle,
+                                    spec: dict) -> None:
+        for attempt in range(5):
+            try:
+                await asyncio.wait_for(
+                    self.pool.get(self.controller_addr).call(
+                        "actor_started", actor_id=actor_id,
+                        addr=handle.addr, worker_id=handle.worker_id,
+                        spec=spec, node_id=self.node_id),
+                    timeout=10.0)
+                return
+            except Exception:
+                await asyncio.sleep(min(2.0 ** attempt, 10.0))
+        logger.warning(
+            "local actor %s could not be registered with the "
+            "controller after 5 attempts; destroying it (an "
+            "unregistered actor cannot be killed or restarted)",
+            actor_id[:12])
+        await self.rpc_kill_actor_worker(actor_id)
+
     async def rpc_lease_worker_local(self, resources: dict = None,
                                      owner_addr=None) -> dict:
         """Grant a worker lease WITHOUT a controller round-trip, from a
@@ -798,55 +969,30 @@ class NodeDaemon:
         global scheduler instead of a peer raylet — the controller is
         this design's spill target).
 
-        Only plain-CPU requests are served locally (everything else
-        needs global placement state): others reply 'unsupported'."""
-        from .config import get_config
-        cfg = get_config()
-        res = dict(resources or {})
-        mode = str(cfg.local_lease_enabled).lower()
-        if mode in ("0", "false"):
-            enabled = False
-        elif mode in ("1", "true"):
-            enabled = True
-        else:   # auto: only worth it when the controller hop crosses
-            # hosts (loopback grants measurably lose to the controller
-            # path — delegation churn with no latency saved). Hosts are
-            # resolved so hostname-vs-IP spellings of the same machine
-            # still compare equal.
-            if any(k != "CPU" for k in res):
-                return {"status": "unsupported"}
-            same = self._controller_same_host_tristate()
-            if same is None:
-                # resolution still in flight (off-loop DNS): 'spill'
-                # routes this one call to the controller WITHOUT the
-                # client latching local-lease-unsupported for good
-                return {"status": "spill"}
-            enabled = not same
-        if not enabled or any(k != "CPU" for k in res):
-            return {"status": "unsupported"}
-        cpu = float(res.get("CPU", 1.0))
-        if self.draining:
-            return {"status": "spill"}
-        while self._lease_blocks.get(cpu, 0) <= 0:
-            # grow the block; re-check after the await (a concurrent
-            # grant may have consumed what this call delegated)
-            try:
-                reply = await self.pool.get(self.controller_addr).call(
-                    "delegate_resources", node_id=self.node_id,
-                    resources={"CPU": cpu},
-                    count=max(1, cfg.lease_block_size))
-            except Exception:
-                reply = None
-            if not reply or reply.get("granted", 0) <= 0:
+        CPU and CPU+TPU requests are served locally (TPU chips are
+        pinned to the lease); everything else needs global placement
+        state and replies 'unsupported'."""
+        claimed, refusal = await self._claim_local_slot(resources)
+        if refusal is not None:
+            return refusal
+        cpu = claimed
+        # TPU leases pin specific chips for the lease lifetime; tasks
+        # dispatched through the lease inherit them (the scheduled path
+        # assigns per-task instead, _assign_tpu_chips)
+        tpu_n = int(dict(cpu).get("TPU", 0))
+        chips = None
+        if tpu_n:
+            if len(self._free_tpu_chips) < tpu_n:
+                self._lease_blocks[cpu] += 1
                 self.local_leases_spilled += 1
-                return {"status": "spill"}
-            self._lease_blocks[cpu] = (self._lease_blocks.get(cpu, 0)
-                                       + reply["granted"])
-        # slot claimed before the worker-acquire await (no double-grant)
-        self._lease_blocks[cpu] -= 1
+                return {"status": "spill", "error": "tpu chips busy"}
+            chips = self._free_tpu_chips[:tpu_n]
+            self._free_tpu_chips = self._free_tpu_chips[tpu_n:]
         reply = await self.rpc_reserve_worker()
         if reply.get("status") != "ok":
             self._lease_blocks[cpu] += 1
+            if chips:
+                self._free_tpu_chips.extend(chips)
             self.local_leases_spilled += 1
             return {"status": "spill", "error": reply.get("error")}
         import uuid as _uuid
@@ -855,6 +1001,7 @@ class NodeDaemon:
             "cpu": cpu, "worker_id": reply["worker_id"],
             "owner_addr": tuple(owner_addr) if owner_addr else None,
             "granted_at": time.monotonic(), "score": 0,
+            "tpu_chips": chips,
         }
         self._lease_activity = time.monotonic()
         self.local_leases_granted += 1
@@ -862,7 +1009,8 @@ class NodeDaemon:
                 "worker_addr": list(reply["addr"]),
                 "worker_id": reply["worker_id"],
                 "daemon_addr": list(self.address),
-                "node_id": self.node_id}
+                "node_id": self.node_id,
+                "tpu_chips": chips}
 
     def _controller_same_host_tristate(self):
         """True/False once known, None while resolving.
@@ -921,6 +1069,8 @@ class NodeDaemon:
             await self.rpc_destroy_worker(ent["worker_id"])
         else:
             await self.rpc_release_worker(ent["worker_id"])
+        if ent.get("tpu_chips"):
+            self._free_tpu_chips.extend(ent["tpu_chips"])
         if not ent.get("unbacked"):
             # unbacked = granted before a controller restart and not
             # re-acquired since (_reconcile_delegations): its slot no
@@ -945,15 +1095,19 @@ class NodeDaemon:
         stale_free: Dict[float, int] = {
             cpu: max(0, n) for cpu, n in self._lease_blocks.items()}
         stale = list(self._local_leases.items())
+        stale_actors = list(self._local_actor_slots.items())
         self._lease_blocks = {}
         # Pessimistic until re-acquired: a stale lease released DURING
         # the awaits below must not credit a block slot from the dead
         # controller epoch.
         for _, ent in stale:
             ent["unbacked"] = True
+        self._unbacked_actor_slots.update(a for a, _ in stale_actors)
         need: Dict[float, int] = dict(stale_free)
         for _, ent in stale:
             need[ent["cpu"]] = need.get(ent["cpu"], 0) + 1
+        for _, cpu in stale_actors:
+            need[cpu] = need.get(cpu, 0) + 1
         controller = self.pool.get(self.controller_addr)
         for cpu, count in need.items():
             if count <= 0:
@@ -962,7 +1116,7 @@ class NodeDaemon:
             try:
                 reply = await controller.call(
                     "delegate_resources", node_id=self.node_id,
-                    resources={"CPU": cpu}, count=count)
+                    resources=dict(cpu), count=count)
                 granted = int((reply or {}).get("granted", 0))
             except Exception:
                 granted = 0
@@ -974,6 +1128,12 @@ class NodeDaemon:
                         or self._local_leases.get(lid) is not ent):
                     continue
                 ent["unbacked"] = False
+                granted -= 1
+            for aid, acpu in stale_actors:
+                if (acpu != cpu or granted <= 0
+                        or aid not in self._local_actor_slots):
+                    continue
+                self._unbacked_actor_slots.discard(aid)
                 granted -= 1
             if granted > 0:
                 self._lease_blocks[cpu] = (
@@ -1033,7 +1193,7 @@ class NodeDaemon:
                     try:
                         await self.pool.get(self.controller_addr).call(
                             "return_delegation", node_id=self.node_id,
-                            resources={"CPU": cpu}, count=free)
+                            resources=dict(cpu), count=free)
                     except Exception:
                         self._lease_blocks[cpu] = (
                             self._lease_blocks.get(cpu, 0) + free)
@@ -1389,12 +1549,56 @@ class NodeDaemon:
             except Exception:
                 pass
 
+    # ------------------------------------------------- placement bundles
+
+    PG_PREPARE_TTL_S = 30.0
+
+    async def rpc_prepare_bundles(self, pg_id: str, bundles: list) -> dict:
+        """Phase 1 of the bundle 2PC: tentatively hold the bundles.
+        Expires after PG_PREPARE_TTL_S if no commit arrives (controller
+        died mid-2PC)."""
+        if self.draining or self._closed:
+            return {"ok": False}
+        self._pg_prepared[pg_id] = (list(bundles), time.monotonic())
+        return {"ok": True}
+
+    async def rpc_commit_bundles(self, pg_id: str) -> dict:
+        ent = self._pg_prepared.pop(pg_id, None)
+        if ent is None:
+            return {"ok": False}
+        self._pg_bundles.setdefault(pg_id, []).extend(ent[0])
+        return {"ok": True}
+
+    async def rpc_release_bundles(self, pg_id: str) -> dict:
+        self._pg_prepared.pop(pg_id, None)
+        self._pg_bundles.pop(pg_id, None)
+        return {"ok": True}
+
+    def _sweep_prepared_bundles(self) -> None:
+        now = time.monotonic()
+        for pg_id, (_, ts) in list(self._pg_prepared.items()):
+            if now - ts > self.PG_PREPARE_TTL_S:
+                self._pg_prepared.pop(pg_id, None)
+
+    def _credit_actor_slot(self, actor_id: str) -> None:
+        """Return a locally-created actor's delegated-block slot on its
+        death (no-op for scheduled actors; unbacked slots — shed by a
+        controller-restart reconciliation — credit nothing)."""
+        slot_cpu = self._local_actor_slots.pop(actor_id, None)
+        unbacked = actor_id in self._unbacked_actor_slots
+        self._unbacked_actor_slots.discard(actor_id)
+        if slot_cpu is not None and not unbacked:
+            self._lease_blocks[slot_cpu] = (
+                self._lease_blocks.get(slot_cpu, 0) + 1)
+            self._lease_activity = time.monotonic()
+
     async def rpc_kill_actor_worker(self, actor_id: str) -> bool:
         for handle in self.workers.values():
             if handle.actor_id == actor_id and handle.state == "actor":
                 if handle.current_task is not None:
                     self._release_tpu_chips(handle.current_task["task_id"])
                 self._kill_proc(handle)
+                self._credit_actor_slot(actor_id)
                 return True
         return False
 
@@ -1564,7 +1768,12 @@ class NodeDaemon:
                     reg = await controller.call(
                         "register_node", node_id=self.node_id,
                         addr=self.address, resources=self.resources,
-                        labels=self.labels)
+                        labels=self.labels,
+                        # committed-bundle ledger: the fresh controller
+                        # audits it against its persisted PG table
+                        pg_bundles=self._pg_bundles)
+                    for pg_id in (reg or {}).get("release_pgs", []):
+                        self._pg_bundles.pop(pg_id, None)
                     # fresh controller: resync view, restart command seqs
                     # (its new NodeEntry numbers commands from 1 again)
                     self._sync_acked = 0
@@ -1575,7 +1784,12 @@ class NodeDaemon:
                             hosted.add(h.actor_id)
                             ack = await controller.call(
                                 "actor_started", actor_id=h.actor_id,
-                                addr=h.addr, worker_id=h.worker_id)
+                                addr=h.addr, worker_id=h.worker_id,
+                                # spec rides along so a controller that
+                                # never saw this (locally-created) actor
+                                # can still rebuild the directory entry
+                                spec=h.current_task,
+                                node_id=self.node_id)
                             if (ack or {}).get("status") == "superseded":
                                 # a replacement is already queued/running;
                                 # two live incarnations must never coexist
@@ -1608,6 +1822,7 @@ class NodeDaemon:
             await self._check_memory_pressure()
             try:
                 await self._local_lease_sweep()
+                self._sweep_prepared_bundles()
             except Exception:
                 pass
             await self._pump_worker_logs(controller)
@@ -1632,6 +1847,10 @@ class NodeDaemon:
                     if spec is not None:
                         self._release_tpu_chips(spec["task_id"])
                     if prev_state == "actor" and handle.actor_id:
+                        # locally-created actor: its delegated-block
+                        # slot frees with the worker (unless shed by a
+                        # controller-restart reconciliation)
+                        self._credit_actor_slot(handle.actor_id)
                         try:
                             await controller.oneway(
                                 "actor_died", actor_id=handle.actor_id,

@@ -60,12 +60,10 @@ class PlacementGroupEntry:
 
     # ------------------------------------------------------------ placement
 
-    def try_place(self, nodes: List) -> Optional[str]:
-        """Attempt to choose nodes for all bundles (phase 1: prepare).
-
-        `nodes` is a list of controller NodeEntry (alive). Returns None on
-        success (bundles placed + resources acquired), or a reason string if
-        currently unplaceable ("" means retry later, non-empty means never).
+    def choose_nodes(self, nodes: List):
+        """Pick a node per bundle from the controller's availability
+        view WITHOUT committing. Returns (chosen, "") on success or
+        (None, reason) — "" retry later, non-empty never placeable.
         """
         alive = [n for n in nodes if n.alive]
         # Each attempt re-derives the infeasibility note from the CURRENT
@@ -83,7 +81,7 @@ class PlacementGroupEntry:
                 self.failure_reason = (
                     f"bundle {i} {b.resources} exceeds every alive node's "
                     f"total capacity (cluster may still be scaling up)")
-                return ""
+                return None, ""
         # Work on a scratch copy of availability so failed prepares roll back.
         scratch = {n.node_id: dict(n.resources_avail) for n in alive}
 
@@ -120,12 +118,12 @@ class PlacementGroupEntry:
                     self.failure_reason = (
                         "STRICT_PACK infeasible on current nodes: no "
                         "single node can hold all bundles")
-                return ""               # retry when resources free up
+                return None, ""         # retry when resources free up
             else:
                 # PACK soft-fallback: greedy first-fit across nodes.
                 chosen = self._greedy(alive, scratch, fits, take)
                 if chosen is None:
-                    return ""
+                    return None, ""
         elif self.strategy in ("STRICT_SPREAD", "SPREAD"):
             used_nodes: set = set()
             for i, b in enumerate(self.bundles):
@@ -140,21 +138,25 @@ class PlacementGroupEntry:
                             "STRICT_SPREAD infeasible on current nodes: "
                             f"{len(self.bundles)} bundles > "
                             f"{len(alive)} nodes")
-                    return ""
+                    return None, ""     # retry when nodes join/free up
                 node = max(cand, key=lambda n: sum(
                     scratch[n.node_id].get(k, 0.0) for k in b.resources))
                 chosen[i] = node.node_id
                 used_nodes.add(node.node_id)
                 take(node, b.resources)
 
-        # Phase 2: commit — deduct from the real node availability.
-        by_id = {n.node_id: n for n in alive}
+        return chosen, ""
+
+    def commit(self, chosen: List[str], nodes_by_id: Dict) -> None:
+        """Deduct the chosen bundles from the controller's node
+        availability and pin the assignments (does NOT flip state —
+        the caller sets CREATED once the daemon-side reservation is
+        confirmed)."""
         for b, node_id in zip(self.bundles, chosen):
             b.node_id = node_id
-            by_id[node_id].acquire(b.resources)
-        self.state = "CREATED"
-        self._wake()
-        return None
+            node = nodes_by_id.get(node_id)
+            if node is not None:
+                node.acquire(b.resources)
 
     def _wake(self) -> None:
         for ev in self.waiters:

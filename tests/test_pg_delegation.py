@@ -1,0 +1,105 @@
+"""Delegated placement-group bundles (distributed dispatch, VERDICT r4
+next-round #2): bundle reservations live in the DAEMONS' two-phase
+ledgers (prepare/commit, reference parity: raylet
+PrepareBundleResources/CommitBundleResources driven by the GCS
+scheduler), and controller-restart / daemon-restart reconciliation
+audits that ledger through the register_node payload."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+
+
+@pytest.fixture()
+def rt():
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _all_daemons(rt):
+    return [rt.head_daemon] + list(rt.extra_daemons)
+
+
+def test_bundles_committed_into_daemon_ledgers(rt):
+    ray_tpu.add_fake_node(num_cpus=2)
+    ray_tpu.add_fake_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="SPREAD")
+    assert pg.ready(timeout=60)
+    committed = {}
+    for d in _all_daemons(rt):
+        for pg_id, bundles in d._pg_bundles.items():
+            committed.setdefault(pg_id, []).extend(bundles)
+    assert pg.id in committed, "no daemon holds the PG's bundles"
+    assert sorted(b["index"] for b in committed[pg.id]) == [0, 1, 2]
+    # prepared map drained by the commit
+    assert all(pg.id not in d._pg_prepared for d in _all_daemons(rt))
+    remove_placement_group(pg)
+    deadline = time.time() + 20
+    while time.time() < deadline and any(
+            pg.id in d._pg_bundles for d in _all_daemons(rt)):
+        time.sleep(0.2)
+    assert all(pg.id not in d._pg_bundles for d in _all_daemons(rt)), \
+        "removal did not clear the daemon ledgers"
+
+
+def test_register_releases_orphan_bundles(rt):
+    """A daemon reporting bundles for a PG the controller no longer
+    knows is told to drop them."""
+    daemon = rt.head_daemon
+    loop = rt.loop_runner
+
+    async def _go():
+        daemon._pg_bundles["ghost-pg"] = [
+            {"index": 0, "resources": {"CPU": 1.0}}]
+        reply = await rt.controller.rpc_register_node(
+            node_id=daemon.node_id, addr=daemon.address,
+            resources=daemon.resources, labels=daemon.labels,
+            pg_bundles=daemon._pg_bundles)
+        return reply
+
+    reply = loop.run_sync(_go(), timeout=30)
+    assert "ghost-pg" in reply.get("release_pgs", []), reply
+
+
+def test_register_replaces_bundles_daemon_lost(rt):
+    """Controller believes a PG is CREATED on a node whose daemon
+    re-registers with an empty ledger (fresh process): the PG loses its
+    placement and goes back through the scheduler."""
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=60)
+    daemon = rt.head_daemon
+    loop = rt.loop_runner
+    entry = rt.controller.placement_groups[pg.id]
+    assert entry.state == "CREATED"
+
+    async def _reregister_empty():
+        # what a daemon-process restart looks like to the controller:
+        # same node id, no committed bundles
+        lost = dict(daemon._pg_bundles)
+        daemon._pg_bundles.clear()
+        await rt.controller.rpc_register_node(
+            node_id=daemon.node_id, addr=daemon.address,
+            resources=daemon.resources, labels=daemon.labels,
+            pg_bundles={})
+        return lost
+
+    loop.run_sync(_reregister_empty(), timeout=30)
+    # the PG re-places (this single-node cluster can host it again) and
+    # the fresh 2PC repopulates the daemon ledger
+    deadline = time.time() + 30
+    while time.time() < deadline and not (
+            entry.state == "CREATED" and pg.id in daemon._pg_bundles):
+        time.sleep(0.2)
+    assert entry.state == "CREATED"
+    assert pg.id in daemon._pg_bundles, \
+        "re-placement did not re-commit the daemon ledger"
+    # availability stayed consistent: exactly one bundle's worth held
+    node = rt.controller.nodes[daemon.node_id]
+    held = node.resources_total["CPU"] - node.resources_avail["CPU"]
+    assert abs(held - 1.0) < 1e-6, held
