@@ -306,8 +306,18 @@ class NodeObjectStore:
         self._entries: Dict[str, ShmStoreEntry] = {}
         self._seq = 0
         from .config import session_dir
-        self.spill_dir = spill_dir or os.path.join(
-            session_dir(session_name), "spill")
+        # RAY_TPU_SPILL_STORAGE may be a URI (gs://bucket/prefix,
+        # mock://spill, custom://...) — remote spill rides the same
+        # pyarrow-fs layer as checkpoints (train/storage.py), matching
+        # the reference's external storage backends
+        # (python/ray/_private/external_storage.py:72,272,482 —
+        # filesystem / S3-smart_open / mock)
+        self.spill_dir = (spill_dir
+                          or os.environ.get("RAY_TPU_SPILL_STORAGE")
+                          or os.path.join(
+                              session_dir(session_name), "spill"))
+        from ..train.storage import is_uri
+        self._spill_remote = is_uri(self.spill_dir)
         self.bytes_spilled = 0
         self.objects_spilled = 0
         self._spill_lock = threading.Lock()
@@ -356,6 +366,8 @@ class NodeObjectStore:
             if shm_name.startswith("spill:"):
                 path = shm_name[len("spill:"):]
                 try:
+                    if "://" in path:      # remote spill backend
+                        return _external_read(path, offset, end - offset)
                     with open(path, "rb") as f:
                         f.seek(offset)
                         return f.read(end - offset)
@@ -391,12 +403,19 @@ class NodeObjectStore:
             data = self.read_bytes(object_id)
             if data is None:
                 return False
-            os.makedirs(self.spill_dir, exist_ok=True)
-            path = os.path.join(self.spill_dir, object_id)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+            if self._spill_remote:
+                path = self.spill_dir.rstrip("/") + "/" + object_id
+                try:
+                    _external_write(path, data)
+                except Exception:
+                    return False       # backend down: keep the shm copy
+            else:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                path = os.path.join(self.spill_dir, object_id)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
             old_name = entry.shm_name
             # publish the new location BEFORE freeing the shm copy so a
             # concurrent reader either sees the old (still-valid) copy or
@@ -468,9 +487,13 @@ class NodeObjectStore:
         if entry is None:
             return
         if entry.shm_name.startswith("spill:"):
+            path = entry.shm_name[len("spill:"):]
             try:
-                os.unlink(entry.shm_name[len("spill:"):])
-            except OSError:
+                if "://" in path:
+                    _external_delete(path)
+                else:
+                    os.unlink(path)
+            except Exception:
                 pass
             return
         self._free_shm_copy(entry.shm_name, entry)
@@ -552,3 +575,34 @@ def read_from_shm(shm_name: str, size: int):
     serialized = SerializedObject.from_flat(shm.buf[:size])
     value = serialized.deserialize()
     return value, shm
+
+def _external_write(uri: str, data: bytes) -> None:
+    """Spill to a remote backend through the pyarrow-fs layer."""
+    from ..train.storage import get_fs_and_path
+    fs, fs_path = get_fs_and_path(uri)
+    parent = fs_path.rsplit("/", 1)[0]
+    try:
+        fs.create_dir(parent, recursive=True)
+    except Exception:
+        pass
+    with fs.open_output_stream(fs_path) as f:
+        f.write(data)
+
+
+def _external_read(uri: str, offset: int = 0,
+                   length: int = None) -> bytes:
+    """Ranged read from the spill backend: chunked cross-node transfers
+    call this once per chunk — seek+read, never a full-object
+    download per chunk."""
+    from ..train.storage import get_fs_and_path
+    fs, fs_path = get_fs_and_path(uri)
+    with fs.open_input_file(fs_path) as f:
+        if offset:
+            f.seek(offset)
+        return f.read(length)
+
+
+def _external_delete(uri: str) -> None:
+    from ..train.storage import get_fs_and_path
+    fs, fs_path = get_fs_and_path(uri)
+    fs.delete_file(fs_path)

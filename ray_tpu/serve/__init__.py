@@ -23,6 +23,7 @@ from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
 from ._private.proxy import Request, Response, StreamingHint
+from .asgi import ingress
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start",
@@ -34,7 +35,7 @@ __all__ = [
     "DeploymentResponseGenerator", "StreamingHint",
     "AutoscalingConfig",
     "DeploymentConfig", "HTTPOptions", "batch", "multiplexed",
-    "get_multiplexed_model_id", "Request", "Response",
+    "get_multiplexed_model_id", "Request", "Response", "ingress",
 ]
 
 

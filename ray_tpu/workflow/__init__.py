@@ -52,7 +52,12 @@ from typing import Any, Dict, List, Optional
 from ..dag.dag_node import DAGNode, FunctionNode
 
 __all__ = ["run", "resume", "get_output", "get_status", "list_all",
-           "delete", "storage_dir", "continuation", "Continuation"]
+           "delete", "storage_dir", "continuation", "Continuation",
+           "wait_for_event", "EventListener", "HTTPEventListener",
+           "start_http_event_provider"]
+
+from .events import (EventListener, HTTPEventListener,  # noqa: E402
+                     start_http_event_provider, wait_for_event)
 
 _STATUS = ("RUNNING", "SUCCESSFUL", "FAILED", "NOT_FOUND")
 

@@ -1,0 +1,249 @@
+"""Workflow events: durable waits on external signals.
+
+Reference parity: python/ray/workflow/event_listener.py (EventListener,
+wait_for_event) and http_event_provider.py:33 (HTTPEventProvider — a
+Serve deployment accepting POSTs that resolve waiting workflow steps).
+Rebuilt on this stack's primitives: the provider is a NAMED async actor
+hosting a minimal asyncio HTTP endpoint (no web framework dependency),
+and an event step is an ordinary checkpointed workflow step — once the
+event arrives its payload is durably recorded, so a later resume does
+not wait again; a run that crashes BEFORE the event re-arms the wait on
+resume, and the event bank inside the provider survives it.
+
+    from ray_tpu import workflow
+
+    provider = workflow.start_http_event_provider()     # named actor
+    dag = handle.bind(workflow.wait_for_event("key-1"))
+    workflow.run(dag, workflow_id="wf-ev")              # blocks on event
+
+    # elsewhere:  POST /event/send_event/key-1  {"by": "external"}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+PROVIDER_NAME = "__workflow_http_event_provider__"
+
+
+class EventListener:
+    """Subclass and implement ``poll_for_event`` (async) to integrate a
+    custom event source (reference: event_listener.py EventListener).
+    """
+
+    async def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+    async def event_checkpointed(self, event: Any) -> None:
+        """Optional ack hook. When overridden, it runs as a FOLLOW-ON
+        workflow step — i.e. strictly after the wait step's result is
+        checkpointed — so deleting the event from its source here never
+        loses it to a crash."""
+
+
+class HTTPEventListener(EventListener):
+    """Waits on the named HTTPEventProvider actor for ``event_key``
+    (sync block inside the event step's worker — the provider parks the
+    call on a future until the POST arrives)."""
+
+    def wait(self, event_key: str,
+             timeout: Optional[float] = None) -> Any:
+        import ray_tpu
+        provider = ray_tpu.get_actor(PROVIDER_NAME)
+        ref = provider.get_event.remote(event_key, timeout=timeout)
+        client_timeout = None if timeout is None else timeout + 15.0
+        return ray_tpu.get(ref, timeout=client_timeout)
+
+
+def _make_provider_class():
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0, max_concurrency=256)
+    class HTTPEventProvider:
+        """Bank of events keyed by event_key + a tiny HTTP POST
+        endpoint. Async actor: many ``get_event`` calls park on
+        futures concurrently.
+
+        HTTP contract (reference http_event_provider.py): POST
+        ``/event/send_event/<event_key>`` with a JSON body resolves
+        every waiting ``get_event(<event_key>)`` with that payload and
+        banks it for late/repeat waiters.
+        """
+
+        def __init__(self, port: int = 0):
+            # __init__ runs OFF the actor's event loop (sync executor):
+            # the HTTP server lazy-starts on the first async method call
+            self._events = {}
+            self._waiters = {}
+            self._want_port = port
+            self._port = None
+            self._server = None
+
+        async def _ensure_started(self) -> None:
+            if self._server is None:
+                await self._serve(self._want_port)
+
+        async def _serve(self, port: int) -> None:
+            import asyncio
+
+            async def handle(reader, writer):
+                try:
+                    request = await reader.readline()
+                    parts = request.decode("latin-1").split()
+                    method, path = (parts + ["", ""])[:2]
+                    length = 0
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b"\n", b""):
+                            break
+                        if line.lower().startswith(b"content-length:"):
+                            length = int(line.split(b":")[1])
+                    body = await reader.readexactly(length) if length \
+                        else b"{}"
+                    prefix = "/event/send_event/"
+                    if method == "POST" and path.startswith(prefix):
+                        key = path[len(prefix):]
+                        try:
+                            payload = json.loads(body.decode() or "{}")
+                        except ValueError:
+                            payload = {"raw": body.decode("latin-1")}
+                        await self.send_event(key, payload)
+                        out = json.dumps({"status": "ok",
+                                          "event_key": key}).encode()
+                        code = b"200 OK"
+                    else:
+                        out = b'{"error": "not found"}'
+                        code = b"404 Not Found"
+                    writer.write(
+                        b"HTTP/1.1 " + code
+                        + b"\r\nContent-Type: application/json"
+                        + b"\r\nContent-Length: "
+                        + str(len(out)).encode()
+                        + b"\r\nConnection: close\r\n\r\n" + out)
+                    await writer.drain()
+                except Exception:
+                    pass
+                finally:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+
+            self._server = await asyncio.start_server(
+                handle, host="127.0.0.1", port=port)
+            self._port = self._server.sockets[0].getsockname()[1]
+
+        async def get_port(self) -> int:
+            await self._ensure_started()
+            return self._port
+
+        async def send_event(self, event_key: str, payload) -> bool:
+            """Bank + deliver (also callable directly, without HTTP)."""
+            await self._ensure_started()
+            self._events[event_key] = payload
+            for fut in self._waiters.pop(event_key, []):
+                if not fut.done():
+                    fut.set_result(payload)
+            return True
+
+        async def get_event(self, event_key: str,
+                            timeout: float = None):
+            import asyncio
+            await self._ensure_started()
+            if event_key in self._events:
+                return self._events[event_key]
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters.setdefault(event_key, []).append(fut)
+            try:
+                return await asyncio.wait_for(fut, timeout=timeout)
+            finally:
+                # timed-out/cancelled waiters must not park an actor
+                # concurrency slot (and its future) forever
+                waiters = self._waiters.get(event_key)
+                if waiters and fut in waiters:
+                    waiters.remove(fut)
+                    if not waiters:
+                        self._waiters.pop(event_key, None)
+
+        async def pop_event(self, event_key: str):
+            """Consume a banked event (repeatable-key workflows)."""
+            return self._events.pop(event_key, None)
+
+    return HTTPEventProvider
+
+
+def start_http_event_provider(port: int = 0):
+    """Start (or fetch) the named provider actor. Returns its handle;
+    ``get_port()`` yields the bound HTTP port."""
+    import time as _time
+
+    import ray_tpu
+    try:
+        return ray_tpu.get_actor(PROVIDER_NAME)
+    except Exception:
+        pass
+    cls = _make_provider_class()
+    handle = cls.options(name=PROVIDER_NAME,
+                         lifetime="detached").remote(port)
+    # concurrent creators race on the name: the loser's creation fails,
+    # but the named lookup converges on the winner either way
+    try:
+        ray_tpu.get(handle.get_port.remote(), timeout=30)
+        return handle
+    except Exception:
+        for _ in range(20):
+            try:
+                return ray_tpu.get_actor(PROVIDER_NAME)
+            except Exception:
+                _time.sleep(0.25)
+        raise
+
+
+def wait_for_event(event_key_or_listener, *args,
+                   timeout: Optional[float] = None, **kwargs):
+    """A DAG node that completes when the event arrives; its payload is
+    the step result (checkpointed — resumes never re-wait once the
+    event landed). Pass an event-key string for the HTTP provider, or
+    an EventListener subclass for custom sources (reference:
+    api.py wait_for_event)."""
+    import asyncio
+
+    import ray_tpu
+
+    if isinstance(event_key_or_listener, str):
+        listener_cls = HTTPEventListener
+        args = (event_key_or_listener,) + args
+        kwargs.setdefault("timeout", timeout)
+    else:
+        listener_cls = event_key_or_listener
+
+    @ray_tpu.remote(num_cpus=0)
+    def __wait_for_event__(*a, **kw):
+        listener = listener_cls()
+        if isinstance(listener, HTTPEventListener):
+            return listener.wait(*a, **kw)
+        to = kw.pop("timeout", timeout)
+
+        async def go():
+            coro = listener.poll_for_event(*a, **kw)
+            if to is not None:
+                coro = asyncio.wait_for(coro, timeout=to)
+            return await coro
+
+        return asyncio.run(go())
+
+    node = __wait_for_event__.bind(*args, **kwargs)
+    if listener_cls.event_checkpointed is not             EventListener.event_checkpointed:
+        # ack in a SEPARATE step: a step only starts after its
+        # dependency's result is durably checkpointed, so the listener
+        # may safely delete the event from its source here (crash
+        # between poll and checkpoint re-polls; crash after checkpoint
+        # re-runs only this idempotent ack)
+        @ray_tpu.remote(num_cpus=0)
+        def __event_checkpointed__(event):
+            asyncio.run(listener_cls().event_checkpointed(event))
+            return event
+
+        node = __event_checkpointed__.bind(node)
+    return node

@@ -1,0 +1,142 @@
+"""ASGI ingress adapter (VERDICT r4 missing #5 / next-round #9;
+reference: python/ray/serve/api.py:172 @serve.ingress). FastAPI is not
+bundled in this image, so the protocol is exercised with a hand-rolled
+ASGI application (routing, query/body/headers, status codes, lifespan);
+a FastAPI test runs when the package is available."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(serve_cluster):
+    yield
+    try:
+        for app in list(serve.status()["applications"]):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+STARTED = {"flag": False}
+
+
+async def tiny_asgi_app(scope, receive, send):
+    """Minimal but protocol-complete ASGI app: lifespan + routes."""
+    if scope["type"] == "lifespan":
+        while True:
+            message = await receive()
+            if message["type"] == "lifespan.startup":
+                STARTED["flag"] = True
+                await send({"type": "lifespan.startup.complete"})
+            elif message["type"] == "lifespan.shutdown":
+                await send({"type": "lifespan.shutdown.complete"})
+                return
+    assert scope["type"] == "http"
+    message = await receive()
+    body = message.get("body", b"")
+
+    async def respond(status, payload, ctype=b"application/json"):
+        await send({"type": "http.response.start", "status": status,
+                    "headers": [(b"content-type", ctype)]})
+        await send({"type": "http.response.body", "body": payload})
+
+    path, method = scope["path"], scope["method"]
+    if path == "/hello" and method == "GET":
+        q = scope["query_string"].decode()
+        await respond(200, json.dumps(
+            {"hi": True, "q": q}).encode())
+    elif path == "/sum" and method == "POST":
+        data = json.loads(body or b"{}")
+        await respond(200, json.dumps(
+            {"sum": data["a"] + data["b"]}).encode())
+    elif path == "/echo-header":
+        hdrs = {k.decode(): v.decode() for k, v in scope["headers"]}
+        await respond(200, json.dumps(
+            {"x": hdrs.get("x-custom", "")}).encode())
+    elif path == "/chunked":
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"text/plain")]})
+        await send({"type": "http.response.body", "body": b"part1-",
+                    "more_body": True})
+        await send({"type": "http.response.body", "body": b"part2"})
+    else:
+        await respond(404, b'{"error": "nope"}')
+
+
+def test_asgi_ingress_end_to_end(serve_cluster):
+    import requests
+
+    @serve.deployment
+    @serve.ingress(tiny_asgi_app)
+    class Api:
+        pass
+
+    serve.run(Api.bind(), name="asgi", route_prefix="/api",
+              http_options=serve.HTTPOptions(port=8127))
+    base = "http://127.0.0.1:8127/api"
+    r = requests.get(base + "/hello?who=x", timeout=15)
+    assert r.status_code == 200 and r.json()["hi"] is True
+    assert "who=x" in r.json()["q"]
+    r = requests.post(base + "/sum", json={"a": 4, "b": 8}, timeout=15)
+    assert r.status_code == 200 and r.json() == {"sum": 12}
+    r = requests.get(base + "/echo-header",
+                     headers={"X-Custom": "abc"}, timeout=15)
+    assert r.json() == {"x": "abc"}
+    # multi-chunk ASGI bodies are buffered into one response
+    r = requests.get(base + "/chunked", timeout=15)
+    assert r.status_code == 200 and r.text == "part1-part2"
+    r = requests.get(base + "/missing", timeout=15)
+    assert r.status_code == 404
+
+
+def test_asgi_adapter_unit():
+    """Protocol-level checks without a cluster: scope fields + lifespan
+    startup ran."""
+    import asyncio
+
+    from ray_tpu.serve.asgi import ASGIAdapter
+    from ray_tpu.serve._private.proxy import Request
+
+    STARTED["flag"] = False
+    adapter = ASGIAdapter(tiny_asgi_app)
+    req = Request("POST", "/sum", {}, {"content-type": "application/json"},
+                  json.dumps({"a": 1, "b": 2}).encode())
+    resp = asyncio.run(adapter.handle(req))
+    assert resp.status == 200
+    assert json.loads(resp.body) == {"sum": 3}
+    assert resp.content_type == "application/json"
+    assert STARTED["flag"], "lifespan startup did not run"
+
+
+def test_fastapi_app_if_available(serve_cluster):
+    fastapi = pytest.importorskip("fastapi")
+    import requests
+
+    app = fastapi.FastAPI()
+
+    @app.get("/items/{item_id}")
+    def read_item(item_id: int):
+        return {"item_id": item_id}
+
+    @serve.deployment
+    @serve.ingress(app)
+    class FApi:
+        pass
+
+    serve.run(FApi.bind(), name="fastapi", route_prefix="/f",
+              http_options=serve.HTTPOptions(port=8128))
+    r = requests.get("http://127.0.0.1:8128/f/items/7", timeout=15)
+    assert r.status_code == 200 and r.json() == {"item_id": 7}
