@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import functools as _functools
 import os
 import threading
 from multiprocessing import resource_tracker, shared_memory
@@ -318,6 +319,13 @@ class NodeObjectStore:
                               session_dir(session_name), "spill"))
         from ..train.storage import is_uri
         self._spill_remote = is_uri(self.spill_dir)
+        # Per-store namespace in the remote key: every node may share
+        # one RAY_TPU_SPILL_STORAGE prefix, and object ids alias across
+        # nodes (staged foreign copies carry the owner's id) — without
+        # the namespace, node B freeing its staged copy would delete
+        # node A's spilled primary.
+        import uuid as _uuid
+        self._spill_ns = _uuid.uuid4().hex[:12]
         self.bytes_spilled = 0
         self.objects_spilled = 0
         self._spill_lock = threading.Lock()
@@ -404,7 +412,8 @@ class NodeObjectStore:
             if data is None:
                 return False
             if self._spill_remote:
-                path = self.spill_dir.rstrip("/") + "/" + object_id
+                path = (self.spill_dir.rstrip("/") + "/"
+                        + self._spill_ns + "/" + object_id)
                 try:
                     _external_write(path, data)
                 except Exception:
@@ -576,10 +585,24 @@ def read_from_shm(shm_name: str, size: int):
     value = serialized.deserialize()
     return value, shm
 
+@_functools.lru_cache(maxsize=32)
+def _spill_fs_for(base_uri: str):
+    from ..train.storage import get_fs_and_path
+    return get_fs_and_path(base_uri)
+
+
+def _spill_fs_and_path(uri: str):
+    """Resolve + CACHE the filesystem by URI base: chunked reads hit
+    this once per chunk, and rebuilding a cloud FileSystem (auth,
+    channel setup) per chunk would dominate the transfer."""
+    base, _, name = uri.rpartition("/")
+    fs, dir_path = _spill_fs_for(base)
+    return fs, dir_path.rstrip("/") + "/" + name
+
+
 def _external_write(uri: str, data: bytes) -> None:
     """Spill to a remote backend through the pyarrow-fs layer."""
-    from ..train.storage import get_fs_and_path
-    fs, fs_path = get_fs_and_path(uri)
+    fs, fs_path = _spill_fs_and_path(uri)
     parent = fs_path.rsplit("/", 1)[0]
     try:
         fs.create_dir(parent, recursive=True)
@@ -594,8 +617,7 @@ def _external_read(uri: str, offset: int = 0,
     """Ranged read from the spill backend: chunked cross-node transfers
     call this once per chunk — seek+read, never a full-object
     download per chunk."""
-    from ..train.storage import get_fs_and_path
-    fs, fs_path = get_fs_and_path(uri)
+    fs, fs_path = _spill_fs_and_path(uri)
     with fs.open_input_file(fs_path) as f:
         if offset:
             f.seek(offset)
@@ -603,6 +625,5 @@ def _external_read(uri: str, offset: int = 0,
 
 
 def _external_delete(uri: str) -> None:
-    from ..train.storage import get_fs_and_path
-    fs, fs_path = get_fs_and_path(uri)
+    fs, fs_path = _spill_fs_and_path(uri)
     fs.delete_file(fs_path)

@@ -51,10 +51,14 @@ class Request:
 
 class Response:
     def __init__(self, body: Any = b"", status: int = 200,
-                 content_type: str = "application/octet-stream"):
+                 content_type: str = "application/octet-stream",
+                 headers: Optional[Dict[str, str]] = None):
         self.body = body
         self.status = status
         self.content_type = content_type
+        # extra response headers (Location, Set-Cookie, ...); content
+        # length is recomputed by the proxy
+        self.headers = headers
 
 
 class StreamingHint:
@@ -184,8 +188,12 @@ class ProxyActor:
                 body = json.dumps(body).encode()
             elif isinstance(body, str):
                 body = body.encode()
+            extra = {k: v for k, v in (result.headers or {}).items()
+                     if k.lower() not in ("content-type",
+                                          "content-length")}
             return web.Response(body=body, status=result.status,
-                                content_type=result.content_type)
+                                content_type=result.content_type,
+                                headers=extra or None)
         if isinstance(result, (dict, list)):
             return web.json_response(result)
         if isinstance(result, str):

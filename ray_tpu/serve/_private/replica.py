@@ -132,7 +132,17 @@ class Replica:
                 "qps_10s": recent / 10.0}
 
     async def prepare_for_shutdown(self) -> None:
-        """Drain: wait for ongoing requests to finish (graceful stop)."""
+        """Drain: wait for ongoing requests to finish (graceful stop),
+        then run the instance's teardown hook if it defines one (the
+        ASGI ingress wrapper uses it to send lifespan.shutdown)."""
         deadline = time.time() + 30
         while self._ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.05)
+        hook = getattr(self._instance, "__serve_shutdown__", None)
+        if hook is not None:
+            try:
+                result = hook()
+                if inspect.isawaitable(result):
+                    await asyncio.wait_for(result, timeout=10.0)
+            except Exception:
+                pass   # teardown is best-effort

@@ -28,7 +28,6 @@ body); use the native StreamingHint ingress for SSE/chunked streams.
 from __future__ import annotations
 
 import asyncio
-import functools
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlencode
 
@@ -40,7 +39,7 @@ class ASGIAdapter:
 
     def __init__(self, app):
         self.app = app
-        self._lifespan_started = False
+        self._startup: Optional[asyncio.Future] = None
         self._startup_error: Optional[Exception] = None
         self._lifespan_receive_q: Optional[asyncio.Queue] = None
 
@@ -48,7 +47,6 @@ class ASGIAdapter:
         """Best-effort lifespan.startup (FastAPI apps that register
         startup hooks need it; apps without a lifespan handler raise —
         that is allowed by the spec and simply skipped)."""
-        self._lifespan_started = True
         receive_q: asyncio.Queue = asyncio.Queue()
         started = asyncio.get_event_loop().create_future()
 
@@ -69,22 +67,32 @@ class ASGIAdapter:
             self.app({"type": "lifespan", "asgi": {"version": "3.0"}},
                      receive, send))
         self._lifespan_receive_q = receive_q
-        try:
-            await asyncio.wait_for(asyncio.shield(started), timeout=10.0)
-        except RuntimeError as e:
+        # watch BOTH the completion future and the app task: an app
+        # that raises on the lifespan scope (no lifespan support, per
+        # spec) is detected instantly, not after a 10s stall
+        done, _ = await asyncio.wait(
+            {started, self._lifespan_task},
+            timeout=10.0, return_when=asyncio.FIRST_COMPLETED)
+        if started in done and started.exception() is not None:
             # the app REPORTED lifespan.startup.failed: serving against
             # a half-initialized app produces confusing per-request
             # errors — fail loudly instead (ASGI spec: do not serve)
-            self._startup_error = e
-            raise
-        except (asyncio.TimeoutError, Exception):
-            # app raised on the lifespan scope / never answered: the
-            # spec allows apps without lifespan support — serve anyway
+            self._startup_error = started.exception()
+            raise RuntimeError(
+                f"ASGI app startup failed: {self._startup_error}")
+        if started not in done:
+            # app died on / ignored the lifespan scope: allowed by the
+            # spec — serve without lifespan
             self._lifespan_task.cancel()
+        if not started.done():
+            started.cancel()
 
     async def handle(self, request: Request) -> Response:
-        if not self._lifespan_started:
-            await self._start_lifespan()
+        if self._startup is None:
+            # one shared startup: concurrent first requests all await
+            # the same future instead of racing past a boolean
+            self._startup = asyncio.ensure_future(self._start_lifespan())
+        await asyncio.shield(self._startup)
         if self._startup_error is not None:
             raise RuntimeError(
                 f"ASGI app startup failed: {self._startup_error}")
@@ -132,11 +140,16 @@ class ASGIAdapter:
         await self.app(scope, receive, send)
         done.set()
         content_type = "application/octet-stream"
+        extra: Dict[str, str] = {}
         for k, v in status["headers"]:
-            if k.decode("latin-1").lower() == "content-type":
+            name = k.decode("latin-1").lower()
+            if name == "content-type":
                 content_type = v.decode("latin-1").split(";")[0].strip()
+            elif name != "content-length":   # proxy recomputes length
+                extra[name] = v.decode("latin-1")
         return Response(b"".join(chunks), status=status["code"],
-                        content_type=content_type)
+                        content_type=content_type,
+                        headers=extra or None)
 
 
     async def aclose(self) -> None:

@@ -65,7 +65,11 @@ def _make_provider_class():
         endpoint. Async actor: many ``get_event`` calls park on
         futures concurrently.
 
-        HTTP contract (reference http_event_provider.py): POST
+        Binds 0.0.0.0 by default (the contract is EXTERNAL signaling,
+    like the reference's cluster-reachable Serve deployment); set
+    RAY_TPU_EVENT_HTTP_HOST=127.0.0.1 to keep it local.
+
+    HTTP contract (reference http_event_provider.py): POST
         ``/event/send_event/<event_key>`` with a JSON body resolves
         every waiting ``get_event(<event_key>)`` with that payload and
         banks it for late/repeat waiters.
@@ -130,8 +134,12 @@ def _make_provider_class():
                     except Exception:
                         pass
 
+            import os
             self._server = await asyncio.start_server(
-                handle, host="127.0.0.1", port=port)
+                handle,
+                host=os.environ.get("RAY_TPU_EVENT_HTTP_HOST",
+                                    "0.0.0.0"),
+                port=port)
             self._port = self._server.sockets[0].getsockname()[1]
 
         async def get_port(self) -> int:

@@ -66,6 +66,11 @@ async def tiny_asgi_app(scope, receive, send):
         hdrs = {k.decode(): v.decode() for k, v in scope["headers"]}
         await respond(200, json.dumps(
             {"x": hdrs.get("x-custom", "")}).encode())
+    elif path == "/redirect":
+        await send({"type": "http.response.start", "status": 307,
+                    "headers": [(b"location", b"/api/hello"),
+                                (b"set-cookie", b"sid=1")]})
+        await send({"type": "http.response.body", "body": b""})
     elif path == "/chunked":
         await send({"type": "http.response.start", "status": 200,
                     "headers": [(b"content-type", b"text/plain")]})
@@ -100,6 +105,12 @@ def test_asgi_ingress_end_to_end(serve_cluster):
     assert r.status_code == 200 and r.text == "part1-part2"
     r = requests.get(base + "/missing", timeout=15)
     assert r.status_code == 404
+    # response headers (Location, Set-Cookie) pass through the proxy
+    r = requests.get(base + "/redirect", timeout=15,
+                     allow_redirects=False)
+    assert r.status_code == 307
+    assert r.headers.get("Location") == "/api/hello"
+    assert "sid=1" in r.headers.get("Set-Cookie", "")
 
 
 def test_asgi_adapter_unit():
