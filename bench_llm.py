@@ -14,6 +14,7 @@ path runs (kernel correctness is covered by interpret-mode tests).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -203,9 +204,10 @@ def bench_speculative(on_tpu: bool) -> dict:
         return round(toks / dt, 1), eng.stats()
 
     plain_tps, _ = run(None)
+    spec_k = int(os.environ.get("RAY_TPU_BENCH_SPEC_K", "4"))
     spec_tps, st = run({"draft_model": target,
                         "draft_params": tparams,
-                        "num_speculative_tokens": 4})
+                        "num_speculative_tokens": spec_k})
     return {"plain_tokens_per_sec": plain_tps,
             "spec_tokens_per_sec": spec_tps,
             "spec_speedup": round(spec_tps / max(plain_tps, 1e-9), 2),
