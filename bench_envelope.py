@@ -281,6 +281,71 @@ def bench_lease_grant(n: int) -> dict:
             "local_speedup": round(t_ctrl / max(t_local, 1e-9), 2)}
 
 
+def bench_big_object(gib: float = 10.0) -> dict:
+    """Move a >8 GiB object end-to-end under spill pressure (VERDICT r4
+    weak #10; reference row: 100 GiB single ray.get on a 64-core host).
+    The arena is shrunk to 64 MB so the object CANNOT live in shm —
+    it spills on seal and every consumer restores from the spill file
+    through the chunked plane; a cross-daemon task forces the full
+    socket transfer as well."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import object_store as om
+
+    prev_arena = om.ARENA_DEFAULT_BYTES
+    om.ARENA_DEFAULT_BYTES = 64 << 20
+    ray_tpu.init(num_cpus=4)
+    ray_tpu.add_fake_node(num_cpus=2, labels={"side": "b"})
+    n = int(gib * (1 << 30) // 8)
+    big = np.arange(n, dtype=np.float64)
+    want = float(big[:: 1 << 20].sum())
+
+    t0 = time.time()
+    ref = ray_tpu.put(big)
+    t_put = time.time() - t0
+    del big
+    # big objects land in their own segment; force it onto the spill
+    # backend so every consumer below RESTORES from spill (plus arena
+    # churn so the pressure loop spills concurrently)
+    store = None
+    import ray_tpu._private.worker as worker_mod
+    store = worker_mod._runtime.head_daemon.object_store
+    spilled_big = store.spill(ref.id)
+    churn = [ray_tpu.put(np.ones(1 << 20, np.float64))
+             for _ in range(24)]
+    del churn
+
+    from ray_tpu.util.scheduling_strategies import (
+        NodeLabelSchedulingStrategy)
+
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        {"side": "b"}))
+    def strided_sum(x):
+        return float(x[:: 1 << 20].sum())
+
+    t0 = time.time()
+    got = ray_tpu.get(strided_sum.remote(ref), timeout=3600)
+    t_task = time.time() - t0
+    assert got == want, (got, want)
+
+    t0 = time.time()
+    back = ray_tpu.get(ref, timeout=3600)
+    t_get = time.time() - t0
+    assert back.nbytes == n * 8
+    del back
+    stats = {"objects_spilled": store.objects_spilled,
+             "bytes_spilled": store.bytes_spilled,
+             "big_object_spilled": bool(spilled_big)}
+    ray_tpu.shutdown()
+    om.ARENA_DEFAULT_BYTES = prev_arena   # later rows get normal arenas
+    return {"row": "big_object", "gib": gib,
+            "put_s": round(t_put, 1),
+            "cross_daemon_task_s": round(t_task, 1),
+            "driver_get_s": round(t_get, 1),
+            "spill": stats}
+
+
 def bench_envelope_10x(n_daemons: int = 32, n_actors: int = 5000,
                        wave: int = 250, n_tasks: int = 200_000,
                        chaos_kill: int = 4) -> dict:
@@ -395,6 +460,10 @@ def main() -> None:
             print(json.dumps(rows[-1]), flush=True)
         if "nn_multi" in wanted:
             rows.append(bench_nn_multidaemon(4, 8, 8, 500 // scale))
+            print(json.dumps(rows[-1]), flush=True)
+        if "big_object" in wanted:
+            ray_tpu.shutdown()      # row re-inits with a tiny arena
+            rows.append(bench_big_object(10.0 / scale))
             print(json.dumps(rows[-1]), flush=True)
         if "envelope10x" in wanted:
             rows.append(bench_envelope_10x(

@@ -43,6 +43,7 @@ class DashboardHead:
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/placement_groups", self._pgs)
+        app.router.add_get("/api/serve", self._serve_status)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/profile/stacks", self._profile_stacks)
@@ -108,6 +109,17 @@ class DashboardHead:
                 1 for t in tasks
                 if t.get("state", "").startswith("PENDING")),
         })
+
+    async def _serve_status(self, request):
+        """Serve application/deployment status (reference parity:
+        dashboard serve module over the serve controller)."""
+        def read():
+            try:
+                from .. import serve
+                return serve.status()
+            except Exception:
+                return {"applications": {}}
+        return self._json(await self._in_thread(read))
 
     async def _profile_stacks(self, request):
         """py-spy-equivalent: live thread stacks of the head + every

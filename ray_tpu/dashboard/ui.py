@@ -59,7 +59,7 @@ INDEX_HTML = """<!DOCTYPE html>
 <main id="main"></main>
 <script>
 const TABS = ["cluster","nodes","actors","tasks","placement_groups",
-              "jobs","objects","profile","timeline"];
+              "serve","jobs","objects","metrics","profile","timeline"];
 let tab = location.hash.slice(1) || "cluster";
 const $ = (id) => document.getElementById(id);
 const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
@@ -70,19 +70,86 @@ function renderTabs() {
     `<button class="${t===tab?"on":""}" onclick="setTab('${t}')">`
     + `${t.replace("_"," ")}</button>`).join("");
 }
-function setTab(t) { tab = t; location.hash = t; renderTabs(); refresh(); }
+function setTab(t) {
+  tab = t; location.hash = t;
+  sortKey = null; sortDir = 1; filterText = "";
+  renderTabs(); refresh();
+}
 
 async function api(path) {
   const r = await fetch(path);
   if (!r.ok) throw new Error(`${path}: ${r.status}`);
   return r.json();
 }
-function table(rows, cols) {
+let sortKey = null, sortDir = 1, filterText = "";
+function table(rows, cols, limit) {
   if (!rows || !rows.length) return `<p class="dim">none</p>`;
-  const head = cols.map(c => `<th>${c[0]}</th>`).join("");
+  if (filterText) {
+    const f = filterText.toLowerCase();
+    rows = rows.filter(r => JSON.stringify(r).toLowerCase().includes(f));
+  }
+  if (sortKey != null) {
+    const col = cols[sortKey];
+    rows = [...rows].sort((a, b) => {
+      let av = stripTags(col[1](a)), bv = stripTags(col[1](b));
+      const na = parseFloat(av), nb = parseFloat(bv);
+      if (!isNaN(na) && !isNaN(nb)) { av = na; bv = nb; }
+      return (av > bv ? 1 : av < bv ? -1 : 0) * sortDir;
+    });
+  }
+  // truncate AFTER filter+sort, so searches reach every row
+  const total = rows.length;
+  if (limit && rows.length > limit) rows = rows.slice(0, limit);
+  const head = cols.map((c, i) =>
+    `<th style="cursor:pointer" onclick="sortBy(${i})">${c[0]}` +
+    `${sortKey===i ? (sortDir>0?" \u25b4":" \u25be") : ""}</th>`).join("");
   const body = rows.map(r =>
     `<tr>${cols.map(c => `<td>${c[1](r)}</td>`).join("")}</tr>`).join("");
-  return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+  const note = limit && total > limit
+    ? `<span class="dim"> showing ${limit} of ${total}</span>` : "";
+  return `<input id="filter" placeholder="filter..." value="${esc(filterText)}"`
+    + ` oninput="setFilter(this.value)" style="margin:0 0 8px;background:var(--panel);`
+    + `border:1px solid var(--line);border-radius:6px;color:var(--fg);`
+    + `padding:5px 10px;font:inherit;width:220px">` + note
+    + `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+}
+const stripTags = (s) => String(s ?? "").replace(/<[^>]*>/g, "");
+function sortBy(i) {
+  if (sortKey === i) sortDir = -sortDir; else { sortKey = i; sortDir = 1; }
+  refresh();
+}
+let filterTimer = null;
+function setFilter(v) {
+  filterText = v;
+  clearTimeout(filterTimer);
+  filterTimer = setTimeout(() => {
+    refresh().then(() => {
+      const el = $("filter");
+      if (el) { el.focus(); el.setSelectionRange(v.length, v.length); }
+    });
+  }, 250);
+}
+// in-browser metric history: ring buffers fed on every refresh tick
+const HISTORY = {};   // key -> [{t, v}]
+function record(key, v) {
+  if (v == null || isNaN(v)) return;
+  const arr = HISTORY[key] = HISTORY[key] || [];
+  arr.push({t: Date.now(), v: Number(v)});
+  if (arr.length > 240) arr.shift();   // ~12 min at 3s ticks
+}
+function spark(key, w = 180, h = 28) {
+  const arr = HISTORY[key] || [];
+  if (arr.length < 2) return `<span class="dim">collecting…</span>`;
+  const vs = arr.map(p => p.v);
+  const lo = Math.min(...vs), hi = Math.max(...vs);
+  const span = Math.max(hi - lo, 1e-9);
+  const pts = arr.map((p, i) =>
+    `${(i/(arr.length-1)*w).toFixed(1)},` +
+    `${(h - 2 - (p.v - lo)/span*(h-4)).toFixed(1)}`).join(" ");
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">` +
+    `<polyline points="${pts}" fill="none" stroke="var(--acc)"` +
+    ` stroke-width="1.5"/></svg>` +
+    ` <span class="dim">${Math.round(lo*100)/100}…${Math.round(hi*100)/100}</span>`;
 }
 const shortid = (s) => `<span title="${esc(s)}">${esc(String(s||"").slice(0,12))}</span>`;
 const alive = (a) => a ? `<span class="ok">ALIVE</span>`
@@ -144,14 +211,14 @@ const VIEWS = {
   async tasks() {
     const rows = await api("/api/tasks");
     rows.sort((a,b) => (b.creation_time||0)-(a.creation_time||0));
-    return table(rows.slice(0,200), [
+    return table(rows, [
       ["task", r => shortid(r.task_id)],
       ["name", r => esc(r.name)],
       ["type", r => esc(r.type)],
       ["state", r => r.state === "FINISHED" ? `<span class="ok">FINISHED</span>`
           : r.state === "FAILED" ? `<span class="bad">FAILED</span>` : esc(r.state)],
       ["node", r => shortid(r.node_id)],
-    ]);
+    ], 200);
   },
   async placement_groups() {
     const data = await api("/api/placement_groups");
@@ -176,12 +243,64 @@ const VIEWS = {
   },
   async objects() {
     const rows = await api("/api/objects");
-    return table(rows.slice(0,200), [
+    return table(rows, [
       ["object", r => shortid(r.object_id)],
       ["size", r => `${Math.round((r.size||0)/1024)} KiB`],
       ["backend", r => esc(r.backend)],
       ["node", r => shortid(r.node_id)],
+    ], 200);
+  },
+  async serve() {
+    const s = await api("/api/serve");
+    const apps = s.applications || {};
+    const rows = [];
+    for (const [app, info] of Object.entries(apps)) {
+      const deps = (info.deployments || info || {});
+      for (const [dep, d] of Object.entries(
+          typeof deps === "object" ? deps : {})) {
+        rows.push({app, dep, status: d.status || info.status || "?",
+                   replicas: d.replica_states || d.replicas || "",
+                   route: info.route_prefix || ""});
+      }
+      if (!Object.keys(deps).length)
+        rows.push({app, dep: "", status: info.status || "?",
+                   replicas: "", route: info.route_prefix || ""});
+    }
+    if (!rows.length) return `<p class="dim">serve not running</p>`;
+    return table(rows, [
+      ["app", r => esc(r.app)],
+      ["deployment", r => esc(r.dep)],
+      ["status", r => r.status === "RUNNING" || r.status === "HEALTHY"
+          ? `<span class="ok">${esc(r.status)}</span>` : esc(r.status)],
+      ["replicas", r => esc(JSON.stringify(r.replicas))],
+      ["route", r => esc(r.route)],
     ]);
+  },
+  async metrics() {
+    // feed ring buffers from the cluster summary + per-node stats
+    const [s, nodes] = await Promise.all(
+      [api("/api/cluster_status"), api("/api/nodes")]);
+    record("pending tasks", s.num_pending_tasks);
+    record("actors", s.num_actors);
+    record("CPUs free", (s.available_resources||{}).CPU);
+    let nodeRows = "";
+    for (const n of nodes) {
+      const id = String(n.node_id).slice(0, 8);
+      record(`store ${id}`, (n.stats||{}).object_store_bytes);
+      record(`workers ${id}`, (n.stats||{}).num_workers);
+      nodeRows += `<tr><td>${esc(id)}</td>` +
+        `<td>${spark("store " + id)}</td>` +
+        `<td>${spark("workers " + id)}</td></tr>`;
+    }
+    return `<p class="dim">live history (in-browser ring buffers,
+      3s ticks; <a href="/metrics" style="color:inherit">raw
+      Prometheus</a>)</p>` +
+      `<div class="cards">` +
+      ["pending tasks","actors","CPUs free"].map(k =>
+        `<div class="card"><div class="k">${k}</div>` +
+        `<div>${spark(k)}</div></div>`).join("") + `</div>` +
+      `<table><thead><tr><th>node</th><th>store bytes</th>` +
+      `<th>workers</th></tr></thead><tbody>${nodeRows}</tbody></table>`;
   },
   async timeline() {
     const data = await api("/api/timeline");
@@ -226,7 +345,13 @@ async function refresh() {
 }
 renderTabs();
 refresh();
-setInterval(() => { if (tab !== "profile") refresh(); }, 3000);
+setInterval(() => {
+  // never yank the DOM out from under someone typing in the filter
+  if (tab === "profile") return;
+  if (document.activeElement && document.activeElement.id === "filter")
+    return;
+  refresh();
+}, 3000);
 </script>
 </body>
 </html>

@@ -35,7 +35,9 @@ class TuneController:
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  max_failures: int = 0,
                  time_budget_s: Optional[float] = None,
-                 stop: Optional[Dict[str, float]] = None):
+                 stop: Optional[Dict[str, float]] = None,
+                 experiment_path: Optional[str] = None,
+                 checkpoint_period_s: float = 10.0):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler(searcher.metric,
@@ -49,6 +51,13 @@ class TuneController:
         self.trials: List[Trial] = []
         self._failures: Dict[str, int] = {}
         self._searcher_done = False
+        # Experiment-level durability (reference parity:
+        # tune_controller.py:351 save_to_dir / :424 restore_from_dir —
+        # searcher + scheduler + trial table persist so a killed driver
+        # resumes the SWEEP, not just individual trials).
+        self.experiment_path = experiment_path
+        self.checkpoint_period_s = checkpoint_period_s
+        self._last_experiment_save = 0.0
 
     # -- trial lifecycle ----------------------------------------------------
 
@@ -226,13 +235,92 @@ class TuneController:
             self._handle_result(trial, result)
         return True
 
+    # -- experiment-level save/restore -------------------------------------
+
+    def save_experiment(self, path: Optional[str] = None) -> str:
+        """Snapshot searcher + scheduler + trial table to one file.
+        In-flight trials are recorded as PAUSED at their last
+        checkpoint (their running actors cannot persist); a restore
+        re-launches them from that checkpoint."""
+        import os
+
+        import cloudpickle
+        path = path or self.experiment_path
+        assert path, "no experiment_path configured"
+        snap = []
+        for t in self.trials:
+            status = t.status
+            if status not in (TERMINATED, ERROR):
+                status = PAUSED if t.checkpoint is not None else PENDING
+            snap.append({
+                "trial_id": t.trial_id, "config": t.config,
+                "status": status, "last_result": t.last_result,
+                "results": t.results, "checkpoint": t.checkpoint,
+                "error": t.error, "iteration": t.iteration,
+            })
+        state = {"searcher": self.searcher, "scheduler": self.scheduler,
+                 "trials": snap, "failures": dict(self._failures),
+                 "searcher_done": self._searcher_done}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, path)
+        self._last_experiment_save = time.time()
+        return path
+
+    def restore_experiment(self, path: Optional[str] = None) -> None:
+        """Load a save_experiment snapshot: finished trials keep their
+        results, interrupted ones re-enter the queue from their last
+        checkpoint, and the searcher/scheduler continue exactly where
+        the sweep stopped (TPE's observation history, HyperBand rungs,
+        PBT populations all survive)."""
+        import cloudpickle
+        path = path or self.experiment_path
+        with open(path, "rb") as f:
+            state = cloudpickle.load(f)
+        self.searcher = state["searcher"]
+        self.scheduler = state["scheduler"]
+        self._failures = dict(state["failures"])
+        self._searcher_done = state["searcher_done"]
+        self.trials = []
+        for s in state["trials"]:
+            t = Trial(s["trial_id"], s["config"])
+            t.status = s["status"]
+            t.last_result = s["last_result"]
+            t.results = s["results"]
+            t.checkpoint = s["checkpoint"]
+            t.error = s["error"]
+            t.iteration = s["iteration"]
+            if t.status == PAUSED:
+                t.restore_payload = t.checkpoint
+            self.trials.append(t)
+        from ..trial import advance_trial_counter_past
+        advance_trial_counter_past(t2.trial_id for t2 in self.trials)
+
+    def _maybe_save_experiment(self) -> None:
+        if (self.experiment_path
+                and time.time() - self._last_experiment_save
+                >= self.checkpoint_period_s):
+            try:
+                self.save_experiment()
+            except Exception:
+                logger.exception("experiment checkpoint failed")
+
     def run(self) -> List[Trial]:
         start = time.time()
         while self.step():
+            self._maybe_save_experiment()
             if self.time_budget_s and time.time() - start > self.time_budget_s:
                 break
         for trial in self._live():
             self._stop_trial(trial, TERMINATED, save_first=True)
             self.searcher.on_trial_complete(trial.trial_id,
                                             trial.last_result or None)
+        if self.experiment_path:
+            try:
+                self.save_experiment()
+            except Exception:
+                logger.exception("final experiment checkpoint failed")
         return self.trials

@@ -59,6 +59,9 @@ class BasicVariantGenerator(Searcher):
         self.space = space
         self.num_samples = num_samples
         self.rng = np.random.default_rng(seed)
+        # initial RNG state: experiment restore replays the emitted
+        # prefix from here (the live rng has advanced past it)
+        self._rng_init_state = self.rng.bit_generator.state
         self._iter = self._generate()
         self.total = num_samples * count_grid_variants(space)
 
@@ -68,9 +71,29 @@ class BasicVariantGenerator(Searcher):
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         try:
-            return next(self._iter)
+            config = next(self._iter)
         except StopIteration:
             return Searcher.FINISHED
+        self._emitted = getattr(self, "_emitted", 0) + 1
+        return config
+
+    # Experiment snapshots pickle the searcher; a generator can't
+    # pickle, but the stream is deterministic given (space, rng seed,
+    # emitted count) — rebuild and fast-forward on restore.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_iter", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.rng.bit_generator.state = self._rng_init_state
+        self._iter = self._generate()
+        for _ in range(getattr(self, "_emitted", 0)):
+            try:
+                next(self._iter)
+            except StopIteration:
+                break
 
 
 class RandomSearch(BasicVariantGenerator):

@@ -49,3 +49,19 @@ class Trial:
 
 def new_trial_id() -> str:
     return f"trial_{next(_counter):05d}"
+
+
+def advance_trial_counter_past(trial_ids) -> None:
+    """Experiment restore in a FRESH process: the global counter starts
+    at 0 again, which would reissue restored trial ids and merge two
+    trials' scheduler/searcher state. Fast-forward past the max."""
+    global _counter
+    import itertools
+    top = -1
+    for tid in trial_ids:
+        try:
+            top = max(top, int(str(tid).rsplit("_", 1)[-1]))
+        except ValueError:
+            continue
+    current = next(_counter)
+    _counter = itertools.count(max(current, top + 1))

@@ -30,6 +30,11 @@ class TuneConfig:
     time_budget_s: Optional[float] = None
     seed: Optional[int] = None
     stop: Optional[Dict[str, float]] = None
+    # experiment-level durability: snapshot searcher/scheduler/trials
+    # here every checkpoint_period_s; Tuner.restore(path, trainable)
+    # resumes the sweep
+    experiment_path: Optional[str] = None
+    checkpoint_period_s: float = 10.0
 
 
 @dataclasses.dataclass
@@ -117,6 +122,7 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config
+        self._restore_path: Optional[str] = None
 
     def fit(self) -> ResultGrid:
         cfg = self.tune_config
@@ -143,9 +149,31 @@ class Tuner:
             resources_per_trial=resources,
             max_failures=max_failures,
             time_budget_s=cfg.time_budget_s,
-            stop=cfg.stop)
+            stop=cfg.stop,
+            experiment_path=cfg.experiment_path,
+            checkpoint_period_s=cfg.checkpoint_period_s)
+        if self._restore_path:
+            controller.restore_experiment(self._restore_path)
         trials = controller.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any,
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Any = None) -> "Tuner":
+        """Resume an interrupted sweep from an experiment snapshot
+        (reference parity: Tuner.restore — searcher observation
+        history, scheduler state, finished-trial results all carry
+        over; interrupted trials re-launch from their last
+        checkpoint). Call .fit() on the returned Tuner."""
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        if tuner.tune_config.experiment_path is None:
+            # copy, don't mutate: the caller may reuse its TuneConfig
+            tuner.tune_config = dataclasses.replace(
+                tuner.tune_config, experiment_path=path)
+        tuner._restore_path = path
+        return tuner
 
 
 def run(trainable: Any, *, config: Optional[Dict[str, Any]] = None,

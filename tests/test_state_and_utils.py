@@ -188,6 +188,26 @@ def test_internal_kv(ray_start):
 
 # ---------------------------------------------------------------- dashboard
 
+def test_dashboard_serve_endpoint_and_ui_tabs(ray_start):
+    """Round-5 UI upgrade: /api/serve endpoint + serve/metrics tabs,
+    sortable/filterable tables (single-file SPA — no build step by
+    design; the reference ships a React app)."""
+    import urllib.request
+    import json as _json
+    from ray_tpu.dashboard import start_dashboard
+    dash = start_dashboard(port=0)
+    base = f"http://127.0.0.1:{dash.port}"
+    with urllib.request.urlopen(base + "/api/serve", timeout=15) as r:
+        data = _json.loads(r.read())
+    assert "applications" in data
+    with urllib.request.urlopen(base + "/", timeout=15) as r:
+        html = r.read().decode()
+    for needle in ('"serve"', '"metrics"', "sortBy", "setFilter",
+                   "spark("):
+        assert needle in html, needle
+
+
+
 def test_dashboard_and_job_submission(ray_start):
     import requests
 

@@ -1,0 +1,116 @@
+"""Experiment-level save/resume (VERDICT r4 weak #9; reference:
+tune/execution/tune_controller.py:351 save_to_dir / :424
+restore_from_dir + Tuner.restore): the SWEEP survives a driver crash —
+searcher observation history, scheduler state, and finished-trial
+results carry over; only unfinished work re-runs."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import TPESearch
+from ray_tpu.tune.execution.tune_controller import TuneController
+from ray_tpu.tune.trainable import wrap_function
+from ray_tpu.tune.trial import ERROR, TERMINATED
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    rt = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_controller_experiment_save_restore(ray_start, tmp_path):
+    marker = str(tmp_path / "executions")
+
+    def objective(config):
+        with open(marker, "a") as f:
+            f.write("x\n")
+        tune.report({"loss": (config["x"] - 0.5) ** 2})
+
+    space = {"x": tune.uniform(-1.0, 1.0)}
+    snap = str(tmp_path / "exp.pkl")
+    tpe = TPESearch(space, metric="loss", mode="min", num_samples=12,
+                    n_startup_trials=4, seed=0)
+    c1 = TuneController(wrap_function(objective), tpe,
+                        max_concurrent=1, experiment_path=snap,
+                        checkpoint_period_s=0.0)
+    # run PART of the sweep, then "crash" (abandon the controller)
+    for _ in range(200):
+        finished = [t for t in c1.trials if t.is_finished]
+        if len(finished) >= 5 or not c1.step():
+            break
+    c1.save_experiment()
+    for t in c1._live():                      # reap the leaked actor
+        if t.actor is not None:
+            try:
+                ray_tpu.kill(t.actor)
+            except Exception:
+                pass
+    done_before = {t.trial_id for t in c1.trials if t.is_finished}
+    assert 1 <= len(done_before) < 12
+    runs_before = open(marker).read().count("x")
+
+    # a fresh controller (different seed on its throwaway searcher —
+    # the RESTORED searcher replaces it) resumes the sweep
+    tpe2 = TPESearch(space, metric="loss", mode="min", num_samples=12,
+                     n_startup_trials=4, seed=999)
+    c2 = TuneController(wrap_function(objective), tpe2,
+                        max_concurrent=1, experiment_path=snap)
+    c2.restore_experiment()
+    assert {t.trial_id for t in c2.trials
+            if t.is_finished} == done_before, \
+        "finished trials lost across restore"
+    trials = c2.run()
+
+    assert len(trials) == 12, "searcher did not continue the sweep"
+    assert all(t.status in (TERMINATED, ERROR) for t in trials)
+    assert done_before <= {t.trial_id for t in trials}
+    # finished trials did NOT re-execute: total executions is 12 plus
+    # at most one re-run of the trial that was in flight at the crash
+    runs_total = open(marker).read().count("x")
+    assert runs_total - runs_before <= (12 - len(done_before)) + 1
+    # the sweep still optimizes end-to-end
+    best = min(t.last_result["loss"] for t in trials
+               if t.last_result and "loss" in t.last_result)
+    assert best < 0.5
+
+    # the final snapshot reflects completion: restoring it again shows
+    # a finished experiment (nothing left to run)
+    c3 = TuneController(wrap_function(objective), tpe2,
+                        max_concurrent=1, experiment_path=snap)
+    c3.restore_experiment()
+    assert all(t.is_finished for t in c3.trials)
+    assert len(c3.trials) == 12
+
+
+def test_tuner_restore_api(ray_start, tmp_path):
+    """The Tuner.restore(path, trainable) surface."""
+    from ray_tpu.tune.tuner import Tuner, TuneConfig
+
+    def objective(config):
+        tune.report({"score": -abs(config["x"] - 0.25)})
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    snap = str(tmp_path / "exp2.pkl")
+    tuner = Tuner(objective, param_space=space,
+                  tune_config=TuneConfig(
+                      metric="score", mode="max", num_samples=6,
+                      max_concurrent_trials=2, experiment_path=snap,
+                      checkpoint_period_s=0.0))
+    grid = tuner.fit()
+    assert len(grid) == 6 and os.path.exists(snap)
+
+    restored = Tuner.restore(snap, objective,
+                             tune_config=TuneConfig(
+                                 metric="score", mode="max",
+                                 num_samples=6))
+    grid2 = restored.fit()
+    # nothing re-ran: same trials, same best
+    assert {r.trial_id for r in grid2.results} == \
+        {r.trial_id for r in grid.results}
+    assert grid2.get_best_result().metrics["score"] == \
+        grid.get_best_result().metrics["score"]
