@@ -295,6 +295,20 @@ def bench_big_object(gib: float = 10.0) -> dict:
 
     prev_arena = om.ARENA_DEFAULT_BYTES
     om.ARENA_DEFAULT_BYTES = 64 << 20
+    try:
+        return _bench_big_object_inner(gib, om)
+    finally:
+        om.ARENA_DEFAULT_BYTES = prev_arena
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+def _bench_big_object_inner(gib: float, om) -> dict:
+    import numpy as np
+
+    import ray_tpu
     ray_tpu.init(num_cpus=4)
     ray_tpu.add_fake_node(num_cpus=2, labels={"side": "b"})
     n = int(gib * (1 << 30) // 8)
@@ -337,8 +351,6 @@ def bench_big_object(gib: float = 10.0) -> dict:
     stats = {"objects_spilled": store.objects_spilled,
              "bytes_spilled": store.bytes_spilled,
              "big_object_spilled": bool(spilled_big)}
-    ray_tpu.shutdown()
-    om.ARENA_DEFAULT_BYTES = prev_arena   # later rows get normal arenas
     return {"row": "big_object", "gib": gib,
             "put_s": round(t_put, 1),
             "cross_daemon_task_s": round(t_task, 1),

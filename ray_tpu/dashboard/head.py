@@ -114,11 +114,19 @@ class DashboardHead:
         """Serve application/deployment status (reference parity:
         dashboard serve module over the serve controller)."""
         def read():
+            from .. import serve
             try:
-                from .. import serve
                 return serve.status()
-            except Exception:
-                return {"applications": {}}
+            except Exception as e:
+                # distinguish "serve not running" (benign empty) from a
+                # genuine controller failure (surfaced in the payload)
+                msg = repr(e)
+                benign = isinstance(e, (ValueError, KeyError)) or \
+                    "not running" in msg or "no controller" in msg.lower()
+                out = {"applications": {}}
+                if not benign:
+                    out["error"] = msg
+                return out
         return self._json(await self._in_thread(read))
 
     async def _profile_stacks(self, request):

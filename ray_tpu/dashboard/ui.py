@@ -91,7 +91,11 @@ function table(rows, cols, limit) {
   if (sortKey != null) {
     const col = cols[sortKey];
     rows = [...rows].sort((a, b) => {
-      let av = stripTags(col[1](a)), bv = stripTags(col[1](b));
+      const rawA = String(col[1](a)), rawB = String(col[1](b));
+      const dvA = rawA.match(/data-v="([-\\d.e]+)"/);
+      const dvB = rawB.match(/data-v="([-\\d.e]+)"/);
+      let av = dvA ? dvA[1] : stripTags(rawA);
+      let bv = dvB ? dvB[1] : stripTags(rawB);
       const na = parseFloat(av), nb = parseFloat(bv);
       if (!isNaN(na) && !isNaN(nb)) { av = na; bv = nb; }
       return (av > bv ? 1 : av < bv ? -1 : 0) * sortDir;
@@ -138,18 +142,33 @@ function record(key, v) {
   if (arr.length > 240) arr.shift();   // ~12 min at 3s ticks
 }
 function spark(key, w = 180, h = 28) {
+  // buffers fill only while the metrics tab renders; the x-axis is
+  // TIME-based and the line BREAKS across sampling gaps, so history
+  // never misrepresents a spike that spans an unobserved window
   const arr = HISTORY[key] || [];
   if (arr.length < 2) return `<span class="dim">collecting…</span>`;
   const vs = arr.map(p => p.v);
   const lo = Math.min(...vs), hi = Math.max(...vs);
   const span = Math.max(hi - lo, 1e-9);
-  const pts = arr.map((p, i) =>
-    `${(i/(arr.length-1)*w).toFixed(1)},` +
-    `${(h - 2 - (p.v - lo)/span*(h-4)).toFixed(1)}`).join(" ");
-  return `<svg width="${w}" height="${h}" style="vertical-align:middle">` +
-    `<polyline points="${pts}" fill="none" stroke="var(--acc)"` +
-    ` stroke-width="1.5"/></svg>` +
-    ` <span class="dim">${Math.round(lo*100)/100}…${Math.round(hi*100)/100}</span>`;
+  const t0 = arr[0].t, t1 = arr[arr.length-1].t;
+  const tspan = Math.max(t1 - t0, 1);
+  const segs = [];
+  let seg = [];
+  for (let i = 0; i < arr.length; i++) {
+    if (i && arr[i].t - arr[i-1].t > 10000) {   // >10s: sampling gap
+      if (seg.length > 1) segs.push(seg);
+      seg = [];
+    }
+    seg.push(`${((arr[i].t - t0)/tspan*w).toFixed(1)},` +
+      `${(h - 2 - (arr[i].v - lo)/span*(h-4)).toFixed(1)}`);
+  }
+  if (seg.length > 1) segs.push(seg);
+  const lines = segs.map(s =>
+    `<polyline points="${s.join(" ")}" fill="none" stroke="var(--acc)"` +
+    ` stroke-width="1.5"/>`).join("");
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">`
+    + lines + `</svg>`
+    + ` <span class="dim">${Math.round(lo*100)/100}…${Math.round(hi*100)/100}</span>`;
 }
 const shortid = (s) => `<span title="${esc(s)}">${esc(String(s||"").slice(0,12))}</span>`;
 const alive = (a) => a ? `<span class="ok">ALIVE</span>`
@@ -161,7 +180,10 @@ const fmtBytes = (n) => {
   const units = ["B","KB","MB","GB","TB"];
   let i = 0, v = Number(n);
   while (v >= 1024 && i < units.length - 1) { v /= 1024; i++; }
-  return esc(`${Math.round(v*10)/10}${units[i]}`);
+  // data-v carries the raw byte count so column sort is numeric, not
+  // lexicographic over "1.5GB" vs "900KB"
+  return `<span data-v="${Number(n)}">`
+    + esc(`${Math.round(v*10)/10}${units[i]}`) + `</span>`;
 };
 
 const VIEWS = {

@@ -20,7 +20,7 @@ from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.sac import SAC, SACConfig
 from .core.learner import Learner, LearnerGroup
 from .core.multi_rl_module import MultiRLModule
-from .core.rl_module import DefaultRLModule, RLModule
+from .core.rl_module import CNNRLModule, DefaultRLModule, RLModule
 from .env.env_runner import SingleAgentEnvRunner
 from .env.env_runner_group import EnvRunnerGroup
 from .env.jax_env import CartPole, EnvSpec, JaxEnv, Pendulum, register_env
@@ -40,7 +40,7 @@ __all__ = [
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "OfflineData",
     "record_samples", "ReplayBuffer",
     "Learner", "LearnerGroup", "RLModule",
-    "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",
+    "CNNRLModule", "DefaultRLModule", "SingleAgentEnvRunner", "EnvRunnerGroup",
     "JaxEnv", "CartPole", "Pendulum", "EnvSpec", "register_env",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiRLModule",
     "MultiAgentJaxEnv", "DualCartPole", "RockPaperScissors",

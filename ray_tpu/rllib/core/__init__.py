@@ -1,3 +1,4 @@
-from .rl_module import DefaultRLModule, RLModule, build_module
+from .rl_module import (CNNRLModule, DefaultRLModule, RLModule,
+                        build_module)
 from .learner import Learner, LearnerGroup, LearnerHyperparams
 from . import distributions, postprocessing

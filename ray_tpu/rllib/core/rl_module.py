@@ -90,6 +90,75 @@ class DefaultRLModule(RLModule):
         return {"action_dist_inputs": logits, "vf": vf}
 
 
+class _ActorCriticCNN(nn.Module):
+    """Shared conv torso + separate policy/value heads (the Nature-CNN
+    shape scaled to the env image; convs land on the MXU on TPU)."""
+    obs_shape: Sequence[int]
+    channels: Sequence[int]
+    dense: int
+    out_dim: int
+
+    n_frames: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        b = x.shape[0]
+        h, w, c = self.obs_shape
+        if self.n_frames > 1:
+            # frame-major flat input: fold frames into CHANNELS (a raw
+            # reshape to (H, W, C*N) would interleave frames into row
+            # blocks and scramble spatial locality)
+            img = x.reshape(b, self.n_frames, h, w, c)
+            img = jnp.concatenate(
+                [img[:, i] for i in range(self.n_frames)], axis=-1)
+        else:
+            img = x.reshape(b, h, w, c)
+        for i, ch in enumerate(self.channels):
+            img = nn.relu(nn.Conv(
+                ch, (3, 3), strides=(2, 2) if i else (1, 1))(img))
+        flat = img.reshape(b, -1)
+        h = nn.relu(nn.Dense(self.dense)(flat))
+        logits = nn.Dense(self.out_dim,
+                          kernel_init=nn.initializers.orthogonal(0.01))(h)
+        vf = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0))(h)
+        return logits, vf[..., 0]
+
+
+class CNNRLModule(RLModule):
+    """Pixel-observation actor-critic: the env's flat obs vector is
+    reshaped to spec.obs_shape (H, W, C) — under feature-wise frame
+    stacking the stacked copies become extra channels. Use via
+    ``.rl_module(module_class=CNNRLModule)`` (reference role: the
+    Atari CNN default in catalog-built torch/TF modules)."""
+
+    def __init__(self, spec, channels: Sequence[int] = (16, 32),
+                 dense: int = 128):
+        super().__init__(spec)
+        base = tuple(getattr(spec, "obs_shape", ()) or ())
+        if len(base) != 3:
+            raise ValueError(
+                f"CNNRLModule needs spec.obs_shape == (H, W, C); "
+                f"got {base!r}")
+        pixels = base[0] * base[1] * base[2]
+        if spec.obs_dim % pixels:
+            raise ValueError(
+                f"obs_dim {spec.obs_dim} is not a multiple of "
+                f"prod(obs_shape) {pixels} — mixed pixel+vector "
+                f"observations need a custom module")
+        n_frames = spec.obs_dim // pixels     # framestack factor
+        out_dim = spec.num_actions if spec.discrete else 2 * spec.action_dim
+        self._net = _ActorCriticCNN(base, tuple(channels),
+                                    dense, out_dim, n_frames)
+
+    def init(self, key):
+        dummy = jnp.zeros((1, self.spec.obs_dim), jnp.float32)
+        return self._net.init(key, dummy)
+
+    def apply(self, params, obs):
+        logits, vf = self._net.apply(params, obs)
+        return {"action_dist_inputs": logits, "vf": vf}
+
+
 def build_module(spec,
                  module_class: Optional[type] = None,
                  model_config: Optional[Dict[str, Any]] = None) -> RLModule:

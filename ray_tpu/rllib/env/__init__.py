@@ -1,3 +1,4 @@
-from .jax_env import CartPole, EnvSpec, JaxEnv, Pendulum, make_env, register_env
+from .jax_env import (CartPole, CatchPixels, EnvSpec, JaxEnv, Pendulum,
+                      make_env, register_env)
 from .env_runner import SingleAgentEnvRunner
 from .env_runner_group import EnvRunnerGroup

@@ -31,6 +31,9 @@ class EnvSpec:
     num_actions: int          # >0 -> discrete; 0 -> continuous
     action_dim: int = 0       # for continuous envs
     max_episode_steps: int = 500
+    # pixel envs: the (H, W, C) the flat obs vector reshapes to — lets
+    # conv modules recover the image without a side channel
+    obs_shape: Tuple[int, ...] = ()
 
     @property
     def discrete(self) -> bool:
@@ -129,11 +132,69 @@ class Pendulum(JaxEnv):
         return (s2, t2), self._obs(s2), -cost, done
 
 
+class CatchPixels(JaxEnv):
+    """Pixel-observation catch game — the in-image-budget stand-in for
+    the reference's Atari PPO learning regression
+    (rllib/benchmarks/ppo/benchmark_atari_ppo.py commits reward
+    targets; ale-py is not in this image). A ball falls one row per
+    step on a HxW grid; a 3-px paddle on the bottom row moves
+    left/stay/right; catching scores +1, missing -1, ball respawns.
+    Observations are the raw pixels (ball 1.0, paddle 0.5) flattened —
+    solvable only by reading the image, which is the point: it gates
+    the CNN module + frame pipeline end to end.
+
+    Random play expects about -4 per 8-drop episode; the committed
+    regression target is +4 (>=75% catch rate)."""
+
+    H, W = 10, 12
+    PAD = 1            # paddle half-width
+
+    def __init__(self, max_episode_steps: int = 80):
+        self.spec = EnvSpec(obs_dim=self.H * self.W, num_actions=3,
+                            max_episode_steps=max_episode_steps,
+                            obs_shape=(self.H, self.W, 1))
+
+    def _render(self, ball_r, ball_c, pad_c):
+        grid = jnp.zeros((self.H, self.W), jnp.float32)
+        grid = grid.at[ball_r, ball_c].set(1.0)
+        cols = jnp.clip(pad_c + jnp.arange(-self.PAD, self.PAD + 1),
+                        0, self.W - 1)
+        grid = grid.at[self.H - 1, cols].add(0.5)
+        return jnp.clip(grid, 0.0, 1.0).reshape(-1)
+
+    def reset(self, key):
+        k1, k2 = jax.random.split(key)
+        ball_c = jax.random.randint(k1, (), 0, self.W)
+        pad_c = jax.random.randint(k2, (), self.PAD, self.W - self.PAD)
+        state = (jnp.zeros((), jnp.int32), ball_c, pad_c,
+                 jnp.zeros((), jnp.int32))
+        return state, self._render(state[0], ball_c, pad_c)
+
+    def step(self, state, action, key):
+        ball_r, ball_c, pad_c, t = state
+        pad_c = jnp.clip(pad_c + (action - 1), self.PAD,
+                         self.W - 1 - self.PAD)
+        ball_r = ball_r + 1
+        at_bottom = ball_r >= self.H - 1
+        caught = jnp.abs(ball_c - pad_c) <= self.PAD
+        reward = jnp.where(at_bottom,
+                           jnp.where(caught, 1.0, -1.0), 0.0)
+        new_c = jax.random.randint(key, (), 0, self.W)
+        ball_r = jnp.where(at_bottom, 0, ball_r)
+        ball_c = jnp.where(at_bottom, new_c, ball_c)
+        t2 = t + 1
+        done = t2 >= self.spec.max_episode_steps
+        s2 = (ball_r, ball_c, pad_c, t2)
+        return s2, self._render(ball_r, ball_c, pad_c), reward, done
+
+
 _ENV_REGISTRY: Dict[str, Callable[[], JaxEnv]] = {
     "CartPole-v1": CartPole,
     "CartPole": CartPole,
     "Pendulum-v1": Pendulum,
     "Pendulum": Pendulum,
+    "CatchPixels-v0": CatchPixels,
+    "CatchPixels": CatchPixels,
 }
 
 

@@ -152,10 +152,18 @@ class Tuner:
             stop=cfg.stop,
             experiment_path=cfg.experiment_path,
             checkpoint_period_s=cfg.checkpoint_period_s)
+        metric, mode = cfg.metric, cfg.mode
         if self._restore_path:
             controller.restore_experiment(self._restore_path)
+            # a bare restore (no tune_config) recovers metric/mode from
+            # the pickled searcher so get_best_result just works
+            if metric is None:
+                metric = controller.searcher.metric
+                mode = controller.searcher.mode or mode
+            controller.scheduler.set_search_properties(
+                controller.searcher.metric, controller.searcher.mode)
         trials = controller.run()
-        return ResultGrid(trials, cfg.metric, cfg.mode)
+        return ResultGrid(trials, metric, mode)
 
     @classmethod
     def restore(cls, path: str, trainable: Any,

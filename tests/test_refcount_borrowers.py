@@ -50,6 +50,14 @@ def test_three_hop_borrower_chain_keeps_object_alive(ray_start):
         def read_sum(self):
             return float(ray_tpu.get(self.ref).sum())
 
+        def read_via_task(self):
+            # a task whose ARG borrows from this borrower (hop 3)
+            @ray_tpu.remote
+            def rd(box):
+                return float(ray_tpu.get(box[0]).sum())
+
+            return ray_tpu.get(rd.remote([self.ref]))
+
         def drop(self):
             self.ref = None
             gc.collect()
@@ -69,25 +77,12 @@ def test_three_hop_borrower_chain_keeps_object_alive(ray_start):
     gc.collect()
     time.sleep(1.0)
 
-    @ray_tpu.remote
-    def reader(box):                                  # hop 3 (task)
-        import numpy as _np
-        return float(ray_tpu.get(box[0]).sum())
-
-    # B forwards its borrowed ref into a fresh task — 3 processes away
-    # from the owner, after the owner released
+    # direct read at hop 2
     assert ray_tpu.get(b.read_sum.remote(), timeout=60) == want
-
-    @ray_tpu.remote(num_cpus=0)
-    class Runner:
-        def run(self, other):
-            # build hop 3 INSIDE a borrower so the task borrows from a
-            # borrower, not from the owner
-            inner_ref = None
-            return ray_tpu.get(other.read_sum.remote())
-
-    r = Runner.remote()
-    assert ray_tpu.get(r.run.remote(b), timeout=60) == want
+    # hop 3: the BORROWER B forwards its borrowed ref into a fresh
+    # task (spawned inside B's worker) — three processes from the
+    # owner, after the owner released
+    assert ray_tpu.get(b.read_via_task.remote(), timeout=120) == want
 
     # unwind the chain: all borrower pins must drain at the owner
     client = ray_start.client
