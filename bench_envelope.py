@@ -296,7 +296,7 @@ def bench_big_object(gib: float = 10.0) -> dict:
     prev_arena = om.ARENA_DEFAULT_BYTES
     om.ARENA_DEFAULT_BYTES = 64 << 20
     try:
-        return _bench_big_object_inner(gib, om)
+        return _bench_big_object_inner(gib)
     finally:
         om.ARENA_DEFAULT_BYTES = prev_arena
         try:
@@ -305,7 +305,7 @@ def bench_big_object(gib: float = 10.0) -> dict:
             pass
 
 
-def _bench_big_object_inner(gib: float, om) -> dict:
+def _bench_big_object_inner(gib: float) -> dict:
     import numpy as np
 
     import ray_tpu

@@ -156,16 +156,18 @@ function spark(key, w = 180, h = 28) {
   let seg = [];
   for (let i = 0; i < arr.length; i++) {
     if (i && arr[i].t - arr[i-1].t > 10000) {   // >10s: sampling gap
-      if (seg.length > 1) segs.push(seg);
+      if (seg.length) segs.push(seg);
       seg = [];
     }
-    seg.push(`${((arr[i].t - t0)/tspan*w).toFixed(1)},` +
-      `${(h - 2 - (arr[i].v - lo)/span*(h-4)).toFixed(1)}`);
+    seg.push([((arr[i].t - t0)/tspan*w).toFixed(1),
+      (h - 2 - (arr[i].v - lo)/span*(h-4)).toFixed(1)]);
   }
-  if (seg.length > 1) segs.push(seg);
-  const lines = segs.map(s =>
-    `<polyline points="${s.join(" ")}" fill="none" stroke="var(--acc)"` +
-    ` stroke-width="1.5"/>`).join("");
+  if (seg.length) segs.push(seg);
+  const lines = segs.map(s => s.length === 1
+    // an isolated sample still shows: dot instead of zero-length line
+    ? `<circle cx="${s[0][0]}" cy="${s[0][1]}" r="1.5" fill="var(--acc)"/>`
+    : `<polyline points="${s.map(p => p.join(",")).join(" ")}"` +
+      ` fill="none" stroke="var(--acc)" stroke-width="1.5"/>`).join("");
   return `<svg width="${w}" height="${h}" style="vertical-align:middle">`
     + lines + `</svg>`
     + ` <span class="dim">${Math.round(lo*100)/100}…${Math.round(hi*100)/100}</span>`;
@@ -274,6 +276,8 @@ const VIEWS = {
   },
   async serve() {
     const s = await api("/api/serve");
+    if (s.error) return `<p class="bad">serve controller error: `
+      + `${esc(s.error)}</p>`;
     const apps = s.applications || {};
     const rows = [];
     for (const [app, info] of Object.entries(apps)) {

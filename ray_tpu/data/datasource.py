@@ -208,7 +208,7 @@ def read_webdataset(paths, *, decode: bool = True) -> Dataset:
             return v.tolist() if isinstance(v, np.ndarray) else v
 
         tables: List[Any] = []
-        for at in range(0, len(order), CHUNK):
+        for at in builtins.range(0, len(order), CHUNK):
             keys = order[at:at + CHUNK]
             # explicit pa.array per column: the generic tensor
             # conversion in _to_table flattens nested lists (decoded
