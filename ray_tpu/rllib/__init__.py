@@ -14,6 +14,7 @@ from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.appo import APPO, APPOConfig
 from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.dreamer_v3 import DreamerV3, DreamerV3Config
 from .algorithms.impala import IMPALA, IMPALAConfig
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from .algorithms.ppo import PPO, PPOConfig
@@ -36,7 +37,7 @@ from .utils.replay_buffers import ReplayBuffer
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
     "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
-    "SAC", "SACConfig", "CQL", "CQLConfig",
+    "SAC", "SACConfig", "CQL", "CQLConfig", "DreamerV3", "DreamerV3Config",
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "OfflineData",
     "record_samples", "ReplayBuffer",
     "Learner", "LearnerGroup", "RLModule",

@@ -3,3 +3,5 @@ from .ppo import PPO, PPOConfig, PPOLearner
 from .impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
 from .appo import APPO, APPOConfig, APPOLearner
 from .cql import CQL, CQLConfig, CQLLearner
+from .dreamer_v3 import (DreamerV3, DreamerV3Config, DreamerV3Learner,
+                         DreamerV3Module)

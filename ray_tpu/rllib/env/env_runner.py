@@ -104,17 +104,25 @@ class SingleAgentEnvRunner:
             return (jnp.clip((x - fmean) / fstd, -10.0, 10.0)
                     if use_filter else x)
 
+        recurrent = hasattr(module, "initial_state")
+
         def one_step(carry, step_key):
             (env_state, obs, stack, ep_ret, ep_len, params,
-             fmean, fstd, fsum_in, fsq_in) = carry
+             fmean, fstd, fsum_in, fsq_in, mstate) = carry
             act_key, step_keys, reset_keys = (
                 step_key[0], step_key[1], step_key[2])
             if use_stack:
                 net_in = filt(stack, fmean, fstd).reshape(B, -1)
             else:
                 net_in = filt(obs, fmean, fstd)
-            action, logp, vf = module.forward_exploration(
-                params, net_in, act_key)
+            if recurrent:
+                # recurrent policies (world models) thread their state
+                # through the scan; done envs reset it below
+                action, logp, vf, mstate = module.forward_exploration(
+                    params, net_in, act_key, mstate)
+            else:
+                action, logp, vf = module.forward_exploration(
+                    params, net_in, act_key)
             next_state, next_obs, reward, done = jax.vmap(env.step)(
                 env_state, action, jax.random.split(step_keys, B))
             ep_ret = ep_ret + reward
@@ -148,18 +156,26 @@ class SingleAgentEnvRunner:
                 fsq = fsq_in + (obs * obs).sum(axis=0)
             else:
                 fsum, fsq = fsum_in, fsq_in
+            if recurrent:
+                fresh = module.initial_state(params, B)
+                mstate = jax.tree_util.tree_map(
+                    lambda f, s: jnp.where(
+                        jnp.reshape(done, (B,) + (1,) * (s.ndim - 1)),
+                        f, s), fresh, mstate)
             return (next_state, next_obs, next_stack, ep_ret, ep_len,
-                    params, fmean, fstd, fsum, fsq), out
+                    params, fmean, fstd, fsum, fsq, mstate), out
 
         def sample(params, env_state, obs, stack, ep_ret, ep_len, key,
-                   fmean, fstd):
+                   fmean, fstd, mstate):
             key, sub = jax.random.split(key)
             step_keys = jax.random.split(sub, T * 3).reshape(T, 3, 2)
             zeros = jnp.zeros(obs.shape[1:], jnp.float32)
             carry, batch = jax.lax.scan(
                 one_step, (env_state, obs, stack, ep_ret, ep_len,
-                           params, fmean, fstd, zeros, zeros), step_keys)
+                           params, fmean, fstd, zeros, zeros, mstate),
+                step_keys)
             env_state, obs, stack, ep_ret, ep_len = carry[:5]
+            mstate = carry[10]
             batch["filt_sum"], batch["filt_sumsq"] = carry[8], carry[9]
             if use_stack:
                 ffinal = filt(stack, fmean, fstd).reshape(B, -1)
@@ -171,7 +187,8 @@ class SingleAgentEnvRunner:
             # reconstruct next_obs[t] as obs[t+1] (+ this for t = T-1);
             # filtered/stacked like every obs the learner sees
             batch["final_obs"] = ffinal
-            return env_state, obs, stack, ep_ret, ep_len, key, batch
+            return (env_state, obs, stack, ep_ret, ep_len, key, batch,
+                    mstate)
 
         return sample
 
@@ -229,10 +246,17 @@ class SingleAgentEnvRunner:
             fmean, fstd = jnp.float32(0.0), jnp.float32(1.0)
         stack = (self._stack if self.framestack > 1
                  else jnp.float32(0.0))
+        if not hasattr(self, "_mstate"):
+            # recurrent modules persist their state ACROSS fragments
+            self._mstate = (self.module.initial_state(
+                self.params, self.num_envs)
+                if hasattr(self.module, "initial_state")
+                else jnp.float32(0.0))
         (self._env_state, self._obs, stack, self._ep_ret, self._ep_len,
-         self._key, batch) = self._sample_jit(
+         self._key, batch, self._mstate) = self._sample_jit(
             self.params, self._env_state, self._obs, stack,
-            self._ep_ret, self._ep_len, self._key, fmean, fstd)
+            self._ep_ret, self._ep_len, self._key, fmean, fstd,
+            self._mstate)
         if self.framestack > 1:
             self._stack = stack
         batch = jax.device_get(batch)
