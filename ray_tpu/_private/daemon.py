@@ -508,17 +508,24 @@ class NodeDaemon:
                      "ray_tpu._private.worker_main"]
                     + self._worker_argv(worker_id))
             if ctx.container is not None:
-                # image_uri stub: a configured container runtime wraps
-                # the spawn; bare nodes fail loudly (the GKE/KubeRay
-                # integration supplies the prefix in production)
-                prefix = get_config().container_run_prefix
-                if not prefix:
-                    raise RuntimeError(
-                        "runtime_env image_uri requires a container "
-                        "runtime (set RAY_TPU_CONTAINER_RUN_PREFIX or "
-                        "run under the KubeRay/GKE integration)")
-                argv = [p.replace("{image}", ctx.container["image_uri"])
-                        for p in prefix.split()] + argv
+                if ctx.container.get("run_prefix"):
+                    # sandbox:// image: the plugin built the native
+                    # namespace-chroot launcher (sandbox_run.py)
+                    argv = list(ctx.container["run_prefix"]) + argv
+                else:
+                    # external image: a configured container runtime
+                    # wraps the spawn; bare nodes fail loudly (the
+                    # GKE/KubeRay integration supplies the prefix)
+                    prefix = get_config().container_run_prefix
+                    if not prefix:
+                        raise RuntimeError(
+                            "runtime_env image_uri requires a container "
+                            "runtime (set RAY_TPU_CONTAINER_RUN_PREFIX, "
+                            "use image_uri='sandbox://<rootfs>', or run "
+                            "under the KubeRay/GKE integration)")
+                    argv = [p.replace("{image}",
+                                      ctx.container["image_uri"])
+                            for p in prefix.split()] + argv
             env = dict(os.environ)
             env.update(self.worker_env)
             env.update(env_vars)

@@ -342,11 +342,18 @@ class UvPlugin(RuntimeEnvPlugin):
 
 
 class ImageURIPlugin(RuntimeEnvPlugin):
-    """Container image stub (reference image_uri.py): validates and
-    propagates the image so a container runtime integration (KubeRay /
-    GKE node pools) can wrap the worker command. Bare nodes have no
-    container runtime — spawn fails with a clear error unless a
-    container_run_prefix is configured (the test/integration hook)."""
+    """Container images (reference image_uri.py, which shells out to
+    podman). Two modes:
+
+    - ``sandbox://<rootfs-dir>``: NATIVE container-lite — workers run
+      inside an unprivileged user+mount namespace chrooted into the
+      rootfs, with the host runtime bind-mounted in
+      (_private/sandbox_run.py). No container runtime needed; works on
+      bare TPU nodes.
+    - any other URI: propagated for an external runtime to wrap the
+      worker command (KubeRay/GKE supplies
+      RAY_TPU_CONTAINER_RUN_PREFIX; bare nodes fail loudly).
+    """
 
     name = "image_uri"
     priority = 5
@@ -354,8 +361,35 @@ class ImageURIPlugin(RuntimeEnvPlugin):
     def validate(self, value):
         if not isinstance(value, str) or not value:
             raise ValueError("image_uri must be a non-empty string")
+        if value.startswith("sandbox://"):
+            rootfs = value[len("sandbox://"):]
+            if not os.path.isdir(rootfs):
+                raise ValueError(
+                    f"sandbox:// rootfs {rootfs!r} is not a directory")
 
     async def create(self, value, ctx, node):
+        if value.startswith("sandbox://"):
+            import sys
+            rootfs = os.path.abspath(value[len("sandbox://"):])
+            pkg_parent = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            prefix_args = ["--bind", pkg_parent]
+            # a venv/conda interpreter outside the default bind set
+            # (e.g. /root/venv) must be visible inside the chroot or
+            # the exec dies invisibly after the pivot
+            from .sandbox_run import DEFAULT_BINDS
+            for pfx in {sys.prefix, sys.base_prefix}:
+                if not any(pfx == b or pfx.startswith(b + "/")
+                           for b in DEFAULT_BINDS):
+                    prefix_args += ["--bind", pfx]
+            ctx.container = {
+                "image_uri": value,
+                # the daemon prepends this to the worker argv
+                "run_prefix": [sys.executable, "-m",
+                               "ray_tpu._private.sandbox_run", rootfs]
+                              + prefix_args + ["--"],
+            }
+            return
         ctx.container = {"image_uri": value}
 
 

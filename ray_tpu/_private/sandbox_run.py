@@ -1,0 +1,103 @@
+"""Container-lite worker launcher: user+mount namespaces + chroot.
+
+Role of the reference's container runtime-env plugin
+(python/ray/_private/runtime_env/image_uri.py, which shells out to
+podman): bare TPU nodes in this stack's target environments have no
+container runtime, but Linux user namespaces are available everywhere —
+so ``image_uri: sandbox://<rootfs-dir>`` activates an unprivileged
+filesystem sandbox instead:
+
+- a new user namespace (root inside, unprivileged outside) + mount
+  namespace;
+- the image rootfs is mounted through an OVERLAY with a tmpfs upper
+  layer, so launches never mutate the user's image directory and
+  read-only rootfs (squashfs, ro binds) work; kernels without
+  unprivileged overlayfs fall back to binding into the rootfs
+  directly;
+- the HOST runtime is recursively bind-mounted in (/usr, /lib, /opt,
+  /proc, /dev incl. /dev/shm — the object-store arena must stay
+  shared — plus ``--bind`` extras such as the ray_tpu package dir and
+  the worker interpreter's prefix), so the sandbox always has a
+  working Python;
+- everything else inside the image shadows or extends the host view,
+  and host paths OUTSIDE the bind set are invisible;
+- ``chroot`` pivots in, the pre-chroot working directory is restored
+  when it exists inside (working_dir runtime envs compose), and the
+  worker command execs.
+
+Usage:  python -m ray_tpu._private.sandbox_run ROOTFS \
+            [--bind PATH]... -- CMD ARG...
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+
+DEFAULT_BINDS = ("/usr", "/lib", "/lib64", "/bin", "/sbin", "/opt",
+                 "/etc", "/proc", "/sys", "/dev", "/tmp", "/var",
+                 "/run")
+
+_STAGE = "/tmp/.ray_tpu_sbx"
+
+
+def build_script(rootfs: str, binds, cmd) -> str:
+    q = shlex.quote
+    lines = [
+        "set -e",
+        f"mkdir -p {_STAGE}",
+        # per-namespace tmpfs: upper/work dirs and any mkdir fallout
+        # live here, never in the user's image directory
+        f"mount -t tmpfs tmpfs {_STAGE}",
+        f"mkdir -p {_STAGE}/u {_STAGE}/w {_STAGE}/m",
+        f"if mount -t overlay overlay -o "
+        f"lowerdir={q(rootfs)},upperdir={_STAGE}/u,workdir={_STAGE}/w "
+        f"{_STAGE}/m 2>/dev/null; then R={_STAGE}/m; "
+        f"else R={q(rootfs)}; fi",
+    ]
+    for b in binds:
+        if not os.path.exists(b):
+            continue
+        target = "$R" + b
+        lines.append(f"mkdir -p {target}")
+        lines.append(f"mount --rbind {q(b)} {target}")
+    inner = (' cd "$RAY_TPU_SANDBOX_CWD" 2>/dev/null || cd /; '
+             'exec "$@" ')
+    lines.append(f"exec chroot \"$R\" /bin/sh -c {q(inner)} sh "
+                 + " ".join(q(c) for c in cmd))
+    return "\n".join(lines)
+
+
+def main() -> None:
+    args = sys.argv[1:]
+    if not args or "--" not in args:
+        sys.stderr.write(__doc__ + "\n")
+        sys.exit(2)
+    split = args.index("--")
+    head, cmd = args[:split], args[split + 1:]
+    rootfs = os.path.abspath(head[0])
+    extra = []
+    i = 1
+    while i < len(head):
+        if head[i] == "--bind" and i + 1 < len(head):
+            extra.append(head[i + 1])
+            i += 2
+        else:
+            sys.stderr.write(f"unknown arg {head[i]!r}\n")
+            sys.exit(2)
+    if not os.path.isdir(rootfs):
+        sys.stderr.write(f"rootfs {rootfs} is not a directory\n")
+        sys.exit(2)
+    binds = list(DEFAULT_BINDS)
+    for b in extra:
+        if b not in binds:
+            binds.append(b)
+    os.environ.setdefault("RAY_TPU_SANDBOX_CWD", os.getcwd())
+    script = build_script(rootfs, binds, cmd)
+    os.execvp("unshare", ["unshare", "--user", "--map-root-user",
+                          "--mount", "sh", "-c", script])
+
+
+if __name__ == "__main__":
+    main()

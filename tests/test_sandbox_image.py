@@ -1,0 +1,114 @@
+"""Container-lite runtime env (closes the VERDICT r4 image_uri stub;
+reference: python/ray/_private/runtime_env/image_uri.py via podman —
+here an unprivileged user+mount-namespace chroot, sandbox_run.py, so
+bare TPU nodes need no container runtime)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+
+def _userns_available() -> bool:
+    try:
+        return subprocess.run(
+            ["unshare", "--user", "--map-root-user", "true"],
+            capture_output=True, timeout=20).returncode == 0
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _userns_available(),
+    reason="user namespaces unavailable on this kernel/sandbox")
+
+
+@pytest.fixture()
+def rt():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_worker_runs_inside_sandbox_image(rt, tmp_path):
+    rootfs = tmp_path / "rootfs"
+    (rootfs / "data").mkdir(parents=True)
+    (rootfs / "data" / "payload.txt").write_text("from-the-image")
+    marker = tmp_path / "host_only_marker.txt"
+    marker.write_text("host")
+
+    @ray_tpu.remote(runtime_env={"image_uri": f"sandbox://{rootfs}"})
+    def probe(marker_path):
+        import os
+        return {
+            "image_file": open("/data/payload.txt").read(),
+            # tmp_path lives under /tmp which IS bound — but the
+            # image's own /data shadows nothing on the host
+            "marker_visible": os.path.exists(marker_path),
+            "cwd": os.getcwd(),
+            "pid": os.getpid(),
+        }
+
+    out = ray_tpu.get(probe.remote(str(marker)), timeout=300)
+    assert out["image_file"] == "from-the-image"
+    assert out["marker_visible"]          # /tmp is deliberately shared
+
+    # a host path OUTSIDE the bind set is invisible inside the sandbox
+    # (skip the sub-check when the runner cannot write there)
+    host_secret = "/root/sandbox_invisibility_check.txt"
+    if not os.access("/root", os.W_OK):
+        pytest.skip("needs a writable /root for the invisibility check")
+    with open(host_secret, "w") as f:
+        f.write("secret")
+    try:
+        @ray_tpu.remote(runtime_env={"image_uri": f"sandbox://{rootfs}"})
+        def cannot_see():
+            import os
+            return os.path.exists(
+                "/root/sandbox_invisibility_check.txt")
+
+        assert ray_tpu.get(cannot_see.remote(), timeout=300) is False
+    finally:
+        os.unlink(host_secret)
+
+    # plain tasks in the same cluster still see the full host
+    @ray_tpu.remote
+    def plain():
+        import os
+        return os.path.exists("/root")
+
+    assert ray_tpu.get(plain.remote(), timeout=120)
+
+
+def test_sandbox_validation(rt, tmp_path):
+    with pytest.raises(Exception):
+        @ray_tpu.remote(runtime_env={"image_uri":
+                                     f"sandbox://{tmp_path}/missing"})
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote(), timeout=120)
+
+def test_sandbox_keeps_rootfs_pristine_and_composes_working_dir(
+        rt, tmp_path):
+    """The overlay upper layer absorbs the bind mountpoints (no
+    skeleton dirs left in the user's image), and working_dir composes
+    (cwd restored after the chroot)."""
+    rootfs = tmp_path / "img"
+    rootfs.mkdir()
+    before = set(os.listdir(rootfs))
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("wd-file")
+
+    @ray_tpu.remote(runtime_env={"image_uri": f"sandbox://{rootfs}",
+                                 "working_dir": str(wd)})
+    def from_wd():
+        return open("data.txt").read()
+
+    assert ray_tpu.get(from_wd.remote(), timeout=300) == "wd-file"
+    after = set(os.listdir(rootfs))
+    assert after == before, f"image dir mutated: {after - before}"
