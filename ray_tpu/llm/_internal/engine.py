@@ -73,6 +73,18 @@ class EngineConfig:
     # only (temperature 0, no penalties). Composes with prefix caching
     # and tp meshes (draft replicated); pp stage-split is unsupported.
     speculative: Optional[Dict[str, Any]] = None
+    # Multi-step decode: run this many decode iterations inside ONE
+    # compiled dispatch (tokens feed back on-device; per-slot budgets
+    # mask steps past max_tokens so KV writes never pass the
+    # preallocated pages). The direct lever for dispatch-latency-bound
+    # links (the axon tunnel measured ~145 ms/call against a ~3 ms
+    # compute floor): K steps amortize one dispatch + one readback.
+    # Greedy and penalty outputs are step-exact vs K=1; sampled
+    # streams differ only in RNG key split structure. Applied only
+    # when nothing is prefilling/waiting, so the chunked-prefill
+    # no-stall contract keeps its one-step cadence; single device or
+    # tp (pp and speculative have their own paths).
+    decode_steps_per_call: int = 1
     # Overlapped pipeline-parallel decode: split the decode batch into
     # this many microbatches per step. Stage i runs microbatch j while
     # stage i+1 runs j-1 (dispatches are async and stage device groups
@@ -330,6 +342,16 @@ class InferenceEngine:
         self._decode_fn = jax.jit(
             self._build_decode(), donate_argnums=(1, 2, 3),
             static_argnums=(15,))
+        self._multi_decode_fn = None
+        if int(ec.decode_steps_per_call or 1) > 1:
+            if self.pp > 1:
+                raise ValueError(
+                    "decode_steps_per_call does not compose with "
+                    "pipeline-parallel serving")
+            self._multi_decode_fn = jax.jit(
+                self._build_multi_decode(
+                    int(ec.decode_steps_per_call)),
+                donate_argnums=(1, 2, 3), static_argnums=(16,))
         self._d_tokens = None          # device-resident slot state
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
@@ -460,6 +482,38 @@ class InferenceEngine:
             return new_tokens, k_pages, v_pages, seen
 
         return step
+
+    def _build_multi_decode(self, k_steps: int):
+        """K decode iterations in one compiled program: sampled tokens
+        feed back on-device, positions advance per step, and a per-slot
+        BUDGET (remaining max_tokens) masks steps that would write past
+        the preallocated KV pages. Emits [K, B] tokens; the host
+        processes them in order (EOS/max_tokens truncate per slot)."""
+        step = self._build_decode()
+
+        def multi(params, k_pages, v_pages, seen, tokens, positions,
+                  page_tables, active, key, temps, top_ps, top_ks,
+                  rep_pens, lora, lora_idx, budget, all_greedy):
+            keys = jax.random.split(key, k_steps)
+
+            def body(carry, xs):
+                tokens, positions, k_pages, v_pages, seen = carry
+                subkey, i = xs
+                act_i = jnp.logical_and(active, budget > i)
+                toks, k_pages, v_pages, seen = step(
+                    params, k_pages, v_pages, seen, tokens, positions,
+                    page_tables, act_i, subkey, temps, top_ps, top_ks,
+                    rep_pens, lora, lora_idx, all_greedy)
+                positions = positions + act_i
+                return (toks, positions, k_pages, v_pages, seen), toks
+
+            (tokens, positions, k_pages, v_pages, seen), out = \
+                jax.lax.scan(
+                    body, (tokens, positions, k_pages, v_pages, seen),
+                    (keys, jnp.arange(k_steps)))
+            return out, tokens, positions, k_pages, v_pages, seen
+
+        return multi
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -1488,6 +1542,8 @@ class InferenceEngine:
             return self._spec_decode(touched)   # read host state only
         if self._d_tokens is None:
             self._refresh_device_state()
+        if self._multi_decode_fn is not None and self._multi_ok():
+            return self._multi_decode(touched)
         self._key, sub = jax.random.split(self._key)
         new_tokens, self.k_pages, self.v_pages, self._d_seen = \
             self._decode_fn(
@@ -1501,6 +1557,54 @@ class InferenceEngine:
         self._d_tokens = new_tokens
         self._d_positions = self._d_positions + self._d_active
         self._post_decode(np.asarray(new_tokens), touched)
+
+    def _multi_ok(self) -> bool:
+        """Multi-step rounds only while nothing is prefilling or
+        waiting: the chunked-prefill no-stall contract needs one-step
+        decode cadence whenever a prompt is advancing."""
+        if self.waiting:
+            return False
+        return not any(s.request is not None and not s.ready
+                       for s in self.slots)
+
+    def _multi_decode(self, touched: List[Request]) -> None:
+        B = self.config.max_batch_size
+        budget = np.zeros(B, np.int32)
+        for s in self.slots:
+            if s.request is not None and s.ready:
+                budget[s.index] = (s.request.params.max_tokens
+                                   - len(s.request.output_tokens))
+        self._key, sub = jax.random.split(self._key)
+        (toks, last, positions, self.k_pages, self.v_pages,
+         self._d_seen) = self._multi_decode_fn(
+            self.params, self.k_pages, self.v_pages, self._d_seen,
+            self._d_tokens, self._d_positions, self._d_tables,
+            self._d_active, sub, self._d_temps, self._d_top_ps,
+            self._d_top_ks, self._d_rep_pens,
+            self._lora_stacks, self._d_lora_idx,
+            self._dev(jnp.asarray(budget)), self._all_greedy)
+        self._d_tokens = last
+        self._d_positions = positions
+        toks_host = np.asarray(toks)          # [K, B] — ONE readback
+        # process ALL K rows BEFORE any device-state refresh: a
+        # mid-loop refresh would roll device positions back under
+        # tokens the host already emitted, desynchronizing KV from the
+        # output stream
+        dirty = False
+        for i in range(toks_host.shape[0]):
+            for s in self.slots:
+                if s.request is None or not self._host_active[s.index]:
+                    continue
+                if budget[s.index] <= i:
+                    continue
+                s.position += 1
+                tok = int(toks_host[i, s.index])
+                s.last_token = tok
+                self._append_token(s, tok, touched)
+                if s.request is None:       # EOS/max_tokens this step
+                    dirty = True
+        if dirty:
+            self._refresh_device_state()
 
     def _post_decode(self, host_tokens: "np.ndarray",
                      touched: List[Request]) -> None:

@@ -182,6 +182,11 @@ class LLMServerImpl:
                 # multi-byte tokenizations correct
                 text = self.tokenizer.decode(req.output_tokens)
                 delta, n_sent = text[n_sent:], len(text)
+                if not delta and not finished:
+                    # multi-step decode enqueues one event per emitted
+                    # token of a dispatch; later events of the batch
+                    # carry no new text — drop the empty SSE chunks
+                    continue
                 yield delta, finished, reason
                 if finished:
                     return

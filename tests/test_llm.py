@@ -396,3 +396,48 @@ def test_deployment_chips_follow_engine_mesh():
     app1 = build_llm_deployment(LLMConfig(
         model_id="m2", accelerator_type="TPU-V5E"))
     assert app1._deployment.config.ray_actor_options["num_tpus"] == 1
+
+
+def test_multi_step_decode_matches_single_step():
+    """decode_steps_per_call=K runs K decode iterations in ONE
+    dispatch (the per-dispatch-overhead amortizer for tunnel-bound
+    chips): greedy and penalty decode are token-exact vs K=1, budgets
+    clamp exactly at max_tokens, and EOS mid-scan truncates."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(2, 250, 6 + i).tolist() for i in range(3)]
+
+    def gen(k, **sp):
+        eng = make_engine(decode_steps_per_call=k,
+                          enable_prefix_caching=False)
+        reqs = eng.generate([list(p) for p in prompts],
+                            SamplingParams(**sp))
+        return [r.output_tokens for r in reqs]
+
+    assert gen(4, max_tokens=13) == gen(1, max_tokens=13)
+    assert gen(4, max_tokens=13, repetition_penalty=1.3) == \
+        gen(1, max_tokens=13, repetition_penalty=1.3)
+    assert all(len(o) == 5 for o in gen(8, max_tokens=5))
+    # stop tokens truncate mid-scan
+    base = gen(1, max_tokens=20)
+    stop = base[0][4]
+    stopped = gen(4, max_tokens=20, stop_token_ids=[stop])
+    ref = gen(1, max_tokens=20, stop_token_ids=[stop])
+    assert stopped == ref
+
+
+def test_multi_step_decode_composes_with_prefix_cache():
+    rng = np.random.default_rng(7)
+    shared = rng.integers(2, 250, 24).tolist()
+    prompts = [shared + [5], shared + [9, 11]]
+
+    def gen(k, prefix):
+        eng = make_engine(decode_steps_per_call=k, page_size=8,
+                          num_pages=96, enable_prefix_caching=prefix)
+        outs = []
+        for p in prompts:
+            outs.append(eng.generate(
+                [list(p)], SamplingParams(max_tokens=10)
+            )[0].output_tokens)
+        return outs
+
+    assert gen(4, True) == gen(1, False)
