@@ -22,7 +22,7 @@ for i in $(seq 1 "$MAX_LOOPS"); do
     echo "$ts" > tpu_watch/GREEN_AT
     timeout 700 python bench_dispatch_ab.py > tpu_watch/ab_results.jsonl 2> tpu_watch/ab_stderr.log
     timeout 900 python bench.py > tpu_watch/bench_mfu.json 2> tpu_watch/bench_mfu.stderr
-    timeout 900 python bench_llm.py > tpu_watch/bench_llm.json 2> tpu_watch/bench_llm.stderr
+    timeout 1500 python bench_llm.py > tpu_watch/bench_llm.json 2> tpu_watch/bench_llm.stderr
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) done green-window runs" >> tpu_watch/watch.log
     exit 0
   fi
