@@ -23,10 +23,10 @@ implementation of Hafner et al. 2023). Rebuilt TPU-native and compact:
   with is_first flags (cold-start at the window head + on in-window
   episode boundaries), the standard stateless-replay formulation.
 
-Simplifications vs the paper (documented, not hidden): reward/value
-regression is symlog-MSE rather than twohot-discretized, and there is
-no critic-EMA regularizer. Both affect reward-scale robustness on
-extreme-sparsity tasks, not the architecture.
+Remaining simplification vs the paper (documented, not hidden): no
+critic-EMA regularizer. Reward and value use the paper's TWOHOT
+discretized regression over symexp-spaced bins (MSE fallback via
+twohot_bins=0).
 """
 
 from __future__ import annotations
@@ -50,6 +50,28 @@ def symlog(x):
 
 def symexp(x):
     return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def twohot_encode(y_symlog, bins):
+    """Distribute each symlog-space target over its two neighboring
+    bins (Hafner et al. 2023 eq. for discretized regression)."""
+    y = jnp.clip(y_symlog, bins[0], bins[-1])
+    idx = jnp.clip(jnp.searchsorted(bins, y) - 1, 0, bins.shape[0] - 2)
+    lo, hi = bins[idx], bins[idx + 1]
+    w_hi = (y - lo) / jnp.maximum(hi - lo, 1e-8)
+    onehot_lo = jax.nn.one_hot(idx, bins.shape[0])
+    onehot_hi = jax.nn.one_hot(idx + 1, bins.shape[0])
+    return onehot_lo * (1 - w_hi)[..., None] + onehot_hi * w_hi[..., None]
+
+
+def twohot_ce(logits, y_symlog, bins):
+    target = twohot_encode(y_symlog, bins)
+    return -jnp.sum(target * jax.nn.log_softmax(logits, -1), -1)
+
+
+def twohot_mean(logits, bins):
+    """Expected value in symlog space -> real space."""
+    return symexp(jnp.sum(jax.nn.softmax(logits, -1) * bins, -1))
 
 
 class _MLP(nn.Module):
@@ -108,7 +130,7 @@ class DreamerV3Module(RLModule):
 
     def __init__(self, spec, deter: int = 256, stoch: int = 8,
                  classes: int = 8, units: int = 128, embed: int = 128,
-                 unimix: float = 0.01):
+                 unimix: float = 0.01, twohot_bins: int = 63):
         if not spec.discrete:
             raise ValueError("this DreamerV3 build supports discrete "
                              "action spaces")
@@ -124,10 +146,21 @@ class DreamerV3Module(RLModule):
         self._prior = _MLP((units,), self.zdim)
         self._post = _MLP((units,), self.zdim)
         self._dec = _MLP((units, units), D)
-        self._rew = _MLP((units,), 1)
+        # twohot discretized regression (paper): symlog-spaced bins;
+        # twohot_bins=0 falls back to scalar symlog-MSE heads
+        self.nbins = int(twohot_bins)
+        if self.nbins == 1:
+            raise ValueError(
+                "twohot_bins must be 0 (scalar symlog-MSE heads) or "
+                ">= 2 — a single bin makes the CE loss identically "
+                "zero and the heads untrainable")
+        head_out = self.nbins if self.nbins else 1
+        self.bins = (jnp.linspace(-20.0, 20.0, self.nbins)
+                     if self.nbins else None)
+        self._rew = _MLP((units,), head_out)
         self._cont = _MLP((units,), 1)
         self._actor = _MLP((units, units), A)
-        self._critic = _MLP((units, units), 1)
+        self._critic = _MLP((units, units), head_out)
         self._feat = feat
 
     # ------------------------------------------------------------- params
@@ -163,18 +196,32 @@ class DreamerV3Module(RLModule):
         return self._post.apply(
             wm["post"], jnp.concatenate([h, embed], -1))
 
+    def _head_mean(self, pred):
+        """Raw head output -> real-space scalar (twohot expectation or
+        symexp of the scalar head)."""
+        if self.nbins:
+            return twohot_mean(pred, self.bins)
+        return symexp(pred[..., 0])
+
+    def _head_loss(self, pred, y_symlog):
+        """Regression loss of a raw head output toward a symlog-space
+        target — ONE definition for reward and critic."""
+        if self.nbins:
+            return twohot_ce(pred, y_symlog, self.bins)
+        return (pred[..., 0] - y_symlog) ** 2
+
     def _reward(self, wm, feat, a_onehot, raw=False):
         pred = self._rew.apply(
-            wm["rew"], jnp.concatenate([feat, a_onehot], -1))[..., 0]
-        return pred if raw else symexp(pred)
+            wm["rew"], jnp.concatenate([feat, a_onehot], -1))
+        return pred if raw else self._head_mean(pred)
 
     def _cont_logit(self, wm, feat, a_onehot):
         return self._cont.apply(
             wm["cont"], jnp.concatenate([feat, a_onehot], -1))[..., 0]
 
     def _value(self, params, feat, raw=False):
-        pred = self._critic.apply(params["critic"], feat)[..., 0]
-        return pred if raw else symexp(pred)
+        pred = self._critic.apply(params["critic"], feat)
+        return pred if raw else self._head_mean(pred)
 
     # ----------------------------------------------------- runner protocol
     def initial_state(self, params, batch_size: int):
@@ -228,6 +275,7 @@ class DreamerV3Config(AlgorithmConfig):
         self.lam = 0.95
         self.entropy = 3e-3
         self.unimix = 0.01
+        self.twohot_bins = 63        # 0 = scalar symlog-MSE heads
         self.model_size: Dict[str, int] = {}   # deter/stoch/classes/units
 
 
@@ -369,7 +417,8 @@ class DreamerV3Learner(Learner):
                 recon = m._dec.apply(wm["dec"], feat)
                 l_rec = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
                 r_pred = m._reward(wm, feat, a1, raw=True)
-                l_rew = jnp.mean((r_pred - symlog(batch["rewards"])) ** 2)
+                l_rew = jnp.mean(m._head_loss(
+                    r_pred, symlog(batch["rewards"])))
                 c_logit = m._cont_logit(wm, feat, a1)
                 cont_t = 1.0 - batch["dones"]
                 l_cont = jnp.mean(optax.sigmoid_binary_cross_entropy(
@@ -402,7 +451,8 @@ class DreamerV3Learner(Learner):
                 z0 = jax.lax.stop_gradient(zs.reshape(B * L, -1))
                 feats, acts, rews, conts = imagine(p, h0, z0, k_img)
                 feats_sg = jax.lax.stop_gradient(feats)
-                values = m._value(p, feats_sg)            # [H, N]
+                v_logits = m._value(p, feats_sg, raw=True)
+                values = m._head_mean(v_logits)           # [H, N]
                 # lambda-returns: R_t = r_t + gamma*c_t*((1-lam)*V_{t+1}
                 # + lam*R_{t+1}); the state after the last imagined
                 # action has no feature, so its value self-bootstraps
@@ -419,9 +469,10 @@ class DreamerV3Learner(Learner):
                     back, vnext[-1], jnp.arange(H - 1, -1, -1))
                 rets = rets[::-1]                         # [H, N]
                 rets_sg = jax.lax.stop_gradient(rets)
-                # critic: symlog MSE toward lambda-returns
-                v_raw = m._value(p, feats_sg, raw=True)
-                l_critic = jnp.mean((v_raw - symlog(rets_sg)) ** 2)
+                # critic regression toward the lambda-returns (same
+                # head-loss definition as the reward head)
+                l_critic = jnp.mean(m._head_loss(
+                    v_logits, symlog(rets_sg)))
                 # actor: REINFORCE with percentile-normalized advantage
                 logits = m._actor.apply(p["actor"], feats_sg)
                 logp_all = jax.nn.log_softmax(logits, -1)
@@ -494,6 +545,7 @@ class DreamerV3(Algorithm):
             algo_cfg.module_class = DreamerV3Module
             algo_cfg.model_config = dict(algo_cfg.model_config,
                                          unimix=algo_cfg.unimix,
+                                         twohot_bins=algo_cfg.twohot_bins,
                                          **algo_cfg.model_size)
         if algo_cfg.rollout_fragment_length < algo_cfg.seq_len:
             raise ValueError(
