@@ -75,6 +75,95 @@ def bench_engine(on_tpu: bool) -> dict:
     }
 
 
+def bench_mixed(on_tpu: bool, smoke: bool = False) -> dict:
+    """Mixed prefill+decode throughput (ISSUE 1 headline): bursts of
+    prompts land WHILE a batch decodes, so prefilling and decoding
+    slots contend for the whole run — the regime where the legacy
+    engine serializes prefills one chunk per tick (paying a separate
+    whole-batch decode dispatch each time) and the unified ragged step
+    packs everything into ONE dispatch under the token budget.
+    Records the new rows: steps-per-token and dispatches-per-step.
+    token_match is the fraction of requests whose greedy output is
+    bit-identical across the two engines — flips are near-tie argmax
+    noise (~0.02 logit margins, where the unified step tracks the
+    full-forward gold at least as closely as the legacy path)."""
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.models import llama
+
+    if smoke:
+        # CI contract: tiny and fast (<30 s) regardless of host
+        cfg = llama.config("debug")
+        batch, plen, n_req, chunk, budget = 4, 48, 10, 16, 64
+        burst, every, gen0 = 3, 6, 8
+    elif on_tpu:
+        cfg = _tpu_bench_model()
+        batch, plen, n_req, chunk, budget = 8, 256, 24, 64, 512
+        burst, every, gen0 = 6, 10, 48
+    else:
+        # big enough that compute (not Python overhead) dominates a tick
+        cfg = llama.config("tiny", vocab_size=2048, hidden=256,
+                           n_layers=4, n_heads=8, n_kv_heads=4,
+                           head_dim=32, ffn=1024, max_seq=512)
+        batch, plen, n_req, chunk, budget = 8, 112, 24, 16, 256
+        burst, every, gen0 = 6, 10, 16
+    rng = np.random.default_rng(4)
+    lens = [plen + 16 * (i % 3) for i in range(n_req)]
+    gens = [gen0 + 8 * (i % 3) for i in range(n_req)]
+    prompts = [rng.integers(1, cfg.vocab_size, lens[i]).tolist()
+               for i in range(n_req)]
+
+    def run(unified):
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=batch, page_size=16,
+            num_pages=max(512, batch * 32), seed=5,
+            max_prefill_tokens=chunk, enable_prefix_caching=False,
+            unified_step=unified, max_num_batched_tokens=budget))
+
+        def drive():
+            eng._prefill_rr = 0          # identical packing every pass
+            reqs = [Request(f"m{i}", list(p),
+                            SamplingParams(max_tokens=gens[i]))
+                    for i, p in enumerate(prompts)]
+            pending = list(reqs)
+            steps = 0
+            while eng.has_work() or pending:
+                if pending and steps % every == 0:
+                    for r in pending[:burst]:
+                        eng.add_request(r)
+                    pending = pending[burst:]
+                eng.step()
+                steps += 1
+            return reqs, steps
+
+        drive()                          # warmup: compiles every bucket
+        d0, t0s = eng.dispatches, eng.ticks
+        t0 = time.perf_counter()
+        reqs, steps = drive()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {
+            "tokens_per_sec": round(toks / dt, 1),
+            "steps_per_token": round(steps / toks, 3),
+            "dispatches_per_step": round(
+                (eng.dispatches - d0) / max(eng.ticks - t0s, 1), 3),
+            "steps": steps,
+        }, [r.output_tokens for r in reqs]
+
+    unified, out_u = run(True)
+    legacy, out_l = run(False)
+    return {
+        "unified": unified, "legacy": legacy,
+        "unified_speedup": round(
+            unified["tokens_per_sec"]
+            / max(legacy["tokens_per_sec"], 1e-9), 2),
+        "token_match": round(
+            sum(a == b for a, b in zip(out_u, out_l)) / n_req, 3),
+        "batch": batch, "prompt_len": plen, "requests": n_req,
+        "chunk": chunk, "token_budget": budget,
+    }
+
+
 def bench_prefix_cache(on_tpu: bool) -> dict:
     """Shared-prefix speedup: time-to-first-token of an identical prompt
     when its prefix KV is cache-hot vs cold (VERDICT r3 #6)."""
@@ -257,9 +346,22 @@ def bench_multi_step(on_tpu: bool) -> dict:
 
 
 def main() -> None:
+    import sys
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
+    if "--smoke" in sys.argv:
+        # CI mode: tiny model, CPU, <30 s — one JSON line whose
+        # dispatches_per_step row fails loudly on scheduler regressions
+        mixed = bench_mixed(on_tpu, smoke=True)
+        print(json.dumps({
+            "metric": "llm_mixed_smoke",
+            "value": mixed["unified"]["tokens_per_sec"],
+            "unit": "tokens_per_sec",
+            "detail": mixed,
+        }))
+        return
     eng = bench_engine(on_tpu)
+    mixed = bench_mixed(on_tpu)
     scaling = bench_kernel_scaling(on_tpu)
     prefix = bench_prefix_cache(on_tpu)
     spec = bench_speculative(on_tpu)
@@ -270,7 +372,8 @@ def main() -> None:
         "value": eng["decode_tokens_per_sec"],
         "unit": "tokens_per_sec",
         "detail": {"device": getattr(dev, "device_kind", str(dev)),
-                   **eng, "paged_kernel_scaling": scaling,
+                   **eng, "mixed_prefill_decode": mixed,
+                   "paged_kernel_scaling": scaling,
                    "prefix_cache": prefix, "speculative": spec,
                    "multi_step_decode": multi},
     }))

@@ -845,8 +845,19 @@ class NodeDaemon:
                 return None, {"status": "spill"}
             if same:
                 return None, {"status": "unsupported"}
+        # zero entries normalize OUT of the block key: an explicit
+        # "CPU: 0" request used to produce a {"CPU": 0.0} key and a
+        # zero-CPU delegation block — a grant the controller's ledger
+        # can't meaningfully meter. Zero-cpu requests take the
+        # scheduled path via 'spill' (a per-key 5 s skip on the
+        # client); 'unsupported' would latch the client's process-wide
+        # local-lease-off flag and kill the fast path for every later
+        # CPU>0 task too.
         req = {k: float(v) for k, v in res.items() if float(v) > 0}
-        req["CPU"] = float(res.get("CPU", 1.0))
+        if "CPU" not in res:
+            req["CPU"] = 1.0             # unspecified -> default 1
+        if "CPU" not in req:
+            return None, {"status": "spill"}
         key = tuple(sorted(req.items()))
         if self.draining:
             return None, {"status": "spill"}

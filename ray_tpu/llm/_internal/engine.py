@@ -5,9 +5,13 @@ python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py; here
 the engine itself is built TPU-first — SURVEY.md §7 hard part #1).
 
 Design:
-- ONE compiled decode program: the running batch lives in fixed
-  max_batch_size slots (static shapes), inactive slots masked — every
-  step is a single device call regardless of arrivals/completions.
+- ONE dispatch per tick: a tick with prefilling slots runs the unified
+  ragged step — one jitted program consuming a flat ragged token batch
+  (each decoding slot contributes 1 token, prefilling slots contribute
+  chunks packed under a Sarathi-style token budget; Ragged Paged
+  Attention, PAPERS.md). Pure-decode ticks run the device-resident
+  decode program. Legacy mode (unified_step=False / pp>1) instead pairs
+  one single-slot prefill-chunk dispatch with a whole-batch decode.
 - Prefill compiles per padded length bucket; prompt KV scatters into the
   page pool inside the same jit.
 - Sampling (greedy/temperature/top-p) fused into both programs.
@@ -54,6 +58,24 @@ class EngineConfig:
     max_prefill_tokens: int = 512
     # Hash-cons full prompt pages so shared prefixes skip re-prefill.
     enable_prefix_caching: bool = True
+    # Unified ragged step (Ragged Paged Attention, PAPERS.md): any tick
+    # with a prefilling slot runs ONE jitted program consuming a flat
+    # ragged token batch — every decoding slot contributes 1 token and
+    # prefilling slots contribute chunks packed under the token budget
+    # below — instead of the legacy pair of dispatches (one chunked
+    # prefill for a single slot, then a whole-batch decode). Retires
+    # the one-chunk-per-step prefill serialization; token-exact vs the
+    # legacy path at temperature 0. pp>1 keeps the legacy stage chain.
+    unified_step: bool = True
+    # Sarathi-style global token budget for one unified tick: decoding
+    # slots take 1 token each, the remainder goes to prefilling slots
+    # round-robin (each capped at max_prefill_tokens). 0 → default
+    # max_prefill_tokens + max_batch_size: a full chunk always rides
+    # on top of the decode tokens, so a single prefilling prompt
+    # advances at least one whole chunk per tick like the legacy path
+    # (leftover budget may additionally start a second prompt's chunk
+    # in the same tick).
+    max_num_batched_tokens: int = 0
     # Tensor-parallel serving: a parallel.MeshSpec (tp>1) — params shard
     # over heads/mlp/vocab, the KV page pool over kv_heads, and
     # prefill/decode jit over the whole mesh (the reference reaches TP
@@ -353,10 +375,23 @@ class InferenceEngine:
                     int(ec.decode_steps_per_call)),
                 donate_argnums=(1, 2, 3), static_argnums=(16,))
         self._d_tokens = None          # device-resident slot state
+        self._d_seen = None
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
         self._chunk_fns: Dict[int, Any] = {}
+        self._ragged_fns: Dict[tuple, Any] = {}
         self._prefill_rr = 0           # round-robin cursor over slots
+        # device-resident page tables: re-uploaded only when the host
+        # mirror changes (admission / finish), not per dispatch
+        self._tables_version = 0
+        self._d_tables_cache = (-1, None)
+        # seen (repetition-penalty support) must be rebuilt from host
+        # state before the next unified tick when slots turn over
+        self._seen_dirty = True
+        # dispatch accounting: compiled-program executions vs engine
+        # ticks (the unified step's contract is one dispatch per tick)
+        self.ticks = 0
+        self.dispatches = 0
         self.pp_mb = max(int(ec.pp_decode_microbatches or 1), 1)
         if self.pp_mb > 1:
             if self.pp <= 1:
@@ -567,6 +602,228 @@ class InferenceEngine:
             self._chunk_fns[(bucket, ctx_pages)] = fn
         return fn
 
+    # -- unified ragged step ------------------------------------------------
+
+    def _device_tables(self):
+        """Device-resident copy of the page tables, re-uploaded only
+        when the host mirror changed (allocation events) — the legacy
+        paths re-uploaded per spec round / per prefill chunk."""
+        ver, arr = self._d_tables_cache
+        if ver != self._tables_version:
+            arr = self._dev(jnp.asarray(self._page_tables))
+            self._d_tables_cache = (self._tables_version, arr)
+        return arr
+
+    def _ragged_fn(self, t_bucket: int, ctx_pages: int):
+        """Jitted unified tick: ragged forward over the flat token
+        batch + per-slot sampling, cached per (token-count bucket,
+        context-pages bucket)."""
+        fn = self._ragged_fns.get((t_bucket, ctx_pages))
+        if fn is None:
+            cfg = self.model_cfg
+            from ...models.llama_infer import ragged_forward
+
+            def run(params, k_pages, v_pages, seen, tokens, slot_ids,
+                    positions, valid, start, page_tables, last_idx,
+                    emit, key, temps, top_ps, top_ks, rep_pens, lora,
+                    lora_idx, all_greedy):
+                logits, k_pages, v_pages = ragged_forward(
+                    cfg, params, tokens, slot_ids, positions, valid,
+                    start, last_idx, k_pages, v_pages, page_tables,
+                    ctx_pages=ctx_pages, lora=lora, lora_idx=lora_idx)
+                if all_greedy:
+                    toks = _sample(logits, key, temps, top_ps,
+                                   all_greedy=True)
+                    return toks, k_pages, v_pages, seen
+                # this tick's tokens count as seen BEFORE sampling
+                # (prompt tokens penalize too, HF semantics; for a
+                # decoding slot the one token is already seen — no-op)
+                seen = seen.at[slot_ids, tokens].max(valid)
+                toks = _sample(logits, key, temps, top_ps, top_ks,
+                               rep_pens, seen)
+                b = logits.shape[0]
+                # only emitting slots keep their sample (mid-prefill
+                # samples are discarded host-side, so they must not
+                # leak into the penalty state either)
+                seen = seen.at[jnp.arange(b), toks].max(emit)
+                return toks, k_pages, v_pages, seen
+
+            fn = jax.jit(run, donate_argnums=(1, 2, 3),
+                         static_argnums=(19,))
+            self._ragged_fns[(t_bucket, ctx_pages)] = fn
+        return fn
+
+    @staticmethod
+    def _token_bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _pack_ragged(self):
+        """Sarathi-style token-budget packing for one unified tick:
+        every decoding slot contributes 1 token, then prefilling slots
+        claim chunks round-robin from what's left of the budget (at
+        least one prefill token per tick, so a decode-saturated budget
+        can never starve admission-to-first-token). Returns
+        [(slot, n_tokens, is_prefill)]."""
+        ec = self.config
+        budget = ec.max_num_batched_tokens or (
+            ec.max_prefill_tokens + ec.max_batch_size)
+        plan = []
+        n_decode = 0
+        for s in self.slots:
+            if s.request is not None and s.ready:
+                plan.append((s, 1, False))
+                n_decode += 1
+        left = max(budget - n_decode, 1)
+        B = len(self.slots)
+        first_served = None
+        for off in range(B):
+            if left <= 0:
+                break
+            s = self.slots[(self._prefill_rr + off) % B]
+            if s.request is None or s.ready:
+                continue
+            take = min(len(s.request.prompt_tokens) - s.prefill_pos,
+                       left, ec.max_prefill_tokens)
+            plan.append((s, take, True))
+            left -= take
+            if first_served is None:
+                first_served = s.index
+        if first_served is not None:
+            # rotate so a budget-limited tail goes first next tick
+            self._prefill_rr = (first_served + 1) % B
+        return plan
+
+    def _need_penalty(self) -> bool:
+        return any(s.request is not None
+                   and s.request.params.repetition_penalty != 1.0
+                   for s in self.slots)
+
+    def _build_seen(self):
+        """Host (B, V) 'seen' array — the ONE builder of the
+        repetition-penalty support, shared by the full device refresh
+        and the ragged tick's seen-only refresh so the two can never
+        diverge. Ready slots have seen prompt+output; prefilling slots
+        their already-cached prefix (later chunks accumulate
+        in-program). Rows stay zero when no penalty is live."""
+        B = self.config.max_batch_size
+        V = self.model_cfg.vocab_size
+        seen = np.zeros((B, V), bool)
+        if self._need_penalty():
+            for s in self.slots:
+                if s.request is None:
+                    continue
+                toks = (s.request.prompt_tokens
+                        + s.request.output_tokens if s.ready
+                        else s.request.prompt_tokens[:s.prefill_pos])
+                if toks:
+                    seen[s.index, np.asarray(toks, np.int64) % V] = True
+        return seen
+
+    def _refresh_seen(self) -> None:
+        """Rebuild ONLY the penalty 'seen' state for a unified tick —
+        a ragged tick needs nothing else device-resident (the decode
+        loop state is rebuilt lazily by the next pure-decode tick), so
+        the full _refresh_device_state would waste a (B, V) rebuild
+        plus ~10 slot-array uploads on every admission-heavy tick.
+        With no live penalty, stale device rows are exact no-ops at
+        rep_pen == 1.0, so both the rebuild and the upload are skipped
+        (a later penalty admission re-sets _seen_dirty and forces the
+        full rebuild)."""
+        if self._d_seen is not None and not self._need_penalty():
+            self._seen_dirty = False
+            return
+        self._d_seen = self._dev(jnp.asarray(self._build_seen()))
+        self._seen_dirty = False
+
+    def _ragged_step(self, touched: List[Request]) -> None:
+        """One unified tick: pack, dispatch the single ragged program,
+        fold the one readback into slot state."""
+        if self._d_seen is None or self._seen_dirty:
+            self._refresh_seen()
+        plan = self._pack_ragged()
+        B = self.config.max_batch_size
+        total = sum(n for _, n, _ in plan)
+        T = self._token_bucket(total)
+        tokens = np.zeros(T, np.int32)
+        slot_ids = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        valid = np.zeros(T, bool)
+        start = np.zeros(B, np.int32)
+        last_idx = np.zeros(B, np.int32)
+        emit = np.zeros(B, bool)
+        temps = np.zeros(B, np.float32)
+        top_ps = np.ones(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        rep_pens = np.ones(B, np.float32)
+        lora_tok = np.zeros(T, np.int32)
+        cur = 0
+        for s, n, is_pref in plan:
+            req, p = s.request, s.request.params
+            if is_pref:
+                seg = req.prompt_tokens[s.prefill_pos:s.prefill_pos + n]
+                pos0 = s.prefill_pos
+            else:
+                seg = [s.last_token]
+                pos0 = s.position
+            tokens[cur:cur + n] = seg
+            slot_ids[cur:cur + n] = s.index
+            positions[cur:cur + n] = np.arange(pos0, pos0 + n)
+            valid[cur:cur + n] = True
+            lora_tok[cur:cur + n] = self._lora_names.get(req.lora, 0)
+            start[s.index] = pos0
+            last_idx[s.index] = cur + n - 1
+            emit[s.index] = ((not is_pref)
+                             or s.prefill_pos + n
+                             >= len(req.prompt_tokens))
+            temps[s.index] = p.temperature
+            top_ps[s.index] = p.top_p
+            top_ks[s.index] = p.top_k
+            rep_pens[s.index] = p.repetition_penalty
+            cur += n
+        all_greedy = bool(np.all(temps <= 0.0)
+                          and np.all(rep_pens == 1.0))
+        ctx = self._ctx_bucket(int(max(start[s.index]
+                                       for s, _, _ in plan)))
+        self._key, sub = jax.random.split(self._key)
+        fn = self._ragged_fn(T, ctx)
+        self.dispatches += 1
+        toks, self.k_pages, self.v_pages, self._d_seen = fn(
+            self.params, self.k_pages, self.v_pages, self._d_seen,
+            self._dev(jnp.asarray(tokens)),
+            self._dev(jnp.asarray(slot_ids)),
+            self._dev(jnp.asarray(positions)),
+            self._dev(jnp.asarray(valid)),
+            self._dev(jnp.asarray(start)), self._device_tables(),
+            self._dev(jnp.asarray(last_idx)),
+            self._dev(jnp.asarray(emit)), sub,
+            self._dev(jnp.asarray(temps)),
+            self._dev(jnp.asarray(top_ps)),
+            self._dev(jnp.asarray(top_ks)),
+            self._dev(jnp.asarray(rep_pens)),
+            self._lora_stacks, self._dev(jnp.asarray(lora_tok)),
+            all_greedy)
+        toks_host = np.asarray(toks)
+        # fold ALL slots from the one readback before any device-state
+        # refresh (same ordering contract as _multi_decode)
+        for s, n, is_pref in plan:
+            tok = int(toks_host[s.index])
+            if is_pref:
+                s.prefill_pos += n
+                if s.prefill_pos >= len(s.request.prompt_tokens):
+                    self._finish_prefill_host(s, tok, touched)
+            else:
+                s.position += 1
+                s.last_token = tok
+                self._append_token(s, tok, touched)
+        # the device-resident decode loop state (tokens/positions) is
+        # stale after a ragged tick; the next pure-decode tick
+        # refreshes lazily. _d_seen stays live: the program updated it
+        # for every surviving slot, and slot turnover sets _seen_dirty.
+        self._d_tokens = None
+
     # -- pipeline-parallel programs (pp > 1) -------------------------------
     # Each stage runs its slice of the layer stack as its own jit
     # program on its own device group; activations hop between groups
@@ -753,6 +1010,7 @@ class InferenceEngine:
         n = len(req.prompt_tokens)
         p = req.params
         self._key, sub = jax.random.split(self._key)
+        self.dispatches += self.pp
         tables = [st.put(jnp.asarray(
             self._page_tables[slot.index:slot.index + 1]))
             for st in self.stages]
@@ -812,6 +1070,7 @@ class InferenceEngine:
         if self.pp_mb > 1:
             return self._pp_decode_overlapped(touched)
         self._key, sub = jax.random.split(self._key)
+        self.dispatches += self.pp
         x = self._d_tokens
         for i in range(self.pp - 1):
             x, self.k_pages[i], self.v_pages[i] = self._pp_decode_fn(i)(
@@ -846,6 +1105,7 @@ class InferenceEngine:
         after every program is in flight."""
         m = self.pp_mb
         self._key, sub = jax.random.split(self._key)
+        self.dispatches += self.pp * m
         subs = jax.random.split(sub, m)
         outs = [None] * m
         for j in range(m):
@@ -992,6 +1252,7 @@ class InferenceEngine:
         tokens[0, :n] = req.prompt_tokens
         table = self._dev(jnp.asarray(
             self._page_tables[slot.index:slot.index + 1]))
+        self.dispatches += 1
         s["dk"], s["dv"] = fn(
             s["params"], s["dk"], s["dv"],
             self._dev(jnp.asarray(tokens)),
@@ -1024,7 +1285,7 @@ class InferenceEngine:
         def canon(sl):
             return sl.request.prompt_tokens + sl.request.output_tokens
 
-        tables = self._dev(jnp.asarray(self._page_tables))
+        tables = self._device_tables()
         delta_bucket = k + 1
 
         # 0. draft catch-up: regular-decode fallback steps (a mixed
@@ -1048,6 +1309,7 @@ class InferenceEngine:
                 cstart[sl.index] = dp
                 clens[sl.index] = take
                 s["draft_pos"][sl.index] = dp + take
+            self.dispatches += 1
             s["dk"], s["dv"] = self._spec_sync_fn(delta_bucket)(
                 s["params"], s["dk"], s["dv"],
                 self._dev(jnp.asarray(ct)),
@@ -1072,6 +1334,7 @@ class InferenceEngine:
             act[sl.index] = True
             limit[sl.index] = len(sl.pages) * page
         ctx = self._ctx_bucket(max(len(canon(sl)) for sl in active) + k)
+        self.dispatches += 1
         cands, s["dk"], s["dv"] = self._spec_draft_fn(
             delta_bucket, ctx)(
             s["params"], s["dk"], s["dv"],
@@ -1105,6 +1368,7 @@ class InferenceEngine:
             assert P - 1 + use <= len(sl.pages) * page, (
                 "verify write past allocated pages", sl.index, P, use,
                 len(sl.pages), page)
+        self.dispatches += 1
         preds, self.k_pages, self.v_pages = self._spec_verify_fn(ctx)(
             self.params, self.k_pages, self.v_pages,
             self._dev(jnp.asarray(vt)),
@@ -1285,12 +1549,23 @@ class InferenceEngine:
         return sum(1 for s in self.slots if s.request is not None)
 
     def step(self) -> List[Request]:
-        """Admit new requests, advance at most ONE prefill chunk, one
-        decode for the running batch — so a long prompt prefills across
-        steps while decode ticks keep flowing. Returns requests that
-        produced a token this step (check .finished / .output_tokens)."""
+        """One engine tick. Unified mode (default, pp == 1): any tick
+        with a prefilling slot runs ONE ragged dispatch that advances
+        every decoding slot by a token AND packs prefill chunks under
+        the token budget; pure-decode ticks keep the device-resident
+        decode loop (also one dispatch). Legacy mode
+        (unified_step=False, or pp > 1): at most one prefill chunk for
+        a single slot, then a separate whole-batch decode. Returns
+        requests that produced a token this step (check .finished /
+        .output_tokens)."""
         touched: List[Request] = []
+        self.ticks += 1
         self._admit()
+        if self.config.unified_step and self.pp == 1 and any(
+                s.request is not None and not s.ready
+                for s in self.slots):
+            self._ragged_step(touched)
+            return touched
         self._advance_prefill(touched)
         if any(s.ready for s in self.slots):
             self._decode(touched)
@@ -1351,6 +1626,8 @@ class InferenceEngine:
             table = np.zeros(self.max_pages_per_seq, np.int32)
             table[:len(slot.pages)] = slot.pages
             self._page_tables[slot.index] = table
+            self._tables_version += 1
+            self._seen_dirty = True      # slot reuse: stale seen row
 
     def _advance_prefill(self, touched: List[Request]) -> None:
         """Advance prefilling slots. While a decode batch is running,
@@ -1390,6 +1667,7 @@ class InferenceEngine:
             tokens, bucket = self._prep_full_prompt(req)
             lidx = self._dev(jnp.asarray(
                 [self._lora_names.get(req.lora, 0)], jnp.int32))
+            self.dispatches += 1
             first, self.k_pages, self.v_pages = self._prefill_fn(bucket)(
                 self.params, self.k_pages, self.v_pages,
                 self._dev(jnp.asarray(tokens)),
@@ -1402,6 +1680,7 @@ class InferenceEngine:
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
         lidx = self._dev(jnp.asarray(
             [self._lora_names.get(req.lora, 0)], jnp.int32))
+        self.dispatches += 1
         first, self.k_pages, self.v_pages = self._chunk_fn(
             bucket, self._ctx_bucket(slot.prefill_pos))(
             self.params, self.k_pages, self.v_pages,
@@ -1415,8 +1694,11 @@ class InferenceEngine:
         if slot.prefill_pos >= n:
             self._finish_prefill(slot, int(first[0]), touched)
 
-    def _finish_prefill(self, slot: _Slot, first_token: int,
-                        touched: List[Request]) -> None:
+    def _finish_prefill_host(self, slot: _Slot, first_token: int,
+                             touched: List[Request]) -> None:
+        """Host-side prompt-completion bookkeeping (no device-state
+        refresh — the ragged step folds a whole tick first and lets the
+        next decode tick refresh lazily)."""
         req = slot.request
         n = len(req.prompt_tokens)
         self.allocator.register_prefix(
@@ -1429,6 +1711,10 @@ class InferenceEngine:
         if self._spec is not None:
             self._spec_prefill_draft(slot)
         self._append_token(slot, first_token, touched)
+
+    def _finish_prefill(self, slot: _Slot, first_token: int,
+                        touched: List[Request]) -> None:
+        self._finish_prefill_host(slot, first_token, touched)
         self._refresh_device_state()
 
     def _refresh_device_state(self) -> None:
@@ -1438,7 +1724,6 @@ class InferenceEngine:
         steady-state step costs ONE dispatch + ONE small readback (this
         matters doubly when the chip sits behind a network tunnel)."""
         B = self.config.max_batch_size
-        V = self.model_cfg.vocab_size
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         active = np.zeros(B, bool)
@@ -1446,11 +1731,7 @@ class InferenceEngine:
         top_ps = np.ones(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         rep_pens = np.ones(B, np.float32)
-        seen = np.zeros((B, V), bool)
-        need_seen = any(
-            s.request is not None
-            and s.request.params.repetition_penalty != 1.0
-            for s in self.slots)
+        seen = self._build_seen()
         for s in self.slots:
             if s.request is None or not s.ready:
                 continue       # empty or still prefilling: inactive
@@ -1462,11 +1743,6 @@ class InferenceEngine:
             top_ps[s.index] = p.top_p
             top_ks[s.index] = p.top_k
             rep_pens[s.index] = p.repetition_penalty
-            if need_seen:
-                # the (B,V) rebuild+upload only when a penalty is live
-                seen[s.index, np.asarray(
-                    s.request.prompt_tokens + s.request.output_tokens,
-                    np.int64) % V] = True
         if self.pp > 1 and self.pp_mb > 1:
             # overlapped decode: per-MICROBATCH slices of every state
             # array (contiguous slot ranges), per stage where needed
@@ -1530,10 +1806,11 @@ class InferenceEngine:
                         s2.request.lora, 0)
             self._d_lora_idx = self._dev(jnp.asarray(lora_idx))
             self._d_seen = self._dev(jnp.asarray(seen))
-            self._d_tables = self._dev(jnp.asarray(self._page_tables))
+            self._d_tables = self._device_tables()
         self._all_greedy = bool(np.all(temps <= 0.0)
                                 and np.all(rep_pens == 1.0))
         self._host_active = active
+        self._seen_dirty = False
 
     def _decode(self, touched: List[Request]) -> None:
         if self.pp > 1:
@@ -1545,6 +1822,7 @@ class InferenceEngine:
         if self._multi_decode_fn is not None and self._multi_ok():
             return self._multi_decode(touched)
         self._key, sub = jax.random.split(self._key)
+        self.dispatches += 1
         new_tokens, self.k_pages, self.v_pages, self._d_seen = \
             self._decode_fn(
                 self.params, self.k_pages, self.v_pages, self._d_seen,
@@ -1575,6 +1853,7 @@ class InferenceEngine:
                 budget[s.index] = (s.request.params.max_tokens
                                    - len(s.request.output_tokens))
         self._key, sub = jax.random.split(self._key)
+        self.dispatches += 1
         (toks, last, positions, self.k_pages, self.v_pages,
          self._d_seen) = self._multi_decode_fn(
             self.params, self.k_pages, self.v_pages, self._d_seen,
@@ -1643,6 +1922,8 @@ class InferenceEngine:
         slot.prefill_pos = 0
         slot.ready = False
         self._page_tables[slot.index] = 0
+        self._tables_version += 1
+        self._seen_dirty = True
 
     def abort(self, request_id: str) -> bool:
         """Stop a request (client disconnected / stream abandoned): free
@@ -1670,6 +1951,13 @@ class InferenceEngine:
             "waiting": len(self.waiting),
             "free_pages": self.allocator.free_pages,
             "total_pages": self.allocator.num_usable,
+            # unified-step telemetry: ticks counts step() calls,
+            # dispatches counts compiled-program executions — the
+            # ragged step's contract is a 1.0 ratio on work ticks
+            "ticks": self.ticks,
+            "dispatches": self.dispatches,
+            "dispatches_per_step": round(
+                self.dispatches / max(self.ticks, 1), 3),
             **self.allocator.stats(),
         }
         if self._spec is not None and self._spec["rounds"]:

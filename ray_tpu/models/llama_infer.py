@@ -49,6 +49,34 @@ def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
         axis=-1).astype(x.dtype)
 
 
+# ---------------------------------------------------------------- layer body
+
+def _layer_body(cfg: LlamaConfig, dt, x, layer, lora_l, lora_idx,
+                lead_shape: tuple, rope_fn, attn_fn):
+    """ONE transformer layer, shared by every inference path (prefill,
+    chunked prefill, ragged step, decode) — the paths differ only in
+    the leading activation shape, the rope application, and the
+    attention call. Returns (x, (k, v)) with k/v rope'd, ready for the
+    KV scatter."""
+    y = rms_norm(x, layer["ln1"], cfg.norm_eps)
+    q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
+        *lead_shape, cfg.n_heads, cfg.head_dim)
+    k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
+        *lead_shape, cfg.n_kv_heads, cfg.head_dim)
+    v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
+        *lead_shape, cfg.n_kv_heads, cfg.head_dim)
+    q = rope_fn(q)
+    k = rope_fn(k)
+    attn = attn_fn(q, k, v)
+    x = x + _proj(attn.reshape(*lead_shape, cfg.q_dim), layer["wo"],
+                  lora_l, "wo", lora_idx, dt)
+    y = rms_norm(x, layer["ln2"], cfg.norm_eps)
+    gate = jax.nn.silu(y @ layer["wg"].astype(dt))
+    up = y @ layer["wi"].astype(dt)
+    x = x + (gate * up) @ layer["wd"].astype(dt)
+    return x, (k, v)
+
+
 # ------------------------------------------------------------------- prefill
 
 def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
@@ -73,27 +101,16 @@ def prefill(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
          else hidden.astype(dt))
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
 
+    impl = "xla" if cfg.attention_impl in ("auto", "ring") \
+        else cfg.attention_impl
+
     def layer_fn(x, inp):
         layer, lora_l = inp
-        y = rms_norm(x, layer["ln1"], cfg.norm_eps)
-        q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
-            b, s, cfg.n_heads, cfg.head_dim)
-        k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
-            b, s, cfg.n_kv_heads, cfg.head_dim)
-        v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
-            b, s, cfg.n_kv_heads, cfg.head_dim)
-        q = _rope_seq(q, cos, sin)
-        k = _rope_seq(k, cos, sin)
-        impl = "xla" if cfg.attention_impl in ("auto", "ring") \
-            else cfg.attention_impl
-        attn = attention_op(q, k, v, causal=True, impl=impl)
-        x = x + _proj(attn.reshape(b, s, cfg.q_dim), layer["wo"],
-                      lora_l, "wo", lora_idx, dt)
-        y = rms_norm(x, layer["ln2"], cfg.norm_eps)
-        gate = jax.nn.silu(y @ layer["wg"].astype(dt))
-        up = y @ layer["wi"].astype(dt)
-        x = x + (gate * up) @ layer["wd"].astype(dt)
-        return x, (k, v)
+        return _layer_body(
+            cfg, dt, x, layer, lora_l, lora_idx, (b, s),
+            lambda t: _rope_seq(t, cos, sin),
+            lambda q, k, v: attention_op(q, k, v, causal=True,
+                                         impl=impl))
 
     x, (ks, vs) = jax.lax.scan(
         layer_fn, x, (params["layers"], lora_scan_xs(lora)))
@@ -171,24 +188,10 @@ def prefill_chunk(cfg: LlamaConfig, params: Dict[str, Any],
 
     def layer_fn(x, inp):
         layer, k_ctx, v_ctx, lora_l = inp
-        y = rms_norm(x, layer["ln1"], cfg.norm_eps)
-        q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
-            b, c, cfg.n_heads, cfg.head_dim)
-        k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
-            b, c, cfg.n_kv_heads, cfg.head_dim)
-        v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
-            b, c, cfg.n_kv_heads, cfg.head_dim)
-        q = rope(q)
-        k = rope(k)
-        attn = chunk_attention_on_gathered(
-            q, k_ctx, v_ctx, k, v, start_pos, chunk_lens)
-        x = x + _proj(attn.reshape(b, c, cfg.q_dim), layer["wo"],
-                      lora_l, "wo", lora_idx, dt)
-        y = rms_norm(x, layer["ln2"], cfg.norm_eps)
-        gate = jax.nn.silu(y @ layer["wg"].astype(dt))
-        up = y @ layer["wi"].astype(dt)
-        x = x + (gate * up) @ layer["wd"].astype(dt)
-        return x, (k, v)
+        return _layer_body(
+            cfg, dt, x, layer, lora_l, lora_idx, (b, c), rope,
+            lambda q, k, v: chunk_attention_on_gathered(
+                q, k_ctx, v_ctx, k, v, start_pos, chunk_lens))
 
     x, (ks, vs) = jax.lax.scan(
         layer_fn, x,
@@ -261,6 +264,71 @@ def lora_scan_xs(lora: Optional[dict]):
     return lora if lora else None
 
 
+# -------------------------------------------------------------- ragged step
+
+def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
+                   tokens: jax.Array, slot_ids: jax.Array,
+                   positions: jax.Array, valid: jax.Array,
+                   start: jax.Array, last_idx: jax.Array,
+                   k_pages: jax.Array, v_pages: jax.Array,
+                   page_tables: jax.Array, ctx_pages: int = -1,
+                   lora: Optional[dict] = None,
+                   lora_idx: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Unified ragged prefill+decode forward: ONE program per engine
+    tick consumes a FLAT token batch where each active slot contributes
+    between 1 token (decoding) and C tokens (prefilling), packed by the
+    engine's token-budget scheduler. Decode is the n_tokens == 1 case
+    of chunked prefill, so this replaces the per-tick pair of
+    prefill_chunk + decode_step dispatches with one program.
+
+    tokens: (T,) flat ragged batch (slot segments contiguous, position
+    order); slot_ids: (T,) owning slot; positions: (T,) absolute
+    position per token; valid: (T,) bool (padding excluded from
+    attention, KV scatter, and seen updates); start: (B,) tokens
+    already cached per slot; last_idx: (B,) flat index of each slot's
+    last valid token (logits source; 0 for slots with no tokens this
+    tick — callers mask); lora_idx: per-TOKEN adapter index (T,).
+
+    Returns (last-token logits per slot (B, V) f32, k_pages, v_pages)
+    with every valid token's KV scattered into the pool at its
+    position.
+    """
+    from ..ops.ragged_paged_attention import ragged_prefill_decode_attention
+
+    (t,) = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]              # (T, H)
+    cos, sin = rope_frequencies(cfg, positions)         # (T, D/2)
+    ctx_tables = (page_tables if ctx_pages < 0
+                  else page_tables[:, :ctx_pages])
+    k_ctx_all, v_ctx_all = gather_kv(k_pages, v_pages, ctx_tables)
+
+    def layer_fn(x, inp):
+        layer, k_ctx, v_ctx, lora_l = inp
+        return _layer_body(
+            cfg, dt, x, layer, lora_l, lora_idx, (t,),
+            lambda a: _rope_single(a, cos, sin),
+            lambda q, k, v: ragged_prefill_decode_attention(
+                q, k_ctx, v_ctx, k, v, slot_ids, positions, valid,
+                start))
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x,
+        (params["layers"], k_ctx_all, v_ctx_all, lora_scan_xs(lora)))
+    # ks/vs: (L, T, KVH, D) -> token-major (T, L, KVH, D)
+    k_rows = jnp.swapaxes(ks, 0, 1)
+    v_rows = jnp.swapaxes(vs, 0, 1)
+    k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
+                                  page_tables[slot_ids], positions,
+                                  valid)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = x[last_idx]                                  # (B, H)
+    logits = last.astype(jnp.float32) @ params["lm_head"].astype(
+        jnp.float32)
+    return logits, k_pages, v_pages
+
+
 # -------------------------------------------------------------------- decode
 
 def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
@@ -311,19 +379,17 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
 
     def layer_fn(x, inp):
         layer, k_l, v_l, lora_l = inp
-        y = rms_norm(x, layer["ln1"], cfg.norm_eps)
-        q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
-            b, cfg.n_heads, cfg.head_dim)
-        k = _proj(y, layer["wk"], lora_l, "wk", lora_idx, dt).reshape(
-            b, cfg.n_kv_heads, cfg.head_dim)
-        v = _proj(y, layer["wv"], lora_l, "wv", lora_idx, dt).reshape(
-            b, cfg.n_kv_heads, cfg.head_dim)
-        q = _rope_single(q, cos, sin)
-        k = _rope_single(k, cos, sin)
-        # The just-computed token's KV is not yet in the pages: the
-        # kernel path merges it with one extra online-softmax step, the
-        # gather path appends it to the dense context (append_len=1).
-        if use_kernel:
+
+        def attn_fn(q, k, v):
+            # The just-computed token's KV is not yet in the pages: the
+            # kernel path merges it with one extra online-softmax step,
+            # the gather path appends it to the dense context
+            # (append_len=1).
+            if not use_kernel:
+                k_full = jnp.concatenate([k_l, k[:, None]], axis=1)
+                v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
+                return paged_attention_on_gathered(
+                    q, k_full, v_full, positions, append_len=1)
             kernel = functools.partial(
                 paged_decode_with_new_token,
                 interpret=(impl == "pallas_interpret"))
@@ -342,19 +408,11 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                               P(None, "tp", None)),         # new v
                     out_specs=P(None, "tp", None),
                     check_vma=False)
-            attn = kernel(q, k_l, v_l, page_tables, positions, k, v)
-        else:
-            k_full = jnp.concatenate([k_l, k[:, None]], axis=1)
-            v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
-            attn = paged_attention_on_gathered(
-                q, k_full, v_full, positions, append_len=1)
-        x = x + _proj(attn.reshape(b, cfg.q_dim), layer["wo"],
-                      lora_l, "wo", lora_idx, dt)
-        y = rms_norm(x, layer["ln2"], cfg.norm_eps)
-        gate = jax.nn.silu(y @ layer["wg"].astype(dt))
-        up = y @ layer["wi"].astype(dt)
-        x = x + (gate * up) @ layer["wd"].astype(dt)
-        return x, (k, v)
+            return kernel(q, k_l, v_l, page_tables, positions, k, v)
+
+        return _layer_body(cfg, dt, x, layer, lora_l, lora_idx, (b,),
+                           lambda a: _rope_single(a, cos, sin),
+                           attn_fn)
 
     x, (ks, vs) = jax.lax.scan(
         layer_fn, x,

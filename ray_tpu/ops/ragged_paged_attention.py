@@ -1,0 +1,159 @@
+"""Ragged paged attention: ONE attention program for mixed
+prefill+decode batches over the paged KV cache.
+
+"Ragged Paged Attention" (PAPERS.md) is the key TPU-serving kernel:
+instead of dispatching a chunked-prefill program per prompt AND a
+separate whole-batch decode program per engine tick, a single program
+consumes a flat ("ragged") token batch where each active slot
+contributes between 1 token (decoding) and C tokens (prefilling).
+Decode is just the n_tokens == 1 degenerate case of chunked prefill, so
+one causal-masking rule covers both:
+
+    token t of slot s at absolute position p attends
+      - cached context of s:   pool positions c with c < start[s]
+      - batch tokens of s:     tokens u with positions[u] <= p
+
+The flat packing (not a padded [B, C] grid) is the point: a tick with 7
+decode slots and one 64-token chunk costs 71 token-positions of
+compute, not 8 x 64. Pool layout matches ops/paged_attention.py
+([n_layers, num_pages, page_size, n_kv_heads, head_dim]); the dense
+gather path here is the CPU/XLA reference the engine runs today and the
+oracle a future Pallas ragged kernel must match.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def ragged_prefill_decode_attention(
+        q: jax.Array, k_ctx: jax.Array, v_ctx: jax.Array,
+        k_new: jax.Array, v_new: jax.Array, slot_ids: jax.Array,
+        positions: jax.Array, valid: jax.Array, start: jax.Array
+) -> jax.Array:
+    """Core ragged attention over gathered context + the batch's own KV.
+
+    q: [T, H, D] queries of the flat ragged token batch; k_ctx/v_ctx:
+    [B, ctx, KVH, D] gathered pool context per slot (position-major —
+    row c holds the KV cached at absolute position c); k_new/v_new:
+    [T, KVH, D] the batch's own (not yet scattered) KV; slot_ids: [T]
+    owning slot per token; positions: [T] absolute position per token;
+    valid: [T] bool (padding rows excluded everywhere); start: [B]
+    cached tokens per slot (the per-slot causal boundary).
+
+    Token t attends its slot's context positions c < start[slot] plus
+    batch tokens u of the same slot with positions[u] <= positions[t].
+    GQA (H // KVH query heads per kv head), softmax in float32.
+    Returns [T, H, D].
+
+    Every token also attends ITSELF unconditionally — a no-op for
+    valid tokens (the causal rule already includes them) that keeps
+    padding rows finite: an all-masked row softmaxes to NaN, the NaN
+    poisons that row's K/V projection at the next layer, and a
+    0-probability x NaN-value product then contaminates every real
+    row of the batch (IEEE 0*NaN=NaN).
+
+    Memory note: k_ctx[slot_ids] duplicates each slot's gathered
+    context per token — O(T * ctx * KVH * D) f32 transient per layer.
+    Fine at the engine's default budgets; at Sarathi-scale budgets
+    over multi-thousand-token contexts this is the term the future
+    Pallas ragged kernel removes (it streams pages per slot instead).
+    Size the token budget accordingly until then.
+    """
+    t, h, d = q.shape
+    ctx, kvh = k_ctx.shape[1], k_ctx.shape[2]
+    group = h // kvh
+    scale = 1.0 / jnp.sqrt(d)
+    qf = q.reshape(t, kvh, group, d).astype(jnp.float32)
+    kc = k_ctx[slot_ids].astype(jnp.float32)          # [T, ctx, KVH, D]
+    vc = v_ctx[slot_ids].astype(jnp.float32)
+    s_ctx = jnp.einsum("tkgd,tckd->tkgc", qf, kc)
+    s_new = jnp.einsum("tkgd,ukd->tkgu", qf, k_new.astype(jnp.float32))
+    ctx_mask = (jnp.arange(ctx)[None, :]
+                < start[slot_ids][:, None])            # [T, ctx]
+    new_mask = ((slot_ids[:, None] == slot_ids[None, :])
+                & (positions[None, :] <= positions[:, None])
+                & valid[None, :]) | jnp.eye(t, dtype=bool)  # [T, T]
+    s_ctx = jnp.where(ctx_mask[:, None, None, :], s_ctx * scale,
+                      -jnp.inf)
+    s_new = jnp.where(new_mask[:, None, None, :], s_new * scale,
+                      -jnp.inf)
+    scores = jnp.concatenate([s_ctx, s_new], axis=-1)  # [T,KVH,G,ctx+T]
+    probs = jax.nn.softmax(scores, axis=-1)
+    p_ctx, p_new = probs[..., :ctx], probs[..., ctx:]
+    out = (jnp.einsum("tkgc,tckd->tkgd", p_ctx, vc)
+           + jnp.einsum("tkgu,ukd->tkgd", p_new,
+                        v_new.astype(jnp.float32)))
+    return out.reshape(t, h, d).astype(q.dtype)
+
+
+def ragged_paged_prefill_decode_attention(
+        q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+        page_tables: jax.Array, slot_ids: jax.Array,
+        positions: jax.Array, valid: jax.Array, start: jax.Array,
+        k_new: jax.Array, v_new: jax.Array,
+        ctx_pages: int = -1) -> jax.Array:
+    """Single-layer convenience: gather each slot's pages then run the
+    ragged attention (what the model forward does once for all layers).
+
+    k_pages/v_pages: [num_pages, page_size, KVH, D] (already sliced to
+    the layer); page_tables: [B, max_pages]; ctx_pages (static) bounds
+    the gather to the context that exists (-1 = the whole table).
+    """
+    tables = (page_tables if ctx_pages < 0
+              else page_tables[:, :ctx_pages])
+    g_k = k_pages[tables]                   # [B, P, page, KVH, D]
+    g_v = v_pages[tables]
+    b, p, s, kvh, d = g_k.shape
+    return ragged_prefill_decode_attention(
+        q, g_k.reshape(b, p * s, kvh, d), g_v.reshape(b, p * s, kvh, d),
+        k_new, v_new, slot_ids, positions, valid, start)
+
+
+def ragged_attention_dense_oracle(
+        q, dense_k, dense_v, k_new, v_new, slot_ids, positions, valid,
+        start) -> np.ndarray:
+    """CPU-exact dense reference for the ragged op (numpy, per-token
+    loops — slow and obviously correct; the property tests' ground
+    truth).
+
+    dense_k/dense_v: [B, max_ctx, KVH, D] each slot's cached KV in
+    position order (row p = the KV written at absolute position p);
+    everything else as in ragged_prefill_decode_attention. Output rows
+    for invalid tokens are zero.
+    """
+    q = np.asarray(q, np.float32)
+    dense_k = np.asarray(dense_k, np.float32)
+    dense_v = np.asarray(dense_v, np.float32)
+    k_new = np.asarray(k_new, np.float32)
+    v_new = np.asarray(v_new, np.float32)
+    slot_ids = np.asarray(slot_ids)
+    positions = np.asarray(positions)
+    valid = np.asarray(valid)
+    start = np.asarray(start)
+    t, h, d = q.shape
+    kvh = k_new.shape[1]
+    group = h // kvh
+    out = np.zeros_like(q)
+    for i in range(t):
+        if not valid[i]:
+            continue
+        s = int(slot_ids[i])
+        keys = [dense_k[s, :start[s]]]                 # [n_ctx, KVH, D]
+        vals = [dense_v[s, :start[s]]]
+        mates = [j for j in range(t)
+                 if valid[j] and slot_ids[j] == s
+                 and positions[j] <= positions[i]]
+        keys.append(k_new[mates])
+        vals.append(v_new[mates])
+        kk = np.repeat(np.concatenate(keys), group, axis=1)  # [n, H, D]
+        vv = np.repeat(np.concatenate(vals), group, axis=1)
+        sc = np.einsum("hd,nhd->hn", q[i], kk) / np.sqrt(d)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[i] = np.einsum("hn,nhd->hd", p, vv)
+    return out

@@ -65,9 +65,11 @@ def _make_provider_class():
         endpoint. Async actor: many ``get_event`` calls park on
         futures concurrently.
 
-        Binds 0.0.0.0 by default (the contract is EXTERNAL signaling,
-    like the reference's cluster-reachable Serve deployment); set
-    RAY_TPU_EVENT_HTTP_HOST=127.0.0.1 to keep it local.
+        Binds 127.0.0.1 by default (reference parity: Serve's
+    DEFAULT_HTTP_HOST — the endpoint accepts UNAUTHENTICATED event
+    injection, so it must be opt-in to expose; ADVICE.md flagged the
+    old 0.0.0.0 default). Set RAY_TPU_EVENT_HTTP_HOST=0.0.0.0 for
+    cluster-external signaling.
 
     HTTP contract (reference http_event_provider.py): POST
         ``/event/send_event/<event_key>`` with a JSON body resolves
@@ -138,13 +140,19 @@ def _make_provider_class():
             self._server = await asyncio.start_server(
                 handle,
                 host=os.environ.get("RAY_TPU_EVENT_HTTP_HOST",
-                                    "0.0.0.0"),
+                                    "127.0.0.1"),
                 port=port)
             self._port = self._server.sockets[0].getsockname()[1]
 
         async def get_port(self) -> int:
             await self._ensure_started()
             return self._port
+
+        async def get_bound_host(self) -> str:
+            """The address the HTTP listener actually bound
+            (introspection for the loopback-by-default contract)."""
+            await self._ensure_started()
+            return self._server.sockets[0].getsockname()[0]
 
         async def send_event(self, event_key: str, payload) -> bool:
             """Bank + deliver (also callable directly, without HTTP)."""

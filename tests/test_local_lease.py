@@ -179,6 +179,48 @@ def test_controller_restart_reconciles_delegations(fresh_cluster):
         daemon.rpc_release_lease_local(reply["lease_id"]), timeout=10)
 
 
+def test_zero_cpu_tasks_never_claim_zero_cpu_blocks(fresh_cluster):
+    """An explicit CPU: 0 request used to build a {"CPU": 0.0} block
+    key and delegate a zero-CPU block; it must route through the
+    scheduled path instead (zero entries normalize out of the key) —
+    WITHOUT latching the client's process-wide local-lease-off flag
+    (the refusal is 'spill', not 'unsupported')."""
+    rt = fresh_cluster
+
+    @ray_tpu.remote(num_cpus=0)
+    def z(x):
+        return x + 1
+
+    assert ray_tpu.get([z.remote(i) for i in range(20)]) == \
+        list(range(1, 21))
+    daemon = rt.head_daemon
+    assert all(dict(key).get("CPU", 0.0) > 0.0
+               for key in daemon._lease_blocks), daemon._lease_blocks
+    # and the controller's ledger holds no zero-CPU delegation
+    for _, res in rt.controller.delegations:     # (node_id, ((k, v),...))
+        assert dict(res).get("CPU", 0.0) > 0.0, res
+
+    # regular tasks submitted AFTER the zero-cpu storm still use the
+    # local-lease fast path ('spill' must not set the process-wide
+    # unsupported latch; only transient per-key 5 s skips, which we
+    # clear so the check is timing-independent)
+    import ray_tpu._private.state as state
+    client = state.current_client()
+    assert not client._local_lease_unsupported, \
+        "zero-cpu refusal latched local leasing off"
+    client._local_lease_skip_until.clear()
+    granted_before = daemon.local_leases_granted
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get([sq.remote(i) for i in range(50)]) == \
+        [i * i for i in range(50)]
+    assert daemon.local_leases_granted > granted_before, \
+        "local-lease fast path dead after zero-cpu storm"
+
+
 @pytest.mark.parametrize("mode", ["0", "auto"])
 def test_local_lease_off_modes(monkeypatch, mode):
     """'0' disables outright; 'auto' disables here because controller

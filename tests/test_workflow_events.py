@@ -100,6 +100,18 @@ def test_custom_event_listener(ray_start, wf_storage):
     assert workflow.run(dag, workflow_id="wf-ev-custom") == "hello"
 
 
+def test_event_http_binds_loopback_by_default(ray_start, wf_storage,
+                                              monkeypatch):
+    """The HTTP endpoint accepts unauthenticated event injection, so
+    by default it must only listen on loopback (reference parity:
+    Serve's DEFAULT_HTTP_HOST; exposure via RAY_TPU_EVENT_HTTP_HOST
+    is opt-in)."""
+    monkeypatch.delenv("RAY_TPU_EVENT_HTTP_HOST", raising=False)
+    provider = workflow.start_http_event_provider()
+    host = ray_tpu.get(provider.get_bound_host.remote(), timeout=60)
+    assert host == "127.0.0.1"
+
+
 def test_send_event_without_http(ray_start, wf_storage):
     provider = workflow.start_http_event_provider()
     ray_tpu.get(provider.send_event.remote("direct-key", {"n": 7}),
