@@ -164,6 +164,137 @@ def bench_mixed(on_tpu: bool, smoke: bool = False) -> dict:
     }
 
 
+def bench_kernel_tick(on_tpu: bool) -> dict:
+    """ISSUE 2 smoke gate: drive a small mixed workload through the
+    unified engine with decode_impl=pallas_interpret (the Pallas
+    ragged kernel in interpreter mode — unified ticks AND pure-decode
+    ticks both run kernels) and require token-exact greedy output vs
+    the dense gather engine. Asserts (CI fails loudly)."""
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.models import llama
+
+    cfg = llama.config("debug")
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, cfg.vocab_size, n).tolist()
+               for n in (24, 9, 1)]
+
+    def run(impl):
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=3, page_size=8, num_pages=64,
+            prefill_buckets=(16, 32), max_prefill_tokens=16, seed=5,
+            enable_prefix_caching=False, decode_impl=impl))
+        reqs = [Request(f"k{i}", list(p), SamplingParams(max_tokens=4))
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            eng.add_request(r)
+        ticks = 0
+        while eng.has_work():
+            eng.step()
+            ticks += 1
+        return [r.output_tokens for r in reqs], ticks
+
+    out_g, _ = run("gather")
+    out_k, ticks = run("pallas_interpret")
+    exact = out_g == out_k
+    assert exact, f"kernel tick diverged: {out_k} vs {out_g}"
+    return {"token_exact": exact, "ticks": ticks,
+            "impl": "pallas_interpret"}
+
+
+def bench_long_ctx(on_tpu: bool) -> dict:
+    """ISSUE 2 headline: bursty mixed prefill+decode at multi-
+    thousand-token contexts, gather vs Pallas ragged kernel. This is
+    the regime where the gather path's per-layer transient —
+    T x ctx x KVH x D floats of per-token gathered context — is the
+    dominant memory term and the kernel streams pages instead (its
+    staging is O(B x chunk x H x D)). Reports tokens/s per impl plus
+    the peak per-layer attention transient each path materializes.
+
+    On CPU the kernel runs in interpreter mode (Python-speed grid
+    steps), so shapes shrink and kernel tokens/s is NOT a hardware
+    number — transient sizes and token agreement are the CPU signal;
+    run on TPU for the real A/B.
+    """
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        cfg = _tpu_bench_model()              # max_seq 2048
+        batch, plen, n_req, chunk, budget = 8, 1792, 12, 256, 512
+        gen = 32
+        kernel_impl = "pallas"
+    else:
+        cfg = llama.config("tiny", vocab_size=512, hidden=128,
+                           n_layers=2, n_heads=4, n_kv_heads=2,
+                           head_dim=32, ffn=256, max_seq=2048)
+        batch, plen, n_req, chunk, budget = 2, 1024, 3, 64, 96
+        gen = 4
+        kernel_impl = "pallas_interpret"
+    page = 16
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            plen + 64 * (i % 3)).tolist()
+               for i in range(n_req)]
+
+    def run(impl):
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=batch, page_size=page,
+            num_pages=max(512, batch * 192), seed=5,
+            max_prefill_tokens=chunk, enable_prefix_caching=False,
+            max_num_batched_tokens=budget, decode_impl=impl))
+        reqs = [Request(f"L{i}", list(p),
+                        SamplingParams(max_tokens=gen))
+                for i, p in enumerate(prompts)]
+        pending = list(reqs)
+        t0 = time.perf_counter()
+        steps = 0
+        while eng.has_work() or pending:
+            if pending and steps % 4 == 0:
+                for r in pending[:batch // 2 or 1]:
+                    eng.add_request(r)
+                pending = pending[batch // 2 or 1:]
+            eng.step()
+            steps += 1
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {"tokens_per_sec": round(toks / dt, 2),
+                "wall_s": round(dt, 1), "steps": steps}, \
+            [r.output_tokens for r in reqs]
+
+    gather, out_g = run("gather")
+    kernel, out_k = run(kernel_impl)
+
+    # peak per-layer attention transient (bytes), analytic: the gather
+    # path materializes k_ctx[slot_ids] + v_ctx[slot_ids] in f32; the
+    # kernel stages padded per-slot Q/O/new-KV in model dtype and
+    # streams context pages through a fixed VMEM block
+    from ray_tpu.ops.ragged_paged_attention import DEFAULT_Q_BLOCK
+    t_bucket = 1 << max(budget - 1, 1).bit_length()
+    max_ctx_tokens = -(-cfg.max_seq // page) * page
+    kvh, h, d = cfg.n_kv_heads, cfg.n_heads, cfg.head_dim
+    dt_bytes = jnp.dtype(cfg.dtype).itemsize
+    gather_bytes = 2 * t_bucket * max_ctx_tokens * kvh * d * 4
+    qb = DEFAULT_Q_BLOCK
+    qp = -(-min(t_bucket, chunk) // qb) * qb
+    kernel_bytes = (batch + 1) * qp * (h + 2 * kvh) * d * dt_bytes
+    return {
+        "gather": gather, "kernel": kernel,
+        "kernel_impl": kernel_impl,
+        "kernel_speedup": round(
+            kernel["tokens_per_sec"]
+            / max(gather["tokens_per_sec"], 1e-9), 2),
+        "token_match": round(
+            sum(a == b for a, b in zip(out_g, out_k)) / n_req, 3),
+        "peak_attn_transient_bytes": {
+            "gather": gather_bytes, "kernel": kernel_bytes,
+            "ratio": round(gather_bytes / max(kernel_bytes, 1), 1)},
+        "batch": batch, "prompt_len": plen, "requests": n_req,
+        "chunk": chunk, "token_budget": budget,
+    }
+
+
 def bench_prefix_cache(on_tpu: bool) -> dict:
     """Shared-prefix speedup: time-to-first-token of an identical prompt
     when its prefix KV is cache-hot vs cold (VERDICT r3 #6)."""
@@ -350,14 +481,27 @@ def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     if "--smoke" in sys.argv:
-        # CI mode: tiny model, CPU, <30 s — one JSON line whose
-        # dispatches_per_step row fails loudly on scheduler regressions
+        # CI mode: tiny model, CPU, fast — one JSON line whose
+        # dispatches_per_step and kernel_tick rows fail loudly on
+        # scheduler / kernel regressions
         mixed = bench_mixed(on_tpu, smoke=True)
+        kernel = bench_kernel_tick(on_tpu)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
             "unit": "tokens_per_sec",
-            "detail": mixed,
+            "detail": {**mixed, "kernel_tick": kernel},
+        }))
+        return
+    if "--long-ctx" in sys.argv:
+        # ISSUE 2 A/B: gather vs Pallas ragged kernel at long context
+        long_ctx = bench_long_ctx(on_tpu)
+        print(json.dumps({
+            "metric": "llm_long_ctx" if on_tpu
+                      else "llm_long_ctx_cpu_interpret",
+            "value": long_ctx["kernel"]["tokens_per_sec"],
+            "unit": "tokens_per_sec",
+            "detail": long_ctx,
         }))
         return
     eng = bench_engine(on_tpu)

@@ -392,6 +392,13 @@ class InferenceEngine:
         # ticks (the unified step's contract is one dispatch per tick)
         self.ticks = 0
         self.dispatches = 0
+        # jit-cache accounting: +1 whenever a NEW bucketed program is
+        # built (first call then compiles it) — a steady-state run must
+        # hold this flat; growth means bucket churn / recompile storms
+        self.compiles = 0
+        # packed per-slot sampling params, cached across ragged ticks
+        # (invalidated on slot admission/retirement only)
+        self._samp_cache = None
         self.pp_mb = max(int(ec.pp_decode_microbatches or 1), 1)
         if self.pp_mb > 1:
             if self.pp <= 1:
@@ -572,6 +579,7 @@ class InferenceEngine:
                 return first, k_pages, v_pages
 
             fn = jax.jit(run, donate_argnums=(1, 2))
+            self.compiles += 1
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -599,6 +607,7 @@ class InferenceEngine:
                 return first, k_pages, v_pages
 
             fn = jax.jit(run, donate_argnums=(1, 2))
+            self.compiles += 1
             self._chunk_fns[(bucket, ctx_pages)] = fn
         return fn
 
@@ -614,23 +623,52 @@ class InferenceEngine:
             self._d_tables_cache = (self._tables_version, arr)
         return arr
 
-    def _ragged_fn(self, t_bucket: int, ctx_pages: int):
+    def _ragged_fn(self, t_bucket: int, ctx_pages: int,
+                   all_greedy: bool):
         """Jitted unified tick: ragged forward over the flat token
         batch + per-slot sampling, cached per (token-count bucket,
-        context-pages bucket)."""
-        fn = self._ragged_fns.get((t_bucket, ctx_pages))
+        context-pages bucket, all_greedy). all_greedy is a STATIC jit
+        arg — keying the cache on it too keeps the compile counter
+        honest (a greedy<->sampled flip builds a second program for
+        the same shape bucket and must count as one). Attention impl
+        comes from the SAME
+        resolver as the decode program (auto -> Pallas ragged kernel
+        on TPU, dense gather on CPU, pallas_interpret for tests).
+
+        Host state arrives PACKED — tok_meta (5, T) int32 rows
+        tokens/slot_ids/positions/valid/lora_idx, slot_meta (3, B)
+        int32 rows start/last_idx/emit, samp (4, B) f32 rows
+        temps/top_ps/top_ks/rep_pens — so a tick uploads two small
+        arrays (tok_meta, slot_meta) instead of ~10; samp is cached
+        across ticks (see _sampling_cache)."""
+        fn = self._ragged_fns.get((t_bucket, ctx_pages, all_greedy))
         if fn is None:
             cfg = self.model_cfg
+            impl = self._resolve_impl()
+            mesh = self.mesh
+            # no slot segment outgrows the chunk cap: bounds the
+            # kernel's per-slot staging pad (decode rows cost one
+            # q block, not T)
+            max_seg = min(t_bucket,
+                          max(self.config.max_prefill_tokens, 1))
             from ...models.llama_infer import ragged_forward
 
-            def run(params, k_pages, v_pages, seen, tokens, slot_ids,
-                    positions, valid, start, page_tables, last_idx,
-                    emit, key, temps, top_ps, top_ks, rep_pens, lora,
-                    lora_idx, all_greedy):
+            def run(params, k_pages, v_pages, seen, tok_meta,
+                    slot_meta, samp, page_tables, key, lora,
+                    all_greedy):
+                tokens, slot_ids, positions = (
+                    tok_meta[0], tok_meta[1], tok_meta[2])
+                valid = tok_meta[3] != 0
+                lora_idx = tok_meta[4]
+                start, last_idx = slot_meta[0], slot_meta[1]
+                emit = slot_meta[2] != 0
+                temps, top_ps, rep_pens = samp[0], samp[1], samp[3]
+                top_ks = samp[2].astype(jnp.int32)
                 logits, k_pages, v_pages = ragged_forward(
                     cfg, params, tokens, slot_ids, positions, valid,
                     start, last_idx, k_pages, v_pages, page_tables,
-                    ctx_pages=ctx_pages, lora=lora, lora_idx=lora_idx)
+                    ctx_pages=ctx_pages, lora=lora, lora_idx=lora_idx,
+                    impl=impl, mesh=mesh, max_seg_len=max_seg)
                 if all_greedy:
                     toks = _sample(logits, key, temps, top_ps,
                                    all_greedy=True)
@@ -649,8 +687,9 @@ class InferenceEngine:
                 return toks, k_pages, v_pages, seen
 
             fn = jax.jit(run, donate_argnums=(1, 2, 3),
-                         static_argnums=(19,))
-            self._ragged_fns[(t_bucket, ctx_pages)] = fn
+                         static_argnums=(10,))
+            self.compiles += 1
+            self._ragged_fns[(t_bucket, ctx_pages, all_greedy)] = fn
         return fn
 
     @staticmethod
@@ -738,73 +777,80 @@ class InferenceEngine:
         self._d_seen = self._dev(jnp.asarray(self._build_seen()))
         self._seen_dirty = False
 
+    def _sampling_cache(self):
+        """Device-resident (4, B) sampling params [temps, top_ps,
+        top_ks, rep_pens] + the all_greedy flag, built ONCE and reused
+        across ticks (sampling params cannot change mid-request) —
+        invalidated only on slot admission/retirement. Before the
+        cache, every ragged tick re-uploaded four (B,)-arrays that had
+        not changed."""
+        if self._samp_cache is None:
+            B = self.config.max_batch_size
+            samp = np.zeros((4, B), np.float32)
+            samp[1] = 1.0
+            samp[3] = 1.0
+            for s in self.slots:
+                if s.request is None:
+                    continue
+                p = s.request.params
+                samp[0, s.index] = p.temperature
+                samp[1, s.index] = p.top_p
+                samp[2, s.index] = p.top_k
+                samp[3, s.index] = p.repetition_penalty
+            all_greedy = bool(np.all(samp[0] <= 0.0)
+                              and np.all(samp[3] == 1.0))
+            self._samp_cache = (self._dev(jnp.asarray(samp)),
+                                all_greedy)
+        return self._samp_cache
+
     def _ragged_step(self, touched: List[Request]) -> None:
         """One unified tick: pack, dispatch the single ragged program,
-        fold the one readback into slot state."""
+        fold the one readback into slot state. Host->device traffic
+        per tick: ONE (5, T) token-meta upload + ONE (3, B) slot-meta
+        upload (page tables and sampling params ride their caches)."""
         if self._d_seen is None or self._seen_dirty:
             self._refresh_seen()
         plan = self._pack_ragged()
         B = self.config.max_batch_size
         total = sum(n for _, n, _ in plan)
         T = self._token_bucket(total)
-        tokens = np.zeros(T, np.int32)
-        slot_ids = np.zeros(T, np.int32)
-        positions = np.zeros(T, np.int32)
-        valid = np.zeros(T, bool)
-        start = np.zeros(B, np.int32)
-        last_idx = np.zeros(B, np.int32)
-        emit = np.zeros(B, bool)
-        temps = np.zeros(B, np.float32)
-        top_ps = np.ones(B, np.float32)
-        top_ks = np.zeros(B, np.int32)
-        rep_pens = np.ones(B, np.float32)
-        lora_tok = np.zeros(T, np.int32)
+        # rows: tokens / slot_ids / positions / valid / lora_idx
+        tok_meta = np.zeros((5, T), np.int32)
+        # rows: start / last_idx / emit
+        slot_meta = np.zeros((3, B), np.int32)
+        max_start = 0
         cur = 0
         for s, n, is_pref in plan:
-            req, p = s.request, s.request.params
+            req = s.request
             if is_pref:
                 seg = req.prompt_tokens[s.prefill_pos:s.prefill_pos + n]
                 pos0 = s.prefill_pos
             else:
                 seg = [s.last_token]
                 pos0 = s.position
-            tokens[cur:cur + n] = seg
-            slot_ids[cur:cur + n] = s.index
-            positions[cur:cur + n] = np.arange(pos0, pos0 + n)
-            valid[cur:cur + n] = True
-            lora_tok[cur:cur + n] = self._lora_names.get(req.lora, 0)
-            start[s.index] = pos0
-            last_idx[s.index] = cur + n - 1
-            emit[s.index] = ((not is_pref)
-                             or s.prefill_pos + n
-                             >= len(req.prompt_tokens))
-            temps[s.index] = p.temperature
-            top_ps[s.index] = p.top_p
-            top_ks[s.index] = p.top_k
-            rep_pens[s.index] = p.repetition_penalty
+            tok_meta[0, cur:cur + n] = seg
+            tok_meta[1, cur:cur + n] = s.index
+            tok_meta[2, cur:cur + n] = np.arange(pos0, pos0 + n)
+            tok_meta[3, cur:cur + n] = 1
+            tok_meta[4, cur:cur + n] = self._lora_names.get(req.lora, 0)
+            slot_meta[0, s.index] = pos0
+            slot_meta[1, s.index] = cur + n - 1
+            slot_meta[2, s.index] = ((not is_pref)
+                                     or s.prefill_pos + n
+                                     >= len(req.prompt_tokens))
+            max_start = max(max_start, pos0)
             cur += n
-        all_greedy = bool(np.all(temps <= 0.0)
-                          and np.all(rep_pens == 1.0))
-        ctx = self._ctx_bucket(int(max(start[s.index]
-                                       for s, _, _ in plan)))
+        samp, all_greedy = self._sampling_cache()
+        ctx = self._ctx_bucket(max_start)
         self._key, sub = jax.random.split(self._key)
-        fn = self._ragged_fn(T, ctx)
+        fn = self._ragged_fn(T, ctx, all_greedy)
         self.dispatches += 1
         toks, self.k_pages, self.v_pages, self._d_seen = fn(
             self.params, self.k_pages, self.v_pages, self._d_seen,
-            self._dev(jnp.asarray(tokens)),
-            self._dev(jnp.asarray(slot_ids)),
-            self._dev(jnp.asarray(positions)),
-            self._dev(jnp.asarray(valid)),
-            self._dev(jnp.asarray(start)), self._device_tables(),
-            self._dev(jnp.asarray(last_idx)),
-            self._dev(jnp.asarray(emit)), sub,
-            self._dev(jnp.asarray(temps)),
-            self._dev(jnp.asarray(top_ps)),
-            self._dev(jnp.asarray(top_ks)),
-            self._dev(jnp.asarray(rep_pens)),
-            self._lora_stacks, self._dev(jnp.asarray(lora_tok)),
-            all_greedy)
+            self._dev(jnp.asarray(tok_meta)),
+            self._dev(jnp.asarray(slot_meta)),
+            samp, self._device_tables(), sub,
+            self._lora_stacks, all_greedy)
         toks_host = np.asarray(toks)
         # fold ALL slots from the one readback before any device-state
         # refresh (same ordering contract as _multi_decode)
@@ -864,6 +910,7 @@ class InferenceEngine:
                 return h, k_pages, v_pages
 
             fns[i] = jax.jit(run, donate_argnums=(1, 2))
+            self.compiles += 1
             return fns[i]
 
         def run_last(params, k_pages, v_pages, hidden, seen, positions,
@@ -886,6 +933,7 @@ class InferenceEngine:
 
         fns[i] = jax.jit(run_last, donate_argnums=(1, 2, 4),
                          static_argnums=(13,))
+        self.compiles += 1
         return fns[i]
 
     def _pp_prefill_fns(self, bucket: int):
@@ -928,6 +976,7 @@ class InferenceEngine:
                 return first_tok, k_pages, v_pages
 
             out.append(jax.jit(run_last, donate_argnums=(1, 2)))
+        self.compiles += len(out)
         cache[bucket] = out
         return out
 
@@ -973,6 +1022,7 @@ class InferenceEngine:
                 return first_tok, k_pages, v_pages
 
             out.append(jax.jit(run_last, donate_argnums=(1, 2)))
+        self.compiles += len(out)
         cache[(bucket, ctx_pages)] = out
         return out
 
@@ -1186,6 +1236,7 @@ class InferenceEngine:
             return cands, dk, dv
 
         fn = jax.jit(run, donate_argnums=(1, 2))
+        self.compiles += 1
         s["draft_fns"][(delta_bucket, ctx_pages)] = fn
         return fn
 
@@ -1207,6 +1258,7 @@ class InferenceEngine:
             return dk, dv
 
         fn = jax.jit(run, donate_argnums=(1, 2))
+        self.compiles += 1
         s["draft_fns"][("sync", bucket)] = fn
         return fn
 
@@ -1226,6 +1278,7 @@ class InferenceEngine:
             return preds, k_pages, v_pages
 
         fn = jax.jit(run, donate_argnums=(1, 2))
+        self.compiles += 1
         s["verify_fns"][ctx_pages] = fn
         return fn
 
@@ -1247,6 +1300,7 @@ class InferenceEngine:
                 return dk, dv
 
             fn = jax.jit(run, donate_argnums=(1, 2))
+            self.compiles += 1
             s["prefill_fns"][bucket] = fn
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.prompt_tokens
@@ -1628,6 +1682,7 @@ class InferenceEngine:
             self._page_tables[slot.index] = table
             self._tables_version += 1
             self._seen_dirty = True      # slot reuse: stale seen row
+            self._samp_cache = None      # new request: stale params
 
     def _advance_prefill(self, touched: List[Request]) -> None:
         """Advance prefilling slots. While a decode batch is running,
@@ -1924,6 +1979,7 @@ class InferenceEngine:
         self._page_tables[slot.index] = 0
         self._tables_version += 1
         self._seen_dirty = True
+        self._samp_cache = None
 
     def abort(self, request_id: str) -> bool:
         """Stop a request (client disconnected / stream abandoned): free
@@ -1958,6 +2014,24 @@ class InferenceEngine:
             "dispatches": self.dispatches,
             "dispatches_per_step": round(
                 self.dispatches / max(self.ticks, 1), 3),
+            # jit-cache observability: live bucketed programs per
+            # cache + cumulative builds — a steady-state run must hold
+            # `compiled_programs` flat (bucket churn = recompile storm)
+            "jit_cache": {
+                "ragged_buckets": len(self._ragged_fns),
+                "prefill_buckets": len(self._prefill_fns),
+                "chunk_buckets": len(self._chunk_fns),
+                "pp_decode_fns": len(
+                    getattr(self, "_pp_decode_cache", None) or {}),
+                "pp_prefill_buckets": len(
+                    getattr(self, "_pp_prefill_cache", None) or {}),
+                "pp_chunk_buckets": len(
+                    getattr(self, "_pp_chunk_cache", None) or {}),
+                "spec_fns": (0 if self._spec is None else sum(
+                    len(self._spec[k]) for k in
+                    ("draft_fns", "verify_fns", "prefill_fns"))),
+                "compiled_programs": self.compiles,
+            },
             **self.allocator.stats(),
         }
         if self._spec is not None and self._spec["rounds"]:

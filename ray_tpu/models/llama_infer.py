@@ -49,6 +49,18 @@ def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
         axis=-1).astype(x.dtype)
 
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the stable `jax.shard_map`
+    (check_vma) when present, else the experimental one (check_rep) —
+    0.4.x only ships the latter."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
 # ---------------------------------------------------------------- layer body
 
 def _layer_body(cfg: LlamaConfig, dt, x, layer, lora_l, lora_idx,
@@ -273,7 +285,9 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
                    k_pages: jax.Array, v_pages: jax.Array,
                    page_tables: jax.Array, ctx_pages: int = -1,
                    lora: Optional[dict] = None,
-                   lora_idx: Optional[jax.Array] = None
+                   lora_idx: Optional[jax.Array] = None,
+                   impl: str = "gather", mesh=None,
+                   max_seg_len: int = -1
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Unified ragged prefill+decode forward: ONE program per engine
     tick consumes a FLAT token batch where each active slot contributes
@@ -290,32 +304,82 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
     last valid token (logits source; 0 for slots with no tokens this
     tick — callers mask); lora_idx: per-TOKEN adapter index (T,).
 
+    impl (mirrors decode_step's kernel selection):
+      "gather"            dense XLA fallback — gathers each token's
+                          [ctx] context up front; O(T*ctx*KVH*D)
+                          transient per layer.
+      "pallas"            Pallas ragged kernel: stream each slot's KV
+                          pages through VMEM with online softmax, no
+                          gathered-context transient.
+      "pallas_interpret"  same kernel, interpreter mode (CPU tests).
+
+    mesh: optional tp Mesh — the gather impl partitions via GSPMD as
+    before; the kernel impl is wrapped in shard_map over 'tp'
+    (attention is per-head: no collectives inside). max_seg_len
+    (static) bounds any one slot's token count this tick (the engine
+    passes its chunk cap so the kernel's per-slot staging doesn't pad
+    decode-heavy batches to T); -1 = no bound.
+
     Returns (last-token logits per slot (B, V) f32, k_pages, v_pages)
     with every valid token's KV scattered into the pool at its
     position.
     """
-    from ..ops.ragged_paged_attention import ragged_prefill_decode_attention
+    from ..ops.ragged_paged_attention import (
+        ragged_paged_attention_pallas, ragged_prefill_decode_attention)
 
     (t,) = tokens.shape
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]              # (T, H)
     cos, sin = rope_frequencies(cfg, positions)         # (T, D/2)
-    ctx_tables = (page_tables if ctx_pages < 0
-                  else page_tables[:, :ctx_pages])
-    k_ctx_all, v_ctx_all = gather_kv(k_pages, v_pages, ctx_tables)
+    use_kernel = impl in ("pallas", "pallas_interpret")
+    if use_kernel:
+        # pool stays layer-major in HBM: the scan slices one layer's
+        # [pages, page, KVH, D] and the kernel streams pages from it
+        k_by_layer, v_by_layer = k_pages, v_pages
+    else:
+        ctx_tables = (page_tables if ctx_pages < 0
+                      else page_tables[:, :ctx_pages])
+        k_by_layer, v_by_layer = gather_kv(k_pages, v_pages, ctx_tables)
 
     def layer_fn(x, inp):
-        layer, k_ctx, v_ctx, lora_l = inp
+        layer, k_l, v_l, lora_l = inp
+
+        def attn_fn(q, k, v):
+            if not use_kernel:
+                return ragged_prefill_decode_attention(
+                    q, k_l, v_l, k, v, slot_ids, positions, valid,
+                    start)
+            kernel = functools.partial(
+                ragged_paged_attention_pallas, ctx_pages=ctx_pages,
+                max_seg_len=max_seg_len,
+                interpret=(impl == "pallas_interpret"))
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                # per-head attention: each tp shard streams pages for
+                # its local kv heads, no cross-shard comms
+                from jax.sharding import PartitionSpec as P
+                kernel = _shard_map(
+                    kernel, mesh,
+                    in_specs=(P(None, "tp", None),          # q (T,H,D)
+                              P(None, None, "tp", None),    # k pool
+                              P(None, None, "tp", None),    # v pool
+                              P(None, None),                # tables
+                              P(None),                      # slot_ids
+                              P(None),                      # positions
+                              P(None),                      # valid
+                              P(None),                      # start
+                              P(None, "tp", None),          # new k
+                              P(None, "tp", None)),         # new v
+                    out_specs=P(None, "tp", None))
+            return kernel(q, k_l, v_l, page_tables, slot_ids,
+                          positions, valid, start, k, v)
+
         return _layer_body(
             cfg, dt, x, layer, lora_l, lora_idx, (t,),
-            lambda a: _rope_single(a, cos, sin),
-            lambda q, k, v: ragged_prefill_decode_attention(
-                q, k_ctx, v_ctx, k, v, slot_ids, positions, valid,
-                start))
+            lambda a: _rope_single(a, cos, sin), attn_fn)
 
     x, (ks, vs) = jax.lax.scan(
         layer_fn, x,
-        (params["layers"], k_ctx_all, v_ctx_all, lora_scan_xs(lora)))
+        (params["layers"], k_by_layer, v_by_layer, lora_scan_xs(lora)))
     # ks/vs: (L, T, KVH, D) -> token-major (T, L, KVH, D)
     k_rows = jnp.swapaxes(ks, 0, 1)
     v_rows = jnp.swapaxes(vs, 0, 1)
@@ -397,8 +461,8 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 # per-head attention: each tp shard runs the kernel on
                 # its local heads/kv-heads, no cross-shard comms
                 from jax.sharding import PartitionSpec as P
-                kernel = jax.shard_map(
-                    kernel, mesh=mesh,
+                kernel = _shard_map(
+                    kernel, mesh,
                     in_specs=(P(None, "tp", None),          # q (B,H,D)
                               P(None, None, "tp", None),    # k pool
                               P(None, None, "tp", None),    # v pool
@@ -406,8 +470,7 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                               P(None),                      # positions
                               P(None, "tp", None),          # new k
                               P(None, "tp", None)),         # new v
-                    out_specs=P(None, "tp", None),
-                    check_vma=False)
+                    out_specs=P(None, "tp", None))
             return kernel(q, k_l, v_l, page_tables, positions, k, v)
 
         return _layer_body(cfg, dt, x, layer, lora_l, lora_idx, (b,),

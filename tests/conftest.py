@@ -17,6 +17,15 @@ force_cpu_mesh(8)
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 CI runs `-m 'not slow'` (ROADMAP.md): mark long-running
+    # benches and TPU-only compiled-kernel paths `slow`; every
+    # interpret-mode kernel equivalence gate stays un-marked (tier-1)
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running or TPU-only; excluded from tier-1 CI")
+
+
 @pytest.fixture(scope="module")
 def ray_start():
     import ray_tpu

@@ -1,17 +1,25 @@
 """Unified ragged prefill+decode step (ISSUE 1 / Ragged Paged
-Attention, PAPERS.md).
+Attention, PAPERS.md) and its Pallas kernel (ISSUE 2).
 
-Three gates:
+Gates:
 - the ragged paged op matches its CPU-exact dense oracle across ragged
   shapes (pure decode, pure prefill, mixed, single-token prompts,
   page-boundary-straddling chunks, padding rows);
+- the Pallas ragged kernel (interpret mode — the same program compiles
+  on TPU) matches the oracle across GQA group widths, partial last
+  pages, decode-only rows, all-padding rows, and start=0 slots;
 - the unified engine step is token-exact vs the legacy two-dispatch
-  path at temperature 0 (with and without repetition penalty);
+  path at temperature 0 (with and without repetition penalty), and
+  with decode_impl=pallas_interpret vs the gather path;
 - a mixed prefill+decode workload costs exactly ONE compiled dispatch
-  per engine tick.
+  per engine tick, and a steady-state decode run holds the jit-cache
+  compile counter flat (no bucket-churn recompile storms).
 """
 
+import zlib
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -19,7 +27,8 @@ from ray_tpu.models import llama
 from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
                                           Request, SamplingParams)
 from ray_tpu.ops.ragged_paged_attention import (
-    ragged_attention_dense_oracle, ragged_paged_prefill_decode_attention)
+    ragged_attention_dense_oracle, ragged_paged_attention_pallas,
+    ragged_paged_prefill_decode_attention)
 
 
 # ------------------------------------------------------------ op vs oracle
@@ -89,6 +98,92 @@ def test_ragged_op_matches_dense_oracle(name, segs, pad):
         c["slot_ids"], c["positions"], c["valid"], c["start"])
     np.testing.assert_allclose(out[c["valid"]], ref[c["valid"]],
                                rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------ pallas kernel vs oracle
+
+def _kernel_out(c, **kw):
+    kw.setdefault("q_block", 4)
+    kw.setdefault("pages_per_block", 2)
+    return np.asarray(ragged_paged_attention_pallas(
+        jnp.asarray(c["q"]), jnp.asarray(c["k_pages"]),
+        jnp.asarray(c["v_pages"]), jnp.asarray(c["tables"]),
+        jnp.asarray(c["slot_ids"]), jnp.asarray(c["positions"]),
+        jnp.asarray(c["valid"]), jnp.asarray(c["start"]),
+        jnp.asarray(c["k_new"]), jnp.asarray(c["v_new"]), **kw))
+
+
+def _oracle_out(c):
+    return ragged_attention_dense_oracle(
+        c["q"], c["dense_k"], c["dense_v"], c["k_new"], c["v_new"],
+        c["slot_ids"], c["positions"], c["valid"], c["start"])
+
+
+@pytest.mark.parametrize("name,segs,pad,kvh,group", [
+    # every row decodes (1 token each, ragged contexts)
+    ("decode_only", [(5, 1), (11, 1), (3, 1), (8, 1)], 0, 2, 2),
+    ("mixed", [(7, 1), (0, 5), (12, 1), (4, 6)], 0, 2, 2),
+    # GQA head-group sweep: 1 query head per kv head and a wide group
+    ("gqa_group1", [(6, 2), (0, 3), (10, 1)], 0, 3, 1),
+    ("gqa_group4", [(6, 2), (0, 3), (10, 1)], 0, 2, 4),
+    # contexts ending mid-page (page_size=4): the kernel must mask the
+    # tail of the last streamed page
+    ("partial_last_page", [(5, 3), (9, 1), (1, 2), (6, 1)], 0, 2, 2),
+    # fresh slots: no cached context, in-batch causal only
+    ("start_zero", [(0, 1), (0, 4), (0, 1)], 0, 2, 2),
+    ("padding_rows", [(5, 1), (0, 4)], 7, 2, 2),
+    # a slot with zero tokens this tick + nothing but padding rows
+    ("all_padding", [(0, 0)], 6, 2, 2),
+])
+def test_pallas_ragged_kernel_matches_oracle(name, segs, pad, kvh, group):
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    c = _ragged_case(rng, segs, pad=pad, kvh=kvh, group=group)
+    out = _kernel_out(c, interpret=True)
+    ref = _oracle_out(c)
+    np.testing.assert_allclose(out[c["valid"]], ref[c["valid"]],
+                               rtol=2e-3, atol=2e-3)
+    # invalid rows must come back exact zeros (finite downstream)
+    if (~c["valid"]).any():
+        assert np.all(out[~c["valid"]] == 0.0)
+
+
+def test_pallas_ragged_kernel_ctx_and_seg_bounds():
+    """The static bounds (ctx_pages sweep cut, max_seg_len staging cut)
+    must not change the math when they cover the live data."""
+    rng = np.random.default_rng(11)
+    c = _ragged_case(rng, [(6, 1), (0, 3), (5, 4)])
+    full = _kernel_out(c, interpret=True)
+    bounded = _kernel_out(c, interpret=True, ctx_pages=2, max_seg_len=4)
+    np.testing.assert_allclose(full[c["valid"]], bounded[c["valid"]],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_ragged_kernel_block_size_invariance():
+    """Online softmax must be exact under any blocking: q_block and
+    pages_per_block sweeps agree with each other and the oracle."""
+    rng = np.random.default_rng(12)
+    c = _ragged_case(rng, [(7, 1), (0, 5), (12, 1), (4, 6)])
+    ref = _oracle_out(c)
+    for q_blk, ppb in [(1, 1), (2, 4), (8, 3)]:
+        out = _kernel_out(c, interpret=True, q_block=q_blk,
+                          pages_per_block=ppb)
+        np.testing.assert_allclose(out[c["valid"]], ref[c["valid"]],
+                                   rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_pallas_ragged_kernel_compiled_tpu():
+    """Compiled-kernel equivalence — needs real TPU hardware (the
+    interpret-mode gates above cover CPU CI)."""
+    if jax.devices()[0].platform == "cpu":
+        pytest.skip("compiled Pallas kernel requires a TPU")
+    rng = np.random.default_rng(13)
+    c = _ragged_case(rng, [(37, 1), (0, 24), (130, 1), (65, 9)],
+                     page_size=16, kvh=4, group=2, d=128)
+    out = _kernel_out(c, interpret=False, q_block=8, pages_per_block=4)
+    ref = _oracle_out(c)
+    np.testing.assert_allclose(out[c["valid"]], ref[c["valid"]],
+                               rtol=2e-3, atol=2e-3)
 
 
 def test_ragged_op_ctx_bucketing_matches_full_table():
@@ -202,6 +297,46 @@ def test_unified_step_one_dispatch_per_tick():
     assert legacy.dispatches - l0 > l_steps   # the two-dispatch tick
 
 
+def test_unified_step_pallas_interpret_token_exact():
+    """decode_impl=pallas_interpret routes the ragged tick through the
+    Pallas ragged kernel AND the pure-decode tick through the paged
+    decode kernel (interpret mode): greedy output must be token-exact
+    vs the dense gather engine on a mixed staggered workload."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(2, 250, n).tolist() for n in (40, 23, 1, 19)]
+    out_g = _drive(_engine(True, decode_impl="gather"),
+                   [list(p) for p in prompts], max_tokens=6)
+    out_p = _drive(_engine(True, decode_impl="pallas_interpret"),
+                   [list(p) for p in prompts], max_tokens=6)
+    assert out_g == out_p
+
+
+def test_jit_cache_counter_stable_in_steady_state():
+    """Engine.stats() exposes the live jit-cache buckets and a
+    cumulative compile counter; once a decode batch reaches steady
+    state, further ticks must not build new programs (bucket churn
+    would show up as a recompile storm here)."""
+    eng = _engine(True)
+    rng = np.random.default_rng(7)
+    for i in range(3):
+        eng.add_request(Request(
+            f"c{i}", rng.integers(2, 250, 12).tolist(),
+            SamplingParams(max_tokens=30)))
+    while any(s.request is not None and not s.ready
+              for s in eng.slots) or eng.waiting:
+        eng.step()
+    for _ in range(3):                    # settle the decode loop
+        eng.step()
+    st0 = eng.stats()["jit_cache"]
+    assert st0["compiled_programs"] > 0
+    assert st0["ragged_buckets"] == len(eng._ragged_fns)
+    for _ in range(12):                   # steady-state decode
+        eng.step()
+    st1 = eng.stats()["jit_cache"]
+    assert st1["compiled_programs"] == st0["compiled_programs"]
+    assert st1["ragged_buckets"] == st0["ragged_buckets"]
+
+
 def test_unified_step_multi_lora_mixed_batch():
     """Per-token adapter indices: a batch mixing base and a strong
     adapter through the ragged step reproduces each request's solo
@@ -252,6 +387,9 @@ def test_bench_llm_smoke_mode():
     row = json.loads(out.stdout.strip().splitlines()[-1])
     assert row["metric"] == "llm_mixed_smoke"
     assert row["detail"]["unified"]["dispatches_per_step"] == 1.0
+    # ISSUE 2 gate: a unified tick through the Pallas ragged kernel
+    # (interpret mode) is token-exact vs the gather path at temp 0
+    assert row["detail"]["kernel_tick"]["token_exact"] is True
     # greedy agreement across the two engines (1.0 in practice; the
     # bound tolerates near-tie argmax flips, which are FP noise, not
     # scheduler bugs — see bench_mixed's docstring)
