@@ -385,11 +385,27 @@ class InferenceEngine:
         # mirror changes (admission / finish), not per dispatch
         self._tables_version = 0
         self._d_tables_cache = (-1, None)
-        # seen (repetition-penalty support) must be rebuilt from host
-        # state before the next unified tick when slots turn over
-        self._seen_dirty = True
-        # dispatch accounting: compiled-program executions vs engine
-        # ticks (the unified step's contract is one dispatch per tick)
+        # seen (repetition-penalty support): slot turnover dirties
+        # ONLY that slot's row (None = full rebuild needed, e.g. no
+        # device copy yet). _refresh_seen re-uploads dirty rows
+        # incrementally instead of rebuilding the whole (B, V) mask
+        # per ban-list mutation.
+        self._seen_dirty_slots: Optional[set] = None
+        # in-place row scatter for the incremental path: the (B, V)
+        # buffer is donated so XLA updates it in HBM (row count is
+        # bucketed by the caller; at most log2(B)+1 programs, each
+        # counted into self.compiles on first use to keep the
+        # jit-cache accounting contract honest)
+        self._seen_update_fn = jax.jit(
+            lambda seen, idx, rows: seen.at[idx].set(rows),
+            donate_argnums=(0,))
+        self._seen_scatter_buckets: set = set()
+        # dispatch accounting: FORWARD-program executions vs engine
+        # ticks (the unified step's contract is one dispatch per
+        # tick). State-refresh machinery is deliberately excluded —
+        # per-tick key splits, admit/finish-time uploads, and the
+        # _refresh_seen row scatter run outside the tick's forward
+        # dispatch and only on turnover events.
         self.ticks = 0
         self.dispatches = 0
         # jit-cache accounting: +1 whenever a NEW bucketed program is
@@ -466,7 +482,7 @@ class InferenceEngine:
         return MeshSpec(**{**sizes, "pp": 1}).build(devices[:tp]), None
 
     def _split_stage_params(self, params: Dict[str, Any],
-                            cfg: LlamaConfig) -> List[Dict[str, Any]]:
+                            cfg: LlamaConfig) -> List[Dict[str, Any]]:  # jaxlint: disable=JL006 -- engine-init only: one placement per pp stage, never on the tick path
         """Slice the stacked layer arrays into per-stage params placed
         on each stage's devices (tp-sharded inside a stage)."""
         from ...parallel.sharding import shard_tree
@@ -578,6 +594,11 @@ class InferenceEngine:
                                 rep_pens, seen)
                 return first, k_pages, v_pages
 
+            # donation audit (JL002/JL003, vs the unified jit's
+            # donate_argnums=(1, 2, 3)): the KV pools (1, 2) are
+            # donated here too; there is no third donated arg because
+            # the whole-prompt path has no threaded `seen` — it is
+            # built in-program from the prompt itself.
             fn = jax.jit(run, donate_argnums=(1, 2))
             self.compiles += 1
             self._prefill_fns[bucket] = fn
@@ -606,6 +627,13 @@ class InferenceEngine:
                                 rep_pens, seen)
                 return first, k_pages, v_pages
 
+            # donation audit (JL002, vs the unified jit's
+            # donate_argnums=(1, 2, 3)): pools (1, 2) donated. The
+            # `seen` arg (12) intentionally is NOT: it is a fresh
+            # per-chunk upload consumed but never returned (the
+            # chunk's sample may be discarded host-side), and no
+            # output matches its (1, V) bool buffer — donating it
+            # would only emit unused-donation warnings.
             fn = jax.jit(run, donate_argnums=(1, 2))
             self.compiles += 1
             self._chunk_fns[(bucket, ctx_pages)] = fn
@@ -740,42 +768,92 @@ class InferenceEngine:
                    and s.request.params.repetition_penalty != 1.0
                    for s in self.slots)
 
-    def _build_seen(self):
-        """Host (B, V) 'seen' array — the ONE builder of the
-        repetition-penalty support, shared by the full device refresh
-        and the ragged tick's seen-only refresh so the two can never
+    def _seen_row(self, index: int) -> "np.ndarray":
+        """Host (V,) 'seen' row for ONE slot — the one builder of the
+        repetition-penalty support, shared by the full (B, V) rebuild
+        and the incremental dirty-row refresh so the two can never
         diverge. Ready slots have seen prompt+output; prefilling slots
         their already-cached prefix (later chunks accumulate
-        in-program). Rows stay zero when no penalty is live."""
+        in-program); empty slots an all-False row."""
+        V = self.model_cfg.vocab_size
+        row = np.zeros(V, bool)
+        s = self.slots[index]
+        if s.request is not None:
+            toks = (s.request.prompt_tokens
+                    + s.request.output_tokens if s.ready
+                    else s.request.prompt_tokens[:s.prefill_pos])
+            if toks:
+                row[np.asarray(toks, np.int64) % V] = True
+        return row
+
+    def _mark_seen_dirty(self, index: int) -> None:
+        """Record a ban-list mutation (slot admission/retirement) for
+        the incremental seen refresh; None means a full rebuild is
+        already pending."""
+        if self._seen_dirty_slots is not None:
+            self._seen_dirty_slots.add(index)
+
+    def _build_seen(self):
+        """Host (B, V) 'seen' array for the FULL refresh (row builder
+        shared with the incremental path, see _seen_row). Rows stay
+        zero when no penalty is live."""
         B = self.config.max_batch_size
         V = self.model_cfg.vocab_size
         seen = np.zeros((B, V), bool)
         if self._need_penalty():
             for s in self.slots:
-                if s.request is None:
-                    continue
-                toks = (s.request.prompt_tokens
-                        + s.request.output_tokens if s.ready
-                        else s.request.prompt_tokens[:s.prefill_pos])
-                if toks:
-                    seen[s.index, np.asarray(toks, np.int64) % V] = True
+                if s.request is not None:
+                    seen[s.index] = self._seen_row(s.index)
         return seen
 
     def _refresh_seen(self) -> None:
-        """Rebuild ONLY the penalty 'seen' state for a unified tick —
+        """Refresh ONLY the penalty 'seen' state for a unified tick —
         a ragged tick needs nothing else device-resident (the decode
-        loop state is rebuilt lazily by the next pure-decode tick), so
-        the full _refresh_device_state would waste a (B, V) rebuild
-        plus ~10 slot-array uploads on every admission-heavy tick.
-        With no live penalty, stale device rows are exact no-ops at
-        rep_pen == 1.0, so both the rebuild and the upload are skipped
-        (a later penalty admission re-sets _seen_dirty and forces the
-        full rebuild)."""
-        if self._d_seen is not None and not self._need_penalty():
-            self._seen_dirty = False
+        loop state is rebuilt lazily by the next pure-decode tick).
+
+        A ban-list mutation (admission/retirement) dirties one slot,
+        so the steady path rebuilds and re-uploads just the dirty
+        rows — (n, V) padded to a power-of-two row count, scattered
+        in place into the donated device buffer — instead of the old
+        full (B, V) host rebuild + upload per mutation. With no live
+        penalty both are skipped outright: stale device rows are
+        exact no-ops at rep_pen == 1.0 (a later penalty admission
+        re-dirties its slot and rebuilds that row)."""
+        dirty = self._seen_dirty_slots
+        if self._d_seen is None or dirty is None:
+            self._d_seen = self._dev(jnp.asarray(self._build_seen()))
+            self._seen_dirty_slots = set()
             return
-        self._d_seen = self._dev(jnp.asarray(self._build_seen()))
-        self._seen_dirty = False
+        if not dirty:
+            return
+        self._seen_dirty_slots = set()
+        if not self._need_penalty():
+            return
+        idx = sorted(dirty)
+        rows = np.stack([self._seen_row(i) for i in idx])
+        # bucket the row count to a power of two: the scatter program
+        # compiles once per bucket (log2(B)+1 max), never per distinct
+        # dirty count (the JL003 discipline). Padding duplicates the
+        # last row — an identical duplicate scatter is a no-op.
+        n = 1
+        while n < len(idx):
+            n *= 2
+        if n not in self._seen_scatter_buckets:
+            # first use of this row-count bucket builds a program
+            self._seen_scatter_buckets.add(n)
+            self.compiles += 1
+        if n > len(idx):
+            pad = n - len(idx)
+            idx = idx + [idx[-1]] * pad
+            rows = np.concatenate(
+                [rows, np.repeat(rows[-1:], pad, axis=0)])
+        # NOT counted in self.dispatches: like the full-rebuild upload
+        # it replaces, this is turnover-event state refresh, not the
+        # tick's forward dispatch (see the counter's definition)
+        self._d_seen = self._seen_update_fn(
+            self._d_seen,
+            self._dev(jnp.asarray(np.asarray(idx, np.int32))),
+            self._dev(jnp.asarray(rows)))
 
     def _sampling_cache(self):
         """Device-resident (4, B) sampling params [temps, top_ps,
@@ -808,8 +886,7 @@ class InferenceEngine:
         fold the one readback into slot state. Host->device traffic
         per tick: ONE (5, T) token-meta upload + ONE (3, B) slot-meta
         upload (page tables and sampling params ride their caches)."""
-        if self._d_seen is None or self._seen_dirty:
-            self._refresh_seen()
+        self._refresh_seen()      # early-outs when nothing is dirty
         plan = self._pack_ragged()
         B = self.config.max_batch_size
         total = sum(n for _, n, _ in plan)
@@ -867,7 +944,8 @@ class InferenceEngine:
         # the device-resident decode loop state (tokens/positions) is
         # stale after a ragged tick; the next pure-decode tick
         # refreshes lazily. _d_seen stays live: the program updated it
-        # for every surviving slot, and slot turnover sets _seen_dirty.
+        # for every surviving slot; slot turnover dirties its row via
+        # _mark_seen_dirty.
         self._d_tokens = None
 
     # -- pipeline-parallel programs (pp > 1) -------------------------------
@@ -909,6 +987,12 @@ class InferenceEngine:
                     hidden=None if first else xin, emit="hidden")
                 return h, k_pages, v_pages
 
+            # donation audit (JL002, vs the unified (1, 2, 3)): this
+            # stage's pool slices (1, 2) donated. `seen` lives with
+            # the LAST stage only (donated there at argnum 4); the
+            # stage-boundary activation xin stays undonated — stage 0
+            # feeds the device-resident token loop state and later
+            # stages re-put the buffer across device groups.
             fns[i] = jax.jit(run, donate_argnums=(1, 2))
             self.compiles += 1
             return fns[i]
@@ -957,7 +1041,7 @@ class InferenceEngine:
                         hidden=None if _first else xin, emit="hidden")
                     return h, k_pages, v_pages
 
-                out.append(jax.jit(run, donate_argnums=(1, 2)))
+                out.append(jax.jit(run, donate_argnums=(1, 2)))  # jaxlint: disable=JL008 -- bounded: one program per pp stage, memoized in cache[bucket]
                 continue
 
             def run_last(params, k_pages, v_pages, hidden, tokens,
@@ -975,7 +1059,7 @@ class InferenceEngine:
                                     rep_pens, seen)
                 return first_tok, k_pages, v_pages
 
-            out.append(jax.jit(run_last, donate_argnums=(1, 2)))
+            out.append(jax.jit(run_last, donate_argnums=(1, 2)))  # jaxlint: disable=JL008 -- bounded: one program per pp stage, memoized in cache[bucket]
         self.compiles += len(out)
         cache[bucket] = out
         return out
@@ -1003,7 +1087,7 @@ class InferenceEngine:
                         hidden=None if _first else xin, emit="hidden")
                     return h, k_pages, v_pages
 
-                out.append(jax.jit(run, donate_argnums=(1, 2)))
+                out.append(jax.jit(run, donate_argnums=(1, 2)))  # jaxlint: disable=JL008 -- bounded: one program per pp stage, memoized in cache[(bucket, ctx_pages)]
                 continue
 
             def run_last(params, k_pages, v_pages, hidden, tokens,
@@ -1021,7 +1105,10 @@ class InferenceEngine:
                                     rep_pens, seen)
                 return first_tok, k_pages, v_pages
 
-            out.append(jax.jit(run_last, donate_argnums=(1, 2)))
+            # donation audit (JL002): `seen` (13) undonated for the
+            # same reason as _chunk_fn's — fresh per-call upload, not
+            # returned, no output aliases its buffer.
+            out.append(jax.jit(run_last, donate_argnums=(1, 2)))  # jaxlint: disable=JL008 -- bounded: one program per pp stage, memoized in cache[(bucket, ctx_pages)]
         self.compiles += len(out)
         cache[(bucket, ctx_pages)] = out
         return out
@@ -1055,7 +1142,7 @@ class InferenceEngine:
         return tokens, chunk, bucket, prior
 
     def _pp_prefill_one_chunk(self, slot: "_Slot",
-                              touched: List[Request]) -> None:
+                              touched: List[Request]) -> None:  # jaxlint: disable=JL006 -- legacy pp path: O(pp) one-row meta uploads per chunk (stage fan-out), not per-tick slot state
         req = slot.request
         n = len(req.prompt_tokens)
         p = req.params
@@ -1329,7 +1416,7 @@ class InferenceEngine:
                    and s.request.params.repetition_penalty == 1.0
                    for s in ready)
 
-    def _spec_decode(self, touched: List[Request]) -> None:
+    def _spec_decode(self, touched: List[Request]) -> None:  # jaxlint: disable=JL006 -- each catch-up round uploads that round's fresh token deltas; nothing is reusable across rounds
         s = self._spec
         k = s["k"]
         B = self.config.max_batch_size
@@ -1495,7 +1582,7 @@ class InferenceEngine:
         self.register_loras({name: adapters}, scale=scale)
 
     def register_loras(self, mapping: Dict[str, Dict[str, tuple]],
-                       scale: float = 1.0) -> None:
+                       scale: float = 1.0) -> None:  # jaxlint: disable=JL006 -- registration-time stack upload (one per projection), not on the tick path
         """Bulk form: stage every adapter, build + upload the padded
         stacks ONCE (k adapters via the per-name API would rebuild and
         transfer k times)."""
@@ -1681,7 +1768,7 @@ class InferenceEngine:
             table[:len(slot.pages)] = slot.pages
             self._page_tables[slot.index] = table
             self._tables_version += 1
-            self._seen_dirty = True      # slot reuse: stale seen row
+            self._mark_seen_dirty(slot.index)  # slot reuse: stale row
             self._samp_cache = None      # new request: stale params
 
     def _advance_prefill(self, touched: List[Request]) -> None:
@@ -1772,7 +1859,7 @@ class InferenceEngine:
         self._finish_prefill_host(slot, first_token, touched)
         self._refresh_device_state()
 
-    def _refresh_device_state(self) -> None:
+    def _refresh_device_state(self) -> None:  # jaxlint: disable=JL006 -- admit/finish-time refresh (not per tick); the pp branches fan slot state out per stage by construction
         """Re-upload slot state after an admit/finish. Between such
         events the decode loop is device-resident: tokens feed back from
         the previous step's output and positions advance on device, so a
@@ -1865,7 +1952,7 @@ class InferenceEngine:
         self._all_greedy = bool(np.all(temps <= 0.0)
                                 and np.all(rep_pens == 1.0))
         self._host_active = active
-        self._seen_dirty = False
+        self._seen_dirty_slots = set()   # full rebuild just happened
 
     def _decode(self, touched: List[Request]) -> None:
         if self.pp > 1:
@@ -1978,7 +2065,7 @@ class InferenceEngine:
         slot.ready = False
         self._page_tables[slot.index] = 0
         self._tables_version += 1
-        self._seen_dirty = True
+        self._mark_seen_dirty(slot.index)
         self._samp_cache = None
 
     def abort(self, request_id: str) -> bool:
@@ -2021,6 +2108,7 @@ class InferenceEngine:
                 "ragged_buckets": len(self._ragged_fns),
                 "prefill_buckets": len(self._prefill_fns),
                 "chunk_buckets": len(self._chunk_fns),
+                "seen_row_buckets": len(self._seen_scatter_buckets),
                 "pp_decode_fns": len(
                     getattr(self, "_pp_decode_cache", None) or {}),
                 "pp_prefill_buckets": len(

@@ -261,7 +261,7 @@ def moe_ffn(x: jax.Array, router_w: jax.Array,
     dt = x.dtype
     xt = x.reshape(b * s, h)
     t = xt.shape[0]
-    capacity = max(int(t * top_k / num_experts * capacity_factor), 1)
+    capacity = max(int(t * top_k / num_experts * capacity_factor), 1)  # jaxlint: disable=JL001 -- t is a static shape; this int() runs at trace time on Python scalars
 
     probs = router_probs(xt, router_w)
     dispatch, combine, aux = make_dispatch(probs, top_k, capacity)

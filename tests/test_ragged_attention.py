@@ -84,7 +84,6 @@ def _ragged_case(rng, segs, page_size=4, kvh=2, group=2, d=8, pad=0):
     ("padding_rows", [(5, 1), (0, 4)], 7),
 ])
 def test_ragged_op_matches_dense_oracle(name, segs, pad):
-    import zlib
     rng = np.random.default_rng(zlib.crc32(name.encode()))
     c = _ragged_case(rng, segs, pad=pad)
     out = np.asarray(ragged_paged_prefill_decode_attention(

@@ -1,0 +1,25 @@
+"""jaxlint: dispatch-discipline static analyzer for the hot path.
+
+PR 1/2 made the engine's steady state "one jitted dispatch per tick,
+zero recompiles, two coalesced uploads"; jaxlint enforces that
+invariant mechanically. Stdlib-ast only (no new deps). See README.md
+for the rule catalogue and ray_tpu/util/jax_guard.py for the paired
+runtime guard.
+"""
+
+from .analyzer import (  # noqa: F401
+    Finding,
+    Project,
+    analyze_paths,
+    iter_py_files,
+)
+from .baseline import (  # noqa: F401
+    Baseline,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding", "Project", "analyze_paths", "iter_py_files",
+    "Baseline", "load_baseline", "write_baseline",
+]

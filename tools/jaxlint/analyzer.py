@@ -1,0 +1,617 @@
+"""Core indexing + driver for jaxlint.
+
+The analyzer works in three passes:
+
+1. Index every module: function defs (with qualnames), per-node
+   enclosing-function / loop-depth context, import bindings, simple
+   local assignments, and suppression comments.
+2. Mark TRACED functions — functions whose bodies run under a jax
+   trace: decorated with / passed to `jax.jit`, `shard_map`,
+   `pallas_call`, `lax.scan` etc., plus everything transitively
+   reachable from a traced body by simple-name call resolution
+   (nested scope -> same class -> module -> imports across the
+   analyzed file set — the engine's jitted `run` closures reach
+   `llama_infer.prefill` and the ops kernels this way).
+3. Run the rule checks (rules.py) over every module.
+
+Findings carry a line number for humans but their BASELINE KEY is
+line-independent (rule : path : function-qualname : detail) so code
+motion above a finding never churns the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# Call targets that put their function argument under a jax trace.
+TRACE_ENTRY_NAMES = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "scan", "while_loop", "cond", "fori_loop",
+    "switch", "shard_map", "_shard_map", "pallas_call", "custom_vjp",
+    "custom_jvp",
+}
+# Decorators that mark a def as traced.
+TRACE_DECORATOR_NAMES = {"jit", "pjit", "pmap", "shard_map"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:jaxlint:\s*disable=|noqa:\s*)([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # posix relpath (baseline-stable)
+    line: int          # for humans; NOT part of the baseline key
+    func: str          # qualname of the enclosing function ("" = module)
+    detail: str        # stable symbol-level detail
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.func}:{self.detail}"
+
+    def render(self) -> str:
+        where = self.func or "<module>"
+        return (f"{self.path}:{self.line}: {self.rule} [{where}] "
+                f"{self.message}")
+
+
+class FunctionInfo:
+    """One function/lambda: identity, trace status, and the call names
+    its body mentions (for traced-reachability propagation)."""
+
+    def __init__(self, node, qualname: str, module: "ModuleInfo",
+                 parent: Optional["FunctionInfo"], class_name: str):
+        self.node = node
+        self.qualname = qualname
+        self.module = module
+        self.parent = parent
+        self.class_name = class_name
+        self.traced = False
+        self.calls_bare: Set[str] = set()  # foo(...) calls
+        self.calls_self: Set[str] = set()  # self.foo(...) calls
+        self.local_names: Set[str] = set() # params + assigned names
+        self.children: List[FunctionInfo] = []
+        # defs nested directly in this function, by bare name
+        self.nested: Dict[str, FunctionInfo] = {}
+        # simple local assignments: name -> value AST (last wins)
+        self.assigns: Dict[str, ast.AST] = {}
+        # names returned by this function that are nested defs (the
+        # `def _build_x(): def run(...); return run` factory pattern)
+        self.returned_defs: List[FunctionInfo] = []
+
+
+class ModuleInfo:
+    def __init__(self, path: str, relpath: str, tree: ast.Module,
+                 source: str):
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.source = source
+        self.functions: List[FunctionInfo] = []
+        # bare name -> FunctionInfos (module-level AND nested; resolver
+        # prefers closer scopes)
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        # class name -> {method name -> FunctionInfo}
+        self.methods: Dict[str, Dict[str, FunctionInfo]] = {}
+        # imported name -> (dotted module, original name | None)
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        # MODULE-scope simple assigns (function-local ones live on
+        # their FunctionInfo — a module-wide last-wins map made
+        # unrelated same-named locals collide); attribute targets
+        # ("self._fn") are kept here under their dotted name
+        self.assigns: Dict[str, ast.AST] = {}
+        # line -> set of suppressed rule ids
+        self.suppressions: Dict[int, Set[str]] = {}
+        # per-node context filled by the indexer:
+        # id(node) -> (FunctionInfo | None, loop_depth)
+        self.node_ctx: Dict[int, Tuple[Optional[FunctionInfo], int]] = {}
+        self.dotted: Optional[str] = None   # e.g. "ray_tpu.models.llama"
+
+    def suppressed(self, rule: str, line: int,
+                   func: Optional[FunctionInfo]) -> bool:
+        """A disable comment suppresses on its own line or, placed on
+        any line of the enclosing `def` signature, for the whole
+        function (justification rides in the same comment:
+        `# jaxlint: disable=JL006 -- reason`)."""
+        if rule in self.suppressions.get(line, ()):
+            return True
+        f = func
+        while f is not None:
+            node = f.node
+            body = getattr(node, "body", None)
+            end = (body[0].lineno if isinstance(body, list) and body
+                   else node.lineno + 1)
+            if any(rule in self.suppressions.get(ln, ())
+                   for ln in range(node.lineno, end)):
+                return True
+            f = f.parent
+        return False
+
+
+def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def normalize_relpath(path: str, root: str) -> str:
+    """The ONE producer of baseline-key paths (shared by
+    Project.add_file and the CLI's analyzed-paths set — they must
+    never diverge, or scoped --fix-baseline retention breaks)."""
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    if rel.startswith(".."):
+        rel = os.path.abspath(path)
+    return rel.replace(os.sep, "/")
+
+
+def lookup_assign(mod: "ModuleInfo", ctx: Optional["FunctionInfo"],
+                  name: str) -> Optional[ast.AST]:
+    """Scope-aware assignment lookup: the enclosing function chain
+    first (a local `fn = ...` in an unrelated function must not be
+    visible here), then module scope. Dotted names ("self._fn") live
+    at module scope."""
+    if "." not in name:
+        f = ctx
+        while f is not None:
+            if name in f.assigns:
+                return f.assigns[name]
+            if name in f.local_names:
+                return None        # local, but not a simple binding
+            f = f.parent
+    return mod.assigns.get(name)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains ('' if other)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+class _Indexer(ast.NodeVisitor):
+    """Single walk building ModuleInfo: function tree, per-node
+    (function, loop-depth) context, calls, imports, assignments."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.func_stack: List[FunctionInfo] = []
+        self.class_stack: List[str] = []
+        self.loop_depth = 0
+
+    # -- helpers --
+    def _cur(self) -> Optional[FunctionInfo]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    def _qual(self, name: str) -> str:
+        parts = [f.qualname for f in self.func_stack[-1:]]
+        if parts:
+            return f"{parts[0]}.{name}"
+        if self.class_stack:
+            return f"{'.'.join(self.class_stack)}.{name}"
+        return name
+
+    def _enter_function(self, node, name: str):
+        parent = self._cur()
+        info = FunctionInfo(node, self._qual(name), self.mod, parent,
+                            self.class_stack[-1] if self.class_stack
+                            else "")
+        self.mod.functions.append(info)
+        self.mod.by_name.setdefault(name, []).append(info)
+        if parent is not None:
+            parent.children.append(info)
+            parent.nested[name] = info
+            parent.local_names.add(name)
+        if self.class_stack and parent is None:
+            self.mod.methods.setdefault(
+                self.class_stack[-1], {})[name] = info
+        if not isinstance(node, ast.Lambda):
+            for arg in ([*node.args.posonlyargs, *node.args.args,
+                         *node.args.kwonlyargs]
+                        + ([node.args.vararg] if node.args.vararg else [])
+                        + ([node.args.kwarg] if node.args.kwarg else [])):
+                info.local_names.add(arg.arg)
+        else:
+            for arg in [*node.args.posonlyargs, *node.args.args,
+                        *node.args.kwonlyargs]:
+                info.local_names.add(arg.arg)
+        return info
+
+    # -- visitors --
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node, name: str):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        info = self._enter_function(node, name)
+        self.func_stack.append(info)
+        saved_depth, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = saved_depth
+        self.func_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node, node.name)
+
+    def visit_Lambda(self, node):
+        self._visit_func(node, "<lambda>")
+
+    def _visit_loop(self, node):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = _visit_loop
+
+    def _visit_for(self, node):
+        # the iterable expression is evaluated ONCE, at the enclosing
+        # depth; only target+body run per iteration
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        self.visit(node.iter)
+        self.loop_depth += 1
+        self.visit(node.target)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = _visit_for
+
+    def _visit_comp(self, node):
+        # comprehensions iterate: element/condition exprs run per
+        # item, but the FIRST iterable is evaluated once
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        self.visit(node.generators[0].iter)
+        self.loop_depth += 1
+        for i, gen in enumerate(node.generators):
+            self.visit(gen.target)
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self.loop_depth -= 1
+
+    visit_ListComp = visit_SetComp = visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_Import(self, node: ast.Import):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        for alias in node.names:
+            self.mod.imports[alias.asname or alias.name.split(".")[0]] \
+                = (alias.name, None)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        modname = ("." * node.level) + (node.module or "")
+        for alias in node.names:
+            self.mod.imports[alias.asname or alias.name] \
+                = (modname, alias.name)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        cur = self._cur()
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if cur is not None:
+                    cur.local_names.add(tgt.id)
+                    cur.assigns[tgt.id] = node.value
+                else:
+                    self.mod.assigns[tgt.id] = node.value
+            elif isinstance(tgt, ast.Tuple):
+                for el in tgt.elts:
+                    if isinstance(el, ast.Name) and cur is not None:
+                        cur.local_names.add(el.id)
+            elif isinstance(tgt, ast.Attribute):
+                # self._decode_fn = jax.jit(...) style bindings
+                self.mod.assigns[dotted_name(tgt)] = node.value
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        cur = self._cur()
+        if isinstance(node.target, ast.Name) and cur is not None:
+            cur.local_names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        cur = self._cur()
+        if cur is not None:
+            if isinstance(node.func, ast.Name):
+                cur.calls_bare.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in ("self", "cls"):
+                cur.calls_self.add(node.func.attr)
+            # other attribute calls (obj.method) are NOT resolved — a
+            # bare tail match against unrelated defs is how false
+            # traced-propagation happens
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return):
+        self.mod.node_ctx[id(node)] = (self._cur(), self.loop_depth)
+        cur = self._cur()
+        if (cur is not None and isinstance(node.value, ast.Name)
+                and node.value.id in cur.nested):
+            cur.returned_defs.append(cur.nested[node.value.id])
+        self.generic_visit(node)
+
+    def generic_visit(self, node):
+        self.mod.node_ctx.setdefault(
+            id(node), (self._cur(), self.loop_depth))
+        super().generic_visit(node)
+
+
+class Project:
+    """All analyzed modules + cross-module traced propagation."""
+
+    def __init__(self, root: str = "."):
+        self.root = os.path.abspath(root)
+        self.modules: List[ModuleInfo] = []
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+
+    # -- loading --
+    def add_file(self, path: str) -> Optional[ModuleInfo]:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return None
+        rel = normalize_relpath(path, self.root)
+        mod = ModuleInfo(path, rel, tree, source)
+        mod.suppressions = _parse_suppressions(source)
+        mod.dotted = self._dotted_for(rel)
+        _Indexer(mod).visit(tree)
+        self.modules.append(mod)
+        if mod.dotted:
+            self.by_dotted[mod.dotted] = mod
+        return mod
+
+    @staticmethod
+    def _dotted_for(relpath: str) -> Optional[str]:
+        if not relpath.endswith(".py") or ":" in relpath:
+            return None
+        parts = relpath[:-3].replace("\\", "/").split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        if not parts or any(not p.isidentifier() for p in parts):
+            return None
+        return ".".join(parts)
+
+    # -- traced-function seeding + propagation --
+    def mark_traced(self) -> None:
+        for mod in self.modules:
+            self._seed_module(mod)
+        # fixpoint: propagate through calls
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules:
+                for fn in mod.functions:
+                    if not fn.traced:
+                        continue
+                    # anything DEFINED inside a traced body executes
+                    # under the trace when invoked (helpers passed as
+                    # callbacks, nested lambdas, scan bodies)
+                    for child in fn.children:
+                        if not child.traced:
+                            child.traced = True
+                            changed = True
+                    for name in fn.calls_bare:
+                        for target in self._resolve(mod, fn, name):
+                            if not target.traced:
+                                target.traced = True
+                                changed = True
+                    for name in fn.calls_self:
+                        for target in self._resolve(mod, fn, name,
+                                                    is_self=True):
+                            if not target.traced:
+                                target.traced = True
+                                changed = True
+
+    def _seed_module(self, mod: ModuleInfo) -> None:
+        for fn in mod.functions:
+            node = fn.node
+            if isinstance(node, ast.Lambda):
+                continue
+            for dec in node.decorator_list:
+                if self._is_trace_entry(dec):
+                    fn.traced = True
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_trace_entry(node.func):
+                continue
+            ctx_fn, _ = mod.node_ctx.get(id(node), (None, 0))
+            for arg in node.args:
+                self._seed_arg(mod, ctx_fn, arg)
+
+    def _seed_arg(self, mod: ModuleInfo, ctx_fn: Optional[FunctionInfo],
+                  arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            info = self._function_for_node(mod, arg)
+            if info is not None:
+                info.traced = True
+            return
+        if isinstance(arg, ast.Call):
+            name = call_name(arg)
+            tail = name.split(".")[-1]
+            if tail == "partial" and arg.args:
+                # jit(functools.partial(f, ...)) -> seed f
+                self._seed_arg(mod, ctx_fn, arg.args[0])
+                return
+            # jax.jit(self._build_decode()) -> seed the defs the
+            # factory returns
+            for target in self._resolve(
+                    mod, ctx_fn, tail,
+                    is_self=name.startswith(("self.", "cls."))):
+                for ret in target.returned_defs:
+                    ret.traced = True
+            return
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            name = dotted_name(arg)
+            tail = name.split(".")[-1]
+            if not tail:
+                return
+            targets = list(self._resolve(
+                mod, ctx_fn, tail,
+                is_self=name.startswith(("self.", "cls."))))
+            for t in targets:
+                t.traced = True
+            if not targets and isinstance(arg, ast.Name):
+                # name bound to functools.partial(f, ...)?
+                val = lookup_assign(mod, ctx_fn, arg.id)
+                if isinstance(val, ast.Call) \
+                        and call_name(val).split(".")[-1] == "partial" \
+                        and val.args:
+                    self._seed_arg(mod, ctx_fn, val.args[0])
+
+    @staticmethod
+    def _is_trace_entry(node: ast.AST) -> bool:
+        name = dotted_name(node)
+        if not name:
+            # @functools.partial(jax.jit, ...) decorator form
+            if isinstance(node, ast.Call):
+                tail = call_name(node).split(".")[-1]
+                if tail == "partial" and node.args:
+                    return Project._is_trace_entry(node.args[0])
+            return False
+        return name.split(".")[-1] in TRACE_ENTRY_NAMES
+
+    def _function_for_node(self, mod: ModuleInfo,
+                           node: ast.AST) -> Optional[FunctionInfo]:
+        for fn in mod.functions:
+            if fn.node is node:
+                return fn
+        return None
+
+    def _resolve(self, mod: ModuleInfo, ctx: Optional[FunctionInfo],
+                 name: str, is_self: bool = False
+                 ) -> Iterable[FunctionInfo]:
+        """Resolve a called name to function defs. Bare names walk the
+        nested scope chain, then module level, then one import hop into
+        another analyzed module (Python has no implicit self, so bare
+        names never hit methods). `self.X` calls resolve ONLY against
+        the enclosing class's methods."""
+        if is_self:
+            cls = ""
+            f = ctx
+            while f is not None and not cls:
+                cls = f.class_name
+                f = f.parent
+            if cls:
+                meth = mod.methods.get(cls, {})
+                if name in meth:
+                    return [meth[name]]
+            return []
+        f = ctx
+        while f is not None:
+            if name in f.nested:
+                return [f.nested[name]]
+            f = f.parent
+        hits = [fn for fn in mod.by_name.get(name, ())
+                if fn.parent is None and not fn.class_name]
+        if hits:
+            return hits
+        imp = mod.imports.get(name)
+        if imp is not None:
+            target_mod = self._resolve_import(mod, imp[0])
+            if target_mod is not None and imp[1]:
+                return [fn for fn in target_mod.by_name.get(imp[1], ())
+                        if fn.parent is None and not fn.class_name]
+        return []
+
+    def _resolve_import(self, mod: ModuleInfo,
+                        modname: str) -> Optional[ModuleInfo]:
+        if not modname.startswith("."):
+            return self.by_dotted.get(modname)
+        if mod.dotted is None:
+            return None
+        level = len(modname) - len(modname.lstrip("."))
+        suffix = modname.lstrip(".")
+        base = mod.dotted.split(".")
+        # a module's relative import is resolved against its package
+        base = base[: len(base) - level] if len(base) >= level else []
+        parts = base + ([suffix] if suffix else [])
+        return self.by_dotted.get(".".join(p for p in parts if p))
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def analyze_paths(paths: Iterable[str], root: str = ".",
+                  select: Optional[Set[str]] = None) -> List[Finding]:
+    """Analyze files/dirs, returning suppression-filtered findings."""
+    from . import rules
+    project = Project(root)
+    for path in iter_py_files(paths):
+        project.add_file(path)
+    project.mark_traced()
+    kept: List[Finding] = []
+    for mod in project.modules:
+        for f in rules.check_module(project, mod):
+            if select and f.rule not in select:
+                continue
+            if not mod.suppressed(f.rule, f.line, _find_func(mod, f.func)):
+                kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+def _find_func(mod: ModuleInfo, qualname: str):
+    for fn in mod.functions:
+        if fn.qualname == qualname:
+            return fn
+    return None

@@ -1,0 +1,452 @@
+"""The jaxlint rule catalogue (JL001-JL008).
+
+Each rule is tuned to this codebase's dispatch-discipline hazards (see
+README.md for rationale + fix patterns). Rules are deliberately
+narrow: a finding should either be fixed or carry a baseline
+justification — noisy rules rot baselines.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .analyzer import (Finding, FunctionInfo, ModuleInfo, Project,
+                       call_name, dotted_name, lookup_assign)
+
+# KV-pool parameter names: functions taking these hold the engine's
+# page pools, which MUST be donated through jit (JL002) or XLA copies
+# the whole cache per token.
+KV_POOL_NAMES = {
+    "k_pages", "v_pages", "kv_pages", "dk", "dv",
+    "k_cache", "v_cache", "cache_k", "cache_v",
+}
+
+# device-upload callees (JL006)
+UPLOAD_CALLEES = {
+    "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    "jax.device_put", "device_put",
+}
+
+# host-sync callees banned under a trace (JL001)
+HOST_SYNC_NP = {"asarray", "array", "copy", "save", "savez"}
+HOST_SYNC_METHODS = {"item", "tolist", "numpy", "__array__"}
+
+# modules/functions that are sanctioned sync points (JL005): timing
+# and benchmarking utilities exist to block; tests may sync freely
+SANCTIONED_SYNC = ("profil", "bench", "timing", "test")
+
+JIT_NAMES = {"jit", "pjit"}
+ALL_RULES = ("JL001", "JL002", "JL003", "JL004",
+             "JL005", "JL006", "JL007", "JL008")
+
+
+def check_module(project: Project, mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    out.extend(check_decorated_defs(project, mod))
+    out.extend(check_traced_mutator_calls(mod))
+    for node in ast.walk(mod.tree):
+        fn, loop_depth = mod.node_ctx.get(id(node), (None, 0))
+        traced = fn is not None and fn.traced
+        if isinstance(node, ast.Call):
+            out.extend(_check_call(project, mod, node, fn, traced,
+                                   loop_depth))
+        elif isinstance(node, (ast.Global, ast.Nonlocal)) and traced:
+            names = ", ".join(node.names)
+            out.append(_f(mod, "JL004", node, fn,
+                          f"scope:{names}",
+                          f"`{type(node).__name__.lower()} {names}` "
+                          f"inside a traced function: mutating "
+                          f"enclosing scope under trace leaks tracers "
+                          f"or captures stale values"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)) and traced:
+            out.extend(_check_traced_assign(mod, node, fn))
+    return out
+
+
+def _f(mod: ModuleInfo, rule: str, node: ast.AST,
+       fn: Optional[FunctionInfo], detail: str, message: str) -> Finding:
+    return Finding(rule=rule, path=mod.relpath,
+                   line=getattr(node, "lineno", 1),
+                   func=fn.qualname if fn else "", detail=detail,
+                   message=message)
+
+
+# ---------------------------------------------------------------- calls
+
+def _check_call(project: Project, mod: ModuleInfo, node: ast.Call,
+                fn: Optional[FunctionInfo], traced: bool,
+                loop_depth: int) -> Iterable[Finding]:
+    out: List[Finding] = []
+    name = call_name(node)
+    tail = name.split(".")[-1] if name else ""
+
+    # JL001: host-sync calls under a trace
+    if traced:
+        root = name.split(".")[0] if name else ""
+        if root in ("np", "numpy") and tail in HOST_SYNC_NP \
+                and not name.startswith((f"{root}.random.",)):
+            out.append(_f(mod, "JL001", node, fn, name,
+                          f"`{name}(...)` inside a traced function "
+                          f"forces a device->host sync per call; use "
+                          f"jnp or hoist to the host side"))
+        elif tail in HOST_SYNC_METHODS and "." in name:
+            out.append(_f(mod, "JL001", node, fn, f".{tail}()",
+                          f"`.{tail}()` inside a traced function "
+                          f"blocks on device values (host sync)"))
+        elif name in ("float", "int", "bool") and node.args \
+                and not all(isinstance(a, ast.Constant)
+                            for a in node.args):
+            out.append(_f(mod, "JL001", node, fn, f"{name}()",
+                          f"`{name}(...)` on a non-constant inside a "
+                          f"traced function concretizes a tracer "
+                          f"(ConcretizationTypeError or host sync)"))
+
+        # JL007: wall-clock / host RNG on the traced path
+        if name.startswith(("time.", "datetime.")) \
+                or name.startswith(("random.", "np.random.",
+                                    "numpy.random.")):
+            out.append(_f(mod, "JL007", node, fn, name,
+                          f"`{name}(...)` inside a traced function is "
+                          f"baked in at trace time (stale clocks / "
+                          f"fixed randomness); thread jax.random keys "
+                          f"or compute host-side"))
+
+    # JL005: explicit sync points
+    if name in ("jax.device_get", "jax.block_until_ready") \
+            or (tail == "block_until_ready" and "." in name):
+        sync = name if name.startswith("jax.") else f".{tail}()"
+        if traced:
+            out.append(_f(mod, "JL005", node, fn, sync,
+                          f"`{sync}` inside a traced function"))
+        elif loop_depth > 0 and not _sanctioned_sync(mod, fn):
+            out.append(_f(mod, "JL005", node, fn, sync,
+                          f"`{sync}` inside a host loop serializes "
+                          f"host and device per iteration; sync once "
+                          f"after the loop"))
+
+    # JL006: per-iteration device uploads in host loops
+    if not traced and loop_depth > 0 and name in UPLOAD_CALLEES:
+        out.append(_f(mod, "JL006", node, fn, name,
+                      f"`{name}(...)` inside a host loop uploads per "
+                      f"iteration; coalesce into one packed upload or "
+                      f"cache device-side (like the engine's "
+                      f"_samp_cache)"))
+
+    # JL008 / JL002: jit call sites
+    if tail in JIT_NAMES and name.split(".")[0] in ("jax", "jit",
+                                                    "pjit"):
+        if loop_depth > 0:
+            out.append(_f(mod, "JL008", node, fn, "jit-in-loop",
+                          "`jax.jit` in a loop body builds a new "
+                          "program (and cache entry) per iteration; "
+                          "hoist + memoize with an explicit keyed "
+                          "cache"))
+        out.extend(_check_jit_donation(project, mod, node, fn))
+
+    # JL003: hazardous args at jitted-callable call sites
+    out.extend(_check_jit_callsite_args(mod, node, fn, name))
+    return out
+
+
+def _sanctioned_sync(mod: ModuleInfo, fn: Optional[FunctionInfo]) -> bool:
+    hay = mod.relpath.lower()
+    if fn is not None:
+        hay += ":" + fn.qualname.lower()
+    return any(s in hay for s in SANCTIONED_SYNC)
+
+
+# ---------------------------------------------------------- JL002 (jit)
+
+def _check_jit_donation(project: Project, mod: ModuleInfo,
+                        node: ast.Call,
+                        fn: Optional[FunctionInfo]) -> Iterable[Finding]:
+    if not node.args:
+        return []
+    targets = _resolve_jitted_fn(project, mod, node.args[0], fn)
+    donated = _int_tuple(_kwarg(node, "donate_argnums"))
+    donated_names = _str_tuple(_kwarg(node, "donate_argnames"))
+    out = []
+    for target, offset in targets:
+        params = [a.arg for a in target.node.args.args]
+        missing = []
+        for i, p in enumerate(params):
+            if i < offset:
+                continue    # bound by functools.partial, not a jit arg
+            if p in KV_POOL_NAMES and (i - offset) not in donated \
+                    and p not in donated_names:
+                missing.append(p)
+        if missing:
+            out.append(_f(
+                mod, "JL002", node, fn,
+                f"{target.qualname}:{','.join(missing)}",
+                f"`jax.jit({target.node.name if hasattr(target.node, 'name') else '<lambda>'})` "
+                f"passes KV pool arg(s) {missing} without donating "
+                f"them (donate_argnums): XLA copies the whole page "
+                f"pool per call instead of updating it in place"))
+    return out
+
+
+def check_decorated_defs(project: Project,
+                         mod: ModuleInfo) -> List[Finding]:
+    """JL002 for the decorator form: @jax.jit / @partial(jax.jit, ...)
+    on a def taking KV-pool args."""
+    out: List[Finding] = []
+    for fninfo in mod.functions:
+        node = fninfo.node
+        if isinstance(node, ast.Lambda):
+            continue
+        for dec in node.decorator_list:
+            donated: Set[int] = set()
+            donated_names: Set[str] = set()
+            is_jit = False
+            if dotted_name(dec).split(".")[-1] in JIT_NAMES:
+                is_jit = True
+            elif isinstance(dec, ast.Call):
+                tail = call_name(dec).split(".")[-1]
+                if tail in JIT_NAMES:
+                    is_jit = True
+                elif tail == "partial" and dec.args and \
+                        dotted_name(dec.args[0]).split(".")[-1] \
+                        in JIT_NAMES:
+                    is_jit = True
+                if is_jit:
+                    donated = _int_tuple(_kwarg(dec, "donate_argnums"))
+                    donated_names = _str_tuple(
+                        _kwarg(dec, "donate_argnames"))
+            if not is_jit:
+                continue
+            params = [a.arg for a in node.args.args]
+            missing = [p for i, p in enumerate(params)
+                       if p in KV_POOL_NAMES and i not in donated
+                       and p not in donated_names]
+            if missing:
+                out.append(_f(
+                    mod, "JL002", node, fninfo,
+                    f"{fninfo.qualname}:{','.join(missing)}",
+                    f"jitted `{node.name}` takes KV pool arg(s) "
+                    f"{missing} without donate_argnums: the pool is "
+                    f"copied per call instead of updated in place"))
+    return out
+
+
+def _resolve_jitted_fn(project: Project, mod: ModuleInfo, arg: ast.AST,
+                       ctx: Optional[FunctionInfo]
+                       ) -> List[tuple]:
+    """-> [(FunctionInfo, offset)]: the defs a jit-site argument
+    resolves to, with the count of positional args pre-bound by
+    functools.partial chains (jit-level donate indices are shifted by
+    that many)."""
+    if isinstance(arg, ast.Lambda):
+        info = project._function_for_node(mod, arg)
+        return [(info, 0)] if info else []
+    if isinstance(arg, (ast.Name, ast.Attribute)):
+        name = dotted_name(arg)
+        tail = name.split(".")[-1]
+        if not tail:
+            return []
+        hits = [(t, 0) for t in project._resolve(
+            mod, ctx, tail,
+            is_self=name.startswith(("self.", "cls.")))]
+        if not hits and isinstance(arg, ast.Name):
+            # name bound to functools.partial(f, ...) — mirror the
+            # traced-seeding resolver so JL002 sees the same fns
+            val = lookup_assign(mod, ctx, arg.id)
+            if isinstance(val, ast.Call) \
+                    and call_name(val).split(".")[-1] == "partial" \
+                    and val.args:
+                return [(t, off + len(val.args) - 1)
+                        for t, off in _resolve_jitted_fn(
+                            project, mod, val.args[0], ctx)]
+        return hits
+    if isinstance(arg, ast.Call):
+        name = call_name(arg)
+        tail = name.split(".")[-1]
+        if tail == "partial" and arg.args:
+            return [(t, off + len(arg.args) - 1)
+                    for t, off in _resolve_jitted_fn(
+                        project, mod, arg.args[0], ctx)]
+        # factory: jax.jit(self._build_decode()) -> the returned defs
+        out: List[tuple] = []
+        for target in project._resolve(
+                mod, ctx, tail,
+                is_self=name.startswith(("self.", "cls."))):
+            out.extend((ret, 0) for ret in target.returned_defs)
+        return out
+    return []
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _int_tuple(node: Optional[ast.AST]) -> Set[int]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {el.value for el in node.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, int)}
+    return set()
+
+
+def _str_tuple(node: Optional[ast.AST]) -> Set[str]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {el.value for el in node.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)}
+    return set()
+
+
+# --------------------------------------------------------------- JL003
+
+def _jitted_binding_statics(mod: ModuleInfo,
+                            ctx: Optional[FunctionInfo],
+                            name: str) -> Optional[Set[int]]:
+    """static_argnums of the jax.jit(...) call bound to `name` in the
+    CALLER'S scope (enclosing-function chain, then module; dotted
+    'self.x' names at module scope) — or None when the name is not a
+    jit binding there. Scope-aware on purpose: an unrelated
+    function's local `fn = jax.jit(...)` must not make every `fn(...)`
+    in the module look jitted."""
+    value = lookup_assign(mod, ctx, name)
+    if isinstance(value, ast.Call) \
+            and call_name(value).split(".")[-1] in JIT_NAMES \
+            and call_name(value).split(".")[0] in ("jax", "jit",
+                                                   "pjit"):
+        return _int_tuple(_kwarg(value, "static_argnums"))
+    return None
+
+
+def _check_jit_callsite_args(mod: ModuleInfo, node: ast.Call,
+                             fn: Optional[FunctionInfo], name: str
+                             ) -> Iterable[Finding]:
+    if not name:
+        return []
+    statics = _jitted_binding_statics(mod, fn, name)
+    if statics is None:
+        return []
+    out = []
+    for i, arg in enumerate(node.args):
+        if i in statics:
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                out.append(_f(
+                    mod, "JL003", node, fn, f"{name}:arg{i}",
+                    f"unhashable {type(arg).__name__.lower()} literal "
+                    f"at static position {i} of jitted `{name}`: "
+                    f"static args must be hashable (TypeError at "
+                    f"runtime)"))
+            continue
+        hazard = None
+        if isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, (bool, int, float)):
+            hazard = f"Python {type(arg.value).__name__} literal"
+        elif isinstance(arg, ast.Call) \
+                and call_name(arg) in ("len", "int", "float", "bool"):
+            hazard = f"`{call_name(arg)}(...)` host scalar"
+        elif isinstance(arg, ast.IfExp) and (
+                (isinstance(arg.body, ast.Constant)
+                 and arg.body.value is None)
+                ^ (isinstance(arg.orelse, ast.Constant)
+                   and arg.orelse.value is None)):
+            hazard = "conditional None/array argument (pytree " \
+                     "structure varies per call -> retrace)"
+        if hazard:
+            out.append(_f(
+                mod, "JL003", node, fn, f"{name}:arg{i}",
+                f"{hazard} at traced position {i} of jitted "
+                f"`{name}`: type/shape drift here retraces or "
+                f"re-uploads per call; mark static or pass a device "
+                f"array"))
+    return out
+
+
+# --------------------------------------------------------------- JL004
+
+def _closure_owner(fn: FunctionInfo, name: str
+                   ) -> Optional[FunctionInfo]:
+    """The function (self or ancestor) whose local `name` is, or None
+    for module globals."""
+    f = fn
+    while f is not None:
+        if name in f.local_names:
+            return f
+        f = f.parent
+    return None
+
+
+def _hazardous_closure_write(fn: FunctionInfo, name: str) -> bool:
+    """Writing a name owned by an enclosing TRACED function is the
+    Pallas-ref / scratch idiom (same trace, fine). Writing a host
+    ancestor's local or a module global from traced code is the
+    trace-time-only mutation / tracer-leak hazard."""
+    owner = _closure_owner(fn, name)
+    if owner is fn:
+        return False
+    return owner is None or not owner.traced
+
+
+def _check_traced_assign(mod: ModuleInfo, node, fn: FunctionInfo
+                         ) -> Iterable[Finding]:
+    out = []
+    targets = (node.targets if isinstance(node, ast.Assign)
+               else [node.target])
+    for tgt in targets:
+        for el in (tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]):
+            if isinstance(el, ast.Attribute):
+                dn = dotted_name(el)
+                out.append(_f(
+                    mod, "JL004", node, fn, f"attr:{dn}",
+                    f"assignment to `{dn}` inside a traced function: "
+                    f"object state mutated under trace captures a "
+                    f"tracer (leak) and silently no-ops on later "
+                    f"cached calls"))
+            elif isinstance(el, ast.Subscript) \
+                    and isinstance(el.value, ast.Name) \
+                    and _hazardous_closure_write(fn, el.value.id):
+                out.append(_f(
+                    mod, "JL004", node, fn, f"mutate:{el.value.id}",
+                    f"subscript assignment to closure/global "
+                    f"`{el.value.id}` inside a traced function: "
+                    f"mutation happens at trace time only (stale on "
+                    f"cached calls) and can leak tracers"))
+    return out
+
+
+# NOTE: no "update" — optax's pure `opt.update(grads, state)` is the
+# canonical traced call and would false-positive constantly
+MUTATORS = {"append", "extend", "add", "insert",
+            "setdefault", "remove"}
+
+
+def check_traced_mutator_calls(mod: ModuleInfo) -> List[Finding]:
+    """JL004: container mutation on closure/global names under trace
+    (separate walk — needs local-name sets finalized)."""
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn, _ = mod.node_ctx.get(id(node), (None, 0))
+        if fn is None or not fn.traced:
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATORS \
+                and isinstance(node.func.value, ast.Name) \
+                and _hazardous_closure_write(fn, node.func.value.id):
+            nm = node.func.value.id
+            out.append(_f(
+                mod, "JL004", node, fn, f"mutate:{nm}",
+                f"`.{node.func.attr}()` on closure/global `{nm}` "
+                f"inside a traced function: runs at trace time "
+                f"only; cached calls skip it (and it may capture "
+                f"tracers)"))
+    return out
