@@ -164,6 +164,95 @@ def bench_mixed(on_tpu: bool, smoke: bool = False) -> dict:
     }
 
 
+def bench_async_ab(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 4 A/B: pipelined async readback vs synchronous folds on
+    the bursty mixed prefill+decode workload — the regime with both
+    steady decode runs (where the pipeline overlaps host folds with
+    device compute) and constant structural events (where it drains).
+    Greedy, so the async engine must be TOKEN-EXACT vs sync: the
+    one-tick lag only delays when tokens become host-visible, never
+    what they are. Reports tokens/s each way plus the async engine's
+    tick_times telemetry (overlap_ratio = share of tick wall-time NOT
+    blocked on the device readback). In --smoke mode this asserts
+    exactness and a never-materially-slower tripwire."""
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.models import llama
+
+    if smoke:
+        cfg = llama.config("debug")
+        batch, plen, n_req, chunk, budget = 4, 48, 10, 16, 64
+        burst, every, gen0 = 3, 6, 8
+    elif on_tpu:
+        cfg = _tpu_bench_model()
+        batch, plen, n_req, chunk, budget = 8, 256, 24, 64, 512
+        burst, every, gen0 = 6, 10, 48
+    else:
+        cfg = llama.config("tiny", vocab_size=2048, hidden=256,
+                           n_layers=4, n_heads=8, n_kv_heads=4,
+                           head_dim=32, ffn=1024, max_seq=512)
+        batch, plen, n_req, chunk, budget = 8, 112, 24, 16, 256
+        burst, every, gen0 = 6, 10, 16
+    rng = np.random.default_rng(8)
+    lens = [plen + 16 * (i % 3) for i in range(n_req)]
+    gens = [gen0 + 8 * (i % 3) for i in range(n_req)]
+    prompts = [rng.integers(1, cfg.vocab_size, lens[i]).tolist()
+               for i in range(n_req)]
+
+    def run(async_readback):
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=batch, page_size=16,
+            num_pages=max(512, batch * 32), seed=5,
+            max_prefill_tokens=chunk, enable_prefix_caching=False,
+            max_num_batched_tokens=budget,
+            async_readback=async_readback))
+
+        def drive():
+            eng._prefill_rr = 0          # identical packing every pass
+            reqs = [Request(f"a{i}", list(p),
+                            SamplingParams(max_tokens=gens[i]))
+                    for i, p in enumerate(prompts)]
+            pending = list(reqs)
+            steps = 0
+            while eng.has_work() or pending:
+                if pending and steps % every == 0:
+                    for r in pending[:burst]:
+                        eng.add_request(r)
+                    pending = pending[burst:]
+                eng.step()
+                steps += 1
+            return reqs, steps
+
+        drive()                          # warmup: compiles every bucket
+        t0 = time.perf_counter()
+        reqs, steps = drive()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {"tokens_per_sec": round(toks / dt, 1), "steps": steps,
+                "tick_times": eng.stats()["tick_times"]}, \
+            [r.output_tokens for r in reqs]
+
+    async_row, out_a = run(True)
+    sync_row, out_s = run(False)
+    res = {
+        "async": async_row, "sync": sync_row,
+        "async_speedup": round(
+            async_row["tokens_per_sec"]
+            / max(sync_row["tokens_per_sec"], 1e-9), 2),
+        "token_exact": out_a == out_s,
+        "batch": batch, "requests": n_req, "chunk": chunk,
+    }
+    if smoke:
+        assert res["token_exact"], \
+            f"async decode diverged from sync: {out_a} vs {out_s}"
+        assert async_row["tick_times"]["lagged_ticks"] > 0, \
+            "async engine never pipelined a tick"
+        # regression tripwire with slack for CI timer noise: the
+        # pipeline must never make decode materially slower
+        assert res["async_speedup"] >= 0.8, res
+    return res
+
+
 def bench_kernel_tick(on_tpu: bool) -> dict:
     """ISSUE 2 smoke gate: drive a small mixed workload through the
     unified engine with decode_impl=pallas_interpret (the Pallas
@@ -486,11 +575,13 @@ def main() -> None:
         # scheduler / kernel regressions
         mixed = bench_mixed(on_tpu, smoke=True)
         kernel = bench_kernel_tick(on_tpu)
+        async_ab = bench_async_ab(on_tpu, smoke=True)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
             "unit": "tokens_per_sec",
-            "detail": {**mixed, "kernel_tick": kernel},
+            "detail": {**mixed, "kernel_tick": kernel,
+                       "async_readback_ab": async_ab},
         }))
         return
     if "--long-ctx" in sys.argv:
@@ -506,6 +597,7 @@ def main() -> None:
         return
     eng = bench_engine(on_tpu)
     mixed = bench_mixed(on_tpu)
+    async_ab = bench_async_ab(on_tpu)
     scaling = bench_kernel_scaling(on_tpu)
     prefix = bench_prefix_cache(on_tpu)
     spec = bench_speculative(on_tpu)
@@ -517,6 +609,7 @@ def main() -> None:
         "unit": "tokens_per_sec",
         "detail": {"device": getattr(dev, "device_kind", str(dev)),
                    **eng, "mixed_prefill_decode": mixed,
+                   "async_readback_ab": async_ab,
                    "paged_kernel_scaling": scaling,
                    "prefix_cache": prefix, "speculative": spec,
                    "multi_step_decode": multi},

@@ -66,6 +66,30 @@ class LLMServerImpl:
                                   req.finish_reason))
             await asyncio.sleep(0)
 
+    def _abort_off_loop(self, rid: str) -> None:
+        """Fire an engine abort WITHOUT blocking the event loop:
+        abort serializes against step() (engine._step_lock), and a
+        step is a device dispatch that can take hundreds of ms behind
+        a network tunnel — awaiting it in a stream's finally would
+        freeze every other coroutine (and an async generator being
+        closed cannot await at all). Fire-and-forget on the executor;
+        abort never raises for an unknown/finished request, but a
+        broken engine invariant (fold assert, OOM in the rebuild)
+        must reach the logs, not die with the discarded future."""
+        def _surface(fut):
+            exc = fut.exception()
+            if exc is not None:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "engine.abort(%s) failed", rid, exc_info=exc)
+
+        try:
+            asyncio.get_running_loop().run_in_executor(
+                None, self.engine.abort, rid
+            ).add_done_callback(_surface)
+        except RuntimeError:        # no running loop (teardown)
+            self.engine.abort(rid)
+
     # -- generation ---------------------------------------------------------
     async def _generate(self, prompt_tokens: List[int],
                         params: SamplingParams,
@@ -87,7 +111,7 @@ class LLMServerImpl:
             self._queues.pop(rid, None)
             if not req.finished:
                 # caller gone (timeout/cancel): stop decoding for nobody
-                self.engine.abort(rid)
+                self._abort_off_loop(rid)
 
     def _lora_for(self, body: Dict[str, Any]) -> "str | None":
         """LoRA multiplexing the vLLM way: requesting model=<adapter
@@ -194,7 +218,7 @@ class LLMServerImpl:
             self._queues.pop(rid, None)
             if not req.finished:
                 # stream abandoned mid-generation: free the slot + pages
-                self.engine.abort(rid)
+                self._abort_off_loop(rid)
 
     async def chat_stream(self, body: Dict[str, Any]):
         """SSE chunks for stream=true chat completions (OpenAI format)."""
@@ -235,15 +259,22 @@ class LLMServerImpl:
         yield "data: [DONE]\n\n"
 
     async def model_info(self) -> Dict[str, Any]:
+        # stats() snapshots tick telemetry under the engine step
+        # lock — run it off the event loop so a busy tick can't
+        # stall other coroutines
+        stats = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.stats)
         return {"id": self.model_id, "object": "model",
                 "owned_by": "ray_tpu",
                 "adapters": sorted(self.engine._lora_raw),
-                "engine": self.engine.stats()}
+                "engine": stats}
 
     async def register_lora(self, name: str,
                             adapters: Dict[str, Any]) -> list:
-        """Live adapter registration through the deployment handle."""
-        self.engine.register_lora(name, adapters)
+        """Live adapter registration through the deployment handle
+        (off the event loop: registration serializes against step)."""
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.register_lora, name, adapters)
         return sorted(self.engine._lora_raw)
 
     async def check_health(self) -> None:
@@ -287,6 +318,22 @@ class LLMRouterImpl:
             models = [{"id": mid, "object": "model", "owned_by": "ray_tpu"}
                       for mid in self._servers]
             return {"object": "list", "data": models}
+        if path.rstrip("/") == "/stats" and method == "GET":
+            # serving observability (ISSUE 4): per-model engine stats,
+            # including tick_times — host_ms/device_ms/overlap_ratio
+            # of the pipelined tick loop plus lag/drain counters — so
+            # the readback overlap is visible in production, not just
+            # in benches. Adapter names alias their base model's
+            # server; dedupe so each engine reports once.
+            stats: Dict[str, Any] = {}
+            seen: List[Any] = []
+            for h in self._servers.values():
+                if any(h is s for s in seen):
+                    continue
+                seen.append(h)
+                info = await h.model_info.remote()
+                stats[info["id"]] = info["engine"]
+            return {"object": "stats", "models": stats}
         try:
             body = request.json()
         except Exception:

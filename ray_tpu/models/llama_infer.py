@@ -20,6 +20,9 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import attention as attention_op
+# shard_map version shim: ONE shared implementation (ops/jax_compat)
+# so the compat logic cannot drift between consumers
+from ..ops.jax_compat import shard_map_compat as _shard_map
 from ..ops.paged_attention import (gather_kv, paged_attention_on_gathered,
                                    paged_decode_with_new_token, scatter_kv)
 from .llama import LlamaConfig, rms_norm, rope_frequencies
@@ -49,16 +52,6 @@ def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
         axis=-1).astype(x.dtype)
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
-    """shard_map across jax versions: the stable `jax.shard_map`
-    (check_vma) when present, else the experimental one (check_rep) —
-    0.4.x only ships the latter."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map as sm
-    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-              check_rep=False)
 
 
 # ---------------------------------------------------------------- layer body

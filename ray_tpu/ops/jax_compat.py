@@ -1,0 +1,26 @@
+"""jax version-compat shims shared by the ops and model layers.
+
+One home for API drift between the pinned 0.4.x line and newer jax:
+each consumer importing its own copy is how the shims diverge (the
+ring-attention and llama_infer copies had already drifted before this
+module existed). Keep additions tiny and documented with the versions
+they bridge.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the stable `jax.shard_map`
+    (check_vma) when present, else the experimental one (check_rep) —
+    0.4.x only ships the latter. 0.4.37's `jax.shard_map` is a
+    deprecation stub whose getattr RAISES, which hasattr treats as
+    absent, so the probe stays correct there too."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .attention import NEG_INF, _repeat_kv
+from .jax_compat import shard_map_compat as _shard_map
 from ..parallel.mesh import AXIS_SP, BATCH_AXES
 
 
@@ -61,7 +62,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     b, sq, h, d = q.shape
     scale_val = scale if scale is not None else d ** -0.5
-    sp = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size is newer than 0.4.x; psum(1) over the axis is
+    # the version-stable spelling of its size (compile-time constant)
+    ax_size = getattr(jax.lax, "axis_size",
+                      lambda name: jax.lax.psum(1, name))
+    sp = ax_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     k = _repeat_kv(k, h)
     v = _repeat_kv(v, h)
@@ -106,8 +111,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     """Ring attention on globally-sharded (B, S, H, D) arrays: shard_map
     over (batch -> dp/fsdp, seq -> sp)."""
     spec = PartitionSpec(BATCH_AXES, AXIS_SP, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ring_attention, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

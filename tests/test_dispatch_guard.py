@@ -32,10 +32,10 @@ def _engine(**over):
     return InferenceEngine(EngineConfig(**kw))
 
 
-def _warmed_engine(**sp_over):
+def _warmed_engine(async_readback=True, **sp_over):
     """Engine with 3 in-flight requests past prefill, decode loop
     settled (all shape buckets built, device-resident state live)."""
-    eng = _engine()
+    eng = _engine(async_readback=async_readback)
     rng = np.random.default_rng(5)
     sp = dict(max_tokens=64)
     sp.update(sp_over)
@@ -51,17 +51,22 @@ def _warmed_engine(**sp_over):
     return eng
 
 
+@pytest.mark.parametrize("async_rb", [True, False],
+                         ids=["pipelined", "sync"])
 @pytest.mark.parametrize("sp", [
     {},                                                  # greedy
     {"temperature": 0.8, "top_k": 20, "top_p": 0.9,
      "repetition_penalty": 1.2},                         # full sampler
 ], ids=["greedy", "sampled_penalized"])
-def test_steady_state_decode_zero_transfers_zero_compiles(sp):
+def test_steady_state_decode_zero_transfers_zero_compiles(sp, async_rb):
     """32 consecutive decode ticks: no h2d upload (the loop state is
     device-resident and feeds back on device — the guard raises at
     the offending line otherwise) and no new compiled program (shape
-    buckets are warm; the sentinel counts XLA builds)."""
-    eng = _warmed_engine(**sp)
+    buckets are warm; the sentinel counts XLA builds). Holds with
+    the ISSUE 4 pipeline ON (lagged folds are pure d2h + host work:
+    the async copy, the one sanctioned readback and the discard mask
+    add zero uploads and zero programs) and OFF."""
+    eng = _warmed_engine(async_readback=async_rb, **sp)
     comp0 = eng.stats()["jit_cache"]["compiled_programs"]
     disp0 = eng.dispatches
     with dispatch_guard() as rep:
@@ -73,6 +78,11 @@ def test_steady_state_decode_zero_transfers_zero_compiles(sp):
     # nothing finished inside the window (no refresh ran, so the
     # guarded ticks really were the steady-state path)
     assert all(s.request is not None and s.ready for s in eng.slots)
+    if async_rb:
+        # the guarded ticks really ran pipelined: every one of them
+        # folded its predecessor a tick late, with zero drains
+        assert eng.stats()["tick_times"]["lagged_ticks"] >= 32
+        assert eng.stats()["tick_times"]["drains"] == 0
 
 
 def test_guard_raises_on_seeded_h2d_transfer():

@@ -375,6 +375,126 @@ def test_jl005_block_until_ready_in_traced_fn(tmp_path):
     assert len(fs) == 1
 
 
+def test_jl005_bare_asarray_on_dispatch_result(tmp_path):
+    """ISSUE 4: np.asarray on a jitted call's result is an
+    unsanctioned sync point — direct, via a named binding, and via
+    tuple-unpack (the engine's `toks, pools = fn(...)` shape)."""
+    fs = _lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        fn = jax.jit(lambda x: (x + 1, x * 2))
+
+        def tick_direct(x):
+            return np.asarray(fn(x))
+
+        def tick_named(x):
+            toks = fn(x)
+            return np.asarray(toks)
+
+        def tick_unpacked(x):
+            toks, pool = fn(x)
+            return np.asarray(toks)
+    """, select={"JL005"})
+    assert len(fs) == 3
+    assert all(f.detail == "np.asarray:dispatch-result" for f in fs)
+
+
+def test_jl005_asarray_on_jit_factory_result(tmp_path):
+    """The engine's memoized-factory idiom: `fn = self._ragged_fn(...)`
+    yields a jitted binding, so reading its call result with
+    np.asarray is a dispatch-result sync; the same method reading it
+    through a helper (`self._read_tokens`) is not."""
+    fs = _lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        class Eng:
+            def _ragged_fn(self, b):
+                fn = self._cache.get(b)
+                if fn is None:
+                    fn = jax.jit(lambda x: x * b)
+                return fn
+
+            def tick(self, x):
+                out = self._ragged_fn(2)(x)
+                bad = np.asarray(out)
+                toks = self._ragged_fn(4)(x)
+                good = self._read_tokens(toks)
+                return bad, good
+    """, select={"JL005"})
+    assert len(fs) == 1
+    assert fs[0].func == "Eng.tick"
+
+
+def test_jl005_asarray_on_decorated_jit_result(tmp_path):
+    """The plain @jax.jit decorator form dispatches too; a helper
+    that is merely REACHABLE from traced code (not itself jitted)
+    returns plain arrays from host calls and must stay clean."""
+    fs = _lint(tmp_path, """
+        import functools
+
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return helper(x) + 1
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step2(x):
+            return x * 2
+
+        def helper(y):
+            return y
+
+        def tick(x):
+            a = np.asarray(step(x))           # decorated dispatch
+            b = np.asarray(step2(x))          # partial-decorated
+            c = np.asarray(helper(x))         # traced-reachable only
+            return a, b, c
+    """, select={"JL005"})
+    assert len(fs) == 2
+    assert all(f.func == "tick" for f in fs)
+
+
+def test_jl005_asarray_negatives(tmp_path):
+    """Host arrays, non-jit call results, suppressed sanctioned
+    sites, and bench/test modules stay clean."""
+    fs = _lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        fn = jax.jit(lambda x: x + 1)
+
+        def build(host_rows):
+            return np.asarray(host_rows)          # plain host data
+
+        def helper(x):
+            return x
+
+        def boundary(x):
+            y = helper(x)
+            return np.asarray(y)                  # not a dispatch
+
+        def sanctioned_fold(x):
+            toks = fn(x)
+            return np.asarray(toks)  # jaxlint: disable=JL005 -- the one fold site
+    """, select={"JL005"})
+    assert fs == []
+    # bench/profiling modules exist to block: exempt by name
+    fs = _lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        fn = jax.jit(lambda x: x + 1)
+
+        def timed(x):
+            return np.asarray(fn(x))
+    """, name="bench_mod.py", select={"JL005"})
+    assert fs == []
+
+
 # ------------------------------------------------------------------ JL006
 
 def test_jl006_upload_in_host_loop(tmp_path):

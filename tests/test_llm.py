@@ -204,6 +204,17 @@ def test_openai_app_http(ray_start):
             "http://127.0.0.1:8126/v1/chat/completions",
             json={"model": "nope", "messages": []}, timeout=60)
         assert r.status_code == 404
+        # /stats smoke (ISSUE 4): tick-pipeline telemetry is
+        # observable in serving — overlap ratio + lag/drain counters
+        r = requests.get("http://127.0.0.1:8126/stats", timeout=30)
+        assert r.status_code == 200
+        eng_stats = r.json()["models"]["m0"]
+        tt = eng_stats["tick_times"]
+        assert {"wall_ms_avg", "host_ms_avg", "device_ms_avg",
+                "overlap_ratio", "lagged_ticks",
+                "drains"} <= set(tt)
+        assert tt["async_readback"] is True
+        assert eng_stats["dispatches"] >= 1
     finally:
         serve.shutdown()
 
@@ -423,6 +434,157 @@ def test_multi_step_decode_matches_single_step():
     stopped = gen(4, max_tokens=20, stop_token_ids=[stop])
     ref = gen(1, max_tokens=20, stop_token_ids=[stop])
     assert stopped == ref
+
+
+def test_async_readback_token_exact_mixed_finishes():
+    """ISSUE 4 lagged retirement: the pipelined engine must match the
+    sync engine token-for-token (and finish_reason-for-finish_reason)
+    on a mixed batch whose requests retire at DIFFERENT ticks via
+    max_tokens, a stop token, and a penalized stream — each
+    length-finish happens while its successor tick is already in
+    flight, so the one-token over-generation discard and the drain
+    barrier are both exercised repeatedly."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(2, 200, n).tolist() for n in (5, 11, 7, 16)]
+
+    def run(async_rb, stop_tok):
+        eng = make_engine(async_readback=async_rb,
+                          enable_prefix_caching=False)
+        params = [SamplingParams(max_tokens=6),
+                  SamplingParams(max_tokens=13),
+                  SamplingParams(max_tokens=20,
+                                 stop_token_ids=(stop_tok,)),
+                  SamplingParams(max_tokens=9,
+                                 repetition_penalty=1.3)]
+        reqs = [Request(f"x{i}", list(p), params[i])
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            eng.add_request(r)
+        while eng.has_work():
+            eng.step()
+        assert eng.stats()["free_pages"] == eng.stats()["total_pages"]
+        return eng, [(r.output_tokens, r.finish_reason) for r in reqs]
+
+    # pick the stop token from a reference pass so request 2 really
+    # stops mid-stream, several ticks after request 0 retired
+    _, ref = run(False, stop_tok=-1)
+    stop_tok = ref[2][0][4]
+    eng_s, out_sync = run(False, stop_tok)
+    eng_a, out_async = run(True, stop_tok)
+    assert out_async == out_sync
+    assert out_async[2][1] == "stop"
+    tt = eng_a.stats()["tick_times"]
+    # the pipeline actually ran: folds lagged and retirements drained
+    assert tt["lagged_ticks"] > 0 and tt["drains"] > 0
+    assert eng_s.stats()["tick_times"]["lagged_ticks"] == 0
+
+
+def test_async_finish_while_successor_in_flight():
+    """Tightest lag case: a single request whose final token folds
+    while the (over-generating) successor tick is in flight — output
+    must truncate exactly at max_tokens, the discarded token must not
+    leak, and the successor's KV write stays inside the slot's pages
+    (the engine asserts that invariant at every fold)."""
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(2, 200, 9).tolist()
+    outs = {}
+    for async_rb in (False, True):
+        eng = make_engine(async_readback=async_rb)
+        req = Request("one", list(prompt), SamplingParams(max_tokens=2))
+        eng.add_request(req)
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+        assert req.finished and req.finish_reason == "length"
+        assert len(req.output_tokens) == 2
+        outs[async_rb] = (req.output_tokens, steps)
+    assert outs[True][0] == outs[False][0]
+    # the async run needed exactly one extra step: the lagged fold
+    assert outs[True][1] == outs[False][1] + 1
+
+
+def test_abort_drain_does_not_strand_finishes():
+    """An abort-triggered drain folds the in-flight tick OUTSIDE any
+    step() — if that fold retires ANOTHER request, its finish event
+    must not be stranded: has_work() stays true until the next step
+    delivers it through the touched list (the server pump parks on
+    has_work, so a stranded finish would hang its stream consumer)."""
+    rng = np.random.default_rng(9)
+    eng = make_engine(max_batch_size=2, enable_prefix_caching=False)
+    r1 = Request("a", rng.integers(2, 200, 5).tolist(),
+                 SamplingParams(max_tokens=9))
+    r2 = Request("b", rng.integers(2, 200, 7).tolist(),
+                 SamplingParams(max_tokens=4))
+    eng.add_request(r1)
+    eng.add_request(r2)
+    # step until r2's FINAL token is in flight but not yet folded
+    while not (eng._inflight is not None
+               and len(r2.output_tokens) == 3):
+        eng.step()
+    assert eng.abort("a")
+    # the abort's drain folded the in-flight tick: r2 finished
+    # outside step(), its event parked in _pending_touched
+    assert r2.finished and r2.finish_reason == "length"
+    assert len(r2.output_tokens) == 4
+    assert eng.has_work()               # one more step delivers it
+    touched = eng.step()
+    assert r2 in touched
+    assert not eng.has_work()
+    assert eng.stats()["free_pages"] == eng.stats()["total_pages"]
+
+
+def test_async_stream_order_preserved():
+    """ISSUE 4 server contract: the one-tick lag must not reorder,
+    drop, or duplicate streamed chunks — two concurrent SSE-style
+    streams through the engine pump must each reconstruct exactly
+    their request's decoded output."""
+    import asyncio
+
+    from ray_tpu.llm._internal.server import LLMServerImpl
+
+    srv = LLMServerImpl({
+        "model_id": "m0", "model_source": "debug",
+        "engine_kwargs": dict(max_batch_size=4, page_size=8,
+                              num_pages=128, prefill_buckets=(16, 32))})
+    assert srv.engine._async            # pipeline on by default
+
+    async def consume(prompt_text, max_tokens):
+        toks = srv.tokenizer.encode(prompt_text)
+        deltas = []
+        finishes = 0
+        async for delta, finished, reason in srv._generate_stream(
+                toks, SamplingParams(max_tokens=max_tokens)):
+            deltas.append(delta)
+            finishes += finished
+        return deltas, finishes
+
+    async def main():
+        out = await asyncio.gather(consume("hello world", 7),
+                                   consume("quite different", 11))
+        srv._pump.cancel()
+        return out
+
+    (d1, f1), (d2, f2) = asyncio.run(main())
+    assert f1 == 1 and f2 == 1          # exactly one finish each
+    # every chunk except possibly the closing one carries new text
+    assert all(d for d in d1[:-1]) and all(d for d in d2[:-1])
+
+    # byte-exact reconstruction vs a SYNCHRONOUS reference engine:
+    # the lagged stream may deliver chunks later, but never permuted,
+    # duplicated, or dropped (greedy decode is batching-independent,
+    # so solo sync runs are the gold text)
+    ref = InferenceEngine(EngineConfig(
+        model="debug", max_batch_size=4, page_size=8, num_pages=128,
+        prefill_buckets=(16, 32), async_readback=False))
+    for deltas, (text, n) in zip(
+            (d1, d2), (("hello world", 7), ("quite different", 11))):
+        out = ref.generate([srv.tokenizer.encode(text)],
+                           SamplingParams(max_tokens=n))
+        assert "".join(deltas) == srv.tokenizer.decode(
+            out[0].output_tokens)
+    tt = srv.engine.stats()["tick_times"]
+    assert tt["lagged_ticks"] > 0       # streams rode the pipeline
 
 
 def test_multi_step_decode_composes_with_prefix_cache():

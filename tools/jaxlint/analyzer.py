@@ -85,6 +85,11 @@ class FunctionInfo:
         # names returned by this function that are nested defs (the
         # `def _build_x(): def run(...); return run` factory pattern)
         self.returned_defs: List[FunctionInfo] = []
+        # returns a jax.jit(...) binding (the memoized jit-factory
+        # pattern `fn = jax.jit(run); ...; return fn`): callers of
+        # this function hold a compiled dispatchable, so reading its
+        # call results with np.asarray is a JL005 sync point
+        self.returns_jit = False
 
 
 class ModuleInfo:
@@ -176,6 +181,18 @@ def lookup_assign(mod: "ModuleInfo", ctx: Optional["FunctionInfo"],
                 return None        # local, but not a simple binding
             f = f.parent
     return mod.assigns.get(name)
+
+
+def is_jit_call(node: Optional[ast.AST]) -> bool:
+    """True for a `jax.jit(...)` / `pjit(...)` call expression — the
+    binding form whose result is a compiled dispatchable (shared by
+    JL003's static-argnum lookup and JL005's dispatch-result
+    tracing)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    return (name.split(".")[-1] in ("jit", "pjit")
+            and name.split(".")[0] in ("jax", "jit", "pjit"))
 
 
 def dotted_name(node: ast.AST) -> str:
@@ -336,9 +353,17 @@ class _Indexer(ast.NodeVisitor):
                 else:
                     self.mod.assigns[tgt.id] = node.value
             elif isinstance(tgt, ast.Tuple):
+                # tuple-unpack targets record the WHOLE RHS as each
+                # name's value: `toks, pool = fn(...)` makes `toks`
+                # resolvable to the dispatch call (JL005's
+                # np.asarray-on-dispatch-result tracing)
                 for el in tgt.elts:
-                    if isinstance(el, ast.Name) and cur is not None:
-                        cur.local_names.add(el.id)
+                    if isinstance(el, ast.Name):
+                        if cur is not None:
+                            cur.local_names.add(el.id)
+                            cur.assigns[el.id] = node.value
+                        else:
+                            self.mod.assigns[el.id] = node.value
             elif isinstance(tgt, ast.Attribute):
                 # self._decode_fn = jax.jit(...) style bindings
                 self.mod.assigns[dotted_name(tgt)] = node.value
@@ -372,6 +397,13 @@ class _Indexer(ast.NodeVisitor):
         if (cur is not None and isinstance(node.value, ast.Name)
                 and node.value.id in cur.nested):
             cur.returned_defs.append(cur.nested[node.value.id])
+        if cur is not None and node.value is not None:
+            # jit-factory detection: `return jax.jit(...)` directly,
+            # or `return fn` where fn's latest prior binding is one
+            if is_jit_call(node.value) or (
+                    isinstance(node.value, ast.Name)
+                    and is_jit_call(cur.assigns.get(node.value.id))):
+                cur.returns_jit = True
         self.generic_visit(node)
 
     def generic_visit(self, node):

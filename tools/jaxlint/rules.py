@@ -12,7 +12,8 @@ import ast
 from typing import Iterable, List, Optional, Set
 
 from .analyzer import (Finding, FunctionInfo, ModuleInfo, Project,
-                       call_name, dotted_name, lookup_assign)
+                       call_name, dotted_name, is_jit_call,
+                       lookup_assign)
 
 # KV-pool parameter names: functions taking these hold the engine's
 # page pools, which MUST be donated through jit (JL002) or XLA copies
@@ -125,6 +126,23 @@ def _check_call(project: Project, mod: ModuleInfo, node: ast.Call,
                           f"host and device per iteration; sync once "
                           f"after the loop"))
 
+    # JL005 (async-readback discipline, ISSUE 4): a bare
+    # np.asarray(...) on a dispatch result. The engine funnels every
+    # device->host readback through ONE sanctioned fold site
+    # (engine._read_tokens, inline-suppressed there); a stray
+    # readback anywhere else re-serializes host and device exactly
+    # where the pipelined tick loop hides the wait.
+    if not traced and name in ("np.asarray", "numpy.asarray") \
+            and node.args and not _sanctioned_sync(mod, fn) \
+            and _is_dispatch_result(project, mod, fn, node.args[0]):
+        out.append(_f(
+            mod, "JL005", node, fn, f"{name}:dispatch-result",
+            f"`{name}(...)` directly on a jitted-dispatch result "
+            f"blocks the host on the device; route readbacks "
+            f"through the one sanctioned sync point (the engine's "
+            f"_read_tokens fold) so the async tick pipeline can "
+            f"hide them"))
+
     # JL006: per-iteration device uploads in host loops
     if not traced and loop_depth > 0 and name in UPLOAD_CALLEES:
         out.append(_f(mod, "JL006", node, fn, name,
@@ -134,8 +152,7 @@ def _check_call(project: Project, mod: ModuleInfo, node: ast.Call,
                       f"_samp_cache)"))
 
     # JL008 / JL002: jit call sites
-    if tail in JIT_NAMES and name.split(".")[0] in ("jax", "jit",
-                                                    "pjit"):
+    if is_jit_call(node):
         if loop_depth > 0:
             out.append(_f(mod, "JL008", node, fn, "jit-in-loop",
                           "`jax.jit` in a loop body builds a new "
@@ -154,6 +171,90 @@ def _sanctioned_sync(mod: ModuleInfo, fn: Optional[FunctionInfo]) -> bool:
     if fn is not None:
         hay += ":" + fn.qualname.lower()
     return any(s in hay for s in SANCTIONED_SYNC)
+
+
+def _factory_returns_jit(project: Project, mod: ModuleInfo,
+                         ctx: Optional[FunctionInfo],
+                         call_node: ast.Call) -> bool:
+    """Does this call yield a compiled dispatchable? True for
+    `jax.jit(f)` itself and for calls of memoized jit factories
+    (`self._ragged_fn(T, ctx)` whose def returns a jit binding)."""
+    if is_jit_call(call_node):
+        return True
+    fname = call_name(call_node)
+    tail = fname.split(".")[-1]
+    if not tail:
+        return False
+    return any(getattr(t, "returns_jit", False)
+               for t in project._resolve(
+                   mod, ctx, tail,
+                   is_self=fname.startswith(("self.", "cls."))))
+
+
+def _has_jit_decorator(fninfo: FunctionInfo) -> bool:
+    """Decorated directly with jit/pjit (incl. the
+    @functools.partial(jax.jit, ...) form) — calling such a def from
+    host code IS a dispatch. Deliberately narrower than .traced,
+    which also covers scan bodies and helpers merely REACHABLE from
+    traced code (calling those from host returns plain arrays)."""
+    node = fninfo.node
+    if isinstance(node, ast.Lambda):
+        return False
+    for dec in node.decorator_list:
+        if dotted_name(dec).split(".")[-1] in JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            tail = call_name(dec).split(".")[-1]
+            if tail in JIT_NAMES:
+                return True
+            if tail == "partial" and dec.args and \
+                    dotted_name(dec.args[0]).split(".")[-1] in JIT_NAMES:
+                return True
+    return False
+
+
+def _dispatch_call(project: Project, mod: ModuleInfo,
+                   ctx: Optional[FunctionInfo],
+                   node: Optional[ast.AST]) -> bool:
+    """Is `node` a Call executing a compiled program: a jax.jit
+    binding (local / module / `self.x` attr), a @jax.jit-decorated
+    def, a name bound to a jit-factory result
+    (`fn = self._ragged_fn(...); fn(...)`), or a direct factory
+    dispatch (`self._prefill_fn(b)(...)`)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name:
+        if _jitted_binding_statics(mod, ctx, name) is not None:
+            return True
+        # @jax.jit-decorated defs: only bare / self.-qualified names
+        # resolve (a dotted `other.step` tail-matched against an
+        # unrelated local def would false-positive)
+        if "." not in name or name.startswith(("self.", "cls.")):
+            if any(_has_jit_decorator(t) for t in project._resolve(
+                    mod, ctx, name.split(".")[-1],
+                    is_self=name.startswith(("self.", "cls.")))):
+                return True
+        val = lookup_assign(mod, ctx, name)
+        return (isinstance(val, ast.Call)
+                and _factory_returns_jit(project, mod, ctx, val))
+    if isinstance(node.func, ast.Call):
+        return _factory_returns_jit(project, mod, ctx, node.func)
+    return False
+
+
+def _is_dispatch_result(project: Project, mod: ModuleInfo,
+                        ctx: Optional[FunctionInfo],
+                        arg: ast.AST) -> bool:
+    """np.asarray's argument traced back to a dispatch: either the
+    call itself, or a name whose scope-aware binding (including
+    tuple-unpack targets) is one."""
+    if isinstance(arg, ast.Call):
+        return _dispatch_call(project, mod, ctx, arg)
+    if isinstance(arg, ast.Name):
+        return _dispatch_call(project, mod, ctx,
+                              lookup_assign(mod, ctx, arg.id))
+    return False
 
 
 # ---------------------------------------------------------- JL002 (jit)
@@ -319,10 +420,7 @@ def _jitted_binding_statics(mod: ModuleInfo,
     function's local `fn = jax.jit(...)` must not make every `fn(...)`
     in the module look jitted."""
     value = lookup_assign(mod, ctx, name)
-    if isinstance(value, ast.Call) \
-            and call_name(value).split(".")[-1] in JIT_NAMES \
-            and call_name(value).split(".")[0] in ("jax", "jit",
-                                                   "pjit"):
+    if is_jit_call(value):
         return _int_tuple(_kwarg(value, "static_argnums"))
     return None
 
