@@ -253,6 +253,109 @@ def bench_async_ab(on_tpu: bool, smoke: bool = False) -> dict:
     return res
 
 
+def bench_telemetry(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 5 gate, two halves. Correctness: after a bursty mixed
+    run, /metrics must render with TTFT observations == finished
+    requests and ITL observations == generated tokens minus first
+    tokens (every token the engine folded is accounted exactly once).
+    Overhead: the identical workload with enable_metrics=False is the
+    baseline — instrumentation is host-only Python on the fold path
+    (the dispatch-guard suite separately proves zero transfers /
+    compiles), so the instrumented run must not be slower beyond
+    timer noise. In --smoke mode both halves assert."""
+    import re
+    import uuid
+
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.models import llama
+
+    if on_tpu and not smoke:
+        cfg = _tpu_bench_model()
+        batch, plen, n_req, chunk, budget = 8, 256, 24, 64, 512
+        burst, every, gen0 = 6, 10, 48
+    else:
+        cfg = llama.config("debug")
+        batch, plen, n_req, chunk, budget = 4, 48, 10, 16, 64
+        burst, every, gen0 = 3, 6, 8
+    rng = np.random.default_rng(11)
+    lens = [plen + 16 * (i % 3) for i in range(n_req)]
+    gens = [gen0 + 8 * (i % 3) for i in range(n_req)]
+    prompts = [rng.integers(1, cfg.vocab_size, lens[i]).tolist()
+               for i in range(n_req)]
+
+    def run(enable_metrics):
+        tag = f"bench{uuid.uuid4().hex[:8]}"
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=batch, page_size=16,
+            num_pages=max(512, batch * 32), seed=5,
+            max_prefill_tokens=chunk, enable_prefix_caching=False,
+            max_num_batched_tokens=budget,
+            enable_metrics=enable_metrics, metrics_model_id=tag))
+
+        def drive():
+            eng._prefill_rr = 0
+            reqs = [Request(f"t{uuid.uuid4().hex[:6]}", list(p),
+                            SamplingParams(max_tokens=gens[i]))
+                    for i, p in enumerate(prompts)]
+            pending = list(reqs)
+            steps = 0
+            while eng.has_work() or pending:
+                if pending and steps % every == 0:
+                    for r in pending[:burst]:
+                        eng.add_request(r)
+                    pending = pending[burst:]
+                eng.step()
+                steps += 1
+            return reqs
+
+        drive()                          # warmup: compiles every bucket
+        t0 = time.perf_counter()
+        reqs = drive()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {"tokens_per_sec": round(toks / dt, 1)}, eng, tag
+
+    on_row, eng_on, tag = run(True)
+    off_row, _, _ = run(False)
+
+    def sample(text, name, **tags):
+        for line in text.splitlines():
+            m = re.match(r"^([a-zA-Z0-9_]+)(?:\{(.*)\})? (.+)$", line)
+            if m is None or m.group(1) != name:
+                continue
+            got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(2) or ""))
+            if got == {k: str(v) for k, v in tags.items()}:
+                return float(m.group(3))
+        return None
+
+    text = eng_on.prometheus_metrics()
+    s = eng_on.stats()["requests"]
+    finished = sum(s["finished"].values())
+    ttft = sample(text, "ray_tpu_llm_ttft_seconds_count", model=tag)
+    itl = sample(text, "ray_tpu_llm_itl_seconds_count", model=tag)
+    res = {
+        "metrics_on": on_row, "metrics_off": off_row,
+        "overhead_ratio": round(
+            on_row["tokens_per_sec"]
+            / max(off_row["tokens_per_sec"], 1e-9), 3),
+        "renders": bool(text) and ttft is not None,
+        "finished_requests": finished,
+        "generated_tokens": s["generated_tokens"],
+        "ttft_count": ttft, "itl_count": itl,
+        "ttft_count_ok": ttft == finished,
+        "itl_count_ok": itl == s["generated_tokens"] - finished,
+    }
+    if smoke:
+        assert res["renders"], "metrics exposition failed to render"
+        assert res["ttft_count_ok"], res
+        assert res["itl_count_ok"], res
+        # tripwire with slack for CI timer noise: host-only recording
+        # must never make decode materially slower
+        assert res["overhead_ratio"] >= 0.8, res
+    return res
+
+
 def bench_kernel_tick(on_tpu: bool) -> dict:
     """ISSUE 2 smoke gate: drive a small mixed workload through the
     unified engine with decode_impl=pallas_interpret (the Pallas
@@ -576,12 +679,14 @@ def main() -> None:
         mixed = bench_mixed(on_tpu, smoke=True)
         kernel = bench_kernel_tick(on_tpu)
         async_ab = bench_async_ab(on_tpu, smoke=True)
+        telemetry = bench_telemetry(on_tpu, smoke=True)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
             "unit": "tokens_per_sec",
             "detail": {**mixed, "kernel_tick": kernel,
-                       "async_readback_ab": async_ab},
+                       "async_readback_ab": async_ab,
+                       "telemetry": telemetry},
         }))
         return
     if "--long-ctx" in sys.argv:
@@ -598,6 +703,7 @@ def main() -> None:
     eng = bench_engine(on_tpu)
     mixed = bench_mixed(on_tpu)
     async_ab = bench_async_ab(on_tpu)
+    telemetry = bench_telemetry(on_tpu)
     scaling = bench_kernel_scaling(on_tpu)
     prefix = bench_prefix_cache(on_tpu)
     spec = bench_speculative(on_tpu)
@@ -610,6 +716,7 @@ def main() -> None:
         "detail": {"device": getattr(dev, "device_kind", str(dev)),
                    **eng, "mixed_prefill_decode": mixed,
                    "async_readback_ab": async_ab,
+                   "telemetry": telemetry,
                    "paged_kernel_scaling": scaling,
                    "prefix_cache": prefix, "speculative": spec,
                    "multi_step_decode": multi},

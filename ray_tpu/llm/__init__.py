@@ -4,6 +4,37 @@ Reference parity: python/ray/llm + serve.llm public API
 (python/ray/serve/llm/__init__.py — LLMConfig, build_openai_app), with
 the external vLLM engine replaced by the in-repo TPU engine
 (paged KV cache + continuous batching, _internal/engine.py).
+
+Observability (ISSUE 5; details: BENCH_CORE.md "Observability
+anatomy"): the router serves `GET /metrics` (Prometheus text),
+`GET /stats` (JSON incl. tick-pipeline + request SLO summaries),
+`GET /debug/trace` (Chrome-trace request lifecycles),
+`GET /debug/events` (engine flight recorder) and
+`POST /debug/profile` (jax.profiler capture of the next N ticks).
+All series carry a `model` tag. Metric catalogue:
+
+    name                                    type       notes
+    ray_tpu_llm_ttft_seconds                histogram  queued -> first host-visible token
+    ray_tpu_llm_itl_seconds                 histogram  gap between consecutive decode tokens
+    ray_tpu_llm_queue_wait_seconds          histogram  queued -> admitted
+    ray_tpu_llm_e2e_latency_seconds         histogram  queued -> finished
+    ray_tpu_llm_prompt_tokens_total         counter    admitted prompt tokens
+    ray_tpu_llm_generated_tokens_total      counter    emitted output tokens
+    ray_tpu_llm_finished_total              counter    + `reason` tag (stop|length|abort)
+    ray_tpu_llm_aborts_total                counter    client-gone aborts
+    ray_tpu_llm_drains_total                counter    tick-pipeline barriers
+    ray_tpu_llm_running_requests            gauge      slots occupied
+    ray_tpu_llm_waiting_requests            gauge      admission queue depth
+    ray_tpu_llm_kv_pages_used               gauge      referenced KV pages
+    ray_tpu_llm_kv_pages_free               gauge      allocatable (free + evictable)
+    ray_tpu_llm_kv_page_occupancy           gauge      used / usable
+    ray_tpu_llm_prefix_cache_hit_rate       gauge      hit tokens / queried tokens
+    ray_tpu_llm_token_budget_utilization    gauge      packed / budget, unified ticks
+
+Instrumentation is recorded purely from host-side engine events (zero
+device syncs, zero extra dispatches — the dispatch-guard suite runs
+with it enabled); disable per engine with
+`engine_kwargs={"enable_metrics": False}`.
 """
 
 from __future__ import annotations

@@ -45,6 +45,7 @@ from ...models import llama
 from ...models.llama import LlamaConfig
 from ...models.llama_infer import decode_step, prefill
 from .kv_cache import PageAllocator
+from .telemetry import EngineTelemetry
 
 
 @dataclasses.dataclass
@@ -140,6 +141,20 @@ class EngineConfig:
     # pp>1 and speculative engines (their dispatch chains manage
     # their own readbacks).
     async_readback: bool = True
+    # Request-lifecycle telemetry (ISSUE 5): SLO histograms (TTFT /
+    # inter-token latency / queue wait / e2e), token + finish-reason
+    # counters, KV-occupancy gauges, per-request Chrome-trace
+    # timelines and the engine flight recorder — recorded from
+    # host-side admission/fold events ONLY, so instrumentation adds
+    # zero device syncs and zero extra dispatches (the dispatch-guard
+    # suite runs with this on). The off switch exists for the bench
+    # overhead A/B (bench_llm --smoke), not because it costs device
+    # time.
+    enable_metrics: bool = True
+    # Prometheus "model" tag on this engine's metric samples (the
+    # server passes its model_id; engines sharing a tag share sample
+    # rows in the process-wide registry).
+    metrics_model_id: Optional[str] = None
     # Real-checkpoint path: directory holding an HF-layout safetensors
     # checkpoint (model.safetensors[.index.json] + config.json). Params
     # load through models/checkpoint_io.py — sharding-aware windowed
@@ -319,6 +334,15 @@ class InferenceEngine:
             ec.num_pages, ec.page_size,
             enable_prefix_caching=ec.enable_prefix_caching)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
+        # observability (ISSUE 5): SLO metrics + lifecycle timelines +
+        # flight recorder, recorded purely from host-side events —
+        # see telemetry.py for the zero-sync contract
+        self.telemetry = EngineTelemetry(
+            model=ec.metrics_model_id or "default",
+            enabled=bool(ec.enable_metrics))
+        # on-demand profiling: {"remaining", "dir", "cm"} while armed
+        # (POST /debug/profile → profile_next_ticks)
+        self._profile: Optional[Dict[str, Any]] = None
         if self.pp > 1:
             per = cfg.n_layers // self.pp
             kv_shape = (per, ec.num_pages, ec.page_size,
@@ -806,6 +830,14 @@ class InferenceEngine:
             b *= 2
         return b
 
+    def _tick_token_budget(self) -> int:
+        """The one tick-packing token budget — _pack_ragged spends it
+        and telemetry's budget-utilization gauge divides by it, so
+        both must read the SAME formula."""
+        ec = self.config
+        return ec.max_num_batched_tokens or (
+            ec.max_prefill_tokens + ec.max_batch_size)
+
     def _pack_ragged(self):
         """Sarathi-style token-budget packing for one unified tick:
         every decoding slot contributes 1 token, then prefilling slots
@@ -814,8 +846,7 @@ class InferenceEngine:
         can never starve admission-to-first-token). Returns
         [(slot, n_tokens, is_prefill)]."""
         ec = self.config
-        budget = ec.max_num_batched_tokens or (
-            ec.max_prefill_tokens + ec.max_batch_size)
+        budget = self._tick_token_budget()
         plan = []
         n_decode = 0
         for s in self.slots:
@@ -969,6 +1000,7 @@ class InferenceEngine:
         plan = self._pack_ragged()
         B = self.config.max_batch_size
         total = sum(n for _, n, _ in plan)
+        self.telemetry.on_tick_budget(total, self._tick_token_budget())
         T = self._token_bucket(total)
         # rows: tokens / slot_ids / positions / valid / lora_idx
         tok_meta = np.zeros((5, T), np.int32)
@@ -1014,6 +1046,8 @@ class InferenceEngine:
         for s, n, is_pref in plan:
             tok = int(toks_host[s.index])
             if is_pref:
+                self.telemetry.on_prefill_chunk(s.request, n,
+                                                s.prefill_pos)
                 s.prefill_pos += n
                 if s.prefill_pos >= len(s.request.prompt_tokens):
                     self._finish_prefill_host(s, tok, touched)
@@ -1240,6 +1274,7 @@ class InferenceEngine:
             [p.repetition_penalty], jnp.float32))
 
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
+            self.telemetry.on_prefill_chunk(req, n, 0)
             tokens, bucket = self._prep_full_prompt(req)
             fns = self._pp_prefill_fns(bucket)
             x = self.stages[0].put(jnp.asarray(tokens))
@@ -1261,6 +1296,7 @@ class InferenceEngine:
             return
 
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
+        self.telemetry.on_prefill_chunk(req, chunk, slot.prefill_pos)
         fns = self._pp_chunk_fns(bucket,
                                  self._ctx_bucket(slot.prefill_pos))
         start = [st.put(jnp.asarray([slot.prefill_pos], jnp.int32))
@@ -1752,6 +1788,8 @@ class InferenceEngine:
         self._lora_raw = new_raw
         self._lora_names = names
         self._lora_stacks = stacks
+        self.telemetry.recorder.record(
+            "lora_registration", adapters=sorted(new_raw))
         # indices may have shifted: refresh device slot state so
         # in-flight requests keep decoding with THEIR adapter
         self._refresh_device_state()
@@ -1774,6 +1812,7 @@ class InferenceEngine:
                 f"prompt+max_tokens needs "
                 f"{self.allocator.pages_needed(worst_case)} KV pages but "
                 f"the pool only has {self.allocator.num_usable}")
+        self.telemetry.on_queued(request)
         self.waiting.append(request)
 
     def has_work(self) -> bool:
@@ -1803,22 +1842,32 @@ class InferenceEngine:
         fold (every step still dispatches exactly once, so progress
         and termination are unchanged)."""
         with self._step_lock:
-            t0 = time.perf_counter()
-            # tokens folded by an out-of-step drain (abort/LoRA
-            # registration) ride the NEXT step's touched list
-            touched: List[Request] = self._pending_touched
-            self._pending_touched = []
-            self.ticks += 1
-            self._step_tick(touched)
-            wall = time.perf_counter() - t0
-            self._tick_times.append(
-                (wall * 1e3, self._tick_host_s * 1e3,
-                 self._tick_dev_s * 1e3))
-            # reset AFTER the append (not at entry) so readback/fold
-            # cost from out-of-step drains lands in the next tick's
-            # record instead of vanishing from the telemetry
-            self._tick_host_s = 0.0
-            self._tick_dev_s = 0.0
+            self._profile_tick_begin()
+            try:
+                t0 = time.perf_counter()
+                # tokens folded by an out-of-step drain (abort/LoRA
+                # registration) ride the NEXT step's touched list
+                touched: List[Request] = self._pending_touched
+                self._pending_touched = []
+                self.ticks += 1
+                self._step_tick(touched)
+                wall = time.perf_counter() - t0
+                self._tick_times.append(
+                    (wall * 1e3, self._tick_host_s * 1e3,
+                     self._tick_dev_s * 1e3))
+                # reset AFTER the append (not at entry) so readback/
+                # fold cost from out-of-step drains lands in the next
+                # tick's record instead of vanishing from the telemetry
+                self._tick_host_s = 0.0
+                self._tick_dev_s = 0.0
+            except BaseException:
+                # a mid-tick raise (fold reservation assert,
+                # GuardViolation, allocator OOM, ...) must not leave an
+                # armed jax.profiler capture running forever — stop the
+                # trace and disarm so /debug/profile can be re-armed
+                self._profile_abort()
+                raise
+            self._profile_tick_end()
             return touched
 
     def _admit_possible(self) -> bool:
@@ -1923,6 +1972,7 @@ class InferenceEngine:
             self._tables_version += 1
             self._mark_seen_dirty(slot.index)  # slot reuse: stale row
             self._samp_cache = None      # new request: stale params
+            self.telemetry.on_admitted(req, cached_tokens=matched)
 
     def _advance_prefill(self, touched: List[Request]) -> None:
         """Advance prefilling slots. While a decode batch is running,
@@ -1959,6 +2009,7 @@ class InferenceEngine:
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
             # whole prompt in one go: the dense full-causal program
             # (no pool gather — the common short-prompt fast path)
+            self.telemetry.on_prefill_chunk(req, n, 0)
             tokens, bucket = self._prep_full_prompt(req)
             lidx = self._dev(jnp.asarray(
                 [self._lora_names.get(req.lora, 0)], jnp.int32))
@@ -1974,6 +2025,7 @@ class InferenceEngine:
             return
 
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
+        self.telemetry.on_prefill_chunk(req, chunk, slot.prefill_pos)
         lidx = self._dev(jnp.asarray(
             [self._lora_names.get(req.lora, 0)], jnp.int32))
         self.dispatches += 1
@@ -2031,7 +2083,10 @@ class InferenceEngine:
             # next step's touched list.
             self._inflight = None
             self._drains += 1
+            self.telemetry.on_drain("device_state_rebuild")
             self._fold_inflight(rec, self._pending_touched)
+        self.telemetry.recorder.record(
+            "device_state_rebuild", active=self.num_active())
         B = self.config.max_batch_size
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
@@ -2133,6 +2188,7 @@ class InferenceEngine:
             return
         self._inflight = None
         self._drains += 1
+        self.telemetry.on_drain("structural")
         if self._fold_inflight(rec, touched):
             self._refresh_device_state()
 
@@ -2221,6 +2277,7 @@ class InferenceEngine:
             # and rebuild device state for the survivors
             rec, self._inflight = self._inflight, None
             self._drains += 1
+            self.telemetry.on_drain("retirement")
             self._fold_inflight(rec, touched, lagged=False)
             self._refresh_device_state()
 
@@ -2297,6 +2354,7 @@ class InferenceEngine:
                       touched: List[Request]) -> None:
         req = slot.request
         req.output_tokens.append(tok)
+        self.telemetry.on_token(req)
         touched.append(req)
         p = req.params
         if tok in p.stop_token_ids:
@@ -2307,6 +2365,7 @@ class InferenceEngine:
     def _finish(self, slot: _Slot, reason: str) -> None:
         slot.request.finished = True
         slot.request.finish_reason = reason
+        self.telemetry.on_finished(slot.request, reason)
         self.allocator.free(slot.pages)
         slot.request = None
         slot.pages = []
@@ -2332,14 +2391,113 @@ class InferenceEngine:
                     del self.waiting[i]
                     req.finished = True
                     req.finish_reason = "abort"
+                    self.telemetry.recorder.record(
+                        "abort", request_id=request_id,
+                        where="waiting")
+                    self.telemetry.on_finished(req, "abort")
                     return True
             for slot in self.slots:
                 if slot.request is not None \
                         and slot.request.request_id == request_id:
+                    self.telemetry.recorder.record(
+                        "abort", request_id=request_id,
+                        where="running")
                     self._finish(slot, "abort")
                     self._refresh_device_state()
                     return True
             return False
+
+    # -- observability (ISSUE 5) -------------------------------------------
+    def profile_next_ticks(self, ticks: int = 8,
+                           log_dir: Optional[str] = None) -> str:
+        """Arm on-demand profiling (POST /debug/profile): the next
+        `ticks` engine ticks run under util/profiling.trace
+        (jax.profiler — XLA timeline + HLO ops for TensorBoard /
+        xprof). Returns the log dir; the profiler starts at the NEXT
+        step() and stops after `ticks` ticks. Re-arming while a
+        capture is pending raises (one capture at a time)."""
+        if int(ticks) < 1:
+            raise ValueError("ticks must be >= 1")
+        with self._step_lock:
+            if self._profile is not None:
+                raise RuntimeError(
+                    "a profile capture is already armed/active "
+                    f"({self._profile['remaining']} tick(s) left, "
+                    f"dir {self._profile['dir']})")
+            if log_dir is None:
+                import tempfile
+                log_dir = tempfile.mkdtemp(prefix="ray_tpu_llm_prof_")
+            self._profile = {"remaining": int(ticks), "dir": log_dir,
+                             "cm": None}
+        self.telemetry.recorder.record(
+            "profile_armed", ticks=int(ticks), log_dir=log_dir)
+        return log_dir
+
+    def _profile_tick_begin(self) -> None:
+        """Start the armed jax.profiler trace (called under the step
+        lock at tick entry; no-op unless freshly armed)."""
+        ps = self._profile
+        if ps is None or ps["cm"] is not None:
+            return
+        from ...util import profiling
+        cm = profiling.trace(ps["dir"])
+        try:
+            cm.__enter__()
+        except Exception as e:   # profiler unavailable on this backend
+            self._profile = None
+            self.telemetry.recorder.record("profile_error",
+                                           error=repr(e))
+            return
+        ps["cm"] = cm
+
+    def _profile_tick_end(self) -> None:
+        ps = self._profile
+        if ps is None or ps["cm"] is None:
+            return
+        ps["remaining"] -= 1
+        if ps["remaining"] > 0:
+            return
+        self._profile = None
+        try:
+            ps["cm"].__exit__(None, None, None)
+        except Exception as e:
+            self.telemetry.recorder.record("profile_error",
+                                           error=repr(e))
+            return
+        self.telemetry.recorder.record("profile_done",
+                                       log_dir=ps["dir"])
+
+    def _profile_abort(self) -> None:
+        """Stop an in-flight capture after a mid-tick exception: flush
+        whatever was recorded so far and disarm, so the next
+        profile_next_ticks() isn't wedged behind a phantom capture."""
+        ps = self._profile
+        self._profile = None
+        if ps is None or ps["cm"] is None:
+            return
+        try:
+            ps["cm"].__exit__(None, None, None)
+        except Exception as e:
+            self.telemetry.recorder.record("profile_error",
+                                           error=repr(e))
+            return
+        self.telemetry.recorder.record("profile_aborted",
+                                       log_dir=ps["dir"])
+
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition of this process's registry with
+        this engine's gauges refreshed — gauge reads happen at SCRAPE
+        time only, so steady-state ticks pay nothing for them."""
+        from ...util import metrics as metrics_api
+        self.telemetry.update_gauges(self)
+        return metrics_api.export_prometheus()
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Per-request lifecycle timelines (queued → admitted →
+        prefill chunks → first token → decode → finished{reason}) as
+        Chrome-trace JSON, merged with the process tracing ring
+        (GET /debug/trace)."""
+        return self.telemetry.chrome_trace()
 
     # -- introspection ------------------------------------------------------
     def _tick_times_summary(self) -> Dict[str, Any]:
@@ -2386,6 +2544,12 @@ class InferenceEngine:
             # tick-pipeline telemetry (ISSUE 4): wall vs host-fold vs
             # blocked-readback per tick + lag/drain counters
             "tick_times": self._tick_times_summary(),
+            # request-lifecycle SLO telemetry (ISSUE 5): per-engine
+            # TTFT/ITL/queue-wait/e2e aggregates, finish-reason
+            # counts, token totals, budget utilization and the
+            # flight-recorder fill level (full series live on the
+            # Prometheus side: GET /metrics)
+            "requests": self.telemetry.summary(),
             # jit-cache observability: live bucketed programs per
             # cache + cumulative builds — a steady-state run must hold
             # `compiled_programs` flat (bucket churn = recompile storm)

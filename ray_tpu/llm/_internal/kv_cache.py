@@ -161,10 +161,30 @@ class PageAllocator:
     def cached_pages(self) -> int:
         return len(self._cache)
 
+    @property
+    def used_pages(self) -> int:
+        """Pages NOT allocatable right now — referenced by live
+        sequences or pinned by multiply-owned cache entries (the
+        complement of free_pages, which counts evictable cached pages
+        as free)."""
+        return self.num_usable - self.free_pages
+
+    @property
+    def cache_hit_rate(self) -> float:
+        """Cumulative prefix-cache hit rate: matched prompt tokens /
+        queried prompt tokens over every ADMITTED request (the
+        occupancy signal paged-attention serving is judged on)."""
+        return (self.cache_hit_tokens / self.cache_query_tokens
+                if self.cache_query_tokens else 0.0)
+
     def stats(self) -> Dict[str, float]:
         return {
             "free_pages": self.free_pages,
+            "used_pages": self.used_pages,
+            "occupancy": (self.used_pages / self.num_usable
+                          if self.num_usable else 0.0),
             "cached_pages": self.cached_pages,
             "cache_hit_tokens": self.cache_hit_tokens,
             "cache_query_tokens": self.cache_query_tokens,
+            "cache_hit_rate": self.cache_hit_rate,
         }

@@ -28,6 +28,9 @@ class LLMServerImpl:
         self._config = dict(llm_config)
         engine_kwargs = dict(self._config.get("engine_kwargs") or {})
         self.model_id = self._config.get("model_id", "default")
+        # Prometheus samples tag per model (ISSUE 5) unless the
+        # engine_kwargs pin an explicit tag
+        engine_kwargs.setdefault("metrics_model_id", self.model_id)
         self.engine = InferenceEngine(EngineConfig(
             model=self._config.get("model_source", "debug"),
             **engine_kwargs))
@@ -277,6 +280,39 @@ class LLMServerImpl:
             None, self.engine.register_lora, name, adapters)
         return sorted(self.engine._lora_raw)
 
+    # -- observability (ISSUE 5) -------------------------------------------
+    async def metrics_text(self) -> str:
+        """This replica's Prometheus text exposition (SLO histograms,
+        token/finish counters, KV gauges — refreshed at scrape time).
+        Off the event loop: the gauge refresh reads engine state and
+        the exposition renders the whole registry."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.prometheus_metrics)
+
+    async def debug_trace(self) -> Dict[str, Any]:
+        """Chrome-trace JSON of per-request lifecycle timelines."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.chrome_trace)
+
+    async def debug_events(self) -> List[Dict[str, Any]]:
+        """The engine flight recorder's ring, oldest first."""
+        return self.engine.telemetry.recorder.events()
+
+    async def start_profile(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Arm jax.profiler capture of the next N engine ticks
+        (POST /debug/profile). Serializes against step() via the
+        engine's step lock — run off the event loop."""
+        body = body or {}
+        # default only when the key is absent/null — an explicit
+        # {"ticks": 0} must reach the engine and be rejected there,
+        # not silently arm the 8-tick default
+        ticks = body.get("ticks")
+        ticks = 8 if ticks is None else int(ticks)
+        log_dir = body.get("log_dir")
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.profile_next_ticks, ticks, log_dir)
+        return {"model": self.model_id, "log_dir": out, "ticks": ticks}
+
     async def check_health(self) -> None:
         return None
 
@@ -308,37 +344,109 @@ class LLMRouterImpl:
             return None
         return next(iter(self._servers.values()))
 
+    def _unique_servers(self) -> List[tuple]:
+        """(model_id, handle) per distinct server. Adapter names alias
+        their base model's handle; _resolve inserts each handle under
+        its model_id FIRST, so the first key seen per handle is the
+        model id."""
+        out: List[tuple] = []
+        for mid, h in self._servers.items():
+            if any(h is s for _, s in out):
+                continue
+            out.append((mid, h))
+        return out
+
+    async def _handle_get(self, norm: str) -> Any:
+        """Every GET endpoint, dispatched BEFORE any body parse — an
+        unknown GET path is a clean 404 instead of the confusing
+        'invalid JSON body' 400 the old fallthrough produced."""
+        from ...serve import Response
+
+        if norm == "/v1/models":
+            models = [{"id": mid, "object": "model", "owned_by": "ray_tpu"}
+                      for mid in self._servers]
+            return {"object": "list", "data": models}
+        if norm == "/stats":
+            # serving observability (ISSUE 4/5): per-model engine
+            # stats — tick_times (pipelined-tick overlap) plus the
+            # request-lifecycle SLO summary ("requests": TTFT/ITL/
+            # queue-wait aggregates, finish-reason counts).
+            stats: Dict[str, Any] = {}
+            for _, h in self._unique_servers():
+                info = await h.model_info.remote()
+                stats[info["id"]] = info["engine"]
+            return {"object": "stats", "models": stats}
+        if norm == "/metrics":
+            # Prometheus text exposition (ISSUE 5): every replica
+            # renders its own process registry (samples tagged per
+            # model), then the blocks MERGE — in-process replicas
+            # share one registry, so naive concatenation would repeat
+            # every series once per replica and Prometheus rejects
+            # the scrape; merging collapses duplicate samples and
+            # keeps one # HELP/# TYPE header per family.
+            from ...util.metrics import merge_expositions
+            texts = []
+            for _, h in self._unique_servers():
+                texts.append(await h.metrics_text.remote())
+            return Response(merge_expositions(texts), status=200,
+                            content_type="text/plain")
+        if norm == "/debug/trace":
+            # Chrome-trace JSON (chrome://tracing, Perfetto): one tid
+            # per request with queued/prefill/decode lifecycle spans
+            events: List[Any] = []
+            for _, h in self._unique_servers():
+                doc = await h.debug_trace.remote()
+                events.extend(doc.get("traceEvents") or [])
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+        if norm == "/debug/events":
+            # engine flight recorders (bounded structured-event rings)
+            out: Dict[str, Any] = {}
+            for mid, h in self._unique_servers():
+                out[mid] = await h.debug_events.remote()
+            return {"object": "events", "models": out}
+        return Response({"error": f"no route {norm}"}, status=404,
+                        content_type="application/json")
+
+    async def _handle_profile(self, body: Dict[str, Any]) -> Any:
+        """POST /debug/profile: arm a capture of the next N engine
+        ticks under jax.profiler ({"ticks": N, "model": optional
+        target, "log_dir": optional}). Responds per model with the
+        log dir (or the arming error, e.g. a capture already
+        pending)."""
+        from ...serve import Response
+
+        target = body.get("model")
+        out: Dict[str, Any] = {}
+        for mid, h in self._unique_servers():
+            if target and mid != target:
+                continue
+            try:
+                out[mid] = await h.start_profile.remote(body)
+            except Exception as e:
+                out[mid] = {"error": repr(e)}
+        if not out:
+            return Response(
+                {"error": f"model {target!r} not found"},
+                status=404, content_type="application/json")
+        return {"object": "profile", "models": out}
+
     async def __call__(self, request) -> Any:
         from ...serve import Response
 
         await self._resolve()
         path = getattr(request, "path", "/")
         method = getattr(request, "method", "POST")
-        if path.rstrip("/") == "/v1/models" and method == "GET":
-            models = [{"id": mid, "object": "model", "owned_by": "ray_tpu"}
-                      for mid in self._servers]
-            return {"object": "list", "data": models}
-        if path.rstrip("/") == "/stats" and method == "GET":
-            # serving observability (ISSUE 4): per-model engine stats,
-            # including tick_times — host_ms/device_ms/overlap_ratio
-            # of the pipelined tick loop plus lag/drain counters — so
-            # the readback overlap is visible in production, not just
-            # in benches. Adapter names alias their base model's
-            # server; dedupe so each engine reports once.
-            stats: Dict[str, Any] = {}
-            seen: List[Any] = []
-            for h in self._servers.values():
-                if any(h is s for s in seen):
-                    continue
-                seen.append(h)
-                info = await h.model_info.remote()
-                stats[info["id"]] = info["engine"]
-            return {"object": "stats", "models": stats}
+        norm = path.rstrip("/") or "/"
+        if method == "GET":
+            return await self._handle_get(norm)
         try:
             body = request.json()
         except Exception:
             return Response({"error": "invalid JSON body"}, status=400,
                             content_type="application/json")
+        if norm == "/debug/profile":
+            return await self._handle_profile(
+                body if isinstance(body, dict) else {})
         server = self._pick(body)
         if server is None:
             # a LoRA adapter may have been registered after the first

@@ -1,0 +1,415 @@
+"""Serving observability: SLO metrics, lifecycle traces, flight recorder.
+
+ISSUE 5: the engine's pipelined steady state (PRs 1-4) was a black box
+per request — nothing recorded when a request was queued, admitted, saw
+its first token, or why it finished. This module is the per-request
+observability layer, built on the existing primitives rather than a
+parallel system: Prometheus metrics are ray_tpu.util.metrics
+(process-shared registry → export_prometheus), trace events render
+through ray_tpu.util.tracing's Chrome-trace schema, and on-demand
+profiling rides util/profiling.trace (jax.profiler → TensorBoard).
+
+Hard constraint (enforced by tests/test_dispatch_guard.py running with
+instrumentation enabled): recording adds ZERO device syncs and ZERO
+extra dispatches. Every timestamp here comes from host-side events the
+engine already has — admission bookkeeping and the (possibly lagged)
+fold — so TTFT/ITL are HOST-VISIBLE latencies: with async_readback a
+token's timestamp is when its fold landed, one tick after dispatch,
+which is exactly when a streaming client could first see it.
+
+Three pieces:
+- EngineTelemetry — per-request lifecycle timelines (queued → admitted
+  → prefill chunk(s) → first token → decode → finished{stop|length|
+  abort}) feeding the SLO histograms (TTFT, inter-token latency,
+  queue wait, e2e), token/finish counters, and scrape-time gauges
+  (running/waiting, KV page occupancy, prefix-cache hit rate,
+  token-budget utilization). Metric name catalogue: BENCH_CORE.md
+  "Observability anatomy".
+- chrome_trace() — the timelines as Chrome-trace "traceEvents" JSON
+  (one tid per request), merged with the process tracing ring; served
+  at GET /debug/trace.
+- FlightRecorder — a fixed-size ring of structured engine events
+  (admission, retirement, drain, lora_registration, abort,
+  device_state_rebuild, guard_violation, profile_*); GET /debug/events.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ...util import metrics as metrics_api
+from ...util import tracing
+
+# SLO histogram boundaries (seconds). Decode-token gaps sit well under
+# a second on real hardware; TTFT/e2e stretch into tens of seconds
+# under queueing — one shared layout keeps the exposition compact and
+# lets dashboards overlay the three latency families.
+LATENCY_BOUNDARIES = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+
+_FLIGHT_RING = 1024          # flight-recorder capacity (events)
+_TRACE_RING = 512            # finished-request timelines retained
+_MAX_CHUNK_MARKS = 128       # prefill-chunk marks kept per request
+
+
+def _build_metrics() -> Dict[str, Any]:
+    """The shared metric family set, constructed idempotently (the
+    registry returns the existing instance on re-registration, so
+    every engine in a process holds the SAME objects and samples
+    split per engine by the `model` tag)."""
+    H, C, G = (metrics_api.Histogram, metrics_api.Counter,
+               metrics_api.Gauge)
+    lat = dict(boundaries=LATENCY_BOUNDARIES, tag_keys=("model",))
+    return {
+        "ttft": H("ray_tpu_llm_ttft_seconds",
+                  "queued -> first host-visible token", **lat),
+        "itl": H("ray_tpu_llm_itl_seconds",
+                 "host-visible gap between consecutive decode tokens",
+                 **lat),
+        "queue_wait": H("ray_tpu_llm_queue_wait_seconds",
+                        "queued -> admitted to a decode slot", **lat),
+        "e2e": H("ray_tpu_llm_e2e_latency_seconds",
+                 "queued -> finished", **lat),
+        "prompt_tokens": C("ray_tpu_llm_prompt_tokens_total",
+                           "prompt tokens admitted", ("model",)),
+        "generated_tokens": C("ray_tpu_llm_generated_tokens_total",
+                              "tokens emitted to requests", ("model",)),
+        "finished": C("ray_tpu_llm_finished_total",
+                      "finished requests by reason",
+                      ("model", "reason")),
+        "aborts": C("ray_tpu_llm_aborts_total",
+                    "requests aborted (client gone)", ("model",)),
+        "drains": C("ray_tpu_llm_drains_total",
+                    "tick-pipeline structural-event barriers",
+                    ("model",)),
+        "running": G("ray_tpu_llm_running_requests",
+                     "requests holding a decode slot", ("model",)),
+        "waiting": G("ray_tpu_llm_waiting_requests",
+                     "requests queued for admission", ("model",)),
+        "kv_used": G("ray_tpu_llm_kv_pages_used",
+                     "KV pages referenced by live sequences",
+                     ("model",)),
+        "kv_free": G("ray_tpu_llm_kv_pages_free",
+                     "KV pages allocatable now (free + evictable "
+                     "cache)", ("model",)),
+        "kv_occupancy": G("ray_tpu_llm_kv_page_occupancy",
+                          "referenced fraction of the usable KV pool",
+                          ("model",)),
+        "prefix_hit_rate": G("ray_tpu_llm_prefix_cache_hit_rate",
+                             "prefix-cache hit tokens / queried "
+                             "tokens, cumulative", ("model",)),
+        "budget_util": G("ray_tpu_llm_token_budget_utilization",
+                         "packed tokens / token budget, recent "
+                         "unified ticks", ("model",)),
+    }
+
+
+class FlightRecorder:
+    """Bounded ring of structured engine events. Recording is a dict
+    append under a lock — safe from the pump's executor thread and
+    the server event loop alike, and cheap enough for per-structural-
+    event use (it never runs per token)."""
+
+    def __init__(self, capacity: int = _FLIGHT_RING,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.dropped = 0            # events displaced by the ring cap
+        self._ring: "collections.deque" = collections.deque(
+            maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._seq += 1
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(
+                {"seq": self._seq, "ts": time.time(), "event": kind,
+                 **fields})
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"events": len(self._ring), "total": self._seq,
+                    "dropped": self.dropped}
+
+
+class _Timeline:
+    """Host-side lifecycle record for ONE request (epoch seconds)."""
+
+    __slots__ = ("rid", "tid", "queued", "admitted", "first_token",
+                 "last_token", "finished", "reason", "prompt_len",
+                 "cached_tokens", "n_tokens", "chunks", "lora")
+
+    def __init__(self, rid: str, tid: int, queued: float,
+                 prompt_len: int, lora: Optional[str]):
+        self.rid = rid
+        self.tid = tid
+        self.queued = queued
+        self.admitted: Optional[float] = None
+        self.first_token: Optional[float] = None
+        self.last_token: Optional[float] = None
+        self.finished: Optional[float] = None
+        self.reason: Optional[str] = None
+        self.prompt_len = prompt_len
+        self.cached_tokens = 0
+        self.n_tokens = 0
+        self.chunks: List[tuple] = []     # (ts, n_tokens, start_pos)
+        self.lora = lora
+
+
+class EngineTelemetry:
+    """One engine's recording surface. All entry points are host-only
+    Python (no jax imports, no device arrays): calling them can never
+    add an upload, a sync, or a compile to the tick."""
+
+    def __init__(self, model: str = "default", enabled: bool = True):
+        self.enabled = enabled
+        self.model = model
+        self.recorder = FlightRecorder(enabled=enabled)
+        self._lock = threading.Lock()
+        self._live: Dict[str, _Timeline] = {}
+        self._done: "collections.deque" = collections.deque(
+            maxlen=_TRACE_RING)
+        self._tid = itertools.count(1)
+        self._budget_used = 0
+        self._budget_total = 0
+        self._budget_last = 0.0
+        # per-engine aggregates (the Prometheus samples are shared
+        # per-process and split by tag; these stay exact per engine
+        # for stats() regardless of tag collisions)
+        self._finished: Dict[str, int] = {}
+        self._aborted = 0
+        self._prompt_tokens = 0
+        self._generated_tokens = 0
+        self._sums = {"ttft": 0.0, "itl": 0.0, "queue": 0.0,
+                      "e2e": 0.0}
+        self._counts = {"ttft": 0, "itl": 0, "queue": 0, "e2e": 0}
+        if enabled:
+            self._m = _build_metrics()
+            self._tags = {"model": model}
+        else:
+            self._m = None
+            self._tags = {}
+
+    # -- lifecycle entry points (called by the engine, host side) ------
+    def on_queued(self, req) -> None:
+        if not self.enabled:
+            return
+        t = _Timeline(req.request_id, next(self._tid),
+                      getattr(req, "submitted_at", time.time()),
+                      len(req.prompt_tokens), req.lora)
+        with self._lock:
+            self._live[req.request_id] = t
+
+    def on_admitted(self, req, cached_tokens: int = 0) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        with self._lock:
+            t = self._live.get(req.request_id)
+            if t is None:
+                return
+            t.admitted = now
+            t.cached_tokens = cached_tokens
+            wait = max(now - t.queued, 0.0)
+            self._sums["queue"] += wait
+            self._counts["queue"] += 1
+            self._prompt_tokens += t.prompt_len
+        self._m["queue_wait"].observe(wait, self._tags)
+        self._m["prompt_tokens"].inc(t.prompt_len, self._tags)
+        self.recorder.record("admission", request_id=req.request_id,
+                             prompt_tokens=t.prompt_len,
+                             cached_tokens=cached_tokens,
+                             lora=req.lora)
+
+    def on_prefill_chunk(self, req, n_tokens: int,
+                         start_pos: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            t = self._live.get(req.request_id)
+            if t is not None and len(t.chunks) < _MAX_CHUNK_MARKS:
+                t.chunks.append((time.time(), n_tokens, start_pos))
+
+    def on_token(self, req) -> None:
+        """One host-visible output token (runs per token per fold —
+        the hottest entry point; keep it a few dict ops)."""
+        if not self.enabled:
+            return
+        now = time.time()
+        first = gap = None
+        with self._lock:
+            t = self._live.get(req.request_id)
+            if t is None:
+                return
+            t.n_tokens += 1
+            if t.first_token is None:
+                t.first_token = now
+                first = max(now - t.queued, 0.0)
+                self._sums["ttft"] += first
+                self._counts["ttft"] += 1
+            else:
+                gap = max(now - t.last_token, 0.0)
+                self._sums["itl"] += gap
+                self._counts["itl"] += 1
+            t.last_token = now
+            self._generated_tokens += 1
+        if first is not None:
+            self._m["ttft"].observe(first, self._tags)
+        if gap is not None:
+            self._m["itl"].observe(gap, self._tags)
+        self._m["generated_tokens"].inc(1, self._tags)
+
+    def on_finished(self, req, reason: str) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        with self._lock:
+            t = self._live.pop(req.request_id, None)
+            if t is not None:
+                t.finished = now
+                t.reason = reason
+                self._done.append(t)
+            self._finished[reason] = self._finished.get(reason, 0) + 1
+            if reason == "abort":
+                self._aborted += 1
+            e2e = max(now - (t.queued if t else now), 0.0)
+            self._sums["e2e"] += e2e
+            self._counts["e2e"] += 1
+        self._m["finished"].inc(1, {**self._tags, "reason": reason})
+        self._m["e2e"].observe(e2e, self._tags)
+        if reason == "abort":
+            self._m["aborts"].inc(1, self._tags)
+        self.recorder.record(
+            "retirement", request_id=req.request_id, reason=reason,
+            generated_tokens=len(req.output_tokens))
+
+    def on_drain(self, cause: str) -> None:
+        if not self.enabled:
+            return
+        self._m["drains"].inc(1, self._tags)
+        self.recorder.record("drain", cause=cause)
+
+    def on_tick_budget(self, used: int, budget: int) -> None:
+        """Token-budget utilization of one unified ragged tick
+        (plain-int accumulators; the gauge is set at scrape time)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._budget_used += used
+            self._budget_total += budget
+            self._budget_last = used / budget if budget else 0.0
+
+    # -- scrape-time surfaces ------------------------------------------
+    def update_gauges(self, engine) -> None:
+        """Refresh this engine's gauges from live state — called at
+        scrape (GET /metrics, /stats), never per tick."""
+        if not self.enabled:
+            return
+        alloc = engine.allocator
+        used = alloc.used_pages
+        self._m["running"].set(engine.num_active(), self._tags)
+        self._m["waiting"].set(len(engine.waiting), self._tags)
+        self._m["kv_used"].set(used, self._tags)
+        self._m["kv_free"].set(alloc.free_pages, self._tags)
+        self._m["kv_occupancy"].set(
+            used / alloc.num_usable if alloc.num_usable else 0.0,
+            self._tags)
+        self._m["prefix_hit_rate"].set(alloc.cache_hit_rate,
+                                       self._tags)
+        with self._lock:
+            util = (self._budget_used / self._budget_total
+                    if self._budget_total else 0.0)
+        self._m["budget_util"].set(util, self._tags)
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-engine SLO aggregates for stats() (exact for THIS
+        engine even when several engines share Prometheus tags)."""
+        if not self.enabled:
+            return {"enabled": False}
+
+        def avg_ms(k):
+            n = self._counts[k]
+            return round(self._sums[k] / n * 1e3, 3) if n else 0.0
+
+        with self._lock:
+            return {
+                "enabled": True,
+                "live": len(self._live),
+                "finished": dict(self._finished),
+                "aborted": self._aborted,
+                "prompt_tokens": self._prompt_tokens,
+                "generated_tokens": self._generated_tokens,
+                "ttft_ms_avg": avg_ms("ttft"),
+                "itl_ms_avg": avg_ms("itl"),
+                "queue_wait_ms_avg": avg_ms("queue"),
+                "e2e_ms_avg": avg_ms("e2e"),
+                "budget_utilization": round(
+                    self._budget_used / self._budget_total, 3)
+                    if self._budget_total else 0.0,
+                "flight_recorder": self.recorder.stats(),
+            }
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Request timelines as Chrome-trace JSON (one tid per
+        request, spans via tracing.complete_event so the fields match
+        live tracing spans), merged with this process's tracing ring
+        (populated when RAY_TPU_TRACE / tracing.enable() is on)."""
+        events: List[Dict[str, Any]] = []
+        pid = os.getpid()
+        now = time.time()
+        with self._lock:
+            timelines = list(self._done) + list(self._live.values())
+        for t in timelines:
+            rid = t.rid
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": t.tid,
+                           "args": {"name": f"request {rid}"}})
+            end_q = t.admitted or t.finished or now
+            events.append(tracing.complete_event(
+                "queued", "request", t.queued, end_q - t.queued,
+                pid=pid, tid=t.tid, args={"request_id": rid}))
+            if t.admitted is not None:
+                end_p = t.first_token or t.finished or now
+                events.append(tracing.complete_event(
+                    "prefill", "request", t.admitted,
+                    end_p - t.admitted, pid=pid, tid=t.tid,
+                    args={"request_id": rid,
+                          "prompt_tokens": t.prompt_len,
+                          "cached_tokens": t.cached_tokens,
+                          **({"lora": t.lora} if t.lora else {})}))
+            for ts, n, pos in t.chunks:
+                events.append(tracing.instant_event(
+                    "prefill_chunk", "request", ts, pid=pid,
+                    tid=t.tid, args={"tokens": n, "start_pos": pos}))
+            if t.first_token is not None:
+                events.append(tracing.instant_event(
+                    "first_token", "request", t.first_token, pid=pid,
+                    tid=t.tid, args={"request_id": rid}))
+                end_d = t.finished or now
+                events.append(tracing.complete_event(
+                    "decode", "request", t.first_token,
+                    end_d - t.first_token, pid=pid, tid=t.tid,
+                    args={"request_id": rid,
+                          "generated_tokens": t.n_tokens}))
+            if t.finished is not None:
+                events.append(tracing.instant_event(
+                    f"finished:{t.reason}", "request", t.finished,
+                    pid=pid, tid=t.tid, args={"request_id": rid}))
+        events.extend(tracing.get_events())
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+__all__ = ["EngineTelemetry", "FlightRecorder", "LATENCY_BOUNDARIES"]

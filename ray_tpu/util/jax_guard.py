@@ -90,7 +90,8 @@ class _CompileSentinel(logging.Handler):
 @contextlib.contextmanager
 def dispatch_guard(max_compiles: int = 0,
                    h2d: str = "disallow_explicit",
-                   d2h: str = "allow", raise_on_violation: bool = True):
+                   d2h: str = "allow", raise_on_violation: bool = True,
+                   recorder=None):
     """Arm transfer guards + the compile sentinel around a hot-path
     section.
 
@@ -104,6 +105,13 @@ def dispatch_guard(max_compiles: int = 0,
         (observability mode for benches) — "disallow" transfer
         levels are downgraded to their "log" forms so a stray
         transfer cannot crash the observed run either.
+    recorder: optional flight recorder — any object with a
+        `.record(kind, **fields)` method, e.g. the LLM engine's
+        `telemetry.recorder` — given one, a compile-budget violation
+        lands as a structured "guard_violation" event (ISSUE 5: the
+        post-mortem dump at GET /debug/events shows it even when a
+        retry layer swallows the raise, and report-only mode records
+        without raising at all).
     """
     if not raise_on_violation:
         downgrade = {"disallow": "log",
@@ -138,10 +146,21 @@ def dispatch_guard(max_compiles: int = 0,
             lg.setLevel(level)
         logging.disable(prev_disable)
         jax.config.update("jax_log_compiles", prev_log_compiles)
-    if raise_on_violation and report.n_compiles > max_compiles:
-        shown = "\n  ".join(report.compiles[:8])
-        raise GuardViolation(
-            f"{report.n_compiles} XLA compilation(s) inside a "
-            f"dispatch_guard block (budget {max_compiles}) — shape "
-            f"bucket churn or an untracked retrace on the hot path:"
-            f"\n  {shown}")
+    if report.n_compiles > max_compiles:
+        if recorder is not None:
+            try:
+                recorder.record(
+                    "guard_violation", cause="compile",
+                    n_compiles=report.n_compiles,
+                    budget=max_compiles,
+                    first=report.compiles[0] if report.compiles
+                    else "")
+            except Exception:
+                pass         # observability must never mask the raise
+        if raise_on_violation:
+            shown = "\n  ".join(report.compiles[:8])
+            raise GuardViolation(
+                f"{report.n_compiles} XLA compilation(s) inside a "
+                f"dispatch_guard block (budget {max_compiles}) — shape "
+                f"bucket churn or an untracked retrace on the hot path:"
+                f"\n  {shown}")

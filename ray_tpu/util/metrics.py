@@ -25,8 +25,38 @@ DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
 class Metric:
     metric_type = "untyped"
 
-    def __init__(self, name: str, description: str = "",
-                 tag_keys: Optional[Sequence[str]] = None):
+    def __new__(cls, name: str, *args, **kwargs):
+        # Re-registration returns the EXISTING instance (same type +
+        # tag keys) instead of silently clobbering the registry entry —
+        # the old behavior orphaned every prior handle: their writes
+        # kept landing on the shadowed object and vanished from the
+        # exposition. Shared construction is the normal pattern (every
+        # engine in a process builds "its" TTFT histogram); a
+        # type-mismatched reuse of a name is a programming error and
+        # raises. Lookup, field init, and registry insert all happen
+        # inside ONE critical section: two threads constructing the
+        # same name concurrently can never both create (check-then-act
+        # clobber), and a merge-path winner can never observe a
+        # half-initialized instance. __init__ then runs the pure
+        # compat/merge check on whichever instance came back.
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}; cannot "
+                        f"re-register as {cls.__name__}")
+                return existing
+            inst = super().__new__(cls)
+            inst._init_fields(name, *args, **kwargs)
+            _registry[name] = inst
+            return inst
+
+    def _init_fields(self, name: str, description: str = "",
+                     tag_keys: Optional[Sequence[str]] = None) -> None:
+        """First-construction initialization — runs under the registry
+        lock in __new__, BEFORE the instance becomes visible."""
         if not name or not name.replace("_", "a").isalnum():
             raise ValueError(f"invalid metric name {name!r}")
         self._name = name
@@ -35,8 +65,19 @@ class Metric:
         self._default_tags: Dict[str, str] = {}
         self._values: Dict[Tuple[str, ...], float] = {}
         self._lock = threading.Lock()
-        with _registry_lock:
-            _registry[name] = self
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        # always the merge/compat path (field init happened in
+        # __new__): tag keys must agree (samples are keyed by them) —
+        # trivially true for the creating caller — and description
+        # backfills if the first registration left it empty
+        if tuple(tag_keys or ()) != self._tag_keys:
+            raise ValueError(
+                f"metric {name!r} already registered with tag_keys="
+                f"{self._tag_keys}; got {tuple(tag_keys or ())}")
+        if description and not self._description:
+            self._description = description
 
     # -- tags ---------------------------------------------------------------
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
@@ -88,14 +129,27 @@ class Gauge(Metric):
 class Histogram(Metric):
     metric_type = "histogram"
 
-    def __init__(self, name: str, description: str = "",
-                 boundaries: Optional[Sequence[float]] = None,
-                 tag_keys: Optional[Sequence[str]] = None):
-        super().__init__(name, description, tag_keys)
+    def _init_fields(self, name: str, description: str = "",
+                     boundaries: Optional[Sequence[float]] = None,
+                     tag_keys: Optional[Sequence[str]] = None) -> None:
+        super()._init_fields(name, description, tag_keys)
         self.boundaries = sorted(boundaries or DEFAULT_BOUNDARIES)
         self._buckets: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._counts: Dict[Tuple[str, ...], int] = {}
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        # merge/compat path (see Metric.__init__): bucket layouts must
+        # agree or the shared bucket counts would be meaningless —
+        # trivially true for the creating caller
+        bounds = sorted(boundaries or DEFAULT_BOUNDARIES)
+        if bounds != self.boundaries:
+            raise ValueError(
+                f"histogram {name!r} already registered with "
+                f"boundaries {self.boundaries}; got {bounds}")
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
@@ -161,6 +215,49 @@ def export_prometheus() -> str:
     return "\n".join(lines) + "\n"
 
 
+def merge_expositions(texts: Sequence[str]) -> str:
+    """Merge several Prometheus text expositions into ONE valid
+    document. Naive concatenation is invalid twice over: in-process
+    replicas each render the same process-wide registry, so every
+    sample appears once per replica (Prometheus rejects duplicate
+    series as a parse error), and even across processes the family
+    headers repeat (all samples of a family must sit under a single
+    # TYPE). Families keep first-appearance order, # HELP/# TYPE come
+    from the first block declaring them, and duplicate series keep
+    the FIRST value seen — dedup keys on series identity (name +
+    label set), not line text, because a live counter can advance
+    between two sequential renders of the same registry."""
+    order: List[str] = []
+    headers: Dict[str, Dict[str, str]] = {}
+    samples: Dict[str, List[str]] = {}
+    seen: Dict[str, set] = {}
+    for text in texts:
+        fam = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                _, kind, fam = line.split(" ", 3)[:3]
+                if fam not in headers:
+                    headers[fam] = {}
+                    samples[fam] = []
+                    seen[fam] = set()
+                    order.append(fam)
+                headers[fam].setdefault(kind, line)
+            elif fam is not None:
+                series = line.rsplit(" ", 1)[0]
+                if series not in seen[fam]:
+                    seen[fam].add(series)
+                    samples[fam].append(line)
+    lines: List[str] = []
+    for fam in order:
+        for kind in ("HELP", "TYPE"):
+            if kind in headers[fam]:
+                lines.append(headers[fam][kind])
+        lines.extend(samples[fam])
+    return "\n".join(lines) + "\n"
+
+
 def snapshot() -> Dict[str, object]:
     """JSON-able snapshot of this process's registry."""
     out = {}
@@ -201,4 +298,5 @@ def collect_cluster() -> Dict[str, object]:
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "export_prometheus",
-           "snapshot", "flush_to_kv", "collect_cluster"]
+           "merge_expositions", "snapshot", "flush_to_kv",
+           "collect_cluster"]

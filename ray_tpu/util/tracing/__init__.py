@@ -129,6 +129,34 @@ def span(name: str, category: str = "task",
                 })
 
 
+def complete_event(name: str, category: str, start_s: float,
+                   dur_s: float, pid: Optional[int] = None,
+                   tid: int = 0,
+                   args: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """Build one Chrome-trace complete ("X") event dict from epoch
+    SECONDS — the same schema span() emits (ts/dur in microseconds),
+    for code that only knows a span's bounds after the fact (the LLM
+    engine's request-lifecycle timelines render through this so the
+    two event sources stay field-compatible in one viewer)."""
+    return {"name": name, "cat": category, "ph": "X",
+            "ts": start_s * 1e6, "dur": max(dur_s, 0.0) * 1e6,
+            "pid": os.getpid() if pid is None else pid,
+            "tid": tid, "args": dict(args or {})}
+
+
+def instant_event(name: str, category: str, ts_s: float,
+                  pid: Optional[int] = None, tid: int = 0,
+                  args: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Chrome-trace instant ("i") event at epoch seconds (thread
+    scope) — point-in-time marks like a prefill chunk landing."""
+    return {"name": name, "cat": category, "ph": "i", "s": "t",
+            "ts": ts_s * 1e6,
+            "pid": os.getpid() if pid is None else pid,
+            "tid": tid, "args": dict(args or {})}
+
+
 def get_events() -> List[Dict[str, Any]]:
     with _lock:
         return list(_events)
@@ -216,4 +244,5 @@ def export_chrome_trace(path: Optional[str] = None,
 
 __all__ = ["enable", "disable", "is_enabled", "span", "get_events",
            "clear", "export_chrome_trace", "inject_context",
-           "current_context", "flush_to_kv", "collect_cluster"]
+           "current_context", "flush_to_kv", "collect_cluster",
+           "complete_event", "instant_event"]

@@ -588,6 +588,72 @@ def test_jl008_memoized_builder_ok(tmp_path):
     assert fs == []
 
 
+# ------------------------------------------------------------------ JL009
+
+def test_jl009_instrumentation_under_trace(tmp_path):
+    """metrics/tracing/telemetry calls inside a traced function run at
+    trace time only (frozen into the program) — flagged; the same
+    calls in host code are the intended pattern — clean."""
+    fs = _lint(tmp_path, """
+        import jax
+        from ray_tpu.util import metrics, tracing
+
+        ttft = metrics.Histogram("x_seconds")
+
+        @jax.jit
+        def f(x, dt):
+            ttft.observe(dt)
+            with tracing.span("tick"):
+                pass
+            return x
+    """, select={"JL009"})
+    assert len(fs) == 2
+    assert {f.detail for f in fs} == {"ttft.observe", "tracing.span"}
+
+
+def test_jl009_self_telemetry_and_recorder_forms(tmp_path):
+    fs = _lint(tmp_path, """
+        import jax
+
+        class Engine:
+            def _build(self):
+                def run(tokens):
+                    self.telemetry.on_token(tokens)
+                    self.telemetry.recorder.record("tick")
+                    return tokens
+                return run
+
+            def go(self, x):
+                return jax.jit(self._build())(x)
+    """, select={"JL009"})
+    assert len(fs) == 2
+    assert all(f.func.endswith("run") for f in fs)
+
+
+def test_jl009_host_side_instrumentation_clean(tmp_path):
+    """The engine's actual pattern — recording from host-side fold /
+    admission code and bare `observe(...)` world-model calls under
+    trace (dreamer) — must stay clean."""
+    fs = _lint(tmp_path, """
+        import jax
+        from ray_tpu.util import metrics
+
+        itl = metrics.Histogram("itl_seconds")
+
+        def fold(engine, toks, dt):        # host side of the boundary
+            itl.observe(dt)
+            engine.telemetry.on_token(toks)
+
+        @jax.jit
+        def world_model(params, x):
+            return observe(params, x)      # bare fn, not a handle
+
+        def observe(params, x):
+            return params * x
+    """, select={"JL009"})
+    assert fs == []
+
+
 # ----------------------------------------------------------- suppressions
 
 def test_inline_disable_comment(tmp_path):

@@ -99,6 +99,69 @@ def test_metrics_counter_gauge_histogram_prometheus():
         c.inc(-1, {"route": "/a"})
 
 
+def test_metrics_reregistration_merges_not_clobbers():
+    """Regression (ISSUE 5 satellite): constructing a second Metric
+    with an existing name used to silently replace the registry entry,
+    orphaning every prior handle — its writes kept landing on the
+    shadowed object and vanished from the exposition. Now the SAME
+    instance comes back when type+tags match (both handles' writes
+    export), and a mismatched re-registration raises."""
+    a = metrics.Counter("rereg_total", "first", ("route",))
+    a.inc(2, {"route": "/x"})
+    b = metrics.Counter("rereg_total", "second", ("route",))
+    assert b is a                         # merged, not clobbered
+    b.inc(3, {"route": "/x"})
+    text = metrics.export_prometheus()
+    assert 'rereg_total{route="/x"} 5.0' in text
+
+    with pytest.raises(ValueError):       # type mismatch
+        metrics.Gauge("rereg_total", "", ("route",))
+    with pytest.raises(ValueError):       # tag-key mismatch
+        metrics.Counter("rereg_total", "", ("other",))
+
+    h1 = metrics.Histogram("rereg_h", "", [0.1, 1.0], ())
+    h1.observe(0.5)
+    h2 = metrics.Histogram("rereg_h", "", [1.0, 0.1], ())  # same sorted
+    assert h2 is h1
+    h2.observe(0.05)
+    text = metrics.export_prometheus()
+    assert "rereg_h_count 2" in text
+    with pytest.raises(ValueError):       # boundary mismatch
+        metrics.Histogram("rereg_h", "", [0.2, 2.0], ())
+
+
+def test_metrics_merge_expositions():
+    """Regression (ISSUE 5 review): /metrics must not concatenate
+    replica expositions verbatim — in-process replicas render the
+    SAME process registry, so naive joining repeats every series
+    (Prometheus rejects duplicate samples), and even distinct blocks
+    repeat # HELP/# TYPE family headers. merge_expositions collapses
+    duplicate sample lines and keeps one header pair per family."""
+    block = ("# HELP m_total things\n"
+             "# TYPE m_total counter\n"
+             'm_total{model="a"} 3.0\n')
+    # two replicas sharing one registry → identical blocks → one copy
+    merged = metrics.merge_expositions([block, block])
+    assert merged.count('m_total{model="a"} 3.0') == 1
+    assert merged.count("# TYPE m_total counter") == 1
+    # distinct processes: same family, different samples → one header,
+    # both samples grouped under it (contiguous, as the format requires)
+    other = ("# HELP m_total things\n"
+             "# TYPE m_total counter\n"
+             'm_total{model="b"} 7.0\n')
+    merged = metrics.merge_expositions([block, other])
+    assert merged.count("# TYPE m_total counter") == 1
+    assert 'm_total{model="a"} 3.0' in merged
+    assert 'm_total{model="b"} 7.0' in merged
+    # a live counter can advance BETWEEN two renders of one shared
+    # registry: dedup keys on series identity, not line text — one
+    # line survives (first value), not two conflicting samples
+    drift = block.replace(" 3.0", " 4.0")
+    merged = metrics.merge_expositions([block, drift])
+    assert merged.count('m_total{model="a"}') == 1
+    assert 'm_total{model="a"} 3.0' in merged
+
+
 def test_metrics_flush_and_collect(ray_start):
     c = metrics.Counter("flush_test_total", "", ())
     c.inc(5)

@@ -39,7 +39,17 @@ SANCTIONED_SYNC = ("profil", "bench", "timing", "test")
 
 JIT_NAMES = {"jit", "pjit"}
 ALL_RULES = ("JL001", "JL002", "JL003", "JL004",
-             "JL005", "JL006", "JL007", "JL008")
+             "JL005", "JL006", "JL007", "JL008", "JL009")
+
+# instrumentation receivers (JL009): a call whose dotted receiver
+# chain names one of these — `metrics.*`, `tracing.span`,
+# `self.telemetry.on_token`, `recorder.record` — is observability
+# code and must stay on the HOST side of the dispatch boundary
+INSTRUMENT_RECEIVERS = {"metrics", "tracing", "telemetry",
+                        "_telemetry", "recorder"}
+# metric-handle method names specific enough to flag on their own
+# (`ttft.observe(...)` on a bound histogram handle)
+INSTRUMENT_TAILS = {"observe"}
 
 
 def check_module(project: Project, mod: ModuleInfo) -> List[Finding]:
@@ -112,6 +122,27 @@ def _check_call(project: Project, mod: ModuleInfo, node: ast.Call,
                           f"baked in at trace time (stale clocks / "
                           f"fixed randomness); thread jax.random keys "
                           f"or compute host-side"))
+
+        # JL009 (ISSUE 5): instrumentation under a trace. A
+        # `metrics.observe`/`tracing.span`/`telemetry.on_*` call
+        # inside a traced function runs at TRACE time only — the
+        # compiled program replays WITHOUT it, so the metric records
+        # once per compile instead of once per call (silently frozen
+        # telemetry), and its wall-clock reads/locks are host work
+        # that has no meaning inside a compiled program. All
+        # instrumentation stays on the host side of the dispatch
+        # boundary (the engine records from admission bookkeeping and
+        # the fold).
+        parts = name.split(".") if name else []
+        if len(parts) > 1 and (set(parts[:-1]) & INSTRUMENT_RECEIVERS
+                               or parts[-1] in INSTRUMENT_TAILS):
+            out.append(_f(
+                mod, "JL009", node, fn, name,
+                f"`{name}(...)` inside a traced function: "
+                f"instrumentation runs at trace time only (frozen "
+                f"into the compiled program, never per call) — "
+                f"record from host-side events outside the jit "
+                f"boundary instead"))
 
     # JL005: explicit sync points
     if name in ("jax.device_get", "jax.block_until_ready") \
