@@ -11,7 +11,26 @@ anatomy"): the router serves `GET /metrics` (Prometheus text),
 `GET /debug/trace` (Chrome-trace request lifecycles),
 `GET /debug/events` (engine flight recorder) and
 `POST /debug/profile` (jax.profiler capture of the next N ticks).
-All series carry a `model` tag. Metric catalogue:
+All series carry a `model` tag (and a `replica` tag in fleets).
+
+Fleet endpoints (ISSUE 6; `ray_tpu.serve.llm` — the multi-replica
+ingress from `build_llm_fleet_app`, details: BENCH_CORE.md "Serving
+fleet anatomy"):
+
+    endpoint                    payload
+    POST /v1/chat/completions   unary or SSE; 429 + Retry-After on overload
+    POST /v1/completions        unary or SSE; 429 + Retry-After on overload
+    GET  /v1/models             the fleet's model (+ live adapters)
+    GET  /fleet                 per-replica routing inputs (status, inflight,
+                                KV occupancy, queue depth, last-tick age),
+                                router/admission counters, autoscale events
+    GET  /stats                 per-replica engine stats + fleet status
+    GET  /metrics               ONE Prometheus exposition for the fleet,
+                                series tagged `replica` per engine
+    GET  /debug/events          per-replica flight recorders
+    GET  /debug/trace           merged Chrome-trace request lifecycles
+
+Single-replica metric catalogue:
 
     name                                    type       notes
     ray_tpu_llm_ttft_seconds                histogram  queued -> first host-visible token

@@ -155,6 +155,11 @@ class EngineConfig:
     # server passes its model_id; engines sharing a tag share sample
     # rows in the process-wide registry).
     metrics_model_id: Optional[str] = None
+    # Prometheus "replica" tag (ISSUE 6 fleets): distinguishes the N
+    # engines of one model's replica fleet. Engines outside a fleet
+    # leave it unset and the label is omitted from the exposition, so
+    # single-replica scrapes keep the pre-fleet series identity.
+    metrics_replica_id: Optional[str] = None
     # Real-checkpoint path: directory holding an HF-layout safetensors
     # checkpoint (model.safetensors[.index.json] + config.json). Params
     # load through models/checkpoint_io.py — sharding-aware windowed
@@ -339,7 +344,12 @@ class InferenceEngine:
         # see telemetry.py for the zero-sync contract
         self.telemetry = EngineTelemetry(
             model=ec.metrics_model_id or "default",
-            enabled=bool(ec.enable_metrics))
+            enabled=bool(ec.enable_metrics),
+            replica=ec.metrics_replica_id or "")
+        # wall-clock stamp of the last completed tick: the fleet
+        # router's liveness input (fleet_stats last_tick_age_s) — a
+        # replica whose pump wedged stops advancing this
+        self.last_step_at: Optional[float] = None
         # on-demand profiling: {"remaining", "dir", "cm"} while armed
         # (POST /debug/profile → profile_next_ticks)
         self._profile: Optional[Dict[str, Any]] = None
@@ -1860,6 +1870,7 @@ class InferenceEngine:
                 # tick's record instead of vanishing from the telemetry
                 self._tick_host_s = 0.0
                 self._tick_dev_s = 0.0
+                self.last_step_at = time.time()
             except BaseException:
                 # a mid-tick raise (fold reservation assert,
                 # GuardViolation, allocator OOM, ...) must not leave an

@@ -31,6 +31,11 @@ class LLMServerImpl:
         # Prometheus samples tag per model (ISSUE 5) unless the
         # engine_kwargs pin an explicit tag
         engine_kwargs.setdefault("metrics_model_id", self.model_id)
+        # fleet identity (ISSUE 6): the fleet deployment builder
+        # injects metrics_replica_id so this replica's series and
+        # fleet_stats() rows carry its id; standalone servers stay ""
+        self.replica_id = str(
+            engine_kwargs.get("metrics_replica_id") or "")
         self.engine = InferenceEngine(EngineConfig(
             model=self._config.get("model_source", "debug"),
             **engine_kwargs))
@@ -312,6 +317,62 @@ class LLMServerImpl:
         out = await asyncio.get_running_loop().run_in_executor(
             None, self.engine.profile_next_ticks, ticks, log_dir)
         return {"model": self.model_id, "log_dir": out, "ticks": ticks}
+
+    # -- fleet surface (ISSUE 6) -------------------------------------------
+    def _fleet_stats_sync(self) -> Dict[str, Any]:
+        """Routing inputs for the fleet router. Plain host-side
+        attribute reads (no step-lock, no device sync) — the router
+        refreshes this at sub-second cadence and must never queue
+        behind a tick."""
+        eng = self.engine
+        alloc = eng.allocator
+        used = alloc.used_pages
+        last = eng.last_step_at
+        return {
+            "replica": self.replica_id,
+            "model": self.model_id,
+            "active": eng.num_active(),
+            "waiting": len(eng.waiting),
+            "kv_occupancy": (used / alloc.num_usable
+                             if alloc.num_usable else 0.0),
+            "free_pages": alloc.free_pages,
+            "cache_hit_rate": alloc.cache_hit_rate,
+            "last_tick_age_s": (None if last is None
+                                else max(time.time() - last, 0.0)),
+            # cumulative SLO sums the fleet autoscaler deltas into
+            # recent-window TTFT / queue-wait means
+            "slo_totals": eng.telemetry.slo_totals(),
+        }
+
+    async def fleet_stats(self) -> Dict[str, Any]:
+        return self._fleet_stats_sync()
+
+    async def health_detail(self) -> Dict[str, Any]:
+        """Per-replica health row surfaced through serve.status()
+        (the controller's metrics poll calls this): the router's
+        inputs — queue depth, KV occupancy, last-tick age — without
+        operators having to hit each replica's /stats."""
+        out = self._fleet_stats_sync()
+        out.pop("slo_totals", None)
+        return out
+
+    async def drain(self, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Run the engine dry WITHOUT dropping in-flight work: the
+        fleet removed this replica from its router ring first, so no
+        new requests arrive; existing requests keep streaming through
+        the pump until each finishes naturally (has_work() also counts
+        pipelined in-flight ticks and pending folds, so a clean return
+        means every lagged token has been delivered). Scale-down calls
+        this before parking the replica on standby."""
+        t0 = time.monotonic()
+        while self.engine.has_work() \
+                and time.monotonic() - t0 < timeout_s:
+            if self._wake is not None:
+                self._wake.set()     # keep the pump ticking
+            await asyncio.sleep(0.01)
+        return {"replica": self.replica_id,
+                "drained": not self.engine.has_work(),
+                "waited_s": round(time.monotonic() - t0, 3)}
 
     async def check_health(self) -> None:
         return None

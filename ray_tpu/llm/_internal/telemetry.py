@@ -61,10 +61,14 @@ def _build_metrics() -> Dict[str, Any]:
     """The shared metric family set, constructed idempotently (the
     registry returns the existing instance on re-registration, so
     every engine in a process holds the SAME objects and samples
-    split per engine by the `model` tag)."""
+    split per engine by the `model` + `replica` tags). `replica` is
+    the ISSUE 6 fleet dimension: engines outside a fleet leave it ""
+    and the exposition omits empty labels, so single-replica scrapes
+    are byte-identical to the pre-fleet format."""
     H, C, G = (metrics_api.Histogram, metrics_api.Counter,
                metrics_api.Gauge)
-    lat = dict(boundaries=LATENCY_BOUNDARIES, tag_keys=("model",))
+    keys = ("model", "replica")
+    lat = dict(boundaries=LATENCY_BOUNDARIES, tag_keys=keys)
     return {
         "ttft": H("ray_tpu_llm_ttft_seconds",
                   "queued -> first host-visible token", **lat),
@@ -76,36 +80,36 @@ def _build_metrics() -> Dict[str, Any]:
         "e2e": H("ray_tpu_llm_e2e_latency_seconds",
                  "queued -> finished", **lat),
         "prompt_tokens": C("ray_tpu_llm_prompt_tokens_total",
-                           "prompt tokens admitted", ("model",)),
+                           "prompt tokens admitted", keys),
         "generated_tokens": C("ray_tpu_llm_generated_tokens_total",
-                              "tokens emitted to requests", ("model",)),
+                              "tokens emitted to requests", keys),
         "finished": C("ray_tpu_llm_finished_total",
                       "finished requests by reason",
-                      ("model", "reason")),
+                      ("model", "replica", "reason")),
         "aborts": C("ray_tpu_llm_aborts_total",
-                    "requests aborted (client gone)", ("model",)),
+                    "requests aborted (client gone)", keys),
         "drains": C("ray_tpu_llm_drains_total",
                     "tick-pipeline structural-event barriers",
-                    ("model",)),
+                    keys),
         "running": G("ray_tpu_llm_running_requests",
-                     "requests holding a decode slot", ("model",)),
+                     "requests holding a decode slot", keys),
         "waiting": G("ray_tpu_llm_waiting_requests",
-                     "requests queued for admission", ("model",)),
+                     "requests queued for admission", keys),
         "kv_used": G("ray_tpu_llm_kv_pages_used",
                      "KV pages referenced by live sequences",
-                     ("model",)),
+                     keys),
         "kv_free": G("ray_tpu_llm_kv_pages_free",
                      "KV pages allocatable now (free + evictable "
-                     "cache)", ("model",)),
+                     "cache)", keys),
         "kv_occupancy": G("ray_tpu_llm_kv_page_occupancy",
                           "referenced fraction of the usable KV pool",
-                          ("model",)),
+                          keys),
         "prefix_hit_rate": G("ray_tpu_llm_prefix_cache_hit_rate",
                              "prefix-cache hit tokens / queried "
-                             "tokens, cumulative", ("model",)),
+                             "tokens, cumulative", keys),
         "budget_util": G("ray_tpu_llm_token_budget_utilization",
                          "packed tokens / token budget, recent "
-                         "unified ticks", ("model",)),
+                         "unified ticks", keys),
     }
 
 
@@ -174,9 +178,11 @@ class EngineTelemetry:
     Python (no jax imports, no device arrays): calling them can never
     add an upload, a sync, or a compile to the tick."""
 
-    def __init__(self, model: str = "default", enabled: bool = True):
+    def __init__(self, model: str = "default", enabled: bool = True,
+                 replica: str = ""):
         self.enabled = enabled
         self.model = model
+        self.replica = replica
         self.recorder = FlightRecorder(enabled=enabled)
         self._lock = threading.Lock()
         self._live: Dict[str, _Timeline] = {}
@@ -198,7 +204,7 @@ class EngineTelemetry:
         self._counts = {"ttft": 0, "itl": 0, "queue": 0, "e2e": 0}
         if enabled:
             self._m = _build_metrics()
-            self._tags = {"model": model}
+            self._tags = {"model": model, "replica": replica}
         else:
             self._m = None
             self._tags = {}
@@ -333,6 +339,26 @@ class EngineTelemetry:
             util = (self._budget_used / self._budget_total
                     if self._budget_total else 0.0)
         self._m["budget_util"].set(util, self._tags)
+
+    def slo_totals(self) -> Dict[str, float]:
+        """Cumulative SLO sums/counts (seconds / observations).
+
+        The fleet autoscaler (serve/llm) differences consecutive
+        snapshots of these to get RECENT-window TTFT / queue-wait
+        means — lifetime averages would never recover after one bad
+        minute, so the control loop needs monotone totals it can
+        delta, not the averages summary() reports."""
+        with self._lock:
+            return {
+                "ttft_s": self._sums["ttft"],
+                "ttft_n": float(self._counts["ttft"]),
+                "itl_s": self._sums["itl"],
+                "itl_n": float(self._counts["itl"]),
+                "queue_s": self._sums["queue"],
+                "queue_n": float(self._counts["queue"]),
+                "e2e_s": self._sums["e2e"],
+                "e2e_n": float(self._counts["e2e"]),
+            }
 
     def summary(self) -> Dict[str, Any]:
         """Per-engine SLO aggregates for stats() (exact for THIS

@@ -41,9 +41,13 @@ __all__ = [
 
 def __getattr__(name):
     # serve.llm namespace (reference: python/ray/serve/llm), loaded
-    # lazily: the llm package pulls in jax + the model stack, which
-    # non-LLM serve processes (controller, proxy) must not pay for
+    # lazily: the llm packages pull in jax + the model stack, which
+    # non-LLM serve processes (controller, proxy) must not pay for.
+    # Since ISSUE 6 this is the REAL serve/llm subpackage (fleet
+    # deployments, router, admission, autoscaling); it re-exports the
+    # single-model surface from ray_tpu.llm, so serve.llm.LLMConfig
+    # etc. keep working.
     if name == "llm":
-        from .. import llm
-        return llm
+        import importlib
+        return importlib.import_module(".llm", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

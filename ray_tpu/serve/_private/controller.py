@@ -40,6 +40,9 @@ class _ReplicaInfo:
         self.ongoing = 0.0
         self.qps = 0.0
         self.total_requests = 0.0
+        # optional health_detail() payload from the last metrics poll
+        # (LLM replicas: queue depth, KV occupancy, last-tick age)
+        self.detail: Optional[Dict] = None
         self.health_task: Optional[asyncio.Task] = None
 
 
@@ -262,6 +265,16 @@ class ServeController:
                             r.total_requests
                             for r in info.replicas.values()),
                     },
+                    # per-replica health detail (ISSUE 6): replicas
+                    # exposing health_detail() — LLM servers report
+                    # queue depth / KV occupancy / last-tick age —
+                    # show their routing inputs here, so operators
+                    # read them from serve.status() instead of
+                    # hitting each replica's /stats
+                    "replica_details": {
+                        rid: r.detail
+                        for rid, r in info.replicas.items()
+                        if r.detail is not None},
                 }
             out["applications"][app_name] = {
                 "status": app["status"],
@@ -413,6 +426,7 @@ class ServeController:
                 rep.ongoing = float(metrics.get("ongoing", 0))
                 rep.qps = float(metrics.get("qps_10s", 0.0))
                 rep.total_requests = float(metrics.get("total", 0))
+                rep.detail = metrics.get("detail")
                 rep.last_health = now
             except Exception as e:
                 logger.warning("replica %s failed health check: %r",

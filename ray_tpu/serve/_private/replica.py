@@ -128,8 +128,25 @@ class Replica:
     async def metrics(self) -> Dict[str, Any]:
         cutoff = time.time() - 10.0
         recent = sum(1 for t in self._window if t >= cutoff)
-        return {"ongoing": self._ongoing, "total": self._total,
-                "qps_10s": recent / 10.0}
+        out: Dict[str, Any] = {"ongoing": self._ongoing,
+                               "total": self._total,
+                               "qps_10s": recent / 10.0}
+        # optional per-replica health detail (ISSUE 6): a callable
+        # exposing health_detail() — the LLM server reports queue
+        # depth / KV occupancy / last-tick age — gets it piggybacked
+        # on the controller's existing metrics poll and surfaced in
+        # serve.status(). Best-effort: a broken hook must not fail
+        # the health probe and kill the replica.
+        fn = getattr(self._instance, "health_detail", None)
+        if fn is not None:
+            try:
+                detail = fn()
+                if inspect.isawaitable(detail):
+                    detail = await detail
+                out["detail"] = detail
+            except Exception:
+                pass
+        return out
 
     async def prepare_for_shutdown(self) -> None:
         """Drain: wait for ongoing requests to finish (graceful stop),

@@ -1,0 +1,58 @@
+"""ray_tpu.serve.llm: multi-replica LLM serving fleets (ISSUE 6).
+
+Reference parity: python/ray/serve/llm — `serve.llm` is where the
+reference composes its LLM engine with the serve deployment stack.
+Here the single-replica surface (LLMConfig / build_openai_app,
+re-exported from ray_tpu.llm) gains the fleet layer the ROADMAP's
+"millions of users" item calls for:
+
+- `FleetConfig` + `build_llm_fleet_app` — N `LLMServerImpl` engine
+  replicas behind one ingress, deployed through `serve.run`
+  (deployment.py);
+- a continuous-batching-aware router: consistent-hash prefix affinity
+  with load-based spillover over live KV-page occupancy and queue
+  depth (router.py);
+- a bounded admission front door: 429 + Retry-After backpressure,
+  per-tenant weighted fair queueing (admission.py);
+- a telemetry-driven autoscaler consuming PR 5's TTFT / queue-wait
+  aggregates, with drain-before-downscale (autoscaler.py, fleet.py).
+
+Scoring formula, admission thresholds, and the autoscale policy are
+documented in BENCH_CORE.md "Serving fleet anatomy".
+"""
+
+from __future__ import annotations
+
+# the single-model serving surface lives in ray_tpu.llm; re-export
+# ALL of it so `serve.llm` stays a strict superset — before ISSUE 6
+# `serve.llm` WAS the ray_tpu.llm module, so every name in its
+# __all__ must keep resolving here (reference: python/ray/serve/llm)
+from ...llm import (ByteTokenizer, EngineConfig,  # noqa: F401
+                    InferenceEngine, LLMConfig, Request,
+                    SamplingParams, build_llm_deployment,
+                    build_openai_app, load_tokenizer)
+
+from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
+                        AdmissionRejected)
+from .autoscaler import (AutoscaleConfig, FleetAutoscaler,  # noqa: F401
+                         FleetMetrics)
+from .deployment import (FleetConfig, LLMFleetIngressImpl,  # noqa: F401
+                         build_llm_fleet_app)
+from .fleet import (FleetManager, HandleReplicaClient,  # noqa: F401
+                    LocalReplicaClient)
+from .router import (FleetRouter, HashRing, ReplicaSnapshot,  # noqa: F401
+                     RouterConfig, prefix_fingerprint)
+
+__all__ = [
+    # fleet layer
+    "FleetConfig", "build_llm_fleet_app", "LLMFleetIngressImpl",
+    "FleetManager", "LocalReplicaClient", "HandleReplicaClient",
+    "FleetRouter", "RouterConfig", "ReplicaSnapshot", "HashRing",
+    "prefix_fingerprint",
+    "AdmissionConfig", "AdmissionController", "AdmissionRejected",
+    "AutoscaleConfig", "FleetAutoscaler", "FleetMetrics",
+    # single-model surface (ray_tpu.llm re-exports)
+    "LLMConfig", "build_openai_app", "build_llm_deployment",
+    "InferenceEngine", "EngineConfig", "SamplingParams", "Request",
+    "ByteTokenizer", "load_tokenizer",
+]

@@ -1,0 +1,231 @@
+"""Fleet admission control: bounded queue, backpressure, tenant weights.
+
+ISSUE 6: the engine already does per-replica admission control (KV
+pages at add_request / slot admission), but a fleet needs a SINGLE
+front door: without it, overload turns into unbounded queueing inside
+whichever replica the router picked — every queued request eventually
+completes, but p99 queue wait grows without limit and clients time out
+anyway, having wasted the fleet's work. This controller makes overload
+an explicit, bounded signal instead:
+
+- at most `max_concurrent` requests are dispatched fleet-wide; excess
+  waits in ONE bounded queue (`max_queue`);
+- a request that would exceed the queue bound is rejected immediately
+  (HTTP 429 + Retry-After at the ingress), and a queued request that
+  waits past `queue_wait_slo_s` is shed the same way — so the queue
+  wait of EVERY request, admitted or shed, is bounded by the SLO;
+- dequeue order is weighted fair across tenants (stride scheduling:
+  each tenant advances a virtual-time pass by 1/weight per request),
+  so a tenant flooding the queue cannot starve the others — it just
+  burns its own share.
+
+Pure asyncio, single event loop, no locks: every mutation happens on
+the loop the ingress runs on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import heapq
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    max_concurrent: int = 64       # fleet-wide dispatched requests
+    max_queue: int = 128           # bounded front-door queue
+    queue_wait_slo_s: float = 2.0  # queued past this -> shed (429)
+    retry_after_s: float = 1.0     # floor for the Retry-After hint
+    # tenant name -> weight (absent tenants get 1.0); higher weight =
+    # larger share of dequeues under contention
+    tenant_weights: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+
+
+class AdmissionRejected(Exception):
+    """Maps to HTTP 429 at the ingress."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class _Ticket:
+    __slots__ = ("tenant", "vtime", "seq", "future", "queued_at")
+
+    def __init__(self, tenant: str, vtime: float, seq: int):
+        self.tenant = tenant
+        self.vtime = vtime
+        self.seq = seq
+        self.future: asyncio.Future = \
+            asyncio.get_running_loop().create_future()
+        self.queued_at = time.monotonic()
+
+    def __lt__(self, other: "_Ticket") -> bool:
+        return (self.vtime, self.seq) < (other.vtime, other.seq)
+
+
+class AdmissionController:
+    """`await acquire(tenant)` then `release()` around each dispatch."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None):
+        self.config = config or AdmissionConfig()
+        self.inflight = 0
+        self._heap: List[_Ticket] = []
+        self._dead = 0     # shed/cancelled tickets still in the heap
+        self._seq = itertools.count()
+        # stride-scheduling state: a tenant's next pass; the global
+        # vtime floor stops an idle tenant banking credit forever
+        self._pass: Dict[str, float] = {}
+        self._vtime = 0.0
+        # observability (GET /fleet)
+        self.admitted = 0
+        self.rejected: Dict[str, int] = {"queue_full": 0,
+                                         "queue_wait_slo": 0}
+        self.shed_total = 0
+        self._recent_waits: collections.deque = collections.deque(
+            maxlen=512)
+
+    # -- internals ------------------------------------------------------
+    def _weight(self, tenant: str) -> float:
+        w = self.config.tenant_weights.get(tenant, 1.0)
+        return w if w > 0 else 1.0
+
+    def _queue_len(self) -> int:
+        # done tickets still heaped are exactly the shed/cancelled
+        # ones (_dead): grants pop their ticket before resolving it
+        return len(self._heap) - self._dead
+
+    def _discard(self, ticket: _Ticket) -> None:
+        """A queued ticket was shed or cancelled. It stays in the heap
+        (removal from the middle is O(n)) but MUST NOT wait for
+        _grant_next's capacity-gated pop to reap it — long-lived
+        streams can peg inflight at the cap for minutes, during which
+        sustained overload would accumulate every ticket ever shed and
+        degrade admission to O(dead) per call. Mark, then compact once
+        the dead tickets win."""
+        if ticket.future.cancel():
+            self._dead += 1
+        if self._dead > 32 and self._dead * 2 > len(self._heap):
+            self._heap = [t for t in self._heap if not t.future.done()]
+            heapq.heapify(self._heap)
+            self._dead = 0
+
+    def _grant_next(self) -> None:
+        while self._heap and self.inflight < self.config.max_concurrent:
+            t = heapq.heappop(self._heap)
+            if t.future.done():
+                self._dead -= 1
+                continue             # shed while queued
+            self.inflight += 1
+            self._vtime = max(self._vtime, t.vtime)
+            self._record_admit(time.monotonic() - t.queued_at)
+            t.future.set_result(None)
+
+    def _record_admit(self, wait_s: float) -> None:
+        self.admitted += 1
+        self._recent_waits.append(wait_s)
+
+    def _prune_pass(self) -> None:
+        # entries at or below the global floor are semantically dead —
+        # acquire()'s max(pass, vtime) picks the floor anyway — and the
+        # tenant string is CLIENT-controlled (the OpenAI "user" field),
+        # so without eviction one dict entry per distinct end-user id
+        # accumulates forever; size-triggered so the rebuild stays off
+        # the per-request path
+        if len(self._pass) > 1024:
+            self._pass = {t: p for t, p in self._pass.items()
+                          if p > self._vtime}
+
+    # -- public API -----------------------------------------------------
+    async def acquire(self, tenant: str = "default") -> None:
+        """Admit or raise AdmissionRejected. Bounded wait: returns
+        within queue_wait_slo_s or rejects."""
+        cfg = self.config
+        # flush cancelled heap heads / spare capacity first, so the
+        # queue-full check below sees the true backlog
+        self._grant_next()
+        if self.inflight >= cfg.max_concurrent \
+                and self._queue_len() >= cfg.max_queue:
+            self.rejected["queue_full"] += 1
+            raise AdmissionRejected("queue_full", self.retry_after())
+        vtime = max(self._pass.get(tenant, 0.0), self._vtime) \
+            + 1.0 / self._weight(tenant)
+        self._pass[tenant] = vtime
+        self._prune_pass()
+        ticket = _Ticket(tenant, vtime, next(self._seq))
+        heapq.heappush(self._heap, ticket)
+        self._grant_next()
+        if ticket.future.done() and not ticket.future.cancelled():
+            return                      # admitted without waiting
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(ticket.future),
+                timeout=cfg.queue_wait_slo_s)
+        except asyncio.TimeoutError:
+            if ticket.future.done():
+                # granted in the same loop turn the timer fired:
+                # the grant stands
+                return
+            self._discard(ticket)
+            self.rejected["queue_wait_slo"] += 1
+            self.shed_total += 1
+            raise AdmissionRejected("queue_wait_slo",
+                                    self.retry_after()) from None
+        except asyncio.CancelledError:
+            # caller cancelled (client gone) — give the slot back if
+            # the grant raced the cancellation
+            if ticket.future.done() and not ticket.future.cancelled():
+                self.release()
+            else:
+                self._discard(ticket)
+            raise
+
+    def would_reject(self) -> bool:
+        """Preflight: would acquire() reject RIGHT NOW? (The ingress
+        checks before committing a 200 SSE stream to the wire.)"""
+        self._grant_next()
+        return (self.inflight >= self.config.max_concurrent
+                and self._queue_len() >= self.config.max_queue)
+
+    def release(self) -> None:
+        """One dispatched request finished; grant the next waiter."""
+        self.inflight = max(self.inflight - 1, 0)
+        self._grant_next()
+
+    def retry_after(self) -> float:
+        """Retry-After hint: the SLO-bounded drain estimate — a full
+        queue drains within one SLO window by construction (every
+        waiter is granted or shed by then)."""
+        cfg = self.config
+        if self._queue_len() == 0:
+            return cfg.retry_after_s
+        return max(cfg.retry_after_s, cfg.queue_wait_slo_s)
+
+    # -- observability --------------------------------------------------
+    def queue_wait_p99_s(self) -> float:
+        waits = sorted(self._recent_waits)
+        if not waits:
+            return 0.0
+        return waits[min(len(waits) - 1, int(len(waits) * 0.99))]
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "inflight": self.inflight,
+            "queued": self._queue_len(),
+            "admitted": self.admitted,
+            "rejected": dict(self.rejected),
+            "shed_total": self.shed_total,
+            "queue_wait_p99_s": round(self.queue_wait_p99_s(), 4),
+            "max_concurrent": self.config.max_concurrent,
+            "max_queue": self.config.max_queue,
+            "queue_wait_slo_s": self.config.queue_wait_slo_s,
+        }
+
+
+__all__ = ["AdmissionConfig", "AdmissionController", "AdmissionRejected"]

@@ -1,0 +1,112 @@
+"""Telemetry-driven fleet autoscaling with drain-before-downscale.
+
+ISSUE 6: PR 5's request-lifecycle telemetry exists precisely so a
+control loop can consume it. This policy scales the ACTIVE replica set
+between min and max off the fleet's recent TTFT and queue-wait
+aggregates (windowed deltas of the cumulative sums the telemetry
+summary exports — not lifetime averages, which would never recover
+after one bad minute) plus the admission controller's shed counter
+(a shed request is the strongest "we are out of capacity" signal the
+front door produces).
+
+The policy only DECIDES a target size; FleetManager applies it. Scale
+down never kills a replica with work in flight: the victim is removed
+from the router ring first (no new requests), drains through the
+engine's own has_work()/abort semantics, and is only retired once
+idle — in-flight streams complete token-exact (the e2e test pins
+this against a single-replica oracle).
+
+Hysteresis mirrors serve's deployment autoscaler
+(_private/controller.py autoscale_tick): a breach must persist for
+upscale_delay_s before adding a replica, idleness for
+downscale_delay_s before removing one, so one bursty tick cannot flap
+the fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # scale-up triggers (recent-window aggregates)
+    ttft_high_ms: float = 2000.0
+    queue_wait_high_ms: float = 500.0
+    # scale-down gate: ALL of these must hold
+    queue_wait_low_ms: float = 50.0
+    occupancy_low: float = 0.30
+    # hysteresis
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+
+
+@dataclasses.dataclass
+class FleetMetrics:
+    """Windowed fleet aggregates (FleetManager computes the deltas)."""
+    ttft_ms: float = 0.0            # recent-window mean TTFT
+    queue_wait_ms: float = 0.0      # recent-window mean engine queue wait
+    waiting: int = 0                # engine queues, fleet-wide, now
+    occupancy: float = 0.0          # mean KV occupancy over active
+    shed_delta: int = 0             # admission sheds/rejects this window
+
+
+class FleetAutoscaler:
+    def __init__(self, config: Optional[AutoscaleConfig] = None):
+        self.config = config or AutoscaleConfig()
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        self.last_decision: Dict[str, Any] = {}
+
+    def _breached(self, m: FleetMetrics, active: int) -> bool:
+        c = self.config
+        return (m.shed_delta > 0
+                or m.ttft_ms > c.ttft_high_ms
+                or m.queue_wait_ms > c.queue_wait_high_ms
+                or m.waiting > active)      # >1 queued per replica
+
+    def _idle(self, m: FleetMetrics) -> bool:
+        c = self.config
+        return (m.shed_delta == 0 and m.waiting == 0
+                and m.queue_wait_ms < c.queue_wait_low_ms
+                and m.occupancy < c.occupancy_low)
+
+    def decide(self, m: FleetMetrics, active: int,
+               now: Optional[float] = None) -> int:
+        """Target active-replica count, clamped to [min, max]."""
+        c = self.config
+        now = time.time() if now is None else now
+        target = active
+        if self._breached(m, active):
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if now - self._above_since >= c.upscale_delay_s:
+                target = active + 1
+                self._above_since = None
+        elif self._idle(m):
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if now - self._below_since >= c.downscale_delay_s:
+                target = active - 1
+                self._below_since = None
+        else:
+            self._above_since = self._below_since = None
+        target = max(c.min_replicas, min(c.max_replicas, target))
+        self.last_decision = {
+            "ts": now, "active": active, "target": target,
+            "ttft_ms": round(m.ttft_ms, 3),
+            "queue_wait_ms": round(m.queue_wait_ms, 3),
+            "waiting": m.waiting,
+            "occupancy": round(m.occupancy, 4),
+            "shed_delta": m.shed_delta,
+        }
+        return target
+
+
+__all__ = ["AutoscaleConfig", "FleetAutoscaler", "FleetMetrics"]

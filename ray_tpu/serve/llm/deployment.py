@@ -1,0 +1,303 @@
+"""LLM fleet deployment: builder + OpenAI-compatible fleet ingress.
+
+`build_llm_fleet_app(FleetConfig)` provisions `max_replicas`
+LLMServer deployments (one engine each, distinct replica ids tagged
+into their Prometheus series) behind ONE `LLMFleetIngressImpl` — the
+deployment that owns the FleetManager (prefix-affine router, bounded
+admission, autoscale control loop). Registering the app through
+`serve.run` gives the fleet the controller's replica supervision and
+the proxy's HTTP/SSE plane for free; `local_testing_mode=True` runs
+the identical graph in-process (the tier-1 e2e tests do).
+
+Ingress HTTP surface (rides the existing proxy):
+    POST /v1/chat/completions      unary or SSE (stream=true)
+    POST /v1/completions           unary or SSE
+    GET  /v1/models                the fleet's model (+ adapters)
+    GET  /fleet                    fleet status: per-replica routing
+                                   inputs, router/admission counters,
+                                   autoscale decisions
+    GET  /stats                    per-replica engine stats + fleet
+    GET  /metrics                  ONE Prometheus exposition for the
+                                   fleet (replica-tagged series)
+    GET  /debug/events             per-replica flight recorders
+    GET  /debug/trace              merged Chrome-trace lifecycles
+Overload returns 429 with a Retry-After header (admission.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from .admission import AdmissionConfig, AdmissionRejected
+from .autoscaler import AutoscaleConfig
+from .fleet import (ACTIVE, DRAINING, STANDBY, FleetManager,
+                    HandleReplicaClient)
+from .router import RouterConfig
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """One model's replica fleet (wraps the single-replica LLMConfig)."""
+    llm_config: Any                      # ray_tpu.llm.LLMConfig
+    min_replicas: int = 1
+    max_replicas: int = 1
+    router: RouterConfig = dataclasses.field(default_factory=RouterConfig)
+    admission: AdmissionConfig = dataclasses.field(
+        default_factory=AdmissionConfig)
+    autoscale: Optional[AutoscaleConfig] = None   # min/max come from above
+    refresh_period_s: float = 0.5
+    autoscale_period_s: float = 2.0
+
+    def resolved_autoscale(self) -> AutoscaleConfig:
+        auto = self.autoscale or AutoscaleConfig()
+        return dataclasses.replace(auto,
+                                   min_replicas=self.min_replicas,
+                                   max_replicas=self.max_replicas)
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "model_id": self.llm_config.model_id,
+            "router": dataclasses.asdict(self.router),
+            "admission": dataclasses.asdict(self.admission),
+            "autoscale": dataclasses.asdict(self.resolved_autoscale()),
+            "refresh_period_s": self.refresh_period_s,
+            "autoscale_period_s": self.autoscale_period_s,
+        }
+
+
+class LLMFleetIngressImpl:
+    """The fleet's front door (a serve deployment class body)."""
+
+    def __init__(self, fleet_wire: Dict[str, Any], *server_handles):
+        self.model_id = fleet_wire.get("model_id", "default")
+        clients = []
+        # handles arrive in bind order (r0..rN-1); in local testing
+        # mode they resolve to in-process replicas that share THIS
+        # process's metric registry, which flips the /metrics merge
+        # strategy (fleet.py metrics_text)
+        from .._private import local_testing
+        shared = local_testing.active()
+        for i, h in enumerate(server_handles):
+            clients.append(HandleReplicaClient(
+                f"r{i}", h, shares_registry=shared))
+        self.fleet = FleetManager(
+            clients,
+            router=RouterConfig(**fleet_wire.get("router") or {}),
+            admission=AdmissionConfig(
+                **fleet_wire.get("admission") or {}),
+            autoscale=AutoscaleConfig(
+                **fleet_wire.get("autoscale") or {}),
+            refresh_period_s=fleet_wire.get("refresh_period_s", 0.5),
+            autoscale_period_s=fleet_wire.get("autoscale_period_s", 2.0))
+        self._adapters: Optional[List[str]] = None
+        self._adapters_ts = 0.0
+
+    # -- helpers --------------------------------------------------------
+    def _429(self, exc: AdmissionRejected):
+        from ...serve import Response
+        return Response(
+            {"error": {"type": "overloaded",
+                       "reason": exc.reason,
+                       "retry_after_s": exc.retry_after_s}},
+            status=429, content_type="application/json",
+            headers={"Retry-After":
+                     str(int(math.ceil(exc.retry_after_s)))})
+
+    async def _known_model(self, name: str) -> bool:
+        if not name or name == self.model_id:
+            return True
+        if name in (self._adapters or ()):
+            return True
+        # unknown name: (re)resolve — adapters can be registered live —
+        # but at most once per cooldown, so an unknown-model storm
+        # can't turn every request into a fleet-wide stats fan-out
+        # (model_info snapshots engine stats under the step lock)
+        now = time.monotonic()
+        if self._adapters is None or now - self._adapters_ts >= 2.0:
+            self._adapters_ts = now
+            await self._resolve_adapters()
+        return name in (self._adapters or ())
+
+    async def _resolve_adapters(self) -> None:
+        infos = await self._replica_infos()
+        self._adapters = sorted(
+            {a for info in infos.values()
+             for a in info.get("adapters") or []})
+
+    async def _replica_infos(self) -> Dict[str, Any]:
+        return await self._fanout("model_info")
+
+    async def _fanout(self, method: str) -> Dict[str, Any]:
+        """Call `method` on every non-standby replica concurrently,
+        bounded: one wedged replica (step lock held mid-tick) degrades
+        its row to an error instead of hanging the whole GET."""
+        ids = [rid for rid, st in self.fleet.replicas.items()
+               if st.status != STANDBY]
+
+        async def one(rid: str):
+            try:
+                return rid, await asyncio.wait_for(
+                    self.fleet.replicas[rid].client.call(method),
+                    timeout=5.0)
+            except Exception as e:
+                return rid, {"error": repr(e)}
+
+        return dict(await asyncio.gather(*(one(rid) for rid in ids)))
+
+    # -- GET surface ----------------------------------------------------
+    async def _handle_get(self, norm: str) -> Any:
+        from ...serve import Response
+
+        if norm == "/v1/models":
+            if self._adapters is None:
+                await self._resolve_adapters()
+            return {"object": "list",
+                    "data": [{"id": self.model_id, "object": "model",
+                              "owned_by": "ray_tpu"}]
+                    + [{"id": a, "object": "model",
+                        "owned_by": "ray_tpu",
+                        "parent": self.model_id}
+                       for a in self._adapters or []]}
+        if norm == "/fleet":
+            return await self.fleet.status()
+        if norm == "/metrics":
+            return Response(await self.fleet.metrics_text(),
+                            status=200, content_type="text/plain")
+        if norm == "/stats":
+            infos = await self._replica_infos()
+            return {"object": "stats", "model": self.model_id,
+                    "replicas": {rid: info.get("engine", info)
+                                 for rid, info in infos.items()},
+                    "fleet": await self.fleet.status()}
+        if norm == "/debug/events":
+            return {"object": "events",
+                    "replicas": await self._fanout("debug_events")}
+        if norm == "/debug/trace":
+            events: List[Any] = []
+            for doc in (await self._fanout("debug_trace")).values():
+                events.extend(doc.get("traceEvents") or [])
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return Response({"error": f"no route {norm}"}, status=404,
+                        content_type="application/json")
+
+    # -- request path ---------------------------------------------------
+    async def __call__(self, request) -> Any:
+        from ...serve import Response, StreamingHint
+
+        self.fleet.start()       # control loop rides the serving loop
+        path = getattr(request, "path", "/")
+        method = getattr(request, "method", "POST")
+        norm = path.rstrip("/") or "/"
+        if method == "GET":
+            return await self._handle_get(norm)
+        try:
+            body = request.json()
+        except Exception:
+            return Response({"error": "invalid JSON body"}, status=400,
+                            content_type="application/json")
+        if not isinstance(body, dict):
+            body = {}
+        if not await self._known_model(body.get("model") or ""):
+            return Response(
+                {"error": f"model {body.get('model')!r} not found"},
+                status=404, content_type="application/json")
+        is_chat = norm.endswith("/chat/completions")
+        is_cmpl = not is_chat and norm.endswith("/completions")
+        if not (is_chat or is_cmpl):
+            return Response({"error": f"no route {path}"}, status=404,
+                            content_type="application/json")
+        if body.get("stream"):
+            # preflight the front door so a flat-out overloaded fleet
+            # answers 429 instead of opening a 200 SSE stream only to
+            # shed inside it (a shed after headers can only be an SSE
+            # error event — see the stream_* methods)
+            if self.fleet.admission.would_reject():
+                return self._429(AdmissionRejected(
+                    "queue_full", self.fleet.admission.retry_after()))
+            return StreamingHint(
+                "stream_chat" if is_chat else "stream_completions",
+                body)
+        try:
+            return await self.fleet.dispatch(
+                "chat" if is_chat else "completions", body)
+        except AdmissionRejected as e:
+            return self._429(e)
+
+    async def _relay(self, method: str, body: Dict[str, Any]):
+        import json
+        self.fleet.start()
+        try:
+            async for chunk in self.fleet.dispatch_stream(method, body):
+                yield chunk
+        except AdmissionRejected as e:
+            # headers are already on the wire: the 429 becomes an SSE
+            # error event (the OpenAI streaming convention)
+            yield "data: " + json.dumps(
+                {"error": {"type": "overloaded", "reason": e.reason,
+                           "retry_after_s": e.retry_after_s}}) + "\n\n"
+            yield "data: [DONE]\n\n"
+
+    async def stream_chat(self, body: Dict[str, Any]):
+        async for chunk in self._relay("chat_stream", body):
+            yield chunk
+
+    async def stream_completions(self, body: Dict[str, Any]):
+        async for chunk in self._relay("completions_stream", body):
+            yield chunk
+
+    async def check_health(self) -> None:
+        return None
+
+    async def health_detail(self) -> Dict[str, Any]:
+        """serve.status() row for the ingress itself: fleet shape +
+        front-door pressure (the per-engine rows come from each
+        LLMServer replica's own health_detail)."""
+        f = self.fleet
+        adm = f.admission
+        return {
+            "model": self.model_id,
+            "active": len(f._ids(ACTIVE)),
+            "draining": len(f._ids(DRAINING)),
+            "standby": len(f._ids(STANDBY)),
+            "inflight": adm.inflight,
+            "queued": adm._queue_len(),
+            "queue_wait_p99_s": round(adm.queue_wait_p99_s(), 4),
+        }
+
+
+def build_llm_fleet_app(config: FleetConfig):
+    """FleetConfig → bound serve Application (deploy via serve.run)."""
+    import dataclasses as _dc
+
+    from ... import serve
+    from ...llm import build_llm_deployment
+
+    lc = config.llm_config
+    if config.min_replicas < 1 \
+            or config.max_replicas < config.min_replicas:
+        raise ValueError("need 1 <= min_replicas <= max_replicas")
+    servers = []
+    for i in range(config.max_replicas):
+        rid = f"r{i}"
+        ek = dict(lc.engine_kwargs or {})
+        # the replica id tags this engine's Prometheus series (and is
+        # how LLMServerImpl learns its own identity)
+        ek["metrics_replica_id"] = rid
+        dep_cfg = dict(lc.deployment_config or {})
+        dep_cfg["name"] = f"LLMServer:{lc.model_id}:{rid}"
+        servers.append(build_llm_deployment(
+            _dc.replace(lc, engine_kwargs=ek,
+                        deployment_config=dep_cfg)))
+    ingress = serve.deployment(
+        name=f"LLMFleet:{lc.model_id}",
+        max_ongoing_requests=max(
+            256, config.admission.max_concurrent
+            + config.admission.max_queue))(LLMFleetIngressImpl)
+    return ingress.bind(config.to_wire(), *servers)
+
+
+__all__ = ["FleetConfig", "LLMFleetIngressImpl", "build_llm_fleet_app"]

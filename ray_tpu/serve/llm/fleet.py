@@ -1,0 +1,437 @@
+"""FleetManager: N engine replicas behind one router + front door.
+
+The composition layer of ISSUE 6. A fleet is a set of `LLMServerImpl`
+replicas reached through a small client interface (so the SAME manager
+runs over in-process servers in tier-1 tests and benches, over
+local-testing-mode deployment handles, and over real replica actors),
+plus the three policy objects:
+
+- `FleetRouter` (router.py): prefix-affine, occupancy-aware pick;
+- `AdmissionController` (admission.py): bounded queue + 429 shed;
+- `FleetAutoscaler` (autoscaler.py): TTFT/queue-wait-driven target.
+
+Replica lifecycle: ACTIVE (in the ring) -> DRAINING (out of the ring,
+finishing in-flight work) -> STANDBY (idle, instantly re-activatable).
+The fleet provisions `max_replicas` up front and moves them between
+these states — scale-down never drops a stream: the victim leaves the
+ring first, the router's in-flight count reaches zero only when every
+stream it was serving has completed, and only then does the engine's
+own idle check (`has_work`) retire it to standby.
+
+Single-event-loop discipline: every mutation of fleet state happens on
+the loop the ingress serves from (the manager is created there); the
+blocking engine work stays inside each replica's own executor pump.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import Any, AsyncIterator, Deque, Dict, List, Optional, \
+    Sequence
+
+from .admission import (AdmissionConfig, AdmissionController,
+                        AdmissionRejected)
+from .autoscaler import AutoscaleConfig, FleetAutoscaler, FleetMetrics
+from .router import (FleetRouter, ReplicaSnapshot, RouterConfig,
+                     prefix_fingerprint)
+
+ACTIVE = "ACTIVE"
+DRAINING = "DRAINING"
+STANDBY = "STANDBY"
+
+
+class LocalReplicaClient:
+    """Direct in-process LLMServerImpl (tier-1 tests, bench --fleet)."""
+
+    shares_registry = True
+
+    def __init__(self, replica_id: str, server: Any):
+        self.replica_id = replica_id
+        self.server = server
+
+    async def call(self, method: str, *args) -> Any:
+        return await getattr(self.server, method)(*args)
+
+    def stream(self, method: str, body: Dict[str, Any]):
+        return getattr(self.server, method)(body)
+
+
+class HandleReplicaClient:
+    """A serve DeploymentHandle to an LLMServer deployment. In
+    local_testing_mode every handle resolves to an in-process replica
+    sharing this process's metric registry; across real replica
+    actors each process has its own registry (shares_registry drives
+    the /metrics merge strategy — see metrics_text())."""
+
+    def __init__(self, replica_id: str, handle: Any,
+                 shares_registry: bool = False):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.shares_registry = shares_registry
+
+    async def call(self, method: str, *args) -> Any:
+        return await getattr(self.handle, method).remote(*args)
+
+    def stream(self, method: str, body: Dict[str, Any]):
+        return getattr(self.handle, method).options(
+            stream=True).remote(body)
+
+
+class _ReplicaState:
+    def __init__(self, client: Any, status: str):
+        self.client = client
+        self.status = status
+        self.inflight = 0            # router-side, zero-lag
+        self.requests_total = 0
+        self.snapshot: Optional[ReplicaSnapshot] = None
+        self.slo_totals: Dict[str, float] = {}
+        self.drain_task: Optional[asyncio.Task] = None
+
+
+class FleetManager:
+    def __init__(self, clients: Sequence[Any],
+                 router: Optional[RouterConfig] = None,
+                 admission: Optional[AdmissionConfig] = None,
+                 autoscale: Optional[AutoscaleConfig] = None,
+                 refresh_period_s: float = 0.5,
+                 autoscale_period_s: float = 2.0):
+        if not clients:
+            raise ValueError("a fleet needs at least one replica")
+        auto = autoscale or AutoscaleConfig(
+            min_replicas=len(clients), max_replicas=len(clients))
+        if auto.max_replicas > len(clients):
+            raise ValueError(
+                f"max_replicas={auto.max_replicas} but only "
+                f"{len(clients)} replicas are provisioned")
+        if not 1 <= auto.min_replicas <= auto.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas={auto.min_replicas} "
+                f"<= max_replicas={auto.max_replicas}")
+        self.router = FleetRouter(router)
+        self.admission = AdmissionController(admission)
+        self.autoscaler = FleetAutoscaler(auto)
+        self.refresh_period_s = refresh_period_s
+        self.autoscale_period_s = autoscale_period_s
+        self.replicas: Dict[str, _ReplicaState] = {}
+        for i, c in enumerate(clients):
+            status = ACTIVE if i < auto.min_replicas else STANDBY
+            self.replicas[c.replica_id] = _ReplicaState(c, status)
+        self.router.set_replicas(self._ids(ACTIVE))
+        self._prev_slo: Dict[str, Dict[str, float]] = {}
+        self._prev_shed = 0
+        self._scale_events: Deque[Dict[str, Any]] = \
+            collections.deque(maxlen=256)
+        self._loop_task: Optional[asyncio.Task] = None
+
+    # -- membership helpers --------------------------------------------
+    def _ids(self, *statuses: str) -> List[str]:
+        return [rid for rid, st in self.replicas.items()
+                if st.status in statuses]
+
+    def _inflight_map(self) -> Dict[str, int]:
+        return {rid: st.inflight for rid, st in self.replicas.items()}
+
+    def _snapshots(self) -> Dict[str, ReplicaSnapshot]:
+        return {rid: st.snapshot for rid, st in self.replicas.items()
+                if st.snapshot is not None}
+
+    # -- request path ---------------------------------------------------
+    def _route(self, body: Dict[str, Any]) -> _ReplicaState:
+        fp = prefix_fingerprint(body, self.router.config.prefix_depth)
+        rid = self.router.pick(fp, self._snapshots(),
+                               self._inflight_map())
+        if rid is None:
+            raise AdmissionRejected("no_active_replicas",
+                                    self.admission.retry_after())
+        return self.replicas[rid]
+
+    @staticmethod
+    def tenant_of(body: Dict[str, Any]) -> str:
+        # OpenAI bodies carry the end-user id in "user"; fall back to
+        # a header-injected hint if the ingress put one in the body
+        return str(body.get("user") or body.get("tenant") or "default")
+
+    async def dispatch(self, method: str, body: Dict[str, Any]) -> Any:
+        """Unary request through admission + routing."""
+        await self.admission.acquire(self.tenant_of(body))
+        try:
+            st = self._route(body)
+            st.inflight += 1
+            st.requests_total += 1
+            try:
+                return await st.client.call(method, body)
+            finally:
+                st.inflight -= 1
+        finally:
+            self.admission.release()
+
+    async def dispatch_stream(self, method: str, body: Dict[str, Any]
+                              ) -> AsyncIterator[Any]:
+        """Streaming request: admission + routing hold for the WHOLE
+        stream (a live stream occupies a decode slot, so it must keep
+        weighing in both the router's in-flight counts and the
+        admission concurrency bound until it completes)."""
+        await self.admission.acquire(self.tenant_of(body))
+        try:
+            st = self._route(body)
+            st.inflight += 1
+            st.requests_total += 1
+            try:
+                async for chunk in st.client.stream(method, body):
+                    yield chunk
+            finally:
+                st.inflight -= 1
+        finally:
+            self.admission.release()
+
+    # -- stats refresh --------------------------------------------------
+    async def refresh(self) -> None:
+        """Pull fleet_stats from every non-standby replica."""
+        ids = self._ids(ACTIVE, DRAINING)
+
+        async def one(rid: str):
+            st = self.replicas[rid]
+            try:
+                stats = await asyncio.wait_for(
+                    st.client.call("fleet_stats"), timeout=5.0)
+            except Exception:
+                return                       # keep the stale snapshot
+            snap = ReplicaSnapshot.from_stats(stats)
+            snap.replica = rid
+            st.snapshot = snap
+            st.slo_totals = dict(stats.get("slo_totals") or {})
+
+        await asyncio.gather(*(one(rid) for rid in ids))
+
+    # -- autoscaling ----------------------------------------------------
+    def _window_metrics(self) -> FleetMetrics:
+        """Fleet aggregates over the window since the last call:
+        deltas of the cumulative TTFT/queue-wait sums each replica's
+        telemetry summary exports (PR 5), plus live queue depths and
+        the admission shed delta. Deltas are tracked PER REPLICA ID,
+        not on a fleet sum over the changing ACTIVE/DRAINING set — a
+        replica parking to STANDBY must not show up as a negative
+        window, and a reactivated one must contribute only its growth
+        since last seen, not its lifetime totals."""
+        keys = ("ttft_s", "ttft_n", "queue_s", "queue_n")
+        d = {k: 0.0 for k in keys}
+        waiting = 0
+        occ: List[float] = []
+        for rid, st in self.replicas.items():
+            if st.slo_totals:
+                prev = self._prev_slo.get(rid, {})
+                cur = {k: float(st.slo_totals.get(k, 0.0))
+                       for k in keys}
+                for k in keys:
+                    # clamped: an engine restart resets its counters
+                    d[k] += max(0.0, cur[k] - prev.get(k, 0.0))
+                self._prev_slo[rid] = cur
+            if st.snapshot is not None and st.status == ACTIVE:
+                waiting += st.snapshot.waiting
+                occ.append(st.snapshot.kv_occupancy)
+        shed = (self.admission.shed_total
+                + self.admission.rejected["queue_full"])
+        shed_delta = shed - self._prev_shed
+        self._prev_shed = shed
+        return FleetMetrics(
+            ttft_ms=(d["ttft_s"] / d["ttft_n"] * 1e3
+                     if d["ttft_n"] > 0 else 0.0),
+            queue_wait_ms=(d["queue_s"] / d["queue_n"] * 1e3
+                           if d["queue_n"] > 0 else 0.0),
+            waiting=waiting,
+            occupancy=(sum(occ) / len(occ) if occ else 0.0),
+            shed_delta=shed_delta)
+
+    async def autoscale_tick(self, now: Optional[float] = None) -> int:
+        """One control-loop iteration: refresh → decide → apply.
+        Returns the applied target (also reachable at GET /fleet)."""
+        await self.refresh()
+        active = len(self._ids(ACTIVE))
+        target = self.autoscaler.decide(self._window_metrics(),
+                                        active, now)
+        if target != active:
+            self._apply_target(target)
+        return target
+
+    def _apply_target(self, target: int) -> None:
+        active = self._ids(ACTIVE)
+        if target > len(active):
+            for rid in self._ids(STANDBY)[:target - len(active)]:
+                self.replicas[rid].status = ACTIVE
+                self._scale_events.append(
+                    {"ts": time.time(), "event": "activate",
+                     "replica": rid})
+        elif target < len(active):
+            # drain the emptiest replicas first: least in-flight work,
+            # then least KV occupancy (cheapest caches to lose)
+            def cost(rid: str):
+                st = self.replicas[rid]
+                occ = (st.snapshot.kv_occupancy
+                       if st.snapshot is not None else 0.0)
+                return (st.inflight, occ)
+
+            for rid in sorted(active, key=cost)[:len(active) - target]:
+                self._begin_drain(rid)
+        self.router.set_replicas(self._ids(ACTIVE))
+
+    def _begin_drain(self, rid: str) -> None:
+        st = self.replicas[rid]
+        st.status = DRAINING
+        self._scale_events.append(
+            {"ts": time.time(), "event": "drain_begin", "replica": rid})
+        st.drain_task = asyncio.get_running_loop().create_task(
+            self._drain_to_standby(rid))
+
+    async def _drain_to_standby(self, rid: str,
+                                timeout_s: float = 120.0) -> None:
+        """Out of the ring already; wait for the router-side in-flight
+        count to hit zero (every stream completed), then for the
+        engine itself to run dry (the replica's drain() polls
+        has_work(), which counts in-flight pipelined ticks and pending
+        folds), then park."""
+        st = self.replicas[rid]
+        attempt = 0
+        while True:
+            deadline = time.monotonic() + timeout_s
+            while st.inflight > 0 and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            drained = True
+            try:
+                rep = await st.client.call("drain", timeout_s)
+                drained = bool((rep or {}).get("drained", True))
+            except Exception:
+                pass    # best-effort: the replica may not expose drain
+            if st.inflight == 0 and drained:
+                break
+            # wedged: STAY DRAINING — out of the ring and ineligible
+            # for reactivation (_apply_target only activates STANDBY)
+            # — and retry; parking dirty would hand a replica known
+            # unable to finish work back to the router on scale-up
+            attempt += 1
+            self._scale_events.append(
+                {"ts": time.time(), "event": "drain_retry",
+                 "replica": rid, "attempt": attempt})
+            await asyncio.sleep(min(30.0, 2.0 * attempt))
+        st.status = STANDBY
+        self._scale_events.append(
+            {"ts": time.time(), "event": "drain_done", "replica": rid,
+             "clean": attempt == 0})
+
+    # -- background control loop ---------------------------------------
+    def start(self) -> None:
+        """Start the refresh + autoscale loop on the current event
+        loop (idempotent). Separate cadences: stats refresh keeps the
+        router's view fresh; autoscale decisions run slower."""
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._control_loop())
+
+    async def stop(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._loop_task = None
+
+    async def _control_loop(self) -> None:
+        last_autoscale = 0.0
+        while True:
+            try:
+                await self.refresh()
+                now = time.monotonic()
+                if now - last_autoscale >= self.autoscale_period_s:
+                    last_autoscale = now
+                    active = len(self._ids(ACTIVE))
+                    target = self.autoscaler.decide(
+                        self._window_metrics(), active)
+                    if target != active:
+                        self._apply_target(target)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "fleet control loop iteration failed")
+            await asyncio.sleep(self.refresh_period_s)
+
+    # -- observability --------------------------------------------------
+    async def metrics_text(self) -> str:
+        """ONE valid Prometheus exposition for the whole fleet.
+
+        Two registry topologies (the ISSUE 6 satellite):
+        - shared registry (in-process replicas / local testing): every
+          scrape renders the same process registry; each replica's
+          engine tags its own series with its replica id, so the fleet
+          scrapes every replica (each refreshes its own gauges) and
+          keeps the LAST rendering — by then every replica's gauges
+          are fresh in the shared registry.
+        - separate registries (real replica actors): each exposition
+          is scraped independently and relabeled with replica=<id> so
+          identical series from different replicas cannot collide or
+          silently sum in the merged document.
+        """
+        from ...util.metrics import merge_expositions, relabel_exposition
+
+        ids = self._ids(ACTIVE, DRAINING)
+
+        async def one(rid: str):
+            st = self.replicas[rid]
+            try:
+                return (rid, st.client, await asyncio.wait_for(
+                    st.client.call("metrics_text"), timeout=5.0))
+            except Exception:
+                return None     # a wedged replica can't black out
+                                # the whole fleet's scrape
+
+        texts = [t for t in await asyncio.gather(
+            *(one(rid) for rid in ids)) if t is not None]
+        if not texts:
+            return "\n"
+        if all(c.shares_registry for _, c, _ in texts):
+            return texts[-1][2]
+        return merge_expositions(
+            [relabel_exposition(t, {"replica": rid})
+             for rid, _, t in texts])
+
+    async def status(self) -> Dict[str, Any]:
+        """The GET /fleet document: routing inputs per replica,
+        router/admission counters, last autoscale decision."""
+        reps: Dict[str, Any] = {}
+        for rid, st in self.replicas.items():
+            snap = st.snapshot
+            reps[rid] = {
+                "status": st.status,
+                "inflight": st.inflight,
+                "requests_total": st.requests_total,
+                **({} if snap is None else {
+                    "active": snap.active,
+                    "waiting": snap.waiting,
+                    "kv_occupancy": round(snap.kv_occupancy, 4),
+                    "free_pages": snap.free_pages,
+                    "prefix_cache_hit_rate": round(
+                        snap.cache_hit_rate, 4),
+                    "last_tick_age_s": snap.last_tick_age_s,
+                }),
+            }
+        return {
+            "replicas": reps,
+            "router": self.router.stats(),
+            "admission": self.admission.stats(),
+            "autoscale": {
+                "min_replicas": self.autoscaler.config.min_replicas,
+                "max_replicas": self.autoscaler.config.max_replicas,
+                "active": len(self._ids(ACTIVE)),
+                "draining": len(self._ids(DRAINING)),
+                "standby": len(self._ids(STANDBY)),
+                "last_decision": self.autoscaler.last_decision,
+                "events": list(self._scale_events)[-32:],
+            },
+        }
+
+
+__all__ = ["FleetManager", "LocalReplicaClient", "HandleReplicaClient",
+           "ACTIVE", "DRAINING", "STANDBY"]

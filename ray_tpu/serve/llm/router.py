@@ -1,0 +1,256 @@
+"""Continuous-batching-aware replica routing for LLM fleets.
+
+ISSUE 6: round-robin (or pow-2 over request counts, serve/handle.py)
+is the wrong policy for a paged-attention engine fleet — at production
+concurrency the binding constraint is KV pages, not request counts
+(Ragged Paged Attention, PAPERS.md), and a request whose prompt prefix
+is already resident in some replica's prefix cache costs a fraction of
+a cold prefill there. So replica choice is:
+
+1. **Prefix affinity**: the request's prompt-prefix fingerprint maps
+   onto a consistent-hash ring over the active replicas. Identical
+   prefixes land on the same replica, so its hash-consed prompt pages
+   (llm/_internal/kv_cache.py) keep getting hit; replica add/remove
+   moves only the keys adjacent to the changed vnodes.
+2. **Load-based spillover**: when the affinity target is saturated
+   (KV-page occupancy or waiting-queue depth past the spill
+   thresholds), the walk continues around the ring — the SECOND
+   choice for a prefix is also sticky, so a hot prefix warms a
+   deterministic small set of replicas instead of spraying everywhere.
+3. **Scored fallback**: if every replica is past the spill thresholds
+   the least-loaded one wins by score (see `score()` — the formula is
+   documented in BENCH_CORE.md "Serving fleet anatomy").
+
+The router consumes each replica's existing stats surface (PR 5's
+KV-occupancy / queue-depth / prefix-hit gauges via
+`LLMServerImpl.fleet_stats()`); it never touches the engine.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import itertools
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def _h(key: str) -> int:
+    """Stable 64-bit point on the ring (sha1; hash() is salted)."""
+    return int.from_bytes(
+        hashlib.sha1(key.encode()).digest()[:8], "big")
+
+
+def prefix_fingerprint(body: Dict[str, Any], depth: int = 256) -> str:
+    """Fingerprint of the request's prompt PREFIX (first `depth`
+    characters of the canonical prompt text) — requests sharing it
+    route to the same replica. Character depth approximates the
+    page-aligned token prefix the KV cache actually shares: two
+    prompts identical for 256 chars share their leading prompt pages
+    for any tokenizer in this repo. Chat requests canonicalize to the
+    same role-tagged rendering the server's chat template consumes, so
+    a shared system prompt + history is a shared fingerprint even as
+    the final user turn varies beyond `depth`."""
+    if body.get("prompt") is not None:
+        text = str(body["prompt"])
+    else:
+        text = "\x1e".join(
+            f"{m.get('role', '')}\x1f{m.get('content', '')}"
+            for m in (body.get("messages") or []))
+        if not text:
+            text = json.dumps(body, sort_keys=True, default=str)
+    return hashlib.sha1(text[:depth].encode()).hexdigest()
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    `preferred(key)` returns every live node, deduplicated, in ring
+    order starting from the key's hash point — the router's spillover
+    walk. Removing a node only remaps keys whose nearest vnode was
+    the removed node's (the classic minimal-disruption property; the
+    fleet tests assert it)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = vnodes
+        self._points: List[int] = []        # sorted vnode hashes
+        self._owner: Dict[int, str] = {}    # vnode hash -> node
+        self._nodes: set = set()
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            p = _h(f"{node}#{i}")
+            # vnode collisions across nodes are astronomically rare;
+            # keep the first owner so add/remove stays symmetric
+            if p in self._owner:
+                continue
+            self._owner[p] = node
+            bisect.insort(self._points, p)
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        dead = [p for p, n in self._owner.items() if n == node]
+        for p in dead:
+            del self._owner[p]
+            self._points.pop(bisect.bisect_left(self._points, p))
+
+    def nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def preferred(self, key: str) -> List[str]:
+        """All nodes in ring-walk order from `key`'s point."""
+        if not self._points:
+            return []
+        out: List[str] = []
+        seen = set()
+        start = bisect.bisect_left(self._points, _h(key))
+        n = len(self._points)
+        for off in range(n):
+            node = self._owner[self._points[(start + off) % n]]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+                if len(out) == len(self._nodes):
+                    break
+        return out
+
+
+@dataclasses.dataclass
+class ReplicaSnapshot:
+    """One replica's routing inputs (from LLMServerImpl.fleet_stats)."""
+    replica: str
+    active: int = 0                  # requests holding a decode slot
+    waiting: int = 0                 # engine admission queue depth
+    kv_occupancy: float = 0.0        # used / usable KV pages
+    free_pages: int = 0
+    cache_hit_rate: float = 0.0      # cumulative prefix-cache hit rate
+    last_tick_age_s: Optional[float] = None
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    @classmethod
+    def from_stats(cls, stats: Dict[str, Any]) -> "ReplicaSnapshot":
+        return cls(
+            replica=stats.get("replica", ""),
+            active=int(stats.get("active", 0)),
+            waiting=int(stats.get("waiting", 0)),
+            kv_occupancy=float(stats.get("kv_occupancy", 0.0)),
+            free_pages=int(stats.get("free_pages", 0)),
+            cache_hit_rate=float(stats.get("cache_hit_rate", 0.0)),
+            last_tick_age_s=stats.get("last_tick_age_s"))
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    # "affinity" is the real policy; "round_robin" exists for the
+    # bench A/B (bench_llm --fleet) and as the degenerate baseline
+    policy: str = "affinity"
+    vnodes: int = 64
+    prefix_depth: int = 256
+    # spillover thresholds: the affinity target is "saturated" when
+    # EITHER trips (pages are the binding constraint; a deep engine
+    # queue means admission there would stall regardless of pages)
+    spill_occupancy: float = 0.85
+    spill_waiting: int = 4
+    # score weights for the all-saturated fallback
+    w_occupancy: float = 4.0
+    w_waiting: float = 1.0
+    w_inflight: float = 0.5
+
+
+class FleetRouter:
+    """Scores replicas by live engine state; sticky on prompt prefix.
+
+    The caller owns the snapshot map (FleetManager refreshes it off
+    each replica's fleet_stats) and the in-flight counts (updated at
+    dispatch/completion — the only zero-lag load signal)."""
+
+    def __init__(self, config: Optional[RouterConfig] = None):
+        self.config = config or RouterConfig()
+        self.ring = HashRing(vnodes=self.config.vnodes)
+        self._rr = itertools.count()
+        # routing telemetry (served at GET /fleet)
+        self.picks = 0
+        self.affinity_hits = 0       # primary target taken
+        self.spills = 0              # ring-walk past a saturated node
+        self.scored_fallbacks = 0    # every node saturated
+
+    # -- membership (FleetManager: activate/drain) ----------------------
+    def set_replicas(self, replica_ids: Sequence[str]) -> None:
+        want = set(replica_ids)
+        for rid in list(self.ring.nodes()):
+            if rid not in want:
+                self.ring.remove(rid)
+        for rid in want:
+            self.ring.add(rid)
+
+    # -- scoring --------------------------------------------------------
+    def score(self, snap: ReplicaSnapshot, inflight: int) -> float:
+        """Lower is better. Documented in BENCH_CORE.md ("Serving
+        fleet anatomy"): occupancy dominates (pages are the binding
+        constraint), engine queue depth next, then the router's own
+        not-yet-visible in-flight count."""
+        c = self.config
+        return (c.w_occupancy * snap.kv_occupancy
+                + c.w_waiting * (snap.waiting + snap.active * 0.25)
+                + c.w_inflight * inflight)
+
+    def _saturated(self, snap: ReplicaSnapshot, inflight: int) -> bool:
+        c = self.config
+        return (snap.kv_occupancy >= c.spill_occupancy
+                or snap.waiting + inflight >= c.spill_waiting)
+
+    # -- the pick -------------------------------------------------------
+    def pick(self, fingerprint: str,
+             snapshots: Dict[str, ReplicaSnapshot],
+             inflight: Dict[str, int]) -> Optional[str]:
+        """Choose a replica for a request with this prefix
+        fingerprint. None only when the ring is empty."""
+        nodes = self.ring.nodes()
+        if not nodes:
+            return None
+        self.picks += 1
+        if self.config.policy == "round_robin":
+            # skip the ring walk entirely: preferred() hashes the key
+            # and walks up to vnodes*replicas points for an ordering
+            # round-robin would discard
+            return nodes[next(self._rr) % len(nodes)]
+        order = self.ring.preferred(fingerprint)
+
+        def _snap(rid: str) -> ReplicaSnapshot:
+            return snapshots.get(rid) or ReplicaSnapshot(replica=rid)
+
+        for rank, rid in enumerate(order):
+            if not self._saturated(_snap(rid), inflight.get(rid, 0)):
+                if rank == 0:
+                    self.affinity_hits += 1
+                else:
+                    self.spills += 1
+                return rid
+        # every replica saturated: degrade gracefully to pure load
+        self.scored_fallbacks += 1
+        return min(order, key=lambda rid: self.score(
+            _snap(rid), inflight.get(rid, 0)))
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "policy": self.config.policy,
+            "replicas": self.ring.nodes(),
+            "picks": self.picks,
+            "affinity_hits": self.affinity_hits,
+            "spills": self.spills,
+            "scored_fallbacks": self.scored_fallbacks,
+        }
+
+
+__all__ = ["FleetRouter", "RouterConfig", "ReplicaSnapshot", "HashRing",
+           "prefix_fingerprint"]

@@ -180,7 +180,13 @@ def _esc_label(v: str) -> str:
 
 
 def _fmt_tags(tags: Dict[str, str], extra: str = "") -> str:
-    parts = [f'{k}="{_esc_label(v)}"' for k, v in tags.items()]
+    # empty-valued labels are omitted: in the Prometheus data model a
+    # label set to "" IS the label being absent, so rendering it would
+    # only add noise — and lets optional tag keys (e.g. the LLM
+    # telemetry's `replica`, empty outside fleets) stay invisible
+    # until something sets them
+    parts = [f'{k}="{_esc_label(v)}"' for k, v in tags.items()
+             if str(v) != ""]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -258,6 +264,44 @@ def merge_expositions(texts: Sequence[str]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def relabel_exposition(text: str, tags: Dict[str, str]) -> str:
+    """Inject labels into every sample of a Prometheus text
+    exposition, returning a new document. A label already present
+    with a NON-empty value wins (the series owner knew better);
+    absent or empty labels are (re)written.
+
+    This is the multi-replica scrape primitive (ISSUE 6 satellite):
+    replicas in separate processes render identical series from their
+    own registries, so the fleet proxy relabels each scrape with
+    `replica="<id>"` before merge_expositions — otherwise the merged
+    document would either collide (duplicate series, a Prometheus
+    parse error) or silently attribute one replica's counts to
+    another. Comment/header lines pass through untouched."""
+    import re as _re
+
+    label_re = _re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?"
+                      r"( .+)$", line)
+        if m is None:
+            out.append(line)
+            continue
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        present = dict(label_re.findall(labels))
+        parts = [f'{k}="{v}"' for k, v in label_re.findall(labels)
+                 if v != ""]
+        for k, v in tags.items():
+            if present.get(k, "") == "":
+                parts.append(f'{k}="{_esc_label(v)}"')
+        out.append(name + ("{" + ",".join(parts) + "}" if parts else "")
+                   + value)
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
 def snapshot() -> Dict[str, object]:
     """JSON-able snapshot of this process's registry."""
     out = {}
@@ -298,5 +342,5 @@ def collect_cluster() -> Dict[str, object]:
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "export_prometheus",
-           "merge_expositions", "snapshot", "flush_to_kv",
-           "collect_cluster"]
+           "merge_expositions", "relabel_exposition", "snapshot",
+           "flush_to_kv", "collect_cluster"]

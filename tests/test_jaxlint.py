@@ -826,3 +826,18 @@ def test_engine_hot_path_has_zero_baselined_findings():
         assert "llm/_internal/engine.py" not in path
         assert "models/llama_infer.py" not in path
         assert "/ops/" not in path
+
+
+def test_serve_llm_fleet_has_zero_baselined_findings():
+    """ISSUE 6 gate: the new serve/llm fleet package (router,
+    admission, autoscaler, fleet manager, deployment builder) starts
+    life at ZERO baseline entries — it is pure host-side control
+    plane, so any jaxlint finding there is a real bug, not debt."""
+    base = load_baseline(str(REPO / "tools/jaxlint/baseline.json"))
+    for key in base.entries:
+        assert "serve/llm/" not in key.split(":")[1]
+    # and the package is clean with NO baseline at all
+    proc = _cli("ray_tpu/serve/llm")
+    assert proc.returncode == 0, (
+        "jaxlint findings in ray_tpu/serve/llm (zero-entry package):\n"
+        + proc.stdout)

@@ -1,0 +1,1079 @@
+"""Multi-replica serve.llm fleet (ISSUE 6).
+
+Layers under test, cheapest first:
+
+- consistent-hash ring + prompt-prefix fingerprint (pure): the
+  minimal-disruption property under replica add/remove, and chat
+  canonicalization (shared system prompt + history = shared key);
+- FleetRouter: prefix affinity is sticky, spills to the ring
+  successor once the target saturates (KV occupancy / queue depth),
+  and degrades to scored least-load when everything is saturated;
+- AdmissionController: bounded queue, immediate 429 on queue_full,
+  SLO-bounded shed of queued waiters (so EVERY request's queue wait
+  is bounded), weighted fair dequeue across tenants;
+- FleetAutoscaler: hysteresis on sustained breach / sustained idle;
+- fleet /metrics: separate-registry scrapes get a `replica` label
+  injected before the merge (the ISSUE 6 satellite) — identical
+  series from different replicas must neither collide nor sum;
+- serve.status() health detail: the replica metrics poll carries an
+  optional health_detail() payload;
+- end-to-end on TWO real in-process engine replicas (debug model,
+  CPU): same-prefix requests co-locate and hit the prefix cache,
+  overload answers 429 with bounded queue wait, scale-down drains a
+  replica without dropping or corrupting an in-flight stream
+  (token-exact vs a single-replica oracle), and each replica's
+  engine still honors the dispatch contract (1 dispatch/tick, 0 h2d,
+  0 compiles) in steady-state decode afterward.
+
+Everything here is in-process (tier-1); process-spawning fleet tests
+live behind the `slow` marker in this file's tail.
+"""
+
+import asyncio
+import json
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.llm import (AdmissionConfig, AdmissionController,
+                               AdmissionRejected, AutoscaleConfig,
+                               FleetAutoscaler, FleetManager,
+                               FleetMetrics, FleetRouter, HashRing,
+                               LocalReplicaClient, ReplicaSnapshot,
+                               RouterConfig, prefix_fingerprint)
+from ray_tpu.serve.llm.fleet import ACTIVE, DRAINING, STANDBY
+from ray_tpu.util import metrics as metrics_api
+
+
+# ----------------------------------------------------------- hash ring
+
+def _fps(n, salt=""):
+    return [prefix_fingerprint({"prompt": f"{salt}prompt #{i} " * 4})
+            for i in range(n)]
+
+
+def test_ring_walk_covers_each_node_exactly_once():
+    ring = HashRing(vnodes=16)
+    for rid in ("r0", "r1", "r2", "r3"):
+        ring.add(rid)
+    for fp in _fps(50):
+        walk = ring.preferred(fp)
+        assert sorted(walk) == ["r0", "r1", "r2", "r3"]
+        assert len(set(walk)) == 4
+
+
+def test_ring_remove_is_minimal_disruption():
+    """Removing a node only remaps keys it owned; re-adding restores
+    the original assignment exactly (vnode points depend only on node
+    names)."""
+    ring = HashRing(vnodes=32)
+    for rid in ("r0", "r1", "r2"):
+        ring.add(rid)
+    keys = _fps(300)
+    before = {k: ring.preferred(k)[0] for k in keys}
+    ring.remove("r1")
+    after = {k: ring.preferred(k)[0] for k in keys}
+    for k in keys:
+        if before[k] == "r1":
+            assert after[k] in ("r0", "r2")
+        else:
+            assert after[k] == before[k]     # untouched keys stay put
+    assert any(before[k] == "r1" for k in keys)
+    ring.add("r1")
+    assert {k: ring.preferred(k)[0] for k in keys} == before
+
+
+def test_ring_state_is_history_independent():
+    """Property under random add/remove churn: the assignment depends
+    only on the surviving node SET, never on the order of membership
+    events — a rebuilt ring with the same nodes maps every key
+    identically."""
+    rng = np.random.default_rng(42)
+    ring = HashRing(vnodes=16)
+    live = set()
+    pool = [f"n{i}" for i in range(8)]
+    keys = _fps(80)
+    for _ in range(60):
+        rid = pool[rng.integers(len(pool))]
+        if rid in live and rng.random() < 0.5:
+            ring.remove(rid)
+            live.discard(rid)
+        else:
+            ring.add(rid)
+            live.add(rid)
+        if not live:
+            assert ring.preferred(keys[0]) == []
+            continue
+        fresh = HashRing(vnodes=16)
+        for r in sorted(live):
+            fresh.add(r)
+        for k in keys:
+            assert ring.preferred(k) == fresh.preferred(k)
+        assert set(ring.nodes()) == live
+
+
+def test_prefix_fingerprint_prompt_depth():
+    shared = "x" * 300
+    a = prefix_fingerprint({"prompt": shared + "tail A"})
+    b = prefix_fingerprint({"prompt": shared + "completely other"})
+    assert a == b                       # differ only beyond depth=256
+    c = prefix_fingerprint({"prompt": "y" + shared})
+    assert c != a                       # differ inside the prefix
+
+
+def test_prefix_fingerprint_chat_canonicalization():
+    sys_msg = {"role": "system", "content": "You are terse. " * 20}
+    hist = [sys_msg, {"role": "user", "content": "earlier turn"}]
+    a = prefix_fingerprint({"messages": hist + [
+        {"role": "user", "content": "now do A"}]})
+    b = prefix_fingerprint({"messages": hist + [
+        {"role": "user", "content": "now do something else"}]})
+    assert a == b                       # shared system+history wins
+    c = prefix_fingerprint({"messages": [
+        {"role": "system", "content": "You are verbose."}]})
+    assert c != a
+    # role changes inside the window change the key even when the
+    # concatenated text would collide
+    d = prefix_fingerprint({"messages": [
+        {"role": "user", "content": sys_msg["content"]}]})
+    e = prefix_fingerprint({"messages": [
+        {"role": "system", "content": sys_msg["content"]}]})
+    assert d != e
+
+
+# ------------------------------------------------------------- router
+
+def _snap(rid, occ=0.0, waiting=0, active=0):
+    return ReplicaSnapshot(replica=rid, kv_occupancy=occ,
+                           waiting=waiting, active=active)
+
+
+def test_router_affinity_sticky_then_spills_then_scores():
+    r = FleetRouter(RouterConfig(vnodes=16))
+    r.set_replicas(["r0", "r1", "r2"])
+    fp = prefix_fingerprint({"prompt": "the shared prefix " * 20})
+    order = r.ring.preferred(fp)
+    primary, second = order[0], order[1]
+    empty = {rid: _snap(rid) for rid in order}
+    # sticky: same fingerprint, same replica, counted as affinity
+    for _ in range(5):
+        assert r.pick(fp, empty, {}) == primary
+    assert r.affinity_hits == 5 and r.spills == 0
+    # primary saturated by occupancy -> deterministic ring successor
+    sat = dict(empty)
+    sat[primary] = _snap(primary, occ=0.95)
+    for _ in range(3):
+        assert r.pick(fp, sat, {}) == second
+    assert r.spills == 3
+    # saturation by queue depth spills too
+    sat[primary] = _snap(primary, waiting=99)
+    assert r.pick(fp, sat, {}) == second
+    # everything saturated -> least-loaded by score
+    allsat = {rid: _snap(rid, occ=0.99, waiting=10) for rid in order}
+    allsat[order[2]] = _snap(order[2], occ=0.86, waiting=4)
+    assert r.pick(fp, allsat, {}) == order[2]
+    assert r.scored_fallbacks == 1
+
+
+def test_router_inflight_counts_toward_saturation():
+    """The router's own not-yet-visible in-flight count saturates a
+    target before the replica's stats catch up (zero-lag signal)."""
+    cfg = RouterConfig(vnodes=16, spill_waiting=4)
+    r = FleetRouter(cfg)
+    r.set_replicas(["r0", "r1"])
+    fp = prefix_fingerprint({"prompt": "hot prefix " * 30})
+    primary = r.ring.preferred(fp)[0]
+    other = r.ring.preferred(fp)[1]
+    snaps = {rid: _snap(rid) for rid in ("r0", "r1")}
+    assert r.pick(fp, snaps, {primary: 3}) == primary
+    assert r.pick(fp, snaps, {primary: 4}) == other
+
+
+def test_router_round_robin_policy_cycles():
+    r = FleetRouter(RouterConfig(policy="round_robin", vnodes=8))
+    r.set_replicas(["r0", "r1"])
+    fp = prefix_fingerprint({"prompt": "same " * 40})
+    picks = [r.pick(fp, {}, {}) for _ in range(4)]
+    assert sorted(picks[:2]) == ["r0", "r1"]
+    assert picks[:2] == picks[2:]       # cycles, ignores the prefix
+
+
+def test_router_empty_ring_returns_none():
+    r = FleetRouter()
+    assert r.pick("deadbeef", {}, {}) is None
+
+
+# ---------------------------------------------------------- admission
+
+def test_admission_queue_full_rejects_immediately():
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=1, max_queue=1, queue_wait_slo_s=5.0))
+        await adm.acquire("a")                       # dispatched
+        waiter = asyncio.create_task(adm.acquire("b"))
+        await asyncio.sleep(0.01)                    # b is queued
+        with pytest.raises(AdmissionRejected) as ei:
+            await adm.acquire("c")                   # queue is full
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s > 0
+        assert adm.rejected["queue_full"] == 1
+        adm.release()                                # grants b
+        await waiter
+        adm.release()
+        assert adm.stats()["queued"] == 0
+    asyncio.run(main())
+
+
+def test_admission_slo_shed_bounds_every_queue_wait():
+    """A queued request that cannot be granted within the SLO is shed
+    with 429 — its wall-clock wait is bounded by the SLO, not by the
+    backlog ahead of it."""
+    async def main():
+        slo = 0.15
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=1, max_queue=4, queue_wait_slo_s=slo))
+        await adm.acquire("hog")       # never released during the test
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as ei:
+            await adm.acquire("victim")
+        waited = time.monotonic() - t0
+        assert ei.value.reason == "queue_wait_slo"
+        assert slo * 0.5 <= waited <= slo + 0.5
+        assert adm.shed_total == 1
+    asyncio.run(main())
+
+
+def test_admission_weighted_fair_dequeue():
+    """Stride scheduling: tenant A (weight 3) drains ~3x faster than
+    tenant B (weight 1) under contention; B is never starved."""
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=1, max_queue=32, queue_wait_slo_s=30.0,
+            tenant_weights={"A": 3.0, "B": 1.0}))
+        await adm.acquire("hog")
+        grants = []
+
+        async def one(tenant, i):
+            await adm.acquire(tenant)
+            grants.append(tenant)
+
+        tasks = []
+        for i in range(6):
+            tasks.append(asyncio.create_task(one("A", i)))
+        for i in range(2):
+            tasks.append(asyncio.create_task(one("B", i)))
+        await asyncio.sleep(0.02)       # everyone queued
+        for _ in range(8):
+            adm.release()               # grant one; the grantee holds
+            await asyncio.sleep(0.005)
+        await asyncio.gather(*tasks)
+        # vtimes: A at 1/3,2/3,1,4/3,5/3,2 ; B at 1,2 -> A gets 3 of
+        # the first 4 grants, B's first inside the first 4
+        assert grants[:3].count("A") == 3
+        assert "B" in grants[:4]
+        assert grants.count("A") == 6 and grants.count("B") == 2
+    asyncio.run(main())
+
+
+def test_admission_overload_p99_bounded():
+    """Hammer the front door: every request either dispatches, gets
+    queue_full instantly, or is shed by the SLO timer — no request
+    waits unboundedly, and the admitted p99 stays under the SLO."""
+    async def main():
+        slo = 0.25
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=2, max_queue=3, queue_wait_slo_s=slo))
+        done = {"ok": 0, "rejected": 0}
+        waits = []
+
+        async def one(i):
+            t0 = time.monotonic()
+            try:
+                await adm.acquire(f"t{i % 3}")
+            except AdmissionRejected:
+                done["rejected"] += 1
+                waits.append(time.monotonic() - t0)
+                return
+            try:
+                await asyncio.sleep(0.03)
+                done["ok"] += 1
+            finally:
+                waits.append(time.monotonic() - t0)
+                adm.release()
+
+        await asyncio.gather(*(one(i) for i in range(40)))
+        assert done["ok"] + done["rejected"] == 40
+        assert done["rejected"] > 0
+        assert max(waits) <= slo + 0.6          # bounded, incl. sheds
+        assert adm.queue_wait_p99_s() <= slo + 0.05
+    asyncio.run(main())
+
+
+def test_admission_tenant_state_bounded():
+    """The stride scheduler's per-tenant pass dict is keyed by the
+    CLIENT-controlled "user" field: a stream of unique tenant ids
+    (millions of end users, or an attacker) must not accumulate one
+    permanent entry each. Entries at/below the global vtime floor are
+    semantically dead and get pruned."""
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=4, max_queue=4))
+        for i in range(5000):
+            await adm.acquire(f"user-{i}")
+            adm.release()
+        assert len(adm._pass) <= 1025
+    asyncio.run(main())
+
+
+def test_admission_shed_tickets_reaped_under_saturation():
+    """Long-lived streams peg inflight at the cap, so _grant_next's
+    capacity-gated pop never runs: shed tickets must be reaped by the
+    mark-and-compact path instead, or an hour of sustained overload
+    retains every ticket ever shed and admission degrades to O(dead)
+    per call."""
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=2, max_queue=8, queue_wait_slo_s=0.01))
+        await adm.acquire("s0")
+        await adm.acquire("s1")                  # cap pegged
+        for _ in range(30):
+            results = await asyncio.gather(
+                *(adm.acquire(f"u{i}") for i in range(8)),
+                return_exceptions=True)
+            assert all(isinstance(r, AdmissionRejected)
+                       for r in results)
+        assert len(adm._heap) <= 80              # 240 shed, reaped
+        adm.release()
+        adm.release()
+    asyncio.run(main())
+
+
+def test_admission_would_reject_preflight_matches():
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=1, max_queue=1, queue_wait_slo_s=5.0))
+        assert not adm.would_reject()
+        await adm.acquire("a")
+        assert not adm.would_reject()            # queue still empty
+        t = asyncio.create_task(adm.acquire("b"))
+        await asyncio.sleep(0.01)
+        assert adm.would_reject()                # full: next is a 429
+        adm.release()
+        await t
+        adm.release()
+    asyncio.run(main())
+
+
+# --------------------------------------------------------- autoscaler
+
+def test_autoscaler_upscale_needs_sustained_breach():
+    a = FleetAutoscaler(AutoscaleConfig(
+        min_replicas=1, max_replicas=3, upscale_delay_s=3.0,
+        downscale_delay_s=30.0, ttft_high_ms=1000.0))
+    hot = FleetMetrics(ttft_ms=5000.0)
+    assert a.decide(hot, active=1, now=100.0) == 1   # breach starts
+    assert a.decide(hot, active=1, now=101.0) == 1   # not sustained
+    assert a.decide(hot, active=1, now=103.5) == 2   # sustained -> +1
+    # a calm tick resets the breach clock
+    assert a.decide(FleetMetrics(ttft_ms=10.0, occupancy=0.5),
+                    active=2, now=104.0) == 2
+    assert a.decide(hot, active=2, now=105.0) == 2
+    assert a.decide(hot, active=2, now=109.0) == 3
+    assert a.decide(hot, active=3, now=120.0) == 3   # clamped at max
+
+
+def test_autoscaler_shed_is_an_instant_breach_signal():
+    a = FleetAutoscaler(AutoscaleConfig(
+        min_replicas=1, max_replicas=2, upscale_delay_s=1.0))
+    m = FleetMetrics(shed_delta=3)      # front door turned traffic away
+    assert a.decide(m, active=1, now=10.0) == 1
+    assert a.decide(m, active=1, now=11.5) == 2
+
+
+def test_autoscaler_downscale_needs_sustained_idle_and_clamps():
+    a = FleetAutoscaler(AutoscaleConfig(
+        min_replicas=1, max_replicas=3, upscale_delay_s=1.0,
+        downscale_delay_s=10.0, occupancy_low=0.3,
+        queue_wait_low_ms=50.0))
+    idle = FleetMetrics(ttft_ms=5.0, queue_wait_ms=1.0, occupancy=0.05)
+    assert a.decide(idle, active=2, now=0.0) == 2
+    assert a.decide(idle, active=2, now=5.0) == 2
+    assert a.decide(idle, active=2, now=10.5) == 1
+    # at min: stays clamped no matter how idle
+    assert a.decide(idle, active=1, now=50.0) == 1
+    assert a.decide(idle, active=1, now=100.0) == 1
+    # busy-but-not-breached middle ground resets the idle clock
+    mid = FleetMetrics(ttft_ms=5.0, queue_wait_ms=1.0, occupancy=0.6)
+    a2 = FleetAutoscaler(AutoscaleConfig(
+        min_replicas=1, max_replicas=3, downscale_delay_s=1.0))
+    assert a2.decide(idle, active=2, now=0.0) == 2
+    assert a2.decide(mid, active=2, now=0.9) == 2
+    assert a2.decide(idle, active=2, now=1.5) == 2   # clock restarted
+
+
+# ----------------------------------------- fleet /metrics aggregation
+
+def test_relabel_exposition_injects_replica_tag():
+    from ray_tpu.util.metrics import relabel_exposition
+    text = ("# HELP t_total help\n"
+            "# TYPE t_total counter\n"
+            't_total{model="m"} 3\n'
+            "plain_gauge 1.5\n"
+            't_total{model="m",replica="keep"} 9\n')
+    out = relabel_exposition(text, {"replica": "r7"})
+    assert 't_total{model="m",replica="r7"} 3' in out
+    assert 'plain_gauge{replica="r7"} 1.5' in out
+    # a NON-empty existing label wins over the injected one
+    assert 't_total{model="m",replica="keep"} 9' in out
+    # headers untouched
+    assert "# HELP t_total help" in out and "# TYPE t_total counter" in out
+
+
+def test_empty_tag_value_is_omitted_from_exposition():
+    """The Prometheus data model treats label="" as the label being
+    absent — engines outside a fleet leave replica unset and render
+    byte-identically to the pre-fleet format."""
+    name = f"t_fleet_omit_{uuid.uuid4().hex[:8]}"
+    g = metrics_api.Gauge(name, "d", tag_keys=("model", "replica"))
+    g.set(4.0, {"model": "m", "replica": ""})
+    text = metrics_api.export_prometheus()
+    assert f'{name}{{model="m"}} 4.0' in text
+    g.set(5.0, {"model": "m", "replica": "r1"})
+    text = metrics_api.export_prometheus()
+    assert f'{name}{{model="m",replica="r1"}} 5.0' in text
+
+
+class _FakeClient:
+    """Replica stub for fleet plumbing tests: canned fleet_stats /
+    metrics_text / drain with call recording."""
+
+    def __init__(self, replica_id, shares_registry=False,
+                 metrics="", stats=None, drain_delay_s=0.0):
+        self.replica_id = replica_id
+        self.shares_registry = shares_registry
+        self._metrics = metrics
+        self._stats = stats or {}
+        self._drain_delay_s = drain_delay_s
+        self.calls = []
+
+    async def call(self, method, *args):
+        self.calls.append(method)
+        if method == "fleet_stats":
+            return {"replica": self.replica_id, **self._stats}
+        if method == "metrics_text":
+            return self._metrics
+        if method == "drain":
+            await asyncio.sleep(self._drain_delay_s)
+            return {"replica": self.replica_id, "drained": True}
+        raise AttributeError(method)
+
+    def stream(self, method, body):
+        raise NotImplementedError
+
+
+def test_fleet_metrics_text_relabels_separate_registries():
+    """True multi-process fleets: each replica renders the same series
+    names from its OWN registry. The fleet scrape must attribute each
+    to its replica — not collide, not silently sum."""
+    exp = ("# HELP ray_tpu_llm_generated_tokens_total t\n"
+           "# TYPE ray_tpu_llm_generated_tokens_total counter\n"
+           'ray_tpu_llm_generated_tokens_total{model="m"} %d\n')
+
+    async def main():
+        fleet = FleetManager([
+            _FakeClient("r0", metrics=exp % 7),
+            _FakeClient("r1", metrics=exp % 11),
+        ])
+        return await fleet.metrics_text()
+
+    out = asyncio.run(main())
+    assert ('ray_tpu_llm_generated_tokens_total'
+            '{model="m",replica="r0"} 7') in out
+    assert ('ray_tpu_llm_generated_tokens_total'
+            '{model="m",replica="r1"} 11') in out
+    # ONE header pair for the family across both scrapes
+    assert out.count("# TYPE ray_tpu_llm_generated_tokens_total") == 1
+
+
+def test_fleet_metrics_text_shared_registry_renders_once():
+    """In-process replicas share one registry: relabeling would lie
+    (every scrape holds EVERY replica's series already) — the fleet
+    returns one rendering instead of a merged duplicate."""
+    exp = "# HELP x y\n# TYPE x gauge\nx 1\n"
+
+    async def main():
+        fleet = FleetManager([
+            _FakeClient("r0", shares_registry=True, metrics=exp),
+            _FakeClient("r1", shares_registry=True, metrics=exp),
+        ])
+        return await fleet.metrics_text()
+
+    out = asyncio.run(main())
+    assert out.count("x 1") == 1
+    assert "replica=" not in out
+
+
+# ------------------------------------------------ fleet state machine
+
+def test_fleet_apply_target_activates_and_drains():
+    async def main():
+        clients = [_FakeClient(f"r{i}") for i in range(3)]
+        fleet = FleetManager(
+            clients,
+            autoscale=AutoscaleConfig(min_replicas=1, max_replicas=3))
+        assert fleet.replicas["r0"].status == ACTIVE
+        assert fleet.replicas["r1"].status == STANDBY
+        assert fleet.router.ring.nodes() == ["r0"]
+        fleet._apply_target(3)
+        assert [fleet.replicas[f"r{i}"].status for i in range(3)] \
+            == [ACTIVE, ACTIVE, ACTIVE]
+        assert fleet.router.ring.nodes() == ["r0", "r1", "r2"]
+        # scale down: the victim leaves the ring IMMEDIATELY, drains
+        # in the background, parks on standby
+        fleet._apply_target(2)
+        draining = [rid for rid, st in fleet.replicas.items()
+                    if st.status == DRAINING]
+        assert len(draining) == 1
+        assert draining[0] not in fleet.router.ring.nodes()
+        await fleet.replicas[draining[0]].drain_task
+        assert fleet.replicas[draining[0]].status == STANDBY
+        events = [e["event"] for e in fleet._scale_events]
+        assert events.count("activate") == 2
+        assert "drain_begin" in events and "drain_done" in events
+    asyncio.run(main())
+
+
+def test_fleet_drain_waits_for_inflight_streams():
+    async def main():
+        clients = [_FakeClient("r0"), _FakeClient("r1")]
+        fleet = FleetManager(
+            clients,
+            autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2))
+        fleet.replicas["r1"].inflight = 2       # live streams
+        fleet._begin_drain("r1")
+        await asyncio.sleep(0.05)
+        assert fleet.replicas["r1"].status == DRAINING
+        assert "drain" not in clients[1].calls  # still waiting on them
+        fleet.replicas["r1"].inflight = 0
+        await asyncio.wait_for(fleet.replicas["r1"].drain_task, 5)
+        assert fleet.replicas["r1"].status == STANDBY
+        assert "drain" in clients[1].calls      # engine-side drain ran
+        done = [e for e in fleet._scale_events
+                if e["event"] == "drain_done"]
+        assert done and done[0]["clean"] is True
+    asyncio.run(main())
+
+
+def test_fleet_window_metrics_are_deltas_not_lifetime():
+    """The autoscaler input is the RECENT window: a fleet that was
+    slow an hour ago but fast now must read as fast now."""
+    async def main():
+        slow = {"slo_totals": {"ttft_s": 50.0, "ttft_n": 10.0,
+                               "queue_s": 5.0, "queue_n": 10.0}}
+        c = _FakeClient("r0", stats=slow)
+        fleet = FleetManager(
+            [c], autoscale=AutoscaleConfig(min_replicas=1,
+                                           max_replicas=1))
+        await fleet.refresh()
+        m1 = fleet._window_metrics()
+        assert m1.ttft_ms == pytest.approx(5000.0)
+        # next window: 10 more requests at 10ms TTFT each
+        c._stats = {"slo_totals": {"ttft_s": 50.1, "ttft_n": 20.0,
+                                   "queue_s": 5.0, "queue_n": 10.0}}
+        await fleet.refresh()
+        m2 = fleet._window_metrics()
+        assert m2.ttft_ms == pytest.approx(10.0, abs=1e-6)
+        assert m2.queue_wait_ms == 0.0          # no new observations
+    asyncio.run(main())
+
+
+def test_fleet_window_metrics_survive_membership_changes():
+    """Deltas are per replica id, not a diff of the fleet sum over the
+    changing ACTIVE set: a replica parking to STANDBY must not read as
+    a negative window (masking a real breach on the survivor), and a
+    reactivated replica must contribute only growth since last seen —
+    not its lifetime totals as one spurious breach window."""
+    async def main():
+        c0 = _FakeClient("r0", stats={"slo_totals": {
+            "ttft_s": 1.0, "ttft_n": 10.0,
+            "queue_s": 0.0, "queue_n": 10.0}})
+        c1 = _FakeClient("r1", stats={"slo_totals": {
+            "ttft_s": 40.0, "ttft_n": 20.0,
+            "queue_s": 0.0, "queue_n": 20.0}})
+        fleet = FleetManager(
+            [c0, c1], autoscale=AutoscaleConfig(min_replicas=1,
+                                                max_replicas=2))
+        fleet.replicas["r1"].status = "ACTIVE"
+        await fleet.refresh()
+        fleet._window_metrics()                  # baseline window
+
+        # r1 parks; r0 alone serves 10 slow requests (500ms TTFT).
+        # With fleet-sum deltas the vanished r1 totals would swamp the
+        # window negative and report 0.0 — the breach must survive.
+        fleet.replicas["r1"].status = "STANDBY"
+        c0._stats = {"slo_totals": {"ttft_s": 6.0, "ttft_n": 20.0,
+                                    "queue_s": 0.0, "queue_n": 20.0}}
+        await fleet.refresh()
+        m = fleet._window_metrics()
+        assert m.ttft_ms == pytest.approx(500.0)
+
+        # r1 reactivates with unchanged lifetime totals: its history
+        # must NOT re-enter as one giant window (fleet-sum deltas
+        # would report (40s + r0 growth) / (20 + n) here)
+        fleet.replicas["r1"].status = "ACTIVE"
+        c0._stats = {"slo_totals": {"ttft_s": 6.1, "ttft_n": 30.0,
+                                    "queue_s": 0.0, "queue_n": 30.0}}
+        await fleet.refresh()
+        m = fleet._window_metrics()
+        assert m.ttft_ms == pytest.approx(10.0, abs=1e-6)
+    asyncio.run(main())
+
+
+# ------------------------------------- serve.status() health detail
+
+def test_replica_metrics_surfaces_health_detail():
+    """The controller's existing metrics poll piggybacks an optional
+    health_detail() hook (sync or async); a broken hook never fails
+    the probe."""
+    from ray_tpu._private.serialization import serialize_code
+    from ray_tpu.serve._private.replica import Replica
+    from ray_tpu.serve._private.serialization_helpers import \
+        serialize_args
+
+    class WithDetail:
+        async def health_detail(self):
+            return {"waiting": 3, "kv_occupancy": 0.25}
+
+        def __call__(self):
+            return "ok"
+
+    class WithBrokenDetail:
+        def health_detail(self):
+            raise RuntimeError("boom")
+
+        def __call__(self):
+            return "ok"
+
+    class NoDetail:
+        def __call__(self):
+            return "ok"
+
+    def build(cls):
+        return Replica("app#d", "rid", serialize_code(cls),
+                       serialize_args((), {}))
+
+    async def main():
+        m = await build(WithDetail).metrics()
+        assert m["detail"] == {"waiting": 3, "kv_occupancy": 0.25}
+        m = await build(WithBrokenDetail).metrics()
+        assert "detail" not in m                # best-effort, no raise
+        m = await build(NoDetail).metrics()
+        assert "detail" not in m
+        assert {"ongoing", "total", "qps_10s"} <= set(m)
+    asyncio.run(main())
+
+
+def test_llm_server_health_detail_shape(fleet_servers):
+    srv = fleet_servers["r0"]
+
+    async def main():
+        return await srv.health_detail()
+
+    d = asyncio.run(main())
+    assert d["replica"] == "r0"
+    assert {"active", "waiting", "kv_occupancy", "free_pages",
+            "last_tick_age_s", "cache_hit_rate"} <= set(d)
+    assert "slo_totals" not in d                # detail stays compact
+
+
+# --------------------------------------------- e2e: real 2-replica fleet
+
+_fleet_state = {}
+
+
+def _make_server(rid, tag):
+    from ray_tpu.llm._internal.server import LLMServerImpl
+    return LLMServerImpl({
+        "model_id": "m", "model_source": "debug",
+        "engine_kwargs": dict(
+            max_batch_size=4, page_size=8, num_pages=128, seed=7,
+            prefill_buckets=(16, 32, 64), max_prefill_tokens=32,
+            metrics_model_id=tag, metrics_replica_id=rid),
+    })
+
+
+@pytest.fixture(scope="module")
+def fleet_servers():
+    """Two real engine replicas (debug model, CPU) shared across the
+    e2e tests — engine construction and shape-bucket compiles are the
+    expensive part, the tests themselves reuse the warm engines."""
+    if "servers" not in _fleet_state:
+        tag = f"fleet{uuid.uuid4().hex[:8]}"
+        _fleet_state["tag"] = tag
+        _fleet_state["servers"] = {
+            rid: _make_server(rid, tag) for rid in ("r0", "r1")}
+    return _fleet_state["servers"]
+
+
+def _cancel_pumps(servers):
+    """End-of-test hygiene: the engine pump task belongs to the test's
+    asyncio.run loop — cancel it before the loop closes so teardown
+    doesn't warn about destroyed pending tasks (each test's first
+    request re-creates the pump on its own loop)."""
+    for srv in servers.values():
+        if srv._pump is not None:
+            srv._pump.cancel()
+
+
+def _fleet_over(servers, **over):
+    kw = dict(
+        router=RouterConfig(prefix_depth=64, spill_waiting=16),
+        admission=AdmissionConfig(max_concurrent=8, max_queue=16,
+                                  queue_wait_slo_s=30.0),
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2))
+    kw.update(over)
+    return FleetManager(
+        [LocalReplicaClient(rid, srv) for rid, srv in servers.items()],
+        **kw)
+
+
+# 64-char shared prefixes (= prefix_depth and a multiple of
+# page_size=8, so followers share the leading prompt pages exactly)
+_PREFIX_A = ("alpha context block that the whole tenant shares " +
+             "a" * 14)[:64]
+_PREFIX_B = ("bravo context block that another tenant shares " +
+             "b" * 16)[:64]
+
+
+def test_e2e_prefix_affinity_colocates_and_hits_cache(fleet_servers):
+    """Same-prefix requests land on the same replica while distinct
+    prefixes may split — and the co-located followers actually HIT
+    the affine replica's prefix cache (the gauge the router's policy
+    exists to maximize)."""
+    fleet = _fleet_over(fleet_servers)
+
+    async def group(prefix, n):
+        picked = set()
+        for i in range(n):
+            body = {"prompt": prefix + f" req {i}", "max_tokens": 2}
+            before = {rid: st.requests_total
+                      for rid, st in fleet.replicas.items()}
+            out = await fleet.dispatch("completions", body)
+            assert out["choices"][0]["finish_reason"] is not None
+            after = {rid: st.requests_total
+                     for rid, st in fleet.replicas.items()}
+            picked.update(rid for rid in after
+                          if after[rid] != before[rid])
+        return picked
+
+    async def main():
+        hit0 = {rid: srv.engine.allocator.cache_hit_rate
+                for rid, srv in fleet_servers.items()}
+        a = await group(_PREFIX_A, 3)
+        b = await group(_PREFIX_B, 3)
+        _cancel_pumps(fleet_servers)
+        return a, b, hit0
+
+    a, b, hit0 = asyncio.run(main())
+    assert len(a) == 1, f"group A sprayed across {a}"
+    assert len(b) == 1, f"group B sprayed across {b}"
+    st = fleet.router.stats()
+    assert st["picks"] == 6 and st["affinity_hits"] == 6
+    assert st["spills"] == 0 and st["scored_fallbacks"] == 0
+    # followers 2..n of each group hit their replica's prefix cache
+    for rid in a | b:
+        eng = fleet_servers[rid].engine
+        assert eng.allocator.cache_hit_rate > hit0.get(rid, 0.0), (
+            f"no prefix-cache hits on affine replica {rid}")
+
+
+def test_e2e_fleet_stats_and_status_surface(fleet_servers):
+    fleet = _fleet_over(fleet_servers)
+
+    async def main():
+        await fleet.refresh()
+        return await fleet.status(), await fleet.metrics_text()
+
+    status, mtext = asyncio.run(main())
+    for rid in ("r0", "r1"):
+        row = status["replicas"][rid]
+        assert row["status"] == ACTIVE
+        assert {"active", "waiting", "kv_occupancy", "free_pages",
+                "prefix_cache_hit_rate",
+                "last_tick_age_s"} <= set(row)
+    assert status["autoscale"]["active"] == 2
+    # in-process replicas share the registry: one clean exposition
+    tag = _fleet_state["tag"]
+    assert f'model="{tag}"' in mtext
+    assert mtext.count("# TYPE ray_tpu_llm_ttft_seconds histogram") == 1
+
+
+def test_e2e_overload_429_with_bounded_wait(fleet_servers):
+    """16 concurrent requests against max_concurrent=1/max_queue=1:
+    the surplus gets an immediate 429 (queue_full) or an SLO-bounded
+    shed — nobody waits unboundedly, admitted work completes."""
+    fleet = _fleet_over(
+        fleet_servers,
+        admission=AdmissionConfig(max_concurrent=1, max_queue=1,
+                                  queue_wait_slo_s=8.0))
+
+    async def main():
+        results = await asyncio.gather(
+            *(fleet.dispatch(
+                "completions",
+                {"prompt": f"overload probe {i}", "max_tokens": 2})
+              for i in range(16)),
+            return_exceptions=True)
+        _cancel_pumps(fleet_servers)
+        return results
+
+    t0 = time.monotonic()
+    results = asyncio.run(main())
+    elapsed = time.monotonic() - t0
+    ok = [r for r in results if isinstance(r, dict)]
+    rejected = [r for r in results if isinstance(r, AdmissionRejected)]
+    other = [r for r in results
+             if not isinstance(r, (dict, AdmissionRejected))]
+    assert not other, other
+    assert len(ok) + len(rejected) == 16
+    assert len(rejected) >= 10          # the burst visibly sheds
+    for r in rejected:
+        assert r.retry_after_s > 0      # Retry-After hint populated
+    assert len(ok) >= 1                 # admitted work completed
+    adm = fleet.admission.stats()
+    assert adm["rejected"]["queue_full"] >= 10
+    # bounded: admitted queue waits obey the SLO; the whole burst
+    # resolves in bounded time instead of queueing 16-deep
+    assert adm["queue_wait_p99_s"] <= 8.0 + 0.5
+    assert elapsed < 60.0
+
+
+def test_e2e_scale_down_drains_streams_token_exact(fleet_servers):
+    """Scale-down mid-stream: the victim leaves the ring, its live
+    SSE streams run to completion, and every stream's text is
+    token-exact vs a single-replica oracle — drain never drops or
+    corrupts in-flight work."""
+    fleet = _fleet_over(fleet_servers)
+    gen_tokens = 12
+    # choose prompts that provably put TWO live streams on EACH
+    # replica (the ring is deterministic), so the drain victim —
+    # whichever replica it is — has work on the wire
+    by_rid = {rid: [] for rid in fleet.replicas}
+    i = 0
+    while any(len(v) < 2 for v in by_rid.values()):
+        p = f"drain stream probe {i}"
+        rid = fleet.router.ring.preferred(
+            prefix_fingerprint({"prompt": p}, 64))[0]
+        if len(by_rid[rid]) < 2:
+            by_rid[rid].append(p)
+        i += 1
+    prompts = [p for group in by_rid.values() for p in group]
+
+    async def consume(body, started):
+        chunks = []
+        async for chunk in fleet.dispatch_stream(
+                "completions_stream", body):
+            chunks.append(chunk)
+            if len(chunks) == 1:
+                started.set_result(None)
+        return chunks
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        started = [loop.create_future() for _ in prompts]
+        tasks = [
+            asyncio.create_task(consume(
+                {"prompt": p, "max_tokens": gen_tokens}, started[i]))
+            for i, p in enumerate(prompts)]
+        await asyncio.wait_for(asyncio.gather(*started), 120)
+        # every stream is live on the wire: drop to ONE replica
+        fleet._apply_target(1)
+        draining = [rid for rid, st in fleet.replicas.items()
+                    if st.status == DRAINING]
+        assert len(draining) == 1
+        assert fleet.router.ring.nodes() != []
+        all_chunks = await asyncio.wait_for(asyncio.gather(*tasks), 120)
+        # a post-drain request still works (routes to the survivor)
+        out = await fleet.dispatch(
+            "completions", {"prompt": "after drain", "max_tokens": 2})
+        assert out["choices"][0]["finish_reason"] is not None
+        await asyncio.wait_for(
+            fleet.replicas[draining[0]].drain_task, 60)
+        _cancel_pumps(fleet_servers)
+        return draining[0], all_chunks
+
+    victim, all_chunks = asyncio.run(main())
+    assert fleet.replicas[victim].status == STANDBY
+    done = [e for e in fleet._scale_events if e["event"] == "drain_done"]
+    assert done and done[-1]["clean"] is True
+
+    def sse_text(chunks):
+        text = ""
+        finishes = 0
+        for c in chunks:
+            payload = c[len("data: "):].strip()
+            if payload == "[DONE]":
+                continue
+            d = json.loads(payload)
+            text += d["choices"][0]["text"]
+            finishes += d["choices"][0]["finish_reason"] is not None
+        assert finishes == 1            # exactly one finish per stream
+        return text
+
+    # oracle: a fresh single replica with the same seed (greedy decode
+    # is batching- and fleet-independent)
+    oracle = _make_server("oracle", f"oracle{uuid.uuid4().hex[:6]}")
+
+    async def oracle_text(p):
+        out = await oracle.completions(
+            {"prompt": p, "max_tokens": gen_tokens})
+        return out["choices"][0]["text"]
+
+    async def oracle_main():
+        texts = []
+        for p in prompts:
+            texts.append(await oracle_text(p))
+        _cancel_pumps({"oracle": oracle})
+        return texts
+
+    want = asyncio.run(oracle_main())
+    got = [sse_text(c) for c in all_chunks]
+    assert got == want, "drain corrupted an in-flight stream"
+
+
+def test_e2e_dispatch_discipline_holds_per_replica(fleet_servers):
+    """After fleet traffic, each replica's engine still honors the
+    dispatch contract in steady-state decode: 16 consecutive ticks =
+    16 dispatches, zero h2d transfers, zero new compiled programs
+    under the armed runtime guard."""
+    from ray_tpu.llm._internal.engine import Request, SamplingParams
+    from ray_tpu.util.jax_guard import dispatch_guard
+
+    rng = np.random.default_rng(3)
+    for rid, srv in fleet_servers.items():
+        eng = srv.engine
+        assert not eng.has_work(), f"{rid} left work behind"
+        rids = []
+        for i in range(2):
+            r = f"guard-{rid}-{i}"
+            rids.append(r)
+            eng.add_request(Request(
+                r, rng.integers(2, 250, 12).tolist(),
+                SamplingParams(max_tokens=64)))
+        while eng.waiting or any(s.request is not None and not s.ready
+                                 for s in eng.slots):
+            eng.step()
+        for _ in range(4):
+            eng.step()                  # settle the pipeline
+        comp0 = eng.stats()["jit_cache"]["compiled_programs"]
+        disp0 = eng.dispatches
+        # the guard RAISES at any h2d transfer site, so 16 clean ticks
+        # prove 0 uploads; the sentinel counts XLA builds
+        with dispatch_guard() as rep:
+            for _ in range(16):
+                eng.step()
+        assert eng.dispatches - disp0 == 16, rid
+        assert rep.n_compiles == 0, rid
+        assert eng.stats()["jit_cache"]["compiled_programs"] == comp0
+        for r in rids:
+            eng.abort(r)
+        while eng.has_work():           # deliver pending folds
+            eng.step()
+
+
+# --------------------------------- e2e: fleet app through serve.run
+
+def test_fleet_app_local_testing_mode(fleet_servers):
+    """The full wiring — FleetConfig -> build_llm_fleet_app ->
+    serve.run(local_testing_mode=True) -> ingress __call__ — serves
+    completions, /fleet, and /metrics through deployment handles
+    (in-process replicas, shared-registry scrape path)."""
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.serve._private.proxy import Request
+    from ray_tpu.serve.llm import FleetConfig, build_llm_fleet_app
+
+    tag = f"fleetapp{uuid.uuid4().hex[:8]}"
+    app = build_llm_fleet_app(FleetConfig(
+        llm_config=LLMConfig(
+            model_id="mf", model_source="debug",
+            engine_kwargs=dict(max_batch_size=4, page_size=8,
+                               num_pages=96, seed=7,
+                               prefill_buckets=(16, 32),
+                               metrics_model_id=tag)),
+        min_replicas=2, max_replicas=2,
+        admission=AdmissionConfig(max_concurrent=4, max_queue=8)))
+    try:
+        h = serve.run(app, name="fleet-local", local_testing_mode=True)
+
+        def req(method, path, body=b""):
+            return Request(method, path, {}, {}, body)
+
+        out = h.remote(req(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": "hello fleet",
+                        "max_tokens": 3}).encode())).result(
+                timeout_s=180)
+        assert out["object"] == "text_completion"
+        assert out["choices"][0]["finish_reason"] is not None
+
+        models = h.remote(req("GET", "/v1/models")).result(timeout_s=30)
+        assert models["data"][0]["id"] == "mf"
+
+        fl = h.remote(req("GET", "/fleet")).result(timeout_s=30)
+        assert set(fl["replicas"]) == {"r0", "r1"}
+        assert fl["admission"]["admitted"] >= 1
+        assert fl["autoscale"]["active"] == 2
+
+        m = h.remote(req("GET", "/metrics")).result(timeout_s=30)
+        assert m.status == 200
+        assert f'model="{tag}"' in m.body
+
+        missing = h.remote(req("GET", "/no/such")).result(timeout_s=30)
+        assert missing.status == 404
+
+        bad = h.remote(req(
+            "POST", "/v1/completions",
+            json.dumps({"model": "nope", "prompt": "x"}).encode())
+        ).result(timeout_s=30)
+        assert bad.status == 404
+    finally:
+        serve.shutdown()
+
+
+# ----------------------------------- process-spawning (slow) coverage
+
+@pytest.mark.slow
+def test_serve_status_replica_details_llm(ray_start):
+    """Real controller path: serve.status() surfaces each LLM
+    replica's health_detail (queue depth, KV occupancy, last-tick
+    age) collected on the controller's metrics poll. Process-spawning
+    and poll-cadence bound -> slow tier."""
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, build_llm_deployment
+
+    app = build_llm_deployment(LLMConfig(
+        model_id="m0", model_source="debug",
+        engine_kwargs=dict(max_batch_size=4, page_size=8,
+                           num_pages=96, prefill_buckets=(16, 32)),
+        deployment_config=dict(health_check_period_s=0.5)))
+    try:
+        serve.run(app, name="llm-status", _start_http=False,
+                  timeout_s=180)
+        deadline = time.time() + 60
+        details = {}
+        while time.time() < deadline:
+            st = serve.status()
+            dep = st["applications"]["llm-status"]["deployments"]
+            details = next(iter(dep.values()))["replica_details"]
+            if details:
+                break
+            time.sleep(0.5)
+        assert details, "no replica_details after 60s of polling"
+        row = next(iter(details.values()))
+        assert {"waiting", "kv_occupancy", "last_tick_age_s",
+                "active"} <= set(row)
+    finally:
+        serve.shutdown()
