@@ -817,6 +817,121 @@ def bench_fleet(on_tpu: bool) -> dict:
     }
 
 
+def bench_fleet_tracing(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 7 gate, two halves. Correctness: fleet serving with
+    distributed tracing + the SLO watchdog on actually produces the
+    observability goods — every request's ingress spans land in the
+    trace buffer, the replica's lifecycle timeline carries the SAME
+    trace id, and the watchdog consumed the replicas' totals.
+    Overhead: the identical workload with enable_tracing=False and
+    the watchdog disabled is the baseline — trace minting is a few
+    dict ops per request at ingress and the watchdog runs on the
+    control loop, not the request path, so the instrumented run must
+    not be slower beyond timer noise (the dispatch-guard suite
+    separately proves zero transfers / compiles). In --smoke mode
+    both halves assert."""
+    import asyncio
+    import uuid
+
+    from ray_tpu.llm._internal.server import LLMServerImpl
+    from ray_tpu.serve.llm import (AdmissionConfig, AutoscaleConfig,
+                                   FleetManager, LocalReplicaClient,
+                                   RouterConfig, WatchdogConfig,
+                                   merge_fleet_traces)
+    from ray_tpu.models import llama
+
+    if on_tpu and not smoke:
+        cfg = _tpu_bench_model()
+        n_req, rounds, gen, pages, batch = 8, 6, 32, 512, 8
+    else:
+        cfg = llama.config("debug")
+        n_req, rounds, gen, pages, batch = 4, 4, 12, 128, 4
+
+    def run(enable_tracing):
+        tag = f"trace{uuid.uuid4().hex[:8]}"
+        servers = {"r0": LLMServerImpl({
+            "model_id": "bench", "model_source": cfg,
+            "engine_kwargs": dict(
+                max_batch_size=batch, page_size=8, num_pages=pages,
+                seed=7, metrics_model_id=tag,
+                metrics_replica_id="r0"),
+        })}
+        fleet = FleetManager(
+            [LocalReplicaClient(rid, srv)
+             for rid, srv in servers.items()],
+            router=RouterConfig(prefix_depth=64),
+            admission=AdmissionConfig(max_concurrent=64,
+                                      max_queue=128,
+                                      queue_wait_slo_s=60.0),
+            autoscale=AutoscaleConfig(min_replicas=1, max_replicas=1),
+            watchdog=WatchdogConfig(enabled=enable_tracing),
+            enable_tracing=enable_tracing)
+
+        async def drive():
+            toks = 0
+            for r in range(rounds):
+                outs = await asyncio.gather(*(
+                    fleet.dispatch("completions", {
+                        "prompt": f"trace bench {i} round {r}",
+                        "max_tokens": gen})
+                    for i in range(n_req)))
+                toks += sum(o["usage"]["completion_tokens"]
+                            for o in outs)
+            for srv in servers.values():
+                if srv._pump is not None:
+                    srv._pump.cancel()
+            return toks
+
+        asyncio.run(drive())                 # warmup: compiles
+        t0 = time.perf_counter()
+        toks = asyncio.run(drive())
+        dt = time.perf_counter() - t0
+        if enable_tracing:
+            # watchdog exercise rides the CONTROL loop in prod
+            # (refresh cadence), not the request path — one tick
+            # OUTSIDE the timed window proves the wiring without
+            # biasing the overhead A/B against its own gate
+            asyncio.run(fleet.autoscale_tick(now=0.0))
+        return ({"tokens_per_sec": round(toks / dt, 1)},
+                fleet, servers)
+
+    on_row, fleet_on, servers_on = run(True)
+    off_row, fleet_off, _ = run(False)
+
+    # correctness half: the traced fleet produced the goods
+    doc = merge_fleet_traces(
+        {"r0": servers_on["r0"].engine.chrome_trace()},
+        fleet_on.trace)
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    ingress_tids = {e["args"]["trace_id"] for e in evs
+                    if e["name"] == "fleet_request"}
+    replica_tids = {e["args"]["trace_id"] for e in evs
+                    if e["name"] == "decode"
+                    and "trace_id" in e["args"]}
+    res = {
+        "tracing_on": on_row, "tracing_off": off_row,
+        "overhead_ratio": round(
+            on_row["tokens_per_sec"]
+            / max(off_row["tokens_per_sec"], 1e-9), 3),
+        "ingress_spans": fleet_on.trace.stats()["total"],
+        "traced_requests": len(ingress_tids),
+        "trace_ids_joined": len(replica_tids & ingress_tids),
+        "watchdog_observed": bool(fleet_on.watchdog.last),
+        "untraced_buffer": fleet_off.trace.stats()["total"],
+    }
+    if smoke:
+        assert res["ingress_spans"] > 0, res
+        assert res["traced_requests"] == 2 * rounds * n_req, res
+        assert res["trace_ids_joined"] > 0, (
+            "no replica lifecycle joined an ingress trace id")
+        assert res["watchdog_observed"], res
+        assert res["untraced_buffer"] == 0, res
+        # tripwire with slack for CI timer noise: ingress-side dict
+        # ops must never make serving materially slower
+        assert res["overhead_ratio"] >= 0.8, res
+    return res
+
+
 def main() -> None:
     import sys
     dev = jax.devices()[0]
@@ -829,13 +944,15 @@ def main() -> None:
         kernel = bench_kernel_tick(on_tpu)
         async_ab = bench_async_ab(on_tpu, smoke=True)
         telemetry = bench_telemetry(on_tpu, smoke=True)
+        fleet_tracing = bench_fleet_tracing(on_tpu, smoke=True)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
             "unit": "tokens_per_sec",
             "detail": {**mixed, "kernel_tick": kernel,
                        "async_readback_ab": async_ab,
-                       "telemetry": telemetry},
+                       "telemetry": telemetry,
+                       "fleet_tracing": fleet_tracing},
         }))
         return
     if "--fleet" in sys.argv:

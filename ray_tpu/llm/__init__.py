@@ -9,13 +9,14 @@ Observability (ISSUE 5; details: BENCH_CORE.md "Observability
 anatomy"): the router serves `GET /metrics` (Prometheus text),
 `GET /stats` (JSON incl. tick-pipeline + request SLO summaries),
 `GET /debug/trace` (Chrome-trace request lifecycles),
-`GET /debug/events` (engine flight recorder) and
-`POST /debug/profile` (jax.profiler capture of the next N ticks).
+`GET /debug/events` (engine flight recorder),
+`POST /debug/profile` (jax.profiler capture of the next N ticks) and
+`POST /debug/dump` (postmortem black-box bundle, ISSUE 7).
 All series carry a `model` tag (and a `replica` tag in fleets).
 
-Fleet endpoints (ISSUE 6; `ray_tpu.serve.llm` — the multi-replica
+Fleet endpoints (ISSUE 6/7; `ray_tpu.serve.llm` — the multi-replica
 ingress from `build_llm_fleet_app`, details: BENCH_CORE.md "Serving
-fleet anatomy"):
+fleet anatomy" + "Fleet observability anatomy"):
 
     endpoint                    payload
     POST /v1/chat/completions   unary or SSE; 429 + Retry-After on overload
@@ -23,12 +24,32 @@ fleet anatomy"):
     GET  /v1/models             the fleet's model (+ live adapters)
     GET  /fleet                 per-replica routing inputs (status, inflight,
                                 KV occupancy, queue depth, last-tick age),
-                                router/admission counters, autoscale events
+                                router/admission counters, watchdog burn
+                                state, autoscale events
     GET  /stats                 per-replica engine stats + fleet status
     GET  /metrics               ONE Prometheus exposition for the fleet,
                                 series tagged `replica` per engine
     GET  /debug/events          per-replica flight recorders
     GET  /debug/trace           merged Chrome-trace request lifecycles
+    GET  /fleet/debug/trace     time-aligned fleet trace: ingress spans +
+                                every replica's lifecycles with Perfetto
+                                flow arrows; ?request_id= / ?trace_id=
+                                narrow to one request
+    GET  /fleet/debug/events    ONE time-ordered event stream merging all
+                                replicas' flight recorders + the ingress's
+                                (slo_alert, brownout, dumps); ?request_id=
+    GET  /fleet/debug/bundles   list every replica's black-box spool;
+                                ?replica=&id= fetches one bundle
+    POST /debug/dump            snapshot a postmortem bundle per replica
+
+ISSUE 7 fleet-scoped metric additions (ingress registry):
+
+    name                                    type       notes
+    ray_tpu_llm_slo_burn_rate               gauge      + `slo` (ttft|queue_wait|e2e)
+                                                       and `window` (short|long) tags;
+                                                       1.0 = spending the error budget
+                                                       exactly at the allowed rate
+    ray_tpu_llm_slo_alerts_total            counter    watchdog page transitions, + `slo`
 
 Single-replica metric catalogue:
 

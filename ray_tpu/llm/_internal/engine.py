@@ -33,6 +33,7 @@ import collections
 import dataclasses
 import functools
 import itertools
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -160,6 +161,21 @@ class EngineConfig:
     # leave it unset and the label is omitted from the exposition, so
     # single-replica scrapes keep the pre-fleet series identity.
     metrics_replica_id: Optional[str] = None
+    # Per-request SLO targets in seconds (ISSUE 7): {"ttft", "queue_wait",
+    # "e2e"} — observations over target count into the *_bad monotone
+    # totals of telemetry.slo_totals(), which the fleet burn-rate
+    # watchdog (serve/llm/watchdog.py) windows into burn rates. None
+    # keeps telemetry.DEFAULT_SLO_TARGETS.
+    slo_targets: Optional[Dict[str, float]] = None
+    # Postmortem black-box bundles (ISSUE 7): on a guard violation or
+    # mid-tick crash the engine snapshots its flight recorder, recent
+    # tick times, metric exposition, config, and in-flight request
+    # states to a bounded on-disk spool (blackbox.py; also on demand
+    # via POST /debug/dump). Host-side file IO on FAILURE paths only —
+    # a healthy tick never touches it.
+    enable_blackbox: bool = True
+    blackbox_dir: Optional[str] = None      # None -> per-engine tempdir
+    blackbox_capacity: int = 16             # bundles retained
     # Real-checkpoint path: directory holding an HF-layout safetensors
     # checkpoint (model.safetensors[.index.json] + config.json). Params
     # load through models/checkpoint_io.py — sharding-aware windowed
@@ -198,7 +214,16 @@ class Request:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
     finish_reason: Optional[str] = None
-    submitted_at: float = dataclasses.field(default_factory=time.time)
+    # MONOTONIC submission stamp (telemetry queue-wait/TTFT baseline):
+    # durations derived from it must be NTP-step immune; convert to
+    # epoch via util.tracing.mono_to_epoch for display
+    submitted_at: float = dataclasses.field(
+        default_factory=time.monotonic)
+    # distributed trace context minted at the fleet ingress (ISSUE 7):
+    # {"trace_id", "span_id", "flow_id"} — host-side metadata only,
+    # carried into the telemetry timeline so one trace id follows the
+    # request across ingress, router, and replica processes
+    trace: Optional[Dict[str, str]] = None
 
 
 class _Slot:
@@ -345,8 +370,20 @@ class InferenceEngine:
         self.telemetry = EngineTelemetry(
             model=ec.metrics_model_id or "default",
             enabled=bool(ec.enable_metrics),
-            replica=ec.metrics_replica_id or "")
-        # wall-clock stamp of the last completed tick: the fleet
+            replica=ec.metrics_replica_id or "",
+            slo_targets=ec.slo_targets)
+        # postmortem black-box spool (ISSUE 7): written only on
+        # failure paths (guard violation via the recorder alert hook,
+        # mid-tick crash in step()) or on explicit POST /debug/dump
+        from .blackbox import BlackboxSpool, default_spool_dir
+        self.blackbox = BlackboxSpool(
+            ec.blackbox_dir or default_spool_dir(
+                ec.metrics_model_id or "default",
+                ec.metrics_replica_id or ""),
+            capacity=ec.blackbox_capacity)
+        if ec.enable_blackbox:
+            self.telemetry.recorder.alert_hook = self._on_alert_event
+        # MONOTONIC stamp of the last completed tick: the fleet
         # router's liveness input (fleet_stats last_tick_age_s) — a
         # replica whose pump wedged stops advancing this
         self.last_step_at: Optional[float] = None
@@ -1870,13 +1907,18 @@ class InferenceEngine:
                 # tick's record instead of vanishing from the telemetry
                 self._tick_host_s = 0.0
                 self._tick_dev_s = 0.0
-                self.last_step_at = time.time()
-            except BaseException:
+                self.last_step_at = time.monotonic()
+            except BaseException as exc:
                 # a mid-tick raise (fold reservation assert,
                 # GuardViolation, allocator OOM, ...) must not leave an
                 # armed jax.profiler capture running forever — stop the
                 # trace and disarm so /debug/profile can be re-armed
                 self._profile_abort()
+                # black-box the replica's last moments (ISSUE 7):
+                # best-effort, lock-free gather — the step lock is
+                # HELD here, so the bundle builder must not re-enter
+                # stats()/step-lock paths
+                self.dump_blackbox("engine_crash", error=repr(exc))
                 raise
             self._profile_tick_end()
             return touched
@@ -2494,6 +2536,85 @@ class InferenceEngine:
             return
         self.telemetry.recorder.record("profile_aborted",
                                        log_dir=ps["dir"])
+
+    def _on_alert_event(self, kind: str, event: Dict[str, Any]) -> None:
+        """FlightRecorder alert hook: a guard violation landing in the
+        ring snapshots a postmortem bundle (fires outside the recorder
+        lock; exceptions are swallowed by the recorder)."""
+        self.dump_blackbox(kind, extra={"alert_event": event})
+
+    def dump_blackbox(self, cause: str, error: Optional[str] = None,
+                      extra: Optional[Dict[str, Any]] = None
+                      ) -> Optional[str]:
+        """Snapshot a postmortem bundle to the on-disk spool (ISSUE 7):
+        flight recorder, last-N tick times, metric exposition, engine
+        config, and in-flight request states. Returns the bundle id
+        (None when black-boxing is disabled or the write failed).
+
+        LOCK-FREE by contract: the crash path calls this while the
+        step lock is HELD (mid-tick exception), so nothing here may
+        take it — tick_times is snapshotted with a bounded retry
+        instead (a concurrent append can raise RuntimeError mid-
+        iteration on the manual-dump path), and stats() is rebuilt
+        from its lock-free components."""
+        if not self.config.enable_blackbox:
+            return None
+        try:
+            ticks: List[Any] = []
+            for _ in range(4):
+                try:
+                    ticks = list(self._tick_times)[-64:]
+                    break
+                except RuntimeError:
+                    continue
+            try:
+                cfg = json.loads(json.dumps(
+                    dataclasses.asdict(self.config), default=repr))
+            except Exception:
+                cfg = {"repr": repr(self.config)}
+            try:
+                self.telemetry.update_gauges(self)
+                from ...util import metrics as metrics_api
+                exposition = metrics_api.export_prometheus()
+            except Exception as e:
+                exposition = f"# exposition failed: {e!r}"
+            bundle = {
+                "error": error,
+                "engine_config": cfg,
+                "counters": {
+                    "ticks": self.ticks,
+                    "dispatches": self.dispatches,
+                    "compiled_programs": self.compiles,
+                    "active": self.num_active(),
+                    "waiting": len(self.waiting),
+                },
+                "tick_times_ms": [list(t) for t in ticks],
+                "flight_recorder": self.telemetry.recorder.events(),
+                "in_flight_requests": self.telemetry.live_snapshot(),
+                "waiting_requests": [r.request_id for r in self.waiting],
+                # single read of s.request per slot: the manual-dump
+                # path races the pump's retirements, and a None between
+                # a check and a .request_id deref would abort the
+                # whole bundle
+                "slots": [
+                    {"index": s.index,
+                     "request_id": req.request_id,
+                     "position": s.position,
+                     "prefill_pos": s.prefill_pos,
+                     "ready": s.ready}
+                    for s in self.slots
+                    for req in (s.request,) if req is not None],
+                "allocator": self.allocator.stats(),
+                "metrics_exposition": exposition,
+                **(extra or {}),
+            }
+            bid = self.blackbox.dump(cause, bundle)
+            if bid is not None:
+                self.telemetry.recorder.record(
+                    "blackbox_dump", cause=cause, bundle_id=bid)
+            return bid
+        except Exception:
+            return None      # never turn a failure into a new failure
 
     def prometheus_metrics(self) -> str:
         """Prometheus text exposition of this process's registry with

@@ -99,12 +99,36 @@ class LLMServerImpl:
             self.engine.abort(rid)
 
     # -- generation ---------------------------------------------------------
+    @staticmethod
+    def _trace_of(body: Dict[str, Any]):
+        """Pop the fleet ingress's trace plumbing off the body (ISSUE
+        7): `_request_id` keeps ONE id across ingress, router, and
+        engine; `_trace` is the minted span context the telemetry
+        timeline tags its lifecycle events with (and binds the
+        Perfetto flow arrow to). Both public ingresses control these
+        keys — the fleet ingress overwrites them with minted values
+        and LLMRouterImpl strips client-supplied ones — so what
+        arrives here is trusted plumbing, not client input."""
+        rid = body.pop("_request_id", None)
+        trace = body.pop("_trace", None)
+        return (str(rid) if rid else None,
+                dict(trace) if isinstance(trace, dict) else None)
+
     async def _generate(self, prompt_tokens: List[int],
                         params: SamplingParams,
-                        lora: "str | None" = None) -> Request:
+                        lora: "str | None" = None,
+                        rid: "str | None" = None,
+                        trace: "Dict[str, str] | None" = None
+                        ) -> Request:
         self._ensure_pump()
-        rid = uuid.uuid4().hex[:16]
-        req = Request(rid, prompt_tokens, params, lora=lora)
+        # a rid already in flight (a client replaying another request's
+        # `_request_id`) must not collide: the duplicate would overwrite
+        # the live request's token queue and abort it on teardown —
+        # fall back to a fresh id (the trace context still rides along)
+        if not rid or rid in self._queues:
+            rid = uuid.uuid4().hex[:16]
+        req = Request(rid, prompt_tokens, params, lora=lora,
+                      trace=trace)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -150,11 +174,13 @@ class LLMServerImpl:
             stop_token_ids=stop)
 
     async def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        rid, trace = self._trace_of(body)
         prompt = self.tokenizer.apply_chat_template(
             body.get("messages") or [])
         toks = self.tokenizer.encode(prompt)
         req = await self._generate(toks, self._sampling(body),
-                                   lora=self._lora_for(body))
+                                   lora=self._lora_for(body),
+                                   rid=rid, trace=trace)
         text = self.tokenizer.decode(req.output_tokens)
         return {
             "id": f"chatcmpl-{req.request_id}",
@@ -174,9 +200,11 @@ class LLMServerImpl:
         }
 
     async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        rid, trace = self._trace_of(body)
         toks = self.tokenizer.encode(str(body.get("prompt") or ""))
         req = await self._generate(toks, self._sampling(body),
-                                   lora=self._lora_for(body))
+                                   lora=self._lora_for(body),
+                                   rid=rid, trace=trace)
         return {
             "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
@@ -196,11 +224,15 @@ class LLMServerImpl:
 
     async def _generate_stream(self, prompt_tokens: List[int],
                                params: SamplingParams,
-                               lora: "str | None" = None):
+                               lora: "str | None" = None,
+                               rid: "str | None" = None,
+                               trace: "Dict[str, str] | None" = None):
         """Yield (token_text, finished, finish_reason) as tokens land."""
         self._ensure_pump()
-        rid = uuid.uuid4().hex[:16]
-        req = Request(rid, prompt_tokens, params, lora=lora)
+        if not rid or rid in self._queues:   # see _generate: a replayed
+            rid = uuid.uuid4().hex[:16]      # id must never collide
+        req = Request(rid, prompt_tokens, params, lora=lora,
+                      trace=trace)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -231,12 +263,14 @@ class LLMServerImpl:
     async def chat_stream(self, body: Dict[str, Any]):
         """SSE chunks for stream=true chat completions (OpenAI format)."""
         import json
+        rid, trace = self._trace_of(body)
         prompt = self.tokenizer.apply_chat_template(
             body.get("messages") or [])
         toks = self.tokenizer.encode(prompt)
         cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         async for delta, finished, reason in self._generate_stream(
-                toks, self._sampling(body), lora=self._lora_for(body)):
+                toks, self._sampling(body), lora=self._lora_for(body),
+                rid=rid, trace=trace):
             chunk = {
                 "id": cid, "object": "chat.completion.chunk",
                 "created": int(time.time()), "model": self.model_id,
@@ -251,10 +285,12 @@ class LLMServerImpl:
 
     async def completions_stream(self, body: Dict[str, Any]):
         import json
+        rid, trace = self._trace_of(body)
         toks = self.tokenizer.encode(str(body.get("prompt") or ""))
         cid = f"cmpl-{uuid.uuid4().hex[:16]}"
         async for delta, finished, reason in self._generate_stream(
-                toks, self._sampling(body), lora=self._lora_for(body)):
+                toks, self._sampling(body), lora=self._lora_for(body),
+                rid=rid, trace=trace):
             chunk = {
                 "id": cid, "object": "text_completion",
                 "created": int(time.time()), "model": self.model_id,
@@ -303,6 +339,28 @@ class LLMServerImpl:
         """The engine flight recorder's ring, oldest first."""
         return self.engine.telemetry.recorder.events()
 
+    async def debug_dump(self, body: "Dict[str, Any] | None" = None
+                         ) -> Dict[str, Any]:
+        """POST /debug/dump: snapshot a postmortem black-box bundle on
+        demand (ISSUE 7). Off the event loop — the bundle renders the
+        metric registry and walks host state."""
+        cause = str((body or {}).get("cause") or "manual")
+        bid = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.dump_blackbox, cause)
+        return {"replica": self.replica_id, "bundle": bid,
+                "spool_dir": self.engine.blackbox.root}
+
+    async def debug_bundles(self) -> List[Dict[str, Any]]:
+        """Black-box spool listing (id, cause, ts, bytes) — oldest
+        first; served merged at GET /fleet/debug/bundles."""
+        return self.engine.blackbox.list()
+
+    async def debug_bundle(self, bundle_id: str
+                           ) -> "Dict[str, Any] | None":
+        """Fetch one postmortem bundle by id (None when unknown)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.blackbox.read, str(bundle_id))
+
     async def start_profile(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Arm jax.profiler capture of the next N engine ticks
         (POST /debug/profile). Serializes against step() via the
@@ -337,8 +395,10 @@ class LLMServerImpl:
                              if alloc.num_usable else 0.0),
             "free_pages": alloc.free_pages,
             "cache_hit_rate": alloc.cache_hit_rate,
+            # monotonic difference: an NTP step must not fake a wedged
+            # (or freshly-ticked) replica to the router
             "last_tick_age_s": (None if last is None
-                                else max(time.time() - last, 0.0)),
+                                else max(time.monotonic() - last, 0.0)),
             # cumulative SLO sums the fleet autoscaler deltas into
             # recent-window TTFT / queue-wait means
             "slo_totals": eng.telemetry.slo_totals(),
@@ -453,12 +513,18 @@ class LLMRouterImpl:
                             content_type="text/plain")
         if norm == "/debug/trace":
             # Chrome-trace JSON (chrome://tracing, Perfetto): one tid
-            # per request with queued/prefill/decode lifecycle spans
+            # per request with queued/prefill/decode lifecycle spans;
+            # metadata carries each engine's tracing-ring fill/drop
+            # counters so a truncated ring reads as truncated
             events: List[Any] = []
-            for _, h in self._unique_servers():
+            meta: Dict[str, Any] = {}
+            for mid, h in self._unique_servers():
                 doc = await h.debug_trace.remote()
                 events.extend(doc.get("traceEvents") or [])
-            return {"traceEvents": events, "displayTimeUnit": "ms"}
+                if doc.get("metadata"):
+                    meta[mid] = doc["metadata"]
+            return {"traceEvents": events, "displayTimeUnit": "ms",
+                    "metadata": meta}
         if norm == "/debug/events":
             # engine flight recorders (bounded structured-event rings)
             out: Dict[str, Any] = {}
@@ -505,9 +571,24 @@ class LLMRouterImpl:
         except Exception:
             return Response({"error": "invalid JSON body"}, status=400,
                             content_type="application/json")
+        if isinstance(body, dict):
+            # trace plumbing keys are INTERNAL (the fleet ingress
+            # mints them): a client forging `_request_id`/`_trace`
+            # through this standalone ingress could replay a finished
+            # request's id or stitch its spans into another trace's
+            # forensics — strip them at the door
+            body.pop("_request_id", None)
+            body.pop("_trace", None)
         if norm == "/debug/profile":
             return await self._handle_profile(
                 body if isinstance(body, dict) else {})
+        if norm == "/debug/dump":
+            # POST /debug/dump: black-box every model's engine now
+            out = {}
+            for mid, h in self._unique_servers():
+                out[mid] = await h.debug_dump.remote(
+                    body if isinstance(body, dict) else {})
+            return {"object": "dump", "models": out}
         server = self._pick(body)
         if server is None:
             # a LoRA adapter may have been registered after the first

@@ -40,6 +40,7 @@ import itertools
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 from ...util import metrics as metrics_api
@@ -52,9 +53,21 @@ from ...util import tracing
 LATENCY_BOUNDARIES = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
 
+# Default per-request SLO targets (seconds): a request whose latency
+# exceeds its target counts as "bad" in slo_totals(), which is what
+# the fleet burn-rate watchdog (serve/llm/watchdog.py) differences.
+DEFAULT_SLO_TARGETS = {"ttft": 2.0, "queue_wait": 0.5, "e2e": 30.0}
+
 _FLIGHT_RING = 1024          # flight-recorder capacity (events)
 _TRACE_RING = 512            # finished-request timelines retained
 _MAX_CHUNK_MARKS = 128       # prefill-chunk marks kept per request
+
+# All recording uses the MONOTONIC clock (an NTP step in time.time()
+# would otherwise skew TTFT/ITL/queue-wait histograms and misorder
+# trace events); rendering converts through the per-process wall
+# anchor so cross-process traces still align on epoch timestamps.
+_now = time.monotonic
+_wall = tracing.mono_to_epoch
 
 
 def _build_metrics() -> Dict[str, Any]:
@@ -117,12 +130,19 @@ class FlightRecorder:
     """Bounded ring of structured engine events. Recording is a dict
     append under a lock — safe from the pump's executor thread and
     the server event loop alike, and cheap enough for per-structural-
-    event use (it never runs per token)."""
+    event use (it never runs per token).
+
+    `alert_hook(kind, event)` fires OUTSIDE the lock for kinds in
+    `alert_kinds` — the black-box hook: a guard violation or SLO page
+    landing in the ring also snapshots a postmortem bundle. The hook
+    must never raise into the recording caller and is swallowed."""
 
     def __init__(self, capacity: int = _FLIGHT_RING,
                  enabled: bool = True):
         self.enabled = enabled
         self.dropped = 0            # events displaced by the ring cap
+        self.alert_hook = None      # callable(kind, event) | None
+        self.alert_kinds = frozenset({"guard_violation"})
         self._ring: "collections.deque" = collections.deque(
             maxlen=capacity)
         self._seq = 0
@@ -130,14 +150,29 @@ class FlightRecorder:
 
     def record(self, kind: str, **fields: Any) -> None:
         if not self.enabled:
+            # metrics off must not disarm the black box: alert kinds
+            # (guard violations) still reach the hook — nothing is
+            # retained in the ring, but the postmortem bundle writes
+            hook = self.alert_hook
+            if hook is not None and kind in self.alert_kinds:
+                try:
+                    hook(kind, {"event": kind, **fields})
+                except Exception:
+                    pass
             return
         with self._lock:
             self._seq += 1
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
-            self._ring.append(
-                {"seq": self._seq, "ts": time.time(), "event": kind,
-                 **fields})
+            ev = {"seq": self._seq, "ts": _wall(_now()), "event": kind,
+                  **fields}
+            self._ring.append(ev)
+        hook = self.alert_hook
+        if hook is not None and kind in self.alert_kinds:
+            try:
+                hook(kind, dict(ev))
+            except Exception:
+                pass    # postmortem capture must never break recording
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -150,14 +185,17 @@ class FlightRecorder:
 
 
 class _Timeline:
-    """Host-side lifecycle record for ONE request (epoch seconds)."""
+    """Host-side lifecycle record for ONE request (monotonic seconds;
+    rendered as epoch through the process wall anchor)."""
 
     __slots__ = ("rid", "tid", "queued", "admitted", "first_token",
                  "last_token", "finished", "reason", "prompt_len",
-                 "cached_tokens", "n_tokens", "chunks", "lora")
+                 "cached_tokens", "n_tokens", "chunks", "lora",
+                 "trace")
 
     def __init__(self, rid: str, tid: int, queued: float,
-                 prompt_len: int, lora: Optional[str]):
+                 prompt_len: int, lora: Optional[str],
+                 trace: Optional[Dict[str, str]] = None):
         self.rid = rid
         self.tid = tid
         self.queued = queued
@@ -171,6 +209,30 @@ class _Timeline:
         self.n_tokens = 0
         self.chunks: List[tuple] = []     # (ts, n_tokens, start_pos)
         self.lora = lora
+        # distributed trace context minted at the fleet ingress
+        # ({"trace_id", "span_id", "flow_id"}): lifecycle spans carry
+        # the trace id and the flow-finish binds the router's arrow
+        self.trace = trace
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view (epoch timestamps) — black-box bundles."""
+        return {
+            "request_id": self.rid,
+            "queued": _wall(self.queued),
+            "admitted": None if self.admitted is None
+            else _wall(self.admitted),
+            "first_token": None if self.first_token is None
+            else _wall(self.first_token),
+            "finished": None if self.finished is None
+            else _wall(self.finished),
+            "reason": self.reason,
+            "prompt_tokens": self.prompt_len,
+            "cached_tokens": self.cached_tokens,
+            "generated_tokens": self.n_tokens,
+            "lora": self.lora,
+            **({"trace_id": self.trace.get("trace_id")}
+               if self.trace else {}),
+        }
 
 
 class EngineTelemetry:
@@ -179,16 +241,30 @@ class EngineTelemetry:
     add an upload, a sync, or a compile to the tick."""
 
     def __init__(self, model: str = "default", enabled: bool = True,
-                 replica: str = ""):
+                 replica: str = "",
+                 slo_targets: Optional[Dict[str, float]] = None):
         self.enabled = enabled
         self.model = model
         self.replica = replica
+        # per-request SLO targets (seconds): observations over target
+        # feed the *_bad counters in slo_totals(), the fleet burn-rate
+        # watchdog's error signal
+        self.slo_targets = dict(DEFAULT_SLO_TARGETS)
+        self.slo_targets.update(slo_targets or {})
         self.recorder = FlightRecorder(enabled=enabled)
         self._lock = threading.Lock()
         self._live: Dict[str, _Timeline] = {}
         self._done: "collections.deque" = collections.deque(
             maxlen=_TRACE_RING)
-        self._tid = itertools.count(1)
+        # per-instance tid base: in-process fleet replicas share one
+        # pid, so counters all starting at 1 would overlay unrelated
+        # requests on one Perfetto track in the merged fleet trace
+        # (and request_id-filtered docs would keep the wrong
+        # thread_name rows) — namespace each engine's request rows by
+        # its identity instead
+        base = (zlib.crc32(f"{model}\x00{replica}".encode())
+                % 997 + 1) * 100_000
+        self._tid = itertools.count(base + 1)
         self._budget_used = 0
         self._budget_total = 0
         self._budget_last = 0.0
@@ -202,6 +278,7 @@ class EngineTelemetry:
         self._sums = {"ttft": 0.0, "itl": 0.0, "queue": 0.0,
                       "e2e": 0.0}
         self._counts = {"ttft": 0, "itl": 0, "queue": 0, "e2e": 0}
+        self._bad = {"ttft": 0, "queue": 0, "e2e": 0}
         if enabled:
             self._m = _build_metrics()
             self._tags = {"model": model, "replica": replica}
@@ -214,15 +291,16 @@ class EngineTelemetry:
         if not self.enabled:
             return
         t = _Timeline(req.request_id, next(self._tid),
-                      getattr(req, "submitted_at", time.time()),
-                      len(req.prompt_tokens), req.lora)
+                      getattr(req, "submitted_at", None) or _now(),
+                      len(req.prompt_tokens), req.lora,
+                      trace=getattr(req, "trace", None))
         with self._lock:
             self._live[req.request_id] = t
 
     def on_admitted(self, req, cached_tokens: int = 0) -> None:
         if not self.enabled:
             return
-        now = time.time()
+        now = _now()
         with self._lock:
             t = self._live.get(req.request_id)
             if t is None:
@@ -232,6 +310,8 @@ class EngineTelemetry:
             wait = max(now - t.queued, 0.0)
             self._sums["queue"] += wait
             self._counts["queue"] += 1
+            if wait > self.slo_targets["queue_wait"]:
+                self._bad["queue"] += 1
             self._prompt_tokens += t.prompt_len
         self._m["queue_wait"].observe(wait, self._tags)
         self._m["prompt_tokens"].inc(t.prompt_len, self._tags)
@@ -247,14 +327,14 @@ class EngineTelemetry:
         with self._lock:
             t = self._live.get(req.request_id)
             if t is not None and len(t.chunks) < _MAX_CHUNK_MARKS:
-                t.chunks.append((time.time(), n_tokens, start_pos))
+                t.chunks.append((_now(), n_tokens, start_pos))
 
     def on_token(self, req) -> None:
         """One host-visible output token (runs per token per fold —
         the hottest entry point; keep it a few dict ops)."""
         if not self.enabled:
             return
-        now = time.time()
+        now = _now()
         first = gap = None
         with self._lock:
             t = self._live.get(req.request_id)
@@ -266,6 +346,8 @@ class EngineTelemetry:
                 first = max(now - t.queued, 0.0)
                 self._sums["ttft"] += first
                 self._counts["ttft"] += 1
+                if first > self.slo_targets["ttft"]:
+                    self._bad["ttft"] += 1
             else:
                 gap = max(now - t.last_token, 0.0)
                 self._sums["itl"] += gap
@@ -281,7 +363,7 @@ class EngineTelemetry:
     def on_finished(self, req, reason: str) -> None:
         if not self.enabled:
             return
-        now = time.time()
+        now = _now()
         with self._lock:
             t = self._live.pop(req.request_id, None)
             if t is not None:
@@ -294,6 +376,8 @@ class EngineTelemetry:
             e2e = max(now - (t.queued if t else now), 0.0)
             self._sums["e2e"] += e2e
             self._counts["e2e"] += 1
+            if e2e > self.slo_targets["e2e"]:
+                self._bad["e2e"] += 1
         self._m["finished"].inc(1, {**self._tags, "reason": reason})
         self._m["e2e"].observe(e2e, self._tags)
         if reason == "abort":
@@ -358,7 +442,20 @@ class EngineTelemetry:
                 "queue_n": float(self._counts["queue"]),
                 "e2e_s": self._sums["e2e"],
                 "e2e_n": float(self._counts["e2e"]),
+                # SLO-violation counts (observation over its target in
+                # slo_targets): the burn-rate watchdog's numerators
+                "ttft_bad": float(self._bad["ttft"]),
+                "queue_bad": float(self._bad["queue"]),
+                "e2e_bad": float(self._bad["e2e"]),
             }
+
+    def live_snapshot(self) -> List[Dict[str, Any]]:
+        """JSON-able in-flight request states (black-box bundles):
+        every live timeline plus the most recent finished ones."""
+        with self._lock:
+            live = [t.snapshot() for t in self._live.values()]
+            done = [t.snapshot() for t in list(self._done)[-16:]]
+        return live + done
 
     def summary(self) -> Dict[str, Any]:
         """Per-engine SLO aggregates for stats() (exact for THIS
@@ -392,50 +489,83 @@ class EngineTelemetry:
         """Request timelines as Chrome-trace JSON (one tid per
         request, spans via tracing.complete_event so the fields match
         live tracing spans), merged with this process's tracing ring
-        (populated when RAY_TPU_TRACE / tracing.enable() is on)."""
+        (populated when RAY_TPU_TRACE / tracing.enable() is on).
+
+        Requests carrying a fleet trace context (ISSUE 7) tag every
+        lifecycle event with the trace id and emit the Perfetto
+        flow-finish ("f") bound to the ingress router's flow-start —
+        the arrow from the routing decision to this replica's
+        prefill/decode spans. The `metadata` block carries the
+        process wall anchor (trace alignment) and the tracing ring's
+        drop counter so a truncated ring reads as truncated."""
         events: List[Dict[str, Any]] = []
         pid = os.getpid()
-        now = time.time()
+        now = _now()
         with self._lock:
             timelines = list(self._done) + list(self._live.values())
         for t in timelines:
             rid = t.rid
+            trace_args = ({"trace_id": t.trace["trace_id"]}
+                          if t.trace and t.trace.get("trace_id")
+                          else {})
             events.append({"ph": "M", "name": "thread_name",
                            "pid": pid, "tid": t.tid,
                            "args": {"name": f"request {rid}"}})
+            if t.trace and t.trace.get("flow_id"):
+                # flow-finish inside the queued span: binds the arrow
+                # the ingress started at its routing-decision span
+                events.append({
+                    "name": "route", "cat": "flow", "ph": "f",
+                    "bp": "e", "id": t.trace["flow_id"],
+                    "ts": _wall(t.admitted or t.queued) * 1e6,
+                    "pid": pid, "tid": t.tid,
+                    "args": {"request_id": rid, **trace_args}})
             end_q = t.admitted or t.finished or now
             events.append(tracing.complete_event(
-                "queued", "request", t.queued, end_q - t.queued,
-                pid=pid, tid=t.tid, args={"request_id": rid}))
+                "queued", "request", _wall(t.queued), end_q - t.queued,
+                pid=pid, tid=t.tid,
+                args={"request_id": rid, **trace_args}))
             if t.admitted is not None:
                 end_p = t.first_token or t.finished or now
                 events.append(tracing.complete_event(
-                    "prefill", "request", t.admitted,
+                    "prefill", "request", _wall(t.admitted),
                     end_p - t.admitted, pid=pid, tid=t.tid,
                     args={"request_id": rid,
                           "prompt_tokens": t.prompt_len,
                           "cached_tokens": t.cached_tokens,
-                          **({"lora": t.lora} if t.lora else {})}))
+                          **({"lora": t.lora} if t.lora else {}),
+                          **trace_args}))
             for ts, n, pos in t.chunks:
                 events.append(tracing.instant_event(
-                    "prefill_chunk", "request", ts, pid=pid,
-                    tid=t.tid, args={"tokens": n, "start_pos": pos}))
+                    "prefill_chunk", "request", _wall(ts), pid=pid,
+                    tid=t.tid, args={"request_id": rid, "tokens": n,
+                                     "start_pos": pos, **trace_args}))
             if t.first_token is not None:
                 events.append(tracing.instant_event(
-                    "first_token", "request", t.first_token, pid=pid,
-                    tid=t.tid, args={"request_id": rid}))
+                    "first_token", "request", _wall(t.first_token),
+                    pid=pid, tid=t.tid,
+                    args={"request_id": rid, **trace_args}))
                 end_d = t.finished or now
                 events.append(tracing.complete_event(
-                    "decode", "request", t.first_token,
+                    "decode", "request", _wall(t.first_token),
                     end_d - t.first_token, pid=pid, tid=t.tid,
                     args={"request_id": rid,
-                          "generated_tokens": t.n_tokens}))
+                          "generated_tokens": t.n_tokens,
+                          **trace_args}))
             if t.finished is not None:
                 events.append(tracing.instant_event(
-                    f"finished:{t.reason}", "request", t.finished,
-                    pid=pid, tid=t.tid, args={"request_id": rid}))
+                    f"finished:{t.reason}", "request",
+                    _wall(t.finished), pid=pid, tid=t.tid,
+                    args={"request_id": rid, **trace_args}))
         events.extend(tracing.get_events())
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metadata": {
+                    "pid": pid,
+                    "replica": self.replica,
+                    "wall_anchor_s": tracing.wall_anchor(),
+                    "tracing_ring": tracing.ring_stats(),
+                }}
 
 
-__all__ = ["EngineTelemetry", "FlightRecorder", "LATENCY_BOUNDARIES"]
+__all__ = ["EngineTelemetry", "FlightRecorder", "LATENCY_BOUNDARIES",
+           "DEFAULT_SLO_TARGETS"]

@@ -17,8 +17,24 @@ re-exported from ray_tpu.llm) gains the fleet layer the ROADMAP's
 - a telemetry-driven autoscaler consuming PR 5's TTFT / queue-wait
   aggregates, with drain-before-downscale (autoscaler.py, fleet.py).
 
-Scoring formula, admission thresholds, and the autoscale policy are
-documented in BENCH_CORE.md "Serving fleet anatomy".
+ISSUE 7 adds the fleet-wide observability layer:
+
+- distributed request tracing: a trace context minted at ingress
+  follows each request through admission → routing → replica engine
+  lifecycle, merged (with Perfetto flow arrows) at
+  `GET /fleet/debug/trace` with `?request_id=`/`?trace_id=` filters
+  (tracemerge.py);
+- an SLO burn-rate watchdog: multi-window error-budget burn over the
+  replicas' slo_totals, paging pre-emptively into the autoscaler and
+  admission brownout (watchdog.py);
+- postmortem black-box bundles: guard violations, crashes, watchdog
+  pages, and `POST /debug/dump` snapshot bounded on-disk bundles,
+  listed/fetched at `GET /fleet/debug/bundles`
+  (llm/_internal/blackbox.py).
+
+Scoring formula, admission thresholds, the autoscale policy, and the
+observability surface are documented in BENCH_CORE.md "Serving fleet
+anatomy" and "Fleet observability anatomy".
 """
 
 from __future__ import annotations
@@ -42,6 +58,10 @@ from .fleet import (FleetManager, HandleReplicaClient,  # noqa: F401
                     LocalReplicaClient)
 from .router import (FleetRouter, HashRing, ReplicaSnapshot,  # noqa: F401
                      RouterConfig, prefix_fingerprint)
+from .tracemerge import (IngressTraceBuffer,  # noqa: F401
+                         filter_trace, merge_fleet_traces,
+                         merge_flight_recorders)
+from .watchdog import SLOBurnWatchdog, WatchdogConfig  # noqa: F401
 
 __all__ = [
     # fleet layer
@@ -51,6 +71,9 @@ __all__ = [
     "prefix_fingerprint",
     "AdmissionConfig", "AdmissionController", "AdmissionRejected",
     "AutoscaleConfig", "FleetAutoscaler", "FleetMetrics",
+    # observability layer (ISSUE 7)
+    "WatchdogConfig", "SLOBurnWatchdog", "IngressTraceBuffer",
+    "merge_fleet_traces", "merge_flight_recorders", "filter_trace",
     # single-model surface (ray_tpu.llm re-exports)
     "LLMConfig", "build_openai_app", "build_llm_deployment",
     "InferenceEngine", "EngineConfig", "SamplingParams", "Request",

@@ -44,6 +44,11 @@ class AdmissionConfig:
     # larger share of dequeues under contention
     tenant_weights: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    # brownout (ISSUE 7): while the SLO burn-rate watchdog pages, the
+    # queue bound shrinks to this fraction — shed the marginal request
+    # at the front door BEFORE it burns more of the error budget
+    # inside an already-slow fleet (0 < factor <= 1; 1 disables)
+    brownout_queue_factor: float = 0.25
 
 
 class AdmissionRejected(Exception):
@@ -86,8 +91,11 @@ class AdmissionController:
         # observability (GET /fleet)
         self.admitted = 0
         self.rejected: Dict[str, int] = {"queue_full": 0,
-                                         "queue_wait_slo": 0}
+                                         "queue_wait_slo": 0,
+                                         "brownout": 0}
         self.shed_total = 0
+        # watchdog-driven degraded mode (see set_brownout)
+        self.brownout = False
         self._recent_waits: collections.deque = collections.deque(
             maxlen=512)
 
@@ -95,6 +103,24 @@ class AdmissionController:
     def _weight(self, tenant: str) -> float:
         w = self.config.tenant_weights.get(tenant, 1.0)
         return w if w > 0 else 1.0
+
+    def _effective_max_queue(self) -> int:
+        cfg = self.config
+        if not self.brownout:
+            return cfg.max_queue
+        return max(0, int(cfg.max_queue * cfg.brownout_queue_factor))
+
+    def set_brownout(self, on: bool) -> bool:
+        """Engage/release brownout (the SLO watchdog's shed signal):
+        while on, the queue bound shrinks so overload turns into fast
+        429s instead of deep queueing — already-queued requests are
+        untouched (they drain or shed under their own SLO timer).
+        Returns True when the state actually changed."""
+        on = bool(on)
+        if on == self.brownout:
+            return False
+        self.brownout = on
+        return True
 
     def _queue_len(self) -> int:
         # done tickets still heaped are exactly the shed/cancelled
@@ -150,10 +176,17 @@ class AdmissionController:
         # flush cancelled heap heads / spare capacity first, so the
         # queue-full check below sees the true backlog
         self._grant_next()
+        limit = self._effective_max_queue()
         if self.inflight >= cfg.max_concurrent \
-                and self._queue_len() >= cfg.max_queue:
-            self.rejected["queue_full"] += 1
-            raise AdmissionRejected("queue_full", self.retry_after())
+                and self._queue_len() >= limit:
+            # attribute the shed: under brownout a rejection the full
+            # bound would have admitted is a pre-emptive brownout shed
+            reason = ("brownout"
+                      if limit < cfg.max_queue
+                      and self._queue_len() < cfg.max_queue
+                      else "queue_full")
+            self.rejected[reason] += 1
+            raise AdmissionRejected(reason, self.retry_after())
         vtime = max(self._pass.get(tenant, 0.0), self._vtime) \
             + 1.0 / self._weight(tenant)
         self._pass[tenant] = vtime
@@ -191,7 +224,7 @@ class AdmissionController:
         checks before committing a 200 SSE stream to the wire.)"""
         self._grant_next()
         return (self.inflight >= self.config.max_concurrent
-                and self._queue_len() >= self.config.max_queue)
+                and self._queue_len() >= self._effective_max_queue())
 
     def release(self) -> None:
         """One dispatched request finished; grant the next waiter."""
@@ -225,6 +258,8 @@ class AdmissionController:
             "max_concurrent": self.config.max_concurrent,
             "max_queue": self.config.max_queue,
             "queue_wait_slo_s": self.config.queue_wait_slo_s,
+            "brownout": self.brownout,
+            "effective_max_queue": self._effective_max_queue(),
         }
 
 

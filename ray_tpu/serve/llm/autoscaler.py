@@ -53,6 +53,11 @@ class FleetMetrics:
     waiting: int = 0                # engine queues, fleet-wide, now
     occupancy: float = 0.0          # mean KV occupancy over active
     shed_delta: int = 0             # admission sheds/rejects this window
+    # SLO burn-rate watchdog signal (ISSUE 7): paging means the fleet
+    # is burning its error budget multi-window-confirmed — treated as
+    # an instant breach so capacity is added BEFORE the SLO is blown
+    slo_page: bool = False
+    slo_burn: float = 0.0           # max confirmed burn across SLOs
 
 
 class FleetAutoscaler:
@@ -65,13 +70,15 @@ class FleetAutoscaler:
     def _breached(self, m: FleetMetrics, active: int) -> bool:
         c = self.config
         return (m.shed_delta > 0
+                or m.slo_page                   # watchdog: pre-emptive
                 or m.ttft_ms > c.ttft_high_ms
                 or m.queue_wait_ms > c.queue_wait_high_ms
                 or m.waiting > active)      # >1 queued per replica
 
     def _idle(self, m: FleetMetrics) -> bool:
         c = self.config
-        return (m.shed_delta == 0 and m.waiting == 0
+        return (m.shed_delta == 0 and not m.slo_page
+                and m.waiting == 0
                 and m.queue_wait_ms < c.queue_wait_low_ms
                 and m.occupancy < c.occupancy_low)
 
@@ -105,6 +112,8 @@ class FleetAutoscaler:
             "waiting": m.waiting,
             "occupancy": round(m.occupancy, 4),
             "shed_delta": m.shed_delta,
+            "slo_page": m.slo_page,
+            "slo_burn": round(m.slo_burn, 3),
         }
         return target
 

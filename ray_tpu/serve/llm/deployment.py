@@ -37,6 +37,8 @@ from .autoscaler import AutoscaleConfig
 from .fleet import (ACTIVE, DRAINING, STANDBY, FleetManager,
                     HandleReplicaClient)
 from .router import RouterConfig
+from .tracemerge import merge_fleet_traces, merge_flight_recorders
+from .watchdog import WatchdogConfig
 
 
 @dataclasses.dataclass
@@ -49,6 +51,15 @@ class FleetConfig:
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
     autoscale: Optional[AutoscaleConfig] = None   # min/max come from above
+    # SLO burn-rate watchdog (ISSUE 7): multi-window error-budget burn
+    # over the replicas' slo_totals; pages pre-emptively into the
+    # autoscaler and admission brownout
+    watchdog: WatchdogConfig = dataclasses.field(
+        default_factory=WatchdogConfig)
+    # distributed request tracing (ISSUE 7): mint a trace context per
+    # request at ingress; one trace id follows it across router and
+    # replica (GET /fleet/debug/trace merges the spans)
+    enable_tracing: bool = True
     refresh_period_s: float = 0.5
     autoscale_period_s: float = 2.0
 
@@ -64,6 +75,8 @@ class FleetConfig:
             "router": dataclasses.asdict(self.router),
             "admission": dataclasses.asdict(self.admission),
             "autoscale": dataclasses.asdict(self.resolved_autoscale()),
+            "watchdog": dataclasses.asdict(self.watchdog),
+            "enable_tracing": self.enable_tracing,
             "refresh_period_s": self.refresh_period_s,
             "autoscale_period_s": self.autoscale_period_s,
         }
@@ -84,6 +97,9 @@ class LLMFleetIngressImpl:
         for i, h in enumerate(server_handles):
             clients.append(HandleReplicaClient(
                 f"r{i}", h, shares_registry=shared))
+        wd_wire = dict(fleet_wire.get("watchdog") or {})
+        if "slos" in wd_wire:           # JSON round-trip: list -> tuple
+            wd_wire["slos"] = tuple(wd_wire["slos"])
         self.fleet = FleetManager(
             clients,
             router=RouterConfig(**fleet_wire.get("router") or {}),
@@ -91,6 +107,8 @@ class LLMFleetIngressImpl:
                 **fleet_wire.get("admission") or {}),
             autoscale=AutoscaleConfig(
                 **fleet_wire.get("autoscale") or {}),
+            watchdog=WatchdogConfig(**wd_wire),
+            enable_tracing=bool(fleet_wire.get("enable_tracing", True)),
             refresh_period_s=fleet_wire.get("refresh_period_s", 0.5),
             autoscale_period_s=fleet_wire.get("autoscale_period_s", 2.0))
         self._adapters: Optional[List[str]] = None
@@ -131,7 +149,7 @@ class LLMFleetIngressImpl:
     async def _replica_infos(self) -> Dict[str, Any]:
         return await self._fanout("model_info")
 
-    async def _fanout(self, method: str) -> Dict[str, Any]:
+    async def _fanout(self, method: str, *args) -> Dict[str, Any]:
         """Call `method` on every non-standby replica concurrently,
         bounded: one wedged replica (step lock held mid-tick) degrades
         its row to an error instead of hanging the whole GET."""
@@ -141,7 +159,8 @@ class LLMFleetIngressImpl:
         async def one(rid: str):
             try:
                 return rid, await asyncio.wait_for(
-                    self.fleet.replicas[rid].client.call(method),
+                    self.fleet.replicas[rid].client.call(
+                        method, *args),
                     timeout=5.0)
             except Exception as e:
                 return rid, {"error": repr(e)}
@@ -149,9 +168,12 @@ class LLMFleetIngressImpl:
         return dict(await asyncio.gather(*(one(rid) for rid in ids)))
 
     # -- GET surface ----------------------------------------------------
-    async def _handle_get(self, norm: str) -> Any:
+    async def _handle_get(self, norm: str,
+                          query: Optional[Dict[str, str]] = None
+                          ) -> Any:
         from ...serve import Response
 
+        query = query or {}
         if norm == "/v1/models":
             if self._adapters is None:
                 await self._resolve_adapters()
@@ -181,6 +203,51 @@ class LLMFleetIngressImpl:
             for doc in (await self._fanout("debug_trace")).values():
                 events.extend(doc.get("traceEvents") or [])
             return {"traceEvents": events, "displayTimeUnit": "ms"}
+        # -- fleet-merged debug surface (ISSUE 7) ------------------------
+        if norm == "/fleet/debug/trace":
+            # time-aligned merge of every replica's Chrome trace with
+            # the ingress's own span buffer; ?request_id= / ?trace_id=
+            # narrow to one request's cross-process lifecycle
+            return merge_fleet_traces(
+                await self._fanout("debug_trace"), self.fleet.trace,
+                request_id=query.get("request_id"),
+                trace_id=query.get("trace_id"))
+        if norm == "/fleet/debug/events":
+            merged = merge_flight_recorders(
+                await self._fanout("debug_events"),
+                self.fleet.recorder.events(),
+                request_id=query.get("request_id"))
+            return {"object": "events", "events": merged,
+                    "ingress": self.fleet.recorder.stats()}
+        if norm == "/fleet/debug/bundles":
+            # list every replica's black-box spool; ?replica=&id=
+            # fetches one bundle
+            rid, bid = query.get("replica"), query.get("id")
+            if rid and bid:
+                st = self.fleet.replicas.get(rid)
+                if st is None:
+                    return Response(
+                        {"error": f"unknown replica {rid!r}"},
+                        status=404, content_type="application/json")
+                try:
+                    # bounded like every other replica fan-out: a
+                    # wedged replica (step lock held — often exactly
+                    # why its bundle is wanted) degrades, not hangs
+                    bundle = await asyncio.wait_for(
+                        st.client.call("debug_bundle", bid),
+                        timeout=5.0)
+                except Exception as e:
+                    return Response(
+                        {"error": f"bundle fetch from {rid} failed: "
+                                  f"{e!r}"},
+                        status=504, content_type="application/json")
+                if bundle is None:
+                    return Response(
+                        {"error": f"no bundle {bid!r} on {rid}"},
+                        status=404, content_type="application/json")
+                return bundle
+            return {"object": "bundles",
+                    "replicas": await self._fanout("debug_bundles")}
         return Response({"error": f"no route {norm}"}, status=404,
                         content_type="application/json")
 
@@ -193,7 +260,9 @@ class LLMFleetIngressImpl:
         method = getattr(request, "method", "POST")
         norm = path.rstrip("/") or "/"
         if method == "GET":
-            return await self._handle_get(norm)
+            return await self._handle_get(
+                norm, dict(getattr(request, "query_params", None)
+                           or {}))
         try:
             body = request.json()
         except Exception:
@@ -201,6 +270,11 @@ class LLMFleetIngressImpl:
                             content_type="application/json")
         if not isinstance(body, dict):
             body = {}
+        if norm == "/debug/dump":
+            # POST /debug/dump: black-box every replica now
+            cause = str(body.get("cause") or "manual")
+            return {"object": "dump",
+                    "replicas": await self.fleet.debug_dump_all(cause)}
         if not await self._known_model(body.get("model") or ""):
             return Response(
                 {"error": f"model {body.get('model')!r} not found"},

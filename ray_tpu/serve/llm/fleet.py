@@ -28,14 +28,23 @@ from __future__ import annotations
 import asyncio
 import collections
 import time
+import uuid
 from typing import Any, AsyncIterator, Deque, Dict, List, Optional, \
     Sequence
 
+from ...llm._internal.telemetry import FlightRecorder
+from ...util import tracing
 from .admission import (AdmissionConfig, AdmissionController,
                         AdmissionRejected)
 from .autoscaler import AutoscaleConfig, FleetAutoscaler, FleetMetrics
 from .router import (FleetRouter, ReplicaSnapshot, RouterConfig,
                      prefix_fingerprint)
+from .tracemerge import IngressTraceBuffer, request_events
+from .watchdog import SLOBurnWatchdog, WatchdogConfig
+
+# monotone SLO-total keys the watchdog accumulates fleet-wide
+_WATCH_KEYS = ("ttft_n", "ttft_bad", "queue_n", "queue_bad",
+               "e2e_n", "e2e_bad")
 
 ACTIVE = "ACTIVE"
 DRAINING = "DRAINING"
@@ -96,7 +105,9 @@ class FleetManager:
                  admission: Optional[AdmissionConfig] = None,
                  autoscale: Optional[AutoscaleConfig] = None,
                  refresh_period_s: float = 0.5,
-                 autoscale_period_s: float = 2.0):
+                 autoscale_period_s: float = 2.0,
+                 watchdog: Optional[WatchdogConfig] = None,
+                 enable_tracing: bool = True):
         if not clients:
             raise ValueError("a fleet needs at least one replica")
         auto = autoscale or AutoscaleConfig(
@@ -124,6 +135,25 @@ class FleetManager:
         self._scale_events: Deque[Dict[str, Any]] = \
             collections.deque(maxlen=256)
         self._loop_task: Optional[asyncio.Task] = None
+        # -- ISSUE 7 observability layer --------------------------------
+        # fleet-level flight recorder: slo_alert/slo_clear, brownout
+        # transitions, postmortem dump triggers (GET /fleet/debug/events
+        # merges it with every replica's ring)
+        self.recorder = FlightRecorder(capacity=512)
+        self.watchdog = SLOBurnWatchdog(watchdog or WatchdogConfig(),
+                                        recorder=self.recorder)
+        # distributed tracing: every request gets a trace context at
+        # ingress; the ingress's own spans land here and merge with
+        # the replicas' lifecycle traces at GET /fleet/debug/trace
+        self.enable_tracing = enable_tracing
+        self.trace = IngressTraceBuffer()
+        # watchdog accumulation state: per-replica clamped deltas into
+        # fleet-monotone totals (membership changes / engine restarts
+        # must not produce negative or replayed windows)
+        self._watch_prev: Dict[str, Dict[str, float]] = {}
+        self._watch_accum: Dict[str, float] = \
+            {k: 0.0 for k in _WATCH_KEYS}
+        self._page_dump_task: Optional[asyncio.Task] = None
 
     # -- membership helpers --------------------------------------------
     def _ids(self, *statuses: str) -> List[str]:
@@ -138,14 +168,15 @@ class FleetManager:
                 if st.snapshot is not None}
 
     # -- request path ---------------------------------------------------
-    def _route(self, body: Dict[str, Any]) -> _ReplicaState:
+    def _route(self, body: Dict[str, Any]
+               ) -> "tuple[_ReplicaState, str]":
         fp = prefix_fingerprint(body, self.router.config.prefix_depth)
-        rid = self.router.pick(fp, self._snapshots(),
-                               self._inflight_map())
+        rid, outcome = self.router.pick_ex(fp, self._snapshots(),
+                                           self._inflight_map())
         if rid is None:
             raise AdmissionRejected("no_active_replicas",
                                     self.admission.retry_after())
-        return self.replicas[rid]
+        return self.replicas[rid], outcome
 
     @staticmethod
     def tenant_of(body: Dict[str, Any]) -> str:
@@ -153,19 +184,87 @@ class FleetManager:
         # a header-injected hint if the ingress put one in the body
         return str(body.get("user") or body.get("tenant") or "default")
 
+    # -- distributed tracing (ISSUE 7) ----------------------------------
+    def _trace_begin(self, method: str, body: Dict[str, Any]):
+        """Mint the request's trace context at fleet ingress: one
+        request id and one trace id that follow it across admission,
+        routing, and the replica's engine lifecycle (the context rides
+        the body; LLMServerImpl pops it onto the engine Request).
+        Returns (body', rec) — body' is a COPY carrying the plumbing
+        keys, rec the in-progress ingress span record."""
+        if not self.enable_tracing:
+            # the plumbing keys are internal even when tracing is off:
+            # never forward client-supplied values to the replica
+            if "_request_id" in body or "_trace" in body:
+                body = {k: v for k, v in body.items()
+                        if k not in ("_request_id", "_trace")}
+            return body, None
+        body = dict(body)
+        # ALWAYS mint — `_request_id` doubles as the engine request id
+        # downstream, so honoring a client-supplied value would let a
+        # replayed id collide with (and abort/starve) another tenant's
+        # in-flight request
+        rid = uuid.uuid4().hex[:16]
+        trace = {"trace_id": tracing.new_span_id(),
+                 "span_id": tracing.new_span_id(),
+                 "flow_id": tracing.new_span_id()}
+        body["_request_id"] = rid
+        body["_trace"] = trace
+        return body, {
+            "rid": rid, "trace": trace, "method": method,
+            "tenant": self.tenant_of(body), "t0": time.monotonic(),
+            "t_admit": None, "t_route": None, "replica": None,
+            "outcome": None, "status": "ok", "done": False}
+
+    def _trace_end(self, rec: Optional[Dict[str, Any]],
+                   status: Optional[str] = None) -> None:
+        """Close the ingress span set and publish it to the buffer
+        (idempotent: the happy path and the error paths both reach
+        here exactly once through the dispatch finally)."""
+        if rec is None or rec["done"]:
+            return
+        rec["done"] = True
+        if status is not None:
+            rec["status"] = status
+        self.trace.add(*request_events(
+            self.trace.next_tid(), rec["rid"], rec["trace"],
+            rec["t0"], rec["t_admit"], rec["t_route"],
+            time.monotonic(), rec["replica"], rec["outcome"],
+            rec["method"], rec["tenant"], rec["status"]))
+
     async def dispatch(self, method: str, body: Dict[str, Any]) -> Any:
-        """Unary request through admission + routing."""
-        await self.admission.acquire(self.tenant_of(body))
+        """Unary request through admission + routing (trace-minted)."""
+        body, rec = self._trace_begin(method, body)
         try:
-            st = self._route(body)
+            await self.admission.acquire(self.tenant_of(body))
+        except AdmissionRejected as e:
+            self._trace_end(rec, f"rejected:{e.reason}")
+            raise
+        if rec is not None:
+            rec["t_admit"] = time.monotonic()
+        try:
+            st, outcome = self._route(body)
+            if rec is not None:
+                rec["t_route"] = time.monotonic()
+                rec["replica"] = st.client.replica_id
+                rec["outcome"] = outcome
             st.inflight += 1
             st.requests_total += 1
             try:
                 return await st.client.call(method, body)
             finally:
                 st.inflight -= 1
+        except AdmissionRejected as e:
+            if rec is not None:
+                rec["status"] = f"rejected:{e.reason}"
+            raise
+        except BaseException:
+            if rec is not None:
+                rec["status"] = "error"
+            raise
         finally:
             self.admission.release()
+            self._trace_end(rec)
 
     async def dispatch_stream(self, method: str, body: Dict[str, Any]
                               ) -> AsyncIterator[Any]:
@@ -173,9 +272,20 @@ class FleetManager:
         stream (a live stream occupies a decode slot, so it must keep
         weighing in both the router's in-flight counts and the
         admission concurrency bound until it completes)."""
-        await self.admission.acquire(self.tenant_of(body))
+        body, rec = self._trace_begin(method, body)
         try:
-            st = self._route(body)
+            await self.admission.acquire(self.tenant_of(body))
+        except AdmissionRejected as e:
+            self._trace_end(rec, f"rejected:{e.reason}")
+            raise
+        if rec is not None:
+            rec["t_admit"] = time.monotonic()
+        try:
+            st, outcome = self._route(body)
+            if rec is not None:
+                rec["t_route"] = time.monotonic()
+                rec["replica"] = st.client.replica_id
+                rec["outcome"] = outcome
             st.inflight += 1
             st.requests_total += 1
             try:
@@ -183,8 +293,21 @@ class FleetManager:
                     yield chunk
             finally:
                 st.inflight -= 1
+        except AdmissionRejected as e:
+            if rec is not None:
+                rec["status"] = f"rejected:{e.reason}"
+            raise
+        except GeneratorExit:
+            if rec is not None:
+                rec["status"] = "abandoned"
+            raise
+        except BaseException:
+            if rec is not None:
+                rec["status"] = "error"
+            raise
         finally:
             self.admission.release()
+            self._trace_end(rec)
 
     # -- stats refresh --------------------------------------------------
     async def refresh(self) -> None:
@@ -232,7 +355,8 @@ class FleetManager:
                 waiting += st.snapshot.waiting
                 occ.append(st.snapshot.kv_occupancy)
         shed = (self.admission.shed_total
-                + self.admission.rejected["queue_full"])
+                + self.admission.rejected["queue_full"]
+                + self.admission.rejected["brownout"])
         shed_delta = shed - self._prev_shed
         self._prev_shed = shed
         return FleetMetrics(
@@ -242,12 +366,75 @@ class FleetManager:
                            if d["queue_n"] > 0 else 0.0),
             waiting=waiting,
             occupancy=(sum(occ) / len(occ) if occ else 0.0),
-            shed_delta=shed_delta)
+            shed_delta=shed_delta,
+            slo_page=self.watchdog.paging,
+            slo_burn=self.watchdog.max_burn)
+
+    # -- SLO burn-rate watchdog (ISSUE 7) -------------------------------
+    def _watchdog_totals(self) -> Dict[str, float]:
+        """Fleet-summed monotone SLO totals, accumulated per replica
+        id with clamped deltas (same reasoning as _window_metrics:
+        replica restarts and membership changes must not produce
+        negative or replayed burn windows)."""
+        for rid, st in self.replicas.items():
+            if not st.slo_totals:
+                continue
+            prev = self._watch_prev.get(rid, {})
+            cur = {k: float(st.slo_totals.get(k, 0.0))
+                   for k in _WATCH_KEYS}
+            for k in _WATCH_KEYS:
+                self._watch_accum[k] += max(
+                    0.0, cur[k] - prev.get(k, 0.0))
+            self._watch_prev[rid] = cur
+        return dict(self._watch_accum)
+
+    def watchdog_tick(self, now: Optional[float] = None) -> None:
+        """One watchdog evaluation over the freshly-refreshed replica
+        totals, plus the reactions: brownout the front door while
+        paging (shed before the SLO is blown) and black-box every
+        replica on the page transition (the postmortem wants the
+        fleet's state AT the breach, not after the restart)."""
+        if not self.watchdog.config.enabled:
+            return
+        was_paging = self.watchdog.paging
+        self.watchdog.observe(self._watchdog_totals(), now)
+        paging = self.watchdog.paging
+        if self.admission.set_brownout(paging):
+            self.recorder.record(
+                "brownout_on" if paging else "brownout_off",
+                burn=round(self.watchdog.max_burn, 3))
+        if paging and not was_paging:
+            try:
+                self._page_dump_task = \
+                    asyncio.get_running_loop().create_task(
+                        self.debug_dump_all("slo_page"))
+            except RuntimeError:
+                pass     # no running loop (sync test driver)
+
+    async def debug_dump_all(self, cause: str) -> Dict[str, Any]:
+        """Ask every non-standby replica to snapshot a postmortem
+        black-box bundle (watchdog page / POST /debug/dump)."""
+        ids = self._ids(ACTIVE, DRAINING)
+
+        async def one(rid: str):
+            try:
+                return rid, await asyncio.wait_for(
+                    self.replicas[rid].client.call(
+                        "debug_dump", {"cause": cause}),
+                    timeout=10.0)
+            except Exception as e:
+                return rid, {"error": repr(e)}
+
+        out = dict(await asyncio.gather(*(one(rid) for rid in ids)))
+        self.recorder.record("postmortem_dump", cause=cause,
+                             replicas=sorted(out))
+        return out
 
     async def autoscale_tick(self, now: Optional[float] = None) -> int:
-        """One control-loop iteration: refresh → decide → apply.
-        Returns the applied target (also reachable at GET /fleet)."""
+        """One control-loop iteration: refresh → watchdog → decide →
+        apply. Returns the applied target (also at GET /fleet)."""
         await self.refresh()
+        self.watchdog_tick(now)
         active = len(self._ids(ACTIVE))
         target = self.autoscaler.decide(self._window_metrics(),
                                         active, now)
@@ -342,6 +529,7 @@ class FleetManager:
         while True:
             try:
                 await self.refresh()
+                self.watchdog_tick()
                 now = time.monotonic()
                 if now - last_autoscale >= self.autoscale_period_s:
                     last_autoscale = now
@@ -374,7 +562,9 @@ class FleetManager:
           identical series from different replicas cannot collide or
           silently sum in the merged document.
         """
-        from ...util.metrics import merge_expositions, relabel_exposition
+        from ...util.metrics import (export_prometheus,
+                                     merge_expositions,
+                                     relabel_exposition)
 
         ids = self._ids(ACTIVE, DRAINING)
 
@@ -390,12 +580,16 @@ class FleetManager:
         texts = [t for t in await asyncio.gather(
             *(one(rid) for rid in ids)) if t is not None]
         if not texts:
-            return "\n"
+            return export_prometheus()
         if all(c.shares_registry for _, c, _ in texts):
             return texts[-1][2]
+        # separate registries: the ingress's own series (watchdog
+        # burn-rate gauges, alert counters) live in THIS process's
+        # registry — merge them in unrelabeled (they are fleet-scoped,
+        # not per-replica)
         return merge_expositions(
             [relabel_exposition(t, {"replica": rid})
-             for rid, _, t in texts])
+             for rid, _, t in texts] + [export_prometheus()])
 
     async def status(self) -> Dict[str, Any]:
         """The GET /fleet document: routing inputs per replica,
@@ -421,6 +615,19 @@ class FleetManager:
             "replicas": reps,
             "router": self.router.stats(),
             "admission": self.admission.stats(),
+            "watchdog": {
+                "enabled": self.watchdog.config.enabled,
+                "paging": self.watchdog.paging,
+                "state": dict(self.watchdog.state),
+                "burn": self.watchdog.last,
+                "alerts_total": self.watchdog.alerts_total,
+                "objective": self.watchdog.config.objective,
+            },
+            "tracing": {
+                "enabled": self.enable_tracing,
+                "ingress_buffer": self.trace.stats(),
+            },
+            "recorder": self.recorder.stats(),
             "autoscale": {
                 "min_replicas": self.autoscaler.config.min_replicas,
                 "max_replicas": self.autoscaler.config.max_replicas,

@@ -215,15 +215,25 @@ class FleetRouter:
              inflight: Dict[str, int]) -> Optional[str]:
         """Choose a replica for a request with this prefix
         fingerprint. None only when the ring is empty."""
+        return self.pick_ex(fingerprint, snapshots, inflight)[0]
+
+    def pick_ex(self, fingerprint: str,
+                snapshots: Dict[str, ReplicaSnapshot],
+                inflight: Dict[str, int]
+                ) -> "tuple[Optional[str], str]":
+        """pick() plus the decision OUTCOME ("affinity" | "spill" |
+        "scored" | "round_robin" | "none") — the routing-decision
+        trace span's payload (ISSUE 7), so a merged fleet trace shows
+        WHY a request landed where it did, not just where."""
         nodes = self.ring.nodes()
         if not nodes:
-            return None
+            return None, "none"
         self.picks += 1
         if self.config.policy == "round_robin":
             # skip the ring walk entirely: preferred() hashes the key
             # and walks up to vnodes*replicas points for an ordering
             # round-robin would discard
-            return nodes[next(self._rr) % len(nodes)]
+            return nodes[next(self._rr) % len(nodes)], "round_robin"
         order = self.ring.preferred(fingerprint)
 
         def _snap(rid: str) -> ReplicaSnapshot:
@@ -233,13 +243,13 @@ class FleetRouter:
             if not self._saturated(_snap(rid), inflight.get(rid, 0)):
                 if rank == 0:
                     self.affinity_hits += 1
-                else:
-                    self.spills += 1
-                return rid
+                    return rid, "affinity"
+                self.spills += 1
+                return rid, "spill"
         # every replica saturated: degrade gracefully to pure load
         self.scored_fallbacks += 1
         return min(order, key=lambda rid: self.score(
-            _snap(rid), inflight.get(rid, 0)))
+            _snap(rid), inflight.get(rid, 0))), "scored"
 
     def stats(self) -> Dict[str, Any]:
         return {

@@ -17,6 +17,7 @@ execute span, across processes and hosts.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
 import itertools
@@ -26,11 +27,88 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-_lock = threading.Lock()
-_events: List[Dict[str, Any]] = []
-_enabled = bool(os.environ.get("RAY_TPU_TRACE"))
 _MAX_EVENTS = 100_000
+
+
+class BoundedRing:
+    """Thread-safe deque(maxlen) ring with displacement accounting —
+    the shared bounded-buffer primitive (this module's event ring, the
+    serve.llm ingress trace buffer). A true ring: at capacity the
+    OLDEST item is displaced and counted, so a long-lived process
+    keeps the events that matter and a truncated buffer is legible as
+    truncated (`stats()["dropped"]`)."""
+
+    def __init__(self, capacity: int):
+        self._ring: "collections.deque[Any]" = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self.total = 0               # ever appended (monotone)
+        self.dropped = 0             # displaced by the capacity bound
+
+    def append(self, *items: Any) -> int:
+        """Append items; returns the new monotone total."""
+        with self._lock:
+            for it in items:
+                if len(self._ring) == self._ring.maxlen:
+                    self.dropped += 1
+                self._ring.append(it)
+                self.total += 1
+            return self.total
+
+    def items(self) -> List[Any]:
+        with self._lock:
+            return list(self._ring)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"capacity": self._ring.maxlen or 0,
+                    "events": len(self._ring), "total": self.total,
+                    "dropped": self.dropped}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.total = 0
+            self.dropped = 0
+
+    def tail_since(self, since_total: int) -> "tuple[List[Any], int]":
+        """Items appended after the `since_total`-th append that are
+        still resident (displaced ones are gone — counted, not
+        recoverable), plus the current total. The incremental-flush
+        primitive."""
+        with self._lock:
+            n = min(self.total - since_total, len(self._ring))
+            if n <= 0:
+                return [], self.total
+            return (list(itertools.islice(
+                self._ring, len(self._ring) - n, len(self._ring))),
+                self.total)
+
+
+# the process event ring (ring_stats() exposes its displaced count;
+# /debug/trace surfaces it in metadata)
+_ring = BoundedRing(_MAX_EVENTS)
+_enabled = bool(os.environ.get("RAY_TPU_TRACE"))
 _span_counter = itertools.count(1)
+
+# One wall-clock anchor per process (satellite of ISSUE 7): durations
+# and ordering must come from the MONOTONIC clock — an NTP step in
+# time.time() would otherwise skew every latency histogram and
+# misorder trace events — while cross-process trace alignment needs
+# epoch timestamps. The anchor is sampled once at import; converting
+# monotonic stamps through it yields epoch-like timestamps whose
+# DIFFERENCES are NTP-immune for the life of the process.
+_MONO_ANCHOR = time.time() - time.monotonic()
+
+
+def wall_anchor() -> float:
+    """This process's wall-clock anchor (epoch - monotonic at import)."""
+    return _MONO_ANCHOR
+
+
+def mono_to_epoch(mono_ts: float) -> float:
+    """Monotonic timestamp -> epoch seconds via the process anchor."""
+    return _MONO_ANCHOR + mono_ts
 # the ambient span: {"trace_id", "span_id"} (reference: the OTel
 # current-span context _DictPropagator serializes into task specs)
 _current: "contextvars.ContextVar[Optional[Dict[str, str]]]" = \
@@ -46,6 +124,16 @@ def _new_span_id() -> str:
     return f"{os.getpid():x}.{next(_span_counter):x}"
 
 
+def new_span_id() -> str:
+    """Mint a process-unique span/trace/flow id (public: the serve.llm
+    fleet ingress mints trace contexts without opening a span)."""
+    return _new_span_id()
+
+
+def _append(*evs: Dict[str, Any]) -> None:
+    _ring.append(*evs)
+
+
 def inject_context() -> Optional[Dict[str, str]]:
     """Serialize the ambient context for a task spec; emits the
     Perfetto flow-start so the consumer side can draw the arrow.
@@ -56,14 +144,12 @@ def inject_context() -> Optional[Dict[str, str]]:
     # one flow id PER SUBMISSION: reusing the span id would chain every
     # task submitted under one driver span into a single flow path
     flow_id = _new_span_id()
-    now = time.time_ns() / 1e3
-    with _lock:
-        if len(_events) < _MAX_EVENTS:
-            _events.append({
-                "name": "submit", "cat": "flow", "ph": "s",
-                "id": flow_id, "ts": now,
-                "pid": os.getpid(),
-                "tid": threading.get_ident() % 100000})
+    now = mono_to_epoch(time.monotonic()) * 1e6
+    _append({
+        "name": "submit", "cat": "flow", "ph": "s",
+        "id": flow_id, "ts": now,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 100000})
     return {**ctx, "flow_id": flow_id}
 
 
@@ -101,32 +187,35 @@ def span(name: str, category: str = "task",
     ctx = {"trace_id": (parent or {}).get("trace_id") or _new_span_id(),
            "span_id": _new_span_id()}
     token = _current.set(ctx)
-    start = time.time_ns()      # epoch: cross-process events must align
+    # monotonic for the duration (NTP-step immune), rendered as epoch
+    # through the per-process anchor so cross-process events align
+    start = time.monotonic()
     try:
         yield ctx
     finally:
-        end = time.time_ns()
+        end = time.monotonic()
         _current.reset(token)
         tid = threading.get_ident() % 100000
-        with _lock:
-            if len(_events) < _MAX_EVENTS:
-                if remote_parent:
-                    _events.append({
-                        "name": "submit", "cat": "flow", "ph": "f",
-                        "bp": "e",
-                        "id": parent.get("flow_id", parent["span_id"]),
-                        "ts": start / 1e3, "pid": os.getpid(),
-                        "tid": tid})
-                _events.append({
-                    "name": name, "cat": category, "ph": "X",
-                    "ts": start / 1e3, "dur": (end - start) / 1e3,
-                    "pid": os.getpid(), "tid": tid,
-                    "args": {**attrs,
-                             "trace_id": ctx["trace_id"],
-                             "span_id": ctx["span_id"],
-                             **({"parent_span_id": parent["span_id"]}
-                                if parent else {})},
-                })
+        start_us = mono_to_epoch(start) * 1e6
+        evs = []
+        if remote_parent:
+            evs.append({
+                "name": "submit", "cat": "flow", "ph": "f",
+                "bp": "e",
+                "id": parent.get("flow_id", parent["span_id"]),
+                "ts": start_us, "pid": os.getpid(),
+                "tid": tid})
+        evs.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": start_us, "dur": (end - start) * 1e6,
+            "pid": os.getpid(), "tid": tid,
+            "args": {**attrs,
+                     "trace_id": ctx["trace_id"],
+                     "span_id": ctx["span_id"],
+                     **({"parent_span_id": parent["span_id"]}
+                        if parent else {})},
+        })
+        _append(*evs)
 
 
 def complete_event(name: str, category: str, start_s: float,
@@ -158,13 +247,20 @@ def instant_event(name: str, category: str, ts_s: float,
 
 
 def get_events() -> List[Dict[str, Any]]:
-    with _lock:
-        return list(_events)
+    return _ring.items()
+
+
+def ring_stats() -> Dict[str, int]:
+    """Ring fill level + displacement: `dropped` counts events the
+    capacity bound displaced (surfaced in /debug/trace metadata so a
+    truncated trace is legible as truncated, not complete)."""
+    return _ring.stats()
 
 
 def clear() -> None:
-    with _lock:
-        _events.clear()
+    global _flushed_upto
+    _ring.clear()
+    _flushed_upto = 0
 
 
 _last_flush = 0.0
@@ -187,13 +283,15 @@ def flush_to_kv(min_interval_s: float = 1.0) -> None:
     client = _state.current_client_or_none()
     if client is None:
         return
-    with _lock:
-        new = _events[_flushed_upto:]
-        if not new:
-            return
-        _flushed_upto += len(new)
-        _flush_seq += 1
-        seq = _flush_seq
+    # NEW events addressed by the ring's monotone append counter —
+    # events displaced before a flush are simply gone (counted in
+    # ring_stats()["dropped"])
+    new, total = _ring.tail_since(_flushed_upto)
+    if not new:
+        return
+    _flushed_upto = total
+    _flush_seq += 1
+    seq = _flush_seq
     _last_flush = now
     wid = getattr(client, "worker_id", None) or f"pid{os.getpid()}"
     key = f"__trace__/{wid}/{seq:06d}"
@@ -245,4 +343,6 @@ def export_chrome_trace(path: Optional[str] = None,
 __all__ = ["enable", "disable", "is_enabled", "span", "get_events",
            "clear", "export_chrome_trace", "inject_context",
            "current_context", "flush_to_kv", "collect_cluster",
-           "complete_event", "instant_event"]
+           "complete_event", "instant_event", "ring_stats",
+           "new_span_id", "wall_anchor", "mono_to_epoch",
+           "BoundedRing"]

@@ -433,6 +433,9 @@ def test_observability_http_endpoints(ray_start):
         assert r.status_code == 200
         names = {e["name"] for e in r.json()["traceEvents"]}
         assert {"queued", "prefill", "decode"} <= names
+        # ISSUE 7 satellite: ring fill/drop counters ride the doc
+        ring = r.json()["metadata"]["m0"]["tracing_ring"]
+        assert ring["capacity"] > 0 and "dropped" in ring
 
         r = requests.get(f"{base}/debug/events", timeout=60)
         kinds = {e["event"] for e in r.json()["models"]["m0"]}

@@ -42,7 +42,8 @@ from ray_tpu.serve.llm import (AdmissionConfig, AdmissionController,
                                FleetAutoscaler, FleetManager,
                                FleetMetrics, FleetRouter, HashRing,
                                LocalReplicaClient, ReplicaSnapshot,
-                               RouterConfig, prefix_fingerprint)
+                               RouterConfig, WatchdogConfig,
+                               merge_fleet_traces, prefix_fingerprint)
 from ray_tpu.serve.llm.fleet import ACTIVE, DRAINING, STANDBY
 from ray_tpu.util import metrics as metrics_api
 
@@ -947,21 +948,46 @@ def test_e2e_dispatch_discipline_holds_per_replica(fleet_servers):
     """After fleet traffic, each replica's engine still honors the
     dispatch contract in steady-state decode: 16 consecutive ticks =
     16 dispatches, zero h2d transfers, zero new compiled programs
-    under the armed runtime guard."""
+    under the armed runtime guard.
+
+    ISSUE 7 acceptance: the replicas run with the FULL observability
+    layer on — trace-context-tagged requests (the fleet prime below
+    routes a traced request, and the direct guard requests carry
+    trace contexts too), SLO targets recording bad counts, the fleet
+    watchdog observing, black-box armed — and the tick cost is still
+    1 dispatch / 0 h2d / 0 compiles, because all of it is host-side
+    Python off the dispatch boundary."""
     from ray_tpu.llm._internal.engine import Request, SamplingParams
     from ray_tpu.util.jax_guard import dispatch_guard
+
+    fleet = _fleet_over(fleet_servers)      # tracing + watchdog on
+
+    async def prime():
+        out = await fleet.dispatch(
+            "completions", {"prompt": "guard trace probe",
+                            "max_tokens": 2})
+        assert out["choices"][0]["finish_reason"] is not None
+        await fleet.autoscale_tick(now=0.0)   # watchdog observes
+        _cancel_pumps(fleet_servers)
+
+    asyncio.run(prime())
+    assert fleet.enable_tracing and fleet.watchdog.config.enabled
+    assert fleet.trace.stats()["events"] > 0   # the ingress traced it
 
     rng = np.random.default_rng(3)
     for rid, srv in fleet_servers.items():
         eng = srv.engine
-        assert not eng.has_work(), f"{rid} left work behind"
+        while eng.has_work():                # drain the primed work
+            eng.step()
         rids = []
         for i in range(2):
             r = f"guard-{rid}-{i}"
             rids.append(r)
             eng.add_request(Request(
                 r, rng.integers(2, 250, 12).tolist(),
-                SamplingParams(max_tokens=64)))
+                SamplingParams(max_tokens=64),
+                trace={"trace_id": f"t-{r}", "span_id": f"s-{r}",
+                       "flow_id": f"f-{r}"}))
         while eng.waiting or any(s.request is not None and not s.ready
                                  for s in eng.slots):
             eng.step()
@@ -981,6 +1007,184 @@ def test_e2e_dispatch_discipline_holds_per_replica(fleet_servers):
             eng.abort(r)
         while eng.has_work():           # deliver pending folds
             eng.step()
+
+
+# ------------------------------- e2e: fleet observability (ISSUE 7)
+
+def test_e2e_fleet_trace_one_trace_id_across_processes(fleet_servers):
+    """Satellite + acceptance: one request through the fleet ingress
+    produces spans sharing ONE trace id across ingress (fleet_request,
+    admission_wait, routing_decision), router flow-start, and the
+    replica's engine lifecycle (queued/prefill/decode), with the
+    Perfetto flow arrow linking router to replica — and ?request_id=
+    filtering returns exactly that request's lifecycle."""
+    fleet = _fleet_over(fleet_servers)
+
+    async def main():
+        out = await fleet.dispatch(
+            "completions",
+            {"prompt": "distributed trace probe", "max_tokens": 3})
+        _cancel_pumps(fleet_servers)
+        return out
+
+    out = asyncio.run(main())
+    rid = out["id"][len("cmpl-"):]
+    docs = {r: srv.engine.chrome_trace()
+            for r, srv in fleet_servers.items()}
+    doc = merge_fleet_traces(docs, fleet.trace, request_id=rid)
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    assert evs, "filter returned nothing for a served request"
+    # exactly that request's lifecycle...
+    for e in evs:
+        assert e["args"]["request_id"] == rid
+    # ...sharing ONE trace id across ingress and replica events
+    trace_ids = {e["args"]["trace_id"] for e in evs
+                 if "trace_id" in e["args"]}
+    assert len(trace_ids) == 1
+    names = {e["name"] for e in evs}
+    assert {"fleet_request", "admission_wait", "routing_decision",
+            "queued", "prefill", "decode"} <= names, names
+    # the flow arrow: one start at the ingress routing span, one
+    # finish on the replica's request row, same flow id
+    flows = [e for e in evs if e.get("cat") == "flow"
+             and e["name"] == "route"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"]
+    # the ingress span names the replica that served it, and that
+    # replica's doc is where the lifecycle events came from
+    span = next(e for e in evs if e["name"] == "fleet_request")
+    assert span["args"]["status"] == "ok"
+    served = span["args"]["replica"]
+    assert served in fleet_servers
+    # timestamps are epoch-aligned: the merged doc orders ingress
+    # admission before the replica's prefill
+    t_admit = next(e for e in evs if e["name"] == "admission_wait")
+    t_prefill = next(e for e in evs if e["name"] == "prefill")
+    assert t_admit["ts"] <= t_prefill["ts"] + 1e3   # <=1ms anchor slop
+    # the UNFILTERED merge contains more than this one request
+    # (the module fixture served earlier traffic)
+    full = merge_fleet_traces(docs, fleet.trace)
+    assert len(full["traceEvents"]) > len(doc["traceEvents"])
+    assert full["metadata"]["ingress"]["buffer"]["events"] > 0
+
+
+_WD_ZERO = {"ttft_s": 0.0, "ttft_n": 0.0, "ttft_bad": 0.0,
+            "queue_s": 0.0, "queue_n": 0.0, "queue_bad": 0.0,
+            "e2e_s": 0.0, "e2e_n": 0.0, "e2e_bad": 0.0}
+
+
+def test_e2e_watchdog_pages_scales_up_and_brownouts():
+    """Acceptance: synthetic SLO burn drives the watchdog to page —
+    slo_alert lands in the fleet recorder, admission engages brownout,
+    the autoscaler treats the page as an instant breach and adds a
+    replica, a postmortem dump is triggered — and healthy traffic
+    clears all of it."""
+    async def main():
+        c0 = _FakeClient("r0", stats={"slo_totals": dict(_WD_ZERO)})
+        c1 = _FakeClient("r1", stats={"slo_totals": dict(_WD_ZERO)})
+        fleet = FleetManager(
+            [c0, c1],
+            autoscale=AutoscaleConfig(min_replicas=1, max_replicas=2,
+                                      upscale_delay_s=3.0),
+            watchdog=WatchdogConfig(short_window_s=10.0,
+                                    long_window_s=60.0,
+                                    min_observations=5,
+                                    page_burn_rate=2.0,
+                                    warn_burn_rate=1.0))
+        await fleet.autoscale_tick(now=0.0)
+        assert not fleet.watchdog.paging
+        assert not fleet.admission.brownout
+
+        # 12 of 20 requests blow their TTFT target: burn 6x in both
+        # windows -> page
+        c0._stats = {"slo_totals": {**_WD_ZERO, "ttft_n": 20.0,
+                                    "ttft_bad": 12.0,
+                                    "ttft_s": 10.0}}
+        await fleet.autoscale_tick(now=5.0)
+        assert fleet.watchdog.paging
+        assert fleet.admission.brownout              # shed early
+        status = await fleet.status()
+        assert status["watchdog"]["paging"] is True
+        assert status["watchdog"]["state"]["ttft"] == "page"
+        assert status["admission"]["brownout"] is True
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "slo_alert" in kinds and "brownout_on" in kinds
+        # the page also black-boxed the fleet (FakeClients error out
+        # of debug_dump, but the trigger breadcrumb must land)
+        if fleet._page_dump_task is not None:
+            await fleet._page_dump_task
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "postmortem_dump" in kinds
+
+        # the page is an instant breach: sustained past the upscale
+        # delay it adds the standby replica PRE-emptively
+        target = await fleet.autoscale_tick(now=9.0)
+        assert target == 2
+        assert fleet.replicas["r1"].status == ACTIVE
+        assert fleet.autoscaler.last_decision["slo_page"] is True
+
+        # healthy traffic cools the short window: page clears,
+        # brownout releases
+        c0._stats = {"slo_totals": {**_WD_ZERO, "ttft_n": 140.0,
+                                    "ttft_bad": 12.0,
+                                    "ttft_s": 11.0}}
+        await fleet.autoscale_tick(now=20.0)
+        assert not fleet.watchdog.paging
+        assert not fleet.admission.brownout
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "slo_clear" in kinds and "brownout_off" in kinds
+    asyncio.run(main())
+
+
+def test_e2e_guard_violation_bundle_fetchable_via_fleet(tmp_path):
+    """Acceptance: a forced guard violation on a replica produces a
+    postmortem bundle fetchable through the fleet surface, and
+    POST /debug/dump snapshots on demand."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm._internal.server import LLMServerImpl
+    from ray_tpu.util.jax_guard import GuardViolation, dispatch_guard
+
+    srv = LLMServerImpl({
+        "model_id": "bbm", "model_source": "debug",
+        "engine_kwargs": dict(
+            max_batch_size=2, page_size=8, num_pages=64,
+            prefill_buckets=(16,),
+            metrics_model_id=f"bb{uuid.uuid4().hex[:8]}",
+            blackbox_dir=str(tmp_path / "bb"))})
+    with pytest.raises(GuardViolation):
+        with dispatch_guard(max_compiles=0,
+                            recorder=srv.engine.telemetry.recorder):
+            jax.jit(lambda x: x - 3)(jnp.arange(5.0))
+
+    fleet = FleetManager([LocalReplicaClient("r0", srv)])
+
+    async def main():
+        listing = await fleet.replicas["r0"].client.call(
+            "debug_bundles")
+        assert listing, "guard violation produced no bundle"
+        assert listing[-1]["cause"] == "guard_violation"
+        bundle = await fleet.replicas["r0"].client.call(
+            "debug_bundle", listing[-1]["id"])
+        assert bundle["cause"] == "guard_violation"
+        assert bundle["alert_event"]["event"] == "guard_violation"
+        assert "metrics_exposition" in bundle
+        # unknown id -> None (the ingress turns this into a 404)
+        assert await fleet.replicas["r0"].client.call(
+            "debug_bundle", "nope") is None
+        # POST /debug/dump: on-demand snapshot adds a second bundle
+        out = await fleet.debug_dump_all("manual_probe")
+        assert out["r0"]["bundle"]
+        return await fleet.replicas["r0"].client.call("debug_bundles")
+
+    listing = asyncio.run(main())
+    assert len(listing) == 2
+    assert listing[-1]["cause"] == "manual_probe"
+    kinds = [e["event"] for e in fleet.recorder.events()]
+    assert "postmortem_dump" in kinds
 
 
 # --------------------------------- e2e: fleet app through serve.run
@@ -1030,6 +1234,46 @@ def test_fleet_app_local_testing_mode(fleet_servers):
         m = h.remote(req("GET", "/metrics")).result(timeout_s=30)
         assert m.status == 200
         assert f'model="{tag}"' in m.body
+
+        # ISSUE 7 surface through the ingress: merged fleet trace
+        # (ingress spans + replica lifecycles), merged flight
+        # recorders, on-demand black-box dump, bundle listing
+        tr = h.remote(req("GET", "/fleet/debug/trace")).result(
+            timeout_s=60)
+        names = {e["name"] for e in tr["traceEvents"]}
+        assert {"fleet_request", "routing_decision"} <= names
+        assert tr["metadata"]["ingress"]["buffer"]["events"] > 0
+        rid_q = next(e["args"]["request_id"]
+                     for e in tr["traceEvents"]
+                     if e["name"] == "fleet_request")
+        filt = h.remote(Request(
+            "GET", "/fleet/debug/trace", {"request_id": rid_q}, {},
+            b"")).result(timeout_s=60)
+        assert filt["traceEvents"] and all(
+            e["args"]["request_id"] == rid_q
+            for e in filt["traceEvents"] if e.get("ph") != "M")
+
+        ev = h.remote(req("GET", "/fleet/debug/events")).result(
+            timeout_s=60)
+        assert ev["object"] == "events"
+        assert any(e["replica"] == "r0" for e in ev["events"])
+
+        dmp = h.remote(req(
+            "POST", "/debug/dump",
+            json.dumps({"cause": "apptest"}).encode())).result(
+                timeout_s=60)
+        assert set(dmp["replicas"]) == {"r0", "r1"}
+        assert all(v.get("bundle") for v in dmp["replicas"].values())
+
+        bl = h.remote(req("GET", "/fleet/debug/bundles")).result(
+            timeout_s=60)
+        assert set(bl["replicas"]) == {"r0", "r1"}
+        assert bl["replicas"]["r0"][-1]["cause"] == "apptest"
+        one = h.remote(Request(
+            "GET", "/fleet/debug/bundles",
+            {"replica": "r0", "id": bl["replicas"]["r0"][-1]["id"]},
+            {}, b"")).result(timeout_s=60)
+        assert one["cause"] == "apptest"
 
         missing = h.remote(req("GET", "/no/such")).result(timeout_s=30)
         assert missing.status == 404
