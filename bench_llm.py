@@ -932,6 +932,142 @@ def bench_fleet_tracing(on_tpu: bool, smoke: bool = False) -> dict:
     return res
 
 
+def bench_chaos(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 9 chaos gate: sever one replica mid-bursty-bench and
+    prove the failure plane's contract — every client stream still
+    completes, every transcript is token-exact vs a single-replica
+    oracle (the failover continuation resumes the exact sequence),
+    the dead replica leaves the ring, and p99 e2e stays bounded (the
+    failover costs one re-route + one cached re-prefill, not a
+    restart). Greedy decode is batching- and fleet-independent, so
+    the oracle check covers the failover boundary exactly."""
+    import asyncio
+    import uuid
+
+    from ray_tpu.llm._internal.server import LLMServerImpl
+    from ray_tpu.serve.llm import (AdmissionConfig, AutoscaleConfig,
+                                   ChaosReplicaClient, ChaosSchedule,
+                                   FleetManager, HealthConfig,
+                                   LocalReplicaClient, RouterConfig)
+    from ray_tpu.serve.llm.fleet import UNHEALTHY
+    from ray_tpu.models import llama
+
+    if on_tpu and not smoke:
+        cfg = _tpu_bench_model()
+        n_req, rounds, gen, pages, batch = 8, 4, 24, 512, 8
+    else:
+        cfg = llama.config("debug")
+        n_req, rounds, gen, pages, batch = 6, 3, 8, 128, 4
+    tag = f"chaos{uuid.uuid4().hex[:8]}"
+    servers = {f"r{i}": LLMServerImpl({
+        "model_id": "bench", "model_source": cfg,
+        "engine_kwargs": dict(
+            max_batch_size=batch, page_size=8, num_pages=pages,
+            seed=7, metrics_model_id=tag, metrics_replica_id=f"r{i}"),
+    }) for i in range(2)}
+    schedules = {rid: ChaosSchedule(seed=13) for rid in servers}
+    victim = "r0"
+    # the victim's SECOND stream dies after 2 chunks — mid-burst,
+    # with sibling streams live on both replicas
+    schedules[victim].sever_stream(
+        after_chunks=2, method="completions_stream_tokens", at_call=1)
+    fleet = FleetManager(
+        [ChaosReplicaClient(LocalReplicaClient(rid, srv),
+                            schedules[rid])
+         for rid, srv in servers.items()],
+        router=RouterConfig(prefix_depth=64, spill_waiting=batch * 4),
+        admission=AdmissionConfig(max_concurrent=64, max_queue=128,
+                                  queue_wait_slo_s=60.0),
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        health=HealthConfig(open_cooldown_s=300.0),
+        model_id="bench")
+
+    def parse(chunks):
+        toks, reasons = [], []
+        for c in chunks:
+            payload = c[len("data: "):].strip()
+            if payload == "[DONE]":
+                continue
+            d = json.loads(payload)
+            ch = d["choices"][0]
+            toks += ch.get("token_ids") or []
+            if ch["finish_reason"] is not None:
+                reasons.append(ch["finish_reason"])
+        return toks, reasons
+
+    results = {}
+    e2es = []
+
+    async def one(prompt):
+        t0 = time.perf_counter()
+        chunks = []
+        async for c in fleet.dispatch_stream(
+                "completions_stream",
+                {"prompt": prompt, "max_tokens": gen}):
+            chunks.append(c)
+        e2es.append(time.perf_counter() - t0)
+        results[prompt] = parse(chunks)
+
+    async def drive():
+        for r in range(rounds):
+            await asyncio.gather(*(
+                one(f"chaos bench tenant {i} round {r}")
+                for i in range(n_req)))
+        for srv in servers.values():
+            if srv._pump is not None:
+                srv._pump.cancel()
+
+    asyncio.run(drive())
+
+    # oracle: fresh single replica, same weights seed
+    oracle = LLMServerImpl({
+        "model_id": "bench", "model_source": cfg,
+        "engine_kwargs": dict(
+            max_batch_size=batch, page_size=8, num_pages=pages,
+            seed=7, metrics_model_id=f"or{uuid.uuid4().hex[:8]}")})
+
+    async def oracle_toks(prompt):
+        out = []
+        async for c in oracle.completions_stream_tokens(
+                {"prompt": prompt, "max_tokens": gen}):
+            out.append(c)
+        return [t for c in out for t in c["toks"]]
+
+    async def oracle_drive():
+        want = {}
+        for p in results:
+            want[p] = await oracle_toks(p)
+        if oracle._pump is not None:
+            oracle._pump.cancel()
+        return want
+
+    want = asyncio.run(oracle_drive())
+    finished = sum(1 for toks, reasons in results.values()
+                   if len(reasons) == 1)
+    exact = sum(1 for p in results if results[p][0] == want[p])
+    fired = [f for s in schedules.values() for f in s.fired]
+    e2es.sort()
+    p99 = e2es[min(len(e2es) - 1, int(len(e2es) * 0.99))]
+    res = {
+        "requests": len(results),
+        "completed": finished,
+        "token_exact": exact,
+        "severs_fired": len(fired),
+        "failovers": sum(
+            v for _, v in fleet.metrics["failovers"]._samples()),
+        "victim_evicted": fleet.replicas[victim].status == UNHEALTHY,
+        "p99_e2e_s": round(p99, 3),
+        "median_e2e_s": round(e2es[len(e2es) // 2], 3),
+    }
+    # the contract asserts in every mode: chaos must never corrupt
+    assert res["severs_fired"] >= 1, res
+    assert res["completed"] == res["requests"], res
+    assert res["token_exact"] == res["requests"], res
+    assert res["victim_evicted"], res
+    assert res["p99_e2e_s"] <= 8.0, res
+    return res
+
+
 def main() -> None:
     import sys
     dev = jax.devices()[0]
@@ -945,6 +1081,7 @@ def main() -> None:
         async_ab = bench_async_ab(on_tpu, smoke=True)
         telemetry = bench_telemetry(on_tpu, smoke=True)
         fleet_tracing = bench_fleet_tracing(on_tpu, smoke=True)
+        chaos = bench_chaos(on_tpu, smoke=True)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
@@ -952,7 +1089,8 @@ def main() -> None:
             "detail": {**mixed, "kernel_tick": kernel,
                        "async_readback_ab": async_ab,
                        "telemetry": telemetry,
-                       "fleet_tracing": fleet_tracing},
+                       "fleet_tracing": fleet_tracing,
+                       "chaos": chaos},
         }))
         return
     if "--fleet" in sys.argv:

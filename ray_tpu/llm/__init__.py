@@ -51,6 +51,20 @@ ISSUE 7 fleet-scoped metric additions (ingress registry):
                                                        exactly at the allowed rate
     ray_tpu_llm_slo_alerts_total            counter    watchdog page transitions, + `slo`
 
+ISSUE 9 failure-plane metric additions (ingress registry; details:
+BENCH_CORE.md "Fault tolerance anatomy"):
+
+    name                                    type       notes
+    ray_tpu_llm_failovers_total             counter    re-dispatches after a replica
+                                                       failure (token-exact mid-stream
+                                                       continuations + unary retries)
+    ray_tpu_llm_replica_evictions_total     counter    health-state-machine ring evictions
+    ray_tpu_llm_breaker_state               gauge      per `replica`: 0 closed / 1 open /
+                                                       2 half-open
+    ray_tpu_llm_deadline_sheds_total        counter    + `stage` (admission|engine):
+                                                       requests shed/aborted past their
+                                                       client `deadline_s`
+
 Single-replica metric catalogue:
 
     name                                    type       notes
@@ -60,7 +74,8 @@ Single-replica metric catalogue:
     ray_tpu_llm_e2e_latency_seconds         histogram  queued -> finished
     ray_tpu_llm_prompt_tokens_total         counter    admitted prompt tokens
     ray_tpu_llm_generated_tokens_total      counter    emitted output tokens
-    ray_tpu_llm_finished_total              counter    + `reason` tag (stop|length|abort)
+    ray_tpu_llm_finished_total              counter    + `reason` tag
+                                                       (stop|length|abort|deadline)
     ray_tpu_llm_aborts_total                counter    client-gone aborts
     ray_tpu_llm_drains_total                counter    tick-pipeline barriers
     ray_tpu_llm_running_requests            gauge      slots occupied

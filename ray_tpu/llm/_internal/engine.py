@@ -32,6 +32,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import hashlib
 import itertools
 import json
 import threading
@@ -110,8 +111,9 @@ class EngineConfig:
     # preallocated pages). The direct lever for dispatch-latency-bound
     # links (the axon tunnel measured ~145 ms/call against a ~3 ms
     # compute floor): K steps amortize one dispatch + one readback.
-    # Greedy and penalty outputs are step-exact vs K=1; sampled
-    # streams differ only in RNG key split structure. Applied only
+    # Greedy, penalty AND sampled outputs are step-exact vs K=1
+    # (sampling keys derive from (request seed, token index), not a
+    # per-dispatch split chain — ISSUE 9). Applied only
     # when nothing is prefilling/waiting, so the chunked-prefill
     # no-stall contract keeps its one-step cadence; single device or
     # tp (pp and speculative have their own paths).
@@ -200,6 +202,16 @@ class SamplingParams:
     top_k: int = 0                       # 0 → off
     repetition_penalty: float = 1.0      # 1.0 → off (CTRL-style)
     stop_token_ids: tuple = ()
+    # Per-request RNG seed (ISSUE 9). None derives a stable seed from
+    # the request id (derive_seed), so EVERY sampled request is
+    # replayable: the sampling key for the token at absolute index i
+    # is fold_in(PRNGKey(seed), i) — independent of tick count,
+    # batching, and which program (prefill / chunked / ragged /
+    # decode) produces it. That makes sampled mid-stream failover
+    # token-exact: a continuation re-prefilled from prompt + emitted
+    # tokens resumes the exact sample sequence. (pp>1 engines keep
+    # the legacy shared-key sampling; their greedy path is unaffected.)
+    seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -224,6 +236,11 @@ class Request:
     # carried into the telemetry timeline so one trace id follows the
     # request across ingress, router, and replica processes
     trace: Optional[Dict[str, str]] = None
+    # absolute MONOTONIC deadline (ISSUE 9): the engine aborts the
+    # request at the next fold boundary once time.monotonic() passes
+    # it (finish_reason="deadline"), whether it is still waiting for
+    # admission or holding a decode slot. None = no deadline.
+    deadline: Optional[float] = None
 
 
 class _Slot:
@@ -235,6 +252,7 @@ class _Slot:
         self.last_token = 0
         self.prefill_pos = 0     # prompt tokens cached (< len => prefilling)
         self.ready = False       # prompt fully prefilled, decoding
+        self.seed = 0            # resolved per-request sampling seed
 
 
 @dataclasses.dataclass
@@ -248,8 +266,32 @@ class _InflightTick:
     active: "np.ndarray"            # host active mask at dispatch
 
 
+def derive_seed(request_id: str) -> int:
+    """Default per-request sampling seed: a stable 31-bit hash of the
+    request id (ISSUE 9). Stable across processes and engine restarts,
+    so a failover continuation carrying the original request's id (or
+    its explicitly pinned seed) replays the exact sample sequence."""
+    return int.from_bytes(
+        hashlib.sha1(str(request_id).encode()).digest()[:4],
+        "big") & 0x7FFFFFFF
+
+
+def _row_sample_keys(seeds, idx):
+    """Per-row sampling keys for per-request deterministic sampling
+    (ISSUE 9): fold the ABSOLUTE index of the token being sampled into
+    a key derived from the request's seed. The key depends only on
+    (seed, token index) — never on tick count, batch composition, or
+    which program (prefill / chunk / ragged / decode) produces the
+    token — so a failover continuation re-prefilled from the original
+    prompt + already-emitted tokens samples the same suffix the dead
+    replica would have."""
+    return jax.vmap(
+        lambda s, i: jax.random.fold_in(jax.random.PRNGKey(s), i)
+    )(seeds, idx)
+
+
 def _sample(logits, key, temps, top_ps, top_ks=None, rep_pens=None,
-            seen=None, all_greedy: bool = False):
+            seen=None, all_greedy: bool = False, row_keys=None):
     """logits: (B, V) f32; temps/top_ps/top_ks/rep_pens: (B,);
     seen: (B, V) bool — tokens already in each sequence (prompt +
     generated), the repetition-penalty support. Greedy where temp<=0.
@@ -262,6 +304,10 @@ def _sample(logits, key, temps, top_ps, top_ks=None, rep_pens=None,
     over the vocab is the expensive part of sampling on TPU and pure
     argmax decoding (the common batch-inference case) never needs it
     (the engine only sets it when every penalty is off too).
+
+    row_keys: optional (B,) per-row PRNG keys (_row_sample_keys) —
+    the per-request deterministic path; `key` is the legacy shared
+    key, kept for the pp stage programs and direct callers.
     """
     if rep_pens is not None and seen is not None:
         pen = jnp.where(logits > 0,
@@ -289,7 +335,10 @@ def _sample(logits, key, temps, top_ps, top_ks=None, rep_pens=None,
     keep = jnp.zeros_like(keep_sorted).at[
         jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
     filtered = jnp.where(keep, scaled, -jnp.inf)
-    sampled = jax.random.categorical(key, filtered, axis=-1)
+    if row_keys is not None:
+        sampled = jax.vmap(jax.random.categorical)(row_keys, filtered)
+    else:
+        sampled = jax.random.categorical(key, filtered, axis=-1)
     return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
@@ -335,6 +384,17 @@ class InferenceEngine:
         cfg, ec = self.model_cfg, config
         self.mesh, self.stages = self._build_placement(ec.mesh, cfg)
         self.pp = len(self.stages) if self.stages else 1
+        if self.pp > 1:
+            import logging
+            # be loud about the ISSUE 9 caveat: the pp stage programs
+            # keep legacy shared-key sampling, so SamplingParams.seed
+            # is ignored there — sampled failover continuations on pp
+            # replicas are NOT token-exact (greedy ones are)
+            logging.getLogger(__name__).warning(
+                "pp>1 engine: per-request seeded sampling is "
+                "unavailable on the pipeline-parallel path; sampled "
+                "(temperature>0) failover replay is not token-exact "
+                "on this replica")
         if params is None and ec.checkpoint:
             from ...models import checkpoint_io
             # sharded load: each device's shard is a windowed mmap read
@@ -470,7 +530,7 @@ class InferenceEngine:
             }
         self._decode_fn = jax.jit(
             self._build_decode(), donate_argnums=(1, 2, 3),
-            static_argnums=(15,))
+            static_argnums=(16,))
         self._multi_decode_fn = None
         if int(ec.decode_steps_per_call or 1) > 1:
             if self.pp > 1:
@@ -480,9 +540,10 @@ class InferenceEngine:
             self._multi_decode_fn = jax.jit(
                 self._build_multi_decode(
                     int(ec.decode_steps_per_call)),
-                donate_argnums=(1, 2, 3), static_argnums=(16,))
+                donate_argnums=(1, 2, 3), static_argnums=(17,))
         self._d_tokens = None          # device-resident slot state
         self._d_seen = None
+        self._d_seeds = None           # per-slot sampling seeds (B,)
         self._host_active = np.zeros(ec.max_batch_size, bool)
         self._prefill_fns: Dict[int, Any] = {}
         self._chunk_fns: Dict[int, Any] = {}
@@ -658,7 +719,7 @@ class InferenceEngine:
 
         def step(params, k_pages, v_pages, seen, tokens, positions,
                  page_tables, active, key, temps, top_ps, top_ks,
-                 rep_pens, lora, lora_idx, all_greedy):
+                 rep_pens, seeds, lora, lora_idx, all_greedy):
             logits, k_pages, v_pages = decode_step(
                 cfg, params, tokens, positions, k_pages, v_pages,
                 page_tables, active, impl=impl, mesh=mesh,
@@ -669,8 +730,13 @@ class InferenceEngine:
                 new_tokens = _sample(logits, key, temps, top_ps,
                                      all_greedy=True)
                 return new_tokens, k_pages, v_pages, seen
+            # the fed token sits at `positions`; the sampled one lands
+            # at positions+1 — the absolute index the per-request key
+            # is derived from (see _row_sample_keys)
+            row_keys = _row_sample_keys(seeds, positions + 1)
             new_tokens = _sample(logits, key, temps, top_ps, top_ks,
-                                 rep_pens, seen, False)
+                                 rep_pens, seen, False,
+                                 row_keys=row_keys)
             b = tokens.shape[0]
             seen = seen.at[jnp.arange(b), new_tokens].max(active)
             return new_tokens, k_pages, v_pages, seen
@@ -687,24 +753,24 @@ class InferenceEngine:
 
         def multi(params, k_pages, v_pages, seen, tokens, positions,
                   page_tables, active, key, temps, top_ps, top_ks,
-                  rep_pens, lora, lora_idx, budget, all_greedy):
-            keys = jax.random.split(key, k_steps)
-
-            def body(carry, xs):
+                  rep_pens, seeds, lora, lora_idx, budget, all_greedy):
+            def body(carry, i):
                 tokens, positions, k_pages, v_pages, seen = carry
-                subkey, i = xs
                 act_i = jnp.logical_and(active, budget > i)
+                # per-request keys come from (seed, absolute position)
+                # inside step(), so sub-steps need no split chain —
+                # multi-step sampled decode is now step-exact vs K=1
                 toks, k_pages, v_pages, seen = step(
                     params, k_pages, v_pages, seen, tokens, positions,
-                    page_tables, act_i, subkey, temps, top_ps, top_ks,
-                    rep_pens, lora, lora_idx, all_greedy)
+                    page_tables, act_i, key, temps, top_ps, top_ks,
+                    rep_pens, seeds, lora, lora_idx, all_greedy)
                 positions = positions + act_i
                 return (toks, positions, k_pages, v_pages, seen), toks
 
             (tokens, positions, k_pages, v_pages, seen), out = \
                 jax.lax.scan(
                     body, (tokens, positions, k_pages, v_pages, seen),
-                    (keys, jnp.arange(k_steps)))
+                    jnp.arange(k_steps))
             return out, tokens, positions, k_pages, v_pages, seen
 
         return multi
@@ -716,7 +782,7 @@ class InferenceEngine:
 
             def run(params, k_pages, v_pages, tokens, true_lens,
                     page_tables, key, temps, top_ps, top_ks, rep_pens,
-                    lora, lora_idx):
+                    seeds, lora, lora_idx):
                 logits, k_pages, v_pages = prefill(
                     cfg, params, tokens, true_lens, k_pages, v_pages,
                     page_tables, lora=lora, lora_idx=lora_idx)
@@ -726,8 +792,13 @@ class InferenceEngine:
                 valid = jnp.arange(bucket_len)[None, :] < true_lens[:, None]
                 seen = jnp.zeros((b, cfg.vocab_size), bool)
                 seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+                # the first generated token sits at absolute index
+                # true_lens (= prompt length): same key a decode tick
+                # would derive for it
                 first = _sample(logits, key, temps, top_ps, top_ks,
-                                rep_pens, seen)
+                                rep_pens, seen,
+                                row_keys=_row_sample_keys(seeds,
+                                                          true_lens))
                 return first, k_pages, v_pages
 
             # donation audit (JL002/JL003, vs the unified jit's
@@ -751,7 +822,7 @@ class InferenceEngine:
 
             def run(params, k_pages, v_pages, tokens, start_pos,
                     chunk_lens, page_tables, key, temps, top_ps,
-                    top_ks, rep_pens, seen, lora, lora_idx):
+                    top_ks, rep_pens, seen, seeds, lora, lora_idx):
                 logits, k_pages, v_pages = prefill_chunk(
                     cfg, params, tokens, start_pos, chunk_lens,
                     k_pages, v_pages, page_tables, ctx_pages=ctx_pages,
@@ -759,8 +830,13 @@ class InferenceEngine:
                 b, bucket_len = tokens.shape
                 valid = jnp.arange(bucket_len)[None, :] < chunk_lens[:, None]
                 seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+                # the sample only COUNTS on the final chunk, where
+                # start_pos + chunk_lens == prompt length — the same
+                # absolute index the whole-prompt path keys on
                 first = _sample(logits, key, temps, top_ps, top_ks,
-                                rep_pens, seen)
+                                rep_pens, seen,
+                                row_keys=_row_sample_keys(
+                                    seeds, start_pos + chunk_lens))
                 return first, k_pages, v_pages
 
             # donation audit (JL002, vs the unified jit's
@@ -814,8 +890,8 @@ class InferenceEngine:
         on TPU, dense gather on CPU, pallas_interpret for tests).
 
         Host state arrives PACKED — tok_meta (5, T) int32 rows
-        tokens/slot_ids/positions/valid/lora_idx, slot_meta (3, B)
-        int32 rows start/last_idx/emit, samp (4, B) f32 rows
+        tokens/slot_ids/positions/valid/lora_idx, slot_meta (4, B)
+        int32 rows start/last_idx/emit/seed, samp (4, B) f32 rows
         temps/top_ps/top_ks/rep_pens — so a tick uploads two small
         arrays (tok_meta, slot_meta) instead of ~10; samp is cached
         across ticks (see _sampling_cache)."""
@@ -840,6 +916,7 @@ class InferenceEngine:
                 lora_idx = tok_meta[4]
                 start, last_idx = slot_meta[0], slot_meta[1]
                 emit = slot_meta[2] != 0
+                seeds = slot_meta[3]
                 temps, top_ps, rep_pens = samp[0], samp[1], samp[3]
                 top_ks = samp[2].astype(jnp.int32)
                 logits, k_pages, v_pages = ragged_forward(
@@ -855,8 +932,14 @@ class InferenceEngine:
                 # (prompt tokens penalize too, HF semantics; for a
                 # decoding slot the one token is already seen — no-op)
                 seen = seen.at[slot_ids, tokens].max(valid)
+                # each slot's sample lands one past its last packed
+                # token — the same absolute index the decode and
+                # prefill programs key on, so a request samples
+                # identically whichever program serves its tick
+                row_keys = _row_sample_keys(
+                    seeds, positions[last_idx] + 1)
                 toks = _sample(logits, key, temps, top_ps, top_ks,
-                               rep_pens, seen)
+                               rep_pens, seen, row_keys=row_keys)
                 b = logits.shape[0]
                 # only emitting slots keep their sample (mid-prefill
                 # samples are discarded host-side, so they must not
@@ -1041,7 +1124,7 @@ class InferenceEngine:
     def _ragged_step(self, touched: List[Request]) -> None:
         """One unified tick: pack, dispatch the single ragged program,
         fold the one readback into slot state. Host->device traffic
-        per tick: ONE (5, T) token-meta upload + ONE (3, B) slot-meta
+        per tick: ONE (5, T) token-meta upload + ONE (4, B) slot-meta
         upload (page tables and sampling params ride their caches)."""
         self._refresh_seen()      # early-outs when nothing is dirty
         plan = self._pack_ragged()
@@ -1051,8 +1134,8 @@ class InferenceEngine:
         T = self._token_bucket(total)
         # rows: tokens / slot_ids / positions / valid / lora_idx
         tok_meta = np.zeros((5, T), np.int32)
-        # rows: start / last_idx / emit
-        slot_meta = np.zeros((3, B), np.int32)
+        # rows: start / last_idx / emit / sampling seed
+        slot_meta = np.zeros((4, B), np.int32)
         max_start = 0
         cur = 0
         for s, n, is_pref in plan:
@@ -1073,6 +1156,7 @@ class InferenceEngine:
             slot_meta[2, s.index] = ((not is_pref)
                                      or s.prefill_pos + n
                                      >= len(req.prompt_tokens))
+            slot_meta[3, s.index] = s.seed
             max_start = max(max_start, pos0)
             cur += n
         samp, all_greedy = self._sampling_cache()
@@ -1945,6 +2029,10 @@ class InferenceEngine:
         return need <= self.allocator.free_pages
 
     def _step_tick(self, touched: List[Request]) -> None:
+        # deadline expiry first (ISSUE 9): an expired request must not
+        # consume this tick's budget, and an expired WAITING request
+        # must not claim the slot a live one could take
+        self._expire_deadlines(touched)
         # admission and prefill are structural events: the in-flight
         # tick (if any) folds BEFORE slot state moves. A backed-up
         # waiting queue that CANNOT admit (no free slot, or pages
@@ -1995,6 +2083,66 @@ class InferenceEngine:
         return reqs
 
     # -- internals ----------------------------------------------------------
+    @staticmethod
+    def _request_seed(req: Request) -> int:
+        """The slot's sampling seed: an explicit SamplingParams.seed
+        wins; otherwise a stable hash of the request id (ISSUE 9 —
+        either way the sample sequence is replayable given the
+        request's identity)."""
+        if req.params.seed is not None:
+            return int(req.params.seed) & 0x7FFFFFFF
+        return derive_seed(req.request_id)
+
+    def _expire_deadlines(self, touched: List[Request]) -> None:
+        """Fold-boundary deadline enforcement (ISSUE 9): at each tick
+        entry, requests past their deadline finish with
+        finish_reason="deadline" — running slots through the same
+        teardown abort() uses (drain the in-flight tick first: a
+        retirement is structural), waiting requests straight out of
+        the queue. Zero cost when no live request carries a deadline."""
+        has_slot_ddl = any(
+            s.request is not None and s.request.deadline is not None
+            for s in self.slots)
+        has_wait_ddl = any(r.deadline is not None for r in self.waiting)
+        if not has_slot_ddl and not has_wait_ddl:
+            return
+        now = time.monotonic()
+        if has_slot_ddl:
+            expired = [s for s in self.slots
+                       if s.request is not None
+                       and s.request.deadline is not None
+                       and now >= s.request.deadline]
+            if expired:
+                self._drain(touched)
+                dirty = False
+                for s in expired:
+                    req = s.request
+                    if req is None or req.finished:
+                        continue     # finished inside the drain fold
+                    self.telemetry.recorder.record(
+                        "deadline_abort", request_id=req.request_id,
+                        where="running",
+                        generated=len(req.output_tokens))
+                    self._finish(s, "deadline")
+                    touched.append(req)
+                    dirty = True
+                if dirty:
+                    self._refresh_device_state()
+        if has_wait_ddl:
+            keep: List[Request] = []
+            for req in self.waiting:
+                if req.deadline is not None and now >= req.deadline:
+                    req.finished = True
+                    req.finish_reason = "deadline"
+                    self.telemetry.recorder.record(
+                        "deadline_abort", request_id=req.request_id,
+                        where="waiting")
+                    self.telemetry.on_finished(req, "deadline")
+                    touched.append(req)
+                else:
+                    keep.append(req)
+            self.waiting = keep
+
     def _admit(self) -> None:
         """Claim slots + KV pages for waiting requests (prefix-cache
         match decides where their prefill starts); the prefill itself
@@ -2019,6 +2167,7 @@ class InferenceEngine:
             slot.prefill_pos = matched
             slot.ready = False
             slot.position = 0
+            slot.seed = self._request_seed(req)
             table = np.zeros(self.max_pages_per_seq, np.int32)
             table[:len(slot.pages)] = slot.pages
             self._page_tables[slot.index] = table
@@ -2058,6 +2207,7 @@ class InferenceEngine:
         top_ks = self._dev(jnp.asarray([p.top_k], jnp.int32))
         rep_pens = self._dev(jnp.asarray(
             [p.repetition_penalty], jnp.float32))
+        seeds = self._dev(jnp.asarray([slot.seed], jnp.int32))
 
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
             # whole prompt in one go: the dense full-causal program
@@ -2071,7 +2221,7 @@ class InferenceEngine:
                 self.params, self.k_pages, self.v_pages,
                 self._dev(jnp.asarray(tokens)),
                 self._dev(jnp.asarray([n], jnp.int32)),
-                table, sub, temps, top_ps, top_ks, rep_pens,
+                table, sub, temps, top_ps, top_ks, rep_pens, seeds,
                 self._lora_stacks, lidx)
             self._finish_prefill(slot, int(self._read_tokens(first)[0]),
                                  touched)
@@ -2089,7 +2239,7 @@ class InferenceEngine:
             self._dev(jnp.asarray([slot.prefill_pos], jnp.int32)),
             self._dev(jnp.asarray([chunk], jnp.int32)),
             table, sub, temps, top_ps, top_ks, rep_pens,
-            self._dev(jnp.asarray(prior)),
+            self._dev(jnp.asarray(prior)), seeds,
             self._lora_stacks, lidx)
         slot.prefill_pos += chunk
         if slot.prefill_pos >= n:
@@ -2148,6 +2298,7 @@ class InferenceEngine:
         top_ps = np.ones(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         rep_pens = np.ones(B, np.float32)
+        seeds = np.zeros(B, np.int32)
         seen = self._build_seen()
         for s in self.slots:
             if s.request is None or not s.ready:
@@ -2160,6 +2311,7 @@ class InferenceEngine:
             top_ps[s.index] = p.top_p
             top_ks[s.index] = p.top_k
             rep_pens[s.index] = p.repetition_penalty
+            seeds[s.index] = s.seed
         if self.pp > 1 and self.pp_mb > 1:
             # overlapped decode: per-MICROBATCH slices of every state
             # array (contiguous slot ranges), per stage where needed
@@ -2216,6 +2368,7 @@ class InferenceEngine:
             self._d_top_ps = self._dev(jnp.asarray(top_ps))
             self._d_top_ks = self._dev(jnp.asarray(top_ks))
             self._d_rep_pens = self._dev(jnp.asarray(rep_pens))
+            self._d_seeds = self._dev(jnp.asarray(seeds))
             lora_idx = np.zeros(B, np.int32)
             for s2 in self.slots:
                 if s2.request is not None and s2.ready:
@@ -2304,7 +2457,7 @@ class InferenceEngine:
                 self.params, self.k_pages, self.v_pages, self._d_seen,
                 self._d_tokens, self._d_positions, self._d_tables,
                 self._d_active, sub, self._d_temps, self._d_top_ps,
-                self._d_top_ks, self._d_rep_pens,
+                self._d_top_ks, self._d_rep_pens, self._d_seeds,
                 self._lora_stacks, self._d_lora_idx,
                 self._all_greedy)
         # device-side feedback for the next step
@@ -2357,7 +2510,7 @@ class InferenceEngine:
             self.params, self.k_pages, self.v_pages, self._d_seen,
             self._d_tokens, self._d_positions, self._d_tables,
             self._d_active, sub, self._d_temps, self._d_top_ps,
-            self._d_top_ks, self._d_rep_pens,
+            self._d_top_ks, self._d_rep_pens, self._d_seeds,
             self._lora_stacks, self._d_lora_idx,
             self._dev(jnp.asarray(budget)), self._all_greedy)
         self._d_tokens = last

@@ -20,6 +20,19 @@ from typing import Any, Dict, List, Optional
 from .engine import EngineConfig, InferenceEngine, Request, SamplingParams
 from .tokenizer import load_tokenizer
 
+# max_tokens when the body omits it — ALSO the value the fleet pins on
+# a stream before its first dispatch (failover continuations decrement
+# it), so it lives here once and the fleet imports it
+DEFAULT_MAX_TOKENS = 32
+
+# body keys minted by the fleet ingress (ISSUE 7/9 plumbing): every
+# public ingress must strip client-supplied values — a forged
+# `_request_id` could replay/abort another request, `_continue_tokens`
+# injects raw token ids, `_deadline_epoch` bypasses `deadline_s`. One
+# canonical list; the fleet imports it too.
+INTERNAL_BODY_KEYS = ("_request_id", "_trace", "_deadline_epoch",
+                      "_continue_tokens", "_token_offset")
+
 
 class LLMServerImpl:
     """The deployment class body (decorated at app-build time)."""
@@ -70,7 +83,12 @@ class LLMServerImpl:
             for req in touched:
                 q = self._queues.get(req.request_id)
                 if q is not None:
-                    q.put_nowait((req.output_tokens[-1], req.finished,
+                    # a deadline expiry in the waiting queue finishes
+                    # a request that never produced a token — the
+                    # event must still reach its stream
+                    tok = (req.output_tokens[-1]
+                           if req.output_tokens else None)
+                    q.put_nowait((tok, req.finished,
                                   req.finish_reason))
             await asyncio.sleep(0)
 
@@ -114,11 +132,46 @@ class LLMServerImpl:
         return (str(rid) if rid else None,
                 dict(trace) if isinstance(trace, dict) else None)
 
+    @staticmethod
+    def _deadline_of(body: Dict[str, Any]) -> "float | None":
+        """Pop the request deadline (ISSUE 9) as an absolute MONOTONIC
+        instant: `_deadline_epoch` (absolute wall clock, minted at the
+        fleet ingress so it survives process hops) wins over a direct
+        client `deadline_s` (seconds from now). The engine aborts the
+        request at the first fold boundary past it."""
+        ep = body.pop("_deadline_epoch", None)
+        if ep is not None:
+            return time.monotonic() + (float(ep) - time.time())
+        ds = body.get("deadline_s")
+        if ds is not None:
+            return time.monotonic() + float(ds)
+        return None
+
+    def _prompt_tokens(self, body: Dict[str, Any],
+                       chat: bool) -> List[int]:
+        """Encode the request's prompt — plus `_continue_tokens`, the
+        failover continuation's already-emitted output ids (ISSUE 9):
+        the fleet re-dispatches a severed stream as the ORIGINAL
+        prompt with the delivered tokens appended, so the new replica
+        re-prefills (cheaply, via the prefix cache) and resumes the
+        exact token sequence."""
+        if chat:
+            prompt = self.tokenizer.apply_chat_template(
+                body.get("messages") or [])
+        else:
+            prompt = str(body.get("prompt") or "")
+        toks = self.tokenizer.encode(prompt)
+        cont = body.get("_continue_tokens")
+        if cont:
+            toks = toks + [int(t) for t in cont]
+        return toks
+
     async def _generate(self, prompt_tokens: List[int],
                         params: SamplingParams,
                         lora: "str | None" = None,
                         rid: "str | None" = None,
-                        trace: "Dict[str, str] | None" = None
+                        trace: "Dict[str, str] | None" = None,
+                        deadline: "float | None" = None
                         ) -> Request:
         self._ensure_pump()
         # a rid already in flight (a client replaying another request's
@@ -128,7 +181,7 @@ class LLMServerImpl:
         if not rid or rid in self._queues:
             rid = uuid.uuid4().hex[:16]
         req = Request(rid, prompt_tokens, params, lora=lora,
-                      trace=trace)
+                      trace=trace, deadline=deadline)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -163,24 +216,27 @@ class LLMServerImpl:
         eos = getattr(self.tokenizer, "eos_id",
                       getattr(self.tokenizer, "eos_token_id", None))
         stop = (eos,) if eos is not None else ()
-        return SamplingParams(
-            max_tokens=int(body.get("max_tokens") or 32),
+        seed = body.get("seed")          # OpenAI param; None derives
+        return SamplingParams(           # from the request id
+            max_tokens=int(body.get("max_tokens")
+                           or DEFAULT_MAX_TOKENS),
             temperature=float(body.get("temperature") or 0.0),
             top_p=float(body.get("top_p") or 1.0),
             # OpenAI-API extensions every serving stack grew (vLLM/TGI)
             top_k=int(body.get("top_k") or 0),
             repetition_penalty=float(
                 body.get("repetition_penalty") or 1.0),
-            stop_token_ids=stop)
+            stop_token_ids=stop,
+            seed=None if seed is None else int(seed))
 
     async def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
         rid, trace = self._trace_of(body)
-        prompt = self.tokenizer.apply_chat_template(
-            body.get("messages") or [])
-        toks = self.tokenizer.encode(prompt)
+        deadline = self._deadline_of(body)
+        toks = self._prompt_tokens(body, chat=True)
         req = await self._generate(toks, self._sampling(body),
                                    lora=self._lora_for(body),
-                                   rid=rid, trace=trace)
+                                   rid=rid, trace=trace,
+                                   deadline=deadline)
         text = self.tokenizer.decode(req.output_tokens)
         return {
             "id": f"chatcmpl-{req.request_id}",
@@ -201,10 +257,12 @@ class LLMServerImpl:
 
     async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         rid, trace = self._trace_of(body)
-        toks = self.tokenizer.encode(str(body.get("prompt") or ""))
+        deadline = self._deadline_of(body)
+        toks = self._prompt_tokens(body, chat=False)
         req = await self._generate(toks, self._sampling(body),
                                    lora=self._lora_for(body),
-                                   rid=rid, trace=trace)
+                                   rid=rid, trace=trace,
+                                   deadline=deadline)
         return {
             "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
@@ -226,32 +284,47 @@ class LLMServerImpl:
                                params: SamplingParams,
                                lora: "str | None" = None,
                                rid: "str | None" = None,
-                               trace: "Dict[str, str] | None" = None):
-        """Yield (token_text, finished, finish_reason) as tokens land."""
+                               trace: "Dict[str, str] | None" = None,
+                               deadline: "float | None" = None,
+                               decode_ctx: "List[int] | None" = None):
+        """Yield (new_tokens, text_delta, finished, finish_reason) as
+        tokens land — token ids AND text per event, so both the SSE
+        wrappers (text) and the fleet's failover relay (token-exact
+        dedup, ISSUE 9) consume one stream.
+
+        decode_ctx: tokens the CLIENT already holds (a failover
+        continuation's `_continue_tokens`) — deltas are decoded with
+        them as context, so a multi-byte character whose tokens span
+        the failover boundary renders correctly instead of as two
+        replacement characters."""
         self._ensure_pump()
         if not rid or rid in self._queues:   # see _generate: a replayed
             rid = uuid.uuid4().hex[:16]      # id must never collide
         req = Request(rid, prompt_tokens, params, lora=lora,
-                      trace=trace)
+                      trace=trace, deadline=deadline)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
+        ctx = list(decode_ctx or [])
         try:
             self.engine.add_request(req)
             self._wake.set()
-            n_sent = 0
+            n_sent = len(self.tokenizer.decode(ctx)) if ctx else 0
+            n_toks = 0
             while True:
                 _, finished, reason = await asyncio.wait_for(q.get(),
                                                              timeout=300)
                 # decode incrementally: whole-prefix decode keeps
                 # multi-byte tokenizations correct
-                text = self.tokenizer.decode(req.output_tokens)
+                text = self.tokenizer.decode(ctx + req.output_tokens)
                 delta, n_sent = text[n_sent:], len(text)
-                if not delta and not finished:
+                new = list(req.output_tokens[n_toks:])
+                n_toks = len(req.output_tokens)
+                if not new and not delta and not finished:
                     # multi-step decode enqueues one event per emitted
                     # token of a dispatch; later events of the batch
-                    # carry no new text — drop the empty SSE chunks
+                    # carry nothing new — drop the empty events
                     continue
-                yield delta, finished, reason
+                yield new, delta, finished, reason
                 if finished:
                     return
         finally:
@@ -264,13 +337,14 @@ class LLMServerImpl:
         """SSE chunks for stream=true chat completions (OpenAI format)."""
         import json
         rid, trace = self._trace_of(body)
-        prompt = self.tokenizer.apply_chat_template(
-            body.get("messages") or [])
-        toks = self.tokenizer.encode(prompt)
+        deadline = self._deadline_of(body)
+        toks = self._prompt_tokens(body, chat=True)
         cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
-        async for delta, finished, reason in self._generate_stream(
+        async for _, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
-                rid=rid, trace=trace):
+                rid=rid, trace=trace, deadline=deadline):
+            if not delta and not finished:
+                continue                 # no text yet: hold the chunk
             chunk = {
                 "id": cid, "object": "chat.completion.chunk",
                 "created": int(time.time()), "model": self.model_id,
@@ -286,11 +360,14 @@ class LLMServerImpl:
     async def completions_stream(self, body: Dict[str, Any]):
         import json
         rid, trace = self._trace_of(body)
-        toks = self.tokenizer.encode(str(body.get("prompt") or ""))
+        deadline = self._deadline_of(body)
+        toks = self._prompt_tokens(body, chat=False)
         cid = f"cmpl-{uuid.uuid4().hex[:16]}"
-        async for delta, finished, reason in self._generate_stream(
+        async for _, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
-                rid=rid, trace=trace):
+                rid=rid, trace=trace, deadline=deadline):
+            if not delta and not finished:
+                continue
             chunk = {
                 "id": cid, "object": "text_completion",
                 "created": int(time.time()), "model": self.model_id,
@@ -301,6 +378,37 @@ class LLMServerImpl:
             }
             yield f"data: {json.dumps(chunk)}\n\n"
         yield "data: [DONE]\n\n"
+
+    # -- token-structured streams (ISSUE 9 failover plane) ----------------
+    async def _stream_tokens(self, body: Dict[str, Any], chat: bool):
+        """Structured token chunks for the fleet's failover-aware SSE
+        relay: {"i": index of the chunk's first output token, "toks":
+        new token ids, "text": decoded delta, "finished", "reason",
+        "model"}. `_token_offset` shifts the indices a continuation
+        reports, so the fleet's dedup-by-token-index sees ONE
+        monotone stream across replica failovers."""
+        rid, trace = self._trace_of(body)
+        deadline = self._deadline_of(body)
+        toks = self._prompt_tokens(body, chat=chat)
+        idx = int(body.get("_token_offset") or 0)
+        cont = [int(t) for t in body.get("_continue_tokens") or []]
+        async for new, delta, finished, reason in self._generate_stream(
+                toks, self._sampling(body), lora=self._lora_for(body),
+                rid=rid, trace=trace, deadline=deadline,
+                decode_ctx=cont):
+            yield {"i": idx, "toks": list(new), "text": delta,
+                   "finished": bool(finished),
+                   "reason": reason if finished else None,
+                   "model": self.model_id}
+            idx += len(new)
+
+    async def chat_stream_tokens(self, body: Dict[str, Any]):
+        async for chunk in self._stream_tokens(body, chat=True):
+            yield chunk
+
+    async def completions_stream_tokens(self, body: Dict[str, Any]):
+        async for chunk in self._stream_tokens(body, chat=False):
+            yield chunk
 
     async def model_info(self) -> Dict[str, Any]:
         # stats() snapshots tick telemetry under the engine step
@@ -572,13 +680,16 @@ class LLMRouterImpl:
             return Response({"error": "invalid JSON body"}, status=400,
                             content_type="application/json")
         if isinstance(body, dict):
-            # trace plumbing keys are INTERNAL (the fleet ingress
-            # mints them): a client forging `_request_id`/`_trace`
-            # through this standalone ingress could replay a finished
+            # plumbing keys are INTERNAL (the fleet ingress mints
+            # them): a client forging `_request_id`/`_trace` through
+            # this standalone ingress could replay a finished
             # request's id or stitch its spans into another trace's
-            # forensics — strip them at the door
-            body.pop("_request_id", None)
-            body.pop("_trace", None)
+            # forensics, and `_continue_tokens`/`_token_offset`/
+            # `_deadline_epoch` are the failover continuation's
+            # plumbing (ISSUE 9) — strip them all at the door
+            # (clients express deadlines via `deadline_s`)
+            for k in INTERNAL_BODY_KEYS:
+                body.pop(k, None)
         if norm == "/debug/profile":
             return await self._handle_profile(
                 body if isinstance(body, dict) else {})

@@ -32,9 +32,27 @@ ISSUE 7 adds the fleet-wide observability layer:
   listed/fetched at `GET /fleet/debug/bundles`
   (llm/_internal/blackbox.py).
 
-Scoring formula, admission thresholds, the autoscale policy, and the
-observability surface are documented in BENCH_CORE.md "Serving fleet
-anatomy" and "Fleet observability anatomy".
+ISSUE 9 adds the failure-handling plane:
+
+- a per-replica health state machine: consecutive probe
+  failures/timeouts open a circuit breaker and EVICT the replica
+  from the router ring immediately; half-open probes after a
+  (backed-off) cooldown decide re-admission (failover.py, fleet.py);
+- token-exact mid-stream failover: a replica dying mid-stream is
+  invisible to the client beyond latency — the fleet re-dispatches
+  the original prompt + delivered tokens (same per-request sampling
+  seed, indices deduped) to a healthy replica (failover.py);
+- deadline propagation: a client `deadline_s` rides the body from
+  ingress (expired → shed before queueing, 504) into the engine
+  (aborted at fold boundaries, finish_reason="deadline");
+- a deterministic, seeded chaos harness wrapping any replica client
+  (call raises, stream severed after N chunks, probe timeouts, slow
+  replicas) so all of the above is tier-1-testable on CPU (chaos.py).
+
+Scoring formula, admission thresholds, the autoscale policy, the
+observability surface, and the failure plane are documented in
+BENCH_CORE.md "Serving fleet anatomy", "Fleet observability anatomy"
+and "Fault tolerance anatomy".
 """
 
 from __future__ import annotations
@@ -52,8 +70,12 @@ from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
                         AdmissionRejected)
 from .autoscaler import (AutoscaleConfig, FleetAutoscaler,  # noqa: F401
                          FleetMetrics)
+from .chaos import (ChaosError, ChaosReplicaClient,  # noqa: F401
+                    ChaosSchedule, FaultSpec, StreamSevered)
 from .deployment import (FleetConfig, LLMFleetIngressImpl,  # noqa: F401
                          build_llm_fleet_app)
+from .failover import (CircuitBreaker, HealthConfig,  # noqa: F401
+                       StreamTranscript)
 from .fleet import (FleetManager, HandleReplicaClient,  # noqa: F401
                     LocalReplicaClient)
 from .router import (FleetRouter, HashRing, ReplicaSnapshot,  # noqa: F401
@@ -71,6 +93,10 @@ __all__ = [
     "prefix_fingerprint",
     "AdmissionConfig", "AdmissionController", "AdmissionRejected",
     "AutoscaleConfig", "FleetAutoscaler", "FleetMetrics",
+    # failure-handling plane (ISSUE 9)
+    "HealthConfig", "CircuitBreaker", "StreamTranscript",
+    "ChaosSchedule", "ChaosReplicaClient", "ChaosError",
+    "StreamSevered", "FaultSpec",
     # observability layer (ISSUE 7)
     "WatchdogConfig", "SLOBurnWatchdog", "IngressTraceBuffer",
     "merge_fleet_traces", "merge_flight_recorders", "filter_trace",

@@ -92,7 +92,8 @@ class AdmissionController:
         self.admitted = 0
         self.rejected: Dict[str, int] = {"queue_full": 0,
                                          "queue_wait_slo": 0,
-                                         "brownout": 0}
+                                         "brownout": 0,
+                                         "deadline": 0}
         self.shed_total = 0
         # watchdog-driven degraded mode (see set_brownout)
         self.brownout = False
@@ -169,10 +170,23 @@ class AdmissionController:
                           if p > self._vtime}
 
     # -- public API -----------------------------------------------------
-    async def acquire(self, tenant: str = "default") -> None:
+    async def acquire(self, tenant: str = "default",
+                      deadline: Optional[float] = None) -> None:
         """Admit or raise AdmissionRejected. Bounded wait: returns
-        within queue_wait_slo_s or rejects."""
+        within queue_wait_slo_s — or within the request's remaining
+        deadline, whichever is sooner (ISSUE 9: an already-expired
+        request sheds BEFORE queueing, and a queued one sheds the
+        moment waiting any longer could not possibly help; either way
+        the fleet does zero work for a request its client has already
+        abandoned). `deadline` is absolute time.monotonic()."""
         cfg = self.config
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            # NOT counted into shed_total: a deadline shed is the
+            # client's budget spent, not fleet overload — it must not
+            # feed the autoscaler's shed_delta breach signal
+            self.rejected["deadline"] += 1
+            raise AdmissionRejected("deadline", self.retry_after())
         # flush cancelled heap heads / spare capacity first, so the
         # queue-full check below sees the true backlog
         self._grant_next()
@@ -196,19 +210,30 @@ class AdmissionController:
         self._grant_next()
         if ticket.future.done() and not ticket.future.cancelled():
             return                      # admitted without waiting
+        timeout = cfg.queue_wait_slo_s
+        if deadline is not None:
+            timeout = min(timeout, max(deadline - now, 0.0))
         try:
             await asyncio.wait_for(
-                asyncio.shield(ticket.future),
-                timeout=cfg.queue_wait_slo_s)
+                asyncio.shield(ticket.future), timeout=timeout)
         except asyncio.TimeoutError:
             if ticket.future.done():
                 # granted in the same loop turn the timer fired:
                 # the grant stands
                 return
             self._discard(ticket)
-            self.rejected["queue_wait_slo"] += 1
-            self.shed_total += 1
-            raise AdmissionRejected("queue_wait_slo",
+            # attribute the shed: the deadline timer firing first
+            # means the CLIENT's budget ran out, not the fleet's SLO
+            # (and only SLO sheds count into shed_total — the
+            # autoscaler's overload signal)
+            reason = ("deadline"
+                      if deadline is not None
+                      and timeout < cfg.queue_wait_slo_s
+                      else "queue_wait_slo")
+            self.rejected[reason] += 1
+            if reason != "deadline":
+                self.shed_total += 1
+            raise AdmissionRejected(reason,
                                     self.retry_after()) from None
         except asyncio.CancelledError:
             # caller cancelled (client gone) — give the slot back if

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 
 from .admission import AdmissionConfig, AdmissionRejected
 from .autoscaler import AutoscaleConfig
+from .failover import HealthConfig
 from .fleet import (ACTIVE, DRAINING, STANDBY, FleetManager,
                     HandleReplicaClient)
 from .router import RouterConfig
@@ -60,6 +61,17 @@ class FleetConfig:
     # request at ingress; one trace id follows it across router and
     # replica (GET /fleet/debug/trace merges the spans)
     enable_tracing: bool = True
+    # failure handling (ISSUE 9): probe-failure eviction thresholds,
+    # circuit-breaker cooldowns, and the mid-stream failover budget
+    health: HealthConfig = dataclasses.field(
+        default_factory=HealthConfig)
+    # named operation timeouts (ISSUE 9 satellite — replace the old
+    # scattered 5.0/10.0 literals so chaos tests and operators can
+    # tune them): probe = stats/metrics/debug fan-outs, dispatch =
+    # control-plane unary calls, drain = scale-down engine drain
+    probe_timeout_s: float = 5.0
+    dispatch_timeout_s: float = 10.0
+    drain_timeout_s: float = 120.0
     refresh_period_s: float = 0.5
     autoscale_period_s: float = 2.0
 
@@ -77,6 +89,10 @@ class FleetConfig:
             "autoscale": dataclasses.asdict(self.resolved_autoscale()),
             "watchdog": dataclasses.asdict(self.watchdog),
             "enable_tracing": self.enable_tracing,
+            "health": dataclasses.asdict(self.health),
+            "probe_timeout_s": self.probe_timeout_s,
+            "dispatch_timeout_s": self.dispatch_timeout_s,
+            "drain_timeout_s": self.drain_timeout_s,
             "refresh_period_s": self.refresh_period_s,
             "autoscale_period_s": self.autoscale_period_s,
         }
@@ -109,6 +125,17 @@ class LLMFleetIngressImpl:
                 **fleet_wire.get("autoscale") or {}),
             watchdog=WatchdogConfig(**wd_wire),
             enable_tracing=bool(fleet_wire.get("enable_tracing", True)),
+            health=HealthConfig(**fleet_wire.get("health") or {}),
+            model_id=self.model_id,
+            # fallbacks come from the dataclass, never re-stated
+            # literals (the satellite that removed the scattered
+            # 5.0/10.0 must not reintroduce them here)
+            probe_timeout_s=fleet_wire.get(
+                "probe_timeout_s", FleetConfig.probe_timeout_s),
+            dispatch_timeout_s=fleet_wire.get(
+                "dispatch_timeout_s", FleetConfig.dispatch_timeout_s),
+            drain_timeout_s=fleet_wire.get(
+                "drain_timeout_s", FleetConfig.drain_timeout_s),
             refresh_period_s=fleet_wire.get("refresh_period_s", 0.5),
             autoscale_period_s=fleet_wire.get("autoscale_period_s", 2.0))
         self._adapters: Optional[List[str]] = None
@@ -116,7 +143,16 @@ class LLMFleetIngressImpl:
 
     # -- helpers --------------------------------------------------------
     def _429(self, exc: AdmissionRejected):
+        """Admission rejections: 429 + Retry-After for overload; a
+        request shed because its own deadline expired (ISSUE 9) is
+        504 Gateway Timeout — retrying won't help a client whose
+        budget is spent."""
         from ...serve import Response
+        if exc.reason == "deadline":
+            return Response(
+                {"error": {"type": "deadline_exceeded",
+                           "reason": exc.reason}},
+                status=504, content_type="application/json")
         return Response(
             {"error": {"type": "overloaded",
                        "reason": exc.reason,
@@ -161,7 +197,7 @@ class LLMFleetIngressImpl:
                 return rid, await asyncio.wait_for(
                     self.fleet.replicas[rid].client.call(
                         method, *args),
-                    timeout=5.0)
+                    timeout=self.fleet.probe_timeout_s)
             except Exception as e:
                 return rid, {"error": repr(e)}
 
@@ -235,7 +271,7 @@ class LLMFleetIngressImpl:
                     # why its bundle is wanted) degrades, not hangs
                     bundle = await asyncio.wait_for(
                         st.client.call("debug_bundle", bid),
-                        timeout=5.0)
+                        timeout=self.fleet.probe_timeout_s)
                 except Exception as e:
                     return Response(
                         {"error": f"bundle fetch from {rid} failed: "
@@ -307,12 +343,36 @@ class LLMFleetIngressImpl:
         try:
             async for chunk in self.fleet.dispatch_stream(method, body):
                 yield chunk
+        except (GeneratorExit, asyncio.CancelledError):
+            raise                      # client gone: nothing to frame
         except AdmissionRejected as e:
-            # headers are already on the wire: the 429 becomes an SSE
-            # error event (the OpenAI streaming convention)
+            # headers are already on the wire: the rejection becomes
+            # an SSE error event (the OpenAI streaming convention).
+            # Same distinction as _429: a deadline shed is the
+            # client's budget spent (no Retry-After — retrying won't
+            # help), anything else is overload.
+            if e.reason == "deadline":
+                err = {"type": "deadline_exceeded", "reason": e.reason}
+            else:
+                err = {"type": "overloaded", "reason": e.reason,
+                       "retry_after_s": e.retry_after_s}
+            yield "data: " + json.dumps({"error": err}) + "\n\n"
+            yield "data: [DONE]\n\n"
+        except Exception as e:
+            # failover budget exhausted / every replica down (ISSUE
+            # 9): the stream must still END per the SSE convention —
+            # an error event + [DONE] — never a silent truncation a
+            # client can't tell from a transport blip. The terminal
+            # cause goes to the log + fleet flight recorder (the SSE
+            # event only names the type; the operator needs the rest)
+            import logging
+            logging.getLogger(__name__).exception(
+                "fleet stream %s failed terminally", method)
+            self.fleet.recorder.record(
+                "stream_failed", method=method, error=repr(e))
             yield "data: " + json.dumps(
-                {"error": {"type": "overloaded", "reason": e.reason,
-                           "retry_after_s": e.retry_after_s}}) + "\n\n"
+                {"error": {"type": "upstream_failure",
+                           "reason": type(e).__name__}}) + "\n\n"
             yield "data: [DONE]\n\n"
 
     async def stream_chat(self, body: Dict[str, Any]):

@@ -34,9 +34,11 @@ from typing import Any, AsyncIterator, Deque, Dict, List, Optional, \
 
 from ...llm._internal.telemetry import FlightRecorder
 from ...util import tracing
+from . import failover
 from .admission import (AdmissionConfig, AdmissionController,
                         AdmissionRejected)
 from .autoscaler import AutoscaleConfig, FleetAutoscaler, FleetMetrics
+from .failover import CircuitBreaker, HealthConfig
 from .router import (FleetRouter, ReplicaSnapshot, RouterConfig,
                      prefix_fingerprint)
 from .tracemerge import IngressTraceBuffer, request_events
@@ -49,6 +51,17 @@ _WATCH_KEYS = ("ttft_n", "ttft_bad", "queue_n", "queue_bad",
 ACTIVE = "ACTIVE"
 DRAINING = "DRAINING"
 STANDBY = "STANDBY"
+# ISSUE 9: evicted by the health state machine — out of the router
+# ring, ineligible for autoscale activation; only the breaker's
+# half-open probes re-admit it
+UNHEALTHY = "UNHEALTHY"
+
+# plumbing keys the fleet mints itself: client-supplied values are
+# stripped at ingress (a forged `_continue_tokens` would inject raw
+# token ids, a forged `_deadline_epoch` would bypass deadline_s).
+# ONE canonical list, owned by the server module that pops them.
+from ...llm._internal.server import \
+    INTERNAL_BODY_KEYS as _INTERNAL_BODY_KEYS  # noqa: E402
 
 
 class LocalReplicaClient:
@@ -89,7 +102,8 @@ class HandleReplicaClient:
 
 
 class _ReplicaState:
-    def __init__(self, client: Any, status: str):
+    def __init__(self, client: Any, status: str,
+                 health: Optional[HealthConfig] = None):
         self.client = client
         self.status = status
         self.inflight = 0            # router-side, zero-lag
@@ -97,6 +111,9 @@ class _ReplicaState:
         self.snapshot: Optional[ReplicaSnapshot] = None
         self.slo_totals: Dict[str, float] = {}
         self.drain_task: Optional[asyncio.Task] = None
+        # ISSUE 9 health state machine: closed -> open (evicted) ->
+        # half-open (probation probes) -> closed (re-admitted)
+        self.breaker = CircuitBreaker(health)
 
 
 class FleetManager:
@@ -107,7 +124,12 @@ class FleetManager:
                  refresh_period_s: float = 0.5,
                  autoscale_period_s: float = 2.0,
                  watchdog: Optional[WatchdogConfig] = None,
-                 enable_tracing: bool = True):
+                 enable_tracing: bool = True,
+                 health: Optional[HealthConfig] = None,
+                 model_id: str = "default",
+                 probe_timeout_s: float = 5.0,
+                 dispatch_timeout_s: float = 10.0,
+                 drain_timeout_s: float = 120.0):
         if not clients:
             raise ValueError("a fleet needs at least one replica")
         auto = autoscale or AutoscaleConfig(
@@ -125,10 +147,24 @@ class FleetManager:
         self.autoscaler = FleetAutoscaler(auto)
         self.refresh_period_s = refresh_period_s
         self.autoscale_period_s = autoscale_period_s
+        # named operation timeouts (ISSUE 9 satellite — were scattered
+        # 5.0/10.0 literals): probe = stats/metrics/bundle fan-outs,
+        # dispatch = control-plane unary calls (postmortem dumps),
+        # drain = scale-down engine drain
+        self.probe_timeout_s = probe_timeout_s
+        self.dispatch_timeout_s = dispatch_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        self.model_id = model_id
+        # failure-handling plane (ISSUE 9)
+        self.health = health or HealthConfig()
+        self.metrics = failover.fleet_metrics()
         self.replicas: Dict[str, _ReplicaState] = {}
         for i, c in enumerate(clients):
             status = ACTIVE if i < auto.min_replicas else STANDBY
-            self.replicas[c.replica_id] = _ReplicaState(c, status)
+            self.replicas[c.replica_id] = _ReplicaState(
+                c, status, self.health)
+            self.metrics["breaker"].set(
+                0, {"model": self.model_id, "replica": c.replica_id})
         self.router.set_replicas(self._ids(ACTIVE))
         self._prev_slo: Dict[str, Dict[str, float]] = {}
         self._prev_shed = 0
@@ -154,6 +190,7 @@ class FleetManager:
         self._watch_accum: Dict[str, float] = \
             {k: 0.0 for k in _WATCH_KEYS}
         self._page_dump_task: Optional[asyncio.Task] = None
+        self._dump_tasks: set = set()   # keep eviction dumps alive
 
     # -- membership helpers --------------------------------------------
     def _ids(self, *statuses: str) -> List[str]:
@@ -192,14 +229,14 @@ class FleetManager:
         the body; LLMServerImpl pops it onto the engine Request).
         Returns (body', rec) — body' is a COPY carrying the plumbing
         keys, rec the in-progress ingress span record."""
+        # ALWAYS copy + strip: the plumbing keys are internal even
+        # when tracing is off — never forward client-supplied values
+        # to the replica (and the failover/deadline paths mutate the
+        # copy, never the caller's dict)
+        body = {k: v for k, v in body.items()
+                if k not in _INTERNAL_BODY_KEYS}
         if not self.enable_tracing:
-            # the plumbing keys are internal even when tracing is off:
-            # never forward client-supplied values to the replica
-            if "_request_id" in body or "_trace" in body:
-                body = {k: v for k, v in body.items()
-                        if k not in ("_request_id", "_trace")}
             return body, None
-        body = dict(body)
         # ALWAYS mint — `_request_id` doubles as the engine request id
         # downstream, so honoring a client-supplied value would let a
         # replayed id collide with (and abort/starve) another tenant's
@@ -232,28 +269,94 @@ class FleetManager:
             time.monotonic(), rec["replica"], rec["outcome"],
             rec["method"], rec["tenant"], rec["status"]))
 
+    # -- deadline propagation (ISSUE 9) ---------------------------------
+    def _mint_deadline(self, body: Dict[str, Any]
+                       ) -> Optional[float]:
+        """A client `deadline_s` (seconds from arrival) becomes an
+        absolute `_deadline_epoch` on the body (wall clock, so it
+        survives process hops to the replica, where the engine aborts
+        past it at fold boundaries). Returns the MONOTONIC deadline
+        admission compares against here at the ingress."""
+        ds = body.get("deadline_s")
+        if ds is None:
+            return None
+        ds = float(ds)
+        body["_deadline_epoch"] = time.time() + ds
+        return time.monotonic() + ds
+
+    def _count_deadline_shed(self, stage: str) -> None:
+        self.metrics["deadline_sheds"].inc(
+            1, {"model": self.model_id, "stage": stage})
+
     async def dispatch(self, method: str, body: Dict[str, Any]) -> Any:
-        """Unary request through admission + routing (trace-minted)."""
+        """Unary request through admission + routing (trace-minted).
+        A replica failure/timeout feeds the breaker and the request
+        retries on another replica (bounded by health.max_failovers) —
+        no tokens have reached the client, so a retry is invisible."""
         body, rec = self._trace_begin(method, body)
+        deadline = self._mint_deadline(body)
         try:
-            await self.admission.acquire(self.tenant_of(body))
+            await self.admission.acquire(self.tenant_of(body),
+                                         deadline=deadline)
         except AdmissionRejected as e:
+            if e.reason == "deadline":
+                self._count_deadline_shed("admission")
             self._trace_end(rec, f"rejected:{e.reason}")
             raise
         if rec is not None:
             rec["t_admit"] = time.monotonic()
+        attempts = 0
         try:
-            st, outcome = self._route(body)
-            if rec is not None:
-                rec["t_route"] = time.monotonic()
-                rec["replica"] = st.client.replica_id
-                rec["outcome"] = outcome
-            st.inflight += 1
-            st.requests_total += 1
-            try:
-                return await st.client.call(method, body)
-            finally:
-                st.inflight -= 1
+            while True:
+                st, outcome = self._route(body)
+                if rec is not None and rec["replica"] is None:
+                    rec["t_route"] = time.monotonic()
+                    rec["replica"] = st.client.replica_id
+                    rec["outcome"] = outcome
+                rid = st.client.replica_id
+                st.inflight += 1
+                st.requests_total += 1
+                try:
+                    # per-attempt COPY: an in-process replica pops the
+                    # plumbing keys (_deadline_epoch/_trace/...) off
+                    # the dict it receives — a retry must re-send the
+                    # fleet's canonical body, not the mutated one.
+                    # With a deadline, the await is BOUNDED (remaining
+                    # budget + grace): a healthy engine finishes with
+                    # finish_reason="deadline" well inside the grace,
+                    # so the timeout firing means the replica HUNG —
+                    # the TimeoutError feeds the breaker below and the
+                    # retry lands on a healthy replica (which sheds
+                    # the expired request cleanly). Deadline-less
+                    # requests keep unbounded unary semantics.
+                    timeout = None
+                    if deadline is not None:
+                        timeout = (max(deadline - time.monotonic(),
+                                       0.0)
+                                   + self.health.unary_deadline_grace_s)
+                    out = await asyncio.wait_for(
+                        st.client.call(method, dict(body)), timeout)
+                except (AdmissionRejected, asyncio.CancelledError):
+                    raise
+                except Exception as exc:
+                    if not self._should_failover(rid, "dispatch",
+                                                 exc, attempts):
+                        raise
+                    attempts += 1
+                    self.recorder.record(
+                        "failover", mode="unary", replica=rid,
+                        method=method, attempt=attempts,
+                        error=repr(exc))
+                    continue
+                finally:
+                    st.inflight -= 1
+                if isinstance(out, dict):
+                    fr = ((out.get("choices") or [{}])[0]
+                          .get("finish_reason")
+                          if out.get("choices") else None)
+                    if fr == "deadline":
+                        self._count_deadline_shed("engine")
+                return out
         except AdmissionRejected as e:
             if rec is not None:
                 rec["status"] = f"rejected:{e.reason}"
@@ -271,28 +374,49 @@ class FleetManager:
         """Streaming request: admission + routing hold for the WHOLE
         stream (a live stream occupies a decode slot, so it must keep
         weighing in both the router's in-flight counts and the
-        admission concurrency bound until it completes)."""
+        admission concurrency bound until it completes).
+
+        For the OpenAI stream methods the fleet consumes the
+        replica's token-structured twin and renders the SSE framing
+        HERE (ISSUE 9): a replica dying mid-stream feeds the breaker,
+        the transcript's token-index dedup guarantees exactly-once
+        delivery, and a continuation (original prompt + delivered
+        tokens, same seed) re-dispatches to a healthy replica —
+        token-exact, one stable completion id, no client-visible
+        restart beyond latency."""
         body, rec = self._trace_begin(method, body)
+        deadline = self._mint_deadline(body)
+        token_method = failover.TOKEN_STREAM_METHODS.get(method)
         try:
-            await self.admission.acquire(self.tenant_of(body))
+            await self.admission.acquire(self.tenant_of(body),
+                                         deadline=deadline)
         except AdmissionRejected as e:
+            if e.reason == "deadline":
+                self._count_deadline_shed("admission")
             self._trace_end(rec, f"rejected:{e.reason}")
             raise
         if rec is not None:
             rec["t_admit"] = time.monotonic()
         try:
-            st, outcome = self._route(body)
-            if rec is not None:
-                rec["t_route"] = time.monotonic()
-                rec["replica"] = st.client.replica_id
-                rec["outcome"] = outcome
-            st.inflight += 1
-            st.requests_total += 1
-            try:
-                async for chunk in st.client.stream(method, body):
+            if token_method is None:
+                # non-OpenAI stream: single-attempt passthrough
+                st, outcome = self._route(body)
+                if rec is not None:
+                    rec["t_route"] = time.monotonic()
+                    rec["replica"] = st.client.replica_id
+                    rec["outcome"] = outcome
+                st.inflight += 1
+                st.requests_total += 1
+                try:
+                    async for chunk in st.client.stream(method, body):
+                        yield chunk
+                finally:
+                    st.inflight -= 1
+            else:
+                async for chunk in self._stream_with_failover(
+                        token_method, method == "chat_stream",
+                        body, rec):
                     yield chunk
-            finally:
-                st.inflight -= 1
         except AdmissionRejected as e:
             if rec is not None:
                 rec["status"] = f"rejected:{e.reason}"
@@ -309,22 +433,257 @@ class FleetManager:
             self.admission.release()
             self._trace_end(rec)
 
+    async def _stream_with_failover(self, token_method: str,
+                                    is_chat: bool,
+                                    body: Dict[str, Any],
+                                    rec: Optional[Dict[str, Any]]
+                                    ) -> AsyncIterator[str]:
+        """The failover-aware SSE relay: drive the replica's token
+        stream through the transcript (dedup by token index), render
+        OpenAI SSE chunks with ONE stable completion id, and on a
+        replica failure re-dispatch a token-exact continuation."""
+        failover.pin_stream_identity(body)
+        cid = (("chatcmpl-" if is_chat else "cmpl-")
+               + str(body.get("_request_id")
+                     or uuid.uuid4().hex[:16]))
+        created = int(time.time())
+        transcript = failover.StreamTranscript()
+        model = self.model_id
+        attempts = 0
+        cur = body
+        while True:
+            st, outcome = self._route(cur)
+            if rec is not None and rec["replica"] is None:
+                rec["t_route"] = time.monotonic()
+                rec["replica"] = st.client.replica_id
+                rec["outcome"] = outcome
+            rid = st.client.replica_id
+            st.inflight += 1
+            st.requests_total += 1
+            gen = None
+            try:
+                # per-attempt COPY (see dispatch): in-process replicas
+                # pop plumbing keys off the dict they receive; the
+                # continuation must inherit the CANONICAL body —
+                # deadline, trace, and seed included
+                gen = st.client.stream(token_method, dict(cur))
+                it = gen.__aiter__()
+                while True:
+                    try:
+                        # stall watchdog (ISSUE 9): a HUNG replica
+                        # (wedged loop, stuck device call) never
+                        # raises — without this bound the stream,
+                        # its admission slot, and the client would
+                        # strand forever even after eviction
+                        chunk = await asyncio.wait_for(
+                            it.__anext__(),
+                            timeout=self.health.stream_stall_timeout_s)
+                    except StopAsyncIteration:
+                        # ended without a finish chunk: the transport
+                        # died quietly — same failover path as a
+                        # loud failure
+                        raise failover.StreamBroken(
+                            f"token stream from {rid} ended "
+                            f"without finish")
+                    except asyncio.TimeoutError:
+                        raise failover.StreamStalled(
+                            f"no chunk from {rid} within "
+                            f"{self.health.stream_stall_timeout_s}s")
+                    folded = transcript.fold(chunk)
+                    if folded is None:
+                        continue                 # replayed: dedup'd
+                    toks, text, fin, reason = folded
+                    model = chunk.get("model") or model
+                    yield failover.sse_chunk(
+                        is_chat, cid, model, created, text, fin,
+                        reason, toks)
+                    if fin:
+                        if reason == "deadline":
+                            self._count_deadline_shed("engine")
+                        yield "data: [DONE]\n\n"
+                        return
+            except (GeneratorExit, asyncio.CancelledError):
+                raise                # client gone: nothing to fail over
+            except AdmissionRejected:
+                raise
+            except Exception as exc:
+                if not self._should_failover(rid, "stream", exc,
+                                             attempts):
+                    raise
+                attempts += 1
+                self.recorder.record(
+                    "failover", mode="stream", replica=rid,
+                    request_id=str(body.get("_request_id")),
+                    tokens_delivered=len(transcript.tokens),
+                    attempt=attempts, error=repr(exc))
+                cur = failover.continuation_body(body, transcript)
+            finally:
+                st.inflight -= 1
+                if gen is not None:
+                    # close the attempt's generator (a stalled one is
+                    # abandoned mid-chunk): the replica side aborts
+                    # its engine request like a real disconnect
+                    await failover.close_quietly(gen)
+
+    # -- health state machine (ISSUE 9) ---------------------------------
+    def _set_breaker_gauge(self, rid: str) -> None:
+        self.metrics["breaker"].set(
+            self.replicas[rid].breaker.gauge(),
+            {"model": self.model_id, "replica": rid})
+
+    def _should_failover(self, rid: str, mode: str,
+                         exc: BaseException, attempts: int) -> bool:
+        """The ONE failover policy for unary and stream attempts:
+        classify the fault (request-caused faults surface unchanged —
+        a retry would fail identically and the replica is fine), feed
+        the breaker, check the retry budget, count the metric.
+        Returns False when the caller must re-raise."""
+        if failover.is_request_fault(exc):
+            return False
+        # a TIMEOUT (the ingress's own deadline-grace timer) is
+        # ambiguous — hung replica vs cold compile vs a tight client
+        # deadline — so it counts SOFTLY toward the threshold; a loud
+        # failure (severed stream, raised call) is a death signal and
+        # trips immediately
+        self._note_replica_failure(
+            rid, f"{mode}:{type(exc).__name__}",
+            hard=not isinstance(exc, asyncio.TimeoutError))
+        if attempts >= self.health.max_failovers:
+            return False
+        self.metrics["failovers"].inc(1, {"model": self.model_id})
+        return True
+
+    def _note_replica_failure(self, rid: str, reason: str,
+                              hard: bool = True) -> None:
+        """A dispatch/stream against this replica failed — a stronger
+        death signal than a slow probe, so (by default, and unless
+        the caller softens it) it trips the breaker immediately and
+        evicts, instead of waiting out probe_failures refresh
+        cycles."""
+        st = self.replicas.get(rid)
+        if st is None:
+            return
+        st.breaker.record_failure(
+            hard=hard and self.health.fail_fast_on_dispatch)
+        self._set_breaker_gauge(rid)
+        # evict on the open TRANSITION — and also when the breaker
+        # was already open but the eviction had been deferred (sole
+        # active replica at the time; another may have activated
+        # since, making the eviction possible now)
+        if st.breaker.state == failover.OPEN:
+            self._evict(rid, reason)
+
+    def _evict(self, rid: str, reason: str) -> None:
+        """The breaker opened: remove the replica from the router
+        ring NOW (in-flight work fails over; new work never routes
+        here) and mark it UNHEALTHY so only half-open probes can
+        bring it back. Never evicts the LAST active replica — a
+        false positive there would turn an incident into a total
+        blackout; its open breaker still gates recovery."""
+        st = self.replicas[rid]
+        if st.status != ACTIVE:
+            return                 # draining/standby: not in the ring
+        if not [r for r in self._ids(ACTIVE) if r != rid]:
+            # the SOLE active replica: activate a standby replacement
+            # if one exists — spare healthy capacity must not idle
+            # while everything routes to a dead replica. With no
+            # standby either, defer: the breaker still gates
+            # recovery, but an empty ring would be a total blackout.
+            standby = self._ids(STANDBY)
+            if not standby:
+                self.recorder.record("eviction_deferred", replica=rid,
+                                     reason=reason)
+                return
+            sub = standby[0]
+            self.replicas[sub].status = ACTIVE
+            self.recorder.record("failover_activate", replica=sub,
+                                 replacing=rid)
+            self._scale_events.append(
+                {"ts": time.time(), "event": "activate",
+                 "replica": sub, "reason": f"replacing:{rid}"})
+        st.status = UNHEALTHY
+        self.router.set_replicas(self._ids(ACTIVE))
+        self.metrics["evictions"].inc(1, {"model": self.model_id})
+        self.recorder.record("replica_evicted", replica=rid,
+                             reason=reason,
+                             trips=st.breaker.trips)
+        self._scale_events.append(
+            {"ts": time.time(), "event": "evict", "replica": rid,
+             "reason": reason})
+        # postmortem breadcrumb: best-effort black-box of the evicted
+        # replica (it may be dead — the dump call is allowed to
+        # fail). The task reference is RETAINED until done: the loop
+        # holds tasks weakly, and a GC'd pending dump would silently
+        # drop the one artifact the eviction exists to capture.
+        try:
+            task = asyncio.get_running_loop().create_task(
+                self._dump_one(rid, f"evicted:{reason}"))
+            self._dump_tasks.add(task)
+            task.add_done_callback(self._dump_tasks.discard)
+        except RuntimeError:
+            pass                   # no running loop (sync test driver)
+
+    async def _dump_one(self, rid: str, cause: str) -> None:
+        try:
+            await asyncio.wait_for(
+                self.replicas[rid].client.call(
+                    "debug_dump", {"cause": cause}),
+                timeout=self.dispatch_timeout_s)
+        except Exception:
+            pass
+
+    def _readmit(self, rid: str) -> None:
+        """The breaker closed (half-open probes passed): back into
+        the router ring. The autoscaler trims any surplus on its own
+        cadence."""
+        st = self.replicas[rid]
+        if st.status != UNHEALTHY:
+            return
+        st.status = ACTIVE
+        self.router.set_replicas(self._ids(ACTIVE))
+        self.recorder.record("replica_readmitted", replica=rid,
+                             trips=st.breaker.trips)
+        self._scale_events.append(
+            {"ts": time.time(), "event": "readmit", "replica": rid})
+
     # -- stats refresh --------------------------------------------------
     async def refresh(self) -> None:
-        """Pull fleet_stats from every non-standby replica."""
-        ids = self._ids(ACTIVE, DRAINING)
+        """Pull fleet_stats from every non-standby replica — the
+        probe loop that drives the health state machine: consecutive
+        failures/timeouts open the breaker (evict from the ring),
+        and once its cooldown passes, half-open probes decide
+        re-admission. A successful probe stamps a FRESH snapshot
+        (mono_ts), so the router can deprioritize replicas whose
+        numbers have gone stale instead of trusting them forever."""
+        ids = self._ids(ACTIVE, DRAINING, UNHEALTHY)
+        now = time.monotonic()
 
         async def one(rid: str):
             st = self.replicas[rid]
+            if not st.breaker.should_probe(now):
+                return          # open, inside its cooldown: leave it
+            self._set_breaker_gauge(rid)     # open->half-open visible
             try:
                 stats = await asyncio.wait_for(
-                    st.client.call("fleet_stats"), timeout=5.0)
-            except Exception:
+                    st.client.call("fleet_stats"),
+                    timeout=self.probe_timeout_s)
+            except Exception as exc:
+                st.breaker.record_failure()
+                self._set_breaker_gauge(rid)
+                if st.breaker.state == failover.OPEN:
+                    # covers the transition AND a previously deferred
+                    # eviction (last-active then; maybe not anymore)
+                    self._evict(rid,
+                                f"probe:{type(exc).__name__}")
                 return                       # keep the stale snapshot
+            closed = st.breaker.record_success()
+            self._set_breaker_gauge(rid)
             snap = ReplicaSnapshot.from_stats(stats)
             snap.replica = rid
             st.snapshot = snap
             st.slo_totals = dict(stats.get("slo_totals") or {})
+            if closed:
+                self._readmit(rid)
 
         await asyncio.gather(*(one(rid) for rid in ids))
 
@@ -413,15 +772,18 @@ class FleetManager:
 
     async def debug_dump_all(self, cause: str) -> Dict[str, Any]:
         """Ask every non-standby replica to snapshot a postmortem
-        black-box bundle (watchdog page / POST /debug/dump)."""
-        ids = self._ids(ACTIVE, DRAINING)
+        black-box bundle (watchdog page / POST /debug/dump).
+        UNHEALTHY replicas included — an evicted-but-alive replica is
+        the one most likely implicated in whatever paged; a dead one
+        degrades to its error row under the timeout."""
+        ids = self._ids(ACTIVE, DRAINING, UNHEALTHY)
 
         async def one(rid: str):
             try:
                 return rid, await asyncio.wait_for(
                     self.replicas[rid].client.call(
                         "debug_dump", {"cause": cause}),
-                    timeout=10.0)
+                    timeout=self.dispatch_timeout_s)
             except Exception as e:
                 return rid, {"error": repr(e)}
 
@@ -469,7 +831,7 @@ class FleetManager:
         self._scale_events.append(
             {"ts": time.time(), "event": "drain_begin", "replica": rid})
         st.drain_task = asyncio.get_running_loop().create_task(
-            self._drain_to_standby(rid))
+            self._drain_to_standby(rid, self.drain_timeout_s))
 
     async def _drain_to_standby(self, rid: str,
                                 timeout_s: float = 120.0) -> None:
@@ -566,13 +928,17 @@ class FleetManager:
                                      merge_expositions,
                                      relabel_exposition)
 
-        ids = self._ids(ACTIVE, DRAINING)
+        # UNHEALTHY included: an evicted replica's series must not
+        # vanish from the merged exposition mid-incident (rate()
+        # gaps, absent-series alerts); a dead one just times out
+        ids = self._ids(ACTIVE, DRAINING, UNHEALTHY)
 
         async def one(rid: str):
             st = self.replicas[rid]
             try:
                 return (rid, st.client, await asyncio.wait_for(
-                    st.client.call("metrics_text"), timeout=5.0))
+                    st.client.call("metrics_text"),
+                    timeout=self.probe_timeout_s))
             except Exception:
                 return None     # a wedged replica can't black out
                                 # the whole fleet's scrape
@@ -601,6 +967,7 @@ class FleetManager:
                 "status": st.status,
                 "inflight": st.inflight,
                 "requests_total": st.requests_total,
+                "breaker": st.breaker.stats(),
                 **({} if snap is None else {
                     "active": snap.active,
                     "waiting": snap.waiting,
@@ -609,6 +976,9 @@ class FleetManager:
                     "prefix_cache_hit_rate": round(
                         snap.cache_hit_rate, 4),
                     "last_tick_age_s": snap.last_tick_age_s,
+                    # snapshot age (ISSUE 9): how old the routing
+                    # inputs above are — stale = probes failing
+                    "snapshot_age_s": round(snap.age_s(), 3),
                 }),
             }
         return {
@@ -628,12 +998,20 @@ class FleetManager:
                 "ingress_buffer": self.trace.stats(),
             },
             "recorder": self.recorder.stats(),
+            "health": {
+                "probe_failures": self.health.probe_failures,
+                "open_cooldown_s": self.health.open_cooldown_s,
+                "half_open_probes": self.health.half_open_probes,
+                "max_failovers": self.health.max_failovers,
+                "unhealthy": self._ids(UNHEALTHY),
+            },
             "autoscale": {
                 "min_replicas": self.autoscaler.config.min_replicas,
                 "max_replicas": self.autoscaler.config.max_replicas,
                 "active": len(self._ids(ACTIVE)),
                 "draining": len(self._ids(DRAINING)),
                 "standby": len(self._ids(STANDBY)),
+                "unhealthy": len(self._ids(UNHEALTHY)),
                 "last_decision": self.autoscaler.last_decision,
                 "events": list(self._scale_events)[-32:],
             },
@@ -641,4 +1019,4 @@ class FleetManager:
 
 
 __all__ = ["FleetManager", "LocalReplicaClient", "HandleReplicaClient",
-           "ACTIVE", "DRAINING", "STANDBY"]
+           "ACTIVE", "DRAINING", "STANDBY", "UNHEALTHY"]

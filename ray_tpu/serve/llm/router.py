@@ -136,6 +136,15 @@ class ReplicaSnapshot:
     cache_hit_rate: float = 0.0      # cumulative prefix-cache hit rate
     last_tick_age_s: Optional[float] = None
     ts: float = dataclasses.field(default_factory=time.time)
+    # MONOTONIC stamp of when this snapshot was taken (ISSUE 9): a
+    # replica whose probes keep failing keeps its LAST snapshot, so
+    # the router must know how old the numbers it scores are (an NTP
+    # step must not fake freshness — hence not `ts`)
+    mono_ts: float = dataclasses.field(default_factory=time.monotonic)
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return max(now - self.mono_ts, 0.0)
 
     @classmethod
     def from_stats(cls, stats: Dict[str, Any]) -> "ReplicaSnapshot":
@@ -165,6 +174,13 @@ class RouterConfig:
     w_occupancy: float = 4.0
     w_waiting: float = 1.0
     w_inflight: float = 0.5
+    # snapshot staleness (ISSUE 9): a snapshot older than this is
+    # routing on fiction — the replica's probes have been failing for
+    # multiple refresh cycles. The affinity walk treats it like a
+    # saturated target (spill to the ring successor, whose numbers are
+    # real) and the scored fallback penalizes it by w_stale.
+    snapshot_stale_s: float = 10.0
+    w_stale: float = 4.0
 
 
 class FleetRouter:
@@ -198,16 +214,22 @@ class FleetRouter:
         """Lower is better. Documented in BENCH_CORE.md ("Serving
         fleet anatomy"): occupancy dominates (pages are the binding
         constraint), engine queue depth next, then the router's own
-        not-yet-visible in-flight count."""
+        not-yet-visible in-flight count; a stale snapshot (probes
+        failing — ISSUE 9) adds a flat deprioritization penalty."""
         c = self.config
         return (c.w_occupancy * snap.kv_occupancy
                 + c.w_waiting * (snap.waiting + snap.active * 0.25)
-                + c.w_inflight * inflight)
+                + c.w_inflight * inflight
+                + (c.w_stale
+                   if snap.age_s() > c.snapshot_stale_s else 0.0))
 
     def _saturated(self, snap: ReplicaSnapshot, inflight: int) -> bool:
         c = self.config
         return (snap.kv_occupancy >= c.spill_occupancy
-                or snap.waiting + inflight >= c.spill_waiting)
+                or snap.waiting + inflight >= c.spill_waiting
+                # stale numbers are no basis for an affinity hit:
+                # walk on to a replica whose state is known
+                or snap.age_s() > c.snapshot_stale_s)
 
     # -- the pick -------------------------------------------------------
     def pick(self, fingerprint: str,

@@ -832,16 +832,23 @@ def test_engine_hot_path_has_zero_baselined_findings():
 
 
 def test_serve_llm_fleet_has_zero_baselined_findings():
-    """ISSUE 6/7 gate: the serve/llm fleet package (router,
+    """ISSUE 6/7/9 gate: the serve/llm fleet package (router,
     admission, autoscaler, fleet manager, deployment builder — plus
-    the ISSUE 7 watchdog and trace-merge modules) stays at ZERO
-    baseline entries — it is pure host-side control plane, so any
-    jaxlint finding there is a real bug, not debt."""
+    the ISSUE 7 watchdog and trace-merge modules and the ISSUE 9
+    failure plane: chaos.py and failover.py) stays at ZERO baseline
+    entries — it is pure host-side control plane, so any jaxlint
+    finding there is a real bug, not debt. Failure handling in
+    particular must add zero device work (the chaos/dispatch-guard
+    suite enforces the runtime half of that contract)."""
     base = load_baseline(str(REPO / "tools/jaxlint/baseline.json"))
     for key in base.entries:
         assert "serve/llm/" not in key.split(":")[1]
-    # and the package — which includes the ISSUE 7 watchdog.py and
-    # tracemerge.py — is clean with NO baseline at all
+    # the ISSUE 9 modules exist and are inside the analyzed package
+    # (if they ever move, this gate must move with them)
+    for fname in ("chaos.py", "failover.py", "watchdog.py",
+                  "tracemerge.py"):
+        assert (REPO / "ray_tpu/serve/llm" / fname).exists(), fname
+    # and the package is clean with NO baseline at all
     proc = _cli("ray_tpu/serve/llm")
     assert proc.returncode == 0, (
         "jaxlint findings in ray_tpu/serve/llm (zero-entry package):\n"

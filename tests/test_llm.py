@@ -149,6 +149,82 @@ def test_sampling_temperature_and_top_p():
                for t in hot[0].output_tokens)
 
 
+def test_seeded_sampling_reproducible_across_engines():
+    """ISSUE 9 satellite: SamplingParams.seed makes the sampled path
+    fully reproducible — two fresh engines (same weights seed), same
+    prompt, same seed → identical token sequences; a different seed
+    diverges. Without an explicit seed, the seed derives from the
+    request id, so identical requests under DIFFERENT ids still
+    diverge (a hot sampled batch must not collapse to one sequence)."""
+    p = SamplingParams(max_tokens=10, temperature=0.9, top_p=0.9,
+                      seed=123)
+    a = make_engine(seed=7).generate([[5, 6, 7, 8]], p)
+    b = make_engine(seed=7).generate([[5, 6, 7, 8]], p)
+    assert a[0].output_tokens == b[0].output_tokens
+    c = make_engine(seed=7).generate(
+        [[5, 6, 7, 8]],
+        SamplingParams(max_tokens=10, temperature=0.9, top_p=0.9,
+                       seed=124))
+    assert c[0].output_tokens != a[0].output_tokens
+
+
+def test_seeded_sampled_replay_is_token_exact():
+    """The failover-continuation property (ISSUE 9), engine-level:
+    re-submitting prompt + the first k sampled outputs as the new
+    prompt (same seed, max_tokens decremented) reproduces the
+    remaining tokens EXACTLY — sampling keys derive from (seed,
+    absolute token index), so the replay's prefill samples what the
+    original's decode ticks would have."""
+    prompt = [5, 6, 7, 8, 9]
+    p = SamplingParams(max_tokens=10, temperature=0.8, top_p=0.95,
+                      seed=999)
+    full = make_engine(seed=7).generate(
+        [prompt], p)[0].output_tokens
+    assert len(full) == 10
+    for k in (1, 4, 9):
+        cont = make_engine(seed=7).generate(
+            [prompt + full[:k]],
+            SamplingParams(max_tokens=10 - k, temperature=0.8,
+                           top_p=0.95, seed=999))[0].output_tokens
+        assert cont == full[k:], (k, cont, full)
+
+
+def test_deadline_expires_waiting_and_running_requests():
+    """ISSUE 9 deadline propagation, engine half: a request past its
+    deadline finishes with finish_reason="deadline" — straight out of
+    the waiting queue if it never got a slot, or aborted at the next
+    fold boundary if it was decoding (pages freed, slot reusable)."""
+    import time as _time
+
+    eng = make_engine()
+    # waiting-queue expiry: deadline already past at the first tick
+    r = Request("ddl-wait", [5, 6, 7], SamplingParams(max_tokens=5),
+                deadline=_time.monotonic() - 1.0)
+    eng.add_request(r)
+    touched = eng.step()
+    assert r.finished and r.finish_reason == "deadline"
+    assert r in touched              # the finish event reaches streams
+    assert not r.output_tokens
+
+    # running-slot expiry: admit normally, then expire mid-decode
+    r2 = Request("ddl-run", [5, 6, 7], SamplingParams(max_tokens=40),
+                 deadline=_time.monotonic() + 3600.0)
+    eng.add_request(r2)
+    for _ in range(4):
+        eng.step()
+    assert not r2.finished and r2.output_tokens
+    free_before = eng.allocator.free_pages
+    r2.deadline = _time.monotonic() - 1.0
+    eng.step()
+    assert r2.finished and r2.finish_reason == "deadline"
+    assert eng.allocator.free_pages > free_before   # pages freed
+    # the engine is still healthy: a fresh request completes
+    ok = eng.generate([[9, 8, 7]], SamplingParams(max_tokens=3))
+    assert ok[0].finish_reason is not None
+    kinds = [e["event"] for e in eng.telemetry.recorder.events()]
+    assert "deadline_abort" in kinds
+
+
 def test_stop_tokens():
     eng = make_engine()
     reqs = eng.generate([[5, 6, 7]], SamplingParams(max_tokens=30))
@@ -553,10 +629,12 @@ def test_async_stream_order_preserved():
         toks = srv.tokenizer.encode(prompt_text)
         deltas = []
         finishes = 0
-        async for delta, finished, reason in srv._generate_stream(
+        async for _, delta, finished, reason in srv._generate_stream(
                 toks, SamplingParams(max_tokens=max_tokens)):
-            deltas.append(delta)
-            finishes += finished
+            if not delta and not finished:
+                continue       # the SSE wrappers drop text-less
+            deltas.append(delta)   # events (tokens ride them for the
+            finishes += finished   # failover relay — ISSUE 9)
         return deltas, finishes
 
     async def main():

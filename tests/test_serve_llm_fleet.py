@@ -39,12 +39,15 @@ import pytest
 
 from ray_tpu.serve.llm import (AdmissionConfig, AdmissionController,
                                AdmissionRejected, AutoscaleConfig,
-                               FleetAutoscaler, FleetManager,
-                               FleetMetrics, FleetRouter, HashRing,
+                               ChaosReplicaClient, ChaosSchedule,
+                               CircuitBreaker, FleetAutoscaler,
+                               FleetManager, FleetMetrics, FleetRouter,
+                               HashRing, HealthConfig,
                                LocalReplicaClient, ReplicaSnapshot,
-                               RouterConfig, WatchdogConfig,
-                               merge_fleet_traces, prefix_fingerprint)
-from ray_tpu.serve.llm.fleet import ACTIVE, DRAINING, STANDBY
+                               RouterConfig, StreamSevered,
+                               WatchdogConfig, merge_fleet_traces,
+                               prefix_fingerprint)
+from ray_tpu.serve.llm.fleet import ACTIVE, DRAINING, STANDBY, UNHEALTHY
 from ray_tpu.util import metrics as metrics_api
 
 
@@ -1285,6 +1288,717 @@ def test_fleet_app_local_testing_mode(fleet_servers):
         assert bad.status == 404
     finally:
         serve.shutdown()
+
+
+# ----------------------------- failure plane (ISSUE 9): unit layers
+
+def test_circuit_breaker_state_machine():
+    """closed -> open after consecutive probe failures (with eviction
+    signal), cooldown -> half-open, successes close, a half-open
+    failure re-opens with a backed-off cooldown."""
+    cfg = HealthConfig(probe_failures=3, open_cooldown_s=1.0,
+                       cooldown_backoff=2.0, max_cooldown_s=30.0,
+                       half_open_probes=2)
+    b = CircuitBreaker(cfg)
+    assert b.state == "closed" and b.gauge() == 0
+    assert not b.record_failure(now=0.0)
+    assert not b.record_failure(now=0.1)
+    assert b.record_failure(now=0.2)          # 3rd opens
+    assert b.state == "open" and b.gauge() == 1 and b.trips == 1
+    # inside the cooldown: no probes
+    assert not b.should_probe(now=0.5)
+    assert b.state == "open"
+    # past it: half-open, probes admitted
+    assert b.should_probe(now=1.3)
+    assert b.state == "half_open" and b.gauge() == 2
+    # one success isn't enough; the second closes
+    assert not b.record_success()
+    assert b.state == "half_open"
+    assert b.record_success()
+    assert b.state == "closed" and b.failures == 0
+    # a hard failure (dispatch error) trips instantly from closed
+    assert b.record_failure(now=2.0, hard=True)
+    assert b.trips == 2
+    assert b.cooldown_s() == pytest.approx(2.0)   # backed off
+    assert b.should_probe(now=4.1)
+    assert b.state == "half_open"
+    # a half-open failure re-opens and backs off further
+    assert b.record_failure(now=4.2)
+    assert b.state == "open" and b.trips == 3
+    assert b.cooldown_s() == pytest.approx(4.0)
+    # a success once half-open again starts the count fresh
+    assert b.should_probe(now=8.3)
+    assert not b.record_success()
+    assert b.record_success()
+    assert b.state == "closed"
+
+
+def test_chaos_schedule_fires_deterministically():
+    """The harness contract: faults fire at exact per-method call
+    indices, `count` times, and the fired log records them — the same
+    schedule replays the same failure sequence every run."""
+    async def main():
+        sched = ChaosSchedule(seed=5)
+        sched.fail_calls(method="completions", at_call=1, count=2)
+        sched.timeout_probes(count=1)
+        client = ChaosReplicaClient(_FakeClient("r0"), sched)
+        assert client.replica_id == "r0"
+        # call 0 passes, calls 1+2 raise, call 3 passes again
+        with pytest.raises(AttributeError):
+            await client.call("completions")   # fake has no method:
+        for _ in range(2):                     # reaches the fake = pass
+            with pytest.raises(Exception) as ei:
+                await client.call("completions")
+            assert "chaos" in str(ei.value)
+        with pytest.raises(AttributeError):
+            await client.call("completions")
+        # fleet_stats: first probe times out, then flows again
+        with pytest.raises(asyncio.TimeoutError):
+            await client.call("fleet_stats")
+        out = await client.call("fleet_stats")
+        assert out["replica"] == "r0"
+        kinds = [f["kind"] for f in sched.fired]
+        assert kinds == ["call_error", "call_error", "probe_timeout"]
+        assert [f["call"] for f in sched.fired] == [1, 2, 0]
+    asyncio.run(main())
+
+
+def test_chaos_severed_stream_closes_inner_generator():
+    """A severed stream must close the replica-side generator (so the
+    server aborts the engine request like a real disconnect) and then
+    raise StreamSevered into the consumer."""
+    closed = {"v": False}
+
+    class StreamFake(_FakeClient):
+        def stream(self, method, body):
+            async def gen():
+                try:
+                    for i in range(10):
+                        yield {"i": i, "toks": [i]}
+                finally:
+                    closed["v"] = True
+            return gen()
+
+    async def main():
+        sched = ChaosSchedule().sever_stream(after_chunks=3)
+        client = ChaosReplicaClient(StreamFake("r0"), sched)
+        got = []
+        with pytest.raises(StreamSevered):
+            async for c in client.stream("completions_stream_tokens",
+                                         {}):
+                got.append(c["i"])
+        assert got == [0, 1, 2]
+        assert closed["v"], "inner stream generator was not closed"
+    asyncio.run(main())
+
+
+def test_chaos_wildcard_sever_waits_for_a_stream():
+    """A wildcard-method stream_sever must NOT be consumed by the
+    next unary call (e.g. a fleet_stats probe) — it waits for an
+    actual stream; probe_timeout conversely never fires on streams."""
+
+    class StreamFake(_FakeClient):
+        def stream(self, method, body):
+            async def gen():
+                for i in range(5):
+                    yield {"i": i, "toks": [i]}
+            return gen()
+
+    async def main():
+        sched = ChaosSchedule().sever_stream(after_chunks=1)
+        client = ChaosReplicaClient(StreamFake("r0"), sched)
+        out = await client.call("fleet_stats")   # unary: not eaten
+        assert out["replica"] == "r0"
+        assert not sched.fired
+        got = []
+        with pytest.raises(StreamSevered):
+            async for c in client.stream("completions_stream_tokens",
+                                         {}):
+                got.append(c["i"])
+        assert got == [0]
+        assert [f["kind"] for f in sched.fired] == ["stream_sever"]
+    asyncio.run(main())
+
+
+def test_ingress_relay_terminates_sse_on_exhausted_failover(
+        fleet_servers):
+    """When the failover budget runs out (every replica severs every
+    stream), the ingress must still END the SSE stream per the
+    convention — an error event then [DONE] — never a silent
+    truncation the client can't tell from a transport blip."""
+    from ray_tpu.serve.llm.deployment import LLMFleetIngressImpl
+
+    schedules = {rid: ChaosSchedule() for rid in fleet_servers}
+    for s in schedules.values():
+        s.sever_stream(after_chunks=1, count=-1)
+    fleet = FleetManager(
+        [ChaosReplicaClient(LocalReplicaClient(rid, srv),
+                            schedules[rid])
+         for rid, srv in fleet_servers.items()],
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        health=HealthConfig(max_failovers=1, open_cooldown_s=30.0),
+        model_id="m")
+    ingress = LLMFleetIngressImpl.__new__(LLMFleetIngressImpl)
+    ingress.model_id = "m"
+    ingress.fleet = fleet
+
+    async def main():
+        chunks = []
+        async for c in ingress._relay(
+                "completions_stream",
+                {"prompt": "doomed stream", "max_tokens": 6}):
+            chunks.append(c)
+        await fleet.stop()
+        _cancel_pumps(fleet_servers)
+        return chunks
+
+    chunks = asyncio.run(main())
+    assert chunks[-1] == "data: [DONE]\n\n"
+    docs = [json.loads(c[6:]) for c in chunks
+            if c.strip() != "data: [DONE]"]
+    assert any(d.get("error", {}).get("type") == "upstream_failure"
+               for d in docs), chunks
+    # tokens that made it out before the failure still framed cleanly
+    assert any("choices" in d for d in docs)
+
+
+def test_fleet_evicts_on_probe_failures_then_readmits():
+    """The tentpole's health state machine on the refresh loop:
+    3 consecutive probe timeouts evict the replica from the ring
+    within the probe cycle that trips the breaker; past the cooldown,
+    half-open probes re-admit it. The healthy replica's snapshot
+    stays fresh throughout."""
+    async def main():
+        sched = ChaosSchedule().timeout_probes(count=3)
+        chaotic = ChaosReplicaClient(_FakeClient("r1"), sched)
+        fleet = FleetManager(
+            [_FakeClient("r0"), chaotic],
+            autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+            health=HealthConfig(probe_failures=3,
+                                open_cooldown_s=0.05,
+                                half_open_probes=2))
+        base = sum(v for _, v in
+                   fleet.metrics["evictions"]._samples())
+        await fleet.refresh()
+        await fleet.refresh()
+        assert fleet.replicas["r1"].status == ACTIVE     # not yet
+        await fleet.refresh()                            # 3rd failure
+        assert fleet.replicas["r1"].status == UNHEALTHY
+        assert fleet.router.ring.nodes() == ["r0"]
+        assert fleet.replicas["r1"].breaker.state == "open"
+        assert sum(v for _, v in
+                   fleet.metrics["evictions"]._samples()) == base + 1
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "replica_evicted" in kinds
+        evs = [e["event"] for e in fleet._scale_events]
+        assert "evict" in evs
+        # healthy replica kept refreshing: snapshot is fresh
+        assert fleet.replicas["r0"].snapshot is not None
+        assert fleet.replicas["r0"].snapshot.age_s() < 5.0
+
+        # inside the cooldown the dead replica is left alone
+        calls_before = sched.stats()["calls"]["fleet_stats"]
+        await fleet.refresh()
+        assert sched.stats()["calls"]["fleet_stats"] == calls_before
+        assert fleet.replicas["r1"].status == UNHEALTHY
+
+        # past the cooldown: half-open probes (now healthy) re-admit
+        # after half_open_probes consecutive successes
+        await asyncio.sleep(0.06)
+        await fleet.refresh()                  # success 1: half-open
+        assert fleet.replicas["r1"].status == UNHEALTHY
+        assert fleet.replicas["r1"].breaker.state == "half_open"
+        await fleet.refresh()                  # success 2: closed
+        assert fleet.replicas["r1"].status == ACTIVE
+        assert fleet.replicas["r1"].breaker.state == "closed"
+        assert fleet.router.ring.nodes() == ["r0", "r1"]
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "replica_readmitted" in kinds
+        status = await fleet.status()
+        assert status["replicas"]["r1"]["breaker"]["trips"] == 1
+        await asyncio.sleep(0)                 # drain the dump task
+    asyncio.run(main())
+
+
+def test_request_faults_do_not_trip_the_breaker():
+    """A malformed REQUEST (replica raises ValueError/TypeError —
+    bad sampling params, unknown adapter) must neither evict the
+    healthy replica nor burn failover retries: one poisoned body must
+    not walk the ring evicting replicas."""
+
+    class BadRequestClient(_FakeClient):
+        async def call(self, method, *args):
+            if method == "completions":
+                raise ValueError("unknown model 'nope'")
+            return await super().call(method, *args)
+
+    async def main():
+        fleet = FleetManager(
+            [BadRequestClient("r0"), BadRequestClient("r1")],
+            autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+            health=HealthConfig())
+        with pytest.raises(ValueError):
+            await fleet.dispatch("completions", {"prompt": "x"})
+        for rid in ("r0", "r1"):
+            assert fleet.replicas[rid].status == ACTIVE
+            assert fleet.replicas[rid].breaker.state == "closed"
+        assert sorted(fleet.router.ring.nodes()) == ["r0", "r1"]
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "failover" not in kinds and "replica_evicted" not in kinds
+    asyncio.run(main())
+
+
+def test_evicting_sole_active_replica_activates_a_standby():
+    """With spare capacity parked on STANDBY, the sole active
+    replica's death must not defer into a dead-replica-serves-all
+    outage: a standby is activated as the replacement, THEN the dead
+    one is evicted."""
+    async def main():
+        sched = ChaosSchedule().timeout_probes(count=1)
+        fleet = FleetManager(
+            [ChaosReplicaClient(_FakeClient("r0"), sched),
+             _FakeClient("r1")],
+            autoscale=AutoscaleConfig(min_replicas=1, max_replicas=2),
+            health=HealthConfig(probe_failures=1))
+        assert fleet.replicas["r1"].status == STANDBY
+        await fleet.refresh()
+        assert fleet.replicas["r0"].status == UNHEALTHY
+        assert fleet.replicas["r1"].status == ACTIVE
+        assert fleet.router.ring.nodes() == ["r1"]
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "failover_activate" in kinds
+        await asyncio.sleep(0)         # drain the eviction dump task
+    asyncio.run(main())
+
+
+def test_deadline_sheds_do_not_feed_autoscaler_overload():
+    """A deadline shed is the client's budget spent, not fleet
+    overload: it must not count into shed_total (the autoscaler's
+    strongest scale-up trigger would otherwise pin an idle fleet at
+    max on expired-deadline traffic)."""
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=1, max_queue=4, queue_wait_slo_s=5.0))
+        for _ in range(3):
+            with pytest.raises(AdmissionRejected):
+                await adm.acquire("t", deadline=time.monotonic() - 1.0)
+        assert adm.rejected["deadline"] == 3
+        assert adm.shed_total == 0
+    asyncio.run(main())
+
+
+def test_unhealthy_replicas_stay_in_observability_fanouts():
+    """An evicted replica must not vanish from /metrics and
+    postmortem dumps mid-incident — that is exactly when its data is
+    wanted (a dead one degrades to an error row under the timeout)."""
+    async def main():
+        sched = ChaosSchedule().timeout_probes(count=1)
+        chaotic = ChaosReplicaClient(_FakeClient("r1"), sched)
+        fleet = FleetManager(
+            [_FakeClient("r0"), chaotic],
+            autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+            health=HealthConfig(probe_failures=1,
+                                open_cooldown_s=300.0))
+        await fleet.refresh()
+        assert fleet.replicas["r1"].status == UNHEALTHY
+        await fleet.metrics_text()
+        assert "metrics_text" in chaotic.inner.calls
+        await fleet.debug_dump_all("probe")
+        assert "debug_dump" in chaotic.inner.calls
+    asyncio.run(main())
+
+
+def test_fleet_never_evicts_last_active_replica():
+    """A false-positive eviction of the ONLY active replica would turn
+    an incident into a blackout: the breaker still opens (recovery
+    stays gated on half-open probes) but the replica keeps its ring
+    slot."""
+    async def main():
+        sched = ChaosSchedule().timeout_probes(count=1)
+        fleet = FleetManager(
+            [ChaosReplicaClient(_FakeClient("r0"), sched)],
+            health=HealthConfig(probe_failures=1))
+        await fleet.refresh()
+        assert fleet.replicas["r0"].breaker.state == "open"
+        assert fleet.replicas["r0"].status == ACTIVE
+        assert fleet.router.ring.nodes() == ["r0"]
+        kinds = [e["event"] for e in fleet.recorder.events()]
+        assert "eviction_deferred" in kinds
+    asyncio.run(main())
+
+
+def test_router_deprioritizes_stale_snapshots():
+    """ISSUE 9 satellite: a snapshot past snapshot_stale_s (its
+    replica's probes keep failing) is treated as saturated by the
+    affinity walk (spill to a replica with real numbers) and carries
+    a flat score penalty in the all-saturated fallback."""
+    cfg = RouterConfig(vnodes=16, snapshot_stale_s=0.5)
+    r = FleetRouter(cfg)
+    r.set_replicas(["r0", "r1"])
+    fp = prefix_fingerprint({"prompt": "stale probe " * 10})
+    primary, second = r.ring.preferred(fp)[:2]
+    fresh = {rid: _snap(rid) for rid in ("r0", "r1")}
+    assert r.pick(fp, fresh, {}) == primary
+    stale = dict(fresh)
+    stale[primary] = ReplicaSnapshot(
+        replica=primary, mono_ts=time.monotonic() - 5.0)
+    rid, outcome = r.pick_ex(fp, stale, {})
+    assert rid == second and outcome == "spill"
+    # scored fallback: staleness costs w_stale
+    s_fresh = r.score(_snap("x"), 0)
+    s_stale = r.score(ReplicaSnapshot(
+        replica="x", mono_ts=time.monotonic() - 5.0), 0)
+    assert s_stale == pytest.approx(s_fresh + cfg.w_stale)
+    # fleet status surfaces the age
+    assert stale[primary].age_s() > 4.0
+
+
+def test_admission_deadline_sheds_before_queueing_and_in_queue():
+    """ISSUE 9 deadline propagation, admission half: an
+    already-expired request sheds instantly (reason "deadline"), and
+    a queued request whose deadline lands before the queue-wait SLO
+    sheds at the deadline, not the SLO."""
+    async def main():
+        adm = AdmissionController(AdmissionConfig(
+            max_concurrent=1, max_queue=4, queue_wait_slo_s=5.0))
+        # expired on arrival: zero work, instant shed
+        with pytest.raises(AdmissionRejected) as ei:
+            await adm.acquire("t", deadline=time.monotonic() - 1.0)
+        assert ei.value.reason == "deadline"
+        assert adm.rejected["deadline"] == 1
+        # queued past its own (short) deadline: shed at the deadline
+        await adm.acquire("hog")
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as ei:
+            await adm.acquire("t", deadline=time.monotonic() + 0.1)
+        waited = time.monotonic() - t0
+        assert ei.value.reason == "deadline"
+        assert waited < 1.0                  # the 5s SLO did NOT gate
+        assert adm.rejected["deadline"] == 2
+        adm.release()
+    asyncio.run(main())
+
+
+# ------------------------------- failure plane (ISSUE 9): chaos e2e
+
+def _sse_transcript(chunks):
+    """Parse fleet SSE chunks -> (token_ids, text, finish_reason);
+    asserts exactly one finish."""
+    toks, text, reasons = [], "", []
+    for c in chunks:
+        payload = c[len("data: "):].strip()
+        if payload == "[DONE]":
+            continue
+        d = json.loads(payload)
+        ch = d["choices"][0]
+        toks += ch.get("token_ids") or []
+        text += ch.get("text") or ch.get("delta", {}).get("content", "") or ""
+        if ch["finish_reason"] is not None:
+            reasons.append(ch["finish_reason"])
+    assert len(reasons) == 1, reasons
+    return toks, text, reasons[0]
+
+
+def _chaos_fleet(servers, victim, after_chunks, **over):
+    """Fleet over the shared servers with a chaos wrapper per replica;
+    the victim's next token stream is severed after `after_chunks`."""
+    schedules = {rid: ChaosSchedule(seed=11) for rid in servers}
+    schedules[victim].sever_stream(
+        after_chunks=after_chunks, method="completions_stream_tokens")
+    kw = dict(
+        router=RouterConfig(prefix_depth=64, spill_waiting=64),
+        admission=AdmissionConfig(max_concurrent=8, max_queue=16,
+                                  queue_wait_slo_s=30.0),
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        health=HealthConfig(open_cooldown_s=30.0), model_id="m")
+    kw.update(over)
+    fleet = FleetManager(
+        [ChaosReplicaClient(LocalReplicaClient(rid, srv),
+                            schedules[rid])
+         for rid, srv in servers.items()], **kw)
+    return fleet, schedules
+
+
+def _prompt_routed_to(fleet, rid, salt=""):
+    i = 0
+    while True:
+        p = f"chaos stream probe {salt}{i}"
+        if fleet.router.ring.preferred(
+                prefix_fingerprint({"prompt": p}, 64))[0] == rid:
+            return p
+        i += 1
+
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_e2e_mid_stream_failover_token_exact(fleet_servers, sampled):
+    """THE acceptance gate: a replica severed mid-stream (2 chunks
+    delivered, more tokens in flight) is evicted from the ring, and
+    the client stream still completes with a transcript token-exact
+    vs a fresh single-replica oracle — greedy AND seeded-sampled —
+    with exactly-once delivery and one finish."""
+    gen = 12
+    victim = "r0"
+    fleet, schedules = _chaos_fleet(fleet_servers, victim,
+                                    after_chunks=2)
+    prompt = _prompt_routed_to(fleet, victim,
+                               "S" if sampled else "G")
+    body = {"prompt": prompt, "max_tokens": gen}
+    if sampled:
+        body.update(temperature=0.8, top_p=0.9, seed=4242)
+    fo_base = sum(v for _, v in
+                  fleet.metrics["failovers"]._samples())
+
+    async def main():
+        chunks = []
+        async for c in fleet.dispatch_stream("completions_stream",
+                                             dict(body)):
+            chunks.append(c)
+        # post-failover: the fleet still serves (survivor takes all)
+        out = await fleet.dispatch(
+            "completions", {"prompt": "after failover", "max_tokens": 2})
+        assert out["choices"][0]["finish_reason"] is not None
+        _cancel_pumps(fleet_servers)
+        return chunks
+
+    chunks = asyncio.run(main())
+    toks, _, reason = _sse_transcript(chunks)
+    assert reason in ("length", "stop")
+    # the sever actually fired and the failover plane reacted
+    assert [f["kind"] for f in schedules[victim].fired] \
+        == ["stream_sever"]
+    assert fleet.replicas[victim].status == UNHEALTHY
+    assert fleet.router.ring.nodes() == ["r1"]
+    kinds = [e["event"] for e in fleet.recorder.events()]
+    assert "failover" in kinds and "replica_evicted" in kinds
+    assert sum(v for _, v in
+               fleet.metrics["failovers"]._samples()) == fo_base + 1
+
+    # token-exact vs a fresh single-replica oracle (same weights seed)
+    oracle = _make_server("oracle", f"oracle{uuid.uuid4().hex[:6]}")
+
+    async def oracle_main():
+        out = []
+        async for c in oracle.completions_stream_tokens(dict(body)):
+            out.append(c)
+        _cancel_pumps({"oracle": oracle})
+        return [t for c in out for t in c["toks"]]
+
+    want = asyncio.run(oracle_main())
+    assert len(want) == gen
+    assert toks == want, (
+        "failover transcript diverged from the single-replica oracle")
+
+
+def test_e2e_hung_replica_stall_watchdog_fails_over(fleet_servers):
+    """The ISSUE 9 motivating case the probes alone can't save a
+    client from: a replica that HANGS mid-stream (no raise, no
+    end-of-stream). The relay's stall watchdog detects the silence,
+    fails over, and the transcript is still token-exact."""
+    gen = 10
+    victim = "r1"
+    schedules = {rid: ChaosSchedule() for rid in fleet_servers}
+    schedules[victim].stall_stream(
+        after_chunks=2, method="completions_stream_tokens")
+    fleet = FleetManager(
+        [ChaosReplicaClient(LocalReplicaClient(rid, srv),
+                            schedules[rid])
+         for rid, srv in fleet_servers.items()],
+        router=RouterConfig(prefix_depth=64, spill_waiting=64),
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        health=HealthConfig(stream_stall_timeout_s=1.0,
+                            open_cooldown_s=300.0),
+        model_id="m")
+    prompt = _prompt_routed_to(fleet, victim, "H")
+    body = {"prompt": prompt, "max_tokens": gen}
+
+    async def main():
+        chunks = []
+        async for c in fleet.dispatch_stream("completions_stream",
+                                             dict(body)):
+            chunks.append(c)
+        _cancel_pumps(fleet_servers)
+        return chunks
+
+    chunks = asyncio.run(main())
+    toks, _, reason = _sse_transcript(chunks)
+    assert reason in ("length", "stop")
+    assert len(toks) == gen
+    assert [f["kind"] for f in schedules[victim].fired] \
+        == ["stream_stall"]
+    assert fleet.replicas[victim].status == UNHEALTHY
+    kinds = [e["event"] for e in fleet.recorder.events()]
+    assert "failover" in kinds
+    # the failover classified the stall, not a generic timeout
+    fo = next(e for e in fleet.recorder.events()
+              if e["event"] == "failover")
+    assert "StreamStalled" in fo["error"]
+
+    # token-exact vs the oracle despite the hang
+    oracle = _make_server("oracle", f"oracle{uuid.uuid4().hex[:6]}")
+
+    async def oracle_main():
+        out = []
+        async for c in oracle.completions_stream_tokens(dict(body)):
+            out.append(c)
+        _cancel_pumps({"oracle": oracle})
+        return [t for c in out for t in c["toks"]]
+
+    assert toks == asyncio.run(oracle_main())
+
+
+def test_e2e_unary_hung_replica_bounded_by_deadline(fleet_servers):
+    """A hung replica must not strand a deadline-carrying UNARY
+    request (and its admission slot) forever: the ingress bounds the
+    await at remaining-deadline + grace, the timeout counts SOFTLY
+    toward the breaker (a tight client deadline must not evict a
+    healthy-but-slow replica outright), and the retry lands on a
+    healthy replica which sheds the expired request cleanly
+    (finish_reason="deadline")."""
+    schedules = {rid: ChaosSchedule() for rid in fleet_servers}
+    fleet = FleetManager(
+        [ChaosReplicaClient(LocalReplicaClient(rid, srv),
+                            schedules[rid])
+         for rid, srv in fleet_servers.items()],
+        router=RouterConfig(prefix_depth=64, spill_waiting=64),
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        health=HealthConfig(open_cooldown_s=300.0,
+                            unary_deadline_grace_s=1.0),
+        model_id="m")
+    victim = "r0"
+    prompt = _prompt_routed_to(fleet, victim, "U")
+    schedules[victim].slow_calls(60.0, method="completions")
+
+    async def main():
+        t0 = time.monotonic()
+        out = await fleet.dispatch(
+            "completions", {"prompt": prompt, "max_tokens": 4,
+                            "deadline_s": 0.3})
+        dt = time.monotonic() - t0
+        _cancel_pumps(fleet_servers)
+        return out, dt
+
+    out, dt = asyncio.run(main())
+    assert out["choices"][0]["finish_reason"] == "deadline"
+    assert dt < 10.0, dt                 # bounded, not the 60s hang
+    # soft evidence: counted toward the threshold, not an instant
+    # eviction — one tight deadline must not cost a ring slot
+    assert fleet.replicas[victim].status == ACTIVE
+    assert fleet.replicas[victim].breaker.failures >= 1
+    kinds = [e["event"] for e in fleet.recorder.events()]
+    assert "failover" in kinds
+
+
+def test_e2e_deadline_propagation_through_fleet(fleet_servers):
+    """ISSUE 9 deadline acceptance: an expired deadline sheds at the
+    front door (zero engine work, counted per stage), and a live one
+    rides the body into the engine, which aborts the stream at a fold
+    boundary with finish_reason="deadline"."""
+    fleet = _fleet_over(fleet_servers)
+
+    def shed_count(stage):
+        return sum(v for tags, v in
+                   fleet.metrics["deadline_sheds"]._samples()
+                   if tags.get("stage") == stage)
+
+    adm0, eng0 = shed_count("admission"), shed_count("engine")
+
+    async def main():
+        with pytest.raises(AdmissionRejected) as ei:
+            await fleet.dispatch(
+                "completions",
+                {"prompt": "already dead", "max_tokens": 2,
+                 "deadline_s": -1.0})
+        assert ei.value.reason == "deadline"
+
+        # mid-generation expiry: way too many tokens for the budget
+        chunks = []
+        async for c in fleet.dispatch_stream(
+                "completions_stream",
+                {"prompt": "deadline stream probe", "max_tokens": 200,
+                 "deadline_s": 0.2}):
+            chunks.append(c)
+        # unary path reports the deadline finish too (same prompt:
+        # its greedy sequence provably runs past the deadline
+        # without hitting a stop token)
+        out = await fleet.dispatch(
+            "completions",
+            {"prompt": "deadline stream probe", "max_tokens": 200,
+             "deadline_s": 0.2})
+        _cancel_pumps(fleet_servers)
+        return chunks, out
+
+    chunks, out = asyncio.run(main())
+    toks, _, reason = _sse_transcript(chunks)
+    assert reason == "deadline"
+    assert len(toks) < 200
+    assert out["choices"][0]["finish_reason"] == "deadline"
+    assert shed_count("admission") == adm0 + 1
+    assert shed_count("engine") >= eng0 + 2
+    # the replica recorded the engine-side abort
+    kinds = [e["event"]
+             for srv in fleet_servers.values()
+             for e in srv.engine.telemetry.recorder.events()]
+    assert "deadline_abort" in kinds
+
+
+def test_e2e_dispatch_discipline_with_chaos_wrapper(fleet_servers):
+    """ISSUE 9 acceptance: failure handling adds ZERO device work.
+    With the chaos wrapper installed and a mid-stream failover
+    already served, each replica's engine still measures 16
+    consecutive steady-state decode ticks = 16 dispatches, 0 h2d
+    transfers, 0 new compiles under the armed runtime guard."""
+    from ray_tpu.llm._internal.engine import Request, SamplingParams
+    from ray_tpu.util.jax_guard import dispatch_guard
+
+    fleet, schedules = _chaos_fleet(fleet_servers, "r1",
+                                    after_chunks=1)
+    prompt = _prompt_routed_to(fleet, "r1", "D")
+
+    async def prime():
+        chunks = []
+        async for c in fleet.dispatch_stream(
+                "completions_stream",
+                {"prompt": prompt, "max_tokens": 6}):
+            chunks.append(c)
+        _cancel_pumps(fleet_servers)
+        return chunks
+
+    chunks = asyncio.run(prime())
+    toks, _, _ = _sse_transcript(chunks)
+    assert len(toks) == 6
+    assert schedules["r1"].fired          # the failover really ran
+
+    rng = np.random.default_rng(9)
+    for rid, srv in fleet_servers.items():
+        eng = srv.engine
+        while eng.has_work():
+            eng.step()
+        rids = []
+        for i in range(2):
+            r = f"chaosguard-{rid}-{i}"
+            rids.append(r)
+            eng.add_request(Request(
+                r, rng.integers(2, 250, 12).tolist(),
+                SamplingParams(max_tokens=64, temperature=0.7,
+                               top_p=0.9, seed=17 + i)))
+        while eng.waiting or any(s.request is not None and not s.ready
+                                 for s in eng.slots):
+            eng.step()
+        for _ in range(4):
+            eng.step()
+        comp0 = eng.stats()["jit_cache"]["compiled_programs"]
+        disp0 = eng.dispatches
+        with dispatch_guard() as rep:
+            for _ in range(16):
+                eng.step()
+        assert eng.dispatches - disp0 == 16, rid
+        assert rep.n_compiles == 0, rid
+        assert eng.stats()["jit_cache"]["compiled_programs"] == comp0
+        for r in rids:
+            eng.abort(r)
+        while eng.has_work():
+            eng.step()
 
 
 # ----------------------------------- process-spawning (slow) coverage
