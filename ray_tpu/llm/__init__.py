@@ -86,6 +86,26 @@ Single-replica metric catalogue:
     ray_tpu_llm_prefix_cache_hit_rate       gauge      hit tokens / queried tokens
     ray_tpu_llm_token_budget_utilization    gauge      packed / budget, unified ticks
 
+ISSUE 10 KV-memory-hierarchy additions (host-offload tier + preemption
+spill/restore; details: BENCH_CORE.md "KV memory hierarchy anatomy";
+`finished_total` gains reason `error` for true page exhaustion):
+
+    ray_tpu_llm_kv_host_pages_used          gauge      KV pages parked in the host-RAM
+                                                       tier (spilled, awaiting restore)
+    ray_tpu_llm_parked_sessions             gauge      preempted sequences parked in the
+                                                       host tier
+    ray_tpu_llm_kv_page_pressure            gauge      (device pages used + parked host
+                                                       pages) / usable; > 1 means the
+                                                       engine is oversubscribed
+    ray_tpu_llm_kv_spills_total             counter    victim sequences spilled
+                                                       device -> host
+    ray_tpu_llm_kv_restores_total           counter    parked sequences restored
+                                                       host -> device, token-exact
+    ray_tpu_llm_preemptions_total           counter    + `reason` tag (growth|manual|...)
+    ray_tpu_llm_fleet_page_pressure         gauge      fleet max page pressure (ingress
+                                                       registry; watchdog hysteresis +
+                                                       spillability-gated brownout)
+
 Instrumentation is recorded purely from host-side engine events (zero
 device syncs, zero extra dispatches — the dispatch-guard suite runs
 with it enabled); disable per engine with

@@ -178,6 +178,32 @@ class EngineConfig:
     enable_blackbox: bool = True
     blackbox_dir: Optional[str] = None      # None -> per-engine tempdir
     blackbox_capacity: int = 16             # bundles retained
+    # -- KV memory hierarchy (ISSUE 10) --------------------------------
+    # Host-RAM KV tier + scheduler preemption: under page pressure the
+    # engine spills a victim slot's KV pages device→host (async d2h —
+    # the copy streams while decode continues), retires the slot, and
+    # PARKS the request; once pages free up it is re-admitted with its
+    # pages restored token-exact (same per-request sampling keys as
+    # failover replay, so greedy AND sampled streams are byte-identical
+    # to a never-preempted run). Off by default: "out of pages" stays
+    # a hard signal unless the operator opts into the latency tier.
+    # Does not compose with pp>1 or speculative engines (their KV
+    # lives in stage/draft pools this tier does not migrate).
+    enable_kv_offload: bool = False
+    # Host-tier capacity in pages (None = unbounded). A full tier makes
+    # preemption attempts fail, falling back to the exhaustion path.
+    host_kv_pages: Optional[int] = None
+    # Optimistic admission (ISSUE 10): None keeps the worst-case
+    # prompt+max_tokens reservation. An int W shrinks the reservation
+    # to prompt + min(max_tokens, W) tokens; a decoding slot crossing
+    # its reservation grows page-by-page (to its full remaining need
+    # when pages are plentiful, minimally under pressure), with
+    # preemption as the safety valve — the engine oversubscribes
+    # device pages like vLLM. REQUIRES enable_kv_offload: without the
+    # preemption/parking valve the oversubscription this creates has
+    # no recourse, and requests a worst-case-reserving engine would
+    # simply queue behind instead finish with finish_reason="error".
+    kv_watermark_tokens: Optional[int] = None
     # Real-checkpoint path: directory holding an HF-layout safetensors
     # checkpoint (model.safetensors[.index.json] + config.json). Params
     # load through models/checkpoint_io.py — sharding-aware windowed
@@ -241,6 +267,14 @@ class Request:
     # it (finish_reason="deadline"), whether it is still waiting for
     # admission or holding a decode slot. None = no deadline.
     deadline: Optional[float] = None
+    # preemption priority (ISSUE 10): under page pressure the LOWEST
+    # priority loses its slot first (ties break youngest-first); the
+    # serving plane maps tenant tiers onto this
+    priority: int = 0
+    # times this request lost its slot and came back (preemption
+    # spill/restore or prefill requeue) — restores skip the admission
+    # telemetry so queue-wait/prefix-hit stats count each request once
+    restarts: int = 0
 
 
 class _Slot:
@@ -424,6 +458,45 @@ class InferenceEngine:
             ec.num_pages, ec.page_size,
             enable_prefix_caching=ec.enable_prefix_caching)
         self.max_pages_per_seq = self.allocator.pages_needed(self.max_seq)
+        # -- KV memory hierarchy (ISSUE 10) ----------------------------
+        if (ec.enable_kv_offload or ec.kv_watermark_tokens is not None) \
+                and (self.pp > 1 or ec.speculative):
+            raise ValueError(
+                "the KV memory hierarchy (enable_kv_offload / "
+                "kv_watermark_tokens) does not compose with pp>1 or "
+                "speculative engines: their KV lives in stage/draft "
+                "pools the host tier does not migrate")
+        if ec.kv_watermark_tokens is not None \
+                and ec.kv_watermark_tokens < 1:
+            raise ValueError("kv_watermark_tokens must be >= 1 or None")
+        if ec.kv_watermark_tokens is not None \
+                and not ec.enable_kv_offload:
+            raise ValueError(
+                "kv_watermark_tokens (optimistic admission) requires "
+                "enable_kv_offload: oversubscribing device pages "
+                "without the preemption/parking safety valve turns "
+                "ordinary contention into finish_reason=\"error\" "
+                "failures a worst-case-reserving engine would simply "
+                "queue through")
+        from .kv_offload import HostKVTier
+        self.host_tier: Optional[HostKVTier] = (
+            HostKVTier(ec.host_kv_pages) if ec.enable_kv_offload
+            else None)
+        self.allocator.host_tier = self.host_tier
+        # preemptions by reason (growth | admission | manual | ...)
+        self.preempt_counts: Dict[str, int] = {}
+        # spills whose async d2h copy is still streaming; materialized
+        # to host numpy at the NEXT tick entry (one tick of overlap —
+        # the lagged-readback discipline applied to page migration)
+        self._pending_spills: List[Any] = []
+        # page-migration programs, cached per power-of-two page-count
+        # bucket (state migration, excluded from self.dispatches like
+        # every other non-forward refresh program)
+        self._page_gather_fns: Dict[int, Any] = {}
+        self._page_scatter_fns: Dict[int, Any] = {}
+        # slot index last attempting a page allocation — the engine-
+        # boundary MemoryError handler's victim attribution
+        self._alloc_ctx: Optional[int] = None
         # observability (ISSUE 5): SLO metrics + lifecycle timelines +
         # flight recorder, recorded purely from host-side events —
         # see telemetry.py for the zero-sync contract
@@ -1814,6 +1887,431 @@ class InferenceEngine:
                 return b
         return self.max_seq
 
+    # -- KV memory hierarchy (ISSUE 10) -------------------------------------
+    # Host-offload tier + preemption spill/restore. Every method here
+    # runs at STRUCTURAL time (after a _drain, outside the steady
+    # decode path): the page gather/scatter programs are state
+    # migration like _refresh_device_state's uploads — excluded from
+    # self.dispatches, counted into self.compiles on first build — and
+    # the restore upload is a sanctioned structural-event h2d exactly
+    # like admission's prefill uploads. Steady-state decode ticks with
+    # the tier active stay 0 h2d / 0 compiles / 1 dispatch (the
+    # dispatch-guard suite runs offload-enabled engines).
+
+    @property
+    def parked(self) -> List[Any]:
+        """Parked (spilled) sequences, FIFO restore order."""
+        return self.host_tier.entries() if self.host_tier else []
+
+    def _reserve_tokens(self, prompt_len: int, max_tokens: int) -> int:
+        """Admission page reservation in tokens: worst case
+        (prompt + max_tokens) by default; under optimistic admission
+        (kv_watermark_tokens) only prompt + watermark, with page
+        growth + preemption covering the rest."""
+        wm = self.config.kv_watermark_tokens
+        if wm is None:
+            return prompt_len + max_tokens
+        return prompt_len + min(max_tokens, wm)
+
+    @staticmethod
+    def _page_bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _page_gather_fn(self, nb: int):
+        """Jitted d2h spill gather: copy `nb` pages out of the pools
+        into a fresh (L, nb, page, H, D) buffer whose async host copy
+        can stream while the freed pool pages are reused."""
+        fn = self._page_gather_fns.get(nb)
+        if fn is None:
+            def run(k_pages, v_pages, ids):
+                return (jnp.take(k_pages, ids, axis=1),
+                        jnp.take(v_pages, ids, axis=1))
+
+            # donation audit (JL002): the pools are deliberately NOT
+            # donated — the gather READS the live pools (which the
+            # next tick keeps using) into an independent spill buffer;
+            # donating would invalidate the engine's pool handles
+            fn = jax.jit(run)  # jaxlint: disable=JL002 -- read-only spill gather: pools stay live for the next tick, output is the independent d2h buffer
+            self.compiles += 1
+            self._page_gather_fns[nb] = fn
+        return fn
+
+    def _page_scatter_fn(self, nb: int):
+        """Jitted h2d restore scatter: write `nb` host pages into
+        their freshly-allocated pool slots. Pools are donated — XLA
+        updates them in place, no copy of the cache per restore."""
+        fn = self._page_scatter_fns.get(nb)
+        if fn is None:
+            def run(k_pages, v_pages, ids, kh, vh):
+                return (k_pages.at[:, ids].set(kh),
+                        v_pages.at[:, ids].set(vh))
+
+            kw = {}
+            if self._kv_sharding is not None:
+                # tp mesh: pin the restored pools to the engine's KV
+                # sharding — inference could otherwise replicate the
+                # output, breaking donation and retracing every
+                # decode program against the new layout
+                kw["out_shardings"] = (self._kv_sharding,
+                                       self._kv_sharding)
+            fn = jax.jit(run, donate_argnums=(0, 1), **kw)
+            self.compiles += 1
+            self._page_scatter_fns[nb] = fn
+        return fn
+
+    def _finalize_spills(self) -> None:
+        """Materialize pending spills to host numpy, one tick after
+        their gather dispatched — the copy_to_host_async started at
+        spill time has had a whole tick to stream, so this readback is
+        (ideally) a wait-free pickup, the lagged-readback discipline
+        applied to page migration."""
+        if not self._pending_spills:
+            return
+        for parked in self._pending_spills:
+            parked.materialize(self._read_tokens)
+        self._pending_spills.clear()
+
+    def _preempt_slot(self, victim: _Slot, touched: List[Request],
+                      reason: str) -> bool:
+        """Preempt one slot (caller has drained). A decoding victim
+        SPILLS: its cached pages gather into a fresh buffer (async d2h
+        starts immediately), the request parks in the host tier, and
+        the device pages free for the winner. A still-prefilling
+        victim REQUEUES instead — it has emitted nothing, so going
+        back to the head of the waiting queue is token-exact for free
+        and its warm prompt pages survive in the prefix cache.
+        Returns False when the victim cannot be preempted (no host
+        tier for a decoding victim, or the tier is full)."""
+        req = victim.request
+        if not victim.ready:
+            self.allocator.free(victim.pages)
+            self._clear_slot(victim)
+            req.restarts += 1
+            self.waiting.insert(0, req)
+            self.preempt_counts[reason] = \
+                self.preempt_counts.get(reason, 0) + 1
+            self.telemetry.on_preempted(req, reason, mode="requeue")
+            return True
+        tier = self.host_tier
+        if tier is None:
+            return False
+        n_pages = self.allocator.pages_needed(victim.position)
+        if not tier.can_store(n_pages):
+            return False
+        from .kv_offload import ParkedSequence
+        nb = self._page_bucket(n_pages)
+        ids = victim.pages[:n_pages]
+        ids = ids + [ids[-1]] * (nb - n_pages)
+        kh, vh = self._page_gather_fn(nb)(
+            self.k_pages, self.v_pages,
+            self._dev(jnp.asarray(np.asarray(ids, np.int32))))
+        # overlap: the d2h copies stream while decode continues; the
+        # gather output is its own buffer, so the pool pages freed
+        # below can be rewritten without corrupting the spill
+        for arr in (kh, vh):
+            start = getattr(arr, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        parked = ParkedSequence(
+            request=req, seed=victim.seed, position=victim.position,
+            last_token=victim.last_token, n_pages=n_pages,
+            reason=reason, k_pending=kh, v_pending=vh)
+        tier.park(parked)
+        self._pending_spills.append(parked)
+        self.allocator.free(victim.pages)
+        self._clear_slot(victim)
+        self.preempt_counts[reason] = \
+            self.preempt_counts.get(reason, 0) + 1
+        self.telemetry.on_preempted(req, reason, mode="spill",
+                                    pages=n_pages,
+                                    position=victim.position)
+        return True
+
+    def _alloc_or_preempt(self, n: int, protect, touched: List[Request],
+                          reason: str) -> Optional[List[int]]:
+        """allocate_pages with preemption as the safety valve: while
+        pages are short, spill/requeue victims (deterministic order —
+        kv_offload.pick_victim) until the allocation fits or no victim
+        remains. None = genuinely exhausted (caller degrades)."""
+        if n <= 0:
+            return []
+        from .kv_offload import pick_victim
+        while n > self.allocator.free_pages:
+            victim = (pick_victim(self.slots, protect,
+                                  spill_ok=self.host_tier is not None)
+                      if self.config.enable_kv_offload else None)
+            if victim is None \
+                    or not self._preempt_slot(victim, touched, reason):
+                return None
+        return self.allocator.allocate_pages(n)
+
+    def _grow_slots(self, touched: List[Request]) -> None:
+        """Optimistic-admission page growth: any decoding slot whose
+        next ticks would write past its reservation extends it BEFORE
+        the dispatch — to its full remaining need when pages are
+        plentiful (so a slot grows once, not every page boundary),
+        minimally (with preemption) under pressure. Growth failure is
+        the ISSUE-10 exhaustion path: the slot finishes with
+        finish_reason="error" instead of raising into the pump."""
+        if self.config.kv_watermark_tokens is None:
+            return
+        page = self.allocator.page_size
+        k = max(int(self.config.decode_steps_per_call or 1), 1)
+        # headroom past the host position: the next dispatch writes at
+        # s.position (min(k, rem) tokens for multi-step rounds), the
+        # pipelined successor one past that (the fold assert's +1
+        # slack), PLUS one more with async_readback on — the host
+        # position is one tick stale at this check (the in-flight
+        # tick's write is not folded yet), so growth must trigger a
+        # tick early or the drain fold below trips its own assert
+        slack = 2 if self._async else 1
+
+        def targets(s):
+            """(minimum, full) token targets for one slot's growth.
+            Both clamp to the request's TRUE final need — position +
+            remaining + 1 == prompt + max_tokens, the worst-case
+            reservation add_request validated against max_seq — so
+            growth can never demand a page past max_pages_per_seq
+            (an unclamped slack near the end would overflow the
+            fixed page-table row) nor spill a victim for a page
+            that will never be written."""
+            rem = max(s.request.params.max_tokens
+                      - len(s.request.output_tokens), 1)
+            final = s.position + rem + 1
+            return min(s.position + min(k, rem) + slack, final), final
+
+        def short(s):
+            if s.request is None or not s.ready:
+                return False
+            return len(s.pages) * page < targets(s)[0]
+
+        if not any(short(s) for s in self.slots):
+            return
+        self._drain(touched)      # structural: tables are changing
+        dirty = False
+        for s in self.slots:
+            if not short(s):
+                continue          # may have retired in the drain fold
+            min_tokens, full_tokens = targets(s)
+            full_need = self.allocator.pages_needed(
+                full_tokens) - len(s.pages)
+            min_need = self.allocator.pages_needed(
+                min_tokens) - len(s.pages)
+            self._alloc_ctx = s.index
+            try:
+                free = self.allocator.free_pages
+                if free >= min_need:
+                    got = self.allocator.allocate_pages(
+                        max(min(full_need, free), min_need))
+                else:
+                    # under real pressure the victim order must hold
+                    # ACROSS growers too: if this slot is itself the
+                    # fleet's designated victim (lowest priority /
+                    # youngest), park IT rather than letting slot
+                    # iteration order preempt a higher-priority peer
+                    from .kv_offload import pick_victim
+                    if self.config.enable_kv_offload and pick_victim(
+                            self.slots, (),
+                            spill_ok=self.host_tier is not None) is s \
+                            and self._preempt_slot(s, touched,
+                                                   "growth"):
+                        dirty = True
+                        continue
+                    got = self._alloc_or_preempt(
+                        min_need, (s.index,), touched, "growth")
+            finally:
+                self._alloc_ctx = None
+            if got is None:
+                self._kv_exhausted(s, touched, where="growth")
+                dirty = True
+                continue
+            s.pages.extend(got)
+            self._page_tables[s.index][:len(s.pages)] = s.pages
+            self._tables_version += 1
+            dirty = True
+        if dirty:
+            self._refresh_device_state()
+
+    def _restore_parked(self, touched: List[Request]) -> bool:  # jaxlint: disable=JL006 -- restore-time page upload: one scatter per re-admitted sequence (structural event), never on the tick path
+        """Re-admit parked sequences (FIFO), restoring their KV pages
+        token-exact: full prompt pages still resident in the prefix
+        cache are re-shared as-is (their content IS the original
+        prefill KV), the rest upload from the host tier into freshly
+        allocated pages via the donated scatter program. The restored
+        slot resumes the decode invariant exactly as spilled —
+        `position` cached tokens, `last_token` pending — so the next
+        tick samples with the same (seed, absolute index) key a
+        never-preempted engine would have used."""
+        tier = self.host_tier
+        if tier is None or not len(tier):
+            return False
+        restored = False
+        for parked in tier.entries():
+            slot = next((s for s in self.slots if s.request is None),
+                        None)
+            if slot is None:
+                break
+            req = parked.request
+            remaining = (req.params.max_tokens
+                         - len(req.output_tokens))
+            reserve = parked.position + 1 + (
+                remaining if self.config.kv_watermark_tokens is None
+                else min(remaining, self.config.kv_watermark_tokens))
+            shared, matched = self.allocator.match_prefix(
+                req.prompt_tokens)
+            need = self.allocator.pages_needed(reserve) - len(shared)
+            if need > self.allocator.free_pages:
+                self.allocator.free(shared)   # undo the match refs
+                break        # FIFO head waits; no preempt-to-restore
+            parked.materialize(self._read_tokens)
+            if parked in self._pending_spills:
+                self._pending_spills.remove(parked)
+            tier.pop(req.request_id)
+            pages = shared + self.allocator.allocate_pages(need)
+            lo, hi = len(shared), parked.n_pages
+            if hi > lo:
+                cnt = hi - lo
+                nb = self._page_bucket(cnt)
+                ids = pages[lo:hi] + [pages[hi - 1]] * (nb - cnt)
+                kh = parked.k_host[:, lo:hi]
+                vh = parked.v_host[:, lo:hi]
+                if nb > cnt:
+                    pad = nb - cnt
+                    kh = np.concatenate(
+                        [kh, np.repeat(kh[:, -1:], pad, axis=1)], 1)
+                    vh = np.concatenate(
+                        [vh, np.repeat(vh[:, -1:], pad, axis=1)], 1)
+                # the sanctioned restore upload: a structural-event
+                # h2d (like admission prefill uploads), never on the
+                # steady decode path
+                self.k_pages, self.v_pages = self._page_scatter_fn(nb)(
+                    self.k_pages, self.v_pages,
+                    self._dev(jnp.asarray(np.asarray(ids, np.int32))),
+                    self._dev(jnp.asarray(kh)),
+                    self._dev(jnp.asarray(vh)))
+            slot.request = req
+            slot.pages = pages
+            slot.prefill_pos = len(req.prompt_tokens)
+            slot.position = parked.position
+            slot.last_token = parked.last_token
+            slot.ready = True
+            slot.seed = parked.seed
+            table = np.zeros(self.max_pages_per_seq, np.int32)
+            table[:len(pages)] = pages
+            self._page_tables[slot.index] = table
+            self._tables_version += 1
+            self._mark_seen_dirty(slot.index)
+            self._samp_cache = None
+            req.restarts += 1
+            self.telemetry.on_restored(req, pages=parked.n_pages,
+                                       parked_s=parked.idle_s(),
+                                       shared_pages=len(shared))
+            restored = True
+        if restored:
+            # restored slots are decode-ready: rebuild the device
+            # loop state lazily on the next decode/ragged tick
+            self._d_tokens = None
+        return restored
+
+    def _restore_possible(self) -> bool:
+        """Mirror of _restore_parked's head-of-queue feasibility check
+        (conservative toward True, like _admit_possible)."""
+        tier = self.host_tier
+        if tier is None or not len(tier):
+            return False
+        if not any(s.request is None for s in self.slots):
+            return False
+        parked = tier.entries()[0]
+        req = parked.request
+        remaining = req.params.max_tokens - len(req.output_tokens)
+        reserve = parked.position + 1 + (
+            remaining if self.config.kv_watermark_tokens is None
+            else min(remaining, self.config.kv_watermark_tokens))
+        need = self.allocator.pages_needed(reserve)
+        if self.allocator.enable_prefix_caching:
+            need -= ((len(req.prompt_tokens) - 1)
+                     // self.allocator.page_size)
+        return need <= self.allocator.free_pages
+
+    def _kv_exhausted(self, slot: Optional[_Slot],
+                      touched: List[Request], where: str,
+                      error: Optional[str] = None) -> None:
+        """Graceful degradation for true page exhaustion (ISSUE 10):
+        a guard_violation-style flight-recorder event (alert-hooked —
+        it black-boxes a postmortem bundle), and the victim request
+        finishes with finish_reason="error" instead of a MemoryError
+        wedging the replica's pump."""
+        req = slot.request if slot is not None else None
+        self.telemetry.recorder.record(
+            "kv_exhausted", where=where, error=error,
+            request_id=req.request_id if req else None,
+            free_pages=self.allocator.free_pages,
+            parked=len(self.parked), waiting=len(self.waiting))
+        if req is not None:
+            self._finish(slot, "error")
+            touched.append(req)
+
+    def _handle_memory_error(self, exc: MemoryError,
+                             touched: List[Request]) -> None:
+        """Engine-boundary backstop (ISSUE 10 satellite): a raw
+        MemoryError escaping allocate_pages mid-tick — any path the
+        graceful growth/admission checks did not cover — retires the
+        attributable victim (or the lowest-priority/youngest slot)
+        with finish_reason="error" and leaves the pump alive."""
+        victim: Optional[_Slot] = None
+        if self._alloc_ctx is not None:
+            s = self.slots[self._alloc_ctx]
+            if s.request is not None:
+                victim = s
+        self._alloc_ctx = None
+        if victim is None:
+            from .kv_offload import pick_victim
+            victim = pick_victim(self.slots, ())
+        self._kv_exhausted(victim, touched, where="engine_boundary",
+                           error=repr(exc))
+        # the refresh folds any in-flight tick and rebuilds device
+        # state over the survivors, whatever the failed path left
+        self._refresh_device_state()
+
+    def page_pressure(self) -> float:
+        """Demand on the device pool as a fraction of usable pages:
+        live pages PLUS parked pages that want back in. > 1.0 means
+        oversubscribed — the autoscaler and watchdog consume this
+        (fleet_stats / GET /metrics)."""
+        usable = self.allocator.num_usable
+        if not usable:
+            return 0.0
+        host = self.host_tier.used_pages if self.host_tier else 0
+        return (self.allocator.used_pages + host) / usable
+
+    def preempt(self, request_id: str, reason: str = "manual") -> bool:
+        """Preempt one running request (operator / serving-plane hook;
+        also the long-idle session-parking entry point: parking a
+        session between turns frees its device pages until the next
+        turn restores them token-exact). Serialized against step()
+        like abort(). Returns False if the request is not in a slot
+        or cannot be parked (no host tier for a decoding victim)."""
+        with self._step_lock:
+            for slot in self.slots:
+                req = slot.request
+                if req is None or req.request_id != request_id:
+                    continue
+                if slot.ready and self.host_tier is None:
+                    return False
+                self._drain(self._pending_touched)
+                req = slot.request
+                if req is None or req.request_id != request_id:
+                    return False     # finished inside the drain fold
+                if self._preempt_slot(slot, self._pending_touched,
+                                      reason):
+                    self._refresh_device_state()
+                    return True
+                return False
+            return False
+
     # -- public API ---------------------------------------------------------
     def register_lora(self, name: str, adapters: Dict[str, tuple],
                       scale: float = 1.0) -> None:
@@ -1953,6 +2451,8 @@ class InferenceEngine:
         # would park with finish events stranded in _pending_touched
         return (bool(self.waiting) or bool(self._pending_touched)
                 or self._inflight is not None
+                or (self.host_tier is not None
+                    and len(self.host_tier) > 0)
                 or any(s.request is not None for s in self.slots))
 
     def num_active(self) -> int:
@@ -1974,12 +2474,14 @@ class InferenceEngine:
         and termination are unchanged)."""
         with self._step_lock:
             self._profile_tick_begin()
+            # tokens folded by an out-of-step drain (abort/LoRA
+            # registration) ride the NEXT step's touched list (hoisted
+            # out of the try so the MemoryError path below can still
+            # deliver them)
+            touched: List[Request] = self._pending_touched
+            self._pending_touched = []
             try:
                 t0 = time.perf_counter()
-                # tokens folded by an out-of-step drain (abort/LoRA
-                # registration) ride the NEXT step's touched list
-                touched: List[Request] = self._pending_touched
-                self._pending_touched = []
                 self.ticks += 1
                 self._step_tick(touched)
                 wall = time.perf_counter() - t0
@@ -1991,6 +2493,16 @@ class InferenceEngine:
                 # tick's record instead of vanishing from the telemetry
                 self._tick_host_s = 0.0
                 self._tick_dev_s = 0.0
+                self.last_step_at = time.monotonic()
+            except MemoryError as exc:
+                # page exhaustion is handled degradation, not a crash
+                # (ISSUE 10): the graceful paths (_grow_slots/_admit)
+                # never raise, so a raw MemoryError here is an
+                # uncovered allocator path — record the alert-hooked
+                # kv_exhausted event (it black-boxes a bundle), retire
+                # a victim with finish_reason="error", keep pumping
+                self._profile_abort()
+                self._handle_memory_error(exc, touched)
                 self.last_step_at = time.monotonic()
             except BaseException as exc:
                 # a mid-tick raise (fold reservation assert,
@@ -2015,12 +2527,16 @@ class InferenceEngine:
         state. Mirrors _admit's head-of-line check assuming BEST-CASE
         prefix sharing (free_pages already counts evictable cached
         pages)."""
+        if self.host_tier is not None and len(self.host_tier):
+            # parked sequences restore before (and instead of) new
+            # admissions — mirror that policy here too
+            return self._restore_possible()
         if not self.waiting or not any(s.request is None
                                        for s in self.slots):
             return False
         req = self.waiting[0]
-        need = self.allocator.pages_needed(
-            len(req.prompt_tokens) + req.params.max_tokens)
+        need = self.allocator.pages_needed(self._reserve_tokens(
+            len(req.prompt_tokens), req.params.max_tokens))
         if self.allocator.enable_prefix_caching:
             # best case: every full page of prompt[:-1] is cached
             # (match_prefix caps one token short of the prompt)
@@ -2029,6 +2545,9 @@ class InferenceEngine:
         return need <= self.allocator.free_pages
 
     def _step_tick(self, touched: List[Request]) -> None:
+        # pick up last tick's spill copies (pure d2h, usually already
+        # streamed home — the page-migration analogue of lagged folds)
+        self._finalize_spills()
         # deadline expiry first (ISSUE 9): an expired request must not
         # consume this tick's budget, and an expired WAITING request
         # must not claim the slot a live one could take
@@ -2045,7 +2564,11 @@ class InferenceEngine:
                 or any(s.request is not None and not s.ready
                        for s in self.slots):
             self._drain(touched)
-        self._admit()
+        self._admit(touched)
+        # optimistic admission (ISSUE 10): extend reservations BEFORE
+        # the dispatch whose KV writes would cross them (no-op unless
+        # kv_watermark_tokens is set)
+        self._grow_slots(touched)
         if self.config.unified_step and self.pp == 1 and any(
                 s.request is not None and not s.ready
                 for s in self.slots):
@@ -2104,9 +2627,33 @@ class InferenceEngine:
             s.request is not None and s.request.deadline is not None
             for s in self.slots)
         has_wait_ddl = any(r.deadline is not None for r in self.waiting)
-        if not has_slot_ddl and not has_wait_ddl:
+        # allocation-free when the tier is off/empty: this runs every
+        # tick, and per-tick garbage shifts GC pauses into the decode
+        # loop (the parked list itself only materializes on demand)
+        has_park_ddl = (self.host_tier is not None
+                        and len(self.host_tier) > 0
+                        and any(p.request.deadline is not None
+                                for p in self.parked))
+        if not has_slot_ddl and not has_wait_ddl and not has_park_ddl:
             return
         now = time.monotonic()
+        if has_park_ddl:
+            # an expired PARKED request must not claim the restore
+            # pages a live one could take; its host KV just drops
+            for parked in list(self.parked):
+                req = parked.request
+                if req.deadline is None or now < req.deadline:
+                    continue
+                self.host_tier.drop(req.request_id)
+                if parked in self._pending_spills:
+                    self._pending_spills.remove(parked)
+                req.finished = True
+                req.finish_reason = "deadline"
+                self.telemetry.recorder.record(
+                    "deadline_abort", request_id=req.request_id,
+                    where="parked", generated=len(req.output_tokens))
+                self.telemetry.on_finished(req, "deadline")
+                touched.append(req)
         if has_slot_ddl:
             expired = [s for s in self.slots
                        if s.request is not None
@@ -2143,27 +2690,49 @@ class InferenceEngine:
                     keep.append(req)
             self.waiting = keep
 
-    def _admit(self) -> None:
+    def _admit(self, touched: Optional[List[Request]] = None) -> None:
         """Claim slots + KV pages for waiting requests (prefix-cache
         match decides where their prefill starts); the prefill itself
-        advances chunk-by-chunk in _advance_prefill."""
+        advances chunk-by-chunk in _advance_prefill. Parked sequences
+        (ISSUE 10) restore FIRST and block new admissions while any
+        remain — they already hold host memory and arrived earlier, so
+        a fresh request claiming the pages a parked one needs would
+        starve it (and thrash the spill path)."""
+        self._restore_parked(touched if touched is not None else [])
+        if self.host_tier is not None and len(self.host_tier):
+            return
         for slot in self.slots:
             if not self.waiting:
                 break
             if slot.request is not None:
                 continue
             req = self.waiting[0]
-            worst_case = len(req.prompt_tokens) + req.params.max_tokens
+            reserve = self._reserve_tokens(len(req.prompt_tokens),
+                                           req.params.max_tokens)
             shared, matched = self.allocator.match_prefix(
                 req.prompt_tokens)
-            need = self.allocator.pages_needed(worst_case) - len(shared)
+            need = self.allocator.pages_needed(reserve) - len(shared)
             if need > self.allocator.free_pages:
                 self.allocator.free(shared)   # undo the match refs
                 break            # head-of-line admission control
             self.waiting.pop(0)
-            self.allocator.record_match(matched, len(req.prompt_tokens))
+            if req.restarts == 0:
+                # a requeued preemption victim counts once: its first
+                # admission already recorded queue-wait/prefix stats
+                self.allocator.record_match(matched,
+                                            len(req.prompt_tokens))
+                self.telemetry.on_admitted(req, cached_tokens=matched)
+            else:
+                self.telemetry.recorder.record(
+                    "readmission", request_id=req.request_id,
+                    restarts=req.restarts, cached_tokens=matched)
             slot.request = req
-            slot.pages = shared + self.allocator.allocate_pages(need)
+            self._alloc_ctx = slot.index
+            try:
+                slot.pages = shared + self.allocator.allocate_pages(
+                    need)
+            finally:
+                self._alloc_ctx = None
             slot.prefill_pos = matched
             slot.ready = False
             slot.position = 0
@@ -2174,7 +2743,6 @@ class InferenceEngine:
             self._tables_version += 1
             self._mark_seen_dirty(slot.index)  # slot reuse: stale row
             self._samp_cache = None      # new request: stale params
-            self.telemetry.on_admitted(req, cached_tokens=matched)
 
     def _advance_prefill(self, touched: List[Request]) -> None:
         """Advance prefilling slots. While a decode batch is running,
@@ -2573,6 +3141,12 @@ class InferenceEngine:
         slot.request.finish_reason = reason
         self.telemetry.on_finished(slot.request, reason)
         self.allocator.free(slot.pages)
+        self._clear_slot(slot)
+
+    def _clear_slot(self, slot: _Slot) -> None:
+        """Return a slot to the empty state (pages already released by
+        the caller — _finish frees them, preemption spills then frees).
+        Invalidates every host/device mirror keyed on slot identity."""
         slot.request = None
         slot.pages = []
         slot.position = 0
@@ -2611,6 +3185,20 @@ class InferenceEngine:
                     self._finish(slot, "abort")
                     self._refresh_device_state()
                     return True
+            if self.host_tier is not None \
+                    and request_id in self.host_tier:
+                # parked mid-preemption and the client gave up: drop
+                # the host KV, never restore
+                parked = self.host_tier.drop(request_id)
+                if parked in self._pending_spills:
+                    self._pending_spills.remove(parked)
+                req = parked.request
+                req.finished = True
+                req.finish_reason = "abort"
+                self.telemetry.recorder.record(
+                    "abort", request_id=request_id, where="parked")
+                self.telemetry.on_finished(req, "abort")
+                return True
             return False
 
     # -- observability (ISSUE 5) -------------------------------------------
@@ -2758,6 +3346,13 @@ class InferenceEngine:
                     for s in self.slots
                     for req in (s.request,) if req is not None],
                 "allocator": self.allocator.stats(),
+                "parked_requests": [
+                    {"request_id": p.request.request_id,
+                     "position": p.position, "pages": p.n_pages,
+                     "reason": p.reason,
+                     "parked_s": round(p.idle_s(), 3)}
+                    for p in self.parked],
+                "preemptions": dict(self.preempt_counts),
                 "metrics_exposition": exposition,
                 **(extra or {}),
             }
@@ -2826,6 +3421,13 @@ class InferenceEngine:
             "dispatches": self.dispatches,
             "dispatches_per_step": round(
                 self.dispatches / max(self.ticks, 1), 3),
+            # KV memory hierarchy (ISSUE 10): parked sessions, demand
+            # over the device pool (>1 = oversubscribed), preemptions
+            # by reason; the host-tier block (spills/restores/host
+            # pages) rides allocator.stats() below when the tier is on
+            "parked_sessions": len(self.parked),
+            "page_pressure": round(self.page_pressure(), 4),
+            "preemptions": dict(self.preempt_counts),
             # tick-pipeline telemetry (ISSUE 4): wall vs host-fold vs
             # blocked-readback per tick + lag/drain counters
             "tick_times": self._tick_times_summary(),
@@ -2843,6 +3445,8 @@ class InferenceEngine:
                 "prefill_buckets": len(self._prefill_fns),
                 "chunk_buckets": len(self._chunk_fns),
                 "seen_row_buckets": len(self._seen_scatter_buckets),
+                "page_migration_fns": (len(self._page_gather_fns)
+                                       + len(self._page_scatter_fns)),
                 "pp_decode_fns": len(
                     getattr(self, "_pp_decode_cache", None) or {}),
                 "pp_prefill_buckets": len(

@@ -28,6 +28,10 @@ class PageAllocator:
         self.page_size = page_size
         self.num_usable = num_pages - 1
         self.enable_prefix_caching = enable_prefix_caching
+        # next tier down the memory hierarchy (ISSUE 10): the engine
+        # attaches its HostKVTier here so one stats() call reports the
+        # whole hierarchy — device pages AND parked host pages
+        self.host_tier = None
         self._free: List[int] = list(range(self.num_usable))
         self._rc: Dict[int, int] = {}
         # prefix cache: chain key -> page id, LRU-ordered (move_to_end on
@@ -178,7 +182,7 @@ class PageAllocator:
                 if self.cache_query_tokens else 0.0)
 
     def stats(self) -> Dict[str, float]:
-        return {
+        out = {
             "free_pages": self.free_pages,
             "used_pages": self.used_pages,
             "occupancy": (self.used_pages / self.num_usable
@@ -188,3 +192,6 @@ class PageAllocator:
             "cache_query_tokens": self.cache_query_tokens,
             "cache_hit_rate": self.cache_hit_rate,
         }
+        if self.host_tier is not None:
+            out.update(self.host_tier.stats())
+        return out

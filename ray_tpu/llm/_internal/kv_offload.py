@@ -1,0 +1,190 @@
+"""Host-RAM KV tier + preemption bookkeeping (ISSUE 10).
+
+The paged allocator stops at device HBM: pages are reserved at
+admission and `allocate_pages` raises MemoryError on exhaustion, so at
+production concurrency the binding constraint is pages, not FLOPs (the
+Ragged Paged Attention premise, PAPERS.md) — and before this module the
+only answer to "out of pages" was a hard reject. This module is the
+next tier down the memory hierarchy: a victim slot's KV pages migrate
+device→host (async d2h, overlapping decode like PR 4's lagged
+readback), the slot retires, and the request PARKS here until pages
+free up — at which point the engine restores the pages token-exact and
+the stream resumes as if never interrupted (same per-request sampling
+keys as PR 9's failover replay).
+
+Strictly host-side: no jax imports, no device arrays beyond opaque
+handles the engine passes through (the pending d2h copies it started).
+The engine owns every dispatch; this module owns accounting, storage,
+and the deterministic victim policy. Movable pages are also the
+prerequisite for disaggregated prefill/decode (ROADMAP item 4 — KV
+shipping between engines rides the same spill/restore format).
+
+Victim policy (`pick_victim`): lowest `Request.priority` first, then
+the youngest request (latest `submitted_at`, vLLM's LIFO-preemption
+discipline — the oldest request keeps its progress), tie-broken by
+request id so the order is total. A total order is what prevents
+preemption livelock: under sustained pressure the same victim keeps
+losing until the winner finishes and frees real pages. Requests past
+their deadline never reach this policy — the engine expires them at
+tick entry before considering preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(eq=False)          # identity compares: fields
+class ParkedSequence:                     # hold numpy arrays
+    """One preempted request living in the host tier.
+
+    `position` / `last_token` snapshot the slot's decode invariant at
+    the (drained) spill point: `position` tokens have KV in the spilled
+    pages, `last_token` is the newest sampled token whose KV is still
+    pending — exactly the state a restored slot resumes from. The KV
+    content arrives in two phases: `k_pending`/`v_pending` hold the
+    gathered device arrays while their copy_to_host_async streams
+    (spills overlap decode); `materialize()` converts to numpy and
+    drops the device handles (the host tier proper)."""
+    request: Any                        # engine Request (not finished)
+    seed: int                           # resolved per-request seed
+    position: int                       # tokens whose KV was spilled
+    last_token: int                     # pending token at restore
+    n_pages: int                        # meaningful pages in k/v
+    reason: str
+    parked_at: float = dataclasses.field(default_factory=time.monotonic)
+    k_host: Optional[Any] = None        # (L, n_pages, page, H, D) numpy
+    v_host: Optional[Any] = None
+    k_pending: Optional[Any] = None     # device arrays, d2h in flight
+    v_pending: Optional[Any] = None
+
+    @property
+    def materialized(self) -> bool:
+        return self.k_host is not None
+
+    def materialize(self, read_fn) -> None:
+        """Finish the d2h migration: block on the (long-since started)
+        async copies via the engine's sanctioned readback and drop the
+        device handles, leaving numpy as the canonical store. Padded
+        gather rows past n_pages are sliced off here."""
+        if self.k_host is not None:
+            return
+        self.k_host = read_fn(self.k_pending)[:, :self.n_pages]
+        self.v_host = read_fn(self.v_pending)[:, :self.n_pages]
+        self.k_pending = self.v_pending = None
+
+    def idle_s(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return max(now - self.parked_at, 0.0)
+
+
+class HostKVTier:
+    """Bounded host-RAM store of spilled KV page sets, keyed by
+    request id, FIFO-ordered (the engine restores the longest-parked
+    session first). Capacity is enforced at park time — a tier that
+    cannot hold the victim makes the preemption attempt fail, and the
+    engine falls back to the ISSUE-10 exhaustion path instead of
+    silently growing host RSS without bound."""
+
+    def __init__(self, capacity_pages: Optional[int] = None):
+        if capacity_pages is not None and capacity_pages < 1:
+            raise ValueError("capacity_pages must be >= 1 or None")
+        self.capacity_pages = capacity_pages
+        self._entries: "OrderedDict[str, ParkedSequence]" = OrderedDict()
+        self.used_pages = 0
+        # cumulative counters (GET /metrics: spills/restores_total)
+        self.spills_total = 0
+        self.restores_total = 0
+        self.spilled_pages_total = 0
+        self.restored_pages_total = 0
+        self.dropped_total = 0          # abort/deadline while parked
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, request_id: str) -> bool:
+        return request_id in self._entries
+
+    def entries(self) -> List[ParkedSequence]:
+        """FIFO view (restore order)."""
+        return list(self._entries.values())
+
+    def can_store(self, n_pages: int) -> bool:
+        return (self.capacity_pages is None
+                or self.used_pages + n_pages <= self.capacity_pages)
+
+    def park(self, parked: ParkedSequence) -> None:
+        rid = parked.request.request_id
+        if rid in self._entries:
+            raise ValueError(f"request {rid!r} already parked")
+        if not self.can_store(parked.n_pages):
+            raise MemoryError(
+                f"host KV tier full: need {parked.n_pages} pages, "
+                f"{self.capacity_pages - self.used_pages} of "
+                f"{self.capacity_pages} free")
+        self._entries[rid] = parked
+        self.used_pages += parked.n_pages
+        self.spills_total += 1
+        self.spilled_pages_total += parked.n_pages
+
+    def pop(self, request_id: str) -> ParkedSequence:
+        """Remove for RESTORE (counts into restores_total)."""
+        parked = self._entries.pop(request_id)
+        self.used_pages -= parked.n_pages
+        self.restores_total += 1
+        self.restored_pages_total += parked.n_pages
+        return parked
+
+    def drop(self, request_id: str) -> Optional[ParkedSequence]:
+        """Remove WITHOUT restoring (abort / deadline while parked)."""
+        parked = self._entries.pop(request_id, None)
+        if parked is not None:
+            self.used_pages -= parked.n_pages
+            self.dropped_total += 1
+        return parked
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "host_pages_used": self.used_pages,
+            "host_pages_capacity": self.capacity_pages,
+            "parked_sessions": len(self._entries),
+            "spills_total": self.spills_total,
+            "restores_total": self.restores_total,
+            "spilled_pages_total": self.spilled_pages_total,
+            "restored_pages_total": self.restored_pages_total,
+            "parked_dropped_total": self.dropped_total,
+        }
+
+
+def victim_order_key(slot) -> tuple:
+    """Total preemption order over candidate slots: lowest priority
+    loses first, then the YOUNGEST request (latest submitted_at —
+    preserving the oldest request's progress, vLLM's discipline), then
+    request id (determinism under equal stamps)."""
+    req = slot.request
+    return (int(getattr(req, "priority", 0)),
+            -float(getattr(req, "submitted_at", 0.0)),
+            str(req.request_id))
+
+
+def pick_victim(slots: Sequence[Any], protect: Sequence[int] = (),
+                spill_ok: bool = True) -> Optional[Any]:
+    """The next slot to preempt, or None. Candidates are occupied
+    slots outside `protect`; with spill_ok=False (no host tier) only
+    PREFILLING slots qualify — they requeue without needing host KV
+    storage (no tokens emitted yet, the prefix cache keeps their warm
+    pages), while a decoding slot can only be preempted by spilling."""
+    protect = set(protect)
+    cands = [s for s in slots
+             if s.request is not None and s.index not in protect
+             and (spill_ok or not s.ready)]
+    if not cands:
+        return None
+    return min(cands, key=victim_order_key)
+
+
+__all__ = ["HostKVTier", "ParkedSequence", "pick_victim",
+           "victim_order_key"]

@@ -166,12 +166,23 @@ class LLMServerImpl:
             toks = toks + [int(t) for t in cont]
         return toks
 
+    @staticmethod
+    def _priority_of(body: Dict[str, Any]) -> int:
+        """Preemption priority (ISSUE 10, API extension): under page
+        pressure the engine parks the LOWEST priority first. Clients
+        (or the fleet's tenant tiers) pass `priority`; absent = 0."""
+        try:
+            return int(body.get("priority") or 0)
+        except (TypeError, ValueError):
+            return 0
+
     async def _generate(self, prompt_tokens: List[int],
                         params: SamplingParams,
                         lora: "str | None" = None,
                         rid: "str | None" = None,
                         trace: "Dict[str, str] | None" = None,
-                        deadline: "float | None" = None
+                        deadline: "float | None" = None,
+                        priority: int = 0
                         ) -> Request:
         self._ensure_pump()
         # a rid already in flight (a client replaying another request's
@@ -181,7 +192,8 @@ class LLMServerImpl:
         if not rid or rid in self._queues:
             rid = uuid.uuid4().hex[:16]
         req = Request(rid, prompt_tokens, params, lora=lora,
-                      trace=trace, deadline=deadline)
+                      trace=trace, deadline=deadline,
+                      priority=priority)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -236,7 +248,8 @@ class LLMServerImpl:
         req = await self._generate(toks, self._sampling(body),
                                    lora=self._lora_for(body),
                                    rid=rid, trace=trace,
-                                   deadline=deadline)
+                                   deadline=deadline,
+                                   priority=self._priority_of(body))
         text = self.tokenizer.decode(req.output_tokens)
         return {
             "id": f"chatcmpl-{req.request_id}",
@@ -262,7 +275,8 @@ class LLMServerImpl:
         req = await self._generate(toks, self._sampling(body),
                                    lora=self._lora_for(body),
                                    rid=rid, trace=trace,
-                                   deadline=deadline)
+                                   deadline=deadline,
+                                   priority=self._priority_of(body))
         return {
             "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
@@ -286,7 +300,8 @@ class LLMServerImpl:
                                rid: "str | None" = None,
                                trace: "Dict[str, str] | None" = None,
                                deadline: "float | None" = None,
-                               decode_ctx: "List[int] | None" = None):
+                               decode_ctx: "List[int] | None" = None,
+                               priority: int = 0):
         """Yield (new_tokens, text_delta, finished, finish_reason) as
         tokens land — token ids AND text per event, so both the SSE
         wrappers (text) and the fleet's failover relay (token-exact
@@ -301,7 +316,8 @@ class LLMServerImpl:
         if not rid or rid in self._queues:   # see _generate: a replayed
             rid = uuid.uuid4().hex[:16]      # id must never collide
         req = Request(rid, prompt_tokens, params, lora=lora,
-                      trace=trace, deadline=deadline)
+                      trace=trace, deadline=deadline,
+                      priority=priority)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         ctx = list(decode_ctx or [])
@@ -342,7 +358,8 @@ class LLMServerImpl:
         cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         async for _, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
-                rid=rid, trace=trace, deadline=deadline):
+                rid=rid, trace=trace, deadline=deadline,
+                priority=self._priority_of(body)):
             if not delta and not finished:
                 continue                 # no text yet: hold the chunk
             chunk = {
@@ -365,7 +382,8 @@ class LLMServerImpl:
         cid = f"cmpl-{uuid.uuid4().hex[:16]}"
         async for _, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
-                rid=rid, trace=trace, deadline=deadline):
+                rid=rid, trace=trace, deadline=deadline,
+                priority=self._priority_of(body)):
             if not delta and not finished:
                 continue
             chunk = {
@@ -395,7 +413,7 @@ class LLMServerImpl:
         async for new, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
-                decode_ctx=cont):
+                decode_ctx=cont, priority=self._priority_of(body)):
             yield {"i": idx, "toks": list(new), "text": delta,
                    "finished": bool(finished),
                    "reason": reason if finished else None,
@@ -507,6 +525,18 @@ class LLMServerImpl:
             # (or freshly-ticked) replica to the router
             "last_tick_age_s": (None if last is None
                                 else max(time.monotonic() - last, 0.0)),
+            # KV memory hierarchy (ISSUE 10): the autoscaler/watchdog's
+            # page-pressure signal + host-tier occupancy for /fleet
+            "page_pressure": round(eng.page_pressure(), 4),
+            "parked_sessions": len(eng.parked),
+            "kv_offload": eng.host_tier is not None,
+            "kv_host_pages_used": (eng.host_tier.used_pages
+                                   if eng.host_tier else 0),
+            "spills_total": (eng.host_tier.spills_total
+                             if eng.host_tier else 0),
+            "restores_total": (eng.host_tier.restores_total
+                               if eng.host_tier else 0),
+            "preemptions_total": sum(eng.preempt_counts.values()),
             # cumulative SLO sums the fleet autoscaler deltas into
             # recent-window TTFT / queue-wait means
             "slo_totals": eng.telemetry.slo_totals(),

@@ -123,6 +123,26 @@ def _build_metrics() -> Dict[str, Any]:
         "budget_util": G("ray_tpu_llm_token_budget_utilization",
                          "packed tokens / token budget, recent "
                          "unified ticks", keys),
+        # KV memory hierarchy (ISSUE 10): host-offload tier +
+        # preemption spill/restore
+        "kv_host_used": G("ray_tpu_llm_kv_host_pages_used",
+                          "KV pages parked in the host-RAM tier",
+                          keys),
+        "parked": G("ray_tpu_llm_parked_sessions",
+                    "preempted sequences parked in the host tier",
+                    keys),
+        "page_pressure": G("ray_tpu_llm_kv_page_pressure",
+                           "(device pages used + parked host pages) "
+                           "/ usable pages; > 1 = oversubscribed",
+                           keys),
+        "spills": C("ray_tpu_llm_kv_spills_total",
+                    "victim sequences spilled device -> host", keys),
+        "restores": C("ray_tpu_llm_kv_restores_total",
+                      "parked sequences restored host -> device",
+                      keys),
+        "preemptions": C("ray_tpu_llm_preemptions_total",
+                         "slot preemptions by reason",
+                         ("model", "replica", "reason")),
     }
 
 
@@ -142,7 +162,11 @@ class FlightRecorder:
         self.enabled = enabled
         self.dropped = 0            # events displaced by the ring cap
         self.alert_hook = None      # callable(kind, event) | None
-        self.alert_kinds = frozenset({"guard_violation"})
+        # kinds that also fire the black-box hook: guard violations
+        # and true KV-page exhaustion (ISSUE 10 — the postmortem wants
+        # the allocator/parked state AT the exhaustion, not after)
+        self.alert_kinds = frozenset({"guard_violation",
+                                      "kv_exhausted"})
         self._ring: "collections.deque" = collections.deque(
             maxlen=capacity)
         self._seq = 0
@@ -392,6 +416,35 @@ class EngineTelemetry:
         self._m["drains"].inc(1, self._tags)
         self.recorder.record("drain", cause=cause)
 
+    def on_preempted(self, req, reason: str, mode: str = "spill",
+                     pages: int = 0, position: int = 0) -> None:
+        """One slot preemption (ISSUE 10): mode "spill" parked the
+        sequence's KV in the host tier, "requeue" sent a still-
+        prefilling victim back to the waiting queue. Host-side
+        bookkeeping only, at structural (drained) time."""
+        if not self.enabled:
+            return
+        self._m["preemptions"].inc(1, {**self._tags, "reason": reason})
+        if mode == "spill":
+            self._m["spills"].inc(1, self._tags)
+        self.recorder.record(
+            "preemption", request_id=req.request_id, reason=reason,
+            mode=mode, pages=pages, position=position,
+            generated=len(req.output_tokens))
+
+    def on_restored(self, req, pages: int = 0, parked_s: float = 0.0,
+                    shared_pages: int = 0) -> None:
+        """A parked sequence re-admitted with its KV pages restored
+        token-exact (shared_pages of them straight from the prefix
+        cache, the rest uploaded from the host tier)."""
+        if not self.enabled:
+            return
+        self._m["restores"].inc(1, self._tags)
+        self.recorder.record(
+            "restore", request_id=req.request_id, pages=pages,
+            shared_pages=shared_pages, parked_s=round(parked_s, 3),
+            generated=len(req.output_tokens))
+
     def on_tick_budget(self, used: int, budget: int) -> None:
         """Token-budget utilization of one unified ragged tick
         (plain-int accumulators; the gauge is set at scrape time)."""
@@ -419,6 +472,17 @@ class EngineTelemetry:
             self._tags)
         self._m["prefix_hit_rate"].set(alloc.cache_hit_rate,
                                        self._tags)
+        # KV memory hierarchy gauges (ISSUE 10) — scrape-time reads
+        # of plain host counters, like everything else here
+        tier = getattr(engine, "host_tier", None)
+        self._m["kv_host_used"].set(
+            tier.used_pages if tier is not None else 0, self._tags)
+        self._m["parked"].set(
+            len(tier) if tier is not None else 0, self._tags)
+        pressure = getattr(engine, "page_pressure", None)
+        if callable(pressure):
+            self._m["page_pressure"].set(round(pressure(), 4),
+                                         self._tags)
         with self._lock:
             util = (self._budget_used / self._budget_total
                     if self._budget_total else 0.0)

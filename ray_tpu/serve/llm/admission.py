@@ -97,6 +97,13 @@ class AdmissionController:
         self.shed_total = 0
         # watchdog-driven degraded mode (see set_brownout)
         self.brownout = False
+        # KV page pressure (ISSUE 10), stamped by the fleet's control
+        # loop: "pages short but SPILLABLE" keeps the full queue bound
+        # — requests wait behind the latency tier instead of being
+        # shed — while a pressured fleet that CANNOT spill sheds via
+        # brownout (FleetManager gates set_brownout on spillability)
+        self.page_pressure = 0.0
+        self.spillable = False
         self._recent_waits: collections.deque = collections.deque(
             maxlen=512)
 
@@ -110,6 +117,15 @@ class AdmissionController:
         if not self.brownout:
             return cfg.max_queue
         return max(0, int(cfg.max_queue * cfg.brownout_queue_factor))
+
+    def set_page_pressure(self, pressure: float,
+                          spillable: bool) -> None:
+        """Stamp the fleet's page-pressure observation (ISSUE 10) —
+        observability plus the documented queue-vs-shed contract: the
+        caller only engages brownout for pressure when `spillable` is
+        False (see FleetManager.watchdog_tick)."""
+        self.page_pressure = float(pressure)
+        self.spillable = bool(spillable)
 
     def set_brownout(self, on: bool) -> bool:
         """Engage/release brownout (the SLO watchdog's shed signal):
@@ -285,6 +301,8 @@ class AdmissionController:
             "queue_wait_slo_s": self.config.queue_wait_slo_s,
             "brownout": self.brownout,
             "effective_max_queue": self._effective_max_queue(),
+            "page_pressure": round(self.page_pressure, 4),
+            "spillable": self.spillable,
         }
 
 

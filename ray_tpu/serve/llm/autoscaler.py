@@ -43,6 +43,12 @@ class AutoscaleConfig:
     # hysteresis
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 30.0
+    # KV memory hierarchy (ISSUE 10): page pressure is (device pages
+    # used + parked host pages) / usable pages — sustained demand past
+    # this on the WORST replica means the fleet is oversubscribing its
+    # KV (spill/restore churn taxes every affected stream), which the
+    # TTFT mean can hide while streams still trickle; scale up
+    page_pressure_high: float = 1.25
 
 
 @dataclasses.dataclass
@@ -58,6 +64,9 @@ class FleetMetrics:
     # an instant breach so capacity is added BEFORE the SLO is blown
     slo_page: bool = False
     slo_burn: float = 0.0           # max confirmed burn across SLOs
+    # KV page pressure (ISSUE 10): max over active replicas of
+    # (device pages used + parked host pages) / usable pages
+    page_pressure: float = 0.0
 
 
 class FleetAutoscaler:
@@ -71,6 +80,7 @@ class FleetAutoscaler:
         c = self.config
         return (m.shed_delta > 0
                 or m.slo_page                   # watchdog: pre-emptive
+                or m.page_pressure > c.page_pressure_high   # ISSUE 10
                 or m.ttft_ms > c.ttft_high_ms
                 or m.queue_wait_ms > c.queue_wait_high_ms
                 or m.waiting > active)      # >1 queued per replica
@@ -79,6 +89,7 @@ class FleetAutoscaler:
         c = self.config
         return (m.shed_delta == 0 and not m.slo_page
                 and m.waiting == 0
+                and m.page_pressure <= 1.0       # not oversubscribed
                 and m.queue_wait_ms < c.queue_wait_low_ms
                 and m.occupancy < c.occupancy_low)
 
@@ -114,6 +125,7 @@ class FleetAutoscaler:
             "shed_delta": m.shed_delta,
             "slo_page": m.slo_page,
             "slo_burn": round(m.slo_burn, 3),
+            "page_pressure": round(m.page_pressure, 4),
         }
         return target
 

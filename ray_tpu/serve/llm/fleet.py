@@ -701,6 +701,7 @@ class FleetManager:
         d = {k: 0.0 for k in keys}
         waiting = 0
         occ: List[float] = []
+        pressure = 0.0
         for rid, st in self.replicas.items():
             if st.slo_totals:
                 prev = self._prev_slo.get(rid, {})
@@ -713,6 +714,10 @@ class FleetManager:
             if st.snapshot is not None and st.status == ACTIVE:
                 waiting += st.snapshot.waiting
                 occ.append(st.snapshot.kv_occupancy)
+                # max, not mean (ISSUE 10): one oversubscribed replica
+                # is already spill/restore-taxing its streams even
+                # when its siblings sit idle
+                pressure = max(pressure, st.snapshot.page_pressure)
         shed = (self.admission.shed_total
                 + self.admission.rejected["queue_full"]
                 + self.admission.rejected["brownout"])
@@ -727,7 +732,8 @@ class FleetManager:
             occupancy=(sum(occ) / len(occ) if occ else 0.0),
             shed_delta=shed_delta,
             slo_page=self.watchdog.paging,
-            slo_burn=self.watchdog.max_burn)
+            slo_burn=self.watchdog.max_burn,
+            page_pressure=pressure)
 
     # -- SLO burn-rate watchdog (ISSUE 7) -------------------------------
     def _watchdog_totals(self) -> Dict[str, float]:
@@ -758,10 +764,30 @@ class FleetManager:
         was_paging = self.watchdog.paging
         self.watchdog.observe(self._watchdog_totals(), now)
         paging = self.watchdog.paging
-        if self.admission.set_brownout(paging):
+        # KV page pressure (ISSUE 10): max over active replicas, with
+        # fleet spillability deciding the reaction — pressure on a
+        # fleet that can spill to its host tiers is a LATENCY tier
+        # (requests queue with backpressure and complete), so only a
+        # non-spillable pressured fleet sheds at the front door
+        pressure = 0.0
+        spillable = True
+        for st in self.replicas.values():
+            snap = st.snapshot
+            if snap is None or st.status != ACTIVE:
+                continue
+            if snap.page_pressure > pressure:
+                pressure = snap.page_pressure
+                spillable = snap.spillable
+        self.watchdog.observe_pressure(pressure)
+        pressure_shed = (self.watchdog.pressure_state == "high"
+                         and not spillable)
+        self.admission.set_page_pressure(pressure, spillable)
+        if self.admission.set_brownout(paging or pressure_shed):
             self.recorder.record(
-                "brownout_on" if paging else "brownout_off",
-                burn=round(self.watchdog.max_burn, 3))
+                "brownout_on" if (paging or pressure_shed)
+                else "brownout_off",
+                burn=round(self.watchdog.max_burn, 3),
+                page_pressure=round(pressure, 4))
         if paging and not was_paging:
             try:
                 self._page_dump_task = \
@@ -976,6 +1002,11 @@ class FleetManager:
                     "prefix_cache_hit_rate": round(
                         snap.cache_hit_rate, 4),
                     "last_tick_age_s": snap.last_tick_age_s,
+                    # KV memory hierarchy (ISSUE 10): host-tier
+                    # occupancy + oversubscription per replica
+                    "page_pressure": round(snap.page_pressure, 4),
+                    "parked_sessions": snap.parked,
+                    "kv_offload": snap.spillable,
                     # snapshot age (ISSUE 9): how old the routing
                     # inputs above are — stale = probes failing
                     "snapshot_age_s": round(snap.age_s(), 3),
@@ -992,6 +1023,9 @@ class FleetManager:
                 "burn": self.watchdog.last,
                 "alerts_total": self.watchdog.alerts_total,
                 "objective": self.watchdog.config.objective,
+                # fleet page-pressure monitor (ISSUE 10)
+                "page_pressure": round(self.watchdog.last_pressure, 4),
+                "pressure_state": self.watchdog.pressure_state,
             },
             "tracing": {
                 "enabled": self.enable_tracing,

@@ -135,6 +135,14 @@ class ReplicaSnapshot:
     free_pages: int = 0
     cache_hit_rate: float = 0.0      # cumulative prefix-cache hit rate
     last_tick_age_s: Optional[float] = None
+    # KV memory hierarchy (ISSUE 10): demand on the device pool
+    # ((used + parked host pages) / usable; > 1 = oversubscribed),
+    # parked session count, and whether the replica can ABSORB page
+    # pressure by spilling (host tier on) — pages short on a spillable
+    # replica is a latency tier, not saturation
+    page_pressure: float = 0.0
+    parked: int = 0
+    spillable: bool = False
     ts: float = dataclasses.field(default_factory=time.time)
     # MONOTONIC stamp of when this snapshot was taken (ISSUE 9): a
     # replica whose probes keep failing keeps its LAST snapshot, so
@@ -155,7 +163,10 @@ class ReplicaSnapshot:
             kv_occupancy=float(stats.get("kv_occupancy", 0.0)),
             free_pages=int(stats.get("free_pages", 0)),
             cache_hit_rate=float(stats.get("cache_hit_rate", 0.0)),
-            last_tick_age_s=stats.get("last_tick_age_s"))
+            last_tick_age_s=stats.get("last_tick_age_s"),
+            page_pressure=float(stats.get("page_pressure", 0.0)),
+            parked=int(stats.get("parked_sessions", 0)),
+            spillable=bool(stats.get("kv_offload", False)))
 
 
 @dataclasses.dataclass

@@ -65,6 +65,19 @@ class WatchdogConfig:
     # (burn 0) — two bad requests out of three must not page a fleet
     min_observations: int = 8
     slos: Tuple[str, ...] = ("ttft", "queue_wait", "e2e")
+    # KV page pressure (ISSUE 10): (device pages used + parked host
+    # pages) / usable, max over active replicas. Sustained demand
+    # past `high` flags pressure_state="high" (alert + gauge);
+    # recovery needs it back under `warn` (hysteresis). Whether high
+    # pressure also BROWNOUTS the front door depends on spillability:
+    # FleetManager sheds only when the pressured replicas cannot
+    # spill — pages short but spillable is a latency tier the
+    # admission queue absorbs, not overload (ISSUE 10 satellite).
+    page_pressure_high: float = 1.5
+    page_pressure_warn: float = 1.0
+    # consecutive observations over `high` before flagging (one
+    # bursty probe must not alert)
+    page_pressure_count: int = 2
 
 
 class SLOBurnWatchdog:
@@ -97,6 +110,15 @@ class SLOBurnWatchdog:
         self._alerts = metrics_api.Counter(
             "ray_tpu_llm_slo_alerts_total",
             "watchdog page transitions per SLO", ("slo",))
+        # KV page-pressure monitor (ISSUE 10)
+        self.pressure_state = "ok"
+        self.last_pressure = 0.0
+        self._pressure_over = 0
+        self._pressure_gauge = metrics_api.Gauge(
+            "ray_tpu_llm_fleet_page_pressure",
+            "max KV page pressure over active replicas "
+            "((used + parked host pages) / usable; > 1 = "
+            "oversubscribed)")
 
     # -- burn math -----------------------------------------------------
     def _window_delta(self, horizon: float, cur: Dict[str, float],
@@ -129,6 +151,35 @@ class SLOBurnWatchdog:
             return 0.0, n
         budget = max(1.0 - self.config.objective, 1e-6)
         return (bad / n) / budget, n
+
+    # -- page pressure (ISSUE 10) --------------------------------------
+    def observe_pressure(self, pressure: float) -> bool:
+        """One page-pressure observation (fleet max). Sets the gauge,
+        drives the hysteretic ok/high state, records alert/clear
+        flight-recorder events. Returns True when the state changed.
+        The caller (FleetManager) decides the brownout reaction using
+        fleet spillability — this monitor only watches."""
+        cfg = self.config
+        self.last_pressure = float(pressure)
+        self._pressure_gauge.set(round(self.last_pressure, 4))
+        prev = self.pressure_state
+        if self.last_pressure >= cfg.page_pressure_high:
+            self._pressure_over += 1
+            if self._pressure_over >= cfg.page_pressure_count:
+                self.pressure_state = "high"
+        elif self.last_pressure < cfg.page_pressure_warn:
+            self._pressure_over = 0
+            self.pressure_state = "ok"
+        else:
+            self._pressure_over = 0      # warn band: hold state
+        changed = self.pressure_state != prev
+        if changed and self.recorder is not None:
+            self.recorder.record(
+                "page_pressure_alert" if self.pressure_state == "high"
+                else "page_pressure_clear",
+                pressure=round(self.last_pressure, 4),
+                high=cfg.page_pressure_high)
+        return changed
 
     # -- the tick ------------------------------------------------------
     def observe(self, totals: Dict[str, float],

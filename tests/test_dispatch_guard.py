@@ -98,6 +98,52 @@ def test_steady_state_decode_zero_transfers_zero_compiles(
         assert eng.telemetry.summary()["generated_tokens"] >= 90
 
 
+@pytest.mark.parametrize("sp", [
+    {},                                                  # greedy
+    {"temperature": 0.8, "top_k": 20, "top_p": 0.9},     # sampled
+], ids=["greedy", "sampled"])
+def test_steady_state_decode_offload_engine_clean(sp):
+    """ISSUE 10: the KV memory hierarchy lives entirely on the
+    structural path. An offload-ENABLED engine whose host tier has
+    already been exercised — one victim spilled (async d2h page
+    gather) and restored (h2d page scatter) before the window — still
+    runs 32 steady-state decode ticks at 0 h2d transfers / 0 compiles
+    / 1 dispatch per tick: spill/restore ride drained structural
+    events exactly like admission uploads, never the decode loop."""
+    eng = _engine(enable_kv_offload=True, async_readback=True)
+    rng = np.random.default_rng(5)
+    for i in range(3):
+        eng.add_request(Request(
+            f"g{i}", rng.integers(2, 250, 12).tolist(),
+            SamplingParams(max_tokens=96, **sp)))
+    while eng.waiting or any(s.request is not None and not s.ready
+                             for s in eng.slots):
+        eng.step()
+    for _ in range(4):
+        eng.step()
+    # exercise the tier: spill one victim, let the engine restore it
+    assert eng.preempt("g1", reason="manual")
+    assert len(eng.parked) == 1
+    while eng.parked:
+        eng.step()
+    assert eng.host_tier.spills_total == 1
+    assert eng.host_tier.restores_total == 1
+    for _ in range(4):
+        eng.step()                       # settle the pipeline again
+    comp0 = eng.stats()["jit_cache"]["compiled_programs"]
+    disp0 = eng.dispatches
+    with dispatch_guard() as rep:
+        for _ in range(32):
+            eng.step()
+    assert rep.n_compiles == 0
+    assert eng.stats()["jit_cache"]["compiled_programs"] == comp0
+    assert eng.dispatches - disp0 == 32      # one dispatch per tick
+    assert all(s.request is not None and s.ready for s in eng.slots)
+    # the tier really was active across the window
+    assert eng.host_tier is not None
+    assert eng.stats()["spills_total"] == 1
+
+
 def test_guard_raises_on_seeded_h2d_transfer():
     with pytest.raises(Exception, match="host-to-device"):
         with dispatch_guard():
