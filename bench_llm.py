@@ -363,6 +363,156 @@ def bench_telemetry(on_tpu: bool, smoke: bool = False) -> dict:
     return res
 
 
+def bench_perf_accounting(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 11 gate, three parts.
+
+    Self-consistency: a single-request sync run's analytic totals must
+    equal the closed form replayed from the known composition (one
+    full-prompt prefill + G-1 decode ticks at growing context) — the
+    accounting can't drift from the costs it claims to sum. And the
+    rolling summary must be sane: flops > 0, 0 < MFU <= 1 against the
+    envelope, a roof named.
+
+    Overhead: the bursty mixed workload with
+    enable_perf_accounting=False as baseline — accounting is a handful
+    of host multiplies per tick, so the A/B must be ~1.0x (the
+    dispatch-guard suite separately proves zero transfers/compiles).
+
+    Regression gate: the canonical perfdiff workload's fingerprint
+    (exact closed-form costs + deterministic dispatch mix and token
+    totals) must match the committed PERF_BASELINE.json; noisy rates
+    are checked against their wide bands. In --smoke mode all three
+    assert."""
+    import uuid
+
+    from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
+                                              Request, SamplingParams)
+    from ray_tpu.llm._internal.perfmodel import CostModel
+    from ray_tpu.models import llama
+    from tools import perfdiff
+
+    if on_tpu and not smoke:
+        cfg = _tpu_bench_model()
+        batch, plen, n_req, chunk, budget = 8, 256, 24, 64, 512
+        burst, every, gen0 = 6, 10, 48
+    else:
+        cfg = llama.config("debug")
+        batch, plen, n_req, chunk, budget = 4, 48, 10, 16, 64
+        burst, every, gen0 = 3, 6, 8
+
+    # -- part 1: closed-form self-consistency (sync, one request) ------
+    P, G = 24, 12
+    eng1 = InferenceEngine(EngineConfig(
+        model=cfg, max_batch_size=2, page_size=16, num_pages=64,
+        max_prefill_tokens=max(P, chunk), seed=3,
+        enable_prefix_caching=False, async_readback=False,
+        metrics_model_id=f"perf{uuid.uuid4().hex[:8]}"))
+    rng = np.random.default_rng(17)
+    r1 = Request("pa0", rng.integers(1, cfg.vocab_size, P).tolist(),
+                 SamplingParams(max_tokens=G))
+    eng1.add_request(r1)
+    while eng1.has_work():
+        eng1.step()
+    tot = eng1.stats()["perf"]["totals"]
+    cm = CostModel(cfg, page_size=16)
+    expect = {"flops_gemm": 0.0, "flops_attn": 0.0,
+              "bytes_kv_read": 0.0, "bytes_kv_write": 0.0}
+    for k, v in cm.chunk_cost(0, P).items():
+        expect[k] += v
+    for i in range(G - 1):                 # decode at growing context
+        for k, v in cm.decode_cost(P + 1 + i).items():
+            expect[k] += v
+    closed_form_ok = (
+        abs(tot["flops_gemm"] - expect["flops_gemm"]) < 1e-3
+        and abs(tot["flops_attn"] - expect["flops_attn"]) < 1e-3
+        and abs(tot["bytes_kv_read"] - expect["bytes_kv_read"]) < 1e-3
+        and abs(tot["bytes_kv_write"] - expect["bytes_kv_write"]) < 1e-3)
+    perf1 = eng1.stats()["perf"]
+
+    # -- part 2: accounting-on vs -off overhead A/B --------------------
+    rng = np.random.default_rng(11)
+    lens = [plen + 16 * (i % 3) for i in range(n_req)]
+    gens = [gen0 + 8 * (i % 3) for i in range(n_req)]
+    prompts = [rng.integers(1, cfg.vocab_size, lens[i]).tolist()
+               for i in range(n_req)]
+
+    def run(enable_perf):
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=batch, page_size=16,
+            num_pages=max(512, batch * 32), seed=5,
+            max_prefill_tokens=chunk, enable_prefix_caching=False,
+            max_num_batched_tokens=budget,
+            enable_perf_accounting=enable_perf,
+            metrics_model_id=f"perf{uuid.uuid4().hex[:8]}"))
+
+        def drive():
+            eng._prefill_rr = 0
+            reqs = [Request(f"p{uuid.uuid4().hex[:6]}", list(p),
+                            SamplingParams(max_tokens=gens[i]))
+                    for i, p in enumerate(prompts)]
+            pending = list(reqs)
+            steps = 0
+            while eng.has_work() or pending:
+                if pending and steps % every == 0:
+                    for r in pending[:burst]:
+                        eng.add_request(r)
+                    pending = pending[burst:]
+                eng.step()
+                steps += 1
+            return reqs
+
+        drive()                          # warmup: compiles every bucket
+        import gc
+        gc.collect()                     # align GC (see bench_async_ab)
+        t0 = time.perf_counter()
+        reqs = drive()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {"tokens_per_sec": round(toks / dt, 1)}, eng
+
+    on_row, eng_on = run(True)
+    off_row, eng_off = run(False)
+    perf_on = eng_on.stats()["perf"]
+
+    # -- part 3: fingerprint vs the committed baseline -----------------
+    fingerprint = perfdiff.run_canonical_workload()
+    try:
+        baseline = perfdiff.load_baseline()
+        diff_failures = perfdiff.compare(baseline, fingerprint)
+    except FileNotFoundError:
+        baseline, diff_failures = None, ["baseline file missing"]
+
+    res = {
+        "accounting_on": on_row, "accounting_off": off_row,
+        "overhead_ratio": round(
+            on_row["tokens_per_sec"]
+            / max(off_row["tokens_per_sec"], 1e-9), 3),
+        "closed_form_ok": closed_form_ok,
+        "flops_total": tot["flops"],
+        "mfu": perf_on["mfu"], "mbu": perf_on["mbu"],
+        "roof": perf_on["roof"], "envelope": perf_on["envelope"],
+        "decode_tokens_per_s": perf_on["decode_tokens_per_s"],
+        "single_request_perf": {k: perf1[k] for k in
+                                ("mfu", "mbu", "roof")},
+        "accounting_off_disabled": (
+            eng_off.stats()["perf"].get("enabled") is False),
+        "fingerprint": fingerprint,
+        "perfdiff_failures": diff_failures,
+    }
+    if smoke:
+        assert res["closed_form_ok"], (tot, expect)
+        assert res["flops_total"] > 0, res
+        assert 0 < res["mfu"] <= 1.0, res
+        assert 0 < res["mbu"] <= 1.0, res
+        assert res["roof"] in ("compute", "memory"), res
+        assert res["accounting_off_disabled"], res
+        # tripwire with slack for CI timer noise: per-tick host
+        # arithmetic must never make decode materially slower
+        assert res["overhead_ratio"] >= 0.8, res
+        assert not diff_failures, diff_failures
+    return res
+
+
 def bench_kernel_tick(on_tpu: bool) -> dict:
     """ISSUE 2 smoke gate: drive a small mixed workload through the
     unified engine with decode_impl=pallas_interpret (the Pallas
@@ -1189,6 +1339,7 @@ def main() -> None:
         fleet_tracing = bench_fleet_tracing(on_tpu, smoke=True)
         chaos = bench_chaos(on_tpu, smoke=True)
         preemption = bench_preemption(on_tpu, smoke=True)
+        perf = bench_perf_accounting(on_tpu, smoke=True)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
@@ -1198,7 +1349,8 @@ def main() -> None:
                        "telemetry": telemetry,
                        "fleet_tracing": fleet_tracing,
                        "chaos": chaos,
-                       "preemption": preemption},
+                       "preemption": preemption,
+                       "perf": perf},
         }))
         return
     if "--fleet" in sys.argv:
@@ -1227,6 +1379,7 @@ def main() -> None:
     mixed = bench_mixed(on_tpu)
     async_ab = bench_async_ab(on_tpu)
     telemetry = bench_telemetry(on_tpu)
+    perf = bench_perf_accounting(on_tpu)
     scaling = bench_kernel_scaling(on_tpu)
     prefix = bench_prefix_cache(on_tpu)
     spec = bench_speculative(on_tpu)
@@ -1240,6 +1393,7 @@ def main() -> None:
                    **eng, "mixed_prefill_decode": mixed,
                    "async_readback_ab": async_ab,
                    "telemetry": telemetry,
+                   "perf": perf,
                    "paged_kernel_scaling": scaling,
                    "prefix_cache": prefix, "speculative": spec,
                    "multi_step_decode": multi},

@@ -106,10 +106,33 @@ spill/restore; details: BENCH_CORE.md "KV memory hierarchy anatomy";
                                                        registry; watchdog hysteresis +
                                                        spillability-gated brownout)
 
+ISSUE 11 per-dispatch perf accounting (analytic FLOP/byte cost model;
+details: BENCH_CORE.md "Perf accounting anatomy"; the same numbers
+ride `stats()["perf"]`, `/fleet` rows, and Perfetto counter tracks in
+`/debug/trace`; regression gate: `python -m tools.perfdiff` vs the
+committed PERF_BASELINE.json):
+
+    ray_tpu_llm_flops_total                 counter    analytic model FLOPs executed
+                                                       (GEMM + attention split)
+    ray_tpu_llm_hbm_bytes_total             counter    + `kind` tag: weights|kv_read|
+                                                       kv_write (device HBM) and
+                                                       d2h|h2d (KV spill/restore)
+    ray_tpu_llm_mfu                         gauge      model-FLOPs utilization vs the
+                                                       hardware envelope, recent window
+    ray_tpu_llm_mbu                         gauge      HBM-bandwidth utilization vs the
+                                                       envelope, recent window
+    ray_tpu_llm_tokens_per_s                gauge      + `phase` tag (decode|prefill):
+                                                       goodput over the window span
+    ray_tpu_llm_fleet_mfu                   gauge      goodput-weighted mean replica MFU
+                                                       (ingress registry)
+    ray_tpu_llm_fleet_mbu                   gauge      goodput-weighted mean replica MBU
+                                                       (ingress registry)
+
 Instrumentation is recorded purely from host-side engine events (zero
 device syncs, zero extra dispatches — the dispatch-guard suite runs
 with it enabled); disable per engine with
-`engine_kwargs={"enable_metrics": False}`.
+`engine_kwargs={"enable_metrics": False}` (and the perf accounting
+with `enable_perf_accounting=False`).
 """
 
 from __future__ import annotations

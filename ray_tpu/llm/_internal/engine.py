@@ -169,6 +169,21 @@ class EngineConfig:
     # watchdog (serve/llm/watchdog.py) windows into burn rates. None
     # keeps telemetry.DEFAULT_SLO_TARGETS.
     slo_targets: Optional[Dict[str, float]] = None
+    # Per-dispatch perf accounting (ISSUE 11): an analytic FLOP/byte
+    # cost model (perfmodel.py) over the model config + each tick's
+    # ragged batch composition records a PerfSample beside the tick
+    # times — GEMM/attention FLOPs, weight/KV-page HBM bytes,
+    # spill/restore d2h/h2d traffic — and stats()["perf"] reports
+    # rolling decode/prefill goodput, MFU/MBU against the hardware
+    # envelope, and which roof binds. Pure host arithmetic: zero
+    # device syncs, zero extra dispatches (the dispatch-guard suite
+    # runs with this ON). The off switch exists for the bench
+    # overhead A/B (bench_llm --smoke), like enable_metrics.
+    enable_perf_accounting: bool = True
+    # Hardware envelope override (a perfmodel.ENVELOPES key, e.g.
+    # "tpu-v5e" | "cpu"). None autodetects from the first jax device;
+    # unknown names raise so a typo can't report MFU vs the wrong peak.
+    perf_envelope: Optional[str] = None
     # Postmortem black-box bundles (ISSUE 7): on a guard violation or
     # mid-tick crash the engine snapshots its flight recorder, recent
     # tick times, metric exposition, config, and in-flight request
@@ -668,6 +683,30 @@ class InferenceEngine:
         # abort/register_loras) surface through the next step's
         # touched list so streaming consumers never lose them
         self._pending_touched: List[Request] = []
+        # per-dispatch perf accounting (ISSUE 11): analytic cost model
+        # + rolling MFU/MBU window (perfmodel.py). Host arithmetic
+        # only — each tick path folds its batch composition into a
+        # pending PerfSample and step() commits it with the tick wall.
+        from .perfmodel import (CostModel, PerfAccountant,
+                                detect_envelope)
+        self.perf: Optional[PerfAccountant] = None
+        if ec.enable_perf_accounting:
+            if self.pp > 1:
+                n_chips = sum(
+                    (int(st.mesh.devices.size) if st.mesh is not None
+                     else 1) for st in self.stages)
+            elif self.mesh is not None:
+                n_chips = int(self.mesh.devices.size)
+            else:
+                n_chips = 1
+            self.perf = PerfAccountant(
+                CostModel(cfg, ec.page_size),
+                detect_envelope(name=ec.perf_envelope),
+                n_chips=n_chips)
+            if self._spec is not None:
+                # draft-model costs accounted against their own config
+                self._spec["cost_model"] = CostModel(
+                    self._spec["cfg"], ec.page_size)
         # tick-pipeline telemetry: per-tick (wall, host-fold, blocked-
         # readback) ms over a sliding window + cumulative counters
         # (stats()["tick_times"]; BENCH_CORE.md "Tick pipelining
@@ -1194,6 +1233,34 @@ class InferenceEngine:
                                 all_greedy)
         return self._samp_cache
 
+    # -- per-dispatch perf accounting (ISSUE 11) ---------------------------
+    # Each hook below runs on the host next to the dispatch it
+    # describes, folding that dispatch's analytic cost (perfmodel
+    # closed forms over the batch composition the engine just packed)
+    # into the tick's pending PerfSample. Plain int/float arithmetic:
+    # nothing here can add an upload, a sync, or a compile.
+    @staticmethod
+    def _merge_cost(tot: Dict[str, float], c: Dict[str, float]) -> None:
+        for k, v in c.items():
+            tot[k] = tot.get(k, 0.0) + v
+
+    def _account_decode_batch(self, kind: str = "decode") -> None:
+        """One whole-batch decode dispatch: every active slot advances
+        one token at its current context."""
+        if self.perf is None:
+            return
+        cm = self.perf.model
+        tot: Dict[str, float] = {}
+        ndec = 0
+        for s in self.slots:
+            if s.request is None or not s.ready \
+                    or not self._host_active[s.index]:
+                continue
+            self._merge_cost(tot, cm.decode_cost(s.position + 1))
+            ndec += 1
+        if ndec:
+            self.perf.add(kind, tot, decode_tokens=ndec)
+
     def _ragged_step(self, touched: List[Request]) -> None:
         """One unified tick: pack, dispatch the single ragged program,
         fold the one readback into slot state. Host->device traffic
@@ -1204,6 +1271,20 @@ class InferenceEngine:
         B = self.config.max_batch_size
         total = sum(n for _, n, _ in plan)
         self.telemetry.on_tick_budget(total, self._tick_token_budget())
+        if self.perf is not None:
+            cm = self.perf.model
+            tot: Dict[str, float] = {}
+            ndec = npre = 0
+            for ps, pn, is_pref in plan:
+                if is_pref:
+                    self._merge_cost(tot,
+                                     cm.chunk_cost(ps.prefill_pos, pn))
+                    npre += pn
+                else:
+                    self._merge_cost(tot, cm.decode_cost(ps.position + 1))
+                    ndec += 1
+            self.perf.add("ragged", tot, decode_tokens=ndec,
+                          prefill_tokens=npre)
         T = self._token_bucket(total)
         # rows: tokens / slot_ids / positions / valid / lora_idx
         tok_meta = np.zeros((5, T), np.int32)
@@ -1479,6 +1560,10 @@ class InferenceEngine:
 
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
             self.telemetry.on_prefill_chunk(req, n, 0)
+            if self.perf is not None:
+                self.perf.add("prefill",
+                              self.perf.model.chunk_cost(0, n),
+                              prefill_tokens=n)
             tokens, bucket = self._prep_full_prompt(req)
             fns = self._pp_prefill_fns(bucket)
             x = self.stages[0].put(jnp.asarray(tokens))
@@ -1501,6 +1586,11 @@ class InferenceEngine:
 
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
         self.telemetry.on_prefill_chunk(req, chunk, slot.prefill_pos)
+        if self.perf is not None:
+            self.perf.add("prefill",
+                          self.perf.model.chunk_cost(slot.prefill_pos,
+                                                     chunk),
+                          prefill_tokens=chunk)
         fns = self._pp_chunk_fns(bucket,
                                  self._ctx_bucket(slot.prefill_pos))
         start = [st.put(jnp.asarray([slot.prefill_pos], jnp.int32))
@@ -1527,6 +1617,9 @@ class InferenceEngine:
     def _pp_decode(self, touched: List[Request]) -> None:
         if self._d_tokens is None:
             self._refresh_device_state()
+        # one whole-batch decode advance regardless of stage split /
+        # microbatching: the analytic cost is the same model forward
+        self._account_decode_batch("decode")
         if self.pp_mb > 1:
             return self._pp_decode_overlapped(touched)
         self._key, sub = jax.random.split(self._key)
@@ -1717,6 +1810,10 @@ class InferenceEngine:
         tokens[0, :n] = req.prompt_tokens
         table = self._dev(jnp.asarray(
             self._page_tables[slot.index:slot.index + 1]))
+        if self.perf is not None:
+            cm_d = s["cost_model"]
+            self.perf.add("spec", cm_d.chunk_cost(0, n),
+                          weight_bytes=cm_d.weight_bytes)
         self.dispatches += 1
         s["dk"], s["dv"] = fn(
             s["params"], s["dk"], s["dv"],
@@ -1774,6 +1871,14 @@ class InferenceEngine:
                 cstart[sl.index] = dp
                 clens[sl.index] = take
                 s["draft_pos"][sl.index] = dp + take
+            if self.perf is not None:
+                cm_d = s["cost_model"]
+                tot: Dict[str, float] = {}
+                for sl in over:
+                    self._merge_cost(tot, cm_d.chunk_cost(
+                        int(cstart[sl.index]), int(clens[sl.index])))
+                self.perf.add("spec", tot,
+                              weight_bytes=cm_d.weight_bytes)
             self.dispatches += 1
             s["dk"], s["dv"] = self._spec_sync_fn(delta_bucket)(
                 s["params"], s["dk"], s["dv"],
@@ -1799,6 +1904,22 @@ class InferenceEngine:
             act[sl.index] = True
             limit[sl.index] = len(sl.pages) * page
         ctx = self._ctx_bucket(max(len(canon(sl)) for sl in active) + k)
+        if self.perf is not None:
+            # draft round: delta chunk-prefill + k-2 scanned decode
+            # steps per slot, charged against the DRAFT model
+            cm_d = s["cost_model"]
+            tot = {}
+            for sl in active:
+                dp = int(dstart[sl.index])
+                dn = int(dlens[sl.index])
+                self._merge_cost(tot, cm_d.chunk_cost(dp, dn))
+                for j in range(max(k - 2, 0)):
+                    self._merge_cost(tot,
+                                     cm_d.decode_cost(dp + dn + j + 1))
+            # delta chunk-prefill + k-2 scanned decode steps = k-1
+            # draft forwards, each re-streaming the draft weights
+            self.perf.add("spec", tot, weight_bytes=cm_d.weight_bytes,
+                          weight_reads=max(k - 1, 1))
         self.dispatches += 1
         cands, s["dk"], s["dv"] = self._spec_draft_fn(
             delta_bucket, ctx)(
@@ -1833,6 +1954,19 @@ class InferenceEngine:
             assert P - 1 + use <= len(sl.pages) * page, (
                 "verify write past allocated pages", sl.index, P, use,
                 len(sl.pages), page)
+        if self.perf is not None:
+            # target verify: one chunk per slot with PER-POSITION
+            # logits (emit="logits_all"), so the head runs for every
+            # verified row, not just the last
+            cm = self.perf.model
+            tot = {}
+            for sl in active:
+                use = int(vlens[sl.index])
+                self._merge_cost(
+                    tot, cm.chunk_cost(int(vstart[sl.index]), use))
+                tot["flops_gemm"] = (tot.get("flops_gemm", 0.0)
+                                     + (use - 1) * cm.head_flops)
+            self.perf.add("spec", tot)
         self.dispatches += 1
         preds, self.k_pages, self.v_pages = self._spec_verify_fn(ctx)(
             self.params, self.k_pages, self.v_pages,
@@ -1842,6 +1976,7 @@ class InferenceEngine:
         preds = self._read_tokens(preds)     # (B, k) greedy per position
 
         # 3. host acceptance + bookkeeping
+        n_emit = 0
         for sl in active:
             i = sl.index
             use = int(vlens[i])
@@ -1862,11 +1997,14 @@ class InferenceEngine:
             sl.position = P - 1
             for tok in new_tokens:
                 s["emitted"] += 1
+                n_emit += 1
                 sl.position += 1
                 sl.last_token = int(tok)
                 self._append_token(sl, int(tok), touched)
                 if sl.request is None:       # finished mid-round
                     break
+        if self.perf is not None and n_emit:
+            self.perf.note_tokens(decode_tokens=n_emit)
         # positions/actives changed: lazily invalidate so a fallback
         # to the regular decode path refreshes, while back-to-back
         # speculative rounds (which read host state only) skip the
@@ -2008,6 +2146,10 @@ class InferenceEngine:
         kh, vh = self._page_gather_fn(nb)(
             self.k_pages, self.v_pages,
             self._dev(jnp.asarray(np.asarray(ids, np.int32))))
+        if self.perf is not None:
+            # actual transfer is the BUCKETED page count (padding
+            # duplicates move too) — real d2h traffic, not the ideal
+            self.perf.note_offload(d2h=nb * self.perf.model.page_bytes)
         # overlap: the d2h copies stream while decode continues; the
         # gather output is its own buffer, so the pool pages freed
         # below can be rewritten without corrupting the spill
@@ -2184,6 +2326,9 @@ class InferenceEngine:
                         [kh, np.repeat(kh[:, -1:], pad, axis=1)], 1)
                     vh = np.concatenate(
                         [vh, np.repeat(vh[:, -1:], pad, axis=1)], 1)
+                if self.perf is not None:
+                    self.perf.note_offload(
+                        h2d=nb * self.perf.model.page_bytes)
                 # the sanctioned restore upload: a structural-event
                 # h2d (like admission prefill uploads), never on the
                 # steady decode path
@@ -2488,6 +2633,11 @@ class InferenceEngine:
                 self._tick_times.append(
                     (wall * 1e3, self._tick_host_s * 1e3,
                      self._tick_dev_s * 1e3))
+                if self.perf is not None:
+                    # fold the tick's pending PerfSample (cost hooks
+                    # ran beside each dispatch above) into the rolling
+                    # MFU/MBU window, stamped with the tick wall
+                    self.perf.commit(wall * 1e3)
                 # reset AFTER the append (not at entry) so readback/
                 # fold cost from out-of-step drains lands in the next
                 # tick's record instead of vanishing from the telemetry
@@ -2502,6 +2652,8 @@ class InferenceEngine:
                 # kv_exhausted event (it black-boxes a bundle), retire
                 # a victim with finish_reason="error", keep pumping
                 self._profile_abort()
+                if self.perf is not None:
+                    self.perf.abort_tick()
                 self._handle_memory_error(exc, touched)
                 self.last_step_at = time.monotonic()
             except BaseException as exc:
@@ -2510,6 +2662,8 @@ class InferenceEngine:
                 # armed jax.profiler capture running forever — stop the
                 # trace and disarm so /debug/profile can be re-armed
                 self._profile_abort()
+                if self.perf is not None:
+                    self.perf.abort_tick()
                 # black-box the replica's last moments (ISSUE 7):
                 # best-effort, lock-free gather — the step lock is
                 # HELD here, so the bundle builder must not re-enter
@@ -2781,6 +2935,10 @@ class InferenceEngine:
             # whole prompt in one go: the dense full-causal program
             # (no pool gather — the common short-prompt fast path)
             self.telemetry.on_prefill_chunk(req, n, 0)
+            if self.perf is not None:
+                self.perf.add("prefill",
+                              self.perf.model.chunk_cost(0, n),
+                              prefill_tokens=n)
             tokens, bucket = self._prep_full_prompt(req)
             lidx = self._dev(jnp.asarray(
                 [self._lora_names.get(req.lora, 0)], jnp.int32))
@@ -2797,6 +2955,11 @@ class InferenceEngine:
 
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
         self.telemetry.on_prefill_chunk(req, chunk, slot.prefill_pos)
+        if self.perf is not None:
+            self.perf.add("prefill",
+                          self.perf.model.chunk_cost(slot.prefill_pos,
+                                                     chunk),
+                          prefill_tokens=chunk)
         lidx = self._dev(jnp.asarray(
             [self._lora_names.get(req.lora, 0)], jnp.int32))
         self.dispatches += 1
@@ -3018,6 +3181,7 @@ class InferenceEngine:
             # the lagged tick must land first
             self._drain(touched)
             return self._multi_decode(touched)
+        self._account_decode_batch("decode")
         self._key, sub = jax.random.split(self._key)
         self.dispatches += 1
         new_tokens, self.k_pages, self.v_pages, self._d_seen = \
@@ -3071,6 +3235,28 @@ class InferenceEngine:
             if s.request is not None and s.ready:
                 budget[s.index] = (s.request.params.max_tokens
                                    - len(s.request.output_tokens))
+        if self.perf is not None:
+            # K on-device rounds; rows past a slot's budget are masked
+            # (no KV write, token discarded) so only min(budget, K)
+            # tokens count as useful work per slot
+            cm = self.perf.model
+            K = int(self.config.decode_steps_per_call or 1)
+            tot: Dict[str, float] = {}
+            ndec = 0
+            for s in self.slots:
+                if s.request is None or not self._host_active[s.index]:
+                    continue
+                rows = min(int(budget[s.index]), K)
+                for j in range(rows):
+                    self._merge_cost(tot,
+                                     cm.decode_cost(s.position + 1 + j))
+                ndec += rows
+            if ndec:
+                # the scanned program runs K full forwards even for
+                # rows masked past their budget — the weights stream
+                # from HBM once per scan iteration, not per dispatch
+                self.perf.add("multi_decode", tot, decode_tokens=ndec,
+                              weight_reads=K)
         self._key, sub = jax.random.split(self._key)
         self.dispatches += 1
         (toks, last, positions, self.k_pages, self.v_pages,
@@ -3346,6 +3532,11 @@ class InferenceEngine:
                     for s in self.slots
                     for req in (s.request,) if req is not None],
                 "allocator": self.allocator.stats(),
+                # perf accounting at the moment of death (ISSUE 11):
+                # the accountant has its own lock (never held across a
+                # raise), so this read is safe from the crash path
+                "perf": (self.perf.summary()
+                         if self.perf is not None else None),
                 "parked_requests": [
                     {"request_id": p.request.request_id,
                      "position": p.position, "pages": p.n_pages,
@@ -3375,18 +3566,31 @@ class InferenceEngine:
     def chrome_trace(self) -> Dict[str, Any]:
         """Per-request lifecycle timelines (queued → admitted →
         prefill chunks → first token → decode → finished{reason}) as
-        Chrome-trace JSON, merged with the process tracing ring
-        (GET /debug/trace)."""
-        return self.telemetry.chrome_trace()
+        Chrome-trace JSON, merged with the process tracing ring and
+        the perf counter tracks (MFU / MBU / tokens-per-tick —
+        ISSUE 11) when accounting is on (GET /debug/trace)."""
+        return self.telemetry.chrome_trace(perf=self.perf)
 
     # -- introspection ------------------------------------------------------
+    @staticmethod
+    def _pctl(sorted_vals, q: float) -> float:
+        """Nearest-rank percentile over an already-sorted sequence."""
+        if not sorted_vals:
+            return 0.0
+        i = min(int(q * (len(sorted_vals) - 1) + 0.5),
+                len(sorted_vals) - 1)
+        return sorted_vals[i]
+
     def _tick_times_summary(self) -> Dict[str, Any]:
         """Tick-pipeline telemetry over the recent window (512 ticks).
         device_ms is time BLOCKED in the sanctioned readback — the
         un-hidden device share of a tick — so overlap_ratio
         (1 - device_ms/wall_ms) rises toward 1 as the async pipeline
         hides the wait behind host folds, and sits near the device
-        share itself when running synchronously."""
+        share itself when running synchronously. Besides the window
+        averages, p50/p95/p99 expose TAIL behavior (ISSUE 11): a
+        wedging tick or periodic stall moves the p99 long before it
+        moves the mean."""
         with self._step_lock:
             # snapshot under the step lock: the pump's executor
             # thread appends per tick, and iterating a deque being
@@ -3396,7 +3600,7 @@ class InferenceEngine:
         wall = sum(t[0] for t in ticks)
         host = sum(t[1] for t in ticks)
         dev = sum(t[2] for t in ticks)
-        return {
+        out = {
             "window": n,
             "wall_ms_avg": round(wall / n, 3) if n else 0.0,
             "host_ms_avg": round(host / n, 3) if n else 0.0,
@@ -3407,6 +3611,11 @@ class InferenceEngine:
             "drains": self._drains,
             "async_readback": self._async,
         }
+        for i, name in enumerate(("wall_ms", "host_ms", "device_ms")):
+            vals = sorted(t[i] for t in ticks)
+            for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                out[f"{name}_{tag}"] = round(self._pctl(vals, q), 3)
+        return out
 
     def stats(self) -> Dict[str, Any]:
         out = {
@@ -3431,6 +3640,11 @@ class InferenceEngine:
             # tick-pipeline telemetry (ISSUE 4): wall vs host-fold vs
             # blocked-readback per tick + lag/drain counters
             "tick_times": self._tick_times_summary(),
+            # per-dispatch perf accounting (ISSUE 11): rolling
+            # decode/prefill goodput, MFU/MBU vs the hardware
+            # envelope, and which roof binds (perfmodel.py)
+            "perf": (self.perf.summary() if self.perf is not None
+                     else {"enabled": False}),
             # request-lifecycle SLO telemetry (ISSUE 5): per-engine
             # TTFT/ITL/queue-wait/e2e aggregates, finish-reason
             # counts, token totals, budget utilization and the

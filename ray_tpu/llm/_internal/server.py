@@ -537,6 +537,11 @@ class LLMServerImpl:
             "restores_total": (eng.host_tier.restores_total
                                if eng.host_tier else 0),
             "preemptions_total": sum(eng.preempt_counts.values()),
+            # per-dispatch perf accounting (ISSUE 11): the fleet-plane
+            # brief — MFU/MBU/roofline + phase goodput — so /fleet
+            # rows and the fleet gauges see utilization per replica
+            "perf": (eng.perf.brief() if eng.perf is not None
+                     else None),
             # cumulative SLO sums the fleet autoscaler deltas into
             # recent-window TTFT / queue-wait means
             "slo_totals": eng.telemetry.slo_totals(),

@@ -143,6 +143,27 @@ def _build_metrics() -> Dict[str, Any]:
         "preemptions": C("ray_tpu_llm_preemptions_total",
                          "slot preemptions by reason",
                          ("model", "replica", "reason")),
+        # Per-dispatch perf accounting (ISSUE 11): analytic cost-model
+        # counters/gauges (perfmodel.py). Counters advance at SCRAPE
+        # time by the delta against the accountant's cumulative totals
+        # (update_gauges), so the tick path never touches a metric.
+        "flops": C("ray_tpu_llm_flops_total",
+                   "analytic model FLOPs executed (GEMM + attention)",
+                   keys),
+        "hbm_bytes": C("ray_tpu_llm_hbm_bytes_total",
+                       "analytic bytes moved, by kind (weights | "
+                       "kv_read | kv_write = device HBM; d2h | h2d = "
+                       "KV spill/restore host traffic)",
+                       ("model", "replica", "kind")),
+        "mfu": G("ray_tpu_llm_mfu",
+                 "model-FLOPs utilization vs the hardware envelope, "
+                 "recent window, engine-busy time", keys),
+        "mbu": G("ray_tpu_llm_mbu",
+                 "HBM-bandwidth utilization vs the hardware envelope, "
+                 "recent window, engine-busy time", keys),
+        "tokens_per_s": G("ray_tpu_llm_tokens_per_s",
+                          "token goodput over the recent window span, "
+                          "by phase", ("model", "replica", "phase")),
     }
 
 
@@ -303,6 +324,9 @@ class EngineTelemetry:
                       "e2e": 0.0}
         self._counts = {"ttft": 0, "itl": 0, "queue": 0, "e2e": 0}
         self._bad = {"ttft": 0, "queue": 0, "e2e": 0}
+        # perf-counter export watermarks (ISSUE 11): cumulative totals
+        # already inc'd into the Prometheus counters at a prior scrape
+        self._perf_exported: Dict[str, float] = {}
         if enabled:
             self._m = _build_metrics()
             self._tags = {"model": model, "replica": replica}
@@ -487,6 +511,41 @@ class EngineTelemetry:
             util = (self._budget_used / self._budget_total
                     if self._budget_total else 0.0)
         self._m["budget_util"].set(util, self._tags)
+        # perf accounting (ISSUE 11): gauges from the rolling summary;
+        # counters advance by the delta vs the last scrape so the
+        # monotone Prometheus totals track the accountant's cumulative
+        # host counters without any tick-path metric call
+        perf = getattr(engine, "perf", None)
+        if perf is not None:
+            s = perf.summary()
+            self._m["mfu"].set(s["mfu"], self._tags)
+            self._m["mbu"].set(s["mbu"], self._tags)
+            self._m["tokens_per_s"].set(
+                s["decode_tokens_per_s"],
+                {**self._tags, "phase": "decode"})
+            self._m["tokens_per_s"].set(
+                s["prefill_tokens_per_s"],
+                {**self._tags, "phase": "prefill"})
+            tot = s["totals"]
+            # watermark read-inc-update under the telemetry lock: two
+            # concurrent scrapes (fleet probe + operator Prometheus,
+            # or a crash dump mid-scrape) must not both export the
+            # same delta into the monotone counters. Metric.inc takes
+            # its own (leaf) lock — no ordering hazard.
+            with self._lock:
+                d = (tot["flops"]
+                     - self._perf_exported.get("flops", 0.0))
+                if d > 0:
+                    self._m["flops"].inc(d, self._tags)
+                    self._perf_exported["flops"] = tot["flops"]
+                for kind in ("weights", "kv_read", "kv_write",
+                             "d2h", "h2d"):
+                    cur = tot[f"bytes_{kind}"]
+                    d = cur - self._perf_exported.get(kind, 0.0)
+                    if d > 0:
+                        self._m["hbm_bytes"].inc(
+                            d, {**self._tags, "kind": kind})
+                        self._perf_exported[kind] = cur
 
     def slo_totals(self) -> Dict[str, float]:
         """Cumulative SLO sums/counts (seconds / observations).
@@ -549,11 +608,45 @@ class EngineTelemetry:
                 "flight_recorder": self.recorder.stats(),
             }
 
-    def chrome_trace(self) -> Dict[str, Any]:
+    def _perf_counter_events(self, perf,
+                             pid: int) -> List[Dict[str, Any]]:
+        """Perfetto counter tracks (ph "C") from the perf accountant's
+        rolling window (ISSUE 11): per-tick instantaneous MFU / MBU
+        and the tick's token mix, timestamped at each tick's end.
+        Bounded by the accountant's window (512 samples)."""
+        events: List[Dict[str, Any]] = []
+        peak_f = perf.envelope.peak_flops * perf.n_chips
+        peak_b = perf.envelope.peak_bytes_per_s * perf.n_chips
+        # Perfetto keys a counter track by (pid, name): in-process
+        # fleet replicas share the pid, so the replica id rides the
+        # NAME (the per-telemetry tid namespacing that separates
+        # request rows cannot disambiguate counters). Single-replica
+        # engines keep the bare names.
+        sfx = f" {self.replica}" if self.replica else ""
+        for t in perf.window():
+            if t.mono_ts <= 0.0:
+                continue
+            ts = _wall(t.mono_ts) * 1e6
+            busy = t.wall_ms * 1e-3
+            mfu = t.flops / (busy * peak_f) if busy > 0 else 0.0
+            mbu = t.hbm_bytes / (busy * peak_b) if busy > 0 else 0.0
+            events.append({"name": "perf:utilization" + sfx,
+                           "ph": "C", "pid": pid, "tid": 0, "ts": ts,
+                           "args": {"mfu": round(mfu, 6),
+                                    "mbu": round(mbu, 6)}})
+            events.append({"name": "perf:tokens_per_tick" + sfx,
+                           "ph": "C", "pid": pid, "tid": 0, "ts": ts,
+                           "args": {"decode": t.decode_tokens,
+                                    "prefill": t.prefill_tokens}})
+        return events
+
+    def chrome_trace(self, perf=None) -> Dict[str, Any]:
         """Request timelines as Chrome-trace JSON (one tid per
         request, spans via tracing.complete_event so the fields match
         live tracing spans), merged with this process's tracing ring
         (populated when RAY_TPU_TRACE / tracing.enable() is on).
+        `perf` (a perfmodel.PerfAccountant) additionally renders the
+        MFU/MBU/token counter tracks beside the request rows.
 
         Requests carrying a fleet trace context (ISSUE 7) tag every
         lifecycle event with the trace id and emit the Perfetto
@@ -621,6 +714,8 @@ class EngineTelemetry:
                     f"finished:{t.reason}", "request",
                     _wall(t.finished), pid=pid, tid=t.tid,
                     args={"request_id": rid, **trace_args}))
+        if perf is not None:
+            events.extend(self._perf_counter_events(perf, pid))
         events.extend(tracing.get_events())
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "metadata": {

@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from . import llama
+from ..ops.jax_compat import set_mesh_compat
 from ..parallel.mesh import BATCH_AXES, AXIS_SP, AXIS_PP, mesh_shape
 from ..parallel.sharding import spec_for, tree_shardings
 
@@ -124,7 +125,7 @@ class TrainStepBundle:
 
     # public API -----------------------------------------------------------
     #
-    # Each call runs under `jax.set_mesh` so the model's logical-axis
+    # Each call runs under `jax.set_mesh` (via the version shim) so the model's logical-axis
     # sharding constraints (with_logical_constraint) resolve against this
     # bundle's mesh at trace time — without the context they silently
     # no-op, which both loses the intended activation shardings and (for
@@ -132,7 +133,7 @@ class TrainStepBundle:
     # check-fail ("Invalid binary instruction opcode copy").
 
     def init_state(self, seed: int = 0):
-        with jax.set_mesh(self.mesh):
+        with set_mesh_compat(self.mesh):
             return self._init(jax.random.PRNGKey(seed))
 
     def init_state_from_checkpoint(self, ckpt_dir: str):
@@ -143,18 +144,18 @@ class TrainStepBundle:
         from . import checkpoint_io
         params = checkpoint_io.load_llama_params(
             self.cfg, ckpt_dir, mesh=self.mesh)
-        with jax.set_mesh(self.mesh):
+        with set_mesh_compat(self.mesh):
             opt_state = jax.jit(
                 self.optimizer.init,
                 out_shardings=self.opt_shardings)(params)
         return params, opt_state
 
     def step(self, state, tokens):
-        with jax.set_mesh(self.mesh):
+        with set_mesh_compat(self.mesh):
             return self._step(state, tokens)
 
     def eval_loss(self, state, tokens):
-        with jax.set_mesh(self.mesh):
+        with set_mesh_compat(self.mesh):
             return self._eval(state[0], tokens)
 
     def shard_batch(self, tokens):

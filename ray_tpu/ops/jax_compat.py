@@ -12,6 +12,17 @@ from __future__ import annotations
 import jax
 
 
+def set_mesh_compat(mesh):
+    """Context manager installing `mesh` as the ambient mesh across
+    jax versions: `jax.set_mesh` where it exists; on the 0.4.x line a
+    Mesh is ITSELF the context manager that sets the thread-local
+    physical mesh (resource env), which is what the logical-axis
+    sharding fallback (parallel/sharding.get_abstract_mesh_or_none)
+    reads there."""
+    sm = getattr(jax, "set_mesh", None)
+    return sm(mesh) if sm is not None else mesh
+
+
 def shard_map_compat(fn, mesh, in_specs, out_specs):
     """shard_map across jax versions: the stable `jax.shard_map`
     (check_vma) when present, else the experimental one (check_rep) —

@@ -76,9 +76,22 @@ def with_logical_constraint(x, *logical_axes: Optional[str],
 
 
 def get_abstract_mesh_or_none():
-    """The mesh from the enclosing `jax.set_mesh` context, if any."""
+    """The mesh from the enclosing `jax.set_mesh` /
+    `ops.jax_compat.set_mesh_compat` context, if any. On the 0.4.x
+    line there is no abstract-mesh API; the ambient mesh lives in the
+    thread-local resource env a `with mesh:` context installs, so the
+    fallback reads it from there — without it every logical-axis
+    constraint silently no-ops on 0.4.x (which is exactly how the
+    training-path shardings regressed unnoticed)."""
     try:
         m = jax.sharding.get_abstract_mesh()
+        if m is not None and not m.empty:
+            return m
+    except Exception:
+        pass
+    try:
+        from jax._src import mesh as _mesh_lib
+        m = _mesh_lib.thread_resources.env.physical_mesh
         if m is not None and not m.empty:
             return m
     except Exception:

@@ -191,6 +191,20 @@ class FleetManager:
             {k: 0.0 for k in _WATCH_KEYS}
         self._page_dump_task: Optional[asyncio.Task] = None
         self._dump_tasks: set = set()   # keep eviction dumps alive
+        # per-dispatch perf accounting (ISSUE 11): fleet-level
+        # utilization gauges — the decode-goodput-weighted mean of the
+        # ACTIVE replicas' recent MFU/MBU (idle replicas with no
+        # traffic don't drag the fleet number to zero), refreshed by
+        # the same probe loop that stamps the snapshots
+        from ...util import metrics as metrics_api
+        self._fleet_mfu_gauge = metrics_api.Gauge(
+            "ray_tpu_llm_fleet_mfu",
+            "goodput-weighted mean replica MFU over active replicas",
+            ("model",))
+        self._fleet_mbu_gauge = metrics_api.Gauge(
+            "ray_tpu_llm_fleet_mbu",
+            "goodput-weighted mean replica MBU over active replicas",
+            ("model",))
 
     # -- membership helpers --------------------------------------------
     def _ids(self, *statuses: str) -> List[str]:
@@ -686,6 +700,28 @@ class FleetManager:
                 self._readmit(rid)
 
         await asyncio.gather(*(one(rid) for rid in ids))
+        self._update_perf_gauges()
+
+    def _update_perf_gauges(self) -> None:
+        """Aggregate per-replica MFU/MBU into the fleet gauges
+        (ISSUE 11): goodput-weighted over ACTIVE replicas, falling
+        back to a plain mean when no tokens flowed in the window."""
+        snaps = [st.snapshot for rid, st in self.replicas.items()
+                 if st.status == ACTIVE and st.snapshot is not None]
+        if not snaps:
+            return
+        w = sum(s.decode_tps + s.prefill_tps for s in snaps)
+        if w > 0:
+            mfu = sum(s.mfu * (s.decode_tps + s.prefill_tps)
+                      for s in snaps) / w
+            mbu = sum(s.mbu * (s.decode_tps + s.prefill_tps)
+                      for s in snaps) / w
+        else:
+            mfu = sum(s.mfu for s in snaps) / len(snaps)
+            mbu = sum(s.mbu for s in snaps) / len(snaps)
+        tags = {"model": self.model_id}
+        self._fleet_mfu_gauge.set(round(mfu, 6), tags)
+        self._fleet_mbu_gauge.set(round(mbu, 6), tags)
 
     # -- autoscaling ----------------------------------------------------
     def _window_metrics(self) -> FleetMetrics:
@@ -1007,6 +1043,14 @@ class FleetManager:
                     "page_pressure": round(snap.page_pressure, 4),
                     "parked_sessions": snap.parked,
                     "kv_offload": snap.spillable,
+                    # perf accounting (ISSUE 11): recent utilization
+                    # against the replica's hardware envelope
+                    "mfu": round(snap.mfu, 6),
+                    "mbu": round(snap.mbu, 6),
+                    "roof": snap.roof,
+                    "decode_tokens_per_s": round(snap.decode_tps, 3),
+                    "prefill_tokens_per_s": round(
+                        snap.prefill_tps, 3),
                     # snapshot age (ISSUE 9): how old the routing
                     # inputs above are — stale = probes failing
                     "snapshot_age_s": round(snap.age_s(), 3),

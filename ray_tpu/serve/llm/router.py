@@ -143,6 +143,14 @@ class ReplicaSnapshot:
     page_pressure: float = 0.0
     parked: int = 0
     spillable: bool = False
+    # per-dispatch perf accounting (ISSUE 11): the replica's recent
+    # MFU/MBU against its hardware envelope, phase goodput, and which
+    # roof binds — surfaced in /fleet rows and the fleet gauges
+    mfu: float = 0.0
+    mbu: float = 0.0
+    decode_tps: float = 0.0
+    prefill_tps: float = 0.0
+    roof: str = ""
     ts: float = dataclasses.field(default_factory=time.time)
     # MONOTONIC stamp of when this snapshot was taken (ISSUE 9): a
     # replica whose probes keep failing keeps its LAST snapshot, so
@@ -156,6 +164,7 @@ class ReplicaSnapshot:
 
     @classmethod
     def from_stats(cls, stats: Dict[str, Any]) -> "ReplicaSnapshot":
+        perf = stats.get("perf") or {}
         return cls(
             replica=stats.get("replica", ""),
             active=int(stats.get("active", 0)),
@@ -166,7 +175,12 @@ class ReplicaSnapshot:
             last_tick_age_s=stats.get("last_tick_age_s"),
             page_pressure=float(stats.get("page_pressure", 0.0)),
             parked=int(stats.get("parked_sessions", 0)),
-            spillable=bool(stats.get("kv_offload", False)))
+            spillable=bool(stats.get("kv_offload", False)),
+            mfu=float(perf.get("mfu", 0.0)),
+            mbu=float(perf.get("mbu", 0.0)),
+            decode_tps=float(perf.get("decode_tokens_per_s", 0.0)),
+            prefill_tps=float(perf.get("prefill_tokens_per_s", 0.0)),
+            roof=str(perf.get("roof", "")))
 
 
 @dataclasses.dataclass

@@ -96,6 +96,13 @@ def test_steady_state_decode_zero_transfers_zero_compiles(
         # zero-transfer result is not vacuous): ~3 tokens/tick folded
         # through on_token (the async pipeline may hold one tick)
         assert eng.telemetry.summary()["generated_tokens"] >= 90
+    # ISSUE 11: perf accounting is ON by default and recorded a
+    # sample for every guarded tick — its host arithmetic added zero
+    # transfers and zero compiles to the window above
+    perf = eng.stats()["perf"]
+    assert perf["enabled"] and perf["window"] >= 32
+    assert perf["totals"]["flops"] > 0
+    assert 0 < perf["mfu"] <= 1.0
 
 
 @pytest.mark.parametrize("sp", [

@@ -819,10 +819,10 @@ def test_shipped_baseline_is_small_and_justified():
 def test_engine_hot_path_has_zero_baselined_findings():
     """The burndown contract: engine.py, llama_infer.py, ops/, and
     the observability modules riding the engine (telemetry.py,
-    blackbox.py — ISSUE 5/7), plus the ISSUE 10 KV memory hierarchy
-    (kv_offload.py host tier + kv_cache.py allocator), own no
-    baseline entries — their findings were fixed or carry inline
-    justified suppressions."""
+    blackbox.py — ISSUE 5/7; perfmodel.py — ISSUE 11), plus the
+    ISSUE 10 KV memory hierarchy (kv_offload.py host tier +
+    kv_cache.py allocator), own no baseline entries — their findings
+    were fixed or carry inline justified suppressions."""
     base = load_baseline(str(REPO / "tools/jaxlint/baseline.json"))
     for key in base.entries:
         path = key.split(":")[1]
@@ -831,6 +831,7 @@ def test_engine_hot_path_has_zero_baselined_findings():
         assert "llm/_internal/blackbox.py" not in path
         assert "llm/_internal/kv_offload.py" not in path
         assert "llm/_internal/kv_cache.py" not in path
+        assert "llm/_internal/perfmodel.py" not in path
         assert "models/llama_infer.py" not in path
         assert "/ops/" not in path
     # the ISSUE 10 offload/preemption module exists inside the
@@ -839,6 +840,13 @@ def test_engine_hot_path_has_zero_baselined_findings():
     proc = _cli("ray_tpu/llm/_internal/kv_offload.py")
     assert proc.returncode == 0, (
         "jaxlint findings in kv_offload.py (zero-entry module):\n"
+        + proc.stdout)
+    # ISSUE 11: the perf-accounting plane is host-only arithmetic
+    # riding the tick path — any jaxlint finding there is a real bug
+    assert (REPO / "ray_tpu/llm/_internal/perfmodel.py").exists()
+    proc = _cli("ray_tpu/llm/_internal/perfmodel.py")
+    assert proc.returncode == 0, (
+        "jaxlint findings in perfmodel.py (zero-entry module):\n"
         + proc.stdout)
 
 

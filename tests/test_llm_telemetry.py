@@ -453,3 +453,93 @@ def test_observability_http_endpoints(ray_start):
         assert reqs_summary["finished"].get("length", 0) >= 1
     finally:
         serve.shutdown()
+
+
+# ----------------------- perf families across fleet topologies (ISSUE 11)
+
+def _drive(eng, n_req=2, gen=8):
+    rng = np.random.default_rng(5)
+    for i in range(n_req):
+        eng.add_request(Request(
+            f"pf{uuid.uuid4().hex[:6]}",
+            rng.integers(2, 250, 12).tolist(),
+            SamplingParams(max_tokens=gen)))
+    while eng.has_work():
+        eng.step()
+
+
+def test_perf_families_shared_registry_topology():
+    """In-process fleet replicas share ONE registry: engines tagged
+    replica=r0/r1 drive work, a single render carries BOTH replicas'
+    perf series (mfu/mbu gauges, flops and per-kind hbm_bytes
+    counters, per-phase tokens_per_s), and merge_expositions over two
+    sequential renders of the same registry dedups to one series per
+    identity and one HELP/TYPE per family."""
+    from ray_tpu.util.metrics import merge_expositions
+
+    tag = f"pf{uuid.uuid4().hex[:10]}"
+    engines = [make_engine(metrics_model_id=tag,
+                           metrics_replica_id=f"r{i}")
+               for i in range(2)]
+    for eng in engines:
+        _drive(eng)
+    text = engines[0].prometheus_metrics()
+    text = engines[1].prometheus_metrics()   # refreshes r1's gauges too
+    for rid in ("r0", "r1"):
+        assert _sample(text, "ray_tpu_llm_mfu",
+                       model=tag, replica=rid) is not None
+        assert _sample(text, "ray_tpu_llm_mbu",
+                       model=tag, replica=rid) is not None
+        v = _sample(text, "ray_tpu_llm_flops_total",
+                    model=tag, replica=rid)
+        assert v is not None and v > 0
+        for kind in ("weights", "kv_read", "kv_write"):
+            assert _sample(text, "ray_tpu_llm_hbm_bytes_total",
+                           model=tag, replica=rid, kind=kind), kind
+        for phase in ("decode", "prefill"):
+            assert _sample(text, "ray_tpu_llm_tokens_per_s",
+                           model=tag, replica=rid,
+                           phase=phase) is not None
+    merged = merge_expositions([text,
+                                engines[0].prometheus_metrics()])
+    assert merged.count("# TYPE ray_tpu_llm_mfu gauge") == 1
+    assert merged.count("# TYPE ray_tpu_llm_hbm_bytes_total counter") \
+        == 1
+    series = [ln.rsplit(" ", 1)[0] for ln in merged.splitlines()
+              if ln.startswith("ray_tpu_llm_mfu{")
+              and f'model="{tag}"' in ln]
+    assert len(series) == len(set(series)) == 2
+
+
+def test_perf_families_cross_process_relabel_topology():
+    """Separate-registry replicas render IDENTICAL series (no replica
+    tag); the fleet scrape relabels each exposition with replica=<id>
+    before merging — afterwards the new families must carry distinct
+    per-replica series instead of colliding, with one header per
+    family (the ISSUE 6 relabel contract extended to ISSUE 11)."""
+    from ray_tpu.util.metrics import (merge_expositions,
+                                      relabel_exposition)
+
+    tag = f"px{uuid.uuid4().hex[:10]}"
+    eng = make_engine(metrics_model_id=tag)     # replica unset -> ""
+    _drive(eng)
+    text = eng.prometheus_metrics()
+    assert _sample(text, "ray_tpu_llm_mfu", model=tag) is not None
+    merged = merge_expositions([
+        relabel_exposition(text, {"replica": "rA"}),
+        relabel_exposition(text, {"replica": "rB"}),
+    ])
+    for rid in ("rA", "rB"):
+        assert _sample(merged, "ray_tpu_llm_mfu",
+                       model=tag, replica=rid) is not None
+        for kind in ("weights", "kv_read", "kv_write"):
+            assert _sample(merged, "ray_tpu_llm_hbm_bytes_total",
+                           model=tag, replica=rid, kind=kind), kind
+        for phase in ("decode", "prefill"):
+            assert _sample(merged, "ray_tpu_llm_tokens_per_s",
+                           model=tag, replica=rid,
+                           phase=phase) is not None
+    # the un-relabeled series collided into per-replica identities:
+    # nothing for this tag survives WITHOUT a replica label
+    assert _sample(merged, "ray_tpu_llm_mfu", model=tag) is None
+    assert merged.count("# TYPE ray_tpu_llm_tokens_per_s gauge") == 1

@@ -1,0 +1,119 @@
+"""Perf-regression gate (ISSUE 11, tools/perfdiff + PERF_BASELINE.json).
+
+The committed baseline is an ASSERTED artifact: the canonical workload
+re-runs here and its exact fields (closed-form model costs + the
+deterministic dispatch mix and token totals) must match the baseline
+to rounding — a drifted cost formula, an extra dispatch per tick, or a
+changed packing plan fails tier-1, not just a bench run someone has to
+read. compare() semantics are unit-tested on synthetic fingerprints.
+"""
+
+import copy
+import json
+
+import pytest
+
+from tools import perfdiff
+
+
+@pytest.fixture(scope="module")
+def canonical_fp():
+    """One canonical-workload run shared by the gate tests (the
+    workload is deterministic, so sharing loses nothing)."""
+    return perfdiff.run_canonical_workload()
+
+
+def _fp(**over):
+    fp = {
+        "schema": perfdiff.SCHEMA,
+        "exact": {"ticks": 10, "dispatches": 10,
+                  "flops_total": 1000.0},
+        "noisy": {"tokens_per_s": 100.0, "mfu": 0.5},
+    }
+    fp.update(over)
+    return fp
+
+
+# ------------------------------------------------------ compare() unit
+
+def test_compare_identical_passes():
+    assert perfdiff.compare(_fp(), _fp()) == []
+
+
+def test_compare_exact_drift_fails():
+    cur = copy.deepcopy(_fp())
+    cur["exact"]["dispatches"] = 11
+    failures = perfdiff.compare(_fp(), cur)
+    assert len(failures) == 1
+    assert "dispatches" in failures[0] and "drifted" in failures[0]
+
+
+def test_compare_exact_float_tolerates_rounding_only():
+    cur = copy.deepcopy(_fp())
+    cur["exact"]["flops_total"] = 1000.0 * (1 + 1e-9)   # rounding
+    assert perfdiff.compare(_fp(), cur) == []
+    cur["exact"]["flops_total"] = 1000.5                # real drift
+    assert perfdiff.compare(_fp(), cur)
+
+
+def test_compare_missing_metric_fails():
+    cur = copy.deepcopy(_fp())
+    del cur["exact"]["ticks"]
+    del cur["noisy"]["mfu"]
+    failures = perfdiff.compare(_fp(), cur)
+    assert any("ticks" in f and "missing" in f for f in failures)
+    assert any("mfu" in f and "missing" in f for f in failures)
+
+
+def test_compare_noisy_band_semantics():
+    base = _fp()
+    cur = copy.deepcopy(base)
+    cur["noisy"]["tokens_per_s"] = 60.0      # 0.6x: inside wide band
+    assert perfdiff.compare(base, cur) == []
+    cur["noisy"]["tokens_per_s"] = 0.5       # 0.005x: catastrophe
+    failures = perfdiff.compare(base, cur)
+    assert failures and "tokens_per_s" in failures[0]
+    # per-metric band override in the baseline wins
+    tight = copy.deepcopy(base)
+    tight["bands"] = {"tokens_per_s": (0.9, 1.1)}
+    cur["noisy"]["tokens_per_s"] = 60.0
+    assert perfdiff.compare(tight, cur)
+
+
+def test_compare_schema_mismatch_short_circuits():
+    cur = _fp(schema=99)
+    failures = perfdiff.compare(_fp(), cur)
+    assert failures == [f"schema mismatch: baseline {perfdiff.SCHEMA} "
+                        f"vs current 99"]
+
+
+# --------------------------------------------- the committed baseline
+
+def test_committed_baseline_parses_and_has_the_gate_fields():
+    base = perfdiff.load_baseline()
+    assert base["schema"] == perfdiff.SCHEMA
+    for key in ("dispatches_per_step", "flops_total", "decode_tokens",
+                "gemm_flops_per_token", "kv_bytes_per_token"):
+        assert key in base["exact"], key
+    # the headline discipline is pinned at exactly one dispatch/tick
+    assert base["exact"]["dispatches_per_step"] == 1.0
+    assert base["exact"]["flops_total"] > 0
+
+
+def test_canonical_workload_matches_committed_baseline(canonical_fp):
+    """THE regression gate: re-run the canonical workload and diff it
+    against PERF_BASELINE.json. Every exact field is deterministic on
+    any machine (token COUNTS are pinned by max_tokens even where
+    near-tie argmax values flip), so a mismatch is a real change —
+    update the baseline deliberately via
+    `python -m tools.perfdiff --write-baseline` and justify it in the
+    commit, exactly like the jaxlint baseline."""
+    baseline = perfdiff.load_baseline()
+    failures = perfdiff.compare(baseline, canonical_fp)
+    assert not failures, "\n".join(failures)
+
+
+def test_fingerprint_round_trips_through_json(canonical_fp):
+    again = json.loads(json.dumps(canonical_fp))
+    assert perfdiff.compare(canonical_fp, again) == []
+    assert perfdiff.compare(again, canonical_fp) == []
