@@ -1324,6 +1324,261 @@ def bench_preemption(on_tpu: bool, smoke: bool = False) -> dict:
     return res
 
 
+def _disagg_servers(n, cfg, pages, batch, chunk):
+    import uuid
+
+    from ray_tpu.llm._internal.server import LLMServerImpl
+
+    tag = f"kvt{uuid.uuid4().hex[:8]}"
+    return {f"r{i}": LLMServerImpl({
+        "model_id": "bench", "model_source": cfg,
+        "engine_kwargs": dict(
+            max_batch_size=batch, page_size=8, num_pages=pages,
+            seed=7, max_prefill_tokens=chunk,
+            enable_kv_offload=True,
+            metrics_model_id=tag, metrics_replica_id=f"r{i}"),
+    }) for i in range(n)}
+
+
+def bench_disagg(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 12 disaggregation A/B: a mixed long-prompt/short-decode
+    burst on 2 MIXED replicas vs 1 PREFILL + 1 DECODE over the fleet
+    KV transport. In the mixed arm every long prompt's chunked
+    prefill shares a tick budget with running decodes; in the
+    disaggregated arm the prefill replica absorbs the long prompts
+    and ships the parked sessions, so the decode replica's ticks
+    stay pure decode — the client-side decode inter-token gap (ITL
+    p99 over the short streams) is the headline. CPU numbers are
+    honest-signal only for the CONTRACT (token-exact handoffs, ships
+    observed); both arms share one host here, so the latency split
+    shows its real gap on TPU. `--smoke` asserts the disaggregated
+    path is token-exact vs a single-engine oracle."""
+    import asyncio
+
+    from ray_tpu.serve.llm import (AdmissionConfig, AutoscaleConfig,
+                                   FleetManager, LocalReplicaClient,
+                                   RouterConfig, TransportConfig)
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        cfg = _tpu_bench_model()
+        long_chars, gen_long, gen_short = 2048, 16, 64
+        n_long, n_short, rounds = 4, 8, 3
+        pages, batch, chunk = 512, 8, 128
+    else:
+        cfg = llama.config("debug")
+        long_chars, gen_long, gen_short = 160, 6, 24
+        n_long, n_short, rounds = 2, 4, 2
+        pages, batch, chunk = 160, 4, 32
+
+    def fleet_over(servers, roles):
+        return FleetManager(
+            [LocalReplicaClient(rid, srv)
+             for rid, srv in servers.items()],
+            router=RouterConfig(prefix_depth=64,
+                                spill_waiting=batch * 4),
+            admission=AdmissionConfig(max_concurrent=64,
+                                      max_queue=128,
+                                      queue_wait_slo_s=60.0),
+            autoscale=AutoscaleConfig(min_replicas=len(servers),
+                                      max_replicas=len(servers)),
+            roles=roles,
+            transport=TransportConfig(disagg_prompt_chars=128,
+                                      enable_prefix_store=False))
+
+    def run(roles):
+        servers = _disagg_servers(2, cfg, pages, batch, chunk)
+        fleet = fleet_over(servers, roles)
+        gaps = []
+
+        async def one(prompt, gen, collect):
+            last = None
+            async for c in fleet.dispatch_stream(
+                    "completions_stream",
+                    {"prompt": prompt, "max_tokens": gen}):
+                if "[DONE]" in c:
+                    continue
+                now = time.perf_counter()
+                if collect and last is not None:
+                    gaps.append(now - last)
+                last = now
+
+        async def drive():
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                jobs = [one(f"long context r{r} i{i} "
+                            + "x" * long_chars, gen_long, False)
+                        for i in range(n_long)]
+                jobs += [one(f"short q r{r} i{i}", gen_short, True)
+                         for i in range(n_short)]
+                await asyncio.gather(*jobs)
+            dt = time.perf_counter() - t0
+            for srv in servers.values():
+                if srv._pump is not None:
+                    srv._pump.cancel()
+            return dt
+
+        dt = asyncio.run(drive())
+        gaps.sort()
+        evs = [e["event"] for e in fleet.recorder.events()]
+        hit = sum(s.engine.allocator.cache_hit_tokens
+                  for s in servers.values())
+        query = sum(s.engine.allocator.cache_query_tokens
+                    for s in servers.values())
+        toks = rounds * (n_long * gen_long + n_short * gen_short)
+        return {
+            "tokens_per_sec": round(toks / dt, 1),
+            "decode_itl_p99_ms": round(
+                gaps[min(len(gaps) - 1, int(len(gaps) * 0.99))]
+                * 1e3, 3) if gaps else None,
+            "decode_itl_p50_ms": round(
+                gaps[len(gaps) // 2] * 1e3, 3) if gaps else None,
+            "fleet_prefix_hit_rate": round(hit / max(query, 1), 4),
+            "sessions_shipped": evs.count("disagg_handoff"),
+            "disagg_fallbacks": evs.count("disagg_fallback"),
+        }
+
+    # correctness half (always, and the whole of --smoke): one long
+    # prompt through the disaggregated fleet vs a single-engine
+    # oracle, token-exact
+    servers = _disagg_servers(2, cfg, pages, batch, chunk)
+    fleet = fleet_over(servers, ["prefill", "decode"])
+    body = {"prompt": "exactness probe " + "y" * long_chars,
+            "max_tokens": gen_short}
+
+    async def probe():
+        toks = []
+        async for c in fleet.dispatch_stream("completions_stream",
+                                             dict(body)):
+            if not c.startswith("data: "):
+                continue
+            d = c[len("data: "):].strip()
+            if d == "[DONE]":
+                continue
+            toks += json.loads(d)["choices"][0].get("token_ids") \
+                or []
+        for srv in servers.values():
+            if srv._pump is not None:
+                srv._pump.cancel()
+        return toks
+
+    got = asyncio.run(probe())
+    oracle = _disagg_servers(1, cfg, pages, batch, chunk)["r0"]
+
+    async def oracle_probe():
+        out = []
+        async for c in oracle.completions_stream_tokens(dict(body)):
+            out.append(c)
+        if oracle._pump is not None:
+            oracle._pump.cancel()
+        return [t for c in out for t in c["toks"]]
+
+    want = asyncio.run(oracle_probe())
+    shipped = [e["event"] for e in fleet.recorder.events()] \
+        .count("disagg_handoff")
+    assert got == want, "disaggregated path diverged from oracle"
+    assert shipped == 1, shipped
+    exact = {"token_exact": True, "tokens": len(got),
+             "sessions_shipped": shipped}
+    if smoke:
+        return {"exactness": exact}
+    disagg = run(["prefill", "decode"])
+    mixed = run(None)
+    assert disagg["sessions_shipped"] >= rounds * n_long \
+        - disagg["disagg_fallbacks"], disagg
+    return {"exactness": exact, "disaggregated_1p1d": disagg,
+            "mixed_2rep": mixed}
+
+
+def bench_prefix_store(on_tpu: bool) -> dict:
+    """ISSUE 12c A/B — the acceptance gate: on a shared-system-prompt
+    workload, the fleet prefix-store hit rate must be STRICTLY above
+    the PR 6 per-replica baseline (same fleet, same deterministic
+    routing, store off). One warm request publishes the prefix; every
+    other replica's FIRST request of that prefix then imports the
+    pages instead of cold-prefilling — the per-replica cache
+    multiplied by fleet size."""
+    import asyncio
+    import uuid
+
+    from ray_tpu.serve.llm import (AdmissionConfig, AutoscaleConfig,
+                                   FleetManager, LocalReplicaClient,
+                                   RouterConfig, TransportConfig)
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        cfg = _tpu_bench_model()
+        gen, per_round, rounds = 16, 8, 2
+        pages, batch, chunk = 512, 8, 128
+    else:
+        cfg = llama.config("debug")
+        gen, per_round, rounds = 4, 4, 2
+        pages, batch, chunk = 160, 4, 32
+    # 64 chars = the router's prefix depth = 8 full byte-tokenizer
+    # pages: exactly the chain the store ships
+    sys_prompt = (f"system prompt {uuid.uuid4().hex[:8]} "
+                  + "s" * 64)[:64]
+
+    def run(store):
+        servers = _disagg_servers(2, cfg, pages, batch, chunk)
+        fleet = FleetManager(
+            [LocalReplicaClient(rid, srv)
+             for rid, srv in servers.items()],
+            # round-robin pins IDENTICAL routing in both arms, so the
+            # only difference is the store seeding the cold replica
+            router=RouterConfig(policy="round_robin",
+                                prefix_depth=64),
+            admission=AdmissionConfig(max_concurrent=64,
+                                      max_queue=128,
+                                      queue_wait_slo_s=60.0),
+            autoscale=AutoscaleConfig(min_replicas=2,
+                                      max_replicas=2),
+            transport=(TransportConfig(enable_disagg=False,
+                                       prefix_min_chars=64)
+                       if store else None))
+
+        async def drive():
+            # the system prompt is prefilled ONCE, sequentially —
+            # with the store on, this publishes it fleet-wide
+            await fleet.dispatch("completions", {
+                "prompt": sys_prompt + " warmup", "max_tokens": gen})
+            for r in range(rounds):
+                await asyncio.gather(*(
+                    fleet.dispatch("completions", {
+                        "prompt": sys_prompt + f" user {r}-{i}",
+                        "max_tokens": gen})
+                    for i in range(per_round)))
+            for srv in servers.values():
+                if srv._pump is not None:
+                    srv._pump.cancel()
+
+        asyncio.run(drive())
+        hit = sum(s.engine.allocator.cache_hit_tokens
+                  for s in servers.values())
+        query = sum(s.engine.allocator.cache_query_tokens
+                    for s in servers.values())
+        return {
+            "fleet_prefix_hit_rate": round(hit / max(query, 1), 4),
+            "store": (fleet.prefix_store.stats()
+                      if fleet.prefix_store is not None else None),
+        }
+
+    baseline = run(False)
+    store = run(True)
+    # THE gate: the shared tier strictly beats per-replica caches
+    assert store["fleet_prefix_hit_rate"] \
+        > baseline["fleet_prefix_hit_rate"], (store, baseline)
+    assert store["store"]["publishes"] >= 1
+    assert store["store"]["hits"] >= 1
+    return {
+        "per_replica_baseline": baseline,
+        "fleet_store": store,
+        "hit_rate_advantage": round(
+            store["fleet_prefix_hit_rate"]
+            - baseline["fleet_prefix_hit_rate"], 4),
+    }
+
+
 def main() -> None:
     import sys
     dev = jax.devices()[0]
@@ -1340,6 +1595,9 @@ def main() -> None:
         chaos = bench_chaos(on_tpu, smoke=True)
         preemption = bench_preemption(on_tpu, smoke=True)
         perf = bench_perf_accounting(on_tpu, smoke=True)
+        # ISSUE 12: disaggregated prefill/decode must be token-exact
+        # vs a single-engine oracle (the ship really happened)
+        disagg = bench_disagg(on_tpu, smoke=True)
         print(json.dumps({
             "metric": "llm_mixed_smoke",
             "value": mixed["unified"]["tokens_per_sec"],
@@ -1350,18 +1608,24 @@ def main() -> None:
                        "fleet_tracing": fleet_tracing,
                        "chaos": chaos,
                        "preemption": preemption,
-                       "perf": perf},
+                       "perf": perf,
+                       "disagg": disagg},
         }))
         return
     if "--fleet" in sys.argv:
         # ISSUE 6 A/B: prefix-affine routing vs round-robin over a
-        # 2-replica in-process fleet + admission overload contract
+        # 2-replica in-process fleet + admission overload contract;
+        # ISSUE 12 rides along: the disaggregation A/B and the fleet
+        # prefix-store-vs-per-replica-baseline gate
         fleet = bench_fleet(on_tpu)
+        disagg = bench_disagg(on_tpu)
+        store = bench_prefix_store(on_tpu)
         print(json.dumps({
             "metric": "llm_fleet" if on_tpu else "llm_fleet_cpu",
             "value": fleet["affinity_2rep"]["tokens_per_sec"],
             "unit": "tokens_per_sec",
-            "detail": fleet,
+            "detail": {**fleet, "disagg": disagg,
+                       "prefix_store": store},
         }))
         return
     if "--long-ctx" in sys.argv:

@@ -128,6 +128,35 @@ committed PERF_BASELINE.json):
     ray_tpu_llm_fleet_mbu                   gauge      goodput-weighted mean replica MBU
                                                        (ingress registry)
 
+ISSUE 12 fleet KV transport (disaggregated prefill/decode, live
+session migration, fleet prefix store; details: BENCH_CORE.md "KV
+transport anatomy"; `finished_total` gains reason `migrated` for
+sessions that left the replica mid-stream):
+
+    ray_tpu_llm_kv_host_bytes_used          gauge      host-RAM bytes pinned by parked
+                                                       KV payloads (beside the page
+                                                       count: migration / prefix-store
+                                                       byte pressure)
+    ray_tpu_llm_kv_sessions_shipped_total   counter    + `kind` tag (disagg|migration|
+                                                       restore): parked sessions shipped
+                                                       between replicas (ingress registry)
+    ray_tpu_llm_kv_ship_bytes_total         counter    + `direction` tag (export|import):
+                                                       serialized transport bytes
+                                                       (ingress registry)
+    ray_tpu_llm_prefix_store_hits_total     counter    fleet prefix-store entries seeded
+                                                       into a replica that had not
+                                                       prefilled the prefix itself
+                                                       (ingress registry)
+
+KV-transport replica endpoints (fleet-internal, reached through the
+replica client interface — the public ingress strips their plumbing
+keys): `export_session` / `import_session` (ship a parked session),
+`prefill_export` (disaggregated prefill: run the prompt, park,
+export), `resume_stream_tokens` (import + stream the remainder with
+global token indices), `export_prefix` / `import_prefix` (fleet
+prefix store), `list_sessions`. Migration/handoff spans land in
+`GET /fleet/debug/trace` under the `kv_transport` category.
+
 Instrumentation is recorded purely from host-side engine events (zero
 device syncs, zero extra dispatches — the dispatch-guard suite runs
 with it enabled); disable per engine with

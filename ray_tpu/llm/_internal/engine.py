@@ -2344,6 +2344,16 @@ class InferenceEngine:
             slot.last_token = parked.last_token
             slot.ready = True
             slot.seed = parked.seed
+            # re-offer the FULL prompt pages to the prefix cache:
+            # locally-spilled sessions usually find them still cached
+            # (no-op), but a session IMPORTED from another replica
+            # (ISSUE 12) carries prompt KV this replica never
+            # prefilled — registering it here is what multiplies the
+            # per-replica prefix cache across the fleet
+            self.allocator.register_prefix(
+                req.prompt_tokens,
+                pages[:len(req.prompt_tokens)
+                      // self.allocator.page_size])
             table = np.zeros(self.max_pages_per_seq, np.int32)
             table[:len(pages)] = pages
             self._page_tables[slot.index] = table
@@ -2456,6 +2466,308 @@ class InferenceEngine:
                     return True
                 return False
             return False
+
+    # -- fleet KV transport (ISSUE 12) ----------------------------------
+    def session_ids(self) -> List[str]:
+        """Request ids resident on this engine (slots + waiting +
+        parked) — the migration orchestrator's inventory."""
+        with self._step_lock:
+            out = [s.request.request_id for s in self.slots
+                   if s.request is not None]
+            out += [r.request_id for r in self.waiting]
+            if self.host_tier is not None:
+                out += [p.request.request_id
+                        for p in self.host_tier.entries()]
+            return out
+
+    def export_session(self, request_id: str,
+                       reason: str = "migration"
+                       ) -> Optional[Dict[str, Any]]:
+        """Detach one live request for shipping to another engine
+        (ISSUE 12): built on the PR 10 spill path — a decoding victim
+        is preempted into the host tier, materialized, and handed out
+        as a plain host-side state dict (numpy KV arrays + the decode
+        invariant import_session / _restore_parked resume from). A
+        still-prefilling or waiting request exports COLD (no pages —
+        it has emitted nothing, so the importer just re-admits it).
+        Returns None when the request is not here, already finished,
+        or cannot be captured (decoding victim with no host tier, or
+        a full tier) — the caller falls back to token replay. On
+        success the request leaves this engine with
+        finish_reason="migrated", so its local stream terminates with
+        a migration marker instead of an abort."""
+        with self._step_lock:
+            tier = self.host_tier
+            if tier is not None and request_id in tier:
+                # fast path: the pages were ALREADY spilled — export
+                # straight out of the host tier, no device work at
+                # all (this is what makes failover-by-restore cheaper
+                # than failover-by-replay)
+                parked = tier.export(request_id)
+                if parked in self._pending_spills:
+                    self._pending_spills.remove(parked)
+                parked.materialize(self._read_tokens)
+                return self._session_state(parked.request, parked,
+                                           reason)
+            for i, req in enumerate(self.waiting):
+                if req.request_id == request_id:
+                    del self.waiting[i]
+                    return self._session_state(req, None, reason)
+            slot = next(
+                (s for s in self.slots if s.request is not None
+                 and s.request.request_id == request_id), None)
+            if slot is None:
+                return None
+            if slot.ready and tier is None:
+                return None       # decoding KV cannot be captured
+            self._drain(self._pending_touched)
+            req = slot.request
+            if req is None or req.request_id != request_id \
+                    or req.finished:
+                return None       # finished inside the drain fold
+            was_ready = slot.ready
+            if not self._preempt_slot(slot, self._pending_touched,
+                                      reason):
+                return None       # host tier full
+            self._refresh_device_state()
+            if not was_ready:
+                # prefilling victims requeue instead of spilling:
+                # pull the requeued request back off the waiting
+                # head for a cold export
+                for i, r in enumerate(self.waiting):
+                    if r.request_id == request_id:
+                        del self.waiting[i]
+                        return self._session_state(r, None, reason)
+                return None
+            parked = tier.export(request_id)
+            if parked in self._pending_spills:
+                self._pending_spills.remove(parked)
+            parked.materialize(self._read_tokens)
+            return self._session_state(parked.request, parked, reason)
+
+    def _session_state(self, req: Request, parked, reason: str
+                       ) -> Dict[str, Any]:
+        """The exported host-side session state (serialized by
+        serve/llm/kv_transport.py). Marks the request finished with
+        reason "migrated" — it no longer lives on this engine."""
+        req.finished = True
+        req.finish_reason = "migrated"
+        self.telemetry.recorder.record(
+            "session_exported", request_id=req.request_id,
+            reason=reason,
+            pages=0 if parked is None else parked.n_pages,
+            generated=len(req.output_tokens))
+        ddl = None
+        if req.deadline is not None:
+            # monotonic deadlines do not survive a process hop; the
+            # importer converts the wall instant back
+            ddl = time.time() + (req.deadline - time.monotonic())
+        return {
+            "request_id": req.request_id,
+            "prompt_tokens": list(req.prompt_tokens),
+            "output_tokens": list(req.output_tokens),
+            "params": dataclasses.asdict(req.params),
+            "lora": req.lora,
+            "priority": int(req.priority),
+            "restarts": int(req.restarts),
+            "trace": req.trace,
+            "deadline_epoch": ddl,
+            "seed": (parked.seed if parked is not None
+                     else self._request_seed(req)),
+            "position": 0 if parked is None else parked.position,
+            "last_token": 0 if parked is None else parked.last_token,
+            "n_pages": 0 if parked is None else parked.n_pages,
+            "k": None if parked is None else parked.k_host,
+            "v": None if parked is None else parked.v_host,
+        }
+
+    def import_session(self, state: Dict[str, Any]) -> Request:
+        """Admit a session exported by another engine: a warm session
+        (pages attached) parks in the host tier and _restore_parked
+        re-admits it at the next tick exactly like a locally-spilled
+        victim — the restored slot resumes the shipped decode
+        invariant, and because every token's sampling key is
+        fold_in(seed, absolute index) the continued stream is
+        byte-identical to the exporter having kept it. A cold
+        session (nothing emitted yet) just re-enters admission.
+        Returns the live Request this engine now owns. Raises
+        ValueError on an id collision or incompatible KV geometry,
+        MemoryError when the tier cannot hold it — callers treat
+        both as a failed ship and fall back to replay."""
+        params = dict(state.get("params") or {})
+        if params.get("stop_token_ids") is not None:
+            params["stop_token_ids"] = tuple(params["stop_token_ids"])
+        # pin the exporter's RESOLVED seed: the importer may run this
+        # session under a different request id, and token-exactness
+        # hangs on the (seed, absolute index) keys staying identical
+        params["seed"] = int(state["seed"])
+        req = Request(str(state["request_id"]),
+                      [int(t) for t in state["prompt_tokens"]],
+                      SamplingParams(**params),
+                      lora=state.get("lora"),
+                      trace=state.get("trace"),
+                      priority=int(state.get("priority") or 0))
+        req.output_tokens = [int(t)
+                             for t in state.get("output_tokens") or []]
+        req.restarts = int(state.get("restarts") or 0)
+        if state.get("deadline_epoch") is not None:
+            req.deadline = time.monotonic() + (
+                float(state["deadline_epoch"]) - time.time())
+        n_pages = int(state.get("n_pages") or 0)
+        with self._step_lock:
+            rid = req.request_id
+            if any(s.request is not None
+                   and s.request.request_id == rid
+                   for s in self.slots) \
+                    or any(r.request_id == rid for r in self.waiting) \
+                    or (self.host_tier is not None
+                        and rid in self.host_tier):
+                raise ValueError(
+                    f"request {rid!r} is already live on this engine")
+            if n_pages == 0:
+                if req.output_tokens:
+                    raise ValueError(
+                        "cold session carries emitted tokens; replay "
+                        "it through the continuation path instead")
+                self.add_request(req)
+                self.telemetry.recorder.record(
+                    "session_imported", request_id=rid, pages=0)
+                return req
+            tier = self.host_tier
+            if tier is None:
+                raise ValueError(
+                    "import_session requires enable_kv_offload "
+                    "(no host tier to stage the pages in)")
+            position = int(state["position"])
+            if self.allocator.pages_needed(position) != n_pages:
+                raise ValueError(
+                    f"inconsistent session: position {position} "
+                    f"spans {self.allocator.pages_needed(position)} "
+                    f"pages, payload carries {n_pages}")
+            if len(req.prompt_tokens) + req.params.max_tokens \
+                    > self.max_seq:
+                raise ValueError(
+                    f"prompt+max_tokens exceeds max_seq_len "
+                    f"{self.max_seq}")
+            k, v = state["k"], state["v"]
+            want = (self.k_pages.shape[0], n_pages,
+                    *self.k_pages.shape[2:])
+            for name, arr in (("k", k), ("v", v)):
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"incompatible KV geometry: {name} is "
+                        f"{tuple(arr.shape)}, this engine expects "
+                        f"{want}")
+                if np.dtype(arr.dtype) != np.dtype(
+                        self.k_pages.dtype):
+                    raise ValueError(
+                        f"incompatible KV dtype: {name} is "
+                        f"{arr.dtype}, pool is {self.k_pages.dtype}")
+            from .kv_offload import ParkedSequence
+            parked = ParkedSequence(
+                request=req, seed=int(state["seed"]),
+                position=position,
+                last_token=int(state["last_token"]),
+                n_pages=n_pages, reason="import",
+                k_host=k, v_host=v)
+            tier.park(parked, count_spill=False)  # MemoryError if full
+            self.telemetry.recorder.record(
+                "session_imported", request_id=rid, pages=n_pages,
+                generated=len(req.output_tokens))
+            return req
+
+    def export_prefix(self, prompt_tokens: List[int]
+                      ) -> Optional[Dict[str, Any]]:
+        """Gather the cached full prompt pages for this token chain
+        to host numpy (the fleet prefix store's publish path). None
+        when nothing is cached. A read-only structural gather off the
+        live pools (the same sanctioned dispatch as the spill path) —
+        never on the tick path."""
+        with self._step_lock:
+            if not self.allocator.enable_prefix_caching:
+                return None
+            pages = self.allocator.cached_prefix_pages(prompt_tokens)
+            if not pages:
+                return None
+            self._drain(self._pending_touched)
+            n = len(pages)
+            nb = self._page_bucket(n)
+            ids = pages + [pages[-1]] * (nb - n)
+            kh, vh = self._page_gather_fn(nb)(
+                self.k_pages, self.v_pages,
+                self._dev(jnp.asarray(np.asarray(ids, np.int32))))
+            if self.perf is not None:
+                self.perf.note_offload(
+                    d2h=nb * self.perf.model.page_bytes)
+            k = self._read_tokens(kh)[:, :n]
+            v = self._read_tokens(vh)[:, :n]
+            toks = [int(t) for t in
+                    prompt_tokens[:n * self.allocator.page_size]]
+            self.telemetry.recorder.record(
+                "prefix_exported", pages=n, tokens=len(toks))
+            return {"tokens": toks, "k": k, "v": v}
+
+    def import_prefix(self, tokens: List[int], k_host, v_host) -> int:  # jaxlint: disable=JL006 -- prefix seeding upload: one scatter per fleet prefix-store import (structural event), never on the tick path
+        """Seed this engine's prefix cache with pages prefilled on
+        ANOTHER replica (the fleet prefix store's import path): the
+        missing tail of the chain uploads into freshly allocated
+        pages and registers under the same hash-cons keys local
+        prefill would have used, so the next admission's match_prefix
+        hits as if this replica had prefilled the prompt itself.
+        Returns the number of pages newly seeded (0 = already cached
+        / no room / nothing importable)."""
+        with self._step_lock:
+            if not self.allocator.enable_prefix_caching:
+                return 0
+            page = self.allocator.page_size
+            n = min(len(tokens) // page, int(k_host.shape[1]))
+            if n == 0:
+                return 0
+            want = (self.k_pages.shape[0], int(k_host.shape[1]),
+                    *self.k_pages.shape[2:])
+            for name, arr in (("k", k_host), ("v", v_host)):
+                if tuple(arr.shape) != want or np.dtype(arr.dtype) \
+                        != np.dtype(self.k_pages.dtype):
+                    raise ValueError(
+                        f"incompatible prefix KV geometry: {name} is "
+                        f"{tuple(arr.shape)}/{arr.dtype}, pool wants "
+                        f"{want}/{self.k_pages.dtype}")
+            toks = [int(t) for t in tokens[:n * page]]
+            have = self.allocator.cached_prefix_pages(toks)
+            if len(have) >= n:
+                return 0              # fully cached already
+            need = n - len(have)
+            if need > self.allocator.free_pages:
+                return 0              # never evict live work for this
+            self._drain(self._pending_touched)
+            fresh = self.allocator.allocate_pages(need)
+            nb = self._page_bucket(need)
+            ids = fresh + [fresh[-1]] * (nb - need)
+            kh = np.ascontiguousarray(k_host[:, len(have):n])
+            vh = np.ascontiguousarray(v_host[:, len(have):n])
+            if nb > need:
+                pad = nb - need
+                kh = np.concatenate(
+                    [kh, np.repeat(kh[:, -1:], pad, axis=1)], 1)
+                vh = np.concatenate(
+                    [vh, np.repeat(vh[:, -1:], pad, axis=1)], 1)
+            if self.perf is not None:
+                self.perf.note_offload(
+                    h2d=nb * self.perf.model.page_bytes)
+            self.k_pages, self.v_pages = self._page_scatter_fn(nb)(
+                self.k_pages, self.v_pages,
+                self._dev(jnp.asarray(np.asarray(ids, np.int32))),
+                self._dev(jnp.asarray(kh)),
+                self._dev(jnp.asarray(vh)))
+            self.allocator.register_prefix(toks, have + fresh)
+            # registration took the cache's reference on the fresh
+            # pages; release the allocation's so they are cache-owned
+            # (rc=1 -> evictable under pressure, like local prefill)
+            self.allocator.free(fresh)
+            self.telemetry.recorder.record(
+                "prefix_imported", pages=need, cached=len(have),
+                tokens=len(toks))
+            return need
 
     # -- public API ---------------------------------------------------------
     def register_lora(self, name: str, adapters: Dict[str, tuple],

@@ -112,6 +112,22 @@ class PageAllocator:
             pages.append(page)
         return pages, len(pages) * self.page_size
 
+    def cached_prefix_pages(self, tokens: Sequence[int]) -> List[int]:
+        """Longest cached chain of FULL pages for `tokens`, in chain
+        order, WITHOUT taking references or touching LRU order — the
+        KV-transport export/import paths (ISSUE 12) inspect the cache
+        under the engine step lock, where nothing can free or evict
+        concurrently. Unlike match_prefix this is NOT capped one
+        token short: the fleet prefix store ships every cached page
+        of the shared prompt."""
+        pages: List[int] = []
+        for key in self._chain_keys(tokens):
+            page = self._cache.get(key)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
     def record_match(self, matched: int, prompt_len: int) -> None:
         """Hit-rate accounting, called ONCE per ADMITTED request (a
         blocked head-of-line request re-matches every scheduler tick and

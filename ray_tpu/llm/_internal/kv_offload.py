@@ -80,6 +80,18 @@ class ParkedSequence:                     # hold numpy arrays
         now = time.monotonic() if now is None else now
         return max(now - self.parked_at, 0.0)
 
+    def payload_bytes(self) -> int:
+        """Host bytes this sequence pins (ISSUE 12 satellite: the
+        `kv_host_bytes_used` gauge). Normalized to the TRUE page
+        count — the pending gather buffers are bucket-padded and the
+        materialized arrays sliced, so per-page bytes times n_pages
+        is the one number stable across both phases."""
+        for arr in (self.k_host, self.k_pending):
+            if arr is not None and getattr(arr, "shape", None):
+                per = int(arr.nbytes) // max(int(arr.shape[1]), 1)
+                return 2 * per * self.n_pages
+        return 0
+
 
 class HostKVTier:
     """Bounded host-RAM store of spilled KV page sets, keyed by
@@ -95,12 +107,19 @@ class HostKVTier:
         self.capacity_pages = capacity_pages
         self._entries: "OrderedDict[str, ParkedSequence]" = OrderedDict()
         self.used_pages = 0
+        # host bytes pinned by parked payloads (ISSUE 12: the
+        # `kv_host_bytes_used` gauge — byte pressure surfaces before
+        # page counts saturate); per-entry sizes are remembered at
+        # park time so removal subtracts exactly what was added
+        self.used_bytes = 0
+        self._entry_bytes: Dict[str, int] = {}
         # cumulative counters (GET /metrics: spills/restores_total)
         self.spills_total = 0
         self.restores_total = 0
         self.spilled_pages_total = 0
         self.restored_pages_total = 0
         self.dropped_total = 0          # abort/deadline while parked
+        self.exports_total = 0          # shipped to another replica
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -116,7 +135,12 @@ class HostKVTier:
         return (self.capacity_pages is None
                 or self.used_pages + n_pages <= self.capacity_pages)
 
-    def park(self, parked: ParkedSequence) -> None:
+    def park(self, parked: ParkedSequence,
+             count_spill: bool = True) -> None:
+        """count_spill=False is the IMPORT path (ISSUE 12): a session
+        shipped from another replica parks here awaiting restore but
+        was never spilled off THIS device, so it must not inflate the
+        spill counters the preemption gates assert on."""
         rid = parked.request.request_id
         if rid in self._entries:
             raise ValueError(f"request {rid!r} already parked")
@@ -127,15 +151,31 @@ class HostKVTier:
                 f"{self.capacity_pages} free")
         self._entries[rid] = parked
         self.used_pages += parked.n_pages
-        self.spills_total += 1
-        self.spilled_pages_total += parked.n_pages
+        self._entry_bytes[rid] = parked.payload_bytes()
+        self.used_bytes += self._entry_bytes[rid]
+        if count_spill:
+            self.spills_total += 1
+            self.spilled_pages_total += parked.n_pages
+
+    def _forget_bytes(self, request_id: str) -> None:
+        self.used_bytes -= self._entry_bytes.pop(request_id, 0)
 
     def pop(self, request_id: str) -> ParkedSequence:
         """Remove for RESTORE (counts into restores_total)."""
         parked = self._entries.pop(request_id)
         self.used_pages -= parked.n_pages
+        self._forget_bytes(request_id)
         self.restores_total += 1
         self.restored_pages_total += parked.n_pages
+        return parked
+
+    def export(self, request_id: str) -> ParkedSequence:
+        """Remove for SHIPPING to another replica (ISSUE 12): neither
+        a restore nor a drop — the session continues elsewhere."""
+        parked = self._entries.pop(request_id)
+        self.used_pages -= parked.n_pages
+        self._forget_bytes(request_id)
+        self.exports_total += 1
         return parked
 
     def drop(self, request_id: str) -> Optional[ParkedSequence]:
@@ -143,12 +183,14 @@ class HostKVTier:
         parked = self._entries.pop(request_id, None)
         if parked is not None:
             self.used_pages -= parked.n_pages
+            self._forget_bytes(request_id)
             self.dropped_total += 1
         return parked
 
     def stats(self) -> Dict[str, Any]:
         return {
             "host_pages_used": self.used_pages,
+            "host_bytes_used": self.used_bytes,
             "host_pages_capacity": self.capacity_pages,
             "parked_sessions": len(self._entries),
             "spills_total": self.spills_total,
@@ -156,6 +198,7 @@ class HostKVTier:
             "spilled_pages_total": self.spilled_pages_total,
             "restored_pages_total": self.restored_pages_total,
             "parked_dropped_total": self.dropped_total,
+            "session_exports_total": self.exports_total,
         }
 
 

@@ -25,13 +25,15 @@ from .tokenizer import load_tokenizer
 # it), so it lives here once and the fleet imports it
 DEFAULT_MAX_TOKENS = 32
 
-# body keys minted by the fleet ingress (ISSUE 7/9 plumbing): every
+# body keys minted by the fleet ingress (ISSUE 7/9/12 plumbing): every
 # public ingress must strip client-supplied values — a forged
 # `_request_id` could replay/abort another request, `_continue_tokens`
-# injects raw token ids, `_deadline_epoch` bypasses `deadline_s`. One
+# injects raw token ids, `_deadline_epoch` bypasses `deadline_s`, and
+# `_session` would inject raw KV pages into the pool (ISSUE 12). One
 # canonical list; the fleet imports it too.
 INTERNAL_BODY_KEYS = ("_request_id", "_trace", "_deadline_epoch",
-                      "_continue_tokens", "_token_offset")
+                      "_continue_tokens", "_token_offset",
+                      "_session", "_resume_offset", "_chat")
 
 
 class LLMServerImpl:
@@ -428,6 +430,202 @@ class LLMServerImpl:
         async for chunk in self._stream_tokens(body, chat=False):
             yield chunk
 
+    # -- fleet KV transport endpoints (ISSUE 12) --------------------------
+    @staticmethod
+    def _kvt():
+        # lazy: the serve.llm package imports this module at load
+        # time, so a top-level import back into it would be circular
+        from ...serve.llm import kv_transport
+        return kv_transport
+
+    async def list_sessions(self) -> List[str]:
+        """Request ids resident on this replica's engine (slots +
+        waiting + parked) — the fleet migration orchestrator's view."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.session_ids)
+
+    async def export_session(self, body: Dict[str, Any]
+                             ) -> Dict[str, Any]:
+        """Detach one live session for shipping (drain migration /
+        failover-by-restore): preempt via the PR 10 spill path,
+        serialize, and terminate the local stream with a "migrated"
+        finish event so the fleet relay resumes it elsewhere instead
+        of reading an abort. {"session": None} when the request is
+        not exportable — the caller falls back to token replay."""
+        kvt = self._kvt()
+        rid = str((body or {}).get("request_id") or "")
+        reason = str((body or {}).get("reason") or "migration")
+        state = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.export_session, rid, reason)
+        if state is None:
+            return {"session": None}
+        q = self._queues.get(rid)
+        if q is not None:
+            # the stream loop is blocked on its queue: deliver the
+            # migration marker (req.finished is already True, so the
+            # generator exits cleanly without aborting the engine)
+            q.put_nowait((None, True, "migrated"))
+        blob = kvt.encode_session(state)
+        return {"session": kvt.to_b64(blob), "bytes": len(blob),
+                "pages": int(state.get("n_pages") or 0),
+                "generated": len(state.get("output_tokens") or [])}
+
+    async def import_session(self, body: Dict[str, Any]
+                             ) -> Dict[str, Any]:
+        """Admit a shipped session (unary twin of
+        resume_stream_tokens, for pre-staging / tests): the payload
+        parks in the host tier and restores token-exact at the next
+        tick. Transport/geometry faults raise — the caller treats a
+        failed ship as a replay fallback, never a crash."""
+        kvt = self._kvt()
+        state = kvt.decode_session(
+            kvt.from_b64(str((body or {}).get("session") or "")))
+        req = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.import_session, state)
+        self._ensure_pump()
+        self._wake.set()
+        return {"request_id": req.request_id,
+                "pages": int(state.get("n_pages") or 0)}
+
+    async def prefill_export(self, body: Dict[str, Any]
+                             ) -> Dict[str, Any]:
+        """The disaggregated-prefill entry point: run the prompt on
+        THIS replica until the first sampled token exists (prefill
+        complete — the expensive long-prompt work), then park and
+        export the session for a decode replica to resume. A request
+        that FINISHES during prefill (1-token generations, instant
+        EOS) returns the final transcript instead ("final") — there
+        is nothing left to disaggregate."""
+        kvt = self._kvt()
+        body = dict(body or {})
+        chat = bool(body.pop("_chat", False))
+        rid, trace = self._trace_of(body)
+        deadline = self._deadline_of(body)
+        toks = self._prompt_tokens(body, chat=chat)
+        self._ensure_pump()
+        if not rid or rid in self._queues:
+            rid = uuid.uuid4().hex[:16]
+        req = Request(rid, toks, self._sampling(body),
+                      lora=self._lora_for(body), trace=trace,
+                      deadline=deadline,
+                      priority=self._priority_of(body))
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        try:
+            self.engine.add_request(req)
+            self._wake.set()
+            while not req.output_tokens and not req.finished:
+                await asyncio.wait_for(q.get(), timeout=300)
+            state = None
+            if not req.finished:
+                state = await asyncio.get_running_loop() \
+                    .run_in_executor(None, self.engine.export_session,
+                                     rid, "disagg")
+            if state is None:
+                if req.finished and req.finish_reason != "migrated":
+                    # finished for real before the export could run
+                    return {"session": None, "final": {
+                        "i": 0, "toks": list(req.output_tokens),
+                        "text": self.tokenizer.decode(
+                            req.output_tokens),
+                        "finished": True,
+                        "reason": req.finish_reason,
+                        "model": self.model_id}}
+                return {"session": None, "final": None}
+            blob = kvt.encode_session(state)
+            return {"session": kvt.to_b64(blob), "bytes": len(blob),
+                    "pages": int(state.get("n_pages") or 0),
+                    "generated": len(state.get("output_tokens")
+                                     or [])}
+        finally:
+            self._queues.pop(rid, None)
+            if not req.finished:
+                self._abort_off_loop(rid)
+
+    async def resume_stream_tokens(self, body: Dict[str, Any]):
+        """Import a shipped session and stream its remaining tokens
+        (the decode half of disaggregation, and the landing side of
+        migration/failover-by-restore). Chunks carry GLOBAL token
+        indices like *_stream_tokens; the first chunk catches the
+        client up from `_resume_offset` (tokens the exporter emitted
+        that never reached the client), so the fleet transcript's
+        index dedup sees one gapless, exactly-once stream."""
+        kvt = self._kvt()
+        state = kvt.decode_session(
+            kvt.from_b64(str(body.get("_session") or "")))
+        offset = int(body.get("_resume_offset") or 0)
+        self._ensure_pump()
+        rid = str(state.get("request_id") or "")
+        if not rid or rid in self._queues:
+            rid = uuid.uuid4().hex[:16]    # see _generate: a replayed
+            state["request_id"] = rid      # id must never collide
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        req: "Request | None" = None
+        try:
+            req = await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.import_session, state)
+            self._wake.set()
+            out = list(req.output_tokens)
+            offset = max(0, min(offset, len(out)))
+            full = self.tokenizer.decode(out)
+            sent = len(self.tokenizer.decode(out[:offset]))
+            yield {"i": offset, "toks": out[offset:],
+                   "text": full[sent:], "finished": False,
+                   "reason": None, "model": self.model_id}
+            n_sent, n_toks = len(full), len(out)
+            while True:
+                _, finished, reason = await asyncio.wait_for(
+                    q.get(), timeout=300)
+                text = self.tokenizer.decode(req.output_tokens)
+                delta, n_sent = text[n_sent:], len(text)
+                new = list(req.output_tokens[n_toks:])
+                prev = n_toks
+                n_toks = len(req.output_tokens)
+                if not new and not delta and not finished:
+                    continue
+                yield {"i": prev, "toks": new, "text": delta,
+                       "finished": bool(finished),
+                       "reason": reason if finished else None,
+                       "model": self.model_id}
+                if finished:
+                    return
+        finally:
+            self._queues.pop(rid, None)
+            if req is not None and not req.finished:
+                # stream abandoned mid-resume: free the slot/pages
+                self._abort_off_loop(rid)
+
+    async def export_prefix(self, body: Dict[str, Any]
+                            ) -> Dict[str, Any]:
+        """Publish the cached KV pages of a prompt prefix (the fleet
+        prefix store's export half). {"prefix": None} when nothing
+        is cached for the chain."""
+        kvt = self._kvt()
+        text = str((body or {}).get("text") or "")
+        if not text:
+            return {"prefix": None}
+        toks = self.tokenizer.encode(text)
+        exp = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.export_prefix, toks)
+        if exp is None:
+            return {"prefix": None}
+        blob = kvt.encode_prefix(exp["tokens"], exp["k"], exp["v"])
+        return {"prefix": kvt.to_b64(blob), "bytes": len(blob),
+                "tokens": len(exp["tokens"])}
+
+    async def import_prefix(self, body: Dict[str, Any]
+                            ) -> Dict[str, Any]:
+        """Seed this replica's prefix cache from a published store
+        entry (the import half). Returns the pages newly seeded
+        (0 = already cached or no room)."""
+        kvt = self._kvt()
+        toks, k, v = kvt.decode_prefix(
+            kvt.from_b64(str((body or {}).get("prefix") or "")))
+        pages = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.import_prefix, toks, k, v)
+        return {"pages": int(pages)}
+
     async def model_info(self) -> Dict[str, Any]:
         # stats() snapshots tick telemetry under the engine step
         # lock — run it off the event loop so a busy tick can't
@@ -531,6 +729,11 @@ class LLMServerImpl:
             "parked_sessions": len(eng.parked),
             "kv_offload": eng.host_tier is not None,
             "kv_host_pages_used": (eng.host_tier.used_pages
+                                   if eng.host_tier else 0),
+            # ISSUE 12 satellite: host-tier BYTE occupancy — byte
+            # pressure from migration/prefix-store traffic surfaces
+            # before page counts saturate
+            "kv_host_bytes_used": (eng.host_tier.used_bytes
                                    if eng.host_tier else 0),
             "spills_total": (eng.host_tier.spills_total
                              if eng.host_tier else 0),

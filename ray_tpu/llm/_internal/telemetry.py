@@ -128,6 +128,12 @@ def _build_metrics() -> Dict[str, Any]:
         "kv_host_used": G("ray_tpu_llm_kv_host_pages_used",
                           "KV pages parked in the host-RAM tier",
                           keys),
+        # ISSUE 12 satellite: host-tier BYTE occupancy beside the
+        # page count — migration / prefix-store byte pressure is
+        # visible before page counts saturate
+        "kv_host_bytes": G("ray_tpu_llm_kv_host_bytes_used",
+                           "host-RAM bytes pinned by parked KV "
+                           "payloads", keys),
         "parked": G("ray_tpu_llm_parked_sessions",
                     "preempted sequences parked in the host tier",
                     keys),
@@ -501,6 +507,8 @@ class EngineTelemetry:
         tier = getattr(engine, "host_tier", None)
         self._m["kv_host_used"].set(
             tier.used_pages if tier is not None else 0, self._tags)
+        self._m["kv_host_bytes"].set(
+            tier.used_bytes if tier is not None else 0, self._tags)
         self._m["parked"].set(
             len(tier) if tier is not None else 0, self._tags)
         pressure = getattr(engine, "page_pressure", None)

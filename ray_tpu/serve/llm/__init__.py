@@ -49,10 +49,30 @@ ISSUE 9 adds the failure-handling plane:
   (call raises, stream severed after N chunks, probe timeouts, slow
   replicas) so all of the above is tier-1-testable on CPU (chaos.py).
 
+ISSUE 12 adds the fleet KV transport — KV pages as a fleet-level
+currency (kv_transport.py, one versioned checksummed wire format for
+PR 10's ParkedSequence, three consumers):
+
+- disaggregated prefill/decode: `FleetConfig.replica_roles` marks
+  replicas prefill/decode/mixed; long prompts prefill on a prefill
+  replica and the parked session ships to a decode replica that
+  resumes it token-exact, so prompt-heavy bursts stop inflating
+  decode ITL;
+- live session migration: drain-before-downscale ships parked
+  sessions instead of replaying tokens, and stream failover gains a
+  failover-by-restore fast path when the victim can still export;
+- a fleet prefix store: a system prompt prefilled once is published
+  (`export_prefix`) and seeded into every replica that later serves
+  the prefix, multiplying the per-replica prefix cache by fleet
+  size. Every transport failure (severed ship, corrupted checksum,
+  rejected import) degrades to the PR 9 replay path — token-exact
+  either way.
+
 Scoring formula, admission thresholds, the autoscale policy, the
-observability surface, and the failure plane are documented in
-BENCH_CORE.md "Serving fleet anatomy", "Fleet observability anatomy"
-and "Fault tolerance anatomy".
+observability surface, the failure plane, and the KV transport are
+documented in BENCH_CORE.md "Serving fleet anatomy", "Fleet
+observability anatomy", "Fault tolerance anatomy" and "KV transport
+anatomy".
 """
 
 from __future__ import annotations
@@ -78,6 +98,11 @@ from .failover import (CircuitBreaker, HealthConfig,  # noqa: F401
                        StreamTranscript)
 from .fleet import (FleetManager, HandleReplicaClient,  # noqa: F401
                     LocalReplicaClient)
+from .kv_transport import (FleetPrefixStore,  # noqa: F401
+                           TransportChecksumError, TransportConfig,
+                           TransportError, decode_prefix,
+                           decode_session, encode_prefix,
+                           encode_session)
 from .router import (FleetRouter, HashRing, ReplicaSnapshot,  # noqa: F401
                      RouterConfig, prefix_fingerprint)
 from .tracemerge import (IngressTraceBuffer,  # noqa: F401
@@ -100,6 +125,10 @@ __all__ = [
     # observability layer (ISSUE 7)
     "WatchdogConfig", "SLOBurnWatchdog", "IngressTraceBuffer",
     "merge_fleet_traces", "merge_flight_recorders", "filter_trace",
+    # fleet KV transport (ISSUE 12)
+    "TransportConfig", "TransportError", "TransportChecksumError",
+    "FleetPrefixStore", "encode_session", "decode_session",
+    "encode_prefix", "decode_prefix",
     # single-model surface (ray_tpu.llm re-exports)
     "LLMConfig", "build_openai_app", "build_llm_deployment",
     "InferenceEngine", "EngineConfig", "SamplingParams", "Request",

@@ -37,6 +37,7 @@ from .autoscaler import AutoscaleConfig
 from .failover import HealthConfig
 from .fleet import (ACTIVE, DRAINING, STANDBY, FleetManager,
                     HandleReplicaClient)
+from .kv_transport import REPLICA_ROLES, ROLE_PREFILL, TransportConfig
 from .router import RouterConfig
 from .tracemerge import merge_fleet_traces, merge_flight_recorders
 from .watchdog import WatchdogConfig
@@ -74,6 +75,14 @@ class FleetConfig:
     drain_timeout_s: float = 120.0
     refresh_period_s: float = 0.5
     autoscale_period_s: float = 2.0
+    # fleet KV transport (ISSUE 12): None = off (pre-transport fleet).
+    # `replica_roles` aligns with r0..rN-1 ("prefill" | "decode" |
+    # "mixed"; None = all mixed) — prefill replicas take long-prompt
+    # handoffs only, never ring traffic. With a transport configured,
+    # every replica's engine gets enable_kv_offload=True by default
+    # (sessions park/restore through the host tier on both ends).
+    transport: Optional[TransportConfig] = None
+    replica_roles: Optional[List[str]] = None
 
     def resolved_autoscale(self) -> AutoscaleConfig:
         auto = self.autoscale or AutoscaleConfig()
@@ -95,6 +104,10 @@ class FleetConfig:
             "drain_timeout_s": self.drain_timeout_s,
             "refresh_period_s": self.refresh_period_s,
             "autoscale_period_s": self.autoscale_period_s,
+            "transport": (None if self.transport is None
+                          else dataclasses.asdict(self.transport)),
+            "replica_roles": (None if self.replica_roles is None
+                              else list(self.replica_roles)),
         }
 
 
@@ -137,7 +150,11 @@ class LLMFleetIngressImpl:
             drain_timeout_s=fleet_wire.get(
                 "drain_timeout_s", FleetConfig.drain_timeout_s),
             refresh_period_s=fleet_wire.get("refresh_period_s", 0.5),
-            autoscale_period_s=fleet_wire.get("autoscale_period_s", 2.0))
+            autoscale_period_s=fleet_wire.get("autoscale_period_s",
+                                              2.0),
+            roles=fleet_wire.get("replica_roles"),
+            transport=(TransportConfig(**fleet_wire["transport"])
+                       if fleet_wire.get("transport") else None))
         self._adapters: Optional[List[str]] = None
         self._adapters_ts = 0.0
 
@@ -414,6 +431,18 @@ def build_llm_fleet_app(config: FleetConfig):
     if config.min_replicas < 1 \
             or config.max_replicas < config.min_replicas:
         raise ValueError("need 1 <= min_replicas <= max_replicas")
+    roles = config.replica_roles
+    if roles is not None:
+        if len(roles) != config.max_replicas:
+            raise ValueError(
+                f"replica_roles ({len(roles)}) must align with "
+                f"max_replicas ({config.max_replicas})")
+        bad = [r for r in roles if r not in REPLICA_ROLES]
+        if bad:
+            raise ValueError(f"unknown replica roles {bad}")
+        if roles.count(ROLE_PREFILL) == len(roles):
+            raise ValueError("a fleet needs at least one "
+                             "decode-capable replica")
     servers = []
     for i in range(config.max_replicas):
         rid = f"r{i}"
@@ -421,6 +450,12 @@ def build_llm_fleet_app(config: FleetConfig):
         # the replica id tags this engine's Prometheus series (and is
         # how LLMServerImpl learns its own identity)
         ek["metrics_replica_id"] = rid
+        if config.transport is not None:
+            # both ends of a session ship stage through the host
+            # tier (export parks via the spill path, import restores
+            # via _restore_parked) — default it ON fleet-wide unless
+            # the operator pinned it explicitly
+            ek.setdefault("enable_kv_offload", True)
         dep_cfg = dict(lc.deployment_config or {})
         dep_cfg["name"] = f"LLMServer:{lc.model_id}:{rid}"
         servers.append(build_llm_deployment(

@@ -34,11 +34,13 @@ from typing import Any, AsyncIterator, Deque, Dict, List, Optional, \
 
 from ...llm._internal.telemetry import FlightRecorder
 from ...util import tracing
-from . import failover
+from . import failover, kv_transport
 from .admission import (AdmissionConfig, AdmissionController,
                         AdmissionRejected)
 from .autoscaler import AutoscaleConfig, FleetAutoscaler, FleetMetrics
 from .failover import CircuitBreaker, HealthConfig
+from .kv_transport import (FleetPrefixStore, TransportConfig,
+                           TransportError)
 from .router import (FleetRouter, ReplicaSnapshot, RouterConfig,
                      prefix_fingerprint)
 from .tracemerge import IngressTraceBuffer, request_events
@@ -103,9 +105,14 @@ class HandleReplicaClient:
 
 class _ReplicaState:
     def __init__(self, client: Any, status: str,
-                 health: Optional[HealthConfig] = None):
+                 health: Optional[HealthConfig] = None,
+                 role: str = kv_transport.ROLE_MIXED):
         self.client = client
         self.status = status
+        # disaggregated prefill/decode (ISSUE 12): `prefill` replicas
+        # never join the router ring — they only take long-prompt
+        # prefill handoffs; `decode`/`mixed` take ring traffic
+        self.role = role
         self.inflight = 0            # router-side, zero-lag
         self.requests_total = 0
         self.snapshot: Optional[ReplicaSnapshot] = None
@@ -129,9 +136,28 @@ class FleetManager:
                  model_id: str = "default",
                  probe_timeout_s: float = 5.0,
                  dispatch_timeout_s: float = 10.0,
-                 drain_timeout_s: float = 120.0):
+                 drain_timeout_s: float = 120.0,
+                 roles: Optional[Sequence[str]] = None,
+                 transport: Optional[TransportConfig] = None):
         if not clients:
             raise ValueError("a fleet needs at least one replica")
+        # per-replica roles (ISSUE 12 disaggregation): aligned with
+        # `clients`; default everyone `mixed` (= pre-transport fleet)
+        roles = (list(roles) if roles is not None
+                 else [kv_transport.ROLE_MIXED] * len(clients))
+        if len(roles) != len(clients):
+            raise ValueError(
+                f"roles ({len(roles)}) must align with clients "
+                f"({len(clients)})")
+        bad = [r for r in roles if r not in kv_transport.REPLICA_ROLES]
+        if bad:
+            raise ValueError(
+                f"unknown replica roles {bad}; valid: "
+                f"{kv_transport.REPLICA_ROLES}")
+        if all(r == kv_transport.ROLE_PREFILL for r in roles):
+            raise ValueError(
+                "a fleet needs at least one decode-capable replica "
+                "(role 'decode' or 'mixed')")
         auto = autoscale or AutoscaleConfig(
             min_replicas=len(clients), max_replicas=len(clients))
         if auto.max_replicas > len(clients):
@@ -162,10 +188,37 @@ class FleetManager:
         for i, c in enumerate(clients):
             status = ACTIVE if i < auto.min_replicas else STANDBY
             self.replicas[c.replica_id] = _ReplicaState(
-                c, status, self.health)
+                c, status, self.health, role=roles[i])
             self.metrics["breaker"].set(
                 0, {"model": self.model_id, "replica": c.replica_id})
-        self.router.set_replicas(self._ids(ACTIVE))
+        # -- fleet KV transport (ISSUE 12) -----------------------------
+        self.transport = transport
+        self.kvt_metrics = kv_transport.transport_metrics()
+        self.prefix_store: Optional[FleetPrefixStore] = None
+        if transport is not None and transport.enable_prefix_store:
+            self.prefix_store = FleetPrefixStore(
+                transport.prefix_store_bytes)
+        # live relay-driven streams by minted request id -> which
+        # replica currently serves them (the migration orchestrator's
+        # inventory), and exported-but-not-yet-resumed session
+        # payloads a drain shipped off a replica
+        self._live_streams: Dict[str, Dict[str, Any]] = {}
+        self._migrations: Dict[str, str] = {}
+        # fingerprints already offered to the prefix store (success
+        # or not): publishing is once-per-fingerprint, never a
+        # per-request tax on the response path
+        self._prefix_attempted: set = set()
+        self._sync_ring()
+        if not self._ring_ids():
+            # the INITIAL ACTIVE set (the first min_replicas clients)
+            # must contain a decode-capable replica — an all-prefill
+            # head would start the fleet with an empty router ring
+            # and reject every request until an autoscale activation
+            # happened to fix it
+            raise ValueError(
+                "the first min_replicas replicas are all "
+                "prefill-role: order at least one decode/mixed "
+                "replica inside min_replicas")
         self._prev_slo: Dict[str, Dict[str, float]] = {}
         self._prev_shed = 0
         self._scale_events: Deque[Dict[str, Any]] = \
@@ -211,6 +264,17 @@ class FleetManager:
         return [rid for rid, st in self.replicas.items()
                 if st.status in statuses]
 
+    def _ring_ids(self) -> List[str]:
+        """ACTIVE decode-capable replicas — the router ring's
+        membership. `prefill`-role replicas (ISSUE 12) never join:
+        they only take explicit prefill handoffs."""
+        return [rid for rid, st in self.replicas.items()
+                if st.status == ACTIVE
+                and st.role != kv_transport.ROLE_PREFILL]
+
+    def _sync_ring(self) -> None:
+        self.router.set_replicas(self._ring_ids())
+
     def _inflight_map(self) -> Dict[str, int]:
         return {rid: st.inflight for rid, st in self.replicas.items()}
 
@@ -219,9 +283,12 @@ class FleetManager:
                 if st.snapshot is not None}
 
     # -- request path ---------------------------------------------------
-    def _route(self, body: Dict[str, Any]
+    def _route(self, body: Dict[str, Any],
+               fp: Optional[str] = None
                ) -> "tuple[_ReplicaState, str]":
-        fp = prefix_fingerprint(body, self.router.config.prefix_depth)
+        if fp is None:
+            fp = prefix_fingerprint(body,
+                                    self.router.config.prefix_depth)
         rid, outcome = self.router.pick_ex(fp, self._snapshots(),
                                            self._inflight_map())
         if rid is None:
@@ -320,14 +387,20 @@ class FleetManager:
         if rec is not None:
             rec["t_admit"] = time.monotonic()
         attempts = 0
+        fp = prefix_fingerprint(body, self.router.config.prefix_depth)
         try:
             while True:
-                st, outcome = self._route(body)
+                st, outcome = self._route(body, fp)
                 if rec is not None and rec["replica"] is None:
                     rec["t_route"] = time.monotonic()
                     rec["replica"] = st.client.replica_id
                     rec["outcome"] = outcome
                 rid = st.client.replica_id
+                # fleet prefix store (ISSUE 12): seed the target with
+                # the published prefix pages BEFORE dispatching, so
+                # its local match_prefix hits like it prefilled the
+                # prompt itself (best-effort, once per replica)
+                await self._prefix_seed(fp, body, st)
                 st.inflight += 1
                 st.requests_total += 1
                 try:
@@ -370,6 +443,10 @@ class FleetManager:
                           if out.get("choices") else None)
                     if fr == "deadline":
                         self._count_deadline_shed("engine")
+                # publish the (now locally-cached) prefix into the
+                # fleet store so the NEXT replica serving it imports
+                # instead of cold-prefilling (once per fingerprint)
+                await self._prefix_publish(fp, body, st)
                 return out
         except AdmissionRejected as e:
             if rec is not None:
@@ -455,89 +532,521 @@ class FleetManager:
         """The failover-aware SSE relay: drive the replica's token
         stream through the transcript (dedup by token index), render
         OpenAI SSE chunks with ONE stable completion id, and on a
-        replica failure re-dispatch a token-exact continuation."""
+        replica failure re-dispatch a token-exact continuation.
+
+        ISSUE 12 layers the KV transport onto the same loop: a long
+        prompt may first take the disaggregated handoff (prefill on a
+        `prefill` replica, session shipped here), any attempt may be
+        a RESUME of a shipped session instead of a fresh dispatch
+        (`resume_stream_tokens` — the first chunk catches the
+        transcript up, so index dedup keeps exactly-once), a serving
+        replica may end its stream with a "migrated" marker (drain
+        shipped the session off it — resume where the payload says),
+        and a failing replica is first asked to EXPORT the session
+        (failover-by-restore) before the PR 9 replay continuation
+        kicks in. Every transport failure — severed ship, corrupted
+        payload, import rejection — degrades to replay, which is
+        token-exact by construction."""
         failover.pin_stream_identity(body)
-        cid = (("chatcmpl-" if is_chat else "cmpl-")
-               + str(body.get("_request_id")
-                     or uuid.uuid4().hex[:16]))
+        srid = str(body.get("_request_id") or uuid.uuid4().hex[:16])
+        cid = ("chatcmpl-" if is_chat else "cmpl-") + srid
         created = int(time.time())
         transcript = failover.StreamTranscript()
         model = self.model_id
         attempts = 0
         cur = body
-        while True:
-            st, outcome = self._route(cur)
-            if rec is not None and rec["replica"] is None:
-                rec["t_route"] = time.monotonic()
-                rec["replica"] = st.client.replica_id
-                rec["outcome"] = outcome
-            rid = st.client.replica_id
-            st.inflight += 1
-            st.requests_total += 1
-            gen = None
-            try:
-                # per-attempt COPY (see dispatch): in-process replicas
-                # pop plumbing keys off the dict they receive; the
-                # continuation must inherit the CANONICAL body —
-                # deadline, trace, and seed included
-                gen = st.client.stream(token_method, dict(cur))
-                it = gen.__aiter__()
-                while True:
-                    try:
+        session: Optional[str] = None     # shipped payload to resume
+        fp = prefix_fingerprint(body, self.router.config.prefix_depth)
+        if self._disagg_applies(body):
+            handoff = await self._prefill_handoff(body, is_chat)
+            if handoff is not None:
+                kind, val = handoff
+                if kind == "final":
+                    # finished during prefill (1-token generations):
+                    # nothing left to disaggregate
+                    folded = transcript.fold(val)
+                    if folded is not None:
+                        _, text, _, reason = folded
+                        yield failover.sse_chunk(
+                            is_chat, cid,
+                            val.get("model") or model, created,
+                            text, True, reason, transcript.tokens)
+                    yield "data: [DONE]\n\n"
+                    return
+                session = val
+        self._live_streams[srid] = {"replica": None,
+                                    "method": token_method}
+        try:
+            while True:
+                st, outcome = self._route(cur, fp)
+                if rec is not None and rec["replica"] is None:
+                    rec["t_route"] = time.monotonic()
+                    rec["replica"] = st.client.replica_id
+                    rec["outcome"] = outcome
+                rid = st.client.replica_id
+                self._live_streams[srid]["replica"] = rid
+                resumed = session is not None
+                if not resumed:
+                    await self._prefix_seed(fp, cur, st)
+                st.inflight += 1
+                st.requests_total += 1
+                gen = None
+                anext_task = None
+                migrated = False
+                try:
+                    if resumed:
+                        # resume a shipped session: import on the
+                        # target and stream from the transcript head
+                        # (the catch-up chunk regenerates nothing —
+                        # the exporter's emitted-but-undelivered
+                        # tokens ride the payload)
+                        self.kvt_metrics["ship_bytes"].inc(
+                            len(session) * 3 // 4,
+                            {"model": self.model_id,
+                             "direction": "import"})
+                        gen = st.client.stream(
+                            "resume_stream_tokens",
+                            {"_session": session,
+                             "_resume_offset": len(transcript.tokens),
+                             "_request_id": body.get("_request_id"),
+                             "_trace": body.get("_trace")})
+                        session = None
+                    else:
+                        # per-attempt COPY (see dispatch): in-process
+                        # replicas pop plumbing keys off the dict they
+                        # receive; the continuation must inherit the
+                        # CANONICAL body — deadline, trace, seed
+                        gen = st.client.stream(token_method, dict(cur))
+                    it = gen.__aiter__()
+                    while True:
                         # stall watchdog (ISSUE 9): a HUNG replica
                         # (wedged loop, stuck device call) never
                         # raises — without this bound the stream,
                         # its admission slot, and the client would
-                        # strand forever even after eviction
-                        chunk = await asyncio.wait_for(
-                            it.__anext__(),
+                        # strand forever even after eviction.
+                        # DELIBERATELY not wait_for (ISSUE 12): a
+                        # timeout must NOT cancel into the replica's
+                        # generator — that would abort the engine
+                        # request (dropping any parked session)
+                        # before the failover-by-restore handler
+                        # below gets a chance to export it; the
+                        # pending read is cancelled in the finally,
+                        # after the restore decision.
+                        anext_task = asyncio.ensure_future(
+                            it.__anext__())
+                        done, _ = await asyncio.wait(
+                            {anext_task},
                             timeout=self.health.stream_stall_timeout_s)
-                    except StopAsyncIteration:
-                        # ended without a finish chunk: the transport
-                        # died quietly — same failover path as a
-                        # loud failure
-                        raise failover.StreamBroken(
-                            f"token stream from {rid} ended "
-                            f"without finish")
-                    except asyncio.TimeoutError:
-                        raise failover.StreamStalled(
-                            f"no chunk from {rid} within "
-                            f"{self.health.stream_stall_timeout_s}s")
-                    folded = transcript.fold(chunk)
-                    if folded is None:
-                        continue                 # replayed: dedup'd
-                    toks, text, fin, reason = folded
-                    model = chunk.get("model") or model
-                    yield failover.sse_chunk(
-                        is_chat, cid, model, created, text, fin,
-                        reason, toks)
-                    if fin:
-                        if reason == "deadline":
-                            self._count_deadline_shed("engine")
-                        yield "data: [DONE]\n\n"
-                        return
-            except (GeneratorExit, asyncio.CancelledError):
-                raise                # client gone: nothing to fail over
-            except AdmissionRejected:
-                raise
-            except Exception as exc:
-                if not self._should_failover(rid, "stream", exc,
-                                             attempts):
+                        if not done:
+                            raise failover.StreamStalled(
+                                f"no chunk from {rid} within "
+                                f"{self.health.stream_stall_timeout_s}"
+                                f"s")
+                        t, anext_task = anext_task, None
+                        try:
+                            chunk = t.result()
+                        except StopAsyncIteration:
+                            # ended without a finish chunk: the
+                            # transport died quietly — same failover
+                            # path as a loud failure
+                            raise failover.StreamBroken(
+                                f"token stream from {rid} ended "
+                                f"without finish")
+                        folded = transcript.fold(chunk)
+                        if folded is None:
+                            continue             # replayed: dedup'd
+                        toks, text, fin, reason = folded
+                        model = chunk.get("model") or model
+                        if fin and reason == "migrated":
+                            # live migration marker (ISSUE 12): the
+                            # session left this replica mid-stream —
+                            # the logical stream is NOT finished.
+                            # Tokens riding the marker (the export's
+                            # drain can fold a not-yet-evented token
+                            # into it) were folded into the
+                            # transcript above, and the resume offset
+                            # starts AT the transcript head — so they
+                            # must reach the client NOW or they would
+                            # be silently skipped
+                            if toks or text:
+                                yield failover.sse_chunk(
+                                    is_chat, cid, model, created,
+                                    text, False, None, toks)
+                            transcript.finished = False
+                            transcript.reason = None
+                            migrated = True
+                            break
+                        yield failover.sse_chunk(
+                            is_chat, cid, model, created, text, fin,
+                            reason, toks)
+                        if fin:
+                            if reason == "deadline":
+                                self._count_deadline_shed("engine")
+                            yield "data: [DONE]\n\n"
+                            await self._prefix_publish(fp, body, st)
+                            return
+                except (GeneratorExit, asyncio.CancelledError):
+                    raise            # client gone: nothing to fail over
+                except AdmissionRejected:
                     raise
-                attempts += 1
-                self.recorder.record(
-                    "failover", mode="stream", replica=rid,
-                    request_id=str(body.get("_request_id")),
-                    tokens_delivered=len(transcript.tokens),
-                    attempt=attempts, error=repr(exc))
-                cur = failover.continuation_body(body, transcript)
-            finally:
-                st.inflight -= 1
-                if gen is not None:
-                    # close the attempt's generator (a stalled one is
-                    # abandoned mid-chunk): the replica side aborts
-                    # its engine request like a real disconnect
-                    await failover.close_quietly(gen)
+                except TransportError as exc:
+                    # a corrupted/stale shipped payload landing on a
+                    # HEALTHY replica: not the replica's fault (no
+                    # breaker food, no failover budget) — degrade to
+                    # the PR 9 replay continuation
+                    self.recorder.record(
+                        "kv_resume_failed", replica=rid,
+                        request_id=srid, error=repr(exc))
+                    cur = failover.continuation_body(body, transcript)
+                except Exception as exc:
+                    if resumed and failover.is_request_fault(exc):
+                        # the import was REJECTED (id collision,
+                        # incompatible geometry): same degradation as
+                        # a corrupted payload
+                        self.recorder.record(
+                            "kv_resume_failed", replica=rid,
+                            request_id=srid, error=repr(exc))
+                        cur = failover.continuation_body(
+                            body, transcript)
+                    elif not self._should_failover(rid, "stream", exc,
+                                                   attempts):
+                        raise
+                    else:
+                        attempts += 1
+                        self.recorder.record(
+                            "failover", mode="stream", replica=rid,
+                            request_id=srid,
+                            tokens_delivered=len(transcript.tokens),
+                            attempt=attempts, error=repr(exc))
+                        # failover-by-restore fast path (ISSUE 12):
+                        # if the victim can still hand the session
+                        # over (pages already spilled, or only the
+                        # stream is wedged), resume beats replay —
+                        # NOTE: runs before the finally closes the
+                        # attempt generator, i.e. before the victim's
+                        # server aborts the engine request
+                        session = await self._restore_handoff(
+                            rid, srid)
+                        if session is None:
+                            cur = failover.continuation_body(
+                                body, transcript)
+                finally:
+                    st.inflight -= 1
+                    if anext_task is not None:
+                        # the stalled read abandoned above — cancel
+                        # it NOW (after the restore decision): the
+                        # replica-side generator unwinds and aborts
+                        # its engine request like a real disconnect
+                        # (a no-op if the session was just exported:
+                        # the request is already finished "migrated")
+                        anext_task.cancel()
+                        try:
+                            await anext_task
+                        except (asyncio.CancelledError, Exception):
+                            pass
+                    if gen is not None:
+                        # close the attempt's generator (a stalled one
+                        # is abandoned mid-chunk): the replica side
+                        # aborts its engine request like a real
+                        # disconnect
+                        await failover.close_quietly(gen)
+                if migrated:
+                    # the marker is enqueued inside the victim's
+                    # export call, so this relay can observe it a few
+                    # scheduler turns before the orchestrator's
+                    # `_migrations[srid] = payload` bookkeeping runs —
+                    # give that assignment a bounded grace before
+                    # declaring the ship lost
+                    session = self._migrations.pop(srid, None)
+                    for _ in range(100):
+                        if session is not None:
+                            break
+                        await asyncio.sleep(0.01)
+                        session = self._migrations.pop(srid, None)
+                    if session is None:
+                        # the ship was lost mid-migration (severed
+                        # export, crashed orchestrator): PR 9 replay
+                        self.recorder.record(
+                            "migration_lost", request_id=srid)
+                        cur = failover.continuation_body(
+                            body, transcript)
+        finally:
+            self._live_streams.pop(srid, None)
+            self._migrations.pop(srid, None)
+
+    # -- fleet KV transport (ISSUE 12) ----------------------------------
+    def _ship_span(self, name: str, replica: str, t0: float,
+                   request_id: Optional[str] = None,
+                   **args: Any) -> None:
+        """One KV-transport span into the ingress trace buffer —
+        migrations/handoffs show up in GET /fleet/debug/trace beside
+        the request lifecycles they interrupt."""
+        if not self.enable_tracing:
+            return
+        self.trace.add(tracing.complete_event(
+            name, "kv_transport", tracing.mono_to_epoch(t0),
+            time.monotonic() - t0, tid=0,
+            args={"replica": replica,
+                  **({"request_id": request_id} if request_id
+                     else {}),
+                  **args}))
+
+    def _pick_prefill(self) -> Optional[_ReplicaState]:
+        """Least-loaded healthy ACTIVE prefill-role replica, or None
+        (disaggregation silently degrades to mixed prefill)."""
+        cands = [st for st in self.replicas.values()
+                 if st.status == ACTIVE
+                 and st.role == kv_transport.ROLE_PREFILL
+                 and st.breaker.state == failover.CLOSED]
+        if not cands:
+            return None
+        return min(cands, key=lambda st: (st.inflight,
+                                          st.client.replica_id))
+
+    def _disagg_applies(self, body: Dict[str, Any]) -> bool:
+        t = self.transport
+        return (t is not None and t.enable_disagg
+                and kv_transport.prompt_char_len(body)
+                >= t.disagg_prompt_chars
+                and self._pick_prefill() is not None)
+
+    async def _prefill_handoff(self, body: Dict[str, Any],
+                               is_chat: bool
+                               ) -> "Optional[tuple]":
+        """Disaggregated prefill (ISSUE 12a): run the long prompt on
+        a prefill replica and take the parked session for a decode
+        replica to resume. -> ("session", payload) | ("final",
+        chunk) when the request finished during prefill | None on
+        any failure (the caller falls back to mixed prefill — the
+        pre-transport path, always correct)."""
+        st = self._pick_prefill()
+        if st is None:
+            return None
+        rid = st.client.replica_id
+        pbody = dict(body)
+        pbody["_chat"] = is_chat
+        st.inflight += 1
+        st.requests_total += 1
+        t0 = time.monotonic()
+        try:
+            # bound generously: a cold prefill replica may be
+            # compiling — the same reasoning as the stall timeout
+            out = await asyncio.wait_for(
+                st.client.call("prefill_export", pbody),
+                max(self.transport.ship_timeout_s,
+                    self.health.stream_stall_timeout_s))
+        except (AdmissionRejected, asyncio.CancelledError):
+            raise
+        except Exception as exc:
+            if not failover.is_request_fault(exc):
+                self._note_replica_failure(
+                    rid, f"prefill:{type(exc).__name__}",
+                    hard=not isinstance(exc, asyncio.TimeoutError))
+            self.recorder.record("disagg_fallback", replica=rid,
+                                 error=repr(exc))
+            return None
+        finally:
+            st.inflight -= 1
+        if out and out.get("final"):
+            self._ship_span("disagg_prefill_final", rid, t0,
+                            str(body.get("_request_id")))
+            return ("final", out["final"])
+        payload = (out or {}).get("session")
+        if not payload:
+            self.recorder.record("disagg_fallback", replica=rid,
+                                 error="not exportable")
+            return None
+        tags = {"model": self.model_id}
+        self.kvt_metrics["sessions_shipped"].inc(
+            1, {**tags, "kind": "disagg"})
+        self.kvt_metrics["ship_bytes"].inc(
+            int(out.get("bytes") or 0),
+            {**tags, "direction": "export"})
+        self._ship_span("disagg_prefill", rid, t0,
+                        str(body.get("_request_id")),
+                        bytes=int(out.get("bytes") or 0),
+                        pages=out.get("pages"))
+        self.recorder.record(
+            "disagg_handoff", replica=rid,
+            request_id=str(body.get("_request_id")),
+            bytes=out.get("bytes"), pages=out.get("pages"),
+            generated=out.get("generated"))
+        return ("session", payload)
+
+    async def _restore_handoff(self, victim: str, srid: str
+                               ) -> Optional[str]:
+        """Failover-by-restore (ISSUE 12b): a pre-shipped payload
+        (drain migration raced the failure) or a live export off the
+        failing replica — which succeeds exactly when the victim can
+        still serve control calls (pages already spilled to its host
+        tier, or only the stream plane is wedged). None -> the
+        caller replays (PR 9), token-exact either way."""
+        t = self.transport
+        if t is None or not t.enable_migration:
+            return None
+        pend = self._migrations.pop(srid, None)
+        if pend is not None:
+            return pend
+        st = self.replicas.get(victim)
+        if st is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            out = await asyncio.wait_for(
+                st.client.call("export_session",
+                               {"request_id": srid,
+                                "reason": "failover"}),
+                t.ship_timeout_s)
+        except Exception:
+            return None
+        payload = (out or {}).get("session")
+        if not payload:
+            return None
+        tags = {"model": self.model_id}
+        self.kvt_metrics["sessions_shipped"].inc(
+            1, {**tags, "kind": "restore"})
+        self.kvt_metrics["ship_bytes"].inc(
+            int(out.get("bytes") or 0), {**tags,
+                                         "direction": "export"})
+        self._ship_span("failover_restore", victim, t0, srid,
+                        bytes=int(out.get("bytes") or 0))
+        self.recorder.record("failover_restore", replica=victim,
+                             request_id=srid,
+                             bytes=out.get("bytes"),
+                             pages=out.get("pages"))
+        return payload
+
+    async def _migrate_sessions_off(self, rid: str) -> int:
+        """Drain migration (ISSUE 12b): export every relay-driven
+        stream this replica is serving; each stream's relay sees the
+        "migrated" finish marker, claims its payload here, and
+        resumes on a ring replica — tokens ship as pages, not
+        replays. Best-effort per session: anything that fails to
+        export just finishes on the draining replica like before."""
+        t = self.transport
+        st = self.replicas.get(rid)
+        if t is None or not t.enable_migration or st is None:
+            return 0
+        moved = 0
+        for srid, info in list(self._live_streams.items()):
+            if info.get("replica") != rid \
+                    or srid in self._migrations:
+                continue
+            t0 = time.monotonic()
+            try:
+                out = await asyncio.wait_for(
+                    st.client.call("export_session",
+                                   {"request_id": srid,
+                                    "reason": "drain"}),
+                    t.ship_timeout_s)
+            except Exception as exc:
+                self.recorder.record("migration_failed", replica=rid,
+                                     request_id=srid,
+                                     error=repr(exc))
+                continue
+            payload = (out or {}).get("session")
+            if not payload:
+                continue
+            self._migrations[srid] = payload
+            moved += 1
+            tags = {"model": self.model_id}
+            self.kvt_metrics["sessions_shipped"].inc(
+                1, {**tags, "kind": "migration"})
+            self.kvt_metrics["ship_bytes"].inc(
+                int(out.get("bytes") or 0),
+                {**tags, "direction": "export"})
+            self._ship_span("session_migration", rid, t0, srid,
+                            bytes=int(out.get("bytes") or 0),
+                            pages=out.get("pages"))
+            self.recorder.record(
+                "session_migrated", replica=rid, request_id=srid,
+                bytes=out.get("bytes"), pages=out.get("pages"),
+                generated=out.get("generated"))
+        return moved
+
+    def _prefix_eligible(self, body: Dict[str, Any]) -> bool:
+        t = self.transport
+        if t is None or not t.enable_prefix_store \
+                or self.prefix_store is None:
+            return False
+        if body.get("prompt") is None:
+            # chat renderings are template-specific; the plain-prompt
+            # prefix is the one chain both tokenizer paths share
+            return False
+        depth = self.router.config.prefix_depth
+        return len(str(body["prompt"])[:depth]) >= t.prefix_min_chars
+
+    async def _prefix_seed(self, fp: str, body: Dict[str, Any],
+                           st: _ReplicaState) -> None:
+        """Seed the routed replica with a published prefix it has not
+        prefilled itself (ISSUE 12c) — best-effort and once per
+        replica per fingerprint."""
+        if not self._prefix_eligible(body):
+            return
+        ent = self.prefix_store.get(fp)
+        rid = st.client.replica_id
+        if ent is None or rid in ent.seeded:
+            return
+        t0 = time.monotonic()
+        try:
+            out = await asyncio.wait_for(
+                st.client.call("import_prefix",
+                               {"prefix": ent.payload}),
+                self.transport.ship_timeout_s)
+        except Exception as exc:
+            self.recorder.record("prefix_seed_failed", replica=rid,
+                                 error=repr(exc))
+            return
+        ent.seeded.add(rid)
+        if (out or {}).get("pages"):
+            self.prefix_store.hits += 1
+            tags = {"model": self.model_id}
+            self.kvt_metrics["prefix_store_hits"].inc(1, tags)
+            self.kvt_metrics["ship_bytes"].inc(
+                len(ent.payload) * 3 // 4,
+                {**tags, "direction": "import"})
+            self._ship_span("prefix_seed", rid, t0,
+                            pages=out["pages"], fp=fp[:12])
+            self.recorder.record("prefix_seeded", replica=rid,
+                                 pages=out["pages"], fp=fp[:12])
+
+    async def _prefix_publish(self, fp: str, body: Dict[str, Any],
+                              st: _ReplicaState) -> None:
+        """Publish a served prefix into the fleet store (ISSUE 12c)
+        — ATTEMPTED once per fingerprint (success or not: a workload
+        of distinct prompts must not pay an export round-trip on
+        every response), exported from the replica that just
+        (cheaply, cache-hot) served it."""
+        if not self._prefix_eligible(body) \
+                or fp in self.prefix_store \
+                or fp in self._prefix_attempted:
+            return
+        self._prefix_attempted.add(fp)
+        depth = self.router.config.prefix_depth
+        text = str(body["prompt"])[:depth]
+        rid = st.client.replica_id
+        t0 = time.monotonic()
+        try:
+            out = await asyncio.wait_for(
+                st.client.call("export_prefix", {"text": text}),
+                self.transport.ship_timeout_s)
+        except Exception as exc:
+            self.recorder.record("prefix_publish_failed",
+                                 replica=rid, error=repr(exc))
+            return
+        payload = (out or {}).get("prefix")
+        if not payload:
+            return
+        self.prefix_store.put(fp, payload,
+                              tokens=int(out.get("tokens") or 0),
+                              publisher=rid)
+        self.kvt_metrics["ship_bytes"].inc(
+            int(out.get("bytes") or 0),
+            {"model": self.model_id, "direction": "export"})
+        self._ship_span("prefix_publish", rid, t0,
+                        tokens=out.get("tokens"), fp=fp[:12])
+        self.recorder.record("prefix_published", replica=rid,
+                             tokens=out.get("tokens"), fp=fp[:12])
 
     # -- health state machine (ISSUE 9) ---------------------------------
     def _set_breaker_gauge(self, rid: str) -> None:
@@ -597,13 +1106,22 @@ class FleetManager:
         st = self.replicas[rid]
         if st.status != ACTIVE:
             return                 # draining/standby: not in the ring
-        if not [r for r in self._ids(ACTIVE) if r != rid]:
-            # the SOLE active replica: activate a standby replacement
+        if rid in self._ring_ids() \
+                and not [r for r in self._ring_ids() if r != rid]:
+            # the SOLE ring replica: activate a standby replacement
             # if one exists — spare healthy capacity must not idle
             # while everything routes to a dead replica. With no
             # standby either, defer: the breaker still gates
             # recovery, but an empty ring would be a total blackout.
-            standby = self._ids(STANDBY)
+            # (An evicted PREFILL replica never empties the ring —
+            # disaggregation just falls back to mixed prefill.)
+            # The replacement must itself be decode-capable: swapping
+            # the last ring replica for a prefill-role standby would
+            # leave the ring empty — the exact blackout this branch
+            # exists to prevent
+            standby = [r for r in self._ids(STANDBY)
+                       if self.replicas[r].role
+                       != kv_transport.ROLE_PREFILL]
             if not standby:
                 self.recorder.record("eviction_deferred", replica=rid,
                                      reason=reason)
@@ -616,7 +1134,7 @@ class FleetManager:
                 {"ts": time.time(), "event": "activate",
                  "replica": sub, "reason": f"replacing:{rid}"})
         st.status = UNHEALTHY
-        self.router.set_replicas(self._ids(ACTIVE))
+        self._sync_ring()
         self.metrics["evictions"].inc(1, {"model": self.model_id})
         self.recorder.record("replica_evicted", replica=rid,
                              reason=reason,
@@ -654,7 +1172,7 @@ class FleetManager:
         if st.status != UNHEALTHY:
             return
         st.status = ACTIVE
-        self.router.set_replicas(self._ids(ACTIVE))
+        self._sync_ring()
         self.recorder.record("replica_readmitted", replica=rid,
                              trips=st.breaker.trips)
         self._scale_events.append(
@@ -883,9 +1401,22 @@ class FleetManager:
                        if st.snapshot is not None else 0.0)
                 return (st.inflight, occ)
 
-            for rid in sorted(active, key=cost)[:len(active) - target]:
+            chosen: List[str] = []
+            for rid in sorted(active, key=cost):
+                if len(chosen) >= len(active) - target:
+                    break
+                # never drain the LAST decode-capable replica: an
+                # idle mixed replica must not be sacrificed while
+                # prefill-role replicas (which can never serve ring
+                # traffic) stay ACTIVE — that would empty the ring
+                if rid in self._ring_ids() and not [
+                        r for r in self._ring_ids()
+                        if r != rid and r not in chosen]:
+                    continue
+                chosen.append(rid)
+            for rid in chosen:
                 self._begin_drain(rid)
-        self.router.set_replicas(self._ids(ACTIVE))
+        self._sync_ring()
 
     def _begin_drain(self, rid: str) -> None:
         st = self.replicas[rid]
@@ -906,6 +1437,15 @@ class FleetManager:
         attempt = 0
         while True:
             deadline = time.monotonic() + timeout_s
+            # KV transport (ISSUE 12): ship the replica's live
+            # sessions to the survivors FIRST — their relays resume
+            # from restored pages instead of replaying tokens, and
+            # the in-flight count below drops as each relay moves off
+            moved = await self._migrate_sessions_off(rid)
+            if moved:
+                self._scale_events.append(
+                    {"ts": time.time(), "event": "drain_migrate",
+                     "replica": rid, "sessions": moved})
             while st.inflight > 0 and time.monotonic() < deadline:
                 await asyncio.sleep(0.02)
             drained = True
@@ -1027,6 +1567,7 @@ class FleetManager:
             snap = st.snapshot
             reps[rid] = {
                 "status": st.status,
+                "role": st.role,
                 "inflight": st.inflight,
                 "requests_total": st.requests_total,
                 "breaker": st.breaker.stats(),
@@ -1043,6 +1584,10 @@ class FleetManager:
                     "page_pressure": round(snap.page_pressure, 4),
                     "parked_sessions": snap.parked,
                     "kv_offload": snap.spillable,
+                    # ISSUE 12 satellite: host-tier byte occupancy —
+                    # migration/prefix-store pressure before page
+                    # counts saturate
+                    "kv_host_bytes_used": snap.kv_host_bytes,
                     # perf accounting (ISSUE 11): recent utilization
                     # against the replica's hardware envelope
                     "mfu": round(snap.mfu, 6),
@@ -1074,6 +1619,21 @@ class FleetManager:
             "tracing": {
                 "enabled": self.enable_tracing,
                 "ingress_buffer": self.trace.stats(),
+            },
+            # fleet KV transport (ISSUE 12)
+            "transport": {
+                "enabled": self.transport is not None,
+                **({} if self.transport is None else {
+                    "roles": {rid: st.role
+                              for rid, st in self.replicas.items()},
+                    "disagg": self.transport.enable_disagg,
+                    "migration": self.transport.enable_migration,
+                    "live_streams": len(self._live_streams),
+                    "pending_migrations": len(self._migrations),
+                    "prefix_store": (
+                        self.prefix_store.stats()
+                        if self.prefix_store is not None else None),
+                }),
             },
             "recorder": self.recorder.stats(),
             "health": {

@@ -1,0 +1,388 @@
+"""Fleet KV transport: KV pages as a fleet-level currency (ISSUE 12).
+
+PR 10 made KV pages *movable* (`ParkedSequence`: position/last_token/
+seed + host page arrays) but they never left a replica. This module is
+the shipping layer that ROADMAP item 2 scopes on top of it — ONE
+versioned, checksummed wire format plus the fleet-side policy objects,
+with three consumers layered on the same transport:
+
+(a) **Disaggregated prefill/decode** — `FleetConfig.replica_roles`
+    marks replicas `prefill` / `decode` / `mixed`; the fleet relay
+    sends long prompts to a prefill replica (`prefill_export`), which
+    runs the prompt, parks the session via the PR 10 spill path, and
+    hands the pages to a decode replica that resumes via
+    `resume_stream_tokens` → `engine.import_session` →
+    `_restore_parked`. Token-exact vs a single-engine oracle (the
+    per-request sampling key is fold_in(seed, absolute index), and
+    restored pages are bit-exact copies), so long-prompt bursts stop
+    inflating decode ITL without any correctness tax (the
+    DistServe-style split; Gemma-on-TPU serving study, PAPERS.md).
+
+(b) **Live session migration** — drain-before-downscale ships parked
+    sessions instead of replaying tokens (`FleetManager.
+    _migrate_sessions_off`), and PR 9's failover-by-replay gains a
+    failover-by-restore fast path: when a failing replica can still
+    export the session (its pages were already spilled, or only the
+    stream — not the engine — is wedged), the fleet restores on a
+    healthy replica instead of re-prefilling the whole transcript.
+
+(c) **Fleet prefix store** — `FleetPrefixStore` promotes the
+    per-replica prefix cache to a fleet-shared tier keyed by prefix
+    fingerprint: a system prompt prefilled ONCE is exported
+    (`export_prefix`) into the store and seeded into every replica
+    that later serves the prefix (`import_prefix` →
+    `allocator.register_prefix`), multiplying PR 6's per-replica
+    prefix-cache hit rate by fleet size.
+
+Wire format (`encode_session`/`decode_session`, `encode_prefix`/
+`decode_prefix` — both ride `_encode_frame`):
+
+    b"RTKV" | u16 version | u32 header_len | header JSON |
+    raw array bytes (C order, concatenated) | u32 crc32
+
+The crc32 covers every byte before it; arrays round-trip BYTE-exact
+(dtype + shape recorded in the header, bfloat16 et al. resolved via
+ml_dtypes). A corrupted or truncated payload raises
+`TransportChecksumError` / `TransportError` — consumers treat that as
+"this ship failed" and fall back to the PR 9 replay path, never as a
+crash (the serialization property test drives both).
+
+Everything here is host-side: numpy + stdlib, no jax, no device work
+(the dispatch-guard suite runs with the transport active). The engine
+side (`export_session` / `import_session` / `export_prefix` /
+`import_prefix`, built on `preempt()` / `_restore_parked`) lives in
+llm/_internal/engine.py; the HTTP surface in llm/_internal/server.py;
+the orchestration in fleet.py.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import struct
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...util import metrics as metrics_api
+
+MAGIC = b"RTKV"
+WIRE_VERSION = 1
+
+
+class TransportError(RuntimeError):
+    """A payload that cannot be decoded (bad magic, truncation,
+    unknown version, malformed header). The consumer falls back to
+    token replay — this is a failed SHIP, never a crash."""
+
+
+class TransportChecksumError(TransportError):
+    """The payload's crc32 does not match its content: corruption in
+    flight. Same fallback contract as TransportError."""
+
+
+def _dtype(name: str) -> np.dtype:
+    """Resolve a recorded dtype name, including the ml_dtypes family
+    (bfloat16, float8_*) numpy alone cannot name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise TransportError(f"unknown array dtype {name!r}")
+
+
+def _encode_frame(kind: str, meta: Dict[str, Any],
+                  arrays: Sequence[Tuple[str, np.ndarray]]) -> bytes:
+    """One wire frame. The header is pure JSON (kind, meta, and per-
+    array name/dtype/shape/nbytes); array payloads follow in header
+    order as raw C-contiguous bytes; the trailing crc32 covers every
+    byte before it."""
+    blobs: List[bytes] = []
+    adesc: List[Dict[str, Any]] = []
+    for name, arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        adesc.append({"name": name, "dtype": arr.dtype.name,
+                      "shape": list(arr.shape), "nbytes": len(raw)})
+        blobs.append(raw)
+    header = json.dumps({"kind": kind, "meta": meta,
+                         "arrays": adesc},
+                        sort_keys=True).encode("utf-8")
+    body = (MAGIC + struct.pack("<HI", WIRE_VERSION, len(header))
+            + header + b"".join(blobs))
+    return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def _decode_frame(blob: bytes, expect_kind: Optional[str] = None
+                  ) -> Tuple[str, Dict[str, Any],
+                             Dict[str, np.ndarray]]:
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise TransportError(
+            f"payload must be bytes, got {type(blob).__name__}")
+    blob = bytes(blob)
+    if len(blob) < len(MAGIC) + 6 + 4:
+        raise TransportError("payload truncated (shorter than the "
+                             "fixed frame header)")
+    if blob[:4] != MAGIC:
+        raise TransportError("bad magic (not a KV transport frame)")
+    body, (crc,) = blob[:-4], struct.unpack("<I", blob[-4:])
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise TransportChecksumError(
+            "payload checksum mismatch (corrupted in flight)")
+    version, hlen = struct.unpack("<HI", blob[4:10])
+    if version != WIRE_VERSION:
+        raise TransportError(
+            f"unsupported wire version {version} "
+            f"(this build speaks {WIRE_VERSION})")
+    if 10 + hlen > len(body):
+        raise TransportError("payload truncated (header)")
+    try:
+        header = json.loads(body[10:10 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise TransportError(f"malformed frame header: {e!r}")
+    kind = header.get("kind")
+    if expect_kind is not None and kind != expect_kind:
+        raise TransportError(
+            f"frame kind {kind!r}, expected {expect_kind!r}")
+    arrays: Dict[str, np.ndarray] = {}
+    off = 10 + hlen
+    for d in header.get("arrays") or []:
+        try:
+            dt, n = _dtype(str(d["dtype"])), int(d["nbytes"])
+            if off + n > len(body):
+                raise TransportError("payload truncated (array body)")
+            arrays[str(d["name"])] = np.frombuffer(
+                body[off:off + n], dtype=dt).reshape(
+                    [int(x) for x in d["shape"]])
+        except TransportError:
+            raise
+        except (ValueError, TypeError, KeyError) as e:
+            # a crc-valid frame whose header lies about its arrays
+            # (nbytes not a dtype multiple, shape/size mismatch,
+            # missing fields) is still a BAD PAYLOAD — consumers key
+            # their fall-back-to-replay contract on TransportError
+            raise TransportError(f"malformed array descriptor: {e!r}")
+        off += n
+    if off != len(body):
+        raise TransportError("payload has trailing bytes past the "
+                             "declared arrays")
+    return str(kind), dict(header.get("meta") or {}), arrays
+
+
+# -- session payloads ---------------------------------------------------
+
+_SESSION_META_KEYS = (
+    "request_id", "prompt_tokens", "output_tokens", "params", "lora",
+    "priority", "restarts", "trace", "deadline_epoch", "seed",
+    "position", "last_token", "n_pages")
+
+
+def encode_session(state: Dict[str, Any]) -> bytes:
+    """engine.export_session state dict → wire bytes. The KV arrays
+    ride raw; everything else (identity, sampling params, decode
+    invariant) is JSON metadata."""
+    meta = {k: state.get(k) for k in _SESSION_META_KEYS}
+    arrays: List[Tuple[str, np.ndarray]] = []
+    if state.get("k") is not None:
+        arrays = [("k", state["k"]), ("v", state["v"])]
+    return _encode_frame("session", meta, arrays)
+
+
+def decode_session(blob: bytes) -> Dict[str, Any]:
+    """Wire bytes → the state dict engine.import_session consumes.
+    Raises TransportError/TransportChecksumError on a bad payload."""
+    _, meta, arrays = _decode_frame(blob, expect_kind="session")
+    state = dict(meta)
+    state["k"] = arrays.get("k")
+    state["v"] = arrays.get("v")
+    if (state["k"] is None) != (state["v"] is None):
+        raise TransportError("session frame carries only one of k/v")
+    if int(state.get("n_pages") or 0) > 0 and state["k"] is None:
+        raise TransportError("warm session frame is missing its KV "
+                             "page arrays")
+    return state
+
+
+def encode_prefix(tokens: Sequence[int], k: np.ndarray,
+                  v: np.ndarray) -> bytes:
+    """engine.export_prefix output → wire bytes (the fleet prefix
+    store's stored value)."""
+    return _encode_frame("prefix", {"tokens": [int(t) for t in tokens]},
+                         [("k", k), ("v", v)])
+
+
+def decode_prefix(blob: bytes
+                  ) -> Tuple[List[int], np.ndarray, np.ndarray]:
+    _, meta, arrays = _decode_frame(blob, expect_kind="prefix")
+    if "k" not in arrays or "v" not in arrays:
+        raise TransportError("prefix frame is missing its KV arrays")
+    return ([int(t) for t in meta.get("tokens") or []],
+            arrays["k"], arrays["v"])
+
+
+def to_b64(blob: bytes) -> str:
+    """Payloads cross replica boundaries inside JSON-ish bodies; b64
+    keeps them transport-safe on every client flavor."""
+    return base64.b64encode(blob).decode("ascii")
+
+
+def from_b64(payload: str) -> bytes:
+    try:
+        return base64.b64decode(payload, validate=True)
+    except Exception as e:
+        raise TransportError(f"payload is not valid base64: {e!r}")
+
+
+def prompt_char_len(body: Dict[str, Any]) -> int:
+    """Prompt length in characters — the disaggregation trigger reads
+    the same canonical text prefix_fingerprint hashes (prompt string,
+    or the role-tagged chat rendering)."""
+    if body.get("prompt") is not None:
+        return len(str(body["prompt"]))
+    return sum(len(str(m.get("role", ""))) + len(str(m.get("content",
+                                                           "")))
+               for m in (body.get("messages") or []))
+
+
+# -- fleet policy -------------------------------------------------------
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_MIXED = "mixed"
+REPLICA_ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED)
+
+
+@dataclasses.dataclass
+class TransportConfig:
+    """Fleet KV-shipping policy (FleetConfig.transport; None = the
+    transport is off and the fleet behaves exactly like PR 11)."""
+    # (a) disaggregated prefill/decode: prompts at least this many
+    # characters long are prefilled on a `prefill`-role replica and
+    # handed to a decode replica (no-op without prefill replicas)
+    enable_disagg: bool = True
+    disagg_prompt_chars: int = 256
+    # (b) live migration: drain-before-downscale ships parked
+    # sessions instead of replaying, and stream failover tries an
+    # export-restore fast path before falling back to PR 9 replay
+    enable_migration: bool = True
+    # (c) fleet prefix store: prompts whose ROUTER-DEPTH prefix is at
+    # least this long are published once and seeded into every
+    # replica that serves the prefix
+    enable_prefix_store: bool = True
+    prefix_min_chars: int = 64
+    prefix_store_bytes: int = 256 << 20
+    # bound on every export/import control call (a wedged replica
+    # must not stall a drain or a failover decision)
+    ship_timeout_s: float = 10.0
+
+
+@dataclasses.dataclass
+class _PrefixEntry:
+    payload: str                 # b64 wire frame (encode_prefix)
+    nbytes: int
+    tokens: int                  # full-page token count stored
+    publisher: str               # replica that exported it
+    seeded: set = dataclasses.field(default_factory=set)
+
+
+class FleetPrefixStore:
+    """Fleet-shared prefix tier: prefix fingerprint → serialized full
+    prompt pages, LRU-bounded by bytes. Lives in the ingress process
+    (one per FleetManager); replicas are SEEDED lazily — the first
+    time the router lands a stored prefix on a replica that has not
+    seen it, the fleet imports the pages there before dispatching, so
+    the replica's own prefix cache hits exactly as if it had
+    prefilled the prompt itself."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20):
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: "OrderedDict[str, _PrefixEntry]" = OrderedDict()
+        self.bytes_used = 0
+        self.publishes = 0
+        self.hits = 0                # imports that seeded a replica
+        self.evictions = 0
+
+    def __contains__(self, fp: str) -> bool:
+        return fp in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, fp: str) -> Optional[_PrefixEntry]:
+        ent = self._entries.get(fp)
+        if ent is not None:
+            self._entries.move_to_end(fp)
+        return ent
+
+    def put(self, fp: str, payload: str, tokens: int,
+            publisher: str) -> Optional[_PrefixEntry]:
+        """Store one published prefix (publisher counts as seeded).
+        Oversized payloads are refused rather than thrashing the
+        whole store."""
+        if fp in self._entries:
+            return self._entries[fp]
+        nbytes = len(payload)
+        if nbytes > self.capacity_bytes:
+            return None
+        while self.bytes_used + nbytes > self.capacity_bytes \
+                and self._entries:
+            _, old = self._entries.popitem(last=False)
+            self.bytes_used -= old.nbytes
+            self.evictions += 1
+        ent = _PrefixEntry(payload=payload, nbytes=nbytes,
+                           tokens=tokens, publisher=publisher,
+                           seeded={publisher})
+        self._entries[fp] = ent
+        self.bytes_used += nbytes
+        self.publishes += 1
+        return ent
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "entries": len(self._entries),
+            "bytes_used": self.bytes_used,
+            "capacity_bytes": self.capacity_bytes,
+            "publishes": self.publishes,
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "seeded_replicas": sorted(
+                {r for e in self._entries.values() for r in e.seeded}),
+        }
+
+
+def transport_metrics() -> Dict[str, Any]:
+    """The fleet transport metric families, registered idempotently
+    in the ingress process registry (same pattern as the failure
+    plane's fleet_metrics)."""
+    C = metrics_api.Counter
+    return {
+        "sessions_shipped": C(
+            "ray_tpu_llm_kv_sessions_shipped_total",
+            "parked sessions shipped between replicas, by consumer "
+            "(disagg | migration | restore)", ("model", "kind")),
+        "ship_bytes": C(
+            "ray_tpu_llm_kv_ship_bytes_total",
+            "serialized KV transport bytes, by direction (export = "
+            "off a replica, import = onto one)",
+            ("model", "direction")),
+        "prefix_store_hits": C(
+            "ray_tpu_llm_prefix_store_hits_total",
+            "fleet prefix-store entries seeded into a replica that "
+            "had not prefilled the prefix itself", ("model",)),
+    }
+
+
+__all__ = [
+    "TransportError", "TransportChecksumError", "TransportConfig",
+    "FleetPrefixStore", "transport_metrics",
+    "encode_session", "decode_session", "encode_prefix",
+    "decode_prefix", "to_b64", "from_b64", "prompt_char_len",
+    "WIRE_VERSION", "MAGIC",
+    "ROLE_PREFILL", "ROLE_DECODE", "ROLE_MIXED", "REPLICA_ROLES",
+]

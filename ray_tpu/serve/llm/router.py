@@ -143,6 +143,10 @@ class ReplicaSnapshot:
     page_pressure: float = 0.0
     parked: int = 0
     spillable: bool = False
+    # ISSUE 12 satellite: host-tier BYTE occupancy beside the page
+    # count — migration / prefix-store byte pressure surfaces in the
+    # /fleet rows before page counts saturate
+    kv_host_bytes: int = 0
     # per-dispatch perf accounting (ISSUE 11): the replica's recent
     # MFU/MBU against its hardware envelope, phase goodput, and which
     # roof binds — surfaced in /fleet rows and the fleet gauges
@@ -176,6 +180,7 @@ class ReplicaSnapshot:
             page_pressure=float(stats.get("page_pressure", 0.0)),
             parked=int(stats.get("parked_sessions", 0)),
             spillable=bool(stats.get("kv_offload", False)),
+            kv_host_bytes=int(stats.get("kv_host_bytes_used", 0)),
             mfu=float(perf.get("mfu", 0.0)),
             mbu=float(perf.get("mbu", 0.0)),
             decode_tps=float(perf.get("decode_tokens_per_s", 0.0)),

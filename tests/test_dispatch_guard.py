@@ -151,6 +151,61 @@ def test_steady_state_decode_offload_engine_clean(sp):
     assert eng.stats()["spills_total"] == 1
 
 
+def test_disaggregated_import_steady_state_clean():
+    """ISSUE 12: the fleet KV transport lives entirely on the
+    structural path. Prefill-on-A, ship, decode-on-B: engine A runs
+    the prompt and exports the parked session, engine B imports it
+    (host-tier park + the sanctioned restore scatter — a structural
+    h2d like admission uploads), and once B's pipeline settles,
+    steady-state decode on B is STILL 1 dispatch/tick, 0 h2d
+    transfers, 0 compiles for 32 ticks — importing a session leaves
+    no residue on the decode loop."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(2, 250, 12).tolist() for _ in range(3)]
+
+    # engine A: prefill + a few decode ticks, then export
+    a = _engine(enable_kv_offload=True)
+    a.add_request(Request("ship0", list(prompts[0]),
+                          SamplingParams(max_tokens=96)))
+    while len(a.slots[0].request.output_tokens
+              if a.slots[0].request else []) < 3 \
+            and a.has_work():
+        a.step()
+    state = a.export_session("ship0", reason="disagg")
+    assert state is not None and state["n_pages"] > 0
+
+    # engine B: warm resident batch (decode buckets compiled), then
+    # import the shipped session into the free slot
+    b = _engine(enable_kv_offload=True, async_readback=True)
+    for i in range(2):
+        b.add_request(Request(
+            f"g{i}", list(prompts[i + 1]),
+            SamplingParams(max_tokens=96)))
+    while b.waiting or any(s.request is not None and not s.ready
+                           for s in b.slots):
+        b.step()
+    for _ in range(4):
+        b.step()
+    req = b.import_session(state)
+    while b.parked:
+        b.step()                 # restore (structural h2d scatter)
+    assert b.host_tier.restores_total == 1
+    assert any(s.request is req and s.ready for s in b.slots)
+    for _ in range(4):
+        b.step()                 # settle the pipeline again
+    comp0 = b.stats()["jit_cache"]["compiled_programs"]
+    disp0 = b.dispatches
+    with dispatch_guard() as rep:
+        for _ in range(32):
+            b.step()
+    assert rep.n_compiles == 0
+    assert b.stats()["jit_cache"]["compiled_programs"] == comp0
+    assert b.dispatches - disp0 == 32        # one dispatch per tick
+    assert all(s.request is not None and s.ready for s in b.slots)
+    # the imported session really decoded inside the window
+    assert len(req.output_tokens) >= 32
+
+
 def test_guard_raises_on_seeded_h2d_transfer():
     with pytest.raises(Exception, match="host-to-device"):
         with dispatch_guard():

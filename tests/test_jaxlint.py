@@ -863,9 +863,11 @@ def test_serve_llm_fleet_has_zero_baselined_findings():
     for key in base.entries:
         assert "serve/llm/" not in key.split(":")[1]
     # the ISSUE 9 modules exist and are inside the analyzed package
-    # (if they ever move, this gate must move with them)
+    # (if they ever move, this gate must move with them) — plus the
+    # ISSUE 12 KV transport (wire codec + fleet shipping policy:
+    # pure host-side numpy/stdlib, so any finding there is a bug)
     for fname in ("chaos.py", "failover.py", "watchdog.py",
-                  "tracemerge.py"):
+                  "tracemerge.py", "kv_transport.py"):
         assert (REPO / "ray_tpu/serve/llm" / fname).exists(), fname
     # and the package is clean with NO baseline at all
     proc = _cli("ray_tpu/serve/llm")
