@@ -443,6 +443,12 @@ def bench_perf_accounting(on_tpu: bool, smoke: bool = False) -> dict:
             max_prefill_tokens=chunk, enable_prefix_caching=False,
             max_num_batched_tokens=budget,
             enable_perf_accounting=enable_perf,
+            # the ISSUE 13 planes ride the accounting hooks but are
+            # NOT what this gate measures — bench_attribution holds
+            # their own on/off A/B (and the anomaly detector's
+            # auto-capture must not tax a timed arm)
+            enable_attribution=False,
+            enable_anomaly_detection=False,
             metrics_model_id=f"perf{uuid.uuid4().hex[:8]}"))
 
         def drive():
@@ -510,6 +516,128 @@ def bench_perf_accounting(on_tpu: bool, smoke: bool = False) -> dict:
         # arithmetic must never make decode materially slower
         assert res["overhead_ratio"] >= 0.8, res
         assert not diff_failures, diff_failures
+    return res
+
+
+def bench_attribution(on_tpu: bool, smoke: bool = False) -> dict:
+    """ISSUE 13 gate, two halves.
+
+    Conservation: a bursty mixed prefill+decode workload with spills
+    (half-capacity pages, offload on), greedy AND sampled rows — the
+    summed per-request receipts must equal the PerfAccountant's tick
+    totals EXACTLY (closed form, not banded) for every conserved
+    field, and every request must end with a closed receipt.
+
+    Overhead: the same workload with attribution + anomaly detection
+    OFF as baseline (perf accounting stays ON in both arms, so the
+    A/B isolates the ISSUE 13 cost: a dict update per slot per tick
+    and a few float ops for the detector). Must be ~1.0x; the
+    dispatch-guard suite separately proves zero transfers/compiles
+    with both features enabled. The detector's auto-capture reactions
+    (profile arming / black-box dump) are disabled in BOTH arms: they
+    run only on ticks that already went anomalous — deliberately
+    expensive evidence-gathering, exercised by the anomaly e2e test —
+    so they are not part of the steady-state overhead contract."""
+    import uuid
+
+    from ray_tpu.llm._internal.attribution import CONSERVED_FIELDS
+    from ray_tpu.llm._internal.engine import (EngineConfig,
+                                              InferenceEngine, Request,
+                                              SamplingParams)
+    from ray_tpu.models import llama
+
+    if on_tpu and not smoke:
+        cfg = _tpu_bench_model()
+        batch, plen, n_req, gen0 = 8, 192, 18, 48
+    else:
+        cfg = llama.config("debug")
+        batch, plen, n_req, gen0 = 3, 40, 12, 16
+
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            plen + 8 * (i % 3)).tolist()
+               for i in range(n_req)]
+
+    def run(enable):
+        eng = InferenceEngine(EngineConfig(
+            model=cfg, max_batch_size=batch, page_size=8,
+            # roughly HALF the workload's worst-case page demand:
+            # spills/restores are exercised, so d2h/h2d attribution
+            # is part of the conservation sum
+            num_pages=max(
+                batch * (plen + 8 + gen0 + 8) // 8 // 2, 16),
+            seed=7, max_prefill_tokens=16, kv_watermark_tokens=8,
+            enable_kv_offload=True, enable_prefix_caching=False,
+            enable_attribution=enable,
+            enable_anomaly_detection=enable,
+            anomaly={"auto_profile": False, "auto_dump": False},
+            metrics_model_id=f"attr{uuid.uuid4().hex[:8]}"))
+
+        def drive():
+            reqs = [Request(
+                f"a{uuid.uuid4().hex[:6]}", list(p),
+                SamplingParams(
+                    max_tokens=gen0 + 8 * (i % 2),
+                    temperature=0.8 if i % 2 else 0.0,
+                    top_k=20 if i % 2 else 0),
+                tenant="tenant-b" if i % 3 == 0 else "")
+                    for i, p in enumerate(prompts)]
+            pending = list(reqs)
+            steps = 0
+            while eng.has_work() or pending:
+                if pending and steps % 5 == 0:
+                    for r in pending[:3]:
+                        eng.add_request(r)
+                    pending = pending[3:]
+                eng.step()
+                steps += 1
+            return reqs
+
+        drive()                          # warmup compiles
+        import gc
+        gc.collect()                     # align GC (see bench_async_ab)
+        t0 = time.perf_counter()
+        reqs = drive()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {"tokens_per_sec": round(toks / dt, 1)}, eng
+
+    on_row, eng_on = run(True)
+    off_row, eng_off = run(False)
+
+    perf_tot = eng_on.perf.totals()
+    attrib_tot = eng_on.attrib.totals()
+    mismatches = [k for k, _ in CONSERVED_FIELDS
+                  if perf_tot[k] != attrib_tot[k]]
+    summ = eng_on.attrib.summary()
+    res = {
+        "attribution_on": on_row, "attribution_off": off_row,
+        "overhead_ratio": round(
+            on_row["tokens_per_sec"]
+            / max(off_row["tokens_per_sec"], 1e-9), 3),
+        "conserved": not mismatches,
+        "conservation_mismatches": mismatches,
+        "spills": eng_on.host_tier.spills_total,
+        "receipts_finished": summ["requests_total"] - summ["live"],
+        "live_receipts": summ["live"],
+        "tenants": sorted(summ["tenants"]),
+        "anomaly_ticks": eng_on.anomaly.stats()["ticks"],
+        "attribution_off_disabled": (
+            eng_off.stats()["attribution"].get("enabled") is False),
+    }
+    if smoke:
+        assert res["conserved"], (
+            "receipt conservation failed", mismatches,
+            {k: (perf_tot[k], attrib_tot[k])
+             for k, _ in CONSERVED_FIELDS})
+        assert res["spills"] >= 1, res      # the gate covered spills
+        assert res["live_receipts"] == 0, res
+        assert set(res["tenants"]) == {"default", "tenant-b"}, res
+        assert res["anomaly_ticks"] > 0, res
+        assert res["attribution_off_disabled"], res
+        # tripwire with CI-noise slack: per-slot dict arithmetic must
+        # never make decode materially slower
+        assert res["overhead_ratio"] >= 0.8, res
     return res
 
 
@@ -1595,6 +1723,9 @@ def main() -> None:
         chaos = bench_chaos(on_tpu, smoke=True)
         preemption = bench_preemption(on_tpu, smoke=True)
         perf = bench_perf_accounting(on_tpu, smoke=True)
+        # ISSUE 13: per-request receipts conserve exactly + on/off
+        # overhead A/B within noise
+        attribution = bench_attribution(on_tpu, smoke=True)
         # ISSUE 12: disaggregated prefill/decode must be token-exact
         # vs a single-engine oracle (the ship really happened)
         disagg = bench_disagg(on_tpu, smoke=True)
@@ -1609,6 +1740,7 @@ def main() -> None:
                        "chaos": chaos,
                        "preemption": preemption,
                        "perf": perf,
+                       "attribution": attribution,
                        "disagg": disagg},
         }))
         return
@@ -1644,6 +1776,7 @@ def main() -> None:
     async_ab = bench_async_ab(on_tpu)
     telemetry = bench_telemetry(on_tpu)
     perf = bench_perf_accounting(on_tpu)
+    attribution = bench_attribution(on_tpu)
     scaling = bench_kernel_scaling(on_tpu)
     prefix = bench_prefix_cache(on_tpu)
     spec = bench_speculative(on_tpu)
@@ -1658,6 +1791,7 @@ def main() -> None:
                    "async_readback_ab": async_ab,
                    "telemetry": telemetry,
                    "perf": perf,
+                   "attribution": attribution,
                    "paged_kernel_scaling": scaling,
                    "prefix_cache": prefix, "speculative": spec,
                    "multi_step_decode": multi},

@@ -157,11 +157,54 @@ global token indices), `export_prefix` / `import_prefix` (fleet
 prefix store), `list_sessions`. Migration/handoff spans land in
 `GET /fleet/debug/trace` under the `kv_transport` category.
 
+ISSUE 13 per-request cost attribution + tick-anomaly analyzer
+(details: BENCH_CORE.md "Attribution & anomaly anatomy"; receipts
+also ride the finish event, `stats()["attribution"]`, and the
+OpenAI response's `usage.cost` block; tenant identity comes from the
+OpenAI `user` field at admission, "" = default tenant whose label is
+omitted so single-tenant scrapes stay byte-identical):
+
+    ray_tpu_llm_tenant_flops_total          counter    + `tenant`: analytic FLOPs
+                                                       attributed to finished requests
+    ray_tpu_llm_tenant_hbm_bytes_total      counter    + `tenant`: attributed device-HBM
+                                                       bytes (weights share + KV traffic)
+    ray_tpu_llm_tenant_tokens_total         counter    + `tenant`, `phase`
+                                                       (decode|prefill)
+    ray_tpu_llm_tick_anomalies_total        counter    + `kind` (recompile|h2d_transfer|
+                                                       gc_pause|host_fold_stall|
+                                                       device_straggler|unknown):
+                                                       classified slow-tick anomalies
+    ray_tpu_llm_tick_anomaly_rate           gauge      anomalous fraction of the recent
+                                                       tick window (rides /fleet rows)
+    ray_tpu_llm_fleet_anomaly_rate          gauge      fleet max anomaly rate (ingress
+                                                       registry; watchdog page precursor
+                                                       with alert/clear hysteresis)
+    ray_tpu_llm_fleet_queue_wait_seconds    histogram  + `tenant`: front-door admission
+                                                       queue wait (ingress registry)
+    ray_tpu_llm_fleet_admission_rejected_total
+                                            counter    + `tenant`, `reason` (queue_full|
+                                                       brownout|queue_wait_slo|deadline):
+                                                       per-tenant 429/shed diagnosis
+
+    endpoint                      payload
+    GET /debug/attribution        per-model top-K cost receipts by
+                                  FLOPs + tenant rollups +
+                                  conservation totals
+    GET /fleet/debug/attribution  fleet-merged receipts: one re-ranked
+                                  top-K, tenant rollups summed
+                                  fleet-wide (?k=&tenant=)
+
+An anomalous tick additionally records a `tick_anomaly` flight event
+(batch composition attached), auto-arms a `profile_next_ticks`
+capture, and drops a rate-limited black-box bundle (cause
+`tick_anomaly`, fetchable at GET /fleet/debug/bundles).
+
 Instrumentation is recorded purely from host-side engine events (zero
 device syncs, zero extra dispatches — the dispatch-guard suite runs
 with it enabled); disable per engine with
-`engine_kwargs={"enable_metrics": False}` (and the perf accounting
-with `enable_perf_accounting=False`).
+`engine_kwargs={"enable_metrics": False}` (the perf accounting with
+`enable_perf_accounting=False`, and the ISSUE 13 planes with
+`enable_attribution=False` / `enable_anomaly_detection=False`).
 """
 
 from __future__ import annotations

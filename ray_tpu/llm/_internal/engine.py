@@ -184,6 +184,29 @@ class EngineConfig:
     # "tpu-v5e" | "cpu"). None autodetects from the first jax device;
     # unknown names raise so a typo can't report MFU vs the wrong peak.
     perf_envelope: Optional[str] = None
+    # Per-request cost attribution (ISSUE 13, attribution.py): split
+    # every committed tick's analytic cost across the requests in its
+    # ragged batch into per-request receipts — {flops, hbm_bytes,
+    # kv_page_ticks, queue/wall/host/device time shares} — surfaced
+    # in the finish event, stats()["attribution"], the usage.cost
+    # block, per-tenant Prometheus counters, and /debug/attribution.
+    # Conservation: summed receipts equal the tick totals EXACTLY.
+    # Pure host arithmetic riding the perf-accounting hooks; requires
+    # enable_perf_accounting (silently off without it).
+    enable_attribution: bool = True
+    # Tick-anomaly flight analyzer (ISSUE 13, anomaly.py): a robust
+    # median+MAD residual monitor comparing each tick's measured wall
+    # time against the cost model's roofline prediction; a flagged
+    # tick is classified (recompile | h2d_transfer | gc_pause |
+    # host_fold_stall | device_straggler | unknown) and triggers
+    # evidence capture: a tick_anomaly flight-recorder event with the
+    # batch composition, an auto-armed profile_next_ticks capture,
+    # and a rate-limited black-box bundle. Requires
+    # enable_perf_accounting.
+    enable_anomaly_detection: bool = True
+    # AnomalyConfig field overrides (anomaly.py), e.g.
+    # {"warmup_ticks": 16, "z_threshold": 4.0}. None keeps defaults.
+    anomaly: Optional[Dict[str, Any]] = None
     # Postmortem black-box bundles (ISSUE 7): on a guard violation or
     # mid-tick crash the engine snapshots its flight recorder, recent
     # tick times, metric exposition, config, and in-flight request
@@ -286,6 +309,13 @@ class Request:
     # priority loses its slot first (ties break youngest-first); the
     # serving plane maps tenant tiers onto this
     priority: int = 0
+    # tenant identity (ISSUE 13), sourced from admission (the fleet
+    # ingress mints `_tenant` from the OpenAI `user` field): tags this
+    # request's cost receipt so per-tenant attribution rollups and
+    # Prometheus counters know who consumed the FLOPs. "" = the
+    # default tenant (label omitted from expositions, so
+    # single-tenant scrapes stay byte-identical)
+    tenant: str = ""
     # times this request lost its slot and came back (preemption
     # spill/restore or prefill requeue) — restores skip the admission
     # telemetry so queue-wait/prefix-hit stats count each request once
@@ -707,6 +737,19 @@ class InferenceEngine:
                 # draft-model costs accounted against their own config
                 self._spec["cost_model"] = CostModel(
                     self._spec["cfg"], ec.page_size)
+        # per-request cost attribution + tick-anomaly analyzer
+        # (ISSUE 13): both ride the perf accountant's numbers, so both
+        # require it; both are pure host arithmetic (the dispatch-
+        # guard suite runs with them enabled)
+        from .attribution import ReceiptLedger
+        self.attrib: Optional[ReceiptLedger] = (
+            ReceiptLedger() if (self.perf is not None
+                                and ec.enable_attribution) else None)
+        self.anomaly = None
+        if self.perf is not None and ec.enable_anomaly_detection:
+            from .anomaly import AnomalyConfig, TickAnomalyDetector
+            self.anomaly = TickAnomalyDetector(
+                AnomalyConfig(**(ec.anomaly or {})))
         # tick-pipeline telemetry: per-tick (wall, host-fold, blocked-
         # readback) ms over a sliding window + cumulative counters
         # (stats()["tick_times"]; BENCH_CORE.md "Tick pipelining
@@ -1244,6 +1287,19 @@ class InferenceEngine:
         for k, v in c.items():
             tot[k] = tot.get(k, 0.0) + v
 
+    def _account_prefill(self, slot: _Slot, start: int,
+                         n: int) -> None:
+        """One slot's prefill chunk (full-prompt or chunked, single-
+        device or pp): fold the closed-form cost into the tick sample
+        AND the slot's request receipt (ISSUE 13)."""
+        if self.perf is None:
+            return
+        c = self.perf.model.chunk_cost(start, n)
+        self.perf.add("prefill", c, prefill_tokens=n)
+        if self.attrib is not None:
+            self.attrib.charge(slot.request, c, prefill_tokens=n,
+                               pages=len(slot.pages))
+
     def _account_decode_batch(self, kind: str = "decode") -> None:
         """One whole-batch decode dispatch: every active slot advances
         one token at its current context."""
@@ -1256,7 +1312,13 @@ class InferenceEngine:
             if s.request is None or not s.ready \
                     or not self._host_active[s.index]:
                 continue
-            self._merge_cost(tot, cm.decode_cost(s.position + 1))
+            c = cm.decode_cost(s.position + 1)
+            self._merge_cost(tot, c)
+            if self.attrib is not None:
+                # the SAME closed-form dict rides both sides, so the
+                # receipt sum conserves against the tick total exactly
+                self.attrib.charge(s.request, c, decode_tokens=1,
+                                   pages=len(s.pages))
             ndec += 1
         if ndec:
             self.perf.add(kind, tot, decode_tokens=ndec)
@@ -1277,12 +1339,18 @@ class InferenceEngine:
             ndec = npre = 0
             for ps, pn, is_pref in plan:
                 if is_pref:
-                    self._merge_cost(tot,
-                                     cm.chunk_cost(ps.prefill_pos, pn))
+                    c = cm.chunk_cost(ps.prefill_pos, pn)
                     npre += pn
                 else:
-                    self._merge_cost(tot, cm.decode_cost(ps.position + 1))
+                    c = cm.decode_cost(ps.position + 1)
                     ndec += 1
+                self._merge_cost(tot, c)
+                if self.attrib is not None:
+                    self.attrib.charge(
+                        ps.request, c,
+                        decode_tokens=0 if is_pref else 1,
+                        prefill_tokens=pn if is_pref else 0,
+                        pages=len(ps.pages))
             self.perf.add("ragged", tot, decode_tokens=ndec,
                           prefill_tokens=npre)
         T = self._token_bucket(total)
@@ -1560,10 +1628,7 @@ class InferenceEngine:
 
         if slot.prefill_pos == 0 and n <= self.config.max_prefill_tokens:
             self.telemetry.on_prefill_chunk(req, n, 0)
-            if self.perf is not None:
-                self.perf.add("prefill",
-                              self.perf.model.chunk_cost(0, n),
-                              prefill_tokens=n)
+            self._account_prefill(slot, 0, n)
             tokens, bucket = self._prep_full_prompt(req)
             fns = self._pp_prefill_fns(bucket)
             x = self.stages[0].put(jnp.asarray(tokens))
@@ -1586,11 +1651,7 @@ class InferenceEngine:
 
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
         self.telemetry.on_prefill_chunk(req, chunk, slot.prefill_pos)
-        if self.perf is not None:
-            self.perf.add("prefill",
-                          self.perf.model.chunk_cost(slot.prefill_pos,
-                                                     chunk),
-                          prefill_tokens=chunk)
+        self._account_prefill(slot, slot.prefill_pos, chunk)
         fns = self._pp_chunk_fns(bucket,
                                  self._ctx_bucket(slot.prefill_pos))
         start = [st.put(jnp.asarray([slot.prefill_pos], jnp.int32))
@@ -1812,8 +1873,10 @@ class InferenceEngine:
             self._page_tables[slot.index:slot.index + 1]))
         if self.perf is not None:
             cm_d = s["cost_model"]
-            self.perf.add("spec", cm_d.chunk_cost(0, n),
-                          weight_bytes=cm_d.weight_bytes)
+            c = cm_d.chunk_cost(0, n)
+            self.perf.add("spec", c, weight_bytes=cm_d.weight_bytes)
+            if self.attrib is not None:
+                self.attrib.charge(req, c, pages=len(slot.pages))
         self.dispatches += 1
         s["dk"], s["dv"] = fn(
             s["params"], s["dk"], s["dv"],
@@ -1875,8 +1938,12 @@ class InferenceEngine:
                 cm_d = s["cost_model"]
                 tot: Dict[str, float] = {}
                 for sl in over:
-                    self._merge_cost(tot, cm_d.chunk_cost(
-                        int(cstart[sl.index]), int(clens[sl.index])))
+                    c = cm_d.chunk_cost(
+                        int(cstart[sl.index]), int(clens[sl.index]))
+                    self._merge_cost(tot, c)
+                    if self.attrib is not None:
+                        self.attrib.charge(sl.request, c,
+                                           pages=len(sl.pages))
                 self.perf.add("spec", tot,
                               weight_bytes=cm_d.weight_bytes)
             self.dispatches += 1
@@ -1912,10 +1979,15 @@ class InferenceEngine:
             for sl in active:
                 dp = int(dstart[sl.index])
                 dn = int(dlens[sl.index])
-                self._merge_cost(tot, cm_d.chunk_cost(dp, dn))
+                sc: Dict[str, float] = {}
+                self._merge_cost(sc, cm_d.chunk_cost(dp, dn))
                 for j in range(max(k - 2, 0)):
-                    self._merge_cost(tot,
+                    self._merge_cost(sc,
                                      cm_d.decode_cost(dp + dn + j + 1))
+                self._merge_cost(tot, sc)
+                if self.attrib is not None:
+                    self.attrib.charge(sl.request, sc,
+                                       pages=len(sl.pages))
             # delta chunk-prefill + k-2 scanned decode steps = k-1
             # draft forwards, each re-streaming the draft weights
             self.perf.add("spec", tot, weight_bytes=cm_d.weight_bytes,
@@ -1962,10 +2034,13 @@ class InferenceEngine:
             tot = {}
             for sl in active:
                 use = int(vlens[sl.index])
-                self._merge_cost(
-                    tot, cm.chunk_cost(int(vstart[sl.index]), use))
-                tot["flops_gemm"] = (tot.get("flops_gemm", 0.0)
-                                     + (use - 1) * cm.head_flops)
+                sc = dict(cm.chunk_cost(int(vstart[sl.index]), use))
+                sc["flops_gemm"] = (sc.get("flops_gemm", 0.0)
+                                    + (use - 1) * cm.head_flops)
+                self._merge_cost(tot, sc)
+                if self.attrib is not None:
+                    self.attrib.charge(sl.request, sc,
+                                       pages=len(sl.pages))
             self.perf.add("spec", tot)
         self.dispatches += 1
         preds, self.k_pages, self.v_pages = self._spec_verify_fn(ctx)(
@@ -1979,6 +2054,8 @@ class InferenceEngine:
         n_emit = 0
         for sl in active:
             i = sl.index
+            req_sl = sl.request
+            emit0 = n_emit
             use = int(vlens[i])
             P = int(vstart[i]) + 1
             n_acc = 0
@@ -2003,6 +2080,11 @@ class InferenceEngine:
                 self._append_token(sl, int(tok), touched)
                 if sl.request is None:       # finished mid-round
                     break
+            if self.attrib is not None and n_emit > emit0:
+                # emitted-token attribution (the cost dicts above
+                # charged the compute; acceptance decides the tokens)
+                self.attrib.charge(req_sl,
+                                   decode_tokens=n_emit - emit0)
         if self.perf is not None and n_emit:
             self.perf.note_tokens(decode_tokens=n_emit)
         # positions/actives changed: lazily invalidate so a fallback
@@ -2150,6 +2232,9 @@ class InferenceEngine:
             # actual transfer is the BUCKETED page count (padding
             # duplicates move too) — real d2h traffic, not the ideal
             self.perf.note_offload(d2h=nb * self.perf.model.page_bytes)
+            if self.attrib is not None:
+                self.attrib.charge_offload(
+                    req, d2h=nb * self.perf.model.page_bytes)
         # overlap: the d2h copies stream while decode continues; the
         # gather output is its own buffer, so the pool pages freed
         # below can be rewritten without corrupting the spill
@@ -2329,6 +2414,9 @@ class InferenceEngine:
                 if self.perf is not None:
                     self.perf.note_offload(
                         h2d=nb * self.perf.model.page_bytes)
+                    if self.attrib is not None:
+                        self.attrib.charge_offload(
+                            req, h2d=nb * self.perf.model.page_bytes)
                 # the sanctioned restore upload: a structural-event
                 # h2d (like admission prefill uploads), never on the
                 # steady decode path
@@ -2552,6 +2640,9 @@ class InferenceEngine:
         reason "migrated" — it no longer lives on this engine."""
         req.finished = True
         req.finish_reason = "migrated"
+        # the receipt closes here: the request's remaining cost
+        # accrues on the importing engine under its own receipt
+        self._attrib_finish(req, "migrated")
         self.telemetry.recorder.record(
             "session_exported", request_id=req.request_id,
             reason=reason,
@@ -2569,6 +2660,7 @@ class InferenceEngine:
             "params": dataclasses.asdict(req.params),
             "lora": req.lora,
             "priority": int(req.priority),
+            "tenant": req.tenant,
             "restarts": int(req.restarts),
             "trace": req.trace,
             "deadline_epoch": ddl,
@@ -2606,7 +2698,8 @@ class InferenceEngine:
                       SamplingParams(**params),
                       lora=state.get("lora"),
                       trace=state.get("trace"),
-                      priority=int(state.get("priority") or 0))
+                      priority=int(state.get("priority") or 0),
+                      tenant=str(state.get("tenant") or ""))
         req.output_tokens = [int(t)
                              for t in state.get("output_tokens") or []]
         req.restarts = int(state.get("restarts") or 0)
@@ -2949,7 +3042,24 @@ class InferenceEngine:
                     # fold the tick's pending PerfSample (cost hooks
                     # ran beside each dispatch above) into the rolling
                     # MFU/MBU window, stamped with the tick wall
-                    self.perf.commit(wall * 1e3)
+                    sample = self.perf.commit(wall * 1e3)
+                    if sample is not None and self.attrib is not None:
+                        # split the tick's shared costs + times across
+                        # its per-request charges (ISSUE 13)
+                        self.attrib.commit(
+                            sample, host_ms=self._tick_host_s * 1e3,
+                            device_ms=self._tick_dev_s * 1e3)
+                    if sample is not None and self.anomaly is not None:
+                        ev = self.anomaly.observe(
+                            sample, wall * 1e3,
+                            self._tick_host_s * 1e3,
+                            self._tick_dev_s * 1e3, self.compiles,
+                            self.perf.envelope.peak_flops
+                            * self.perf.n_chips,
+                            self.perf.envelope.peak_bytes_per_s
+                            * self.perf.n_chips)
+                        if ev is not None:
+                            self._on_tick_anomaly(ev)
                 # reset AFTER the append (not at entry) so readback/
                 # fold cost from out-of-step drains lands in the next
                 # tick's record instead of vanishing from the telemetry
@@ -2966,6 +3076,8 @@ class InferenceEngine:
                 self._profile_abort()
                 if self.perf is not None:
                     self.perf.abort_tick()
+                if self.attrib is not None:
+                    self.attrib.abort_tick()
                 self._handle_memory_error(exc, touched)
                 self.last_step_at = time.monotonic()
             except BaseException as exc:
@@ -2976,6 +3088,8 @@ class InferenceEngine:
                 self._profile_abort()
                 if self.perf is not None:
                     self.perf.abort_tick()
+                if self.attrib is not None:
+                    self.attrib.abort_tick()
                 # black-box the replica's last moments (ISSUE 7):
                 # best-effort, lock-free gather — the step lock is
                 # HELD here, so the bundle builder must not re-enter
@@ -3118,7 +3232,9 @@ class InferenceEngine:
                 self.telemetry.recorder.record(
                     "deadline_abort", request_id=req.request_id,
                     where="parked", generated=len(req.output_tokens))
-                self.telemetry.on_finished(req, "deadline")
+                self.telemetry.on_finished(
+                    req, "deadline",
+                    cost=self._attrib_finish(req, "deadline"))
                 touched.append(req)
         if has_slot_ddl:
             expired = [s for s in self.slots
@@ -3150,7 +3266,9 @@ class InferenceEngine:
                     self.telemetry.recorder.record(
                         "deadline_abort", request_id=req.request_id,
                         where="waiting")
-                    self.telemetry.on_finished(req, "deadline")
+                    self.telemetry.on_finished(
+                        req, "deadline",
+                        cost=self._attrib_finish(req, "deadline"))
                     touched.append(req)
                 else:
                     keep.append(req)
@@ -3188,6 +3306,10 @@ class InferenceEngine:
                 self.allocator.record_match(matched,
                                             len(req.prompt_tokens))
                 self.telemetry.on_admitted(req, cached_tokens=matched)
+                if self.attrib is not None:
+                    # queue-time share of the receipt (ISSUE 13)
+                    self.attrib.note_queue(
+                        req, time.monotonic() - req.submitted_at)
             else:
                 self.telemetry.recorder.record(
                     "readmission", request_id=req.request_id,
@@ -3247,10 +3369,7 @@ class InferenceEngine:
             # whole prompt in one go: the dense full-causal program
             # (no pool gather — the common short-prompt fast path)
             self.telemetry.on_prefill_chunk(req, n, 0)
-            if self.perf is not None:
-                self.perf.add("prefill",
-                              self.perf.model.chunk_cost(0, n),
-                              prefill_tokens=n)
+            self._account_prefill(slot, 0, n)
             tokens, bucket = self._prep_full_prompt(req)
             lidx = self._dev(jnp.asarray(
                 [self._lora_names.get(req.lora, 0)], jnp.int32))
@@ -3267,11 +3386,7 @@ class InferenceEngine:
 
         tokens, chunk, bucket, prior = self._prep_chunk(slot, req)
         self.telemetry.on_prefill_chunk(req, chunk, slot.prefill_pos)
-        if self.perf is not None:
-            self.perf.add("prefill",
-                          self.perf.model.chunk_cost(slot.prefill_pos,
-                                                     chunk),
-                          prefill_tokens=chunk)
+        self._account_prefill(slot, slot.prefill_pos, chunk)
         lidx = self._dev(jnp.asarray(
             [self._lora_names.get(req.lora, 0)], jnp.int32))
         self.dispatches += 1
@@ -3559,9 +3674,15 @@ class InferenceEngine:
                 if s.request is None or not self._host_active[s.index]:
                     continue
                 rows = min(int(budget[s.index]), K)
+                sc: Dict[str, float] = {}
                 for j in range(rows):
-                    self._merge_cost(tot,
+                    self._merge_cost(sc,
                                      cm.decode_cost(s.position + 1 + j))
+                self._merge_cost(tot, sc)
+                if self.attrib is not None and rows:
+                    self.attrib.charge(s.request, sc,
+                                       decode_tokens=rows,
+                                       pages=len(s.pages))
                 ndec += rows
             if ndec:
                 # the scanned program runs K full forwards even for
@@ -3634,10 +3755,22 @@ class InferenceEngine:
         elif len(req.output_tokens) >= p.max_tokens:
             self._finish(slot, "length")
 
+    def _attrib_finish(self, req: Request,
+                       reason: Optional[str] = None
+                       ) -> Optional[Dict[str, Any]]:
+        """Close the request's cost receipt (ISSUE 13) and return its
+        usage.cost brief for the finish event (None when the request
+        was never charged — e.g. shed from the waiting queue)."""
+        if self.attrib is None:
+            return None
+        rec = self.attrib.finish(req, reason)
+        return None if rec is None else rec.cost_block()
+
     def _finish(self, slot: _Slot, reason: str) -> None:
         slot.request.finished = True
         slot.request.finish_reason = reason
-        self.telemetry.on_finished(slot.request, reason)
+        cost = self._attrib_finish(slot.request, reason)
+        self.telemetry.on_finished(slot.request, reason, cost=cost)
         self.allocator.free(slot.pages)
         self._clear_slot(slot)
 
@@ -3672,7 +3805,9 @@ class InferenceEngine:
                     self.telemetry.recorder.record(
                         "abort", request_id=request_id,
                         where="waiting")
-                    self.telemetry.on_finished(req, "abort")
+                    self.telemetry.on_finished(
+                        req, "abort",
+                        cost=self._attrib_finish(req, "abort"))
                     return True
             for slot in self.slots:
                 if slot.request is not None \
@@ -3695,7 +3830,9 @@ class InferenceEngine:
                 req.finish_reason = "abort"
                 self.telemetry.recorder.record(
                     "abort", request_id=request_id, where="parked")
-                self.telemetry.on_finished(req, "abort")
+                self.telemetry.on_finished(
+                    req, "abort",
+                    cost=self._attrib_finish(req, "abort"))
                 return True
             return False
 
@@ -3776,6 +3913,50 @@ class InferenceEngine:
         self.telemetry.recorder.record("profile_aborted",
                                        log_dir=ps["dir"])
 
+    def _arm_profile_locked(self, ticks: int,
+                            trigger: str = "tick_anomaly"
+                            ) -> Optional[str]:
+        """profile_next_ticks' body WITHOUT taking the step lock — the
+        anomaly detector fires inside step() with the lock held, so
+        the auto-arm path must not re-enter it. No-op (None) when a
+        capture is already armed instead of raising: an anomaly storm
+        must never crash the tick it is trying to explain."""
+        if self._profile is not None:
+            return None
+        import tempfile
+        log_dir = tempfile.mkdtemp(prefix="ray_tpu_llm_prof_")
+        self._profile = {"remaining": int(ticks), "dir": log_dir,
+                         "cm": None}
+        self.telemetry.recorder.record(
+            "profile_armed", ticks=int(ticks), log_dir=log_dir,
+            trigger=trigger)
+        return log_dir
+
+    def _on_tick_anomaly(self, ev: Dict[str, Any]) -> None:
+        """React to a classified tick anomaly (ISSUE 13): record the
+        flight event with the offending batch composition, auto-arm a
+        profile capture of the next ticks, and drop a rate-limited
+        black-box bundle (all decisions — including the rate limits —
+        were made by the detector; this just acts on them). Runs under
+        the step lock on an ALREADY-slow tick, so the capture cost
+        never taxes a healthy one."""
+        # "kind" would collide with the recorder's positional event
+        # kind — the classification rides as "anomaly_kind"
+        fields = {("anomaly_kind" if k == "kind" else k): v
+                  for k, v in ev.items()
+                  if k not in ("arm_profile", "dump")}
+        self.telemetry.recorder.record("tick_anomaly", **fields)
+        if ev.get("arm_profile") and self.anomaly is not None:
+            self._arm_profile_locked(self.anomaly.config.profile_ticks)
+        if ev.get("dump"):
+            # lock-free by contract (the crash path uses it the same
+            # way); never turns an anomaly into a failure. Keyed
+            # "anomaly_event" — the bundle already carries the
+            # detector's stats under "anomaly", and extra is applied
+            # last (it would silently replace them)
+            self.dump_blackbox("tick_anomaly",
+                               extra={"anomaly_event": ev})
+
     def _on_alert_event(self, kind: str, event: Dict[str, Any]) -> None:
         """FlightRecorder alert hook: a guard violation landing in the
         ring snapshots a postmortem bundle (fires outside the recorder
@@ -3849,6 +4030,12 @@ class InferenceEngine:
                 # raise), so this read is safe from the crash path
                 "perf": (self.perf.summary()
                          if self.perf is not None else None),
+                # ISSUE 13 forensics: who was consuming the machine
+                # when it died, and what the anomaly plane last saw
+                "attribution": (self.attrib.summary(top_k=4)
+                                if self.attrib is not None else None),
+                "anomaly": (self.anomaly.stats()
+                            if self.anomaly is not None else None),
                 "parked_requests": [
                     {"request_id": p.request.request_id,
                      "position": p.position, "pages": p.n_pages,
@@ -3874,6 +4061,14 @@ class InferenceEngine:
         from ...util import metrics as metrics_api
         self.telemetry.update_gauges(self)
         return metrics_api.export_prometheus()
+
+    def attribution_summary(self, top_k: int = 8) -> Dict[str, Any]:
+        """GET /debug/attribution: top-K receipts by FLOPs + tenant
+        rollups + conservation totals (ledger-locked reads — never
+        touches the step lock, so it can't queue behind a tick)."""
+        if self.attrib is None:
+            return {"enabled": False}
+        return self.attrib.summary(top_k=top_k)
 
     def chrome_trace(self) -> Dict[str, Any]:
         """Per-request lifecycle timelines (queued → admitted →
@@ -3957,6 +4152,16 @@ class InferenceEngine:
             # envelope, and which roof binds (perfmodel.py)
             "perf": (self.perf.summary() if self.perf is not None
                      else {"enabled": False}),
+            # per-request cost attribution (ISSUE 13): top receipts,
+            # per-tenant rollups, conservation totals
+            "attribution": (self.attrib.summary()
+                            if self.attrib is not None
+                            else {"enabled": False}),
+            # tick-anomaly analyzer (ISSUE 13): recent anomaly rate,
+            # counts by classified kind, last event
+            "anomaly": (self.anomaly.stats()
+                        if self.anomaly is not None
+                        else {"enabled": False}),
             # request-lifecycle SLO telemetry (ISSUE 5): per-engine
             # TTFT/ITL/queue-wait/e2e aggregates, finish-reason
             # counts, token totals, budget utilization and the

@@ -333,13 +333,16 @@ class PerfAccountant:
         p.bytes_d2h += d2h
         p.bytes_h2d += h2d
 
-    def commit(self, wall_ms: float) -> None:
+    def commit(self, wall_ms: float) -> Optional[PerfSample]:
         """Stamp the tick's wall time and fold the pending sample into
         the window + cumulative totals. A tick that dispatched nothing
-        (admission-only) and moved no offload bytes records nothing."""
+        (admission-only) and moved no offload bytes records nothing.
+        Returns the committed sample (None for an empty tick) so the
+        attribution ledger and anomaly detector (ISSUE 13) can consume
+        the same record the window keeps."""
         p, self._pending = self._pending, None
         if p is None:
-            return
+            return None
         p.wall_ms = float(wall_ms)
         p.mono_ts = time.monotonic()
         with self._lock:
@@ -355,6 +358,7 @@ class PerfAccountant:
             self.bytes_total["h2d"] += p.bytes_h2d
             self.decode_tokens_total += p.decode_tokens
             self.prefill_tokens_total += p.prefill_tokens
+        return p
 
     # -- scrape-time reads ---------------------------------------------
     def window(self) -> tuple:

@@ -33,7 +33,8 @@ DEFAULT_MAX_TOKENS = 32
 # canonical list; the fleet imports it too.
 INTERNAL_BODY_KEYS = ("_request_id", "_trace", "_deadline_epoch",
                       "_continue_tokens", "_token_offset",
-                      "_session", "_resume_offset", "_chat")
+                      "_session", "_resume_offset", "_chat",
+                      "_tenant")
 
 
 class LLMServerImpl:
@@ -169,6 +170,19 @@ class LLMServerImpl:
         return toks
 
     @staticmethod
+    def _tenant_of(body: Dict[str, Any]) -> str:
+        """Tenant identity for cost attribution (ISSUE 13): the fleet
+        ingress mints `_tenant` at admission (from the OpenAI `user`
+        field, "" for the default tenant); a standalone server reads
+        the same client fields directly. "" = default tenant — its
+        label is omitted from expositions."""
+        t = body.pop("_tenant", None)
+        if t is None:
+            t = body.get("user") or body.get("tenant") or ""
+        t = str(t)
+        return "" if t == "default" else t
+
+    @staticmethod
     def _priority_of(body: Dict[str, Any]) -> int:
         """Preemption priority (ISSUE 10, API extension): under page
         pressure the engine parks the LOWEST priority first. Clients
@@ -184,8 +198,8 @@ class LLMServerImpl:
                         rid: "str | None" = None,
                         trace: "Dict[str, str] | None" = None,
                         deadline: "float | None" = None,
-                        priority: int = 0
-                        ) -> Request:
+                        priority: int = 0,
+                        tenant: str = "") -> Request:
         self._ensure_pump()
         # a rid already in flight (a client replaying another request's
         # `_request_id`) must not collide: the duplicate would overwrite
@@ -195,7 +209,7 @@ class LLMServerImpl:
             rid = uuid.uuid4().hex[:16]
         req = Request(rid, prompt_tokens, params, lora=lora,
                       trace=trace, deadline=deadline,
-                      priority=priority)
+                      priority=priority, tenant=tenant)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -243,6 +257,23 @@ class LLMServerImpl:
             stop_token_ids=stop,
             seed=None if seed is None else int(seed))
 
+    def _usage(self, toks: List[int], req: Request) -> Dict[str, Any]:
+        """OpenAI usage block + the `cost` extension (ISSUE 13): the
+        request's attribution receipt — analytic FLOPs/HBM bytes, KV
+        page-ticks, queue/wall time shares — so a caller can see what
+        its completion consumed, not just how many tokens it got."""
+        usage = {
+            "prompt_tokens": len(toks),
+            "completion_tokens": len(req.output_tokens),
+            "total_tokens": len(toks) + len(req.output_tokens),
+        }
+        attrib = getattr(self.engine, "attrib", None)
+        if attrib is not None:
+            rec = attrib.receipt(req.request_id)
+            if rec is not None:
+                usage["cost"] = rec.cost_block()
+        return usage
+
     async def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
         rid, trace = self._trace_of(body)
         deadline = self._deadline_of(body)
@@ -251,7 +282,8 @@ class LLMServerImpl:
                                    lora=self._lora_for(body),
                                    rid=rid, trace=trace,
                                    deadline=deadline,
-                                   priority=self._priority_of(body))
+                                   priority=self._priority_of(body),
+                                   tenant=self._tenant_of(body))
         text = self.tokenizer.decode(req.output_tokens)
         return {
             "id": f"chatcmpl-{req.request_id}",
@@ -263,11 +295,7 @@ class LLMServerImpl:
                 "message": {"role": "assistant", "content": text},
                 "finish_reason": req.finish_reason,
             }],
-            "usage": {
-                "prompt_tokens": len(toks),
-                "completion_tokens": len(req.output_tokens),
-                "total_tokens": len(toks) + len(req.output_tokens),
-            },
+            "usage": self._usage(toks, req),
         }
 
     async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -278,7 +306,8 @@ class LLMServerImpl:
                                    lora=self._lora_for(body),
                                    rid=rid, trace=trace,
                                    deadline=deadline,
-                                   priority=self._priority_of(body))
+                                   priority=self._priority_of(body),
+                                   tenant=self._tenant_of(body))
         return {
             "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
@@ -289,11 +318,7 @@ class LLMServerImpl:
                 "text": self.tokenizer.decode(req.output_tokens),
                 "finish_reason": req.finish_reason,
             }],
-            "usage": {
-                "prompt_tokens": len(toks),
-                "completion_tokens": len(req.output_tokens),
-                "total_tokens": len(toks) + len(req.output_tokens),
-            },
+            "usage": self._usage(toks, req),
         }
 
     async def _generate_stream(self, prompt_tokens: List[int],
@@ -303,7 +328,8 @@ class LLMServerImpl:
                                trace: "Dict[str, str] | None" = None,
                                deadline: "float | None" = None,
                                decode_ctx: "List[int] | None" = None,
-                               priority: int = 0):
+                               priority: int = 0,
+                               tenant: str = ""):
         """Yield (new_tokens, text_delta, finished, finish_reason) as
         tokens land — token ids AND text per event, so both the SSE
         wrappers (text) and the fleet's failover relay (token-exact
@@ -319,7 +345,7 @@ class LLMServerImpl:
             rid = uuid.uuid4().hex[:16]      # id must never collide
         req = Request(rid, prompt_tokens, params, lora=lora,
                       trace=trace, deadline=deadline,
-                      priority=priority)
+                      priority=priority, tenant=tenant)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         ctx = list(decode_ctx or [])
@@ -361,7 +387,8 @@ class LLMServerImpl:
         async for _, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
-                priority=self._priority_of(body)):
+                priority=self._priority_of(body),
+                tenant=self._tenant_of(body)):
             if not delta and not finished:
                 continue                 # no text yet: hold the chunk
             chunk = {
@@ -385,7 +412,8 @@ class LLMServerImpl:
         async for _, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
-                priority=self._priority_of(body)):
+                priority=self._priority_of(body),
+                tenant=self._tenant_of(body)):
             if not delta and not finished:
                 continue
             chunk = {
@@ -415,7 +443,8 @@ class LLMServerImpl:
         async for new, delta, finished, reason in self._generate_stream(
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
-                decode_ctx=cont, priority=self._priority_of(body)):
+                decode_ctx=cont, priority=self._priority_of(body),
+                tenant=self._tenant_of(body)):
             yield {"i": idx, "toks": list(new), "text": delta,
                    "finished": bool(finished),
                    "reason": reason if finished else None,
@@ -663,6 +692,13 @@ class LLMServerImpl:
         """The engine flight recorder's ring, oldest first."""
         return self.engine.telemetry.recorder.events()
 
+    async def debug_attribution(self, top_k: int = 8
+                                ) -> Dict[str, Any]:
+        """GET /debug/attribution (ISSUE 13): top-K cost receipts by
+        FLOPs, per-tenant rollups, conservation totals. Ledger-locked
+        host reads — never queues behind a tick, so no executor."""
+        return self.engine.attribution_summary(int(top_k))
+
     async def debug_dump(self, body: "Dict[str, Any] | None" = None
                          ) -> Dict[str, Any]:
         """POST /debug/dump: snapshot a postmortem black-box bundle on
@@ -745,6 +781,16 @@ class LLMServerImpl:
             # rows and the fleet gauges see utilization per replica
             "perf": (eng.perf.brief() if eng.perf is not None
                      else None),
+            # tick-anomaly analyzer (ISSUE 13): the recent anomaly
+            # rate + totals ride every snapshot so /fleet rows show
+            # them and the fleet watchdog reads the rate as a page
+            # precursor
+            "anomaly": (None if eng.anomaly is None else {
+                "rate": eng.anomaly.rate(),
+                "total": eng.anomaly.anomalies_total,
+                "last_kind": ((eng.anomaly.last or {}).get("kind")
+                              if eng.anomaly.last else None),
+            }),
             # cumulative SLO sums the fleet autoscaler deltas into
             # recent-window TTFT / queue-wait means
             "slo_totals": eng.telemetry.slo_totals(),
@@ -877,6 +923,12 @@ class LLMRouterImpl:
             for mid, h in self._unique_servers():
                 out[mid] = await h.debug_events.remote()
             return {"object": "events", "models": out}
+        if norm == "/debug/attribution":
+            # per-request cost receipts + tenant rollups (ISSUE 13)
+            out = {}
+            for mid, h in self._unique_servers():
+                out[mid] = await h.debug_attribution.remote()
+            return {"object": "attribution", "models": out}
         return Response({"error": f"no route {norm}"}, status=404,
                         content_type="application/json")
 

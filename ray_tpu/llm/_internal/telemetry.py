@@ -170,6 +170,33 @@ def _build_metrics() -> Dict[str, Any]:
         "tokens_per_s": G("ray_tpu_llm_tokens_per_s",
                           "token goodput over the recent window span, "
                           "by phase", ("model", "replica", "phase")),
+        # Per-request cost attribution + tick anomalies (ISSUE 13).
+        # Counters advance at SCRAPE time by delta against the
+        # ledger/detector's host totals (update_gauges) — the tick
+        # path never touches a metric. The `tenant` label is "" for
+        # the default tenant and the exposition omits empty labels,
+        # so single-tenant scrapes stay byte-identical (the PR 6
+        # `replica` convention).
+        "tenant_flops": C("ray_tpu_llm_tenant_flops_total",
+                          "analytic model FLOPs attributed to "
+                          "finished requests, per tenant",
+                          ("model", "replica", "tenant")),
+        "tenant_hbm": C("ray_tpu_llm_tenant_hbm_bytes_total",
+                        "analytic device-HBM bytes attributed to "
+                        "finished requests, per tenant",
+                        ("model", "replica", "tenant")),
+        "tenant_tokens": C("ray_tpu_llm_tenant_tokens_total",
+                           "tokens attributed to finished requests, "
+                           "per tenant and phase",
+                           ("model", "replica", "tenant", "phase")),
+        "anomalies": C("ray_tpu_llm_tick_anomalies_total",
+                       "classified tick anomalies by kind "
+                       "(recompile | h2d_transfer | gc_pause | "
+                       "host_fold_stall | device_straggler | unknown)",
+                       ("model", "replica", "kind")),
+        "anomaly_rate": G("ray_tpu_llm_tick_anomaly_rate",
+                          "anomalous fraction of the recent tick "
+                          "window", keys),
     }
 
 
@@ -414,7 +441,11 @@ class EngineTelemetry:
             self._m["itl"].observe(gap, self._tags)
         self._m["generated_tokens"].inc(1, self._tags)
 
-    def on_finished(self, req, reason: str) -> None:
+    def on_finished(self, req, reason: str,
+                    cost: Optional[Dict[str, Any]] = None) -> None:
+        """`cost` is the request's closed attribution receipt brief
+        (ISSUE 13) — it rides the retirement flight-recorder event so
+        the finish evidence names what the request consumed."""
         if not self.enabled:
             return
         now = _now()
@@ -438,7 +469,8 @@ class EngineTelemetry:
             self._m["aborts"].inc(1, self._tags)
         self.recorder.record(
             "retirement", request_id=req.request_id, reason=reason,
-            generated_tokens=len(req.output_tokens))
+            generated_tokens=len(req.output_tokens),
+            **({"cost": cost} if cost else {}))
 
     def on_drain(self, cause: str) -> None:
         if not self.enabled:
@@ -554,6 +586,45 @@ class EngineTelemetry:
                         self._m["hbm_bytes"].inc(
                             d, {**self._tags, "kind": kind})
                         self._perf_exported[kind] = cur
+        # per-tenant attribution counters (ISSUE 13): same scrape-time
+        # delta pattern against the ledger's monotone finished-receipt
+        # rollups; the default tenant exports with tenant="" (label
+        # omitted) so single-tenant scrapes keep their series identity
+        attrib = getattr(engine, "attrib", None)
+        if attrib is not None:
+            rows = attrib.tenants()
+            with self._lock:
+                for tenant, t in rows.items():
+                    lbl = "" if tenant == "default" else tenant
+                    base = {**self._tags, "tenant": lbl}
+                    for wk, metric, tags, cur in (
+                            (f"tnf:{tenant}", "tenant_flops", base,
+                             float(t["flops"])),
+                            (f"tnh:{tenant}", "tenant_hbm", base,
+                             float(t["hbm_bytes"])),
+                            (f"tnd:{tenant}", "tenant_tokens",
+                             {**base, "phase": "decode"},
+                             float(t["decode_tokens"])),
+                            (f"tnp:{tenant}", "tenant_tokens",
+                             {**base, "phase": "prefill"},
+                             float(t["prefill_tokens"]))):
+                        d = cur - self._perf_exported.get(wk, 0.0)
+                        if d > 0:
+                            self._m[metric].inc(d, tags)
+                            self._perf_exported[wk] = cur
+        # tick-anomaly counters/rate (ISSUE 13)
+        anomaly = getattr(engine, "anomaly", None)
+        if anomaly is not None:
+            st = anomaly.stats()
+            self._m["anomaly_rate"].set(st["rate"], self._tags)
+            with self._lock:
+                for kind, cur in st["by_kind"].items():
+                    wk = f"anom:{kind}"
+                    d = float(cur) - self._perf_exported.get(wk, 0.0)
+                    if d > 0:
+                        self._m["anomalies"].inc(
+                            d, {**self._tags, "kind": kind})
+                        self._perf_exported[wk] = float(cur)
 
     def slo_totals(self) -> Dict[str, float]:
         """Cumulative SLO sums/counts (seconds / observations).

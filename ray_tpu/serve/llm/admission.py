@@ -60,6 +60,30 @@ class AdmissionRejected(Exception):
         self.retry_after_s = retry_after_s
 
 
+def admission_metrics() -> Dict[str, Any]:
+    """Tenant-labeled front-door series (ISSUE 13 satellite): noisy-
+    tenant diagnosis — whose queue waits grew, who is being shed —
+    without log archaeology. Registered idempotently in the ingress
+    process registry. The default tenant exports tenant="" and the
+    exposition omits empty labels, so single-tenant scrapes stay
+    byte-identical (the PR 6 `replica` convention)."""
+    from ...llm._internal.telemetry import LATENCY_BOUNDARIES
+    from ...util import metrics as metrics_api
+    return {
+        "queue_wait": metrics_api.Histogram(
+            "ray_tpu_llm_fleet_queue_wait_seconds",
+            "front-door admission queue wait of ADMITTED requests, "
+            "per tenant", boundaries=LATENCY_BOUNDARIES,
+            tag_keys=("model", "tenant")),
+        "rejected": metrics_api.Counter(
+            "ray_tpu_llm_fleet_admission_rejected_total",
+            "front-door rejections per tenant and reason "
+            "(queue_full | brownout -> 429; queue_wait_slo = SLO "
+            "shed -> 429; deadline -> 504)",
+            ("model", "tenant", "reason")),
+    }
+
+
 class _Ticket:
     __slots__ = ("tenant", "vtime", "seq", "future", "queued_at")
 
@@ -78,8 +102,15 @@ class _Ticket:
 class AdmissionController:
     """`await acquire(tenant)` then `release()` around each dispatch."""
 
-    def __init__(self, config: Optional[AdmissionConfig] = None):
+    def __init__(self, config: Optional[AdmissionConfig] = None,
+                 metrics_model_id: Optional[str] = None):
         self.config = config or AdmissionConfig()
+        # tenant-labeled Prometheus series (ISSUE 13 satellite): off
+        # unless the owner names a model id — bare unit-test
+        # controllers stay registry-silent
+        self._metrics = (admission_metrics()
+                         if metrics_model_id is not None else None)
+        self._mtags = {"model": metrics_model_id or ""}
         self.inflight = 0
         self._heap: List[_Ticket] = []
         self._dead = 0     # shed/cancelled tickets still in the heap
@@ -139,6 +170,18 @@ class AdmissionController:
         self.brownout = on
         return True
 
+    @staticmethod
+    def _tenant_label(tenant: str) -> str:
+        # the default tenant's label is "" (omitted from expositions)
+        return "" if tenant in ("", "default") else tenant
+
+    def _count_reject(self, tenant: str, reason: str) -> None:
+        self.rejected[reason] += 1
+        if self._metrics is not None:
+            self._metrics["rejected"].inc(
+                1, {**self._mtags, "reason": reason,
+                    "tenant": self._tenant_label(tenant)})
+
     def _queue_len(self) -> int:
         # done tickets still heaped are exactly the shed/cancelled
         # ones (_dead): grants pop their ticket before resolving it
@@ -167,12 +210,18 @@ class AdmissionController:
                 continue             # shed while queued
             self.inflight += 1
             self._vtime = max(self._vtime, t.vtime)
-            self._record_admit(time.monotonic() - t.queued_at)
+            self._record_admit(time.monotonic() - t.queued_at,
+                               t.tenant)
             t.future.set_result(None)
 
-    def _record_admit(self, wait_s: float) -> None:
+    def _record_admit(self, wait_s: float,
+                      tenant: str = "default") -> None:
         self.admitted += 1
         self._recent_waits.append(wait_s)
+        if self._metrics is not None:
+            self._metrics["queue_wait"].observe(
+                wait_s, {**self._mtags,
+                         "tenant": self._tenant_label(tenant)})
 
     def _prune_pass(self) -> None:
         # entries at or below the global floor are semantically dead —
@@ -201,7 +250,7 @@ class AdmissionController:
             # NOT counted into shed_total: a deadline shed is the
             # client's budget spent, not fleet overload — it must not
             # feed the autoscaler's shed_delta breach signal
-            self.rejected["deadline"] += 1
+            self._count_reject(tenant, "deadline")
             raise AdmissionRejected("deadline", self.retry_after())
         # flush cancelled heap heads / spare capacity first, so the
         # queue-full check below sees the true backlog
@@ -215,7 +264,7 @@ class AdmissionController:
                       if limit < cfg.max_queue
                       and self._queue_len() < cfg.max_queue
                       else "queue_full")
-            self.rejected[reason] += 1
+            self._count_reject(tenant, reason)
             raise AdmissionRejected(reason, self.retry_after())
         vtime = max(self._pass.get(tenant, 0.0), self._vtime) \
             + 1.0 / self._weight(tenant)
@@ -246,7 +295,7 @@ class AdmissionController:
                       if deadline is not None
                       and timeout < cfg.queue_wait_slo_s
                       else "queue_wait_slo")
-            self.rejected[reason] += 1
+            self._count_reject(tenant, reason)
             if reason != "deadline":
                 self.shed_total += 1
             raise AdmissionRejected(reason,
@@ -306,4 +355,5 @@ class AdmissionController:
         }
 
 
-__all__ = ["AdmissionConfig", "AdmissionController", "AdmissionRejected"]
+__all__ = ["AdmissionConfig", "AdmissionController",
+           "AdmissionRejected", "admission_metrics"]

@@ -21,6 +21,9 @@ Ingress HTTP surface (rides the existing proxy):
                                    fleet (replica-tagged series)
     GET  /debug/events             per-replica flight recorders
     GET  /debug/trace              merged Chrome-trace lifecycles
+    GET  /fleet/debug/attribution  fleet-merged per-request cost
+                                   receipts + tenant rollups
+                                   (?k=&tenant= — ISSUE 13)
 Overload returns 429 with a Retry-After header (admission.py).
 """
 
@@ -272,6 +275,35 @@ class LLMFleetIngressImpl:
                 request_id=query.get("request_id"))
             return {"object": "events", "events": merged,
                     "ingress": self.fleet.recorder.stats()}
+        if norm == "/fleet/debug/attribution":
+            # ISSUE 13: fleet-merged cost attribution — every
+            # replica's top receipts re-ranked into ONE top-K and the
+            # tenant rollups summed fleet-wide (?k= bounds the list;
+            # ?tenant= filters the receipt rows)
+            per = await self._fanout("debug_attribution")
+            try:
+                k = max(int(query.get("k") or 8), 1)
+            except ValueError:
+                k = 8
+            want_tenant = query.get("tenant")
+            tenants: Dict[str, Dict[str, float]] = {}
+            top: List[Dict[str, Any]] = []
+            for rid, doc in sorted(per.items()):
+                if not isinstance(doc, dict) or "error" in doc:
+                    continue
+                for row in doc.get("top") or []:
+                    if want_tenant and row.get("tenant") != want_tenant:
+                        continue
+                    top.append({**row, "replica": rid})
+                for t, v in (doc.get("tenants") or {}).items():
+                    agg = tenants.setdefault(t, {})
+                    for key, val in v.items():
+                        agg[key] = agg.get(key, 0) + val
+            top.sort(key=lambda r: (-r.get("flops", 0),
+                                    r.get("request_id", "")))
+            return {"object": "attribution", "model": self.model_id,
+                    "top": top[:k], "tenants": tenants,
+                    "replicas": per}
         if norm == "/fleet/debug/bundles":
             # list every replica's black-box spool; ?replica=&id=
             # fetches one bundle

@@ -169,7 +169,10 @@ class FleetManager:
                 f"need 1 <= min_replicas={auto.min_replicas} "
                 f"<= max_replicas={auto.max_replicas}")
         self.router = FleetRouter(router)
-        self.admission = AdmissionController(admission)
+        # tenant-labeled front-door series (ISSUE 13 satellite) tag
+        # with this fleet's model id
+        self.admission = AdmissionController(
+            admission, metrics_model_id=model_id)
         self.autoscaler = FleetAutoscaler(auto)
         self.refresh_period_s = refresh_period_s
         self.autoscale_period_s = autoscale_period_s
@@ -316,6 +319,12 @@ class FleetManager:
         # copy, never the caller's dict)
         body = {k: v for k, v in body.items()
                 if k not in _INTERNAL_BODY_KEYS}
+        # mint the tenant identity at admission (ISSUE 13): the
+        # replica tags the engine Request (cost receipts, per-tenant
+        # counters) with it; "" = default tenant so single-tenant
+        # expositions stay label-free
+        tenant = self.tenant_of(body)
+        body["_tenant"] = "" if tenant == "default" else tenant
         if not self.enable_tracing:
             return body, None
         # ALWAYS mint — `_request_id` doubles as the engine request id
@@ -1325,6 +1334,7 @@ class FleetManager:
         # non-spillable pressured fleet sheds at the front door
         pressure = 0.0
         spillable = True
+        anomaly_rate = 0.0
         for st in self.replicas.values():
             snap = st.snapshot
             if snap is None or st.status != ACTIVE:
@@ -1332,7 +1342,11 @@ class FleetManager:
             if snap.page_pressure > pressure:
                 pressure = snap.page_pressure
                 spillable = snap.spillable
+            anomaly_rate = max(anomaly_rate, snap.anomaly_rate)
         self.watchdog.observe_pressure(pressure)
+        # tick-anomaly page precursor (ISSUE 13): watch-only — the
+        # alert precedes SLO burn, it never sheds on its own
+        self.watchdog.observe_anomaly(anomaly_rate)
         pressure_shed = (self.watchdog.pressure_state == "high"
                          and not spillable)
         self.admission.set_page_pressure(pressure, spillable)
@@ -1596,6 +1610,12 @@ class FleetManager:
                     "decode_tokens_per_s": round(snap.decode_tps, 3),
                     "prefill_tokens_per_s": round(
                         snap.prefill_tps, 3),
+                    # tick-anomaly analyzer (ISSUE 13): recent
+                    # anomaly rate + lifetime count per replica
+                    "anomaly_rate": round(snap.anomaly_rate, 4),
+                    "anomalies_total": snap.anomalies_total,
+                    **({"anomaly_last_kind": snap.anomaly_last_kind}
+                       if snap.anomaly_last_kind else {}),
                     # snapshot age (ISSUE 9): how old the routing
                     # inputs above are — stale = probes failing
                     "snapshot_age_s": round(snap.age_s(), 3),
@@ -1615,6 +1635,10 @@ class FleetManager:
                 # fleet page-pressure monitor (ISSUE 10)
                 "page_pressure": round(self.watchdog.last_pressure, 4),
                 "pressure_state": self.watchdog.pressure_state,
+                # tick-anomaly page precursor (ISSUE 13)
+                "anomaly_rate": round(
+                    self.watchdog.last_anomaly_rate, 4),
+                "anomaly_state": self.watchdog.anomaly_state,
             },
             "tracing": {
                 "enabled": self.enable_tracing,

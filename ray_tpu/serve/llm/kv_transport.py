@@ -179,8 +179,8 @@ def _decode_frame(blob: bytes, expect_kind: Optional[str] = None
 
 _SESSION_META_KEYS = (
     "request_id", "prompt_tokens", "output_tokens", "params", "lora",
-    "priority", "restarts", "trace", "deadline_epoch", "seed",
-    "position", "last_token", "n_pages")
+    "priority", "tenant", "restarts", "trace", "deadline_epoch",
+    "seed", "position", "last_token", "n_pages")
 
 
 def encode_session(state: Dict[str, Any]) -> bytes:
@@ -289,20 +289,32 @@ class _PrefixEntry:
     tokens: int                  # full-page token count stored
     publisher: str               # replica that exported it
     seeded: set = dataclasses.field(default_factory=set)
+    hits: int = 0                # lookups that found this entry
+    last_seq: int = 0            # recency stamp (store-wide counter)
 
 
 class FleetPrefixStore:
     """Fleet-shared prefix tier: prefix fingerprint → serialized full
-    prompt pages, LRU-bounded by bytes. Lives in the ingress process
-    (one per FleetManager); replicas are SEEDED lazily — the first
-    time the router lands a stored prefix on a replica that has not
-    seen it, the fleet imports the pages there before dispatching, so
-    the replica's own prefix cache hits exactly as if it had
-    prefilled the prompt itself."""
+    prompt pages, byte-bounded. Lives in the ingress process (one per
+    FleetManager); replicas are SEEDED lazily — the first time the
+    router lands a stored prefix on a replica that has not seen it,
+    the fleet imports the pages there before dispatching, so the
+    replica's own prefix cache hits exactly as if it had prefilled
+    the prompt itself.
+
+    Eviction is HIT-FREQUENCY-WEIGHTED, not LRU-by-bytes (ROADMAP
+    item 2 "REMAINS"): under byte pressure the victim is the entry
+    with the lowest hits-per-byte score (ties broken
+    least-recently-used). A hot small system prompt — the store's
+    whole reason to exist — therefore outlives a cold large prefix
+    that happens to have arrived later, where pure LRU would churn
+    the hot entry out the moment a burst of large cold prefixes
+    passed through."""
 
     def __init__(self, capacity_bytes: int = 256 << 20):
         self.capacity_bytes = int(capacity_bytes)
         self._entries: "OrderedDict[str, _PrefixEntry]" = OrderedDict()
+        self._seq = 0
         self.bytes_used = 0
         self.publishes = 0
         self.hits = 0                # imports that seeded a replica
@@ -317,8 +329,18 @@ class FleetPrefixStore:
     def get(self, fp: str) -> Optional[_PrefixEntry]:
         ent = self._entries.get(fp)
         if ent is not None:
-            self._entries.move_to_end(fp)
+            self._seq += 1
+            ent.hits += 1
+            ent.last_seq = self._seq
         return ent
+
+    @staticmethod
+    def _score(ent: _PrefixEntry) -> "Tuple[float, int]":
+        """Eviction priority, LOWEST evicted first: hit frequency per
+        byte (a hot small entry scores far above a cold large one),
+        recency as the tie-break. New entries start at 0 hits — they
+        must earn their residency."""
+        return (ent.hits / max(ent.nbytes, 1), ent.last_seq)
 
     def put(self, fp: str, payload: str, tokens: int,
             publisher: str) -> Optional[_PrefixEntry]:
@@ -332,12 +354,15 @@ class FleetPrefixStore:
             return None
         while self.bytes_used + nbytes > self.capacity_bytes \
                 and self._entries:
-            _, old = self._entries.popitem(last=False)
+            victim = min(self._entries,
+                         key=lambda k: self._score(self._entries[k]))
+            old = self._entries.pop(victim)
             self.bytes_used -= old.nbytes
             self.evictions += 1
+        self._seq += 1
         ent = _PrefixEntry(payload=payload, nbytes=nbytes,
                            tokens=tokens, publisher=publisher,
-                           seeded={publisher})
+                           seeded={publisher}, last_seq=self._seq)
         self._entries[fp] = ent
         self.bytes_used += nbytes
         self.publishes += 1
@@ -348,6 +373,7 @@ class FleetPrefixStore:
             "entries": len(self._entries),
             "bytes_used": self.bytes_used,
             "capacity_bytes": self.capacity_bytes,
+            "policy": "hit-frequency-weighted",
             "publishes": self.publishes,
             "hits": self.hits,
             "evictions": self.evictions,

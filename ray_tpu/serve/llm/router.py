@@ -155,6 +155,12 @@ class ReplicaSnapshot:
     decode_tps: float = 0.0
     prefill_tps: float = 0.0
     roof: str = ""
+    # tick-anomaly analyzer (ISSUE 13): the replica's recent anomaly
+    # rate + lifetime count — surfaced in /fleet rows; the fleet
+    # watchdog reads the max rate as a page precursor
+    anomaly_rate: float = 0.0
+    anomalies_total: int = 0
+    anomaly_last_kind: str = ""
     ts: float = dataclasses.field(default_factory=time.time)
     # MONOTONIC stamp of when this snapshot was taken (ISSUE 9): a
     # replica whose probes keep failing keeps its LAST snapshot, so
@@ -169,6 +175,7 @@ class ReplicaSnapshot:
     @classmethod
     def from_stats(cls, stats: Dict[str, Any]) -> "ReplicaSnapshot":
         perf = stats.get("perf") or {}
+        anom = stats.get("anomaly") or {}
         return cls(
             replica=stats.get("replica", ""),
             active=int(stats.get("active", 0)),
@@ -185,7 +192,10 @@ class ReplicaSnapshot:
             mbu=float(perf.get("mbu", 0.0)),
             decode_tps=float(perf.get("decode_tokens_per_s", 0.0)),
             prefill_tps=float(perf.get("prefill_tokens_per_s", 0.0)),
-            roof=str(perf.get("roof", "")))
+            roof=str(perf.get("roof", "")),
+            anomaly_rate=float(anom.get("rate", 0.0)),
+            anomalies_total=int(anom.get("total", 0)),
+            anomaly_last_kind=str(anom.get("last_kind") or ""))
 
 
 @dataclasses.dataclass

@@ -78,6 +78,16 @@ class WatchdogConfig:
     # consecutive observations over `high` before flagging (one
     # bursty probe must not alert)
     page_pressure_count: int = 2
+    # Tick-anomaly page PRECURSOR (ISSUE 13): max recent anomaly rate
+    # over active replicas (from each engine's TickAnomalyDetector).
+    # Sustained rate past `high` flags anomaly_state="high" — an
+    # early-warning alert BEFORE SLO burn shows up (a stalling/
+    # recompiling replica goes anomalous ticks before it goes slow
+    # enough to burn budget); recovery needs it back under `warn`.
+    # Watch-only: it never brownouts the front door on its own.
+    anomaly_rate_high: float = 0.25
+    anomaly_rate_warn: float = 0.10
+    anomaly_count: int = 2
 
 
 class SLOBurnWatchdog:
@@ -119,6 +129,14 @@ class SLOBurnWatchdog:
             "max KV page pressure over active replicas "
             "((used + parked host pages) / usable; > 1 = "
             "oversubscribed)")
+        # tick-anomaly page precursor (ISSUE 13)
+        self.anomaly_state = "ok"
+        self.last_anomaly_rate = 0.0
+        self._anomaly_over = 0
+        self._anomaly_gauge = metrics_api.Gauge(
+            "ray_tpu_llm_fleet_anomaly_rate",
+            "max recent tick-anomaly rate over active replicas "
+            "(the SLO-page precursor signal)")
 
     # -- burn math -----------------------------------------------------
     def _window_delta(self, horizon: float, cur: Dict[str, float],
@@ -179,6 +197,37 @@ class SLOBurnWatchdog:
                 else "page_pressure_clear",
                 pressure=round(self.last_pressure, 4),
                 high=cfg.page_pressure_high)
+        return changed
+
+    # -- tick-anomaly precursor (ISSUE 13) -----------------------------
+    def observe_anomaly(self, rate: float) -> bool:
+        """One fleet-max anomaly-rate observation. Same hysteretic
+        shape as observe_pressure: consecutive readings over `high`
+        flag, recovery under `warn` clears, alert/clear land in the
+        flight recorder. Watch-only — the point is a page PRECURSOR:
+        the alert fires while the SLO budget is still intact, so an
+        operator (or the postmortem reader) sees the anomaly storm
+        that preceded the burn. Returns True on a state change."""
+        cfg = self.config
+        self.last_anomaly_rate = float(rate)
+        self._anomaly_gauge.set(round(self.last_anomaly_rate, 4))
+        prev = self.anomaly_state
+        if self.last_anomaly_rate >= cfg.anomaly_rate_high:
+            self._anomaly_over += 1
+            if self._anomaly_over >= cfg.anomaly_count:
+                self.anomaly_state = "high"
+        elif self.last_anomaly_rate < cfg.anomaly_rate_warn:
+            self._anomaly_over = 0
+            self.anomaly_state = "ok"
+        else:
+            self._anomaly_over = 0       # warn band: hold state
+        changed = self.anomaly_state != prev
+        if changed and self.recorder is not None:
+            self.recorder.record(
+                "anomaly_rate_alert" if self.anomaly_state == "high"
+                else "anomaly_rate_clear",
+                rate=round(self.last_anomaly_rate, 4),
+                high=cfg.anomaly_rate_high)
         return changed
 
     # -- the tick ------------------------------------------------------

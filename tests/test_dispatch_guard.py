@@ -103,6 +103,17 @@ def test_steady_state_decode_zero_transfers_zero_compiles(
     assert perf["enabled"] and perf["window"] >= 32
     assert perf["totals"]["flops"] > 0
     assert 0 < perf["mfu"] <= 1.0
+    # ISSUE 13: attribution + anomaly detection are ON by default and
+    # were LIVE inside the guarded window — per-request receipts grew
+    # (3 decode tokens charged per tick) and the detector observed
+    # every tick — while adding zero transfers and zero compiles
+    attrib = eng.stats()["attribution"]
+    assert attrib["enabled"] and attrib["live"] == 3
+    assert attrib["ticks_total"] >= 32
+    assert attrib["totals"]["decode_tokens"] >= 96
+    anomaly = eng.stats()["anomaly"]
+    assert anomaly["enabled"] and anomaly["ticks"] >= 32
+    assert anomaly["anomalies_total"] == 0      # steady state IS steady
 
 
 @pytest.mark.parametrize("sp", [

@@ -630,6 +630,32 @@ def test_jl009_self_telemetry_and_recorder_forms(tmp_path):
     assert all(f.func.endswith("run") for f in fs)
 
 
+def test_jl009_attribution_anomaly_receivers(tmp_path):
+    """ISSUE 13 regression: the attribution ledger / anomaly detector
+    receivers are instrumentation — a charge or observe call frozen
+    under a trace would record once at trace time and never again
+    (and its wall-clock reads are host work). Flagged under jit;
+    clean as the engine's actual host-side pattern."""
+    fs = _lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(self, x):
+            self.attrib.charge(self.req, {}, decode_tokens=1)
+            self.anomaly.observe(x, 1.0, 0.0, 0.0, 0, 1.0, 1.0)
+            return x
+    """, select={"JL009"})
+    assert {f.detail for f in fs} == {"self.attrib.charge",
+                                      "self.anomaly.observe"}
+    fs = _lint(tmp_path, """
+        def tick(self, wall):              # host side of the boundary
+            self.attrib.commit(self.sample, host_ms=wall)
+            self.anomaly.observe(self.sample, wall, 0.0, 0.0,
+                                 self.compiles, 1.0, 1.0)
+    """, select={"JL009"})
+    assert fs == []
+
+
 def test_jl009_host_side_instrumentation_clean(tmp_path):
     """The engine's actual pattern — recording from host-side fold /
     admission code and bare `observe(...)` world-model calls under
@@ -832,6 +858,8 @@ def test_engine_hot_path_has_zero_baselined_findings():
         assert "llm/_internal/kv_offload.py" not in path
         assert "llm/_internal/kv_cache.py" not in path
         assert "llm/_internal/perfmodel.py" not in path
+        assert "llm/_internal/attribution.py" not in path
+        assert "llm/_internal/anomaly.py" not in path
         assert "models/llama_infer.py" not in path
         assert "/ops/" not in path
     # the ISSUE 10 offload/preemption module exists inside the
@@ -848,6 +876,15 @@ def test_engine_hot_path_has_zero_baselined_findings():
     assert proc.returncode == 0, (
         "jaxlint findings in perfmodel.py (zero-entry module):\n"
         + proc.stdout)
+    # ISSUE 13: the attribution/anomaly planes ride the same tick
+    # path under the same contract (pure host arithmetic, no jax)
+    for fname in ("attribution.py", "anomaly.py"):
+        path = REPO / "ray_tpu/llm/_internal" / fname
+        assert path.exists(), fname
+        proc = _cli(f"ray_tpu/llm/_internal/{fname}")
+        assert proc.returncode == 0, (
+            f"jaxlint findings in {fname} (zero-entry module):\n"
+            + proc.stdout)
 
 
 def test_serve_llm_fleet_has_zero_baselined_findings():

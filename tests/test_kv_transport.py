@@ -832,6 +832,54 @@ def test_e2e_transport_status_surface(transport_servers):
     assert doc2["transport"] == {"enabled": False}
 
 
+def test_prefix_store_hot_small_outlives_cold_large():
+    """ISSUE 13 satellite (ROADMAP item 2 "REMAINS"): eviction is
+    hit-frequency-weighted, not LRU-by-bytes — a HOT small prefix
+    (the shared system prompt the store exists for) must survive byte
+    pressure that evicts a COLD large one, even when the large one
+    arrived later (pure LRU would evict the hot entry here)."""
+    from ray_tpu.serve.llm.kv_transport import FleetPrefixStore
+
+    store = FleetPrefixStore(capacity_bytes=1000)
+    assert store.put("hot", "h" * 100, tokens=8, publisher="r0")
+    for _ in range(5):
+        assert store.get("hot") is not None      # it earns residency
+    # a cold large entry lands AFTER the hot one (more recent under
+    # LRU) and fills most of the store
+    assert store.put("cold", "c" * 800, tokens=64, publisher="r0")
+    # byte pressure: the next put must evict — the victim is the
+    # cold large entry (0 hits), NOT the older-but-hot small one
+    assert store.put("new", "n" * 500, tokens=32, publisher="r1")
+    assert "hot" in store
+    assert "cold" not in store
+    assert store.evictions == 1
+    assert store.stats()["policy"] == "hit-frequency-weighted"
+    # repeated pressure: the fresh entry (0 hits) goes before hot
+    assert store.put("new2", "m" * 500, tokens=32, publisher="r1")
+    assert "hot" in store and "new" not in store
+
+
+def test_prefix_store_frequency_ties_break_lru():
+    """Among equally-cold entries the LEAST recently used evicts
+    first (recency is the score's tie-break)."""
+    from ray_tpu.serve.llm.kv_transport import FleetPrefixStore
+
+    store = FleetPrefixStore(capacity_bytes=300)
+    store.put("a", "a" * 100, tokens=8, publisher="r0")
+    store.put("b", "b" * 100, tokens=8, publisher="r0")
+    store.put("c", "c" * 100, tokens=8, publisher="r0")
+    store.get("a")                    # a is now most recent AND hot
+    store.get("b")
+    store.get("b")                    # b hotter than a; c coldest
+    store.put("d", "d" * 100, tokens=8, publisher="r0")
+    assert "c" not in store           # 0 hits: out first
+    assert {"a", "b", "d"} <= {k for k in ("a", "b", "d")
+                               if k in store}
+    store.put("e", "e" * 100, tokens=8, publisher="r0")
+    assert "d" not in store           # 0 hits, least recent of those
+    assert "a" in store and "b" in store
+
+
 def test_fleet_config_wire_carries_transport_and_roles():
     """FleetConfig -> to_wire -> ingress-side reconstruction keeps
     the transport policy and the role map (the deployment path's

@@ -543,3 +543,99 @@ def test_perf_families_cross_process_relabel_topology():
     # nothing for this tag survives WITHOUT a replica label
     assert _sample(merged, "ray_tpu_llm_mfu", model=tag) is None
     assert merged.count("# TYPE ray_tpu_llm_tokens_per_s gauge") == 1
+
+
+# --------- tenant + anomaly families across fleet topologies (ISSUE 13)
+
+def _drive_tenants(eng, gen=8):
+    """Two tenants: the default one ("" — label omitted) and an
+    explicit one, so the tenant-labeled families carry both shapes."""
+    rng = np.random.default_rng(5)
+    for i in range(4):
+        eng.add_request(Request(
+            f"tn{uuid.uuid4().hex[:6]}",
+            rng.integers(2, 250, 12).tolist(),
+            SamplingParams(max_tokens=gen),
+            tenant="acme" if i % 2 else ""))
+    while eng.has_work():
+        eng.step()
+
+
+def test_tenant_anomaly_families_shared_registry_topology():
+    """ISSUE 13 over the shared-registry fleet topology: both
+    replicas' tenant counters and anomaly families render in one
+    exposition; the default tenant's series carry NO tenant label
+    (byte-identical single-tenant contract); merge_expositions over
+    two renders dedups to one series per identity and one HELP/TYPE
+    per family."""
+    from ray_tpu.util.metrics import merge_expositions
+
+    tag = f"tf{uuid.uuid4().hex[:10]}"
+    engines = [make_engine(metrics_model_id=tag,
+                           metrics_replica_id=f"r{i}")
+               for i in range(2)]
+    for eng in engines:
+        _drive_tenants(eng)
+    engines[0].prometheus_metrics()
+    text = engines[1].prometheus_metrics()   # refreshes r1's gauges too
+    for rid in ("r0", "r1"):
+        # explicit tenant labeled; default tenant label-free
+        for tenant_tags in ({"tenant": "acme"}, {}):
+            v = _sample(text, "ray_tpu_llm_tenant_flops_total",
+                        model=tag, replica=rid, **tenant_tags)
+            assert v is not None and v > 0, (rid, tenant_tags)
+            assert _sample(text, "ray_tpu_llm_tenant_hbm_bytes_total",
+                           model=tag, replica=rid,
+                           **tenant_tags) is not None
+            for phase in ("decode", "prefill"):
+                assert _sample(text, "ray_tpu_llm_tenant_tokens_total",
+                               model=tag, replica=rid, phase=phase,
+                               **tenant_tags) is not None
+        assert _sample(text, "ray_tpu_llm_tick_anomaly_rate",
+                       model=tag, replica=rid) == 0.0
+    merged = merge_expositions([text,
+                                engines[0].prometheus_metrics()])
+    assert merged.count(
+        "# TYPE ray_tpu_llm_tenant_flops_total counter") == 1
+    assert merged.count(
+        "# TYPE ray_tpu_llm_tick_anomaly_rate gauge") == 1
+    series = [ln.rsplit(" ", 1)[0] for ln in merged.splitlines()
+              if ln.startswith("ray_tpu_llm_tenant_flops_total{")
+              and f'model="{tag}"' in ln]
+    # 2 replicas x 2 tenants, each exactly once after the merge
+    assert len(series) == len(set(series)) == 4
+
+
+def test_tenant_anomaly_families_cross_process_relabel_topology():
+    """ISSUE 13 over the separate-registry topology: identical
+    expositions relabel with replica=<id> before merging — tenant and
+    anomaly series split per replica instead of colliding, and the
+    tenant label survives the relabel untouched."""
+    from ray_tpu.util.metrics import (merge_expositions,
+                                      relabel_exposition)
+
+    tag = f"tx{uuid.uuid4().hex[:10]}"
+    eng = make_engine(metrics_model_id=tag)     # replica unset -> ""
+    _drive_tenants(eng)
+    text = eng.prometheus_metrics()
+    assert _sample(text, "ray_tpu_llm_tenant_flops_total",
+                   model=tag, tenant="acme") is not None
+    merged = merge_expositions([
+        relabel_exposition(text, {"replica": "rA"}),
+        relabel_exposition(text, {"replica": "rB"}),
+    ])
+    for rid in ("rA", "rB"):
+        for tenant_tags in ({"tenant": "acme"}, {}):
+            assert _sample(merged, "ray_tpu_llm_tenant_flops_total",
+                           model=tag, replica=rid,
+                           **tenant_tags) is not None, (rid,
+                                                        tenant_tags)
+        assert _sample(merged, "ray_tpu_llm_tick_anomaly_rate",
+                       model=tag, replica=rid) is not None
+        assert _sample(merged, "ray_tpu_llm_tick_anomalies_total",
+                       model=tag, replica=rid) is None  # none fired
+    # nothing for this tag survives WITHOUT a replica label
+    assert _sample(merged, "ray_tpu_llm_tenant_flops_total",
+                   model=tag, tenant="acme") is None
+    assert merged.count(
+        "# TYPE ray_tpu_llm_tenant_tokens_total counter") == 1

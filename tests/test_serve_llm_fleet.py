@@ -354,6 +354,80 @@ def test_admission_shed_tickets_reaped_under_saturation():
     asyncio.run(main())
 
 
+def test_admission_tenant_labeled_series():
+    """ISSUE 13 satellite: queue-wait / shed / 429 series carry the
+    tenant label — and the DEFAULT tenant exports with NO tenant
+    label, so single-tenant scrapes stay byte-identical (the PR 6
+    `replica` convention)."""
+    import re
+
+    from ray_tpu.util.metrics import export_prometheus
+
+    tag = f"adm{uuid.uuid4().hex[:8]}"
+
+    def sample(text, name, **tags):
+        for line in text.splitlines():
+            m = re.match(r"^([a-zA-Z0-9_]+)(?:\{(.*)\})? (.+)$", line)
+            if m is None or m.group(1) != name:
+                continue
+            got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(2) or ""))
+            if got == {k: str(v) for k, v in tags.items()}:
+                return float(m.group(3))
+        return None
+
+    async def main():
+        adm = AdmissionController(
+            AdmissionConfig(max_concurrent=1, max_queue=0),
+            metrics_model_id=tag)
+        await adm.acquire("default")          # default tenant admits
+        with pytest.raises(AdmissionRejected) as e:
+            await adm.acquire("noisy-tenant")  # full: immediate 429
+        assert e.value.reason == "queue_full"
+        adm.release()
+        await adm.acquire("noisy-tenant")
+        adm.release()
+
+    asyncio.run(main())
+    text = export_prometheus()
+    # default tenant: label OMITTED
+    assert sample(text, "ray_tpu_llm_fleet_queue_wait_seconds_count",
+                  model=tag) == 1.0
+    # explicit tenant: labeled, on both the wait and the 429 series
+    assert sample(text, "ray_tpu_llm_fleet_queue_wait_seconds_count",
+                  model=tag, tenant="noisy-tenant") == 1.0
+    assert sample(text, "ray_tpu_llm_fleet_admission_rejected_total",
+                  model=tag, tenant="noisy-tenant",
+                  reason="queue_full") == 1.0
+    # nothing leaked onto an unlabeled rejection series
+    assert sample(text, "ray_tpu_llm_fleet_admission_rejected_total",
+                  model=tag, reason="queue_full") is None
+
+
+def test_watchdog_anomaly_precursor_hysteresis():
+    """ISSUE 13: the fleet watchdog's tick-anomaly monitor — two
+    consecutive high readings flag, the warn band holds state, and
+    recovery under warn clears; alert/clear land in the recorder."""
+    from ray_tpu.llm._internal.telemetry import FlightRecorder
+    from ray_tpu.serve.llm.watchdog import (SLOBurnWatchdog,
+                                            WatchdogConfig)
+
+    rec = FlightRecorder()
+    wd = SLOBurnWatchdog(WatchdogConfig(
+        anomaly_rate_high=0.25, anomaly_rate_warn=0.10,
+        anomaly_count=2), recorder=rec)
+    assert not wd.observe_anomaly(0.3)          # 1st high: not yet
+    assert wd.anomaly_state == "ok"
+    assert wd.observe_anomaly(0.4)              # 2nd: flags
+    assert wd.anomaly_state == "high"
+    assert not wd.observe_anomaly(0.15)         # warn band: holds
+    assert wd.anomaly_state == "high"
+    assert wd.observe_anomaly(0.05)             # under warn: clears
+    assert wd.anomaly_state == "ok"
+    kinds = [e["event"] for e in rec.events()]
+    assert kinds.count("anomaly_rate_alert") == 1
+    assert kinds.count("anomaly_rate_clear") == 1
+
+
 def test_admission_would_reject_preflight_matches():
     async def main():
         adm = AdmissionController(AdmissionConfig(
@@ -1460,6 +1534,128 @@ def test_ingress_relay_terminates_sse_on_exhausted_failover(
                for d in docs), chunks
     # tokens that made it out before the failure still framed cleanly
     assert any("choices" in d for d in docs)
+
+
+def test_e2e_anomaly_capture_fetchable_via_fleet():
+    """ISSUE 13 acceptance: an injected stall (forced recompile — a
+    cold prefill bucket mid-steady-state) on one replica produces a
+    CLASSIFIED tick_anomaly event, an auto-armed profile capture, and
+    a black-box bundle fetchable at GET /fleet/debug/bundles; the
+    anomaly rate rides the replica's snapshot into the /fleet row,
+    and GET /fleet/debug/attribution merges both replicas' cost
+    receipts."""
+    from ray_tpu.llm._internal.engine import Request, SamplingParams
+    from ray_tpu.llm._internal.server import LLMServerImpl
+    from ray_tpu.serve.llm.deployment import LLMFleetIngressImpl
+
+    tag = f"anomfleet{uuid.uuid4().hex[:8]}"
+    servers = {}
+    for rid in ("r0", "r1"):
+        servers[rid] = LLMServerImpl({
+            "model_id": "m", "model_source": "debug",
+            "engine_kwargs": dict(
+                # batch 4: one slot stays FREE during the steady warm
+                # phase, so the injected long prompt admits (and its
+                # cold-bucket recompile fires) immediately
+                max_batch_size=4, page_size=8, num_pages=128, seed=7,
+                prefill_buckets=(16, 32, 64), max_prefill_tokens=16,
+                metrics_model_id=tag, metrics_replica_id=rid,
+                # fast warmup + no capture rate limits: the test
+                # injects exactly one stall and wants its evidence
+                anomaly={"warmup_ticks": 16, "min_wall_ms": 0.0,
+                         "profile_min_interval_s": 0.0,
+                         "dump_min_interval_s": 0.0}),
+        })
+    fleet = FleetManager(
+        [LocalReplicaClient(rid, srv) for rid, srv in servers.items()],
+        autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        model_id="m")
+    ingress = LLMFleetIngressImpl.__new__(LLMFleetIngressImpl)
+    ingress.model_id = "m"
+    ingress.fleet = fleet
+
+    # warm r0 into steady decode past the detector warmup, then
+    # inject the stall: a prompt far past every warmed bucket forces
+    # a recompile mid-steady-state
+    eng = servers["r0"].engine
+    rng = np.random.default_rng(5)
+    for i in range(3):
+        eng.add_request(Request(
+            f"w{i}", rng.integers(2, 250, 12).tolist(),
+            SamplingParams(max_tokens=200), tenant="tenant-a"))
+    while eng.waiting or any(s.request is not None and not s.ready
+                             for s in eng.slots):
+        eng.step()
+    for _ in range(40):
+        eng.step()
+    assert eng.anomaly.stats()["warmed"]
+    eng.add_request(Request(
+        "stall", rng.integers(2, 250, 60).tolist(),
+        SamplingParams(max_tokens=4)))
+    for _ in range(30):
+        eng.step()
+        if eng.anomaly.anomalies_total:
+            break
+    assert eng.anomaly.anomalies_total >= 1
+    assert eng.anomaly.stats()["by_kind"].get("recompile", 0) >= 1
+    armed = [e for e in eng.telemetry.recorder.events()
+             if e["event"] == "profile_armed"
+             and e.get("trigger") == "tick_anomaly"]
+    assert armed, "profile capture was not auto-armed"
+    # drive a little work on r1 too so the merged attribution doc has
+    # both replicas' receipts
+    eng1 = servers["r1"].engine
+    eng1.add_request(Request("other", rng.integers(2, 250, 12).tolist(),
+                             SamplingParams(max_tokens=4)))
+    while eng1.has_work():
+        eng1.step()
+
+    async def main():
+        await fleet.refresh()
+        status = await fleet.status()
+        bundles = await ingress._handle_get("/fleet/debug/bundles", {})
+        r0_bundles = bundles["replicas"]["r0"]
+        bid = next(b["id"] for b in r0_bundles
+                   if b["cause"] == "tick_anomaly")
+        bundle = await ingress._handle_get(
+            "/fleet/debug/bundles", {"replica": "r0", "id": bid})
+        events = await ingress._handle_get("/fleet/debug/events", {})
+        attribution = await ingress._handle_get(
+            "/fleet/debug/attribution", {})
+        return status, bundle, events, attribution
+
+    status, bundle, events, attribution = asyncio.run(main())
+    # the anomaly rate rode ReplicaSnapshot into the /fleet row
+    row = status["replicas"]["r0"]
+    assert row["anomalies_total"] >= 1
+    assert row["anomaly_rate"] > 0
+    assert row["anomaly_last_kind"] == "recompile"
+    assert status["replicas"]["r1"].get("anomalies_total", 0) == 0
+    assert "anomaly_state" in status["watchdog"]
+    # the fetched bundle IS the anomaly postmortem: the triggering
+    # event AND the detector's stats both survive
+    assert bundle["anomaly_event"]["kind"] == "recompile"
+    assert bundle["anomaly_event"]["compile_delta"] >= 1
+    assert bundle["anomaly"]["anomalies_total"] >= 1
+    assert bundle["attribution"] is not None
+    # the classified event surfaces in the merged fleet event stream
+    kinds = [e["event"] for e in events["events"]]
+    assert "tick_anomaly" in kinds
+    ev = next(e for e in events["events"]
+              if e["event"] == "tick_anomaly")
+    assert ev["anomaly_kind"] == "recompile"
+    assert ev["composition"]["dispatches"] >= 1
+    # merged attribution: both replicas' receipts, one fleet top-K,
+    # summed tenant rollups
+    assert set(attribution["replicas"]) == {"r0", "r1"}
+    assert attribution["top"], "no receipts in the merged doc"
+    assert {r["replica"] for r in attribution["top"]} <= {"r0", "r1"}
+    # the warm decodes are still LIVE: their receipts rank in the
+    # merged top-K under their tenant; rollups count finished ones
+    assert any(r["tenant"] == "tenant-a" for r in attribution["top"])
+    # r1's finished request rolled up fleet-wide
+    assert attribution["tenants"]["default"]["requests"] >= 1
+    _cancel_pumps(servers)
 
 
 def test_fleet_evicts_on_probe_failures_then_readmits():

@@ -43,10 +43,12 @@ ALL_RULES = ("JL001", "JL002", "JL003", "JL004",
 
 # instrumentation receivers (JL009): a call whose dotted receiver
 # chain names one of these — `metrics.*`, `tracing.span`,
-# `self.telemetry.on_token`, `recorder.record` — is observability
-# code and must stay on the HOST side of the dispatch boundary
+# `self.telemetry.on_token`, `recorder.record`, `self.attrib.charge`
+# (ISSUE 13 attribution/anomaly planes) — is observability code and
+# must stay on the HOST side of the dispatch boundary
 INSTRUMENT_RECEIVERS = {"metrics", "tracing", "telemetry",
-                        "_telemetry", "recorder"}
+                        "_telemetry", "recorder", "attrib",
+                        "anomaly"}
 # metric-handle method names specific enough to flag on their own
 # (`ttft.observe(...)` on a bound histogram handle)
 INSTRUMENT_TAILS = {"observe"}
