@@ -47,9 +47,12 @@ def load_library(name: str, source: str) -> Optional[ctypes.CDLL]:
                     raise FileNotFoundError(src_path)
                 os.makedirs(build, exist_ok=True)
                 tmp = so_path + f".tmp{os.getpid()}"
+                # -lrt: shm_open/shm_unlink live in librt on older
+                # glibc (no-op on newer where they moved into libc)
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall",
-                     "-shared", "-pthread", "-o", tmp, src_path],
+                     "-shared", "-pthread", "-o", tmp, src_path,
+                     "-lrt"],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, so_path)   # atomic: racing builders OK
             lib = ctypes.CDLL(so_path)

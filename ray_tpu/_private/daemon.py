@@ -542,11 +542,29 @@ class NodeDaemon:
         ev = asyncio.Event()
         self._register_events[worker_id] = ev
         try:
-            await asyncio.wait_for(ev.wait(), timeout=60.0)
-        except asyncio.TimeoutError:
-            self._kill_proc(handle)
-            raise RuntimeError(
-                f"worker failed to start within 60s; see {log_path}")
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(),
+                        timeout=min(1.0, max(
+                            deadline - time.monotonic(), 0.05)))
+                    break
+                except asyncio.TimeoutError:
+                    # a worker that DIED before registering (bad
+                    # container prefix, sandbox mount failure, import
+                    # crash) fails the spawn immediately instead of
+                    # burning the full registration timeout
+                    rc = proc.poll()
+                    if rc is not None:
+                        raise RuntimeError(
+                            f"worker exited (code {rc}) before "
+                            f"registering; see {log_path}")
+                    if time.monotonic() >= deadline:
+                        self._kill_proc(handle)
+                        raise RuntimeError(
+                            f"worker failed to start within 60s; "
+                            f"see {log_path}")
         finally:
             self._register_events.pop(worker_id, None)
         return handle

@@ -31,6 +31,7 @@ Usage:  python -m ray_tpu._private.sandbox_run ROOTFS \
 
 from __future__ import annotations
 
+import ctypes
 import os
 import shlex
 import sys
@@ -38,6 +39,9 @@ import sys
 DEFAULT_BINDS = ("/usr", "/lib", "/lib64", "/bin", "/sbin", "/opt",
                  "/etc", "/proc", "/sys", "/dev", "/tmp", "/var",
                  "/run")
+
+_CLONE_NEWUSER = 0x10000000
+_CLONE_NEWNS = 0x00020000
 
 _STAGE = "/tmp/.ray_tpu_sbx"
 
@@ -69,6 +73,78 @@ def build_script(rootfs: str, binds, cmd) -> str:
     return "\n".join(lines)
 
 
+def _run_wide_map(script: str) -> None:
+    """Root-launched namespaces get a FULL-RANGE uid/gid map.
+
+    ``--map-root-user`` maps only the caller's uid; every file owned
+    by any OTHER uid appears as the overflow uid inside the
+    namespace, and mode-700 directories owned by such uids become
+    untraversable even for namespace-root (no CAP_DAC_OVERRIDE over
+    unmapped owners) — so binding a working dir that lives under
+    e.g. a 700 /root owned by a different account fails EACCES.
+    A real-root launcher holds CAP_SETUID/CAP_SETGID and may map the
+    whole range, restoring normal DAC behavior with no privilege
+    gained (root outside is root inside). Multi-entry maps can only
+    be written by a PARENT process, so: fork, child unshares and
+    execs the mount script, parent writes the maps and mirrors the
+    child's exit status. Returns (falls through to the single-map
+    CLI path) if the namespace or map setup is refused.
+    """
+    r_ready, w_ready = os.pipe()
+    r_go, w_go = os.pipe()
+    pid = os.fork()
+    if pid == 0:
+        os.close(r_ready)
+        os.close(w_go)
+        libc = ctypes.CDLL(None, use_errno=True)
+        if libc.unshare(_CLONE_NEWUSER | _CLONE_NEWNS) != 0:
+            os._exit(125)
+        os.write(w_ready, b"x")
+        os.close(w_ready)
+        go = os.read(r_go, 1)
+        os.close(r_go)
+        if go != b"g":
+            os._exit(125)
+        os.execvp("sh", ["sh", "-c", script])
+    os.close(w_ready)
+    os.close(r_go)
+    mapped = False
+    try:
+        if os.read(r_ready, 1) == b"x":
+            try:
+                with open(f"/proc/{pid}/setgroups", "w") as f:
+                    f.write("deny")
+            except OSError:
+                pass            # not required when CAP_SETGID held
+            with open(f"/proc/{pid}/uid_map", "w") as f:
+                f.write("0 0 65536\n")
+            with open(f"/proc/{pid}/gid_map", "w") as f:
+                f.write("0 0 65536\n")
+            mapped = True
+    except OSError:
+        mapped = False
+    finally:
+        os.close(r_ready)
+    go_ok = False
+    try:
+        os.write(w_go, b"g" if mapped else b"n")
+        go_ok = True
+    except OSError:
+        pass
+    os.close(w_go)
+    _, status = os.waitpid(pid, 0)
+    code = (os.WEXITSTATUS(status) if os.WIFEXITED(status)
+            else 128 + os.WTERMSIG(status))
+    if not (mapped and go_ok):
+        # the child never exec'd (unshare refused, map write failed,
+        # or the go byte was lost) — fall back to the unshare CLI
+        # path. A mapped child that received its go byte DID exec the
+        # script, so its exit status is the command's own (even 125)
+        # and must be mirrored, never re-run.
+        return
+    sys.exit(code)
+
+
 def main() -> None:
     args = sys.argv[1:]
     if not args or "--" not in args:
@@ -95,6 +171,8 @@ def main() -> None:
             binds.append(b)
     os.environ.setdefault("RAY_TPU_SANDBOX_CWD", os.getcwd())
     script = build_script(rootfs, binds, cmd)
+    if os.geteuid() == 0:
+        _run_wide_map(script)   # exits unless setup was refused
     os.execvp("unshare", ["unshare", "--user", "--map-root-user",
                           "--mount", "sh", "-c", script])
 

@@ -41,6 +41,15 @@ fleet anatomy" + "Fleet observability anatomy"):
     GET  /fleet/debug/bundles   list every replica's black-box spool;
                                 ?replica=&id= fetches one bundle
     POST /debug/dump            snapshot a postmortem bundle per replica
+    POST /v1/batch              submit a batch-lane job (ISSUE 14):
+                                {"requests": [<completion/chat body>...],
+                                "method": "completions"|"chat"} -> job
+                                brief; priority-0, admission-exempt,
+                                preemptible bulk inference
+    GET  /v1/batch              list batch jobs + lane stats
+    GET  /v1/batch/{id}         one job's status + per-request results
+    POST /v1/batch/{id}/cancel  stop a job's unlaunched requests
+                                (in-flight ones finish; results kept)
 
 ISSUE 7 fleet-scoped metric additions (ingress registry):
 
@@ -85,6 +94,10 @@ Single-replica metric catalogue:
     ray_tpu_llm_kv_page_occupancy           gauge      used / usable
     ray_tpu_llm_prefix_cache_hit_rate       gauge      hit tokens / queried tokens
     ray_tpu_llm_token_budget_utilization    gauge      packed / budget, unified ticks
+    ray_tpu_llm_batch_lane_tokens_total     counter    tokens emitted to batch-lane
+                                                       requests (ISSUE 14) — EXCLUDED
+                                                       from every SLO family above
+    ray_tpu_llm_batch_lane_finished_total   counter    + `reason`: batch-lane finishes
 
 ISSUE 10 KV-memory-hierarchy additions (host-offload tier + preemption
 spill/restore; details: BENCH_CORE.md "KV memory hierarchy anatomy";

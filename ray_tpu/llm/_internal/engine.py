@@ -320,6 +320,14 @@ class Request:
     # spill/restore or prefill requeue) — restores skip the admission
     # telemetry so queue-wait/prefix-hit stats count each request once
     restarts: int = 0
+    # scheduling lane (ISSUE 14): "interactive" (default) or "batch".
+    # Batch-lane requests are the preemptible bulk-inference tier —
+    # they ride Request.priority for victim choice, and telemetry
+    # EXCLUDES them from the SLO sums/violation counts the fleet
+    # autoscaler and burn-rate watchdog consume (a deliberately
+    # deep queue of offline work must not read as overload), keeping
+    # their tokens in separate batch-lane counters instead
+    lane: str = "interactive"
 
 
 class _Slot:
@@ -2381,6 +2389,22 @@ class InferenceEngine:
                         None)
             if slot is None:
                 break
+            if self.waiting and self.waiting[0].priority \
+                    > parked.request.priority:
+                # batch-lane inversion guard (ISSUE 14): restoring a
+                # preempted priority-0 batch session while a
+                # higher-priority interactive request waits would
+                # hand back the slot/pages the winner is queued for
+                # (and thrash the spill path when it preempts again);
+                # the parked work resumes in the next trough.
+                # CONTINUE, not break: a parked session deeper in the
+                # FIFO that the head does NOT outrank (e.g. a parked
+                # interactive behind parked batch) must still
+                # restore, or a mixed-priority tier livelocks — the
+                # head can't outrank ALL parked (so _admit's gate
+                # blocks) while the restorable one waits forever
+                # behind the batch head
+                continue
             req = parked.request
             remaining = (req.params.max_tokens
                          - len(req.output_tokens))
@@ -2460,14 +2484,23 @@ class InferenceEngine:
         return restored
 
     def _restore_possible(self) -> bool:
-        """Mirror of _restore_parked's head-of-queue feasibility check
-        (conservative toward True, like _admit_possible)."""
+        """Mirror of _restore_parked's head-of-ELIGIBLE-queue
+        feasibility check (conservative toward True, like
+        _admit_possible): eligible = not outranked by the waiting
+        head (the ISSUE 14 yield in _restore_parked)."""
         tier = self.host_tier
         if tier is None or not len(tier):
             return False
         if not any(s.request is None for s in self.slots):
             return False
-        parked = tier.entries()[0]
+        head_pri = (self.waiting[0].priority if self.waiting
+                    else None)
+        parked = next(
+            (p for p in tier.entries()
+             if head_pri is None or p.request.priority >= head_pri),
+            None)
+        if parked is None:
+            return False
         req = parked.request
         remaining = req.params.max_tokens - len(req.output_tokens)
         reserve = parked.position + 1 + (
@@ -2518,6 +2551,30 @@ class InferenceEngine:
         # the refresh folds any in-flight tick and rebuilds device
         # state over the survivors, whatever the failed path left
         self._refresh_device_state()
+
+    def lane_counts(self) -> Dict[str, int]:
+        """Batch-lane occupancy (ISSUE 14): how much of this engine's
+        queue/slots/parked set is priority-0 bulk work. Plain host
+        reads (fleet_stats cadence) — the serving plane subtracts
+        these from its overload signals."""
+        return {
+            "waiting_batch": sum(1 for r in self.waiting
+                                 if r.lane == "batch"),
+            "active_batch": sum(
+                1 for s in self.slots
+                if s.request is not None
+                and s.request.lane == "batch"),
+            "parked_batch": (sum(1 for p in self.host_tier.entries()
+                                 if p.request.lane == "batch")
+                             if self.host_tier is not None else 0),
+            # device pages held by batch-lane slots: displaceable
+            # occupancy the autoscaler's idle check must subtract (a
+            # batch-soaked fleet must still read as scale-downable)
+            "batch_kv_pages": sum(
+                len(s.pages) for s in self.slots
+                if s.request is not None
+                and s.request.lane == "batch"),
+        }
 
     def page_pressure(self) -> float:
         """Demand on the device pool as a fraction of usable pages:
@@ -2661,6 +2718,7 @@ class InferenceEngine:
             "lora": req.lora,
             "priority": int(req.priority),
             "tenant": req.tenant,
+            "lane": req.lane,
             "restarts": int(req.restarts),
             "trace": req.trace,
             "deadline_epoch": ddl,
@@ -2699,7 +2757,8 @@ class InferenceEngine:
                       lora=state.get("lora"),
                       trace=state.get("trace"),
                       priority=int(state.get("priority") or 0),
-                      tenant=str(state.get("tenant") or ""))
+                      tenant=str(state.get("tenant") or ""),
+                      lane=str(state.get("lane") or "interactive"))
         req.output_tokens = [int(t)
                              for t in state.get("output_tokens") or []]
         req.restarts = int(state.get("restarts") or 0)
@@ -3108,21 +3167,56 @@ class InferenceEngine:
         prefix sharing (free_pages already counts evictable cached
         pages)."""
         if self.host_tier is not None and len(self.host_tier):
-            # parked sequences restore before (and instead of) new
-            # admissions — mirror that policy here too
-            return self._restore_possible()
-        if not self.waiting or not any(s.request is None
-                                       for s in self.slots):
+            top = max(p.request.priority
+                      for p in self.host_tier.entries())
+            if not (self.waiting
+                    and self.waiting[0].priority > top):
+                # parked sequences restore before (and instead of)
+                # new admissions — mirror that policy here too
+                return self._restore_possible()
+            # batch-lane inversion guard (ISSUE 14): the head admits
+            # past the parked work — but only claim a drain is
+            # warranted when it can actually MOVE (a free slot whose
+            # pages fit, or a strictly-outranked victim to preempt);
+            # an unconditional True here would force a drain every
+            # tick of a saturated all-interactive period, degrading
+            # the pipeline to synchronous exactly where it matters
+            if any(s.request is None for s in self.slots) \
+                    and self._head_fits():
+                return True
+            return self._priority_victim_exists()
+        if not self.waiting:
             return False
-        req = self.waiting[0]
-        need = self.allocator.pages_needed(self._reserve_tokens(
-            len(req.prompt_tokens), req.params.max_tokens))
-        if self.allocator.enable_prefix_caching:
-            # best case: every full page of prompt[:-1] is cached
-            # (match_prefix caps one token short of the prompt)
-            need -= ((len(req.prompt_tokens) - 1)
-                     // self.allocator.page_size)
-        return need <= self.allocator.free_pages
+        if not any(s.request is None for s in self.slots):
+            # batch-lane inversion guard: with every slot taken, the
+            # head can still claim one by preempting the designated
+            # victim when it strictly outranks it (ISSUE 14)
+            return self._priority_victim_exists()
+        # a free slot but pages short: preemption can free pages too
+        return self._head_fits() or self._priority_victim_exists()
+
+    def _priority_victim_exists(self) -> bool:
+        """Does the waiting head strictly outrank the fleet's
+        designated victim (the slot _preempt_for_priority would
+        take), AND can that victim actually be preempted right now
+        (requeue needs nothing; a decoding victim needs host-tier
+        room for its spill)? Without the capacity half, a full host
+        tier would force a pipeline drain every tick of a saturated
+        period for a preemption that _preempt_slot then refuses."""
+        if not self.config.enable_kv_offload or not self.waiting:
+            return False
+        from .kv_offload import pick_victim
+        victim = pick_victim(self.slots, (),
+                             spill_ok=self.host_tier is not None)
+        if victim is None or victim.request is None \
+                or victim.request.priority \
+                >= self.waiting[0].priority:
+            return False
+        if not victim.ready:
+            return True              # prefilling: requeue path
+        return (self.host_tier is not None
+                and self.host_tier.can_store(
+                    self.allocator.pages_needed(victim.position)))
 
     def _step_tick(self, touched: List[Request]) -> None:
         # pick up last tick's spill copies (pure d2h, usually already
@@ -3274,6 +3368,73 @@ class InferenceEngine:
                     keep.append(req)
             self.waiting = keep
 
+    def _preempt_for_priority(self, touched: List[Request]) -> None:
+        """Batch-lane inversion guard (ISSUE 14): while the waiting
+        head STRICTLY outranks the fleet's designated victim (lowest
+        priority, then youngest — kv_offload.pick_victim, the same
+        total order page pressure uses) and cannot be admitted as
+        things stand (no free slot, or pages short even with
+        best-case prefix sharing), preempt that victim — an
+        interactive request must never queue behind the priority-0
+        bulk work it exists to displace. Bounded by the slot count;
+        equal priorities never preempt (the pre-ISSUE-14 behavior,
+        pinned by the PR 10 suite)."""
+        if not self.config.enable_kv_offload or not self.waiting:
+            return
+        from .kv_offload import pick_victim
+        for _ in range(len(self.slots)):
+            if not self.waiting:
+                return
+            # re-read the head each round: a REQUEUED victim (below)
+            # or a drain-fold retirement can change waiting[0]
+            head = self.waiting[0]
+            if any(s.request is None for s in self.slots) \
+                    and self._head_fits():
+                return
+            victim = pick_victim(
+                self.slots, (),
+                spill_ok=self.host_tier is not None)
+            if victim is None or victim.request is None \
+                    or victim.request.priority >= head.priority:
+                return
+            self._drain(touched)       # preemption is structural
+            if victim.request is None:
+                continue       # retired inside the drain fold
+            if victim.request.priority >= head.priority:
+                return         # the fold reshuffled the order
+            vreq = victim.request
+            if not self._preempt_slot(victim, touched, "priority"):
+                return         # host tier full: head waits its turn
+            self._refresh_device_state()
+            # a still-PREFILLING victim requeues to waiting[0] (the
+            # PR 10 head-requeue keeps it ahead of its equal-priority
+            # peers) — but here it just got preempted BY the head, so
+            # leaving it at the front would re-admit it into the slot
+            # it lost (priority inversion; with prefix caching off, a
+            # preempt/readmit livelock). Move it behind every waiter
+            # that strictly outranks it, ahead of its own tier.
+            if self.waiting and self.waiting[0] is vreq:
+                self.waiting.pop(0)
+                i = 0
+                while i < len(self.waiting) \
+                        and self.waiting[i].priority > vreq.priority:
+                    i += 1
+                self.waiting.insert(i, vreq)
+
+    def _head_fits(self) -> bool:
+        """Could the waiting head's reservation be claimed right now,
+        assuming best-case prefix sharing? (The same arithmetic as
+        _admit_possible's head-of-line check.)"""
+        req = self.waiting[0]
+        need = self.allocator.pages_needed(self._reserve_tokens(
+            len(req.prompt_tokens), req.params.max_tokens))
+        if self.allocator.enable_prefix_caching:
+            # best case: every full page of prompt[:-1] is cached
+            # (match_prefix caps one token short of the prompt)
+            need -= ((len(req.prompt_tokens) - 1)
+                     // self.allocator.page_size)
+        return need <= self.allocator.free_pages
+
     def _admit(self, touched: Optional[List[Request]] = None) -> None:
         """Claim slots + KV pages for waiting requests (prefix-cache
         match decides where their prefill starts); the prefill itself
@@ -3281,16 +3442,40 @@ class InferenceEngine:
         (ISSUE 10) restore FIRST and block new admissions while any
         remain — they already hold host memory and arrived earlier, so
         a fresh request claiming the pages a parked one needs would
-        starve it (and thrash the spill path)."""
-        self._restore_parked(touched if touched is not None else [])
+        starve it (and thrash the spill path). The ONE exception
+        (ISSUE 14): a waiting head that strictly outranks every
+        parked session — it admits past the parked batch work (which
+        it could preempt out of a slot anyway, so blocking at the
+        door would invert the priority order), via
+        _preempt_for_priority when slots or pages are short."""
+        touched = touched if touched is not None else []
+        self._restore_parked(touched)
         if self.host_tier is not None and len(self.host_tier):
-            return
+            top = max(p.request.priority
+                      for p in self.host_tier.entries())
+            if not (self.waiting
+                    and self.waiting[0].priority > top):
+                return
+        self._preempt_for_priority(touched)
+        parked_top: Optional[int] = (
+            max(p.request.priority for p in self.host_tier.entries())
+            if self.host_tier is not None and len(self.host_tier)
+            else None)
         for slot in self.slots:
             if not self.waiting:
                 break
             if slot.request is not None:
                 continue
             req = self.waiting[0]
+            if parked_top is not None \
+                    and req.priority <= parked_top:
+                # the ISSUE 14 exception is PER HEAD, not a gate the
+                # first head unlocks for the whole loop: once the
+                # current head no longer outranks every parked
+                # session, parked-first resumes — a new batch request
+                # queued behind an interactive head must not claim
+                # the pages an earlier-arrived parked session needs
+                break
             reserve = self._reserve_tokens(len(req.prompt_tokens),
                                            req.params.max_tokens)
             shared, matched = self.allocator.match_prefix(
@@ -4144,6 +4329,8 @@ class InferenceEngine:
             "parked_sessions": len(self.parked),
             "page_pressure": round(self.page_pressure(), 4),
             "preemptions": dict(self.preempt_counts),
+            # batch lane (ISSUE 14): preemptible bulk-work occupancy
+            "lanes": self.lane_counts(),
             # tick-pipeline telemetry (ISSUE 4): wall vs host-fold vs
             # blocked-readback per tick + lag/drain counters
             "tick_times": self._tick_times_summary(),

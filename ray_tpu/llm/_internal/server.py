@@ -34,7 +34,7 @@ DEFAULT_MAX_TOKENS = 32
 INTERNAL_BODY_KEYS = ("_request_id", "_trace", "_deadline_epoch",
                       "_continue_tokens", "_token_offset",
                       "_session", "_resume_offset", "_chat",
-                      "_tenant")
+                      "_tenant", "_lane")
 
 
 class LLMServerImpl:
@@ -183,6 +183,16 @@ class LLMServerImpl:
         return "" if t == "default" else t
 
     @staticmethod
+    def _lane_of(body: Dict[str, Any]) -> str:
+        """Scheduling lane (ISSUE 14): the fleet's batch pump mints
+        `_lane: "batch"` on the bodies it dispatches (a plumbing key
+        — public ingresses strip client-supplied values, so a client
+        cannot exempt itself from SLO accounting by forging it).
+        Everything else is the interactive lane."""
+        return ("batch" if body.pop("_lane", None) == "batch"
+                else "interactive")
+
+    @staticmethod
     def _priority_of(body: Dict[str, Any]) -> int:
         """Preemption priority (ISSUE 10, API extension): under page
         pressure the engine parks the LOWEST priority first. Clients
@@ -199,7 +209,8 @@ class LLMServerImpl:
                         trace: "Dict[str, str] | None" = None,
                         deadline: "float | None" = None,
                         priority: int = 0,
-                        tenant: str = "") -> Request:
+                        tenant: str = "",
+                        lane: str = "interactive") -> Request:
         self._ensure_pump()
         # a rid already in flight (a client replaying another request's
         # `_request_id`) must not collide: the duplicate would overwrite
@@ -209,7 +220,7 @@ class LLMServerImpl:
             rid = uuid.uuid4().hex[:16]
         req = Request(rid, prompt_tokens, params, lora=lora,
                       trace=trace, deadline=deadline,
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, lane=lane)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
@@ -283,7 +294,8 @@ class LLMServerImpl:
                                    rid=rid, trace=trace,
                                    deadline=deadline,
                                    priority=self._priority_of(body),
-                                   tenant=self._tenant_of(body))
+                                   tenant=self._tenant_of(body),
+                                   lane=self._lane_of(body))
         text = self.tokenizer.decode(req.output_tokens)
         return {
             "id": f"chatcmpl-{req.request_id}",
@@ -307,7 +319,8 @@ class LLMServerImpl:
                                    rid=rid, trace=trace,
                                    deadline=deadline,
                                    priority=self._priority_of(body),
-                                   tenant=self._tenant_of(body))
+                                   tenant=self._tenant_of(body),
+                                   lane=self._lane_of(body))
         return {
             "id": f"cmpl-{req.request_id}",
             "object": "text_completion",
@@ -329,7 +342,8 @@ class LLMServerImpl:
                                deadline: "float | None" = None,
                                decode_ctx: "List[int] | None" = None,
                                priority: int = 0,
-                               tenant: str = ""):
+                               tenant: str = "",
+                               lane: str = "interactive"):
         """Yield (new_tokens, text_delta, finished, finish_reason) as
         tokens land — token ids AND text per event, so both the SSE
         wrappers (text) and the fleet's failover relay (token-exact
@@ -345,7 +359,7 @@ class LLMServerImpl:
             rid = uuid.uuid4().hex[:16]      # id must never collide
         req = Request(rid, prompt_tokens, params, lora=lora,
                       trace=trace, deadline=deadline,
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, lane=lane)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         ctx = list(decode_ctx or [])
@@ -388,7 +402,8 @@ class LLMServerImpl:
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
                 priority=self._priority_of(body),
-                tenant=self._tenant_of(body)):
+                tenant=self._tenant_of(body),
+                lane=self._lane_of(body)):
             if not delta and not finished:
                 continue                 # no text yet: hold the chunk
             chunk = {
@@ -413,7 +428,8 @@ class LLMServerImpl:
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
                 priority=self._priority_of(body),
-                tenant=self._tenant_of(body)):
+                tenant=self._tenant_of(body),
+                lane=self._lane_of(body)):
             if not delta and not finished:
                 continue
             chunk = {
@@ -444,7 +460,8 @@ class LLMServerImpl:
                 toks, self._sampling(body), lora=self._lora_for(body),
                 rid=rid, trace=trace, deadline=deadline,
                 decode_ctx=cont, priority=self._priority_of(body),
-                tenant=self._tenant_of(body)):
+                tenant=self._tenant_of(body),
+                lane=self._lane_of(body)):
             yield {"i": idx, "toks": list(new), "text": delta,
                    "finished": bool(finished),
                    "reason": reason if finished else None,
@@ -746,6 +763,7 @@ class LLMServerImpl:
         alloc = eng.allocator
         used = alloc.used_pages
         last = eng.last_step_at
+        lanes = eng.lane_counts()
         return {
             "replica": self.replica_id,
             "model": self.model_id,
@@ -762,6 +780,12 @@ class LLMServerImpl:
             # KV memory hierarchy (ISSUE 10): the autoscaler/watchdog's
             # page-pressure signal + host-tier occupancy for /fleet
             "page_pressure": round(eng.page_pressure(), 4),
+            # batch lane (ISSUE 14): the serving plane subtracts the
+            # preemptible tier from its overload signals
+            **lanes,
+            "kv_occupancy_batch": (
+                lanes["batch_kv_pages"] / alloc.num_usable
+                if alloc.num_usable else 0.0),
             "parked_sessions": len(eng.parked),
             "kv_offload": eng.host_tier is not None,
             "kv_host_pages_used": (eng.host_tier.used_pages

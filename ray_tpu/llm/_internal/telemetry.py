@@ -197,6 +197,18 @@ def _build_metrics() -> Dict[str, Any]:
         "anomaly_rate": G("ray_tpu_llm_tick_anomaly_rate",
                           "anomalous fraction of the recent tick "
                           "window", keys),
+        # batch lane (ISSUE 14): the preemptible bulk-inference
+        # tier's own token/finish accounting — these requests are
+        # EXCLUDED from the SLO histograms and slo_totals() above
+        # (their latencies are harvested idle time, not user
+        # experience), so the recovered throughput needs its own
+        # monotone series
+        "batch_tokens": C("ray_tpu_llm_batch_lane_tokens_total",
+                          "tokens emitted to batch-lane requests",
+                          keys),
+        "batch_finished": C("ray_tpu_llm_batch_lane_finished_total",
+                            "batch-lane requests finished, by reason",
+                            ("model", "replica", "reason")),
     }
 
 
@@ -269,11 +281,12 @@ class _Timeline:
     __slots__ = ("rid", "tid", "queued", "admitted", "first_token",
                  "last_token", "finished", "reason", "prompt_len",
                  "cached_tokens", "n_tokens", "chunks", "lora",
-                 "trace")
+                 "trace", "batch")
 
     def __init__(self, rid: str, tid: int, queued: float,
                  prompt_len: int, lora: Optional[str],
-                 trace: Optional[Dict[str, str]] = None):
+                 trace: Optional[Dict[str, str]] = None,
+                 batch: bool = False):
         self.rid = rid
         self.tid = tid
         self.queued = queued
@@ -291,6 +304,9 @@ class _Timeline:
         # ({"trace_id", "span_id", "flow_id"}): lifecycle spans carry
         # the trace id and the flow-finish binds the router's arrow
         self.trace = trace
+        # batch lane (ISSUE 14): timeline kept (traces/black boxes
+        # still show the lifecycle) but SLO accounting skipped
+        self.batch = batch
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view (epoch timestamps) — black-box bundles."""
@@ -357,6 +373,14 @@ class EngineTelemetry:
                       "e2e": 0.0}
         self._counts = {"ttft": 0, "itl": 0, "queue": 0, "e2e": 0}
         self._bad = {"ttft": 0, "queue": 0, "e2e": 0}
+        # batch lane (ISSUE 14): the preemptible bulk tier's own
+        # token/finish aggregates — its requests never touch the SLO
+        # sums/bad counts above (the watchdog's burn and the
+        # autoscaler's windowed means must read interactive traffic
+        # only), so the recovered throughput is counted here
+        self._batch_tokens = 0
+        self._batch_prompt_tokens = 0
+        self._batch_finished: Dict[str, int] = {}
         # perf-counter export watermarks (ISSUE 11): cumulative totals
         # already inc'd into the Prometheus counters at a prior scrape
         self._perf_exported: Dict[str, float] = {}
@@ -374,7 +398,8 @@ class EngineTelemetry:
         t = _Timeline(req.request_id, next(self._tid),
                       getattr(req, "submitted_at", None) or _now(),
                       len(req.prompt_tokens), req.lora,
-                      trace=getattr(req, "trace", None))
+                      trace=getattr(req, "trace", None),
+                      batch=getattr(req, "lane", "") == "batch")
         with self._lock:
             self._live[req.request_id] = t
 
@@ -389,17 +414,25 @@ class EngineTelemetry:
             t.admitted = now
             t.cached_tokens = cached_tokens
             wait = max(now - t.queued, 0.0)
-            self._sums["queue"] += wait
-            self._counts["queue"] += 1
-            if wait > self.slo_targets["queue_wait"]:
-                self._bad["queue"] += 1
-            self._prompt_tokens += t.prompt_len
-        self._m["queue_wait"].observe(wait, self._tags)
+            if t.batch:
+                # batch lane (ISSUE 14): a bulk job deliberately
+                # queued through a busy hour must not count as an
+                # SLO violation — its wait is the lane working
+                self._batch_prompt_tokens += t.prompt_len
+            else:
+                self._sums["queue"] += wait
+                self._counts["queue"] += 1
+                if wait > self.slo_targets["queue_wait"]:
+                    self._bad["queue"] += 1
+                self._prompt_tokens += t.prompt_len
+        if not t.batch:
+            self._m["queue_wait"].observe(wait, self._tags)
         self._m["prompt_tokens"].inc(t.prompt_len, self._tags)
         self.recorder.record("admission", request_id=req.request_id,
                              prompt_tokens=t.prompt_len,
                              cached_tokens=cached_tokens,
-                             lora=req.lora)
+                             lora=req.lora,
+                             **({"lane": "batch"} if t.batch else {}))
 
     def on_prefill_chunk(self, req, n_tokens: int,
                          start_pos: int) -> None:
@@ -417,12 +450,22 @@ class EngineTelemetry:
             return
         now = _now()
         first = gap = None
+        batch = False
         with self._lock:
             t = self._live.get(req.request_id)
             if t is None:
                 return
+            batch = t.batch
             t.n_tokens += 1
-            if t.first_token is None:
+            if batch:
+                # batch lane (ISSUE 14): tokens count (that IS the
+                # recovered throughput) but never the TTFT/ITL
+                # latency families — a token held back by a
+                # preemption window is the lane yielding, not an SLO
+                # event
+                t.first_token = t.first_token or now
+                self._batch_tokens += 1
+            elif t.first_token is None:
                 t.first_token = now
                 first = max(now - t.queued, 0.0)
                 self._sums["ttft"] += first
@@ -440,6 +483,8 @@ class EngineTelemetry:
         if gap is not None:
             self._m["itl"].observe(gap, self._tags)
         self._m["generated_tokens"].inc(1, self._tags)
+        if batch:
+            self._m["batch_tokens"].inc(1, self._tags)
 
     def on_finished(self, req, reason: str,
                     cost: Optional[Dict[str, Any]] = None) -> None:
@@ -449,27 +494,40 @@ class EngineTelemetry:
         if not self.enabled:
             return
         now = _now()
+        batch = False
         with self._lock:
             t = self._live.pop(req.request_id, None)
             if t is not None:
                 t.finished = now
                 t.reason = reason
+            batch = t.batch if t is not None \
+                else getattr(req, "lane", "") == "batch"
+            if t is not None:
                 self._done.append(t)
             self._finished[reason] = self._finished.get(reason, 0) + 1
             if reason == "abort":
                 self._aborted += 1
             e2e = max(now - (t.queued if t else now), 0.0)
-            self._sums["e2e"] += e2e
-            self._counts["e2e"] += 1
-            if e2e > self.slo_targets["e2e"]:
-                self._bad["e2e"] += 1
+            if batch:
+                self._batch_finished[reason] = \
+                    self._batch_finished.get(reason, 0) + 1
+            else:
+                self._sums["e2e"] += e2e
+                self._counts["e2e"] += 1
+                if e2e > self.slo_targets["e2e"]:
+                    self._bad["e2e"] += 1
         self._m["finished"].inc(1, {**self._tags, "reason": reason})
-        self._m["e2e"].observe(e2e, self._tags)
+        if batch:
+            self._m["batch_finished"].inc(
+                1, {**self._tags, "reason": reason})
+        else:
+            self._m["e2e"].observe(e2e, self._tags)
         if reason == "abort":
             self._m["aborts"].inc(1, self._tags)
         self.recorder.record(
             "retirement", request_id=req.request_id, reason=reason,
             generated_tokens=len(req.output_tokens),
+            **({"lane": "batch"} if batch else {}),
             **({"cost": cost} if cost else {}))
 
     def on_drain(self, cause: str) -> None:
@@ -684,6 +742,13 @@ class EngineTelemetry:
                 "budget_utilization": round(
                     self._budget_used / self._budget_total, 3)
                     if self._budget_total else 0.0,
+                # batch lane (ISSUE 14): the preemptible tier's own
+                # totals — EXCLUDED from every latency family above
+                "batch": {
+                    "generated_tokens": self._batch_tokens,
+                    "prompt_tokens": self._batch_prompt_tokens,
+                    "finished": dict(self._batch_finished),
+                },
                 "flight_recorder": self.recorder.stats(),
             }
 

@@ -68,11 +68,30 @@ PR 10's ParkedSequence, three consumers):
   rejected import) degrades to the PR 9 replay path — token-exact
   either way.
 
+ISSUE 14 closes the loop from measured cost model to fleet-scale
+what-if analysis, then harvests what it finds:
+
+- a million-session discrete-event fleet simulator (sim/): a seeded
+  virtual clock + event heap driving the REAL FleetRouter /
+  AdmissionController / FleetAutoscaler / SLOBurnWatchdog /
+  CircuitBreaker objects (no forks — the injectable `clock=` on each
+  is the whole integration) against synthetic replicas calibrated
+  from PR 11's CostModel and measured tick-time distributions;
+  diurnal / flash-crowd / tenant-skew / chaos traces, fleet SLO
+  assertions, and capacity-planning curves (replicas vs p99 TTFT)
+  as a JSON artifact;
+- a preemptible batch-inference lane (batch.py): `POST /v1/batch`
+  bulk jobs dispatched at priority 0 outside the admission queue,
+  soaking idle capacity and preempted token-exact by interactive
+  traffic via PR 10's spill/restore; the admission, autoscaler, and
+  watchdog planes all EXCLUDE batch-lane depth from their overload
+  and burn signals.
+
 Scoring formula, admission thresholds, the autoscale policy, the
-observability surface, the failure plane, and the KV transport are
-documented in BENCH_CORE.md "Serving fleet anatomy", "Fleet
-observability anatomy", "Fault tolerance anatomy" and "KV transport
-anatomy".
+observability surface, the failure plane, the KV transport, and the
+traffic simulator are documented in BENCH_CORE.md "Serving fleet
+anatomy", "Fleet observability anatomy", "Fault tolerance anatomy",
+"KV transport anatomy" and "Traffic simulation anatomy".
 """
 
 from __future__ import annotations
@@ -90,6 +109,8 @@ from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
                         AdmissionRejected)
 from .autoscaler import (AutoscaleConfig, FleetAutoscaler,  # noqa: F401
                          FleetMetrics)
+from .batch import (BATCH_PRIORITY, INTERACTIVE_PRIORITY,  # noqa: F401
+                    BatchJob, BatchLane, BatchLaneConfig)
 from .chaos import (ChaosError, ChaosReplicaClient,  # noqa: F401
                     ChaosSchedule, FaultSpec, StreamSevered)
 from .deployment import (FleetConfig, LLMFleetIngressImpl,  # noqa: F401
@@ -129,6 +150,9 @@ __all__ = [
     "TransportConfig", "TransportError", "TransportChecksumError",
     "FleetPrefixStore", "encode_session", "decode_session",
     "encode_prefix", "decode_prefix",
+    # preemptible batch lane (ISSUE 14)
+    "BatchLaneConfig", "BatchLane", "BatchJob",
+    "BATCH_PRIORITY", "INTERACTIVE_PRIORITY",
     # single-model surface (ray_tpu.llm re-exports)
     "LLMConfig", "build_openai_app", "build_llm_deployment",
     "InferenceEngine", "EngineConfig", "SamplingParams", "Request",

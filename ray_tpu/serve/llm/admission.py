@@ -21,6 +21,14 @@ an explicit, bounded signal instead:
 
 Pure asyncio, single event loop, no locks: every mutation happens on
 the loop the ingress runs on.
+
+ISSUE 14 layered a SYNCHRONOUS twin under the async surface: all the
+policy state (heap, stride passes, brownout bound, shed accounting)
+lives in loop-free methods — `submit()` enqueues, `shed_expired()`
+applies the SLO/deadline timers, `granted_sync()` drains grants — and
+`acquire()` is now a thin asyncio waiter over them. The discrete-event
+fleet simulator (serve/llm/sim) drives THIS object, not a fork, in
+virtual time through the injected `clock`.
 """
 
 from __future__ import annotations
@@ -31,7 +39,7 @@ import dataclasses
 import heapq
 import itertools
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -85,26 +93,51 @@ def admission_metrics() -> Dict[str, Any]:
 
 
 class _Ticket:
-    __slots__ = ("tenant", "vtime", "seq", "future", "queued_at")
+    """One queued admission claim. The asyncio `future` exists only
+    for async waiters (acquire); synchronous drivers (the fleet
+    simulator) read `granted`/`dead` directly — grants they missed
+    accumulate in the controller's `granted_sync()` drain."""
 
-    def __init__(self, tenant: str, vtime: float, seq: int):
+    __slots__ = ("tenant", "vtime", "seq", "future", "queued_at",
+                 "deadline", "granted", "dead", "sync")
+
+    def __init__(self, tenant: str, vtime: float, seq: int,
+                 queued_at: float, deadline: Optional[float] = None,
+                 sync: bool = True):
         self.tenant = tenant
         self.vtime = vtime
         self.seq = seq
-        self.future: asyncio.Future = \
-            asyncio.get_running_loop().create_future()
-        self.queued_at = time.monotonic()
+        self.queued_at = queued_at
+        self.deadline = deadline       # absolute clock instant | None
+        self.future: Optional[asyncio.Future] = None
+        self.granted = False
+        self.dead = False
+        # sync tickets (no asyncio waiter) report their grants through
+        # granted_sync() and their sheds through shed_expired(); async
+        # tickets (acquire) run their own future + timer instead
+        self.sync = sync
+
+    @property
+    def done(self) -> bool:
+        return self.granted or self.dead
 
     def __lt__(self, other: "_Ticket") -> bool:
         return (self.vtime, self.seq) < (other.vtime, other.seq)
 
 
 class AdmissionController:
-    """`await acquire(tenant)` then `release()` around each dispatch."""
+    """`await acquire(tenant)` then `release()` around each dispatch —
+    or, for clock-driven hosts (the fleet simulator), `submit()` /
+    `shed_expired()` / `granted_sync()` / `release()`."""
 
     def __init__(self, config: Optional[AdmissionConfig] = None,
-                 metrics_model_id: Optional[str] = None):
+                 metrics_model_id: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.config = config or AdmissionConfig()
+        # injectable clock (ISSUE 14): every time source in this
+        # controller goes through it, so the simulator can drive the
+        # REAL policy in virtual time without monkeypatching
+        self._clock = clock if clock is not None else time.monotonic
         # tenant-labeled Prometheus series (ISSUE 13 satellite): off
         # unless the owner names a model id — bare unit-test
         # controllers stay registry-silent
@@ -119,6 +152,9 @@ class AdmissionController:
         # vtime floor stops an idle tenant banking credit forever
         self._pass: Dict[str, float] = {}
         self._vtime = 0.0
+        # grants made to SYNC tickets (no future to resolve): the
+        # clock-driven host collects them here after submit()/release()
+        self._granted_sync: List[_Ticket] = []
         # observability (GET /fleet)
         self.admitted = 0
         self.rejected: Dict[str, int] = {"queue_full": 0,
@@ -195,37 +231,46 @@ class AdmissionController:
         sustained overload would accumulate every ticket ever shed and
         degrade admission to O(dead) per call. Mark, then compact once
         the dead tickets win."""
-        if ticket.future.cancel():
-            self._dead += 1
+        if ticket.done:
+            return
+        ticket.dead = True
+        if ticket.future is not None:
+            ticket.future.cancel()
+        self._dead += 1
         if self._dead > 32 and self._dead * 2 > len(self._heap):
-            self._heap = [t for t in self._heap if not t.future.done()]
+            self._heap = [t for t in self._heap if not t.done]
             heapq.heapify(self._heap)
             self._dead = 0
 
     def _grant_next(self) -> None:
         while self._heap and self.inflight < self.config.max_concurrent:
             t = heapq.heappop(self._heap)
-            if t.future.done():
+            if t.done:
                 self._dead -= 1
                 continue             # shed while queued
             self.inflight += 1
             self._vtime = max(self._vtime, t.vtime)
-            self._record_admit(time.monotonic() - t.queued_at,
-                               t.tenant)
-            t.future.set_result(None)
+            t.granted = True
+            self._record_admit(self._clock() - t.queued_at, t.tenant)
+            if t.future is not None:
+                if not t.future.done():
+                    t.future.set_result(None)
+            elif t.sync:
+                self._granted_sync.append(t)
 
     def _record_admit(self, wait_s: float,
                       tenant: str = "default") -> None:
         self.admitted += 1
-        self._recent_waits.append(wait_s)
+        self._recent_waits.append(max(wait_s, 0.0))
         if self._metrics is not None:
             self._metrics["queue_wait"].observe(
-                wait_s, {**self._mtags,
-                         "tenant": self._tenant_label(tenant)})
+                max(wait_s, 0.0),
+                {**self._mtags,
+                 "tenant": self._tenant_label(tenant)})
 
     def _prune_pass(self) -> None:
         # entries at or below the global floor are semantically dead —
-        # acquire()'s max(pass, vtime) picks the floor anyway — and the
+        # submit()'s max(pass, vtime) picks the floor anyway — and the
         # tenant string is CLIENT-controlled (the OpenAI "user" field),
         # so without eviction one dict entry per distinct end-user id
         # accumulates forever; size-triggered so the rebuild stays off
@@ -234,18 +279,20 @@ class AdmissionController:
             self._pass = {t: p for t, p in self._pass.items()
                           if p > self._vtime}
 
-    # -- public API -----------------------------------------------------
-    async def acquire(self, tenant: str = "default",
-                      deadline: Optional[float] = None) -> None:
-        """Admit or raise AdmissionRejected. Bounded wait: returns
-        within queue_wait_slo_s — or within the request's remaining
-        deadline, whichever is sooner (ISSUE 9: an already-expired
-        request sheds BEFORE queueing, and a queued one sheds the
-        moment waiting any longer could not possibly help; either way
-        the fleet does zero work for a request its client has already
-        abandoned). `deadline` is absolute time.monotonic()."""
+    # -- synchronous policy core (async acquire + sim both drive it) ----
+    def submit(self, tenant: str = "default",
+               deadline: Optional[float] = None,
+               now: Optional[float] = None,
+               sync: bool = True) -> _Ticket:
+        """Enqueue one admission claim RIGHT NOW: raises
+        AdmissionRejected (deadline already expired, queue full,
+        brownout) or returns a ticket — possibly already granted
+        (sync tickets' grants ALSO land in granted_sync(), so a
+        clock-driven host handles immediate and queued grants through
+        one drain). `deadline` is an absolute instant on this
+        controller's clock."""
         cfg = self.config
-        now = time.monotonic()
+        now = self._clock() if now is None else now
         if deadline is not None and now >= deadline:
             # NOT counted into shed_total: a deadline shed is the
             # client's budget spent, not fleet overload — it must not
@@ -270,11 +317,70 @@ class AdmissionController:
             + 1.0 / self._weight(tenant)
         self._pass[tenant] = vtime
         self._prune_pass()
-        ticket = _Ticket(tenant, vtime, next(self._seq))
+        ticket = _Ticket(tenant, vtime, next(self._seq),
+                         queued_at=now, deadline=deadline, sync=sync)
         heapq.heappush(self._heap, ticket)
         self._grant_next()
-        if ticket.future.done() and not ticket.future.cancelled():
+        return ticket
+
+    def shed_expired(self, now: Optional[float] = None
+                     ) -> List[_Ticket]:
+        """Apply the SLO/deadline timers to queued SYNC tickets (the
+        async path runs its own asyncio timers): a ticket queued past
+        queue_wait_slo_s — or past its own deadline, whichever is
+        sooner — is shed, counted exactly like acquire()'s timeout
+        path. Returns the tickets shed this call so a clock-driven
+        host can fail their sessions. O(queue) per call; drivers call
+        it at control-loop cadence, not per request."""
+        now = self._clock() if now is None else now
+        slo = self.config.queue_wait_slo_s
+        shed: List[_Ticket] = []
+        for t in self._heap:
+            if t.done or not t.sync:
+                continue
+            by_deadline = t.deadline is not None and now >= t.deadline
+            if not by_deadline and now - t.queued_at < slo:
+                continue
+            # attribute by whichever timer fired FIRST (the async
+            # path's semantics): with a coarse driver cadence both
+            # may have elapsed by now, but a deadline sooner than the
+            # SLO instant is the client's budget, not fleet overload
+            reason = ("deadline"
+                      if by_deadline
+                      and t.deadline <= t.queued_at + slo
+                      else "queue_wait_slo")
+            self._discard(t)
+            self._count_reject(t.tenant, reason)
+            if reason != "deadline":
+                self.shed_total += 1
+            shed.append(t)
+        return shed
+
+    def granted_sync(self) -> List[_Ticket]:
+        """Drain the grants made to sync tickets since the last call
+        (in grant order) — the clock-driven host routes each one's
+        session now."""
+        out, self._granted_sync = self._granted_sync, []
+        return out
+
+    # -- public API -----------------------------------------------------
+    async def acquire(self, tenant: str = "default",
+                      deadline: Optional[float] = None) -> None:
+        """Admit or raise AdmissionRejected. Bounded wait: returns
+        within queue_wait_slo_s — or within the request's remaining
+        deadline, whichever is sooner (ISSUE 9: an already-expired
+        request sheds BEFORE queueing, and a queued one sheds the
+        moment waiting any longer could not possibly help; either way
+        the fleet does zero work for a request its client has already
+        abandoned). `deadline` is absolute on this controller's clock
+        (time.monotonic unless injected)."""
+        cfg = self.config
+        now = self._clock()
+        ticket = self.submit(tenant, deadline=deadline, now=now,
+                             sync=False)
+        if ticket.granted:
             return                      # admitted without waiting
+        ticket.future = asyncio.get_running_loop().create_future()
         timeout = cfg.queue_wait_slo_s
         if deadline is not None:
             timeout = min(timeout, max(deadline - now, 0.0))
@@ -282,7 +388,7 @@ class AdmissionController:
             await asyncio.wait_for(
                 asyncio.shield(ticket.future), timeout=timeout)
         except asyncio.TimeoutError:
-            if ticket.future.done():
+            if ticket.granted:
                 # granted in the same loop turn the timer fired:
                 # the grant stands
                 return
@@ -295,7 +401,7 @@ class AdmissionController:
                       if deadline is not None
                       and timeout < cfg.queue_wait_slo_s
                       else "queue_wait_slo")
-            self._count_reject(tenant, reason)
+            self._count_reject(ticket.tenant, reason)
             if reason != "deadline":
                 self.shed_total += 1
             raise AdmissionRejected(reason,
@@ -303,7 +409,7 @@ class AdmissionController:
         except asyncio.CancelledError:
             # caller cancelled (client gone) — give the slot back if
             # the grant raced the cancellation
-            if ticket.future.done() and not ticket.future.cancelled():
+            if ticket.granted:
                 self.release()
             else:
                 self._discard(ticket)

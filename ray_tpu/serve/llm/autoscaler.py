@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 
 @dataclasses.dataclass
@@ -70,8 +70,14 @@ class FleetMetrics:
 
 
 class FleetAutoscaler:
-    def __init__(self, config: Optional[AutoscaleConfig] = None):
+    def __init__(self, config: Optional[AutoscaleConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.config = config or AutoscaleConfig()
+        # injectable clock (ISSUE 14): the hysteresis deltas only need
+        # a monotone time source, so the discrete-event simulator can
+        # drive decide() in virtual time; real fleets default to
+        # time.monotonic (NTP-step immune, like the rest of the plane)
+        self._clock = clock if clock is not None else time.monotonic
         self._above_since: Optional[float] = None
         self._below_since: Optional[float] = None
         self.last_decision: Dict[str, Any] = {}
@@ -97,7 +103,7 @@ class FleetAutoscaler:
                now: Optional[float] = None) -> int:
         """Target active-replica count, clamped to [min, max]."""
         c = self.config
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         target = active
         if self._breached(m, active):
             self._below_since = None

@@ -12,6 +12,11 @@ the identical graph in-process (the tier-1 e2e tests do).
 Ingress HTTP surface (rides the existing proxy):
     POST /v1/chat/completions      unary or SSE (stream=true)
     POST /v1/completions           unary or SSE
+    POST /v1/batch                 submit a batch-lane job (ISSUE 14):
+                                   priority-0, admission-exempt,
+                                   preemptible bulk inference
+    GET  /v1/batch                 list batch jobs (+ lane stats);
+                                   /v1/batch/{id} = one job's results
     GET  /v1/models                the fleet's model (+ adapters)
     GET  /fleet                    fleet status: per-replica routing
                                    inputs, router/admission counters,
@@ -37,6 +42,7 @@ from typing import Any, Dict, List, Optional
 
 from .admission import AdmissionConfig, AdmissionRejected
 from .autoscaler import AutoscaleConfig
+from .batch import BatchLaneConfig
 from .failover import HealthConfig
 from .fleet import (ACTIVE, DRAINING, STANDBY, FleetManager,
                     HandleReplicaClient)
@@ -86,6 +92,16 @@ class FleetConfig:
     # (sessions park/restore through the host tier on both ends).
     transport: Optional[TransportConfig] = None
     replica_roles: Optional[List[str]] = None
+    # preemptible batch-inference lane (ISSUE 14): None = off. With a
+    # lane configured, POST /v1/batch submits priority-0 bulk jobs
+    # that soak idle capacity and yield token-exact to interactive
+    # traffic. Engines should run enable_kv_offload: the engine's
+    # priority preemption is gated on it entirely, so WITHOUT it
+    # batch work is never preempted at all — interactive requests
+    # queue behind running batch jobs until they finish naturally,
+    # and only the lane's soak governor (which stops LAUNCHING under
+    # load) still protects interactive latency.
+    batch_lane: Optional[BatchLaneConfig] = None
 
     def resolved_autoscale(self) -> AutoscaleConfig:
         auto = self.autoscale or AutoscaleConfig()
@@ -111,6 +127,8 @@ class FleetConfig:
                           else dataclasses.asdict(self.transport)),
             "replica_roles": (None if self.replica_roles is None
                               else list(self.replica_roles)),
+            "batch_lane": (None if self.batch_lane is None
+                           else dataclasses.asdict(self.batch_lane)),
         }
 
 
@@ -157,7 +175,9 @@ class LLMFleetIngressImpl:
                                               2.0),
             roles=fleet_wire.get("replica_roles"),
             transport=(TransportConfig(**fleet_wire["transport"])
-                       if fleet_wire.get("transport") else None))
+                       if fleet_wire.get("transport") else None),
+            batch_lane=(BatchLaneConfig(**fleet_wire["batch_lane"])
+                        if fleet_wire.get("batch_lane") else None))
         self._adapters: Optional[List[str]] = None
         self._adapters_ts = 0.0
 
@@ -230,6 +250,22 @@ class LLMFleetIngressImpl:
         from ...serve import Response
 
         query = query or {}
+        # preemptible batch lane (ISSUE 14): job listing + status
+        if norm == "/v1/batch" or norm.startswith("/v1/batch/"):
+            if self.fleet.batch is None:
+                return Response(
+                    {"error": "batch lane not configured"},
+                    status=404, content_type="application/json")
+            if norm == "/v1/batch":
+                return {"object": "list",
+                        "data": self.fleet.batch.list(),
+                        "lane": self.fleet.batch.stats()}
+            doc = self.fleet.batch.get(norm.rsplit("/", 1)[1])
+            if doc is None:
+                return Response({"error": "unknown batch job"},
+                                status=404,
+                                content_type="application/json")
+            return doc
         if norm == "/v1/models":
             if self._adapters is None:
                 await self._resolve_adapters()
@@ -360,6 +396,28 @@ class LLMFleetIngressImpl:
             cause = str(body.get("cause") or "manual")
             return {"object": "dump",
                     "replicas": await self.fleet.debug_dump_all(cause)}
+        if norm == "/v1/batch" or (norm.startswith("/v1/batch/")
+                                   and norm.endswith("/cancel")):
+            # preemptible batch lane (ISSUE 14): submit a bulk job —
+            # returns the job brief immediately; the lane pump soaks
+            # it through idle capacity at priority 0. POST
+            # /v1/batch/{id}/cancel stops its unlaunched requests.
+            if self.fleet.batch is None:
+                return Response(
+                    {"error": "batch lane not configured"},
+                    status=404, content_type="application/json")
+            if norm != "/v1/batch":
+                doc = self.fleet.batch.cancel(norm.split("/")[-2])
+                if doc is None:
+                    return Response({"error": "unknown batch job"},
+                                    status=404,
+                                    content_type="application/json")
+                return doc
+            try:
+                return self.fleet.batch.submit(body)
+            except ValueError as e:
+                return Response({"error": str(e)}, status=400,
+                                content_type="application/json")
         if not await self._known_model(body.get("model") or ""):
             return Response(
                 {"error": f"model {body.get('model')!r} not found"},

@@ -44,7 +44,7 @@ import dataclasses
 import json
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ...llm._internal.engine import derive_seed
 from ...llm._internal.server import DEFAULT_MAX_TOKENS  # noqa: F401
@@ -140,8 +140,14 @@ class CircuitBreaker:
     loop (dispatch errors, severed streams) feed `record_failure`
     with hard=True."""
 
-    def __init__(self, config: Optional[HealthConfig] = None):
+    def __init__(self, config: Optional[HealthConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.config = config or HealthConfig()
+        # injectable clock (ISSUE 14): cooldown timing runs on
+        # whatever monotone source the driver provides — the fleet
+        # simulator's chaos overlays exercise open/half-open/close in
+        # virtual time through it
+        self._clock = clock if clock is not None else time.monotonic
         self.state = CLOSED
         self.failures = 0            # consecutive
         self.trips = 0               # lifetime opens
@@ -157,7 +163,7 @@ class CircuitBreaker:
     def should_probe(self, now: Optional[float] = None) -> bool:
         if self.state != OPEN:
             return True
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         if now - self.opened_at >= self.cooldown_s():
             self.state = HALF_OPEN
             self._half_ok = 0
@@ -186,7 +192,7 @@ class CircuitBreaker:
                        hard: bool = False) -> bool:
         """One failed probe/dispatch. Returns True when it OPENED the
         breaker (the caller evicts the replica)."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         self.failures += 1
         if self.state == HALF_OPEN or (
                 self.state == CLOSED

@@ -38,6 +38,8 @@ from . import failover, kv_transport
 from .admission import (AdmissionConfig, AdmissionController,
                         AdmissionRejected)
 from .autoscaler import AutoscaleConfig, FleetAutoscaler, FleetMetrics
+from .batch import (BATCH_PRIORITY, INTERACTIVE_PRIORITY, BatchLane,
+                    BatchLaneConfig)
 from .failover import CircuitBreaker, HealthConfig
 from .kv_transport import (FleetPrefixStore, TransportConfig,
                            TransportError)
@@ -138,7 +140,8 @@ class FleetManager:
                  dispatch_timeout_s: float = 10.0,
                  drain_timeout_s: float = 120.0,
                  roles: Optional[Sequence[str]] = None,
-                 transport: Optional[TransportConfig] = None):
+                 transport: Optional[TransportConfig] = None,
+                 batch_lane: Optional[BatchLaneConfig] = None):
         if not clients:
             raise ValueError("a fleet needs at least one replica")
         # per-replica roles (ISSUE 12 disaggregation): aligned with
@@ -211,6 +214,14 @@ class FleetManager:
         # or not): publishing is once-per-fingerprint, never a
         # per-request tax on the response path
         self._prefix_attempted: set = set()
+        # preemptible batch-inference lane (ISSUE 14): POST /v1/batch
+        # jobs dispatched at BATCH_PRIORITY outside the front-door
+        # queue, soaking idle capacity; None = lane off (and then no
+        # interactive priority stamping either — the pre-ISSUE-14
+        # fleet byte-for-byte)
+        self.batch: Optional[BatchLane] = (
+            BatchLane(self, batch_lane)
+            if batch_lane is not None else None)
         self._sync_ring()
         if not self._ring_ids():
             # the INITIAL ACTIVE set (the first min_replicas clients)
@@ -306,7 +317,8 @@ class FleetManager:
         return str(body.get("user") or body.get("tenant") or "default")
 
     # -- distributed tracing (ISSUE 7) ----------------------------------
-    def _trace_begin(self, method: str, body: Dict[str, Any]):
+    def _trace_begin(self, method: str, body: Dict[str, Any],
+                     lane: Optional[str] = None):
         """Mint the request's trace context at fleet ingress: one
         request id and one trace id that follow it across admission,
         routing, and the replica's engine lifecycle (the context rides
@@ -325,6 +337,26 @@ class FleetManager:
         # expositions stay label-free
         tenant = self.tenant_of(body)
         body["_tenant"] = "" if tenant == "default" else tenant
+        if lane == "batch":
+            # the batch lane's identity (ISSUE 14): priority is
+            # FORCED to the bottom tier (a job body naming its own
+            # priority must not outrank interactive traffic) and the
+            # engine's SLO exclusion keys off the minted _lane
+            body["_lane"] = "batch"
+            body["priority"] = BATCH_PRIORITY
+        elif self.batch is not None:
+            # with the lane on, interactive traffic rides one tier up
+            # so the engine's victim order (lowest priority first)
+            # can never tie batch work against a user request — and a
+            # client that explicitly sends the pre-lane default
+            # priority 0 is CLAMPED up, not trusted: priorities <=
+            # BATCH_PRIORITY belong to the lane (relative order among
+            # clients above the floor is preserved)
+            try:
+                p = int(body.get("priority"))
+            except (TypeError, ValueError):
+                p = INTERACTIVE_PRIORITY
+            body["priority"] = max(p, INTERACTIVE_PRIORITY)
         if not self.enable_tracing:
             return body, None
         # ALWAYS mint — `_request_id` doubles as the engine request id
@@ -378,21 +410,31 @@ class FleetManager:
         self.metrics["deadline_sheds"].inc(
             1, {"model": self.model_id, "stage": stage})
 
-    async def dispatch(self, method: str, body: Dict[str, Any]) -> Any:
+    async def dispatch(self, method: str, body: Dict[str, Any],
+                       lane: Optional[str] = None) -> Any:
         """Unary request through admission + routing (trace-minted).
         A replica failure/timeout feeds the breaker and the request
         retries on another replica (bounded by health.max_failovers) —
-        no tokens have reached the client, so a retry is invisible."""
-        body, rec = self._trace_begin(method, body)
+        no tokens have reached the client, so a retry is invisible.
+
+        lane="batch" (ISSUE 14) BYPASSES the admission controller:
+        the front door's queue bound and SLO/brownout sheds protect
+        user-visible waits, and a bulk job's whole point is to wait
+        out the rush — its backpressure is the BatchLane pump's soak
+        governor plus the engine's own priority-0 queueing, so its
+        depth never feeds the shed/overload signals."""
+        batch = lane == "batch"
+        body, rec = self._trace_begin(method, body, lane=lane)
         deadline = self._mint_deadline(body)
-        try:
-            await self.admission.acquire(self.tenant_of(body),
-                                         deadline=deadline)
-        except AdmissionRejected as e:
-            if e.reason == "deadline":
-                self._count_deadline_shed("admission")
-            self._trace_end(rec, f"rejected:{e.reason}")
-            raise
+        if not batch:
+            try:
+                await self.admission.acquire(self.tenant_of(body),
+                                             deadline=deadline)
+            except AdmissionRejected as e:
+                if e.reason == "deadline":
+                    self._count_deadline_shed("admission")
+                self._trace_end(rec, f"rejected:{e.reason}")
+                raise
         if rec is not None:
             rec["t_admit"] = time.monotonic()
         attempts = 0
@@ -466,7 +508,8 @@ class FleetManager:
                 rec["status"] = "error"
             raise
         finally:
-            self.admission.release()
+            if not batch:
+                self.admission.release()
             self._trace_end(rec)
 
     async def dispatch_stream(self, method: str, body: Dict[str, Any]
@@ -1275,8 +1318,12 @@ class FleetManager:
                     d[k] += max(0.0, cur[k] - prev.get(k, 0.0))
                 self._prev_slo[rid] = cur
             if st.snapshot is not None and st.status == ACTIVE:
-                waiting += st.snapshot.waiting
-                occ.append(st.snapshot.kv_occupancy)
+                # batch lane (ISSUE 14): queued priority-0 bulk work
+                # is harvested idle capacity — the autoscaler must
+                # scale on INTERACTIVE depth only, or a deliberately
+                # deep batch backlog would page the fleet to max
+                waiting += st.snapshot.displaceable_waiting()
+                occ.append(st.snapshot.interactive_occupancy())
                 # max, not mean (ISSUE 10): one oversubscribed replica
                 # is already spill/restore-taxing its streams even
                 # when its siblings sit idle
@@ -1316,6 +1363,26 @@ class FleetManager:
             self._watch_prev[rid] = cur
         return dict(self._watch_accum)
 
+    def _interactive_idle(self) -> bool:
+        """No interactive demand anywhere: front door empty AND every
+        ACTIVE replica's snapshot shows zero interactive requests
+        queued or decoding (batch-lane depth is the trough's own soak
+        and does not count). Conservative toward False — a missing
+        snapshot is unknown, not idle."""
+        if self.admission.inflight > 0 \
+                or self.admission._queue_len() > 0:
+            return False
+        for st in self.replicas.values():
+            if st.status != ACTIVE:
+                continue
+            snap = st.snapshot
+            if snap is None:
+                return False
+            if (snap.active - snap.active_batch) > 0 \
+                    or snap.displaceable_waiting() > 0:
+                return False
+        return True
+
     def watchdog_tick(self, now: Optional[float] = None) -> None:
         """One watchdog evaluation over the freshly-refreshed replica
         totals, plus the reactions: brownout the front door while
@@ -1325,7 +1392,8 @@ class FleetManager:
         if not self.watchdog.config.enabled:
             return
         was_paging = self.watchdog.paging
-        self.watchdog.observe(self._watchdog_totals(), now)
+        self.watchdog.observe(self._watchdog_totals(), now,
+                              idle=self._interactive_idle())
         paging = self.watchdog.paging
         # KV page pressure (ISSUE 10): max over active replicas, with
         # fleet spillability deciding the reaction — pressure on a
@@ -1494,6 +1562,8 @@ class FleetManager:
                 self._control_loop())
 
     async def stop(self) -> None:
+        if self.batch is not None:
+            await self.batch.stop()
         if self._loop_task is not None:
             self._loop_task.cancel()
             try:
@@ -1588,6 +1658,9 @@ class FleetManager:
                 **({} if snap is None else {
                     "active": snap.active,
                     "waiting": snap.waiting,
+                    # batch lane (ISSUE 14): the preemptible share
+                    "waiting_batch": snap.waiting_batch,
+                    "active_batch": snap.active_batch,
                     "kv_occupancy": round(snap.kv_occupancy, 4),
                     "free_pages": snap.free_pages,
                     "prefix_cache_hit_rate": round(
@@ -1659,6 +1732,10 @@ class FleetManager:
                         if self.prefix_store is not None else None),
                 }),
             },
+            # preemptible batch lane (ISSUE 14)
+            "batch": (self.batch.stats()
+                      if self.batch is not None
+                      else {"enabled": False}),
             "recorder": self.recorder.stats(),
             "health": {
                 "probe_failures": self.health.probe_failures,

@@ -34,7 +34,7 @@ import hashlib
 import itertools
 import json
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 
 def _h(key: str) -> int:
@@ -73,16 +73,25 @@ class HashRing:
     the removed node's (the classic minimal-disruption property; the
     fleet tests assert it)."""
 
+    # walk orderings memoized per key between membership changes: a
+    # production fleet routes thousands of requests (and the traffic
+    # simulator millions — ISSUE 14) over repeating prefix
+    # fingerprints while the ring stays put, and the walk is the
+    # expensive part of a pick. Bounded; cleared on add/remove.
+    _CACHE_MAX = 4096
+
     def __init__(self, vnodes: int = 64):
         self.vnodes = vnodes
         self._points: List[int] = []        # sorted vnode hashes
         self._owner: Dict[int, str] = {}    # vnode hash -> node
         self._nodes: set = set()
+        self._walks: Dict[str, List[str]] = {}
 
     def add(self, node: str) -> None:
         if node in self._nodes:
             return
         self._nodes.add(node)
+        self._walks.clear()
         for i in range(self.vnodes):
             p = _h(f"{node}#{i}")
             # vnode collisions across nodes are astronomically rare;
@@ -96,6 +105,7 @@ class HashRing:
         if node not in self._nodes:
             return
         self._nodes.discard(node)
+        self._walks.clear()
         dead = [p for p, n in self._owner.items() if n == node]
         for p in dead:
             del self._owner[p]
@@ -108,9 +118,13 @@ class HashRing:
         return len(self._nodes)
 
     def preferred(self, key: str) -> List[str]:
-        """All nodes in ring-walk order from `key`'s point."""
+        """All nodes in ring-walk order from `key`'s point. The
+        returned list is a cache entry — callers read, never mutate."""
         if not self._points:
             return []
+        hit = self._walks.get(key)
+        if hit is not None:
+            return hit
         out: List[str] = []
         seen = set()
         start = bisect.bisect_left(self._points, _h(key))
@@ -122,6 +136,9 @@ class HashRing:
                 out.append(node)
                 if len(out) == len(self._nodes):
                     break
+        if len(self._walks) >= self._CACHE_MAX:
+            self._walks.clear()
+        self._walks[key] = out
         return out
 
 
@@ -131,6 +148,17 @@ class ReplicaSnapshot:
     replica: str
     active: int = 0                  # requests holding a decode slot
     waiting: int = 0                 # engine admission queue depth
+    # batch lane (ISSUE 14): how much of `waiting`/`active` is
+    # priority-0 batch-lane work — the autoscaler/watchdog plane
+    # subtracts it from its overload signals (a deep queue of
+    # preemptible bulk jobs is harvested idle capacity, not overload)
+    waiting_batch: int = 0
+    active_batch: int = 0
+    # fraction of the usable KV pool held by batch-lane slots: the
+    # autoscaler's idle check reads occupancy MINUS this (a fleet
+    # soaked to 85% with displaceable bulk work must still scale
+    # down when interactive traffic leaves)
+    kv_occupancy_batch: float = 0.0
     kv_occupancy: float = 0.0        # used / usable KV pages
     free_pages: int = 0
     cache_hit_rate: float = 0.0      # cumulative prefix-cache hit rate
@@ -172,6 +200,22 @@ class ReplicaSnapshot:
         now = time.monotonic() if now is None else now
         return max(now - self.mono_ts, 0.0)
 
+    def displaceable_waiting(self) -> int:
+        """Engine queue depth MINUS the batch lane (ISSUE 14): queued
+        priority-0 bulk jobs are displaceable — an interactive
+        request routed here jumps them (and preempts their running
+        peers) — so every consumer of "how loaded is this replica
+        with INTERACTIVE work" (router saturation/score, autoscaler
+        window, batch soak governor) reads this ONE definition."""
+        return max(self.waiting - self.waiting_batch, 0)
+
+    def interactive_occupancy(self) -> float:
+        """KV occupancy minus the batch-lane share (ISSUE 14): the
+        autoscaler's scale-down signal — pages held by displaceable
+        bulk work must not keep a fleet pinned at size after its
+        interactive traffic leaves."""
+        return max(self.kv_occupancy - self.kv_occupancy_batch, 0.0)
+
     @classmethod
     def from_stats(cls, stats: Dict[str, Any]) -> "ReplicaSnapshot":
         perf = stats.get("perf") or {}
@@ -180,6 +224,10 @@ class ReplicaSnapshot:
             replica=stats.get("replica", ""),
             active=int(stats.get("active", 0)),
             waiting=int(stats.get("waiting", 0)),
+            waiting_batch=int(stats.get("waiting_batch", 0)),
+            active_batch=int(stats.get("active_batch", 0)),
+            kv_occupancy_batch=float(
+                stats.get("kv_occupancy_batch", 0.0)),
             kv_occupancy=float(stats.get("kv_occupancy", 0.0)),
             free_pages=int(stats.get("free_pages", 0)),
             cache_hit_rate=float(stats.get("cache_hit_rate", 0.0)),
@@ -230,8 +278,13 @@ class FleetRouter:
     each replica's fleet_stats) and the in-flight counts (updated at
     dispatch/completion — the only zero-lag load signal)."""
 
-    def __init__(self, config: Optional[RouterConfig] = None):
+    def __init__(self, config: Optional[RouterConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.config = config or RouterConfig()
+        # injectable clock (ISSUE 14): snapshot-staleness judgments
+        # compare against this time source — virtual in the simulator,
+        # time.monotonic in a real fleet (matching mono_ts stamps)
+        self._clock = clock if clock is not None else time.monotonic
         self.ring = HashRing(vnodes=self.config.vnodes)
         self._rr = itertools.count()
         # routing telemetry (served at GET /fleet)
@@ -258,18 +311,25 @@ class FleetRouter:
         failing — ISSUE 9) adds a flat deprioritization penalty."""
         c = self.config
         return (c.w_occupancy * snap.kv_occupancy
-                + c.w_waiting * (snap.waiting + snap.active * 0.25)
+                + c.w_waiting * (snap.displaceable_waiting()
+                                 + snap.active * 0.25)
                 + c.w_inflight * inflight
                 + (c.w_stale
-                   if snap.age_s() > c.snapshot_stale_s else 0.0))
+                   if snap.age_s(self._clock()) > c.snapshot_stale_s
+                   else 0.0))
 
     def _saturated(self, snap: ReplicaSnapshot, inflight: int) -> bool:
+        # batch-lane depth is displaceable load (ISSUE 14): a replica
+        # soaking bulk work must not repel its affinity traffic as if
+        # it were saturated — neither its queued batch requests nor
+        # the KV pages its batch slots hold (they spill on demand)
         c = self.config
-        return (snap.kv_occupancy >= c.spill_occupancy
-                or snap.waiting + inflight >= c.spill_waiting
+        return (snap.interactive_occupancy() >= c.spill_occupancy
+                or snap.displaceable_waiting() + inflight
+                >= c.spill_waiting
                 # stale numbers are no basis for an affinity hit:
                 # walk on to a replica whose state is known
-                or snap.age_s() > c.snapshot_stale_s)
+                or snap.age_s(self._clock()) > c.snapshot_stale_s)
 
     # -- the pick -------------------------------------------------------
     def pick(self, fingerprint: str,

@@ -1,0 +1,40 @@
+"""serve.llm.sim — the million-session fleet simulator (ISSUE 14).
+
+A seeded discrete-event simulator (virtual clock + event heap) that
+drives the REAL fleet policy objects — `FleetRouter`,
+`AdmissionController`, `FleetAutoscaler`, `SLOBurnWatchdog`,
+`CircuitBreaker` — in virtual time, against synthetic replica engines
+whose tick/prefill/preemption timing is calibrated from the measured
+engine (`stats()["tick_times"]` + the PR 11 PerfSample window, via
+`tools/simcal`). Millions of sessions of diurnal / flash-crowd /
+tenant-skew / chaos traffic replay in seconds of host time; runs are
+byte-identical per seed; capacity-planning curves (replicas vs p99
+TTFT) emit as JSON artifacts.
+
+The headroom the curves reveal is harvested by the batch lane
+(serve/llm/batch.py) — which the simulator also models, so batch-soak
+policies can be tuned at a million sessions before they ever touch a
+real fleet. BENCH_CORE.md "Traffic simulation anatomy" documents the
+model and its fidelity gates.
+"""
+
+from __future__ import annotations
+
+from .calibration import (CALIBRATION_BAND,  # noqa: F401
+                          SimCalibration, default_cpu_calibration)
+from .capacity import capacity_curve, write_artifact  # noqa: F401
+from .core import (FleetSimulator, SimFleetConfig,  # noqa: F401
+                   VirtualClock, assert_slos)
+from .replica import Hist, SyntheticReplica  # noqa: F401
+from .traffic import (BATCH, INTERACTIVE, ChaosEvent,  # noqa: F401
+                      SimSession, TraceConfig, batch_backlog,
+                      chaos_overlay, generate)
+
+__all__ = [
+    "FleetSimulator", "SimFleetConfig", "VirtualClock", "assert_slos",
+    "SimCalibration", "default_cpu_calibration", "CALIBRATION_BAND",
+    "SyntheticReplica", "Hist",
+    "TraceConfig", "SimSession", "ChaosEvent", "generate",
+    "batch_backlog", "chaos_overlay", "INTERACTIVE", "BATCH",
+    "capacity_curve", "write_artifact",
+]

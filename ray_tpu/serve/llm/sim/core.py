@@ -1,0 +1,602 @@
+"""FleetSimulator: the discrete-event loop over the REAL policy
+objects.
+
+This is the point of the whole package (ROADMAP item 5): every fleet
+control policy — `FleetRouter` ring walks, `AdmissionController`
+stride queueing and SLO sheds, `FleetAutoscaler` hysteresis,
+`SLOBurnWatchdog` multi-window burn + brownout, per-replica
+`CircuitBreaker`s — runs here as the PRODUCTION object, imported from
+its production module, constructed with the simulator's virtual
+clock injected through the `clock=` parameter ISSUE 14 threaded in.
+No forks, no monkeypatching: a policy bug the simulator finds is a
+bug the fleet ships, and the tier-1 suite asserts the identity
+(`sim.router.__class__ is serve.llm.FleetRouter`, etc.).
+
+Only the replicas are synthetic (replica.py — closed-form continuous
+batching calibrated from measured tick times), which is what lets a
+million sessions of simulated traffic replay in seconds: the event
+heap carries one arrival per session, one wake per completion batch,
+and a control tick at the fleet's refresh cadence.
+
+Determinism: same (trace config, sim config, seed) → byte-identical
+`run()` summary. All randomness flows from seeded `random.Random`
+streams (traffic + per-replica tick draws); the virtual clock is the
+only time source; iteration orders are index-stable. The summary is
+canonical JSON (`summary_json()`, sorted keys) so the gate is one
+string compare.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+from ..admission import (AdmissionConfig, AdmissionController,
+                         AdmissionRejected)
+from ..autoscaler import (AutoscaleConfig, FleetAutoscaler,
+                          FleetMetrics)
+from ..failover import CircuitBreaker, HealthConfig
+from ..router import FleetRouter, ReplicaSnapshot, RouterConfig
+from ..watchdog import SLOBurnWatchdog, WatchdogConfig
+from .calibration import SimCalibration
+from .replica import Hist, SyntheticReplica
+from .traffic import BATCH, ChaosEvent, SimSession
+
+ACTIVE = "ACTIVE"
+DRAINING = "DRAINING"
+STANDBY = "STANDBY"
+UNHEALTHY = "UNHEALTHY"
+
+_ARRIVE, _WAKE, _CONTROL, _CHAOS = 0, 1, 2, 3
+
+
+class VirtualClock:
+    __slots__ = ("t",)
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+
+@dataclasses.dataclass
+class SimFleetConfig:
+    """The simulated fleet's shape. Replica counts mirror
+    FleetConfig (min active at start, max provisioned)."""
+    replicas: int = 4
+    min_replicas: int = 1
+    slots_per_replica: int = 8
+    pages_per_replica: int = 2048
+    calibration: Optional[SimCalibration] = None
+    router: Optional[RouterConfig] = None
+    admission: Optional[AdmissionConfig] = None
+    autoscale: Optional[AutoscaleConfig] = None
+    watchdog: Optional[WatchdogConfig] = None
+    health: Optional[HealthConfig] = None
+    slo_targets: Optional[Dict[str, float]] = None
+    control_period_s: float = 1.0
+    autoscale_period_s: float = 5.0
+    seed: int = 0
+
+
+class FleetSimulator:
+    def __init__(self, trace: Iterable[SimSession],
+                 config: SimFleetConfig,
+                 batch_jobs: Optional[List[SimSession]] = None,
+                 chaos: Optional[List[ChaosEvent]] = None):
+        cfg = config
+        self.cfg = cfg
+        self.clock = VirtualClock()
+        clk = self.clock.now
+        calib = cfg.calibration or SimCalibration(
+            decode_tick_ms={"1": {"p50": 1.0, "p95": 1.5,
+                                  "p99": 2.5}})
+        # ---- the PRODUCTION policy objects, virtual-clocked --------
+        self.router = FleetRouter(cfg.router or RouterConfig(),
+                                  clock=clk)
+        self.admission = AdmissionController(
+            cfg.admission or AdmissionConfig(), clock=clk)
+        auto = cfg.autoscale or AutoscaleConfig(
+            min_replicas=cfg.min_replicas,
+            max_replicas=cfg.replicas)
+        self.autoscaler = FleetAutoscaler(auto, clock=clk)
+        self.watchdog = SLOBurnWatchdog(
+            cfg.watchdog or WatchdogConfig(), clock=clk)
+        health = cfg.health or HealthConfig()
+        # ---- synthetic data plane ----------------------------------
+        self.replicas: List[SyntheticReplica] = [
+            SyntheticReplica(f"r{i}", calib,
+                             slots=cfg.slots_per_replica,
+                             pages=cfg.pages_per_replica,
+                             seed=cfg.seed,
+                             slo_targets=cfg.slo_targets)
+            for i in range(cfg.replicas)]
+        self.status = [ACTIVE if i < max(cfg.min_replicas, 1)
+                       else STANDBY for i in range(cfg.replicas)]
+        self.breakers = [CircuitBreaker(health, clock=clk)
+                         for _ in range(cfg.replicas)]
+        self._by_rid = {r.rid: i
+                        for i, r in enumerate(self.replicas)}
+        self._sync_ring()
+        # ---- event plumbing ----------------------------------------
+        self._trace = iter(trace)
+        self._batch_jobs = list(batch_jobs or [])
+        self._chaos = sorted(chaos or [], key=lambda e: e.at)
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._pending: Dict[Any, SimSession] = {}   # ticket -> sess
+        self._snapshots: Dict[str, ReplicaSnapshot] = {}
+        self._inflight: Dict[str, int] = {r.rid: 0
+                                          for r in self.replicas}
+        self._session_replica: Dict[int, str] = {}
+        self._dead_until = [0.0] * cfg.replicas
+        self._stall_until = [0.0] * cfg.replicas
+        self._prev_slo: Dict[str, Dict[str, float]] = {}
+        self._prev_shed = 0
+        self._watch_accum = {k: 0.0 for k in
+                             ("ttft_n", "ttft_bad", "queue_n",
+                              "queue_bad", "e2e_n", "e2e_bad")}
+        self._watch_prev: Dict[str, Dict[str, float]] = {}
+        # ---- results -----------------------------------------------
+        self.ttft = Hist()
+        self.itl = Hist()
+        self.e2e = Hist()
+        self.front_wait = Hist()
+        self.counts = {"arrived": 0, "admitted": 0, "completed": 0,
+                       "failed_over": 0, "batch_submitted": 0,
+                       "batch_completed": 0}
+        self.shed: Dict[str, int] = {}
+        self.per_tenant: Dict[str, int] = {}
+        self.scale_events = 0
+        self.active_minmax = [len(self._ring_ids()),
+                              len(self._ring_ids())]
+        self.pages_seen = 0
+        self.evictions = 0
+        self.readmissions = 0
+
+    # -- membership ----------------------------------------------------
+    def _ring_ids(self) -> List[str]:
+        return [r.rid for i, r in enumerate(self.replicas)
+                if self.status[i] == ACTIVE]
+
+    def _sync_ring(self) -> None:
+        self.router.set_replicas(self._ring_ids())
+
+    # -- event heap ----------------------------------------------------
+    def _push(self, t: float, kind: int, data: Any) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, kind, data))
+
+    def _schedule_wake(self, idx: int) -> None:
+        rep = self.replicas[idx]
+        nxt = rep.next_wall(self.clock.t)
+        if nxt is None:
+            rep.scheduled_wall = None
+            return
+        if rep.scheduled_wall is not None \
+                and rep.scheduled_wall <= nxt + 1e-9:
+            return             # an earlier (or equal) wake is pending
+        rep.wake_version += 1
+        rep.scheduled_wall = nxt
+        self._push(nxt, _WAKE, (idx, rep.wake_version))
+
+    # -- request path --------------------------------------------------
+    def _route(self, sess: SimSession) -> Optional[int]:
+        rid, _ = self.router.pick_ex(f"g{sess.group}",
+                                     self._snapshots,
+                                     self._inflight)
+        return None if rid is None else self._by_rid[rid]
+
+    def _dispatch(self, sess: SimSession) -> None:
+        idx = self._route(sess)
+        if idx is None:
+            self.shed["no_active_replicas"] = \
+                self.shed.get("no_active_replicas", 0) + 1
+            if sess.lane != BATCH:
+                self.admission.release()
+            return
+        rep = self.replicas[idx]
+        self._inflight[rep.rid] += 1
+        self._session_replica[sess.sid] = rep.rid
+        rep.enqueue(sess, self.clock.t)
+        self._schedule_wake(idx)
+
+    def _arrive(self, sess: SimSession) -> None:
+        self.counts["arrived"] += 1
+        self.per_tenant[sess.tenant] = \
+            self.per_tenant.get(sess.tenant, 0) + 1
+        if sess.lane == BATCH:
+            # the batch lane bypasses the front door (ISSUE 14) —
+            # its backpressure is engine-side priority queueing
+            self.counts["batch_submitted"] += 1
+            self._dispatch(sess)
+            return
+        try:
+            ticket = self.admission.submit(sess.tenant,
+                                           now=self.clock.t)
+        except AdmissionRejected as e:
+            self.shed[e.reason] = self.shed.get(e.reason, 0) + 1
+            return
+        self._pending[ticket] = sess
+        self._drain_grants()
+
+    def _drain_grants(self) -> None:
+        for ticket in self.admission.granted_sync():
+            sess = self._pending.pop(ticket, None)
+            if sess is None:
+                continue
+            self.counts["admitted"] += 1
+            self.front_wait.add(max(self.clock.t - sess.at, 0.0))
+            self._dispatch(sess)
+
+    def _complete(self, sess: SimSession, rid: str) -> None:
+        self._inflight[rid] = max(self._inflight[rid] - 1, 0)
+        self._session_replica.pop(sess.sid, None)
+        self.counts["completed"] += 1
+        if sess.lane == BATCH:
+            self.counts["batch_completed"] += 1
+        else:
+            self.admission.release()
+            self._drain_grants()
+
+    # -- control plane -------------------------------------------------
+    def _refresh(self) -> None:
+        """The FleetManager.refresh() analogue: probe each replica,
+        drive its breaker, stamp fresh snapshots."""
+        now = self.clock.t
+        for i, rep in enumerate(self.replicas):
+            if self.status[i] == STANDBY:
+                continue
+            br = self.breakers[i]
+            if not br.should_probe(now):
+                continue
+            if self._dead_until[i] > now:
+                if br.record_failure(now):
+                    self._evict(i)
+                continue
+            closed = br.record_success(now)
+            self._snapshots[rep.rid] = ReplicaSnapshot.from_stats(
+                rep.snapshot_stats())
+            self._snapshots[rep.rid].mono_ts = now
+            if closed and self.status[i] == UNHEALTHY:
+                self.status[i] = ACTIVE
+                self.readmissions += 1
+                self._sync_ring()
+
+    def _evict(self, idx: int) -> None:
+        if self.status[idx] != ACTIVE:
+            return
+        others = [r for r in self._ring_ids()
+                  if r != self.replicas[idx].rid]
+        if not others:
+            # last-replica guard (fleet.py): activate a standby
+            for j, st in enumerate(self.status):
+                if st == STANDBY:
+                    self.status[j] = ACTIVE
+                    break
+            else:
+                return
+        self.status[idx] = UNHEALTHY
+        self.evictions += 1
+        self._sync_ring()
+        # fail the resident sessions over (PR 9 replay semantics)
+        rep = self.replicas[idx]
+        for sess in rep.fail_all(self.clock.t):
+            self._inflight[rep.rid] = max(
+                self._inflight[rep.rid] - 1, 0)
+            self.counts["failed_over"] += 1
+            self._dispatch(sess)
+
+    def _watch_totals(self) -> Dict[str, float]:
+        # per-replica clamped deltas into fleet-monotone totals, the
+        # FleetManager._watchdog_totals discipline (synthetic
+        # replicas never restart, but DRAINING->ACTIVE cycles reuse
+        # the same accumulators)
+        accum = self._watch_accum
+        for rep in self.replicas:
+            prev = self._watch_prev.get(rep.rid)
+            tot = rep.slo_totals
+            cur = {k: tot[k] for k in accum}
+            if prev is None:
+                for k in accum:
+                    accum[k] += cur[k]
+            else:
+                for k in accum:
+                    d = cur[k] - prev[k]
+                    if d > 0:
+                        accum[k] += d
+            self._watch_prev[rep.rid] = cur
+        return dict(accum)
+
+    def _fleet_metrics(self) -> FleetMetrics:
+        keys = ("ttft_s", "ttft_n", "queue_s", "queue_n")
+        d = {k: 0.0 for k in keys}
+        waiting = 0
+        occ: List[float] = []
+        pressure = 0.0
+        for i, rep in enumerate(self.replicas):
+            prev = self._prev_slo.get(rep.rid, {})
+            cur = {k: rep.slo_totals[k] for k in keys}
+            for k in keys:
+                d[k] += max(cur[k] - prev.get(k, 0.0), 0.0)
+            self._prev_slo[rep.rid] = cur
+            if self.status[i] == ACTIVE:
+                waiting += max(rep.waiting_count()
+                               - rep.waiting_batch_count(), 0)
+                # interactive occupancy only (the FleetManager
+                # discipline): soaked batch pages are displaceable
+                # and must not veto scale-down
+                occ.append(rep.interactive_occupancy())
+                pressure = max(pressure, rep.page_pressure())
+        shed = (self.admission.shed_total
+                + self.admission.rejected["queue_full"]
+                + self.admission.rejected["brownout"])
+        shed_delta = shed - self._prev_shed
+        self._prev_shed = shed
+        return FleetMetrics(
+            ttft_ms=(d["ttft_s"] / d["ttft_n"] * 1e3
+                     if d["ttft_n"] > 0 else 0.0),
+            queue_wait_ms=(d["queue_s"] / d["queue_n"] * 1e3
+                           if d["queue_n"] > 0 else 0.0),
+            waiting=waiting,
+            occupancy=(sum(occ) / len(occ) if occ else 0.0),
+            shed_delta=shed_delta,
+            slo_page=self.watchdog.paging,
+            slo_burn=self.watchdog.max_burn,
+            page_pressure=pressure)
+
+    def _apply_target(self, target: int) -> None:
+        active = [i for i, st in enumerate(self.status)
+                  if st == ACTIVE]
+        if target > len(active):
+            for i, st in enumerate(self.status):
+                if st == STANDBY and target > len(active):
+                    self.status[i] = ACTIVE
+                    active.append(i)
+                    self.scale_events += 1
+        elif target < len(active):
+            # drain the emptiest first, never below one
+            order = sorted(
+                active,
+                key=lambda i: (self._inflight[self.replicas[i].rid],
+                               self.replicas[i].occupancy()))
+            for i in order[:len(active) - target]:
+                if len(self._ring_ids()) <= 1:
+                    break
+                self.status[i] = DRAINING
+                self.scale_events += 1
+        self._sync_ring()
+
+    def _interactive_idle(self) -> bool:
+        """FleetManager._interactive_idle analogue: no front-door
+        tickets pending and no interactive session queued or decoding
+        on any active replica (batch soak does not count)."""
+        if self._pending:
+            return False
+        for i, rep in enumerate(self.replicas):
+            if self.status[i] != ACTIVE:
+                continue
+            if any(lv.sess.lane != BATCH
+                   for lv in rep.active.values()):
+                return False
+            if rep.waiting_count() - rep.waiting_batch_count() > 0:
+                return False
+        return True
+
+    def _control(self) -> None:
+        now = self.clock.t
+        self._refresh()
+        # watchdog + brownout (FleetManager.watchdog_tick analogue)
+        self.watchdog.observe(self._watch_totals(), now,
+                              idle=self._interactive_idle())
+        pressure = 0.0
+        spillable = True
+        for i, rep in enumerate(self.replicas):
+            if self.status[i] == ACTIVE:
+                pressure = max(pressure, rep.page_pressure())
+        self.watchdog.observe_pressure(pressure)
+        shed_for_pressure = (self.watchdog.pressure_state == "high"
+                             and not spillable)
+        self.admission.set_page_pressure(pressure, spillable)
+        self.admission.set_brownout(self.watchdog.paging
+                                    or shed_for_pressure)
+        # front-door SLO timer (acquire()'s asyncio timer analogue)
+        for t in self.admission.shed_expired(now):
+            sess = self._pending.pop(t, None)
+            if sess is not None:
+                self.shed["queue_wait_slo"] = \
+                    self.shed.get("queue_wait_slo", 0) + 1
+        self._drain_grants()
+        # drained replicas park
+        for i, st in enumerate(self.status):
+            if st == DRAINING and self.replicas[i].idle() \
+                    and self._inflight[self.replicas[i].rid] == 0:
+                self.status[i] = STANDBY
+        n_active = len([1 for st in self.status if st == ACTIVE])
+        self.active_minmax[0] = min(self.active_minmax[0], n_active)
+        self.active_minmax[1] = max(self.active_minmax[1], n_active)
+
+    def _autoscale(self) -> None:
+        active = len([1 for st in self.status if st == ACTIVE])
+        target = self.autoscaler.decide(self._fleet_metrics(),
+                                        active, self.clock.t)
+        if target != active:
+            self._apply_target(target)
+
+    def _apply_chaos(self, ev: ChaosEvent) -> None:
+        idx = ev.replica % len(self.replicas)
+        rep = self.replicas[idx]
+        if ev.kind == "die":
+            self._dead_until[idx] = self.clock.t + ev.duration_s
+            for sess in rep.fail_all(self.clock.t):
+                self._inflight[rep.rid] = max(
+                    self._inflight[rep.rid] - 1, 0)
+                self.counts["failed_over"] += 1
+                self._dispatch(sess)
+        else:
+            self._stall_until[idx] = self.clock.t + ev.duration_s
+            rep.stall_factor = max(ev.factor, 1.0)
+            self._push(self.clock.t + ev.duration_s, _CHAOS,
+                       ("unstall", idx))
+            self._schedule_wake(idx)
+
+    # -- the loop ------------------------------------------------------
+    def run(self, max_virtual_s: Optional[float] = None
+            ) -> Dict[str, Any]:
+        cfg = self.cfg
+        for sess in self._batch_jobs:
+            self._push(sess.at, _ARRIVE, sess)
+        self._push(0.0, _CONTROL, None)
+        for ev in self._chaos:
+            self._push(ev.at, _CHAOS, ev)
+        next_arrival = next(self._trace, None)
+        last_autoscale = 0.0
+        heap = self._heap
+        while heap or next_arrival is not None:
+            if next_arrival is not None and (
+                    not heap or next_arrival.at <= heap[0][0]):
+                self.clock.t = max(self.clock.t, next_arrival.at)
+                self._arrive(next_arrival)
+                next_arrival = next(self._trace, None)
+                continue
+            t, _, kind, data = heapq.heappop(heap)
+            if max_virtual_s is not None and t > max_virtual_s \
+                    and next_arrival is None:
+                break
+            self.clock.t = max(self.clock.t, t)
+            if kind == _ARRIVE:
+                # heap-scheduled arrivals (the batch backlog rides
+                # here; trace arrivals stream from the iterator)
+                self._arrive(data)
+            elif kind == _WAKE:
+                idx, version = data
+                rep = self.replicas[idx]
+                if rep.wake_version != version:
+                    continue
+                rep.scheduled_wall = None
+                for sess in rep.wake(self.clock.t, self.ttft,
+                                     self.itl, self.e2e):
+                    self._complete(sess, rep.rid)
+                self._schedule_wake(idx)
+            elif kind == _CONTROL:
+                self._control()
+                if self.clock.t - last_autoscale \
+                        >= cfg.autoscale_period_s:
+                    last_autoscale = self.clock.t
+                    self._autoscale()
+                # stop ticking once the system has fully drained
+                if (next_arrival is not None or heap
+                        or any(not r.idle() for r in self.replicas)):
+                    self._push(self.clock.t + cfg.control_period_s,
+                               _CONTROL, None)
+            elif kind == _CHAOS:
+                if isinstance(data, tuple) and data[0] == "unstall":
+                    idx = data[1]
+                    if self._stall_until[idx] <= self.clock.t:
+                        self.replicas[idx].stall_factor = 1.0
+                        self._schedule_wake(idx)
+                else:
+                    self._apply_chaos(data)
+        return self.summary()
+
+    # -- results -------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        reps = self.replicas
+        return {
+            "sim": {
+                "seed": self.cfg.seed,
+                "replicas": self.cfg.replicas,
+                "min_replicas": self.cfg.min_replicas,
+                "slots_per_replica": self.cfg.slots_per_replica,
+                "pages_per_replica": self.cfg.pages_per_replica,
+                "virtual_s": round(self.clock.t, 3),
+            },
+            "sessions": dict(sorted(self.counts.items())),
+            "shed": dict(sorted(self.shed.items())),
+            "admission": {
+                "admitted": self.admission.admitted,
+                "rejected": dict(self.admission.rejected),
+                "shed_total": self.admission.shed_total,
+                "brownout": self.admission.brownout,
+            },
+            "latency": {
+                "ttft": self.ttft.summary_ms(),
+                "itl": self.itl.summary_ms(),
+                "e2e": self.e2e.summary_ms(),
+                "front_door_wait": self.front_wait.summary_ms(),
+            },
+            "router": self.router.stats(),
+            "autoscale": {
+                "events": self.scale_events,
+                "active_min": self.active_minmax[0],
+                "active_max": self.active_minmax[1],
+                "final_active": len(self._ring_ids()),
+            },
+            "watchdog": {
+                "paging": self.watchdog.paging,
+                "alerts_total": self.watchdog.alerts_total,
+                "state": dict(sorted(self.watchdog.state.items())),
+            },
+            "health": {
+                "evictions": self.evictions,
+                "readmissions": self.readmissions,
+            },
+            "batch": {
+                "submitted": self.counts["batch_submitted"],
+                "completed": self.counts["batch_completed"],
+                "tokens": sum(r.batch_tokens for r in reps),
+            },
+            "engine": {
+                "completed": sum(r.completed for r in reps),
+                "decode_tokens": sum(r.decode_tokens for r in reps),
+                "preemptions": sum(r.preemptions for r in reps),
+                "spills": sum(r.spills for r in reps),
+                "restores": sum(r.restores for r in reps),
+            },
+            "tenants": dict(sorted(self.per_tenant.items())),
+        }
+
+    def summary_json(self) -> str:
+        """Canonical rendering — the determinism gate compares these
+        byte-for-byte."""
+        return json.dumps(self.summary(), sort_keys=True,
+                          separators=(",", ":"))
+
+
+def assert_slos(summary: Dict[str, Any],
+                max_p99_ttft_s: Optional[float] = None,
+                max_p99_itl_s: Optional[float] = None,
+                max_shed_rate: Optional[float] = None,
+                min_completion_rate: float = 0.99) -> None:
+    """Fleet-level SLO assertions over a run summary (raises
+    AssertionError naming the violated objective)."""
+    s = summary["sessions"]
+    interactive = s["arrived"] - s["batch_submitted"]
+    done = s["completed"] - s["batch_completed"]
+    shed = sum(summary["shed"].values())
+    if interactive > 0:
+        rate = (done + shed) / interactive
+        assert rate >= min_completion_rate, (
+            f"only {rate:.4f} of interactive sessions reached a "
+            f"terminal state (completed {done} + shed {shed} of "
+            f"{interactive})")
+        if max_shed_rate is not None:
+            assert shed / interactive <= max_shed_rate, (
+                f"shed rate {shed / interactive:.4f} over "
+                f"{max_shed_rate}")
+    lat = summary["latency"]
+    if max_p99_ttft_s is not None:
+        got = lat["ttft"]["p99_ms"] / 1e3
+        assert got <= max_p99_ttft_s, (
+            f"p99 TTFT {got:.3f}s over {max_p99_ttft_s}s")
+    if max_p99_itl_s is not None:
+        got = lat["itl"]["p99_ms"] / 1e3
+        assert got <= max_p99_itl_s, (
+            f"p99 ITL {got:.3f}s over {max_p99_itl_s}s")
+
+
+__all__ = ["FleetSimulator", "SimFleetConfig", "VirtualClock",
+           "assert_slos"]

@@ -1,0 +1,528 @@
+"""SyntheticReplica: a continuous-batching engine in closed form.
+
+The real engine's steady state is one ragged dispatch per tick, every
+decoding slot emitting one token per tick (PR 1). That invariant is
+what makes a fast simulation possible: a session's SERVICE demand is
+exact in tick-index space — `prefill_ticks(prompt) + out_tokens`
+ticks from slot admission — and only the tick DURATION varies (with
+batch size and prefill load, drawn from the calibration's measured
+percentiles). So the replica keeps a tick-index clock:
+
+    tick(t) = tick(t0) + (t - t0) / tick_ms(current membership)
+
+advanced lazily at every membership change; completions live in a
+heap keyed by tick index (which never changes once assigned —
+membership changes move their WALL time, not their tick), and the
+wall estimate for the earliest completion is recomputed on demand.
+One admission, one completion, and O(1) bookkeeping per session —
+millions of sessions replay in seconds of host time.
+
+Fidelity shortcuts (all verified against the real engine by the
+sim-vs-real calibration band): TTFT is estimated at slot admission
+(queue wait + prefill ticks at the then-current tick duration)
+rather than evented; concurrent prefills share the chunk budget only
+through the tick surcharge; KV pages reserve prompt+out up front
+with hash-group prefix sharing.
+
+The batch lane (ISSUE 14) is modeled with the engine's real policy:
+priority-0 sessions admit only through free capacity, an interactive
+arrival preempts the youngest batch slot when slots/pages are short
+(spill latency charged from the calibration), and parked batch work
+restores FIFO once no interactive request waits.
+"""
+
+from __future__ import annotations
+
+import bisect
+import heapq
+import random
+import zlib
+from typing import Any, Dict, List, Optional
+
+from .calibration import SimCalibration
+from .traffic import BATCH, SimSession
+
+
+class Hist:
+    """Fixed log-spaced latency histogram (seconds in, deterministic
+    percentiles out) — the summary's p50/p95/p99 source."""
+
+    __slots__ = ("bins", "counts", "n", "total")
+
+    _EDGES: List[float] = [1e-4 * (1.15 ** i) for i in range(180)]
+
+    def __init__(self):
+        self.counts = [0] * (len(self._EDGES) + 1)
+        self.n = 0
+        self.total = 0.0
+
+    def add(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self._EDGES, v)] += 1
+        self.n += 1
+        self.total += v
+
+    def pctl(self, q: float) -> float:
+        if not self.n:
+            return 0.0
+        want = max(int(q * (self.n - 1) + 0.5), 0)
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc > want:
+                return (self._EDGES[i] if i < len(self._EDGES)
+                        else self._EDGES[-1])
+        return self._EDGES[-1]
+
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+    def summary_ms(self) -> Dict[str, float]:
+        return {"n": self.n,
+                "mean_ms": round(self.mean() * 1e3, 3),
+                "p50_ms": round(self.pctl(0.50) * 1e3, 3),
+                "p95_ms": round(self.pctl(0.95) * 1e3, 3),
+                "p99_ms": round(self.pctl(0.99) * 1e3, 3)}
+
+
+class _Live:
+    """One session holding a slot (or parked)."""
+
+    __slots__ = ("sess", "enqueued_at", "admit_wall", "first_tick",
+                 "done_tick", "pages", "version", "ttft_wall",
+                 "remaining")
+
+    def __init__(self, sess: SimSession):
+        self.sess = sess
+        self.enqueued_at = sess.at
+        self.admit_wall = 0.0
+        self.first_tick = 0.0
+        self.done_tick = 0.0
+        self.pages = 0
+        self.version = 0
+        self.ttft_wall = 0.0
+        self.remaining = sess.out_tokens
+
+
+class SyntheticReplica:
+    """One replica's closed-form engine. The simulator owns the
+    clock; every public method takes `now` (virtual seconds)."""
+
+    def __init__(self, rid: str, calib: SimCalibration,
+                 slots: int = 8, pages: int = 2048,
+                 seed: int = 0, slo_targets: Optional[Dict[str,
+                                                          float]] = None):
+        self.rid = rid
+        self.calib = calib
+        self.slots = slots
+        self.num_pages = pages
+        # crc32, not hash(): string hashing is salted per process and
+        # would break the byte-identical-summary determinism gate
+        self.rng = random.Random(
+            (seed * 1_000_003) ^ zlib.crc32(rid.encode()))
+        self.slo = {"ttft": 2.0, "queue_wait": 0.5, "e2e": 30.0,
+                    **(slo_targets or {})}
+        # tick-index clock
+        self.tick = 0.0
+        self.anchor = 0.0
+        self.tick_ms = calib.tick_point(1, "p50")
+        # per-bucket (p50,p95,p99) memo: tick_point re-derives the
+        # bucket and string keys on every call, and _retick runs ~3x
+        # per session — at 1M sessions the lookup is the hot loop
+        self._tick_pts: Dict[int, tuple] = {}
+        # membership
+        self.active: Dict[int, _Live] = {}      # sid -> live
+        self.waiting: List[_Live] = []          # FIFO (deque-free:
+        #                                         index head)
+        self._wait_head = 0
+        self.parked: List[_Live] = []           # preempted batch, FIFO
+        self.used_pages = 0
+        self._warm_groups: set = set()
+        # (first_tick, tokens/tick) marks of in-flight prefills: the
+        # running token sum feeds the tick-duration surcharge and
+        # decays as the marks pass (lazily, at each retick)
+        self._prefill_ticks_heap: List[tuple] = []
+        self._prefill_token_load = 0.0
+        self._done_heap: List[tuple] = []   # (done_tick, sid, version)
+        # chaos
+        self.stall_factor = 1.0
+        self.dead = False
+        # wake scheduling (core-managed)
+        self.wake_version = 0
+        self.scheduled_wall: Optional[float] = None
+        # accounting (monotone; the control plane deltas them)
+        self.slo_totals = {k: 0.0 for k in
+                           ("ttft_s", "ttft_n", "ttft_bad", "queue_s",
+                            "queue_n", "queue_bad", "e2e_s", "e2e_n",
+                            "e2e_bad")}
+        self.completed = 0
+        self.decode_tokens = 0
+        self.batch_tokens = 0
+        self.batch_completed = 0
+        self.preemptions = 0
+        self.spills = 0
+        self.restores = 0
+        self.cache_hits = 0
+        self.cache_queries = 0
+
+    # -- clock ---------------------------------------------------------
+    def _advance(self, now: float) -> None:
+        # never REWIND the anchor: spill/restore charge their latency
+        # by pushing it past now (a one-off stall) — snapping back to
+        # now would erase the penalty in the same virtual instant and
+        # make preemption churn free
+        if now > self.anchor:
+            if self.active:
+                self.tick += (now - self.anchor) * 1e3 \
+                    / (self.tick_ms * self.stall_factor)
+            self.anchor = now
+
+    def _eps(self) -> float:
+        """Due tolerance, scaled with the tick index: at ~3e7 ticks
+        (a simulated day) double-precision ulp is ~7e-9 ticks, so a
+        fixed epsilon would leave events perpetually "almost due"
+        and the wake loop spinning at one virtual instant."""
+        return 1e-9 + 1e-11 * self.tick
+
+    def _prefill_tokens(self) -> float:
+        h = self._prefill_ticks_heap
+        eps = self._eps()
+        while h and h[0][0] <= self.tick + eps:
+            self._prefill_token_load -= heapq.heappop(h)[1]
+        if not h:
+            self._prefill_token_load = 0.0    # drift backstop
+        return self._prefill_token_load
+
+    def _retick(self) -> None:
+        """Membership changed: redraw the current tick duration from
+        the calibration — batch size plus the prefill tokens riding
+        the tick (capped at the engine's chunk budget, exactly as the
+        Sarathi packer would). Same mixture as
+        `SimCalibration.draw_tick_ms` (90% body / 8% p95 shoulder /
+        2% p99 tail), with the percentile points memoized per bucket."""
+        b = len(self.active) or 1
+        pre = self._prefill_tokens()
+        if pre > self.calib.prefill_chunk_tokens:
+            pre = self.calib.prefill_chunk_tokens
+        pts = self._tick_pts.get(b)
+        if pts is None:
+            pts = (self.calib.tick_point(b, "p50"),
+                   self.calib.tick_point(b, "p95"),
+                   self.calib.tick_point(b, "p99"))
+            self._tick_pts[b] = pts
+        u = self.rng.random()
+        ms = (pts[0 if u < 0.90 else (1 if u < 0.98 else 2)]
+              + pre * self.calib.prefill_ms_per_token)
+        self.tick_ms = ms if ms > 1e-3 else 1e-3
+
+    # -- pages ---------------------------------------------------------
+    def _pages_for(self, sess: SimSession) -> int:
+        page = max(self.calib.page_size, 1)
+        total = (sess.prompt_tokens + sess.out_tokens
+                 + page - 1) // page
+        self.cache_queries += 1
+        if sess.group in self._warm_groups:
+            self.cache_hits += 1
+            shared = max((sess.prompt_tokens - 1) // page, 0)
+            return max(total - shared, 1)
+        return max(total, 1)
+
+    @property
+    def free_pages(self) -> int:
+        return self.num_pages - self.used_pages
+
+    def occupancy(self) -> float:
+        return self.used_pages / self.num_pages \
+            if self.num_pages else 0.0
+
+    def batch_occupancy(self) -> float:
+        """Fraction of the pool held by batch-lane slots (the
+        autoscaler's displaceable-occupancy exclusion)."""
+        if not self.num_pages:
+            return 0.0
+        return sum(lv.pages for lv in self.active.values()
+                   if lv.sess.lane == BATCH) / self.num_pages
+
+    def interactive_occupancy(self) -> float:
+        return max(self.occupancy() - self.batch_occupancy(), 0.0)
+
+    def page_pressure(self) -> float:
+        parked = sum(lv.pages for lv in self.parked)
+        return (self.used_pages + parked) / self.num_pages \
+            if self.num_pages else 0.0
+
+    # -- queue/slots ---------------------------------------------------
+    def _waitq(self) -> List[_Live]:
+        if self._wait_head > 64 \
+                and self._wait_head * 2 > len(self.waiting):
+            self.waiting = self.waiting[self._wait_head:]
+            self._wait_head = 0
+        return self.waiting
+
+    def waiting_count(self) -> int:
+        return len(self.waiting) - self._wait_head
+
+    def waiting_batch_count(self) -> int:
+        return sum(1 for i in range(self._wait_head,
+                                    len(self.waiting))
+                   if self.waiting[i].sess.lane == BATCH)
+
+    def active_batch_count(self) -> int:
+        return sum(1 for lv in self.active.values()
+                   if lv.sess.lane == BATCH)
+
+    def enqueue(self, sess: SimSession, now: float) -> None:
+        lv = _Live(sess)
+        lv.enqueued_at = now
+        self.waiting.append(lv)
+        self._fill(now)
+
+    def _head(self) -> Optional[_Live]:
+        return (self.waiting[self._wait_head]
+                if self._wait_head < len(self.waiting) else None)
+
+    def _fill(self, now: float) -> None:
+        """The engine's admission loop in miniature: restore parked
+        batch work first UNLESS an interactive request waits (the
+        ISSUE 14 inversion guard), then head-of-line admission, with
+        priority preemption when the interactive head finds the
+        slots/pages held by batch work."""
+        self._advance(now)
+        changed = False
+        while True:
+            head = self._head()
+            interactive_waiting = (head is not None
+                                   and head.sess.lane != BATCH)
+            # parked-first restore (PR 10), yielding to interactive
+            if self.parked and not interactive_waiting \
+                    and len(self.active) < self.slots:
+                lv = self.parked[0]
+                if lv.pages > self.free_pages:
+                    break
+                self.parked.pop(0)
+                self._restore(lv, now)
+                changed = True
+                continue
+            if head is None:
+                break
+            if len(self.active) >= self.slots:
+                if not self._preempt_for(head):
+                    break
+                changed = True
+            pages = self._pages_for(head.sess)
+            while pages > self.free_pages \
+                    and self._preempt_for(head):
+                changed = True
+            if pages > self.free_pages:
+                break                     # head-of-line blocking
+            self._wait_head += 1
+            self._waitq()
+            self._admit(head, pages, now)
+            changed = True
+        if changed:
+            self._retick()
+
+    def _preempt_for(self, head: _Live) -> bool:
+        """Spill the designated victim (lowest priority, youngest —
+        batch lane only carries priority 0 vs interactive 1) when the
+        head strictly outranks it."""
+        victims = [lv for lv in self.active.values()
+                   if lv.sess.lane == BATCH]
+        if head.sess.lane == BATCH or not victims:
+            return False
+        victim = max(victims, key=lambda lv: lv.admit_wall)
+        sid = victim.sess.sid
+        del self.active[sid]
+        victim.version += 1
+        # decrement the CURRENT remaining (a restored session's
+        # first_tick was re-anchored at its restore): resetting from
+        # out_tokens on a second preemption would double-count every
+        # token decoded before the first one
+        done = min(max(int(self.tick - victim.first_tick), 0),
+                   victim.remaining)
+        victim.remaining = max(victim.remaining - done, 1)
+        self.decode_tokens += done
+        self.batch_tokens += done
+        self.used_pages -= victim.pages
+        self.parked.append(victim)
+        self.preemptions += 1
+        self.spills += 1
+        # the spill's gather latency lands as a one-off stall: the
+        # anchor moves forward, so the next ticks start that late
+        self.anchor += self.calib.spill_ms * 1e-3
+        return True
+
+    def _admit(self, lv: _Live, pages: int, now: float) -> None:
+        sess = lv.sess
+        lv.pages = pages
+        lv.admit_wall = now
+        self.used_pages += pages
+        self._warm_groups.add(sess.group)
+        pticks = self.calib.prefill_ticks(sess.prompt_tokens)
+        lv.first_tick = self.tick + pticks
+        lv.done_tick = lv.first_tick + lv.remaining
+        per_tick = sess.prompt_tokens / pticks
+        heapq.heappush(self._prefill_ticks_heap,
+                       (lv.first_tick, per_tick))
+        self._prefill_token_load += per_tick
+        self.active[sess.sid] = lv
+        heapq.heappush(self._done_heap,
+                       (lv.done_tick, sess.sid, lv.version))
+        # queue-wait + estimated TTFT recorded here (see module doc)
+        queue_wait = max(now - lv.enqueued_at, 0.0)
+        ttft = max(now - sess.at, 0.0) \
+            + pticks * self.tick_ms * self.stall_factor * 1e-3
+        lv.ttft_wall = sess.at + ttft
+        if sess.lane != BATCH:
+            t = self.slo_totals
+            t["queue_s"] += queue_wait
+            t["queue_n"] += 1
+            if queue_wait > self.slo["queue_wait"]:
+                t["queue_bad"] += 1
+            t["ttft_s"] += ttft
+            t["ttft_n"] += 1
+            if ttft > self.slo["ttft"]:
+                t["ttft_bad"] += 1
+
+    def _restore(self, lv: _Live, now: float) -> None:
+        """Re-admit a parked batch session token-exact: no prefill
+        (its KV restores), remaining tokens only."""
+        lv.version += 1
+        lv.admit_wall = now
+        self.used_pages += lv.pages
+        lv.first_tick = self.tick
+        lv.done_tick = self.tick + lv.remaining
+        self.active[lv.sess.sid] = lv
+        heapq.heappush(self._done_heap,
+                       (lv.done_tick, lv.sess.sid, lv.version))
+        self.restores += 1
+        self.anchor += self.calib.restore_ms * 1e-3
+
+    # -- completions ---------------------------------------------------
+    def wake(self, now: float, ttft_hist: Hist, itl_hist: Hist,
+             e2e_hist: Hist) -> List[SimSession]:
+        """Advance to `now`, retire every due completion, refill.
+        Returns the finished sessions (the core releases admission
+        and counts them)."""
+        self._advance(now)
+        finished: List[SimSession] = []
+        h = self._done_heap
+        changed = False
+        eps = self._eps()
+        while h and h[0][0] <= self.tick + eps:
+            done_tick, sid, version = heapq.heappop(h)
+            lv = self.active.get(sid)
+            if lv is None or lv.version != version:
+                continue                    # preempted/stale entry
+            del self.active[sid]
+            self.used_pages -= lv.pages
+            sess = lv.sess
+            self.completed += 1
+            self.decode_tokens += lv.remaining
+            e2e = max(now - sess.at, 0.0)
+            if sess.lane == BATCH:
+                self.batch_tokens += lv.remaining
+                self.batch_completed += 1
+            else:
+                t = self.slo_totals
+                t["e2e_s"] += e2e
+                t["e2e_n"] += 1
+                if e2e > self.slo["e2e"]:
+                    t["e2e_bad"] += 1
+                ttft = max(lv.ttft_wall - sess.at, 0.0)
+                ttft_hist.add(ttft)
+                e2e_hist.add(e2e)
+                if sess.out_tokens > 1:
+                    itl_hist.add(max(now - lv.ttft_wall, 0.0)
+                                 / (sess.out_tokens - 1))
+            finished.append(sess)
+            changed = True
+        if changed or self.waiting_count() or self.parked:
+            self._fill(now)
+        if changed:
+            self._retick()
+        elif self._prefill_ticks_heap \
+                and self._prefill_ticks_heap[0][0] \
+                <= self.tick + eps:
+            # the wake was a prefill-surcharge expiry: no membership
+            # change, but the tick duration must relax NOW — without
+            # this, a burst's prefill tax would linger on every
+            # decode tick until the next completion (the sim-vs-real
+            # band catches exactly this over-prediction), and a due
+            # mark left unpopped would re-fire this wake at the same
+            # virtual instant forever
+            self._retick()
+        return finished
+
+    def next_wall(self, now: float) -> Optional[float]:
+        """Wall estimate of the earliest event — a completion OR a
+        prefill-surcharge expiry (None = idle). An early wake
+        self-corrects: wake() simply reschedules."""
+        h = self._done_heap
+        target: Optional[float] = None
+        while h:
+            done_tick, sid, version = h[0]
+            lv = self.active.get(sid)
+            if lv is None or lv.version != version:
+                heapq.heappop(h)
+                continue
+            target = done_tick
+            break
+        pm = self._prefill_ticks_heap
+        if pm and (target is None or pm[0][0] < target):
+            target = pm[0][0]
+        if target is None:
+            return None
+        self._advance(now)
+        dt = max(target - self.tick, 0.0) \
+            * self.tick_ms * self.stall_factor * 1e-3
+        return now + dt
+
+    # -- chaos / lifecycle --------------------------------------------
+    def fail_all(self, now: float) -> List[SimSession]:
+        """The replica died: every resident session (active, waiting,
+        parked) is returned for the core to fail over elsewhere (the
+        PR 9 replay path — progress is lost, the relay re-dispatches
+        the full request)."""
+        self._advance(now)
+        out = [lv.sess for lv in self.active.values()]
+        out += [self.waiting[i].sess
+                for i in range(self._wait_head, len(self.waiting))]
+        out += [lv.sess for lv in self.parked]
+        self.active.clear()
+        self.waiting = []
+        self._wait_head = 0
+        self.parked = []
+        self._done_heap = []
+        self._prefill_ticks_heap = []
+        self._prefill_token_load = 0.0
+        self.used_pages = 0
+        return out
+
+    def idle(self) -> bool:
+        return (not self.active and not self.parked
+                and self.waiting_count() == 0)
+
+    # -- control-plane surface ----------------------------------------
+    def snapshot_stats(self) -> Dict[str, Any]:
+        """The fleet_stats subset ReplicaSnapshot.from_stats reads —
+        the SAME wire shape a real replica reports, so the production
+        router scores simulated replicas through its production
+        parser."""
+        return {
+            "replica": self.rid,
+            "active": len(self.active),
+            "waiting": self.waiting_count(),
+            "waiting_batch": self.waiting_batch_count(),
+            "active_batch": self.active_batch_count(),
+            "kv_occupancy": self.occupancy(),
+            "kv_occupancy_batch": self.batch_occupancy(),
+            "free_pages": self.free_pages,
+            "cache_hit_rate": (self.cache_hits
+                               / max(self.cache_queries, 1)),
+            "page_pressure": self.page_pressure(),
+            "parked_sessions": len(self.parked),
+            "kv_offload": True,
+        }
+
+
+__all__ = ["SyntheticReplica", "Hist"]

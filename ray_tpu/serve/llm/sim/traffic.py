@@ -1,0 +1,205 @@
+"""Seeded traffic-trace generators for the fleet simulator.
+
+Each generator yields `SimSession`s in non-decreasing arrival order —
+the simulator streams them (a million-session trace never
+materializes as a list unless the caller asks). Everything is driven
+by ONE `random.Random(seed)`: the same (config, seed) pair produces
+the identical trace byte-for-byte, which is half of the simulator's
+determinism gate (the other half is the virtual clock).
+
+Shapes (ROADMAP item 5):
+- **diurnal**: sinusoidal rate over a day — the capacity-planning
+  baseline, and the trace whose troughs the batch lane soaks;
+- **flash_crowd**: a steady floor plus K sudden bursts (launch/retry
+  storms) — exercises admission shed + autoscaler reaction;
+- **tenant_skew**: Zipf-weighted tenants — one tenant floods, the
+  stride scheduler's fairness is what keeps the rest alive;
+- **chaos overlays**: replica stall/death/recovery events layered on
+  any trace — exercises the breaker/failover plane in virtual time.
+
+Arrival times come from inverse-CDF sampling of the rate profile
+(cumulative rate over a fixed grid, then one bisect per session), so
+a trace with N sessions costs O(N log G) and hits N exactly.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import math
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+INTERACTIVE = "interactive"
+BATCH = "batch"
+
+_GRID = 1440         # rate-profile resolution (1 min at 24 h)
+
+
+class SimSession:
+    """One logical request: arrival instant, identity, and size."""
+
+    __slots__ = ("at", "tenant", "group", "prompt_tokens",
+                 "out_tokens", "lane", "sid")
+
+    def __init__(self, at: float, tenant: str, group: int,
+                 prompt_tokens: int, out_tokens: int,
+                 lane: str = INTERACTIVE, sid: int = 0):
+        self.at = at
+        self.tenant = tenant
+        self.group = group          # prefix-fingerprint group
+        self.prompt_tokens = prompt_tokens
+        self.out_tokens = out_tokens
+        self.lane = lane
+        self.sid = sid
+
+
+@dataclasses.dataclass
+class TraceConfig:
+    """One synthetic workload. `kind` picks the rate profile."""
+    kind: str = "diurnal"           # diurnal|flash_crowd|tenant_skew|steady
+    sessions: int = 10_000
+    duration_s: float = 86_400.0
+    seed: int = 0
+    # request shape (geometric-ish around the means)
+    prompt_tokens_mean: int = 64
+    out_tokens_mean: int = 24
+    prompt_tokens_max: int = 512
+    out_tokens_max: int = 128
+    # identity
+    tenants: int = 4
+    prefix_groups: int = 256
+    # diurnal: peak/trough rate ratio
+    diurnal_amplitude: float = 0.8
+    # flash_crowd: bursts as a fraction of all sessions, burst width
+    crowds: int = 3
+    crowd_fraction: float = 0.5
+    crowd_width_s: float = 300.0
+    # tenant_skew: Zipf exponent over tenant popularity
+    skew: float = 1.5
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """A replica-plane fault in simulated time (the sim applies it):
+    kind "stall" multiplies the victim's tick duration by `factor`
+    for `duration_s`; kind "die" makes it drop its streams and fail
+    probes until `duration_s` later (the breaker plane handles the
+    rest)."""
+    at: float
+    replica: int                     # index into the sim's fleet
+    kind: str = "stall"              # stall | die
+    duration_s: float = 60.0
+    factor: float = 10.0
+
+
+def _rate_profile(cfg: TraceConfig) -> List[float]:
+    """Relative arrival rate over _GRID equal bins of the trace."""
+    if cfg.kind == "diurnal":
+        a = min(max(cfg.diurnal_amplitude, 0.0), 1.0)
+        return [1.0 + a * math.sin(2 * math.pi * (i / _GRID) * 1.0
+                                   - math.pi / 2)
+                for i in range(_GRID)]
+    if cfg.kind == "flash_crowd":
+        base = [1.0] * _GRID
+        width = max(int(cfg.crowd_width_s / cfg.duration_s * _GRID),
+                    1)
+        # crowd centers are structural (evenly spread, deterministic
+        # in config alone) so the burst mass is independent of the
+        # per-session RNG stream
+        per = (cfg.crowd_fraction / max(1.0 - cfg.crowd_fraction,
+                                        1e-6)) * _GRID / max(
+            cfg.crowds * width, 1)
+        for k in range(cfg.crowds):
+            center = int((k + 0.5) / max(cfg.crowds, 1) * _GRID)
+            for i in range(center - width // 2,
+                           center + (width + 1) // 2):
+                if 0 <= i < _GRID:
+                    base[i] += per
+        return base
+    # steady / tenant_skew: flat arrivals (skew lives in identity)
+    return [1.0] * _GRID
+
+
+def _tenant_weights(cfg: TraceConfig) -> List[float]:
+    if cfg.kind == "tenant_skew":
+        w = [1.0 / (i + 1) ** cfg.skew for i in range(cfg.tenants)]
+    else:
+        w = [1.0] * cfg.tenants
+    total = sum(w)
+    return [x / total for x in w]
+
+
+def _cum(weights: List[float]) -> List[float]:
+    out: List[float] = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        out.append(acc)
+    return out
+
+
+def generate(cfg: TraceConfig) -> Iterator[SimSession]:
+    """The trace: cfg.sessions SimSessions in arrival order."""
+    rng = random.Random(cfg.seed)
+    profile = _rate_profile(cfg)
+    cum = _cum(profile)
+    total = cum[-1]
+    tcum = _cum(_tenant_weights(cfg))
+    bin_w = cfg.duration_s / _GRID
+    n = cfg.sessions
+    for i in range(n):
+        # stratified inverse-CDF: session i lands in the quantile
+        # band [i/n, (i+1)/n) of the rate profile — arrival order is
+        # construction-sorted, no sort of a million records needed
+        u = (i + rng.random()) / n * total
+        b = min(bisect.bisect_left(cum, u), _GRID - 1)
+        frac = (u - (cum[b - 1] if b else 0.0)) \
+            / max(profile[b], 1e-12)
+        at = (b + min(frac, 1.0)) * bin_w
+        tv = rng.random()
+        tenant = f"t{bisect.bisect_left(tcum, tv * tcum[-1])}"
+        # sizes: geometric-ish tails clipped to the max
+        prompt = min(1 + int(rng.expovariate(
+            1.0 / max(cfg.prompt_tokens_mean, 1))),
+            cfg.prompt_tokens_max)
+        out = min(1 + int(rng.expovariate(
+            1.0 / max(cfg.out_tokens_mean, 1))),
+            cfg.out_tokens_max)
+        group = rng.randrange(cfg.prefix_groups)
+        yield SimSession(at, tenant, group, prompt, out,
+                         INTERACTIVE, sid=i)
+
+
+def batch_backlog(count: int, out_tokens: int = 32,
+                  prompt_tokens: int = 32, at: float = 0.0,
+                  group_base: int = 1_000_000) -> List[SimSession]:
+    """A bulk-inference backlog submitted up front (the sim's batch
+    lane input): `count` priority-0 sessions all arriving at `at` —
+    the soak governor and preemption plane decide when they actually
+    run."""
+    return [SimSession(at, "batch", group_base + i, prompt_tokens,
+                       out_tokens, BATCH, sid=-(i + 1))
+            for i in range(count)]
+
+
+def chaos_overlay(cfg: TraceConfig, replicas: int, events: int = 2,
+                  kind: str = "stall",
+                  duration_s: float = 120.0,
+                  factor: float = 10.0,
+                  seed: Optional[int] = None) -> List[ChaosEvent]:
+    """Seeded fault schedule over the trace span (deterministic, and
+    independent of the arrival RNG stream so layering chaos does not
+    reshuffle the traffic)."""
+    rng = random.Random(cfg.seed + 0x5EED if seed is None else seed)
+    out = [ChaosEvent(
+        at=rng.uniform(0.1, 0.8) * cfg.duration_s,
+        replica=rng.randrange(max(replicas, 1)),
+        kind=kind, duration_s=duration_s, factor=factor)
+        for _ in range(events)]
+    out.sort(key=lambda e: e.at)
+    return out
+
+
+__all__ = ["SimSession", "TraceConfig", "ChaosEvent", "generate",
+           "batch_backlog", "chaos_overlay", "INTERACTIVE", "BATCH"]

@@ -35,7 +35,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Any, Deque, Dict, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, Optional, Tuple
 
 from ...util import metrics as metrics_api
 
@@ -95,8 +95,13 @@ class SLOBurnWatchdog:
     `paging` / `state` / `last`. Injectable `now` for tests."""
 
     def __init__(self, config: Optional[WatchdogConfig] = None,
-                 recorder: Any = None):
+                 recorder: Any = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.config = config or WatchdogConfig()
+        # injectable clock (ISSUE 14): burn windows are pure deltas
+        # over whatever monotone time source drives observe() — the
+        # fleet simulator passes its virtual clock here
+        self._clock = clock if clock is not None else time.monotonic
         unknown = set(self.config.slos) - set(_SLO_KEYS)
         if unknown:
             # fail at fleet build, not as a KeyError on every control-
@@ -232,13 +237,23 @@ class SLOBurnWatchdog:
 
     # -- the tick ------------------------------------------------------
     def observe(self, totals: Dict[str, float],
-                now: Optional[float] = None) -> Dict[str, Any]:
+                now: Optional[float] = None,
+                idle: bool = False) -> Dict[str, Any]:
         """One watchdog evaluation over the fleet-summed monotone
-        totals. Returns (and stores in .last) the per-SLO report."""
+        totals. Returns (and stores in .last) the per-SLO report.
+
+        `idle=True` asserts the caller sees NO interactive demand
+        anywhere (front door empty, zero interactive requests queued
+        or decoding on any replica): an empty short window then means
+        a healthy trough, and a held page clears. Without it, zero
+        observations under a page read as a total stall — requests
+        arriving but nothing completing — and the page holds (ISSUE
+        14: a post-burst page latched through an idle trough wedged
+        brownout shut and starved the batch lane forever)."""
         cfg = self.config
         if not cfg.enabled:
             return {}
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         report: Dict[str, Any] = {}
         for slo in cfg.slos:
             n_key, bad_key = _SLO_KEYS[slo]
@@ -255,12 +270,17 @@ class SLOBurnWatchdog:
                 state = "page"
             elif prev == "page" and (
                     short >= cfg.warn_burn_rate
-                    or short_n < cfg.min_observations):
+                    or (short_n < cfg.min_observations
+                        and not idle)):
                 # hysteresis: recovery needs EVIDENCE — a cooled short
                 # window with enough observations. A totally stalled
                 # fleet (zero new requests) is the outage at its
                 # worst, not recovery; hold the page until traffic
-                # flows again.
+                # flows again. EXCEPT when the caller vouches the
+                # fleet is demand-idle (`idle=True`): an empty window
+                # over an empty fleet is a trough, and holding the
+                # page there would latch brownout with nobody left to
+                # shed.
                 state = "page"
             elif min(short, long_) >= cfg.warn_burn_rate:
                 state = "warn"

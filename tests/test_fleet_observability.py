@@ -183,6 +183,32 @@ def test_watchdog_holds_page_through_total_stall():
     assert not wd.paging
 
 
+def test_watchdog_clears_page_when_fleet_is_demand_idle():
+    """The ISSUE 14 trough: a page latched at the end of a burst must
+    CLEAR once the caller vouches there is no interactive demand left
+    anywhere (`idle=True`) — an empty short window over an empty
+    fleet is a healthy trough, and a held page would wedge brownout
+    shut with nobody left to shed (it starved the batch-lane soak
+    governor forever). Without the idle vouch the stall hold stays."""
+    wd, rec = _wd()
+    wd.observe({"ttft_n": 0.0, "ttft_bad": 0.0}, now=0.0)
+    wd.observe({"ttft_n": 20.0, "ttft_bad": 10.0}, now=5.0)
+    assert wd.paging
+    # totals frozen but NOT vouched idle: stall semantics, page holds
+    wd.observe({"ttft_n": 20.0, "ttft_bad": 10.0}, now=20.0)
+    assert wd.paging
+    # same frozen totals, fleet vouched demand-idle: trough, clears
+    wd.observe({"ttft_n": 20.0, "ttft_bad": 10.0}, now=21.0,
+               idle=True)
+    assert not wd.paging and wd.state["ttft"] == "ok"
+    assert "slo_clear" in [e["event"] for e in rec.events()]
+    # a dirty short window still pages even when idle is claimed
+    # (evidence of bad traffic beats the vouch)
+    wd.observe({"ttft_n": 40.0, "ttft_bad": 30.0}, now=22.0,
+               idle=True)
+    assert wd.paging
+
+
 def test_watchdog_rejects_unknown_slo_at_construction():
     with pytest.raises(ValueError, match="unknown watchdog slo"):
         SLOBurnWatchdog(WatchdogConfig(slos=("ttft", "itl")))

@@ -902,9 +902,14 @@ def test_serve_llm_fleet_has_zero_baselined_findings():
     # the ISSUE 9 modules exist and are inside the analyzed package
     # (if they ever move, this gate must move with them) — plus the
     # ISSUE 12 KV transport (wire codec + fleet shipping policy:
-    # pure host-side numpy/stdlib, so any finding there is a bug)
+    # pure host-side numpy/stdlib, so any finding there is a bug),
+    # the ISSUE 14 batch lane, and the ISSUE 14 simulator package
+    # (pure stdlib discrete-event code: the one place a stray jax
+    # import would be an architecture error, not just debt)
     for fname in ("chaos.py", "failover.py", "watchdog.py",
-                  "tracemerge.py", "kv_transport.py"):
+                  "tracemerge.py", "kv_transport.py", "batch.py",
+                  "sim/core.py", "sim/replica.py", "sim/traffic.py",
+                  "sim/calibration.py", "sim/capacity.py"):
         assert (REPO / "ray_tpu/serve/llm" / fname).exists(), fname
     # and the package is clean with NO baseline at all
     proc = _cli("ray_tpu/serve/llm")

@@ -1,0 +1,57 @@
+"""CLI: python -m tools.simcal --out <calibration.json> [--name N]
+
+Options:
+    --out PATH     where to write the SimCalibration JSON (required)
+    --name NAME    calibration name recorded in the file
+    --ticks N      steady decode ticks measured per batch bucket
+    --curve PATH   additionally run a small capacity sweep against
+                   the fresh calibration and write the artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.simcal",
+                                 description=__doc__)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--name", default="cpu-debug")
+    ap.add_argument("--ticks", type=int, default=48)
+    ap.add_argument("--curve", default=None)
+    args = ap.parse_args(argv)
+
+    from tools.simcal import build_engine, drive_calibration_workload
+    from ray_tpu.serve.llm.sim.calibration import SimCalibration
+
+    eng = build_engine()
+    drive_calibration_workload(eng, decode_ticks=args.ticks)
+    calib = SimCalibration.from_engine(eng, name=args.name)
+    calib.save(args.out)
+    print(json.dumps({"wrote": args.out, "name": calib.name,
+                      "buckets": sorted(calib.decode_tick_ms),
+                      "prefill_ms_per_token":
+                          calib.prefill_ms_per_token,
+                      "spill_ms": calib.spill_ms,
+                      "restore_ms": calib.restore_ms}))
+    if args.curve:
+        from ray_tpu.serve.llm.sim import (SimFleetConfig,
+                                           TraceConfig,
+                                           capacity_curve,
+                                           write_artifact)
+        curve = capacity_curve(
+            TraceConfig(kind="diurnal", sessions=20_000,
+                        duration_s=3600.0, seed=7),
+            SimFleetConfig(calibration=calib),
+            replica_counts=[1, 2, 4, 8])
+        write_artifact(curve, args.curve)
+        print(json.dumps({"wrote": args.curve,
+                          "points": len(curve["points"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
