@@ -212,6 +212,40 @@ An anomalous tick additionally records a `tick_anomaly` flight event
 capture, and drops a rate-limited black-box bundle (cause
 `tick_anomaly`, fetchable at GET /fleet/debug/bundles).
 
+ISSUE 16 quantized serving (int8/fp8 KV pages with fused-dequant
+attention, quantize-on-spill/ship, quantized tp collectives; details:
+BENCH_CORE.md "Quantized serving anatomy"):
+
+    config knob (EngineConfig)              notes
+    kv_dtype="f32"|"int8"|"fp8"             KV page storage kind. Quantized
+                                            pages carry per-(token, head) f32
+                                            scales; append quantizes once,
+                                            attention dequantizes fused in the
+                                            kernel's HBM->VMEM stream. Spill/
+                                            restore and every ship path move
+                                            the narrow bytes + scales (wire v2)
+                                            and are token-exact vs a same-kind
+                                            engine; imports across kinds are
+                                            rejected (TransportError -> fleet
+                                            replay fallback). ~3.5x (f32) /
+                                            ~1.9x (bf16) smaller KV footprint
+                                            and read traffic.
+    quantized_collectives=True              arms the EQuARX-style block-scaled
+                                            quantized allreduce/allgather
+                                            helpers (ops/quantized_collectives)
+                                            for the tp mesh, tolerance-gated
+                                            vs f32 in tests/test_kv_quant.py
+
+    ray_tpu_llm_kv_device_bytes_used        gauge      device HBM bytes in used
+                                                       KV pages, from the
+                                                       CONFIGURED page dtype
+                                                       (values + scale pages)
+
+`stats()` gains `kv_dtype`, `kv_page_bytes` (per-page bytes for the
+configured kind) and `kv_device_bytes_used`; the perf cost model's
+kv_read/kv_write byte streams and spill/restore d2h/h2d accounting are
+parametrized by the same kind (f32 fingerprints byte-identical).
+
 Instrumentation is recorded purely from host-side engine events (zero
 device syncs, zero extra dispatches — the dispatch-guard suite runs
 with it enabled); disable per engine with

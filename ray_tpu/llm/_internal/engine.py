@@ -231,6 +231,24 @@ class EngineConfig:
     # Host-tier capacity in pages (None = unbounded). A full tier makes
     # preemption attempts fail, falling back to the exhaustion path.
     host_kv_pages: Optional[int] = None
+    # -- Quantized KV serving (ISSUE 16) -------------------------------
+    # KV page storage dtype: "f32" (default, pages in model compute
+    # dtype) | "int8" | "fp8" (e4m3). Quantized pools store narrow
+    # values plus per-(token row, kv head) f32 scales (ops/kv_quant.py)
+    # — the write paths quantize at append, the dense gather paths
+    # dequantize up front, and the Pallas kernels fuse the dequant
+    # multiply into their HBM→VMEM streaming loop, so decode reads
+    # ~1/4 the KV bytes. Spill/restore and session/prefix shipping
+    # move the narrow pages + scales as stored. Requires the unified
+    # ragged step; does not compose with pp>1 or speculative engines
+    # (their stage/draft pools stay f32).
+    kv_dtype: str = "f32"
+    # EQuARX-style quantized tp collectives (ops/quantized_collectives):
+    # expose int8 psum/all_gather for mesh programs that opt in. The
+    # llama serving path is GSPMD-partitioned (no explicit collectives
+    # to swap), so this knob only arms the ops-layer helpers; they are
+    # tolerance-gated vs the f32 collectives in tests.
+    quantized_collectives: bool = False
     # Optimistic admission (ISSUE 10): None keeps the worst-case
     # prompt+max_tokens reservation. An int W shrinks the reservation
     # to prompt + min(max_tokens, W) tokens; a decoding slot crossing
@@ -531,6 +549,34 @@ class InferenceEngine:
                 "ordinary contention into finish_reason=\"error\" "
                 "failures a worst-case-reserving engine would simply "
                 "queue through")
+        # -- Quantized KV pages (ISSUE 16) -----------------------------
+        from ...ops import kv_quant
+        self._kv_kind = kv_quant.validate_kind(ec.kv_dtype)
+        if self._kv_kind != "f32":
+            if self.pp > 1 or ec.speculative:
+                raise ValueError(
+                    "kv_dtype=int8/fp8 does not compose with pp>1 or "
+                    "speculative engines: their stage/draft pools "
+                    "have no scale plumbing")
+            if not ec.unified_step:
+                raise ValueError(
+                    "kv_dtype=int8/fp8 requires unified_step=True: "
+                    "the legacy whole-prompt prefill programs have no "
+                    "quantized write path (unified engines prefill "
+                    "through the ragged program, which does)")
+        # per-page device bytes at the CONFIGURED storage kind: the
+        # occupancy/pressure gauges report bytes from this, never an
+        # assumed f32 itemsize (quantized pages carry 1-byte values
+        # plus the per-(row, head) f32 scale sidecar)
+        mc = self.model_cfg
+        if self._kv_kind == "f32":
+            row_bytes = int(2 * mc.n_layers * mc.n_kv_heads
+                            * mc.head_dim
+                            * jnp.dtype(mc.dtype).itemsize)
+        else:
+            row_bytes = 2 * mc.n_layers * kv_quant.token_row_bytes(
+                self._kv_kind, mc.n_kv_heads, mc.head_dim)
+        self._kv_page_bytes = row_bytes * ec.page_size
         from .kv_offload import HostKVTier
         self.host_tier: Optional[HostKVTier] = (
             HostKVTier(ec.host_kv_pages) if ec.enable_kv_offload
@@ -593,11 +639,30 @@ class InferenceEngine:
         else:
             kv_shape = (cfg.n_layers, ec.num_pages, ec.page_size,
                         cfg.n_kv_heads, cfg.head_dim)
-            self.k_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
+            pool_dt = (cfg.dtype if self._kv_kind == "f32"
+                       else kv_quant.storage_dtype(self._kv_kind))
+            self.k_pages = self._dev(jnp.zeros(kv_shape, pool_dt),
                                      self._kv_sharding)
-            self.v_pages = self._dev(jnp.zeros(kv_shape, cfg.dtype),
+            self.v_pages = self._dev(jnp.zeros(kv_shape, pool_dt),
                                      self._kv_sharding)
             self._key = self._dev(jax.random.PRNGKey(ec.seed + 1))
+        # per-(token row, kv head) f32 scale pools beside the value
+        # pools (None for f32 engines): [L, P, page, KVH], sharded on
+        # kv heads under tp exactly like the pools they scale
+        self._scale_sharding = None
+        if self._kv_kind != "f32":
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._scale_sharding = NamedSharding(
+                    self.mesh,
+                    PartitionSpec(None, None, None, "tp"))
+            sc_shape = kv_quant.scale_shape(kv_shape)
+            self.k_scales = self._dev(jnp.zeros(sc_shape, jnp.float32),
+                                      self._scale_sharding)
+            self.v_scales = self._dev(jnp.zeros(sc_shape, jnp.float32),
+                                      self._scale_sharding)
+        else:
+            self.k_scales = self.v_scales = None
 
         # multi-LoRA: name -> adapter index (0 = the zero adapter);
         # stacks are {proj: {"a": (A, L, H, r), "b": (A, r, O)}} device
@@ -654,19 +719,34 @@ class InferenceEngine:
                 "accepted": 0, "rounds": 0, "emitted": 0,
                 "draft_fns": {}, "verify_fns": {}, "prefill_fns": {},
             }
-        self._decode_fn = jax.jit(
-            self._build_decode(), donate_argnums=(1, 2, 3),
-            static_argnums=(16,))
+        # quantized engines thread the scale pools right after the
+        # value pools (all donated: in-place HBM updates), shifting
+        # the trailing static all_greedy arg by 2
+        if self._kv_kind != "f32":
+            self._decode_fn = jax.jit(
+                self._build_decode(), donate_argnums=(1, 2, 3, 4, 5),
+                static_argnums=(18,))
+        else:
+            self._decode_fn = jax.jit(
+                self._build_decode(), donate_argnums=(1, 2, 3),
+                static_argnums=(16,))
         self._multi_decode_fn = None
         if int(ec.decode_steps_per_call or 1) > 1:
             if self.pp > 1:
                 raise ValueError(
                     "decode_steps_per_call does not compose with "
                     "pipeline-parallel serving")
-            self._multi_decode_fn = jax.jit(
-                self._build_multi_decode(
-                    int(ec.decode_steps_per_call)),
-                donate_argnums=(1, 2, 3), static_argnums=(17,))
+            if self._kv_kind != "f32":
+                self._multi_decode_fn = jax.jit(
+                    self._build_multi_decode(
+                        int(ec.decode_steps_per_call)),
+                    donate_argnums=(1, 2, 3, 4, 5),
+                    static_argnums=(19,))
+            else:
+                self._multi_decode_fn = jax.jit(
+                    self._build_multi_decode(
+                        int(ec.decode_steps_per_call)),
+                    donate_argnums=(1, 2, 3), static_argnums=(17,))
         self._d_tokens = None          # device-resident slot state
         self._d_seen = None
         self._d_seeds = None           # per-slot sampling seeds (B,)
@@ -738,7 +818,7 @@ class InferenceEngine:
             else:
                 n_chips = 1
             self.perf = PerfAccountant(
-                CostModel(cfg, ec.page_size),
+                CostModel(cfg, ec.page_size, kv_dtype=self._kv_kind),
                 detect_envelope(name=ec.perf_envelope),
                 n_chips=n_chips)
             if self._spec is not None:
@@ -879,20 +959,28 @@ class InferenceEngine:
         cfg = self.model_cfg
         impl = self._resolve_impl()
         mesh = self.mesh
+        kind = self._kv_kind
 
-        def step(params, k_pages, v_pages, seen, tokens, positions,
-                 page_tables, active, key, temps, top_ps, top_ks,
-                 rep_pens, seeds, lora, lora_idx, all_greedy):
-            logits, k_pages, v_pages = decode_step(
+        def core(params, k_pages, v_pages, k_scales, v_scales, seen,
+                 tokens, positions, page_tables, active, key, temps,
+                 top_ps, top_ks, rep_pens, seeds, lora, lora_idx,
+                 all_greedy):
+            out = decode_step(
                 cfg, params, tokens, positions, k_pages, v_pages,
                 page_tables, active, impl=impl, mesh=mesh,
-                lora=lora, lora_idx=lora_idx)
+                lora=lora, lora_idx=lora_idx, kv_kind=kind,
+                k_scales=k_scales, v_scales=v_scales)
+            if kind != "f32":
+                logits, k_pages, v_pages, k_scales, v_scales = out
+            else:
+                logits, k_pages, v_pages = out
             if all_greedy:
                 # static fast path: no penalties/seen bookkeeping — the
                 # common greedy batch-inference case stays argmax-only
                 new_tokens = _sample(logits, key, temps, top_ps,
                                      all_greedy=True)
-                return new_tokens, k_pages, v_pages, seen
+                return (new_tokens, k_pages, v_pages, k_scales,
+                        v_scales, seen)
             # the fed token sits at `positions`; the sampled one lands
             # at positions+1 — the absolute index the per-request key
             # is derived from (see _row_sample_keys)
@@ -902,7 +990,29 @@ class InferenceEngine:
                                  row_keys=row_keys)
             b = tokens.shape[0]
             seen = seen.at[jnp.arange(b), new_tokens].max(active)
-            return new_tokens, k_pages, v_pages, seen
+            return (new_tokens, k_pages, v_pages, k_scales, v_scales,
+                    seen)
+
+        if kind != "f32":
+            def step_q(params, k_pages, v_pages, k_scales, v_scales,
+                       seen, tokens, positions, page_tables, active,
+                       key, temps, top_ps, top_ks, rep_pens, seeds,
+                       lora, lora_idx, all_greedy):
+                return core(params, k_pages, v_pages, k_scales,
+                            v_scales, seen, tokens, positions,
+                            page_tables, active, key, temps, top_ps,
+                            top_ks, rep_pens, seeds, lora, lora_idx,
+                            all_greedy)
+            return step_q
+
+        def step(params, k_pages, v_pages, seen, tokens, positions,
+                 page_tables, active, key, temps, top_ps, top_ks,
+                 rep_pens, seeds, lora, lora_idx, all_greedy):
+            toks, k_pages, v_pages, _, _, seen = core(
+                params, k_pages, v_pages, None, None, seen, tokens,
+                positions, page_tables, active, key, temps, top_ps,
+                top_ks, rep_pens, seeds, lora, lora_idx, all_greedy)
+            return toks, k_pages, v_pages, seen
 
         return step
 
@@ -913,6 +1023,34 @@ class InferenceEngine:
         the preallocated KV pages. Emits [K, B] tokens; the host
         processes them in order (EOS/max_tokens truncate per slot)."""
         step = self._build_decode()
+        if self._kv_kind != "f32":
+            def multi_q(params, k_pages, v_pages, k_scales, v_scales,
+                        seen, tokens, positions, page_tables, active,
+                        key, temps, top_ps, top_ks, rep_pens, seeds,
+                        lora, lora_idx, budget, all_greedy):
+                def body(carry, i):
+                    (tokens, positions, k_pages, v_pages, k_scales,
+                     v_scales, seen) = carry
+                    act_i = jnp.logical_and(active, budget > i)
+                    toks, k_pages, v_pages, k_scales, v_scales, seen \
+                        = step(params, k_pages, v_pages, k_scales,
+                               v_scales, seen, tokens, positions,
+                               page_tables, act_i, key, temps, top_ps,
+                               top_ks, rep_pens, seeds, lora, lora_idx,
+                               all_greedy)
+                    positions = positions + act_i
+                    return (toks, positions, k_pages, v_pages,
+                            k_scales, v_scales, seen), toks
+
+                (tokens, positions, k_pages, v_pages, k_scales,
+                 v_scales, seen), out = jax.lax.scan(
+                    body, (tokens, positions, k_pages, v_pages,
+                           k_scales, v_scales, seen),
+                    jnp.arange(k_steps))
+                return (out, tokens, positions, k_pages, v_pages,
+                        k_scales, v_scales, seen)
+
+            return multi_q
 
         def multi(params, k_pages, v_pages, seen, tokens, positions,
                   page_tables, active, key, temps, top_ps, top_ks,
@@ -1070,9 +1208,11 @@ class InferenceEngine:
                           max(self.config.max_prefill_tokens, 1))
             from ...models.llama_infer import ragged_forward
 
-            def run(params, k_pages, v_pages, seen, tok_meta,
-                    slot_meta, samp, page_tables, key, lora,
-                    all_greedy):
+            kind = self._kv_kind
+
+            def core(params, k_pages, v_pages, k_scales, v_scales,
+                     seen, tok_meta, slot_meta, samp, page_tables,
+                     key, lora, all_greedy):
                 tokens, slot_ids, positions = (
                     tok_meta[0], tok_meta[1], tok_meta[2])
                 valid = tok_meta[3] != 0
@@ -1082,15 +1222,22 @@ class InferenceEngine:
                 seeds = slot_meta[3]
                 temps, top_ps, rep_pens = samp[0], samp[1], samp[3]
                 top_ks = samp[2].astype(jnp.int32)
-                logits, k_pages, v_pages = ragged_forward(
+                out = ragged_forward(
                     cfg, params, tokens, slot_ids, positions, valid,
                     start, last_idx, k_pages, v_pages, page_tables,
                     ctx_pages=ctx_pages, lora=lora, lora_idx=lora_idx,
-                    impl=impl, mesh=mesh, max_seg_len=max_seg)
+                    impl=impl, mesh=mesh, max_seg_len=max_seg,
+                    kv_kind=kind, k_scales=k_scales,
+                    v_scales=v_scales)
+                if kind != "f32":
+                    logits, k_pages, v_pages, k_scales, v_scales = out
+                else:
+                    logits, k_pages, v_pages = out
                 if all_greedy:
                     toks = _sample(logits, key, temps, top_ps,
                                    all_greedy=True)
-                    return toks, k_pages, v_pages, seen
+                    return (toks, k_pages, v_pages, k_scales,
+                            v_scales, seen)
                 # this tick's tokens count as seen BEFORE sampling
                 # (prompt tokens penalize too, HF semantics; for a
                 # decoding slot the one token is already seen — no-op)
@@ -1108,10 +1255,30 @@ class InferenceEngine:
                 # samples are discarded host-side, so they must not
                 # leak into the penalty state either)
                 seen = seen.at[jnp.arange(b), toks].max(emit)
-                return toks, k_pages, v_pages, seen
+                return toks, k_pages, v_pages, k_scales, v_scales, seen
 
-            fn = jax.jit(run, donate_argnums=(1, 2, 3),
-                         static_argnums=(10,))
+            if kind != "f32":
+                def run_q(params, k_pages, v_pages, k_scales, v_scales,
+                          seen, tok_meta, slot_meta, samp, page_tables,
+                          key, lora, all_greedy):
+                    return core(params, k_pages, v_pages, k_scales,
+                                v_scales, seen, tok_meta, slot_meta,
+                                samp, page_tables, key, lora,
+                                all_greedy)
+                fn = jax.jit(run_q, donate_argnums=(1, 2, 3, 4, 5),
+                             static_argnums=(12,))
+            else:
+                def run(params, k_pages, v_pages, seen, tok_meta,
+                        slot_meta, samp, page_tables, key, lora,
+                        all_greedy):
+                    toks, k_pages, v_pages, _, _, seen = core(
+                        params, k_pages, v_pages, None, None, seen,
+                        tok_meta, slot_meta, samp, page_tables, key,
+                        lora, all_greedy)
+                    return toks, k_pages, v_pages, seen
+
+                fn = jax.jit(run, donate_argnums=(1, 2, 3),
+                             static_argnums=(10,))
             self.compiles += 1
             self._ragged_fns[(t_bucket, ctx_pages, all_greedy)] = fn
         return fn
@@ -1394,12 +1561,22 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         fn = self._ragged_fn(T, ctx, all_greedy)
         self.dispatches += 1
-        toks, self.k_pages, self.v_pages, self._d_seen = fn(
-            self.params, self.k_pages, self.v_pages, self._d_seen,
-            self._dev(jnp.asarray(tok_meta)),
-            self._dev(jnp.asarray(slot_meta)),
-            samp, self._device_tables(), sub,
-            self._lora_stacks, all_greedy)
+        if self._kv_kind != "f32":
+            (toks, self.k_pages, self.v_pages, self.k_scales,
+             self.v_scales, self._d_seen) = fn(
+                self.params, self.k_pages, self.v_pages,
+                self.k_scales, self.v_scales, self._d_seen,
+                self._dev(jnp.asarray(tok_meta)),
+                self._dev(jnp.asarray(slot_meta)),
+                samp, self._device_tables(), sub,
+                self._lora_stacks, all_greedy)
+        else:
+            toks, self.k_pages, self.v_pages, self._d_seen = fn(
+                self.params, self.k_pages, self.v_pages, self._d_seen,
+                self._dev(jnp.asarray(tok_meta)),
+                self._dev(jnp.asarray(slot_meta)),
+                samp, self._device_tables(), sub,
+                self._lora_stacks, all_greedy)
         toks_host = self._read_tokens(toks)
         # fold ALL slots from the one readback before any device-state
         # refresh (same ordering contract as _multi_decode)
@@ -2154,9 +2331,18 @@ class InferenceEngine:
         can stream while the freed pool pages are reused."""
         fn = self._page_gather_fns.get(nb)
         if fn is None:
-            def run(k_pages, v_pages, ids):
-                return (jnp.take(k_pages, ids, axis=1),
-                        jnp.take(v_pages, ids, axis=1))
+            if self._kv_kind != "f32":
+                # quantized pools spill AS STORED: narrow value pages
+                # plus their f32 scale pages ride the same d2h stream
+                def run(k_pages, v_pages, k_scales, v_scales, ids):
+                    return (jnp.take(k_pages, ids, axis=1),
+                            jnp.take(v_pages, ids, axis=1),
+                            jnp.take(k_scales, ids, axis=1),
+                            jnp.take(v_scales, ids, axis=1))
+            else:
+                def run(k_pages, v_pages, ids):
+                    return (jnp.take(k_pages, ids, axis=1),
+                            jnp.take(v_pages, ids, axis=1))
 
             # donation audit (JL002): the pools are deliberately NOT
             # donated — the gather READS the live pools (which the
@@ -2173,10 +2359,7 @@ class InferenceEngine:
         updates them in place, no copy of the cache per restore."""
         fn = self._page_scatter_fns.get(nb)
         if fn is None:
-            def run(k_pages, v_pages, ids, kh, vh):
-                return (k_pages.at[:, ids].set(kh),
-                        v_pages.at[:, ids].set(vh))
-
+            quant = self._kv_kind != "f32"
             kw = {}
             if self._kv_sharding is not None:
                 # tp mesh: pin the restored pools to the engine's KV
@@ -2185,7 +2368,22 @@ class InferenceEngine:
                 # decode program against the new layout
                 kw["out_shardings"] = (self._kv_sharding,
                                        self._kv_sharding)
-            fn = jax.jit(run, donate_argnums=(0, 1), **kw)
+                if quant:
+                    kw["out_shardings"] += (self._scale_sharding,
+                                            self._scale_sharding)
+            if quant:
+                def run_q(k_pages, v_pages, k_scales, v_scales, ids,
+                          kh, vh, ksh, vsh):
+                    return (k_pages.at[:, ids].set(kh),
+                            v_pages.at[:, ids].set(vh),
+                            k_scales.at[:, ids].set(ksh),
+                            v_scales.at[:, ids].set(vsh))
+                fn = jax.jit(run_q, donate_argnums=(0, 1, 2, 3), **kw)
+            else:
+                def run(k_pages, v_pages, ids, kh, vh):
+                    return (k_pages.at[:, ids].set(kh),
+                            v_pages.at[:, ids].set(vh))
+                fn = jax.jit(run, donate_argnums=(0, 1), **kw)
             self.compiles += 1
             self._page_scatter_fns[nb] = fn
         return fn
@@ -2233,9 +2431,15 @@ class InferenceEngine:
         nb = self._page_bucket(n_pages)
         ids = victim.pages[:n_pages]
         ids = ids + [ids[-1]] * (nb - n_pages)
-        kh, vh = self._page_gather_fn(nb)(
-            self.k_pages, self.v_pages,
-            self._dev(jnp.asarray(np.asarray(ids, np.int32))))
+        d_ids = self._dev(jnp.asarray(np.asarray(ids, np.int32)))
+        ksh = vsh = None
+        if self._kv_kind != "f32":
+            kh, vh, ksh, vsh = self._page_gather_fn(nb)(
+                self.k_pages, self.v_pages, self.k_scales,
+                self.v_scales, d_ids)
+        else:
+            kh, vh = self._page_gather_fn(nb)(
+                self.k_pages, self.v_pages, d_ids)
         if self.perf is not None:
             # actual transfer is the BUCKETED page count (padding
             # duplicates move too) — real d2h traffic, not the ideal
@@ -2246,14 +2450,16 @@ class InferenceEngine:
         # overlap: the d2h copies stream while decode continues; the
         # gather output is its own buffer, so the pool pages freed
         # below can be rewritten without corrupting the spill
-        for arr in (kh, vh):
+        for arr in (kh, vh, ksh, vsh):
             start = getattr(arr, "copy_to_host_async", None)
             if start is not None:
                 start()
         parked = ParkedSequence(
             request=req, seed=victim.seed, position=victim.position,
             last_token=victim.last_token, n_pages=n_pages,
-            reason=reason, k_pending=kh, v_pending=vh)
+            reason=reason, k_pending=kh, v_pending=vh,
+            kv_kind=self._kv_kind, k_scales_pending=ksh,
+            v_scales_pending=vsh)
         tier.park(parked)
         self._pending_spills.append(parked)
         self.allocator.free(victim.pages)
@@ -2427,14 +2633,17 @@ class InferenceEngine:
                 cnt = hi - lo
                 nb = self._page_bucket(cnt)
                 ids = pages[lo:hi] + [pages[hi - 1]] * (nb - cnt)
-                kh = parked.k_host[:, lo:hi]
-                vh = parked.v_host[:, lo:hi]
-                if nb > cnt:
-                    pad = nb - cnt
-                    kh = np.concatenate(
-                        [kh, np.repeat(kh[:, -1:], pad, axis=1)], 1)
-                    vh = np.concatenate(
-                        [vh, np.repeat(vh[:, -1:], pad, axis=1)], 1)
+
+                def _bucketed(host):
+                    rows = host[:, lo:hi]
+                    if nb > cnt:
+                        rows = np.concatenate(
+                            [rows, np.repeat(rows[:, -1:],
+                                             nb - cnt, axis=1)], 1)
+                    return self._dev(jnp.asarray(rows))
+
+                kh = _bucketed(parked.k_host)
+                vh = _bucketed(parked.v_host)
                 if self.perf is not None:
                     self.perf.note_offload(
                         h2d=nb * self.perf.model.page_bytes)
@@ -2444,11 +2653,19 @@ class InferenceEngine:
                 # the sanctioned restore upload: a structural-event
                 # h2d (like admission prefill uploads), never on the
                 # steady decode path
-                self.k_pages, self.v_pages = self._page_scatter_fn(nb)(
-                    self.k_pages, self.v_pages,
-                    self._dev(jnp.asarray(np.asarray(ids, np.int32))),
-                    self._dev(jnp.asarray(kh)),
-                    self._dev(jnp.asarray(vh)))
+                d_ids = self._dev(
+                    jnp.asarray(np.asarray(ids, np.int32)))
+                if self._kv_kind != "f32":
+                    (self.k_pages, self.v_pages, self.k_scales,
+                     self.v_scales) = self._page_scatter_fn(nb)(
+                        self.k_pages, self.v_pages, self.k_scales,
+                        self.v_scales, d_ids, kh, vh,
+                        _bucketed(parked.k_scales_host),
+                        _bucketed(parked.v_scales_host))
+                else:
+                    self.k_pages, self.v_pages = \
+                        self._page_scatter_fn(nb)(
+                            self.k_pages, self.v_pages, d_ids, kh, vh)
             slot.request = req
             slot.pages = pages
             slot.prefill_pos = len(req.prompt_tokens)
@@ -2729,6 +2946,13 @@ class InferenceEngine:
             "n_pages": 0 if parked is None else parked.n_pages,
             "k": None if parked is None else parked.k_host,
             "v": None if parked is None else parked.v_host,
+            # quantized serving (ISSUE 16): the pages ship AS STORED —
+            # the importer must run the same kv_dtype or reject
+            "kv_dtype": self._kv_kind,
+            "k_scales": (None if parked is None
+                         else parked.k_scales_host),
+            "v_scales": (None if parked is None
+                         else parked.v_scales_host),
         }
 
     def import_session(self, state: Dict[str, Any]) -> Request:
@@ -2802,6 +3026,16 @@ class InferenceEngine:
                     f"prompt+max_tokens exceeds max_seq_len "
                     f"{self.max_seq}")
             k, v = state["k"], state["v"]
+            src_kind = str(state.get("kv_dtype") or "f32")
+            if src_kind != self._kv_kind:
+                # never reinterpret pages across storage kinds: an
+                # int8 page scattered into an f32 pool (or vice versa)
+                # would be silent garbage — callers fall back to
+                # token replay, which is kind-agnostic
+                raise ValueError(
+                    f"incompatible KV dtype kind: session pages are "
+                    f"{src_kind!r}, this engine serves "
+                    f"{self._kv_kind!r}")
             want = (self.k_pages.shape[0], n_pages,
                     *self.k_pages.shape[2:])
             for name, arr in (("k", k), ("v", v)):
@@ -2815,13 +3049,24 @@ class InferenceEngine:
                     raise ValueError(
                         f"incompatible KV dtype: {name} is "
                         f"{arr.dtype}, pool is {self.k_pages.dtype}")
+            ksc = vsc = None
+            if self._kv_kind != "f32":
+                ksc, vsc = state.get("k_scales"), state.get("v_scales")
+                want_s = want[:-1]
+                for name, arr in (("k_scales", ksc),
+                                  ("v_scales", vsc)):
+                    if arr is None or tuple(arr.shape) != want_s:
+                        raise ValueError(
+                            f"quantized session missing/misshaped "
+                            f"{name}: expected {want_s}")
             from .kv_offload import ParkedSequence
             parked = ParkedSequence(
                 request=req, seed=int(state["seed"]),
                 position=position,
                 last_token=int(state["last_token"]),
                 n_pages=n_pages, reason="import",
-                k_host=k, v_host=v)
+                k_host=k, v_host=v, kv_kind=src_kind,
+                k_scales_host=ksc, v_scales_host=vsc)
             tier.park(parked, count_spill=False)  # MemoryError if full
             self.telemetry.recorder.record(
                 "session_imported", request_id=rid, pages=n_pages,
@@ -2845,32 +3090,50 @@ class InferenceEngine:
             n = len(pages)
             nb = self._page_bucket(n)
             ids = pages + [pages[-1]] * (nb - n)
-            kh, vh = self._page_gather_fn(nb)(
-                self.k_pages, self.v_pages,
-                self._dev(jnp.asarray(np.asarray(ids, np.int32))))
+            d_ids = self._dev(jnp.asarray(np.asarray(ids, np.int32)))
+            out = {}
+            if self._kv_kind != "f32":
+                kh, vh, ksh, vsh = self._page_gather_fn(nb)(
+                    self.k_pages, self.v_pages, self.k_scales,
+                    self.v_scales, d_ids)
+                out["k_scales"] = self._read_tokens(ksh)[:, :n]
+                out["v_scales"] = self._read_tokens(vsh)[:, :n]
+            else:
+                kh, vh = self._page_gather_fn(nb)(
+                    self.k_pages, self.v_pages, d_ids)
             if self.perf is not None:
                 self.perf.note_offload(
                     d2h=nb * self.perf.model.page_bytes)
-            k = self._read_tokens(kh)[:, :n]
-            v = self._read_tokens(vh)[:, :n]
-            toks = [int(t) for t in
-                    prompt_tokens[:n * self.allocator.page_size]]
+            out["k"] = self._read_tokens(kh)[:, :n]
+            out["v"] = self._read_tokens(vh)[:, :n]
+            out["tokens"] = [int(t) for t in
+                             prompt_tokens[:n * self.allocator.page_size]]
+            out["kv_dtype"] = self._kv_kind
             self.telemetry.recorder.record(
-                "prefix_exported", pages=n, tokens=len(toks))
-            return {"tokens": toks, "k": k, "v": v}
+                "prefix_exported", pages=n, tokens=len(out["tokens"]))
+            return out
 
-    def import_prefix(self, tokens: List[int], k_host, v_host) -> int:  # jaxlint: disable=JL006 -- prefix seeding upload: one scatter per fleet prefix-store import (structural event), never on the tick path
+    def import_prefix(self, tokens: List[int], k_host, v_host,
+                      k_scales=None, v_scales=None,
+                      kv_dtype: str = "f32") -> int:  # jaxlint: disable=JL006 -- prefix seeding upload: one scatter per fleet prefix-store import (structural event), never on the tick path
         """Seed this engine's prefix cache with pages prefilled on
         ANOTHER replica (the fleet prefix store's import path): the
         missing tail of the chain uploads into freshly allocated
         pages and registers under the same hash-cons keys local
         prefill would have used, so the next admission's match_prefix
         hits as if this replica had prefilled the prompt itself.
+        Quantized engines require matching kv_dtype pages plus their
+        scale arrays (ships as stored — never reinterpreted).
         Returns the number of pages newly seeded (0 = already cached
         / no room / nothing importable)."""
         with self._step_lock:
             if not self.allocator.enable_prefix_caching:
                 return 0
+            if str(kv_dtype or "f32") != self._kv_kind:
+                raise ValueError(
+                    f"incompatible prefix KV dtype kind: pages are "
+                    f"{kv_dtype!r}, this engine serves "
+                    f"{self._kv_kind!r}")
             page = self.allocator.page_size
             n = min(len(tokens) // page, int(k_host.shape[1]))
             if n == 0:
@@ -2884,6 +3147,13 @@ class InferenceEngine:
                         f"incompatible prefix KV geometry: {name} is "
                         f"{tuple(arr.shape)}/{arr.dtype}, pool wants "
                         f"{want}/{self.k_pages.dtype}")
+            if self._kv_kind != "f32":
+                for name, arr in (("k_scales", k_scales),
+                                  ("v_scales", v_scales)):
+                    if arr is None or tuple(arr.shape) != want[:-1]:
+                        raise ValueError(
+                            f"quantized prefix missing/misshaped "
+                            f"{name}: expected {want[:-1]}")
             toks = [int(t) for t in tokens[:n * page]]
             have = self.allocator.cached_prefix_pages(toks)
             if len(have) >= n:
@@ -2895,22 +3165,30 @@ class InferenceEngine:
             fresh = self.allocator.allocate_pages(need)
             nb = self._page_bucket(need)
             ids = fresh + [fresh[-1]] * (nb - need)
-            kh = np.ascontiguousarray(k_host[:, len(have):n])
-            vh = np.ascontiguousarray(v_host[:, len(have):n])
-            if nb > need:
-                pad = nb - need
-                kh = np.concatenate(
-                    [kh, np.repeat(kh[:, -1:], pad, axis=1)], 1)
-                vh = np.concatenate(
-                    [vh, np.repeat(vh[:, -1:], pad, axis=1)], 1)
+
+            def _bucketed(host):
+                rows = np.ascontiguousarray(host[:, len(have):n])
+                if nb > need:
+                    rows = np.concatenate(
+                        [rows, np.repeat(rows[:, -1:],
+                                         nb - need, axis=1)], 1)
+                return self._dev(jnp.asarray(rows))
+
             if self.perf is not None:
                 self.perf.note_offload(
                     h2d=nb * self.perf.model.page_bytes)
-            self.k_pages, self.v_pages = self._page_scatter_fn(nb)(
-                self.k_pages, self.v_pages,
-                self._dev(jnp.asarray(np.asarray(ids, np.int32))),
-                self._dev(jnp.asarray(kh)),
-                self._dev(jnp.asarray(vh)))
+            d_ids = self._dev(jnp.asarray(np.asarray(ids, np.int32)))
+            if self._kv_kind != "f32":
+                (self.k_pages, self.v_pages, self.k_scales,
+                 self.v_scales) = self._page_scatter_fn(nb)(
+                    self.k_pages, self.v_pages, self.k_scales,
+                    self.v_scales, d_ids,
+                    _bucketed(k_host), _bucketed(v_host),
+                    _bucketed(k_scales), _bucketed(v_scales))
+            else:
+                self.k_pages, self.v_pages = self._page_scatter_fn(nb)(
+                    self.k_pages, self.v_pages, d_ids,
+                    _bucketed(k_host), _bucketed(v_host))
             self.allocator.register_prefix(toks, have + fresh)
             # registration took the cache's reference on the fresh
             # pages; release the allocation's so they are cache-owned
@@ -3796,14 +4074,26 @@ class InferenceEngine:
         self._account_decode_batch("decode")
         self._key, sub = jax.random.split(self._key)
         self.dispatches += 1
-        new_tokens, self.k_pages, self.v_pages, self._d_seen = \
-            self._decode_fn(
-                self.params, self.k_pages, self.v_pages, self._d_seen,
+        if self._kv_kind != "f32":
+            (new_tokens, self.k_pages, self.v_pages, self.k_scales,
+             self.v_scales, self._d_seen) = self._decode_fn(
+                self.params, self.k_pages, self.v_pages,
+                self.k_scales, self.v_scales, self._d_seen,
                 self._d_tokens, self._d_positions, self._d_tables,
                 self._d_active, sub, self._d_temps, self._d_top_ps,
                 self._d_top_ks, self._d_rep_pens, self._d_seeds,
                 self._lora_stacks, self._d_lora_idx,
                 self._all_greedy)
+        else:
+            new_tokens, self.k_pages, self.v_pages, self._d_seen = \
+                self._decode_fn(
+                    self.params, self.k_pages, self.v_pages,
+                    self._d_seen, self._d_tokens, self._d_positions,
+                    self._d_tables, self._d_active, sub,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_rep_pens, self._d_seeds,
+                    self._lora_stacks, self._d_lora_idx,
+                    self._all_greedy)
         # device-side feedback for the next step
         self._d_tokens = new_tokens
         self._d_positions = self._d_positions + self._d_active
@@ -3877,14 +4167,27 @@ class InferenceEngine:
                               weight_reads=K)
         self._key, sub = jax.random.split(self._key)
         self.dispatches += 1
-        (toks, last, positions, self.k_pages, self.v_pages,
-         self._d_seen) = self._multi_decode_fn(
-            self.params, self.k_pages, self.v_pages, self._d_seen,
-            self._d_tokens, self._d_positions, self._d_tables,
-            self._d_active, sub, self._d_temps, self._d_top_ps,
-            self._d_top_ks, self._d_rep_pens, self._d_seeds,
-            self._lora_stacks, self._d_lora_idx,
-            self._dev(jnp.asarray(budget)), self._all_greedy)
+        if self._kv_kind != "f32":
+            (toks, last, positions, self.k_pages, self.v_pages,
+             self.k_scales, self.v_scales, self._d_seen) = \
+                self._multi_decode_fn(
+                    self.params, self.k_pages, self.v_pages,
+                    self.k_scales, self.v_scales, self._d_seen,
+                    self._d_tokens, self._d_positions, self._d_tables,
+                    self._d_active, sub, self._d_temps,
+                    self._d_top_ps, self._d_top_ks, self._d_rep_pens,
+                    self._d_seeds, self._lora_stacks,
+                    self._d_lora_idx, self._dev(jnp.asarray(budget)),
+                    self._all_greedy)
+        else:
+            (toks, last, positions, self.k_pages, self.v_pages,
+             self._d_seen) = self._multi_decode_fn(
+                self.params, self.k_pages, self.v_pages, self._d_seen,
+                self._d_tokens, self._d_positions, self._d_tables,
+                self._d_active, sub, self._d_temps, self._d_top_ps,
+                self._d_top_ks, self._d_rep_pens, self._d_seeds,
+                self._lora_stacks, self._d_lora_idx,
+                self._dev(jnp.asarray(budget)), self._all_greedy)
         self._d_tokens = last
         self._d_positions = positions
         toks_host = self._read_tokens(toks)   # [K, B] — ONE readback
@@ -4328,6 +4631,13 @@ class InferenceEngine:
             # pages) rides allocator.stats() below when the tier is on
             "parked_sessions": len(self.parked),
             "page_pressure": round(self.page_pressure(), 4),
+            # device-pool byte occupancy at the CONFIGURED page dtype
+            # (ISSUE 16 small fix: int8/fp8 pools must not report f32
+            # bytes — per-page bytes include the quant scale sidecar)
+            "kv_dtype": self._kv_kind,
+            "kv_page_bytes": self._kv_page_bytes,
+            "kv_device_bytes_used": (self.allocator.used_pages
+                                     * self._kv_page_bytes),
             "preemptions": dict(self.preempt_counts),
             # batch lane (ISSUE 14): preemptible bulk-work occupancy
             "lanes": self.lane_counts(),

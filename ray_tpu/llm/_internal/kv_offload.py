@@ -48,7 +48,15 @@ class ParkedSequence:                     # hold numpy arrays
     content arrives in two phases: `k_pending`/`v_pending` hold the
     gathered device arrays while their copy_to_host_async streams
     (spills overlap decode); `materialize()` converts to numpy and
-    drops the device handles (the host tier proper)."""
+    drops the device handles (the host tier proper).
+
+    Quantized engines (ISSUE 16, EngineConfig.kv_dtype != "f32") spill
+    the pages AS STORED — int8/fp8 values plus the per-(row, head) f32
+    scale pages (`k_scales_*`/`v_scales_*`, shape (L, n_pages, page,
+    H)) — so the host tier and every ship path move the narrow bytes,
+    not a dequantized copy. `kv_kind` records the storage kind the
+    pages were written with; a restore/import into an engine of a
+    different kind must be rejected, never reinterpreted."""
     request: Any                        # engine Request (not finished)
     seed: int                           # resolved per-request seed
     position: int                       # tokens whose KV was spilled
@@ -60,6 +68,11 @@ class ParkedSequence:                     # hold numpy arrays
     v_host: Optional[Any] = None
     k_pending: Optional[Any] = None     # device arrays, d2h in flight
     v_pending: Optional[Any] = None
+    kv_kind: str = "f32"                # page storage kind (ISSUE 16)
+    k_scales_host: Optional[Any] = None    # (L, n_pages, page, H) f32
+    v_scales_host: Optional[Any] = None
+    k_scales_pending: Optional[Any] = None
+    v_scales_pending: Optional[Any] = None
 
     @property
     def materialized(self) -> bool:
@@ -75,6 +88,12 @@ class ParkedSequence:                     # hold numpy arrays
         self.k_host = read_fn(self.k_pending)[:, :self.n_pages]
         self.v_host = read_fn(self.v_pending)[:, :self.n_pages]
         self.k_pending = self.v_pending = None
+        if self.k_scales_pending is not None:
+            self.k_scales_host = read_fn(
+                self.k_scales_pending)[:, :self.n_pages]
+            self.v_scales_host = read_fn(
+                self.v_scales_pending)[:, :self.n_pages]
+            self.k_scales_pending = self.v_scales_pending = None
 
     def idle_s(self, now: Optional[float] = None) -> float:
         now = time.monotonic() if now is None else now
@@ -86,11 +105,15 @@ class ParkedSequence:                     # hold numpy arrays
         count — the pending gather buffers are bucket-padded and the
         materialized arrays sliced, so per-page bytes times n_pages
         is the one number stable across both phases."""
-        for arr in (self.k_host, self.k_pending):
-            if arr is not None and getattr(arr, "shape", None):
-                per = int(arr.nbytes) // max(int(arr.shape[1]), 1)
-                return 2 * per * self.n_pages
-        return 0
+        total = 0
+        for pair in ((self.k_host, self.k_pending),
+                     (self.k_scales_host, self.k_scales_pending)):
+            for arr in pair:
+                if arr is not None and getattr(arr, "shape", None):
+                    per = int(arr.nbytes) // max(int(arr.shape[1]), 1)
+                    total += 2 * per * self.n_pages
+                    break
+        return total
 
 
 class HostKVTier:

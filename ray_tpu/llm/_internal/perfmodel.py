@@ -135,9 +135,12 @@ class CostModel:
     All per-token / per-pair constants precompute at construction so
     the per-tick accounting is a handful of int multiplies."""
 
-    def __init__(self, cfg: LlamaConfig, page_size: int):
+    def __init__(self, cfg: LlamaConfig, page_size: int,
+                 kv_dtype: str = "f32"):
+        from ...ops import kv_quant
         self.cfg = cfg
         self.page_size = int(page_size)
+        self.kv_dtype = kv_quant.validate_kind(kv_dtype)
         h, L = cfg.hidden, cfg.n_layers
         # -- GEMM FLOPs per token through the layer stack (no head) --
         qkvo = 2 * h * (cfg.q_dim + 2 * cfg.kv_dim) + 2 * cfg.q_dim * h
@@ -166,10 +169,18 @@ class CostModel:
             inactive = (3 * h * cfg.ffn * L
                         * max(cfg.n_experts - cfg.moe_top_k, 0))
             self.weight_bytes -= inactive * _dtype_bytes(cfg.param_dtype)
-        # one token's K+V rows across the stack (pool dtype)
-        self.kv_bytes_per_token = float(
-            2 * L * cfg.n_kv_heads * cfg.head_dim
-            * _dtype_bytes(cfg.dtype))
+        # one token's K+V rows across the stack. f32 pools store the
+        # activation dtype; quantized pools (ISSUE 16) store 1-byte
+        # values plus a per-(row, head) f32 scale — the scale overhead
+        # is real HBM traffic the kernel streams, so it is counted
+        if self.kv_dtype == "f32":
+            self.kv_bytes_per_token = float(
+                2 * L * cfg.n_kv_heads * cfg.head_dim
+                * _dtype_bytes(cfg.dtype))
+        else:
+            self.kv_bytes_per_token = float(
+                2 * L * kv_quant.token_row_bytes(
+                    self.kv_dtype, cfg.n_kv_heads, cfg.head_dim))
         self.page_bytes = self.kv_bytes_per_token * self.page_size
 
     # -- primitives ----------------------------------------------------

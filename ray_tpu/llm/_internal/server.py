@@ -13,6 +13,7 @@ requests share the continuously-batched decode loop.
 from __future__ import annotations
 
 import asyncio
+import functools
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -526,6 +527,9 @@ class LLMServerImpl:
         kvt = self._kvt()
         state = kvt.decode_session(
             kvt.from_b64(str((body or {}).get("session") or "")))
+        kvt.ship_kind_compatible(state.get("kv_dtype"),
+                                 getattr(self.engine, "_kv_kind",
+                                         "f32"))
         req = await asyncio.get_running_loop().run_in_executor(
             None, self.engine.import_session, state)
         self._ensure_pump()
@@ -599,6 +603,9 @@ class LLMServerImpl:
         kvt = self._kvt()
         state = kvt.decode_session(
             kvt.from_b64(str(body.get("_session") or "")))
+        kvt.ship_kind_compatible(state.get("kv_dtype"),
+                                 getattr(self.engine, "_kv_kind",
+                                         "f32"))
         offset = int(body.get("_resume_offset") or 0)
         self._ensure_pump()
         rid = str(state.get("request_id") or "")
@@ -656,7 +663,11 @@ class LLMServerImpl:
             None, self.engine.export_prefix, toks)
         if exp is None:
             return {"prefix": None}
-        blob = kvt.encode_prefix(exp["tokens"], exp["k"], exp["v"])
+        blob = kvt.encode_prefix(
+            exp["tokens"], exp["k"], exp["v"],
+            k_scales=exp.get("k_scales"),
+            v_scales=exp.get("v_scales"),
+            kv_dtype=str(exp.get("kv_dtype") or "f32"))
         return {"prefix": kvt.to_b64(blob), "bytes": len(blob),
                 "tokens": len(exp["tokens"])}
 
@@ -666,10 +677,16 @@ class LLMServerImpl:
         entry (the import half). Returns the pages newly seeded
         (0 = already cached or no room)."""
         kvt = self._kvt()
-        toks, k, v = kvt.decode_prefix(
+        pfx = kvt.decode_prefix(
             kvt.from_b64(str((body or {}).get("prefix") or "")))
+        kvt.ship_kind_compatible(pfx["kv_dtype"],
+                                 getattr(self.engine, "_kv_kind",
+                                         "f32"))
         pages = await asyncio.get_running_loop().run_in_executor(
-            None, self.engine.import_prefix, toks, k, v)
+            None, functools.partial(
+                self.engine.import_prefix, pfx["tokens"], pfx["k"],
+                pfx["v"], k_scales=pfx["k_scales"],
+                v_scales=pfx["v_scales"], kv_dtype=pfx["kv_dtype"]))
         return {"pages": int(pages)}
 
     async def model_info(self) -> Dict[str, Any]:

@@ -134,6 +134,13 @@ def _build_metrics() -> Dict[str, Any]:
         "kv_host_bytes": G("ray_tpu_llm_kv_host_bytes_used",
                            "host-RAM bytes pinned by parked KV "
                            "payloads", keys),
+        # ISSUE 16 satellite: device-pool byte occupancy at the
+        # CONFIGURED page dtype (int8/fp8 pages + scale sidecar, not
+        # an assumed-f32 itemsize)
+        "kv_device_bytes": G("ray_tpu_llm_kv_device_bytes_used",
+                             "device-HBM bytes held by allocated KV "
+                             "pages at the configured kv_dtype",
+                             keys),
         "parked": G("ray_tpu_llm_parked_sessions",
                     "preempted sequences parked in the host tier",
                     keys),
@@ -599,6 +606,8 @@ class EngineTelemetry:
             tier.used_pages if tier is not None else 0, self._tags)
         self._m["kv_host_bytes"].set(
             tier.used_bytes if tier is not None else 0, self._tags)
+        self._m["kv_device_bytes"].set(
+            used * getattr(engine, "_kv_page_bytes", 0), self._tags)
         self._m["parked"].set(
             len(tier) if tier is not None else 0, self._tags)
         pressure = getattr(engine, "page_pressure", None)

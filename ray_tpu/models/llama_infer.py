@@ -23,8 +23,10 @@ from ..ops.attention import attention as attention_op
 # shard_map version shim: ONE shared implementation (ops/jax_compat)
 # so the compat logic cannot drift between consumers
 from ..ops.jax_compat import shard_map_compat as _shard_map
-from ..ops.paged_attention import (gather_kv, paged_attention_on_gathered,
-                                   paged_decode_with_new_token, scatter_kv)
+from ..ops.paged_attention import (gather_kv, gather_kv_quant,
+                                   paged_attention_on_gathered,
+                                   paged_decode_with_new_token, scatter_kv,
+                                   scatter_kv_quant)
 from .llama import LlamaConfig, rms_norm, rope_frequencies
 
 
@@ -280,8 +282,10 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
                    lora: Optional[dict] = None,
                    lora_idx: Optional[jax.Array] = None,
                    impl: str = "gather", mesh=None,
-                   max_seg_len: int = -1
-                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                   max_seg_len: int = -1, kv_kind: str = "f32",
+                   k_scales: Optional[jax.Array] = None,
+                   v_scales: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, ...]:
     """Unified ragged prefill+decode forward: ONE program per engine
     tick consumes a FLAT token batch where each active slot contributes
     between 1 token (decoding) and C tokens (prefilling), packed by the
@@ -313,6 +317,14 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
     passes its chunk cap so the kernel's per-slot staging doesn't pad
     decode-heavy batches to T); -1 = no bound.
 
+    kv_kind/k_scales/v_scales: quantized pools (ISSUE 16). With
+    kv_kind in ("int8", "fp8") the pools hold narrow values and
+    k_scales/v_scales carry the [L, P, page, KVH] f32 row scales; the
+    gather impl dequantizes up front (gather_kv_quant), the kernel impl
+    streams scale blocks beside the pages and fuses the dequant
+    multiply, and the return grows to (logits, k_pages, v_pages,
+    k_scales, v_scales) with the tick's fresh KV quantized at append.
+
     Returns (last-token logits per slot (B, V) f32, k_pages, v_pages)
     with every valid token's KV scattered into the pool at its
     position.
@@ -322,9 +334,11 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
     (t,) = tokens.shape
     dt = cfg.dtype
+    quantized = kv_kind != "f32"
     x = params["embed"].astype(dt)[tokens]              # (T, H)
     cos, sin = rope_frequencies(cfg, positions)         # (T, D/2)
     use_kernel = impl in ("pallas", "pallas_interpret")
+    kernel_quant = use_kernel and quantized
     if use_kernel:
         # pool stays layer-major in HBM: the scan slices one layer's
         # [pages, page, KVH, D] and the kernel streams pages from it
@@ -332,57 +346,91 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
     else:
         ctx_tables = (page_tables if ctx_pages < 0
                       else page_tables[:, :ctx_pages])
-        k_by_layer, v_by_layer = gather_kv(k_pages, v_pages, ctx_tables)
+        if quantized:
+            k_by_layer, v_by_layer = gather_kv_quant(
+                k_pages, v_pages, k_scales, v_scales, ctx_tables)
+        else:
+            k_by_layer, v_by_layer = gather_kv(k_pages, v_pages,
+                                               ctx_tables)
 
     def layer_fn(x, inp):
-        layer, k_l, v_l, lora_l = inp
+        if kernel_quant:
+            layer, k_l, v_l, ks_l, vs_l, lora_l = inp
+        else:
+            layer, k_l, v_l, lora_l = inp
+            ks_l = vs_l = None
 
         def attn_fn(q, k, v):
             if not use_kernel:
                 return ragged_prefill_decode_attention(
                     q, k_l, v_l, k, v, slot_ids, positions, valid,
                     start)
-            kernel = functools.partial(
+            base = functools.partial(
                 ragged_paged_attention_pallas, ctx_pages=ctx_pages,
                 max_seg_len=max_seg_len,
                 interpret=(impl == "pallas_interpret"))
+            if kernel_quant:
+                # positional wrapper so shard_map's in_specs line up
+                def kernel(q_, kp, vp, tb, si, po, va, st, kn, vn,
+                           ksl, vsl):
+                    return base(q_, kp, vp, tb, si, po, va, st, kn, vn,
+                                k_scales=ksl, v_scales=vsl)
+            else:
+                kernel = base
             if mesh is not None and mesh.shape.get("tp", 1) > 1:
                 # per-head attention: each tp shard streams pages for
                 # its local kv heads, no cross-shard comms
                 from jax.sharding import PartitionSpec as P
+                in_specs = [P(None, "tp", None),          # q (T,H,D)
+                            P(None, None, "tp", None),    # k pool
+                            P(None, None, "tp", None),    # v pool
+                            P(None, None),                # tables
+                            P(None),                      # slot_ids
+                            P(None),                      # positions
+                            P(None),                      # valid
+                            P(None),                      # start
+                            P(None, "tp", None),          # new k
+                            P(None, "tp", None)]          # new v
+                if kernel_quant:
+                    # scale blocks shard on kv heads like their pages
+                    in_specs += [P(None, None, "tp"),     # k scales
+                                 P(None, None, "tp")]     # v scales
                 kernel = _shard_map(
                     kernel, mesh,
-                    in_specs=(P(None, "tp", None),          # q (T,H,D)
-                              P(None, None, "tp", None),    # k pool
-                              P(None, None, "tp", None),    # v pool
-                              P(None, None),                # tables
-                              P(None),                      # slot_ids
-                              P(None),                      # positions
-                              P(None),                      # valid
-                              P(None),                      # start
-                              P(None, "tp", None),          # new k
-                              P(None, "tp", None)),         # new v
+                    in_specs=tuple(in_specs),
                     out_specs=P(None, "tp", None))
-            return kernel(q, k_l, v_l, page_tables, slot_ids,
-                          positions, valid, start, k, v)
+            args = (q, k_l, v_l, page_tables, slot_ids,
+                    positions, valid, start, k, v)
+            if kernel_quant:
+                args += (ks_l, vs_l)
+            return kernel(*args)
 
         return _layer_body(
             cfg, dt, x, layer, lora_l, lora_idx, (t,),
             lambda a: _rope_single(a, cos, sin), attn_fn)
 
-    x, (ks, vs) = jax.lax.scan(
-        layer_fn, x,
-        (params["layers"], k_by_layer, v_by_layer, lora_scan_xs(lora)))
+    scan_xs = (params["layers"], k_by_layer, v_by_layer)
+    if kernel_quant:
+        scan_xs += (k_scales, v_scales)
+    scan_xs += (lora_scan_xs(lora),)
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, scan_xs)
     # ks/vs: (L, T, KVH, D) -> token-major (T, L, KVH, D)
     k_rows = jnp.swapaxes(ks, 0, 1)
     v_rows = jnp.swapaxes(vs, 0, 1)
-    k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
-                                  page_tables[slot_ids], positions,
-                                  valid)
+    if quantized:
+        k_pages, v_pages, k_scales, v_scales = scatter_kv_quant(
+            k_pages, v_pages, k_scales, v_scales, k_rows, v_rows,
+            page_tables[slot_ids], positions, valid, kv_kind)
+    else:
+        k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
+                                      page_tables[slot_ids], positions,
+                                      valid)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = x[last_idx]                                  # (B, H)
     logits = last.astype(jnp.float32) @ params["lm_head"].astype(
         jnp.float32)
+    if quantized:
+        return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages
 
 
@@ -395,8 +443,11 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 impl: str = "gather", mesh=None,
                 lora: Optional[dict] = None,
                 lora_idx: Optional[jax.Array] = None,
-                hidden: Optional[jax.Array] = None, emit: str = "logits"
-                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                hidden: Optional[jax.Array] = None, emit: str = "logits",
+                kv_kind: str = "f32",
+                k_scales: Optional[jax.Array] = None,
+                v_scales: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, ...]:
     """One decode step for the whole running batch.
 
     tokens: (B,) last sampled token per slot; positions: (B,) its
@@ -419,23 +470,39 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
     in shard_map over 'tp' (attention is per-head: no collectives
     inside, psum on the projections happens in the surrounding GSPMD
     program).
+
+    kv_kind/k_scales/v_scales: quantized pools (ISSUE 16) — same
+    contract as ragged_forward: dequant-on-gather or fused-dequant
+    kernel on the read side, quantize-at-append on the write side, and
+    a (logits, k_pages, v_pages, k_scales, v_scales) return.
     """
     b = tokens.shape[0]
     dt = cfg.dtype
+    quantized = kv_kind != "f32"
     x = (params["embed"].astype(dt)[tokens] if hidden is None
          else hidden.astype(dt))                    # (B, H)
     cos, sin = rope_frequencies(cfg, positions)     # (B, D/2)
 
     use_kernel = impl in ("pallas", "pallas_interpret")
+    kernel_quant = use_kernel and quantized
     if use_kernel:
         # Pool is layer-major already: scan slices (pages, page, KVH, D).
         k_by_layer, v_by_layer = k_pages, v_pages
     else:
         # One gather of the whole context for all layers, layer-major.
-        k_by_layer, v_by_layer = gather_kv(k_pages, v_pages, page_tables)
+        if quantized:
+            k_by_layer, v_by_layer = gather_kv_quant(
+                k_pages, v_pages, k_scales, v_scales, page_tables)
+        else:
+            k_by_layer, v_by_layer = gather_kv(k_pages, v_pages,
+                                               page_tables)
 
     def layer_fn(x, inp):
-        layer, k_l, v_l, lora_l = inp
+        if kernel_quant:
+            layer, k_l, v_l, ks_l, vs_l, lora_l = inp
+        else:
+            layer, k_l, v_l, lora_l = inp
+            ks_l = vs_l = None
 
         def attn_fn(q, k, v):
             # The just-computed token's KV is not yet in the pages: the
@@ -447,38 +514,64 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 v_full = jnp.concatenate([v_l, v[:, None]], axis=1)
                 return paged_attention_on_gathered(
                     q, k_full, v_full, positions, append_len=1)
-            kernel = functools.partial(
+            base = functools.partial(
                 paged_decode_with_new_token,
                 interpret=(impl == "pallas_interpret"))
+            if kernel_quant:
+                # positional wrapper so shard_map's in_specs line up
+                def kernel(q_, kp, vp, tb, po, kn, vn, ksl, vsl):
+                    return base(q_, kp, vp, tb, po, kn, vn,
+                                k_scales=ksl, v_scales=vsl)
+            else:
+                kernel = base
             if mesh is not None and mesh.shape.get("tp", 1) > 1:
                 # per-head attention: each tp shard runs the kernel on
                 # its local heads/kv-heads, no cross-shard comms
                 from jax.sharding import PartitionSpec as P
+                in_specs = [P(None, "tp", None),          # q (B,H,D)
+                            P(None, None, "tp", None),    # k pool
+                            P(None, None, "tp", None),    # v pool
+                            P(None, None),                # tables
+                            P(None),                      # positions
+                            P(None, "tp", None),          # new k
+                            P(None, "tp", None)]          # new v
+                if kernel_quant:
+                    # scale blocks shard on kv heads like their pages
+                    in_specs += [P(None, None, "tp"),     # k scales
+                                 P(None, None, "tp")]     # v scales
                 kernel = _shard_map(
                     kernel, mesh,
-                    in_specs=(P(None, "tp", None),          # q (B,H,D)
-                              P(None, None, "tp", None),    # k pool
-                              P(None, None, "tp", None),    # v pool
-                              P(None, None),                # tables
-                              P(None),                      # positions
-                              P(None, "tp", None),          # new k
-                              P(None, "tp", None)),         # new v
+                    in_specs=tuple(in_specs),
                     out_specs=P(None, "tp", None))
-            return kernel(q, k_l, v_l, page_tables, positions, k, v)
+            args = (q, k_l, v_l, page_tables, positions, k, v)
+            if kernel_quant:
+                args += (ks_l, vs_l)
+            return kernel(*args)
 
         return _layer_body(cfg, dt, x, layer, lora_l, lora_idx, (b,),
                            lambda a: _rope_single(a, cos, sin),
                            attn_fn)
 
-    x, (ks, vs) = jax.lax.scan(
-        layer_fn, x,
-        (params["layers"], k_by_layer, v_by_layer, lora_scan_xs(lora)))
+    scan_xs = (params["layers"], k_by_layer, v_by_layer)
+    if kernel_quant:
+        scan_xs += (k_scales, v_scales)
+    scan_xs += (lora_scan_xs(lora),)
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, scan_xs)
     k_rows = jnp.transpose(ks, (1, 0, 2, 3))        # (B, L, KVH, D)
     v_rows = jnp.transpose(vs, (1, 0, 2, 3))
-    k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
-                                  page_tables, positions, active)
+    if quantized:
+        k_pages, v_pages, k_scales, v_scales = scatter_kv_quant(
+            k_pages, v_pages, k_scales, v_scales, k_rows, v_rows,
+            page_tables, positions, active, kv_kind)
+    else:
+        k_pages, v_pages = scatter_kv(k_pages, v_pages, k_rows, v_rows,
+                                      page_tables, positions, active)
     if emit == "hidden":
+        if quantized:
+            return x, k_pages, v_pages, k_scales, v_scales
         return x, k_pages, v_pages
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    if quantized:
+        return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages

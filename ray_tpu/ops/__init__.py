@@ -1,2 +1,3 @@
+from . import kv_quant, quantized_collectives
 from .attention import attention, flash_attention, reference_attention
 from .ring_attention import ring_attention, ring_attention_sharded

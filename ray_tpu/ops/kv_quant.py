@@ -1,0 +1,129 @@
+"""Quantized KV-page primitives: int8/fp8 storage with per-row scales.
+
+ISSUE 16 stores the paged KV pool in a narrow dtype (int8 or fp8
+e4m3) and keeps a separate f32 scale array so every consumer of pages
+— the fused-dequant ragged kernel, the dense gather paths, the
+spill/restore d2h/h2d hierarchy, and the fleet KV transport — reads a
+quarter of the value bytes and reconstructs f32 with one multiply.
+
+Scale granularity is per TOKEN ROW per KV HEAD: for a pool shaped
+``[L, P, page, KVH, D]`` the scales are ``[L, P, page, KVH]`` f32,
+i.e. one scale over each row's D lane values. Two properties hang on
+this choice:
+
+- the write path stays WRITE-ONLY: a page fills one token row at a
+  time (decode appends, chunked prefill), and a per-row scale means
+  appending a row never has to re-read neighbours to recompute a
+  shared page scale;
+- the scale array shards exactly like the pool under tp
+  (``P(None, None, None, "tp")``): each shard scales its own heads,
+  no cross-shard max.
+
+Symmetric absmax quantization: ``scale = max|x| / qmax`` over D,
+``q = clip(round(x / scale))`` for int8 or a straight fp8 cast of
+``x / scale * qmax``-free form (fp8 keeps its own mantissa; only the
+range is normalized). Dequant is ``q.astype(f32) * scale`` — the one
+fused multiply the kernel folds into its HBM->VMEM streaming loop.
+
+All functions are jit-safe and run identically under CPU interpret
+mode (the tolerance-oracle tests exercise them there).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# KV page storage kinds: name -> (jnp storage dtype, qmax used to
+# normalize the absmax into the representable range, bytes per value).
+# "f32" is the identity kind — no scale arrays exist, every quant
+# helper below rejects it so a caller can never half-quantize.
+_FP8 = jnp.float8_e4m3fn
+KV_KINDS = ("f32", "int8", "fp8")
+_STORE = {
+    "int8": (jnp.int8, 127.0, 1),
+    "fp8": (_FP8, 448.0, 1),
+}
+# f32 scale per (token row, kv head) — the fixed overhead every
+# byte-accounting surface (perfmodel, telemetry, transport) adds on
+# top of the narrow values
+SCALE_BYTES = 4
+
+
+def validate_kind(kind: str) -> str:
+    if kind not in KV_KINDS:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_KINDS}, got {kind!r}")
+    return kind
+
+
+def is_quantized(kind: str) -> bool:
+    return validate_kind(kind) != "f32"
+
+
+def storage_dtype(kind: str):
+    """jnp dtype the KV pool is allocated in for `kind`."""
+    if kind == "f32":
+        return jnp.float32
+    return _STORE[validate_kind(kind)][0]
+
+
+def qmax(kind: str) -> float:
+    return _STORE[validate_kind(kind)][1]
+
+
+def value_bytes(kind: str) -> int:
+    """Bytes per stored KV value (no scale overhead)."""
+    if kind == "f32":
+        return 4
+    return _STORE[validate_kind(kind)][2]
+
+
+def token_row_bytes(kind: str, n_kv_heads: int, head_dim: int) -> int:
+    """Bytes ONE token row of ONE of k/v occupies in ONE layer:
+    values plus the per-(row, head) scales. The atom perfmodel and
+    telemetry build page/token byte math from."""
+    vals = n_kv_heads * head_dim * value_bytes(kind)
+    if kind == "f32":
+        return vals
+    return vals + n_kv_heads * SCALE_BYTES
+
+
+def scale_shape(pool_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Scale-array shape for a pool shaped [..., KVH, D]: drop D."""
+    return tuple(pool_shape[:-1])
+
+
+def quantize_rows(x: jnp.ndarray, kind: str
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize f32 rows [..., KVH, D] -> (q [..., KVH, D] narrow,
+    scales [..., KVH] f32). Symmetric absmax over D; all-zero rows get
+    scale 0 and dequantize back to exact zeros (fresh pool pages and
+    masked scatter rows stay clean)."""
+    if not is_quantized(kind):
+        raise ValueError("quantize_rows: kind must be int8/fp8")
+    dt, qm, _ = _STORE[kind]
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scales = amax / qm
+    # zero rows: divide by 1 instead of 0; scale 0 zeroes the dequant
+    safe = jnp.where(scales > 0.0, scales, 1.0)[..., None]
+    y = x / safe          # row absmax lands exactly at qmax
+    if kind == "int8":
+        q = jnp.clip(jnp.round(y), -qm, qm).astype(dt)
+    else:
+        # fp8 e4m3fn: the row's absmax sits at the format's top of
+        # range (448); fp8's own mantissa does the rounding
+        q = y.astype(dt)
+    return q, scales
+
+
+def dequantize_rows(q: jnp.ndarray, scales: jnp.ndarray,
+                    kind: str) -> jnp.ndarray:
+    """Inverse of quantize_rows: q [..., KVH, D] + scales [..., KVH]
+    -> f32 [..., KVH, D]. This multiply is exactly what the Pallas
+    kernel fuses after its VMEM load."""
+    if not is_quantized(kind):
+        raise ValueError("dequantize_rows: kind must be int8/fp8")
+    return q.astype(jnp.float32) * scales[..., None].astype(jnp.float32)

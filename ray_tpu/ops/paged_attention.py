@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_tpu.ops import kv_quant
+
 
 def gather_kv(k_pages: jax.Array, v_pages: jax.Array,
               page_tables: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -47,6 +49,25 @@ def gather_kv(k_pages: jax.Array, v_pages: jax.Array,
         l, b, p, s, h, d = g.shape
         return g.reshape(l, b, p * s, h, d)
     return one(k_pages), one(v_pages)
+
+
+def gather_kv_quant(k_pages: jax.Array, v_pages: jax.Array,
+                    k_scales: jax.Array, v_scales: jax.Array,
+                    page_tables: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Quantized-pool gather (ISSUE 16): pools hold int8/fp8 values
+    with per-(row, head) f32 scales ([L, P, page, KVH],
+    ops/kv_quant.py layout). Gathers values AND scales by the table,
+    dequantizes, and returns the same dense f32 layout as gather_kv —
+    the XLA fallback paths stay byte-for-byte identical downstream of
+    this call."""
+    def one(pages, scales):
+        g = pages[:, page_tables]          # [L, B, P, page, KVH, D]
+        s = scales[:, page_tables]         # [L, B, P, page, KVH]
+        l, b, p, sz, h, d = g.shape
+        deq = g.astype(jnp.float32) * s.astype(jnp.float32)[..., None]
+        return deq.reshape(l, b, p * sz, h, d)
+    return one(k_pages, k_scales), one(v_pages, v_scales)
 
 
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
@@ -134,12 +155,21 @@ def chunk_attention_on_gathered(q: jax.Array, k_ctx: jax.Array,
     return out.reshape(b, c, h, d).astype(q.dtype)
 
 
-def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, m_scr, l_scr, acc_scr, *,
-                         page_size: int, scale: float, kvh: int):
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
+                         *rest, page_size: int, scale: float, kvh: int,
+                         quantized: bool = False):
     """Grid (B, max_pages): each step consumes one page for ALL kv heads
     (the per-head loop is unrolled — kvh is small and static), keeping the
-    grid shallow so dispatch overhead doesn't dominate decode."""
+    grid shallow so dispatch overhead doesn't dominate decode.
+
+    quantized=True (ISSUE 16): two extra refs after v_ref carry the
+    page's per-(row, head) f32 scales; dequant folds into the f32
+    upcast of each head's page slice."""
+    if quantized:
+        (ks_ref, vs_ref, o_ref, m_ref, l_ref,
+         m_scr, l_scr, acc_scr) = rest
+    else:
+        (o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr) = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
     n_pages = pl.num_programs(1)
@@ -163,6 +193,9 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, h].astype(jnp.float32)        # (group, D)
             k = k_ref[0, :, h].astype(jnp.float32)     # (page, D)
             v = v_ref[0, :, h].astype(jnp.float32)     # (page, D)
+            if quantized:
+                k = k * ks_ref[0, :, h][:, None]
+                v = v * vs_ref[0, :, h][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # (group, page)
@@ -190,16 +223,26 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_decode_kernel_mp(tables_ref, lens_ref, q_ref, k_hbm, v_hbm,
-                            o_ref, m_ref, l_ref, k_vmem, v_vmem, sem,
-                            m_scr, l_scr, acc_scr, *,
-                            page_size: int, ppb: int, scale: float,
-                            kvh: int):
+                            *rest, page_size: int, ppb: int,
+                            scale: float, kvh: int,
+                            quantized: bool = False):
     """Multi-page variant: grid (B, max_pages // ppb); each step manually
     DMAs its block's ppb pages (all kv heads per page — our pool layout
     keeps heads together) into VMEM and runs one online-softmax update
     over ppb*page_size keys. 8x fewer grid steps and 8x larger matmuls
     than the one-page-per-step BlockSpec kernel, whose per-step dispatch
-    overhead dominated decode (~5us x B x max_pages)."""
+    overhead dominated decode (~5us x B x max_pages).
+
+    quantized=True (ISSUE 16): two extra HBM refs carry the per-(row,
+    head) f32 scale pools; each block DMAs its pages' scale rows in
+    the same wave and fuses the dequant multiply into the f32 upcast —
+    the streamed context bytes drop to ~1/4 of f32."""
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, m_ref, l_ref, k_vmem, v_vmem,
+         ks_vmem, vs_vmem, sem, m_scr, l_scr, acc_scr) = rest
+    else:
+        (o_ref, m_ref, l_ref, k_vmem, v_vmem, sem,
+         m_scr, l_scr, acc_scr) = rest
     b = pl.program_id(0)
     i = pl.program_id(1)
     n_blocks = pl.num_programs(1)
@@ -224,6 +267,11 @@ def _paged_decode_kernel_mp(tables_ref, lens_ref, q_ref, k_hbm, v_hbm,
                     k_hbm.at[idx], k_vmem.at[t], sem))
                 out.append(pltpu.make_async_copy(
                     v_hbm.at[idx], v_vmem.at[t], sem))
+                if quantized:
+                    out.append(pltpu.make_async_copy(
+                        ks_hbm.at[idx], ks_vmem.at[t], sem))
+                    out.append(pltpu.make_async_copy(
+                        vs_hbm.at[idx], vs_vmem.at[t], sem))
             return out
 
         for c in copies():
@@ -238,6 +286,10 @@ def _paged_decode_kernel_mp(tables_ref, lens_ref, q_ref, k_hbm, v_hbm,
         # [ppb, page, kvh, D] -> per-head [bk, D]
         kb = k_vmem[...].astype(jnp.float32)
         vb = v_vmem[...].astype(jnp.float32)
+        if quantized:
+            # fused dequant against the scale rows from the same wave
+            kb = kb * ks_vmem[...][..., None]
+            vb = vb * vs_vmem[...][..., None]
         for h in range(kvh):
             q = q_ref[0, h].astype(jnp.float32)        # (group, D)
             k = kb[:, :, h].reshape(bk, d)
@@ -270,7 +322,8 @@ def _paged_decode_kernel_mp(tables_ref, lens_ref, q_ref, k_hbm, v_hbm,
 
 
 def _paged_decode_multipage(q, k_pages, v_pages, page_tables, seq_lens,
-                            ppb: int, interpret: bool = False):
+                            ppb: int, interpret: bool = False,
+                            k_scales=None, v_scales=None):
     b, h, d = q.shape
     _, page_size, kvh, _ = k_pages.shape
     max_pages = page_tables.shape[1]
@@ -278,25 +331,38 @@ def _paged_decode_multipage(q, k_pages, v_pages, page_tables, seq_lens,
     scale = d ** -0.5
     qg = q.reshape(b, kvh, group, d)
     n_blocks = max(-(-max_pages // ppb), 1)
+    quantized = k_scales is not None
 
     fixed = lambda bi, i, tables, lens: (bi, 0, 0, 0)
     out_spec = pl.BlockSpec((1, kvh, group, d), fixed)
     stat_spec = pl.BlockSpec((1, kvh, group, 1), fixed)
+    in_specs = [
+        pl.BlockSpec((1, kvh, group, d), fixed),
+        pl.BlockSpec(memory_space=pl.ANY),   # k pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),   # v pool stays in HBM
+    ]
+    inputs = [qg, k_pages, v_pages]
+    scratch = [
+        pltpu.VMEM((ppb, page_size, kvh, d), k_pages.dtype),
+        pltpu.VMEM((ppb, page_size, kvh, d), v_pages.dtype),
+    ]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        inputs += [k_scales.astype(jnp.float32),
+                   v_scales.astype(jnp.float32)]
+        scratch += [pltpu.VMEM((ppb, page_size, kvh), jnp.float32),
+                    pltpu.VMEM((ppb, page_size, kvh), jnp.float32)]
     return pl.pallas_call(
         functools.partial(_paged_decode_kernel_mp, page_size=page_size,
-                          ppb=ppb, scale=scale, kvh=kvh),
+                          ppb=ppb, scale=scale, kvh=kvh,
+                          quantized=quantized),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_blocks),
-            in_specs=[
-                pl.BlockSpec((1, kvh, group, d), fixed),
-                pl.BlockSpec(memory_space=pl.ANY),   # k pool stays in HBM
-                pl.BlockSpec(memory_space=pl.ANY),   # v pool stays in HBM
-            ],
+            in_specs=in_specs,
             out_specs=(out_spec, stat_spec, stat_spec),
-            scratch_shapes=[
-                pltpu.VMEM((ppb, page_size, kvh, d), k_pages.dtype),
-                pltpu.VMEM((ppb, page_size, kvh, d), v_pages.dtype),
+            scratch_shapes=scratch + [
                 pltpu.SemaphoreType.DMA,
                 pltpu.VMEM((kvh * group, 1), jnp.float32),
                 pltpu.VMEM((kvh * group, 1), jnp.float32),
@@ -310,7 +376,7 @@ def _paged_decode_multipage(q, k_pages, v_pages, page_tables, seq_lens,
         ),
         interpret=interpret,
     )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      qg, k_pages, v_pages)
+      *inputs)
 
 
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
@@ -318,6 +384,8 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                            seq_lens: jax.Array, *,
                            return_stats: bool = False,
                            pages_per_block: int = 16,
+                           k_scales: jax.Array = None,
+                           v_scales: jax.Array = None,
                            interpret: bool = False):
     """Pallas paged decode attention for one layer.
 
@@ -337,9 +405,11 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     b, h, d = q.shape
     _, page_size, kvh, _ = k_pages.shape
     max_pages = page_tables.shape[1]
+    quantized = k_scales is not None
     if not interpret and max_pages >= pages_per_block > 1:
         out, m, l = _paged_decode_multipage(
-            q, k_pages, v_pages, page_tables, seq_lens, pages_per_block)
+            q, k_pages, v_pages, page_tables, seq_lens, pages_per_block,
+            k_scales=k_scales, v_scales=v_scales)
         out = out.reshape(b, h, d)
         if return_stats:
             return out, m.reshape(b, h), l.reshape(b, h)
@@ -352,23 +422,36 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
         last = jnp.maximum((lens[bi] - 1) // page_size, 0)
         return (tables[bi, jnp.minimum(j, last)], 0, 0, 0)
 
+    def scale_index(bi, j, tables, lens):
+        last = jnp.maximum((lens[bi] - 1) // page_size, 0)
+        return (tables[bi, jnp.minimum(j, last)], 0, 0)
+
     grid = (b, max_pages)
     out_spec = pl.BlockSpec(
         (1, kvh, group, d), lambda bi, j, tables, lens: (bi, 0, 0, 0))
     stat_spec = pl.BlockSpec(
         (1, kvh, group, 1), lambda bi, j, tables, lens: (bi, 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, kvh, group, d),
+                     lambda bi, j, tables, lens: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, page_size, kvh, d), page_index),
+        pl.BlockSpec((1, page_size, kvh, d), page_index),
+    ]
+    inputs = [qg, k_pages, v_pages]
+    if quantized:
+        # scale blocks ride the same clamped page-index map as their
+        # pages, so the DMA-elision for past-the-end steps holds
+        in_specs += [pl.BlockSpec((1, page_size, kvh), scale_index),
+                     pl.BlockSpec((1, page_size, kvh), scale_index)]
+        inputs += [k_scales.astype(jnp.float32),
+                   v_scales.astype(jnp.float32)]
     out, m, l = pl.pallas_call(
         functools.partial(_paged_decode_kernel, page_size=page_size,
-                          scale=scale, kvh=kvh),
+                          scale=scale, kvh=kvh, quantized=quantized),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, kvh, group, d),
-                             lambda bi, j, tables, lens: (bi, 0, 0, 0)),
-                pl.BlockSpec((1, page_size, kvh, d), page_index),
-                pl.BlockSpec((1, page_size, kvh, d), page_index),
-            ],
+            in_specs=in_specs,
             out_specs=(out_spec, stat_spec, stat_spec),
             scratch_shapes=[
                 pltpu.VMEM((kvh * group, 1), jnp.float32),
@@ -383,7 +466,7 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
         ),
         interpret=interpret,
     )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      qg, k_pages, v_pages)
+      *inputs)
     out = out.reshape(b, h, d)
     if return_stats:
         return out, m.reshape(b, h), l.reshape(b, h)
@@ -394,12 +477,16 @@ def paged_decode_with_new_token(q: jax.Array, k_pages: jax.Array,
                                 v_pages: jax.Array, page_tables: jax.Array,
                                 seq_lens: jax.Array, k_new: jax.Array,
                                 v_new: jax.Array, *,
+                                k_scales: jax.Array = None,
+                                v_scales: jax.Array = None,
                                 interpret: bool = False) -> jax.Array:
     """Kernel decode over cached pages + one online-softmax merge step for
     the current token's KV (not yet scattered into the pool).
 
     q/k_new/v_new: [B, H, D] / [B, KVH, D] / [B, KVH, D];
     seq_lens counts CACHED tokens only. Returns [B, H, D].
+    k_scales/v_scales: per-(row, head) f32 scales when the pools are
+    int8/fp8 (ISSUE 16); the new token's KV stays full-precision.
     """
     b, h, d = q.shape
     kvh = k_new.shape[1]
@@ -407,7 +494,8 @@ def paged_decode_with_new_token(q: jax.Array, k_pages: jax.Array,
     scale = d ** -0.5
     out, m, l = paged_decode_attention(
         q, k_pages, v_pages, page_tables, seq_lens,
-        return_stats=True, interpret=interpret)
+        return_stats=True, k_scales=k_scales, v_scales=v_scales,
+        interpret=interpret)
     # score of the new token against itself (always attendable)
     qf = q.reshape(b, kvh, group, d).astype(jnp.float32)
     kf = k_new.astype(jnp.float32)
@@ -453,3 +541,39 @@ def scatter_kv(k_pages: jax.Array, v_pages: jax.Array,
     k_pages = flat(k_pages).at[:, rows].set(k_rows).reshape(k_pages.shape)
     v_pages = flat(v_pages).at[:, rows].set(v_rows).reshape(v_pages.shape)
     return k_pages, v_pages
+
+
+def scatter_kv_quant(k_pages: jax.Array, v_pages: jax.Array,
+                     k_scales: jax.Array, v_scales: jax.Array,
+                     k_new: jax.Array, v_new: jax.Array,
+                     page_tables: jax.Array, positions: jax.Array,
+                     valid: jax.Array, kind: str
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """scatter_kv for quantized pools: quantize-at-append (ISSUE 16).
+
+    Same row math as scatter_kv, but the fresh f32 rows are quantized
+    to `kind` (int8/fp8) with per-(row, head) scales before the write,
+    and the scale rows land in the [L, P, page, KVH] scale pools at the
+    same flat rows. Append stays write-only: each row carries its own
+    scale, so no neighbour rows are re-read. Invalid rows hit the
+    scratch page in both the value and scale pools.
+    """
+    l, num_pages, page_size, kvh, d = k_pages.shape
+    scratch = num_pages - 1
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    page_idx = jnp.where(valid, page_idx, scratch)
+    rows = page_idx * page_size + positions % page_size          # [N]
+    kq, ks = kv_quant.quantize_rows(k_new, kind)   # [N,L,KVH,D]/[N,L,KVH]
+    vq, vs = kv_quant.quantize_rows(v_new, kind)
+    flat = lambda p: p.reshape(l, num_pages * page_size, kvh, d)
+    flat_s = lambda s: s.reshape(l, num_pages * page_size, kvh)
+    k_pages = flat(k_pages).at[:, rows].set(
+        jnp.swapaxes(kq, 0, 1)).reshape(k_pages.shape)
+    v_pages = flat(v_pages).at[:, rows].set(
+        jnp.swapaxes(vq, 0, 1)).reshape(v_pages.shape)
+    k_scales = flat_s(k_scales).at[:, rows].set(
+        jnp.swapaxes(ks, 0, 1)).reshape(k_scales.shape)
+    v_scales = flat_s(v_scales).at[:, rows].set(
+        jnp.swapaxes(vs, 0, 1)).reshape(v_scales.shape)
+    return k_pages, v_pages, k_scales, v_scales

@@ -1,0 +1,122 @@
+"""EQuARX-style quantized collectives for the tp mesh (ISSUE 16c).
+
+Tensor-parallel serving moves activations through two collectives per
+layer (the psum closing each row-parallel matmul, the all_gather
+opening column-parallel ones), and at small batch the wire time — not
+the MXU — bounds the layer. EQuARX (PAPERS.md) cuts that wire time by
+shipping int8 blocks + f32 block scales instead of wide activations,
+quantizing at BOTH hops of the two-phase allreduce so every byte on
+the ICI is narrow. This module is that scheme expressed in portable
+lax collectives, callable inside any shard_map over the tp axis:
+
+  phase 1 (reduce-scatter shaped): each shard splits its operand into
+    one chunk per peer, quantizes every chunk block-wise, and
+    all_to_alls the narrow values + scales; the receiver dequantizes
+    to f32 and reduces its owned chunk exactly.
+  phase 2 (all_gather shaped): the reduced chunk is re-quantized and
+    all_gathered, again as narrow values + scales; every shard
+    dequantizes the full result.
+
+The f32 accumulate between the hops is what keeps the error one
+quantization deep per hop (2 total) instead of growing with the ring —
+the EQuARX design point. Block-wise scales (default 256 values per
+f32 scale) bound the relative error per block; the payload helper
+below accounts the exact bytes a transport layer would move.
+
+The serving engine's llama path is GSPMD — XLA emits its collectives
+from shardings, so there is no call site to swap mid-model. The
+`EngineConfig.quantized_collectives` knob therefore ARMS these helpers
+for explicitly shard_mapped programs (and future custom layers);
+correctness is gated here by tolerance oracles vs the f32 collectives
+(tests/test_kv_quant.py) on a forced multi-device host platform.
+
+Storage kinds, qmax conventions, and the quantize/dequantize rules are
+shared with the KV-page quantizer (`ops/kv_quant.py`) — one numeric
+contract across pages, spills, ships, and collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import kv_quant
+
+# one f32 scale per this many values (EQuARX block scaling): small
+# enough to bound per-block relative error, large enough that scale
+# traffic stays ~1.5% of the narrow payload
+DEFAULT_BLOCK = 256
+
+
+def _axis_size(axis_name: str) -> int:
+    return int(lax.psum(1, axis_name))
+
+
+def _quantize_blocks(flat: jax.Array, kind: str, block: int):
+    """[n] f32 (n % block == 0) -> ([n/block, block] narrow,
+    [n/block] f32 scales)."""
+    return kv_quant.quantize_rows(flat.reshape(-1, block), kind)
+
+
+def payload_bytes(n_elems: int, kind: str,
+                  block: int = DEFAULT_BLOCK) -> int:
+    """Wire bytes one hop ships for `n_elems` values: narrow values
+    plus one f32 scale per block (f32 ships wide, no scales)."""
+    if kind == "f32":
+        return int(n_elems) * 4
+    blocks = -(-int(n_elems) // int(block))
+    return (int(n_elems) * kv_quant.value_bytes(kind)
+            + blocks * kv_quant.SCALE_BYTES)
+
+
+def quantized_all_gather(x: jax.Array, axis_name: str,
+                         kind: str = "int8",
+                         block: int = DEFAULT_BLOCK) -> jax.Array:
+    """lax.all_gather semantics (new leading axis of size P) with the
+    shipped payload quantized block-wise. Call inside shard_map."""
+    kind = kv_quant.validate_kind(kind)
+    if kind == "f32":
+        return lax.all_gather(x, axis_name)
+    shape, dt = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    padded = -(-n // block) * block
+    flat = jnp.pad(flat, (0, padded - n))
+    q, s = _quantize_blocks(flat, kind, block)
+    qg = lax.all_gather(q, axis_name)          # [P, nb, block] narrow
+    sg = lax.all_gather(s, axis_name)          # [P, nb] f32
+    full = kv_quant.dequantize_rows(qg, sg, kind)
+    return full.reshape(full.shape[0], -1)[:, :n] \
+        .reshape((full.shape[0],) + shape).astype(dt)
+
+
+def quantized_psum(x: jax.Array, axis_name: str, kind: str = "int8",
+                   block: int = DEFAULT_BLOCK) -> jax.Array:
+    """lax.psum semantics with both hops of the two-phase allreduce
+    shipping quantized blocks (EQuARX). Call inside shard_map."""
+    kind = kv_quant.validate_kind(kind)
+    if kind == "f32":
+        return lax.psum(x, axis_name)
+    P = _axis_size(axis_name)
+    shape, dt = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    per = -(-n // (P * block)) * block         # chunk rows per peer
+    flat = jnp.pad(flat, (0, per * P - n))
+    chunks = flat.reshape(P, per // block, block)
+    # hop 1: quantize every peer's chunk, ship narrow, reduce in f32
+    q, s = kv_quant.quantize_rows(chunks, kind)
+    q_r = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    s_r = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    partial = kv_quant.dequantize_rows(q_r, s_r, kind).sum(axis=0)
+    # hop 2: re-quantize the reduced chunk, gather narrow, dequantize
+    q2, s2 = kv_quant.quantize_rows(partial, kind)
+    qg = lax.all_gather(q2, axis_name)
+    sg = lax.all_gather(s2, axis_name)
+    out = kv_quant.dequantize_rows(qg, sg, kind).reshape(-1)[:n]
+    return out.reshape(shape).astype(dt)
+
+
+__all__ = ["DEFAULT_BLOCK", "payload_bytes", "quantized_all_gather",
+           "quantized_psum"]

@@ -181,10 +181,10 @@ def ragged_attention_dense_oracle(
 # ----------------------------------------------------- Pallas ragged kernel
 
 def _ragged_paged_kernel(tables_ref, start_ref, qlen_ref, q_ref, k_hbm,
-                         v_hbm, kn_ref, vn_ref, o_ref, k_vmem, v_vmem,
-                         sem, m_scr, l_scr, acc_scr, *, page_size: int,
+                         v_hbm, *rest, page_size: int,
                          ppb: int, n_ctx_blocks: int, q_blk: int,
-                         scale: float, kvh: int, group: int):
+                         scale: float, kvh: int, group: int,
+                         quantized: bool = False):
     """Grid (B, NQ, NK): slot b x query block qb x kv block i.
 
     kv blocks [0, n_ctx_blocks) stream the slot's CACHED context pages
@@ -204,7 +204,22 @@ def _ragged_paged_kernel(tables_ref, start_ref, qlen_ref, q_ref, k_hbm,
     j <= i and j < q_len[b] (the engine packs each slot's tokens
     contiguously at positions start[b] + rank, so offset order IS
     position order).
+
+    quantized=True (ISSUE 16): the pools hold int8/fp8 values and two
+    extra HBM refs carry the per-(row, head) f32 scales
+    ([num_pages, page, KVH], ops/kv_quant.py layout). Each context
+    step DMAs the scale rows of its ppb pages alongside the pages
+    themselves and folds the dequant — one broadcast multiply — into
+    the existing f32 upcast of the VMEM block, so the quantized
+    kernel streams ~1/4 the context bytes with no extra pass. The
+    fresh in-batch KV (kn/vn) is never quantized.
     """
+    if quantized:
+        (ks_hbm, vs_hbm, kn_ref, vn_ref, o_ref, k_vmem, v_vmem,
+         ks_vmem, vs_vmem, sem, m_scr, l_scr, acc_scr) = rest
+    else:
+        (kn_ref, vn_ref, o_ref, k_vmem, v_vmem,
+         sem, m_scr, l_scr, acc_scr) = rest
     b = pl.program_id(0)
     qb = pl.program_id(1)
     i = pl.program_id(2)
@@ -250,6 +265,11 @@ def _ragged_paged_kernel(tables_ref, start_ref, qlen_ref, q_ref, k_hbm,
                     k_hbm.at[idx], k_vmem.at[t], sem))
                 out.append(pltpu.make_async_copy(
                     v_hbm.at[idx], v_vmem.at[t], sem))
+                if quantized:
+                    out.append(pltpu.make_async_copy(
+                        ks_hbm.at[idx], ks_vmem.at[t], sem))
+                    out.append(pltpu.make_async_copy(
+                        vs_hbm.at[idx], vs_vmem.at[t], sem))
             return out
 
         for c in copies():
@@ -261,6 +281,11 @@ def _ragged_paged_kernel(tables_ref, start_ref, qlen_ref, q_ref, k_hbm,
         keep = pos < ctx_len                           # (1, bk)
         kb = k_vmem[...].astype(jnp.float32)           # (ppb, page, kvh, D)
         vb = v_vmem[...].astype(jnp.float32)
+        if quantized:
+            # the fused dequant: one multiply against the scale rows
+            # that rode the same DMA wave as their pages
+            kb = kb * ks_vmem[...][..., None]
+            vb = vb * vs_vmem[...][..., None]
         for h in range(kvh):
             q = q_ref[0, :, h * group:(h + 1) * group, :].reshape(
                 r, d).astype(jnp.float32)
@@ -312,6 +337,7 @@ def ragged_paged_attention_pallas(
         k_new: jax.Array, v_new: jax.Array, *, ctx_pages: int = -1,
         max_seg_len: int = -1, q_block: int = DEFAULT_Q_BLOCK,
         pages_per_block: int = DEFAULT_PAGES_PER_BLOCK,
+        k_scales: jax.Array = None, v_scales: jax.Array = None,
         interpret: bool = False) -> jax.Array:
     """TPU Pallas ragged paged attention: same contract as
     `ragged_paged_prefill_decode_attention`, but each slot's KV pages
@@ -337,6 +363,12 @@ def ragged_paged_attention_pallas(
     sizes. The per-slot padded Q/O/new-KV staging arrays are
     [B, ceil(max_seg_len/q_block)*q_block, ...] — O(B * C * H * D),
     vs the gather path's O(T * ctx * KVH * D) context transient.
+
+    Quantized KV (ISSUE 16): pass k_scales/v_scales
+    ([num_pages, page_size, KVH] f32, ops/kv_quant.py layout) when
+    the pools hold int8/fp8 values; the kernel DMAs the scale rows
+    beside their pages and fuses the dequant multiply into the
+    streaming loop. k_new/v_new stay full-precision either way.
     """
     t, h, d = q.shape
     _, page_size, kvh, _ = k_pages.shape
@@ -379,25 +411,42 @@ def ragged_paged_attention_pallas(
 
     new_spec = pl.BlockSpec((1, q_blk, kvh, d), new_kv_index)
 
+    quantized = k_scales is not None
+    if quantized and v_scales is None:
+        raise ValueError("k_scales and v_scales must come together")
+    in_specs = [
+        io_spec,                             # padded queries
+        pl.BlockSpec(memory_space=pl.ANY),   # k pool in HBM
+        pl.BlockSpec(memory_space=pl.ANY),   # v pool in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((ppb, page_size, kvh, d), k_pages.dtype),
+        pltpu.VMEM((ppb, page_size, kvh, d), v_pages.dtype),
+    ]
+    inputs = [q_pad, k_pages, v_pages]
+    if quantized:
+        # scale pools ride beside the page pools: HBM-resident, DMA'd
+        # per context block into their own VMEM scratch rows
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((ppb, page_size, kvh), jnp.float32),
+                    pltpu.VMEM((ppb, page_size, kvh), jnp.float32)]
+        inputs += [k_scales.astype(jnp.float32),
+                   v_scales.astype(jnp.float32)]
+    in_specs += [new_spec, new_spec]         # padded new k / new v
+    inputs += [kn_pad, vn_pad]
+
     out = pl.pallas_call(
         functools.partial(
             _ragged_paged_kernel, page_size=page_size, ppb=ppb,
             n_ctx_blocks=n_ctx_blocks, q_blk=q_blk, scale=scale,
-            kvh=kvh, group=group),
+            kvh=kvh, group=group, quantized=quantized),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(b, nq, nk),
-            in_specs=[
-                io_spec,                             # padded queries
-                pl.BlockSpec(memory_space=pl.ANY),   # k pool in HBM
-                pl.BlockSpec(memory_space=pl.ANY),   # v pool in HBM
-                new_spec,                            # padded new k
-                new_spec,                            # padded new v
-            ],
+            in_specs=in_specs,
             out_specs=io_spec,
-            scratch_shapes=[
-                pltpu.VMEM((ppb, page_size, kvh, d), k_pages.dtype),
-                pltpu.VMEM((ppb, page_size, kvh, d), v_pages.dtype),
+            scratch_shapes=scratch + [
                 pltpu.SemaphoreType.DMA,
                 pltpu.VMEM((kvh * q_blk * group, 1), jnp.float32),
                 pltpu.VMEM((kvh * q_blk * group, 1), jnp.float32),
@@ -407,7 +456,7 @@ def ragged_paged_attention_pallas(
         out_shape=jax.ShapeDtypeStruct((b, qp, h, d), q.dtype),
         interpret=interpret,
     )(tables.astype(jnp.int32), start.astype(jnp.int32), qlen,
-      q_pad, k_pages, v_pages, kn_pad, vn_pad)
+      *inputs)
 
     flat = out[jnp.where(valid, slot_ids, 0), off]     # [T, H, D]
     return jnp.where(valid[:, None, None], flat,

@@ -47,6 +47,19 @@ ml_dtypes). A corrupted or truncated payload raises
 "this ship failed" and fall back to the PR 9 replay path, never as a
 crash (the serialization property test drives both).
 
+Wire v2 (ISSUE 16): quantized engines ship pages AS STORED — int8/fp8
+value arrays plus the per-(row, head) f32 scale arrays — so
+quantize-on-ship falls out of the page format (a v2 int8 session frame
+is ~1/4 the bytes of its f32 twin). The header meta carries `kv_dtype`
+(the `ops/kv_quant.py` kind) and the scale arrays ride beside k/v as
+`k_scales`/`v_scales`. v1 frames (no kv_dtype, no scales) still decode
+as f32. The DECODER validates frame self-consistency (a quantized
+frame missing scales, or scale shapes that disagree with the pages, is
+a bad payload); kind compatibility with the RECEIVING engine is the
+import surface's job — `ship_kind_compatible` raises TransportError on
+mismatch so consumers hit the same replay fallback, because narrow
+pages must never be reinterpreted across storage kinds.
+
 Everything here is host-side: numpy + stdlib, no jax, no device work
 (the dispatch-guard suite runs with the transport active). The engine
 side (`export_session` / `import_session` / `export_prefix` /
@@ -70,7 +83,10 @@ import numpy as np
 from ...util import metrics as metrics_api
 
 MAGIC = b"RTKV"
-WIRE_VERSION = 1
+# v2 (ISSUE 16): kv_dtype in meta + per-(row, head) scale arrays for
+# quantized pages. v1 frames (implicitly f32, no scales) still decode.
+WIRE_VERSION = 2
+SUPPORTED_WIRE_VERSIONS = (1, 2)
 
 
 class TransportError(RuntimeError):
@@ -136,10 +152,10 @@ def _decode_frame(blob: bytes, expect_kind: Optional[str] = None
         raise TransportChecksumError(
             "payload checksum mismatch (corrupted in flight)")
     version, hlen = struct.unpack("<HI", blob[4:10])
-    if version != WIRE_VERSION:
+    if version not in SUPPORTED_WIRE_VERSIONS:
         raise TransportError(
             f"unsupported wire version {version} "
-            f"(this build speaks {WIRE_VERSION})")
+            f"(this build speaks {SUPPORTED_WIRE_VERSIONS})")
     if 10 + hlen > len(body):
         raise TransportError("payload truncated (header)")
     try:
@@ -183,20 +199,70 @@ _SESSION_META_KEYS = (
     "seed", "position", "last_token", "n_pages")
 
 
+def _check_quant_arrays(kind: str, arrays: Dict[str, np.ndarray],
+                        what: str) -> None:
+    """Frame self-consistency for quantized payloads: a quantized
+    frame with pages must carry BOTH scale arrays, each shaped like
+    its page array minus the trailing head_dim axis; an f32 frame must
+    carry none. Violations are bad payloads (TransportError), not
+    crashes."""
+    have_k = arrays.get("k") is not None
+    ks, vs = arrays.get("k_scales"), arrays.get("v_scales")
+    if kind == "f32":
+        if ks is not None or vs is not None:
+            raise TransportError(
+                f"f32 {what} frame carries quant scale arrays")
+        return
+    if not have_k:
+        return                      # cold session: no pages, no scales
+    if ks is None or vs is None:
+        raise TransportError(
+            f"quantized ({kind}) {what} frame is missing its scale "
+            f"arrays")
+    for name, s in (("k_scales", ks), ("v_scales", vs)):
+        page = arrays["k" if name[0] == "k" else "v"]
+        if tuple(s.shape) != tuple(page.shape[:-1]):
+            raise TransportError(
+                f"{what} frame {name} shape {tuple(s.shape)} does not "
+                f"match pages {tuple(page.shape)}")
+
+
+def ship_kind_compatible(frame_kind: Optional[str],
+                         engine_kind: str) -> str:
+    """Gate an import against the RECEIVING engine's storage kind.
+    Narrow pages are meaningless under a different kind, so a mismatch
+    is a failed SHIP (TransportError → the consumer's replay
+    fallback), never a reinterpretation. Returns the resolved frame
+    kind (v1 frames carry none → f32)."""
+    fk = str(frame_kind or "f32")
+    if fk != engine_kind:
+        raise TransportError(
+            f"KV dtype mismatch: frame pages are {fk!r}, the "
+            f"receiving engine serves {engine_kind!r} (fall back to "
+            f"token replay)")
+    return fk
+
+
 def encode_session(state: Dict[str, Any]) -> bytes:
     """engine.export_session state dict → wire bytes. The KV arrays
-    ride raw; everything else (identity, sampling params, decode
-    invariant) is JSON metadata."""
+    (and, for quantized engines, their scale arrays) ride raw;
+    everything else (identity, sampling params, decode invariant,
+    storage kind) is JSON metadata."""
     meta = {k: state.get(k) for k in _SESSION_META_KEYS}
+    meta["kv_dtype"] = str(state.get("kv_dtype") or "f32")
     arrays: List[Tuple[str, np.ndarray]] = []
     if state.get("k") is not None:
         arrays = [("k", state["k"]), ("v", state["v"])]
+        if state.get("k_scales") is not None:
+            arrays += [("k_scales", state["k_scales"]),
+                       ("v_scales", state["v_scales"])]
     return _encode_frame("session", meta, arrays)
 
 
 def decode_session(blob: bytes) -> Dict[str, Any]:
     """Wire bytes → the state dict engine.import_session consumes.
-    Raises TransportError/TransportChecksumError on a bad payload."""
+    Raises TransportError/TransportChecksumError on a bad payload.
+    v1 frames decode as f32 with no scales."""
     _, meta, arrays = _decode_frame(blob, expect_kind="session")
     state = dict(meta)
     state["k"] = arrays.get("k")
@@ -206,24 +272,42 @@ def decode_session(blob: bytes) -> Dict[str, Any]:
     if int(state.get("n_pages") or 0) > 0 and state["k"] is None:
         raise TransportError("warm session frame is missing its KV "
                              "page arrays")
+    state["kv_dtype"] = str(meta.get("kv_dtype") or "f32")
+    _check_quant_arrays(state["kv_dtype"], arrays, "session")
+    state["k_scales"] = arrays.get("k_scales")
+    state["v_scales"] = arrays.get("v_scales")
     return state
 
 
 def encode_prefix(tokens: Sequence[int], k: np.ndarray,
-                  v: np.ndarray) -> bytes:
+                  v: np.ndarray,
+                  k_scales: Optional[np.ndarray] = None,
+                  v_scales: Optional[np.ndarray] = None,
+                  kv_dtype: str = "f32") -> bytes:
     """engine.export_prefix output → wire bytes (the fleet prefix
-    store's stored value)."""
-    return _encode_frame("prefix", {"tokens": [int(t) for t in tokens]},
-                         [("k", k), ("v", v)])
+    store's stored value). Quantized prefixes ship their scale arrays
+    beside the narrow pages."""
+    arrays: List[Tuple[str, np.ndarray]] = [("k", k), ("v", v)]
+    if k_scales is not None:
+        arrays += [("k_scales", k_scales), ("v_scales", v_scales)]
+    return _encode_frame(
+        "prefix", {"tokens": [int(t) for t in tokens],
+                   "kv_dtype": str(kv_dtype or "f32")}, arrays)
 
 
-def decode_prefix(blob: bytes
-                  ) -> Tuple[List[int], np.ndarray, np.ndarray]:
+def decode_prefix(blob: bytes) -> Dict[str, Any]:
+    """Wire bytes → {tokens, k, v, k_scales, v_scales, kv_dtype}
+    (scales None / kv_dtype "f32" for v1 and f32 frames)."""
     _, meta, arrays = _decode_frame(blob, expect_kind="prefix")
     if "k" not in arrays or "v" not in arrays:
         raise TransportError("prefix frame is missing its KV arrays")
-    return ([int(t) for t in meta.get("tokens") or []],
-            arrays["k"], arrays["v"])
+    kind = str(meta.get("kv_dtype") or "f32")
+    _check_quant_arrays(kind, arrays, "prefix")
+    return {"tokens": [int(t) for t in meta.get("tokens") or []],
+            "k": arrays["k"], "v": arrays["v"],
+            "k_scales": arrays.get("k_scales"),
+            "v_scales": arrays.get("v_scales"),
+            "kv_dtype": kind}
 
 
 def to_b64(blob: bytes) -> str:
@@ -408,7 +492,8 @@ __all__ = [
     "TransportError", "TransportChecksumError", "TransportConfig",
     "FleetPrefixStore", "transport_metrics",
     "encode_session", "decode_session", "encode_prefix",
-    "decode_prefix", "to_b64", "from_b64", "prompt_char_len",
-    "WIRE_VERSION", "MAGIC",
+    "decode_prefix", "ship_kind_compatible", "to_b64", "from_b64",
+    "prompt_char_len",
+    "WIRE_VERSION", "SUPPORTED_WIRE_VERSIONS", "MAGIC",
     "ROLE_PREFILL", "ROLE_DECODE", "ROLE_MIXED", "REPLICA_ROLES",
 ]

@@ -116,19 +116,26 @@ def test_steady_state_decode_zero_transfers_zero_compiles(
     assert anomaly["anomalies_total"] == 0      # steady state IS steady
 
 
+@pytest.mark.parametrize("kv_dtype", ["f32", "int8", "fp8"])
 @pytest.mark.parametrize("sp", [
     {},                                                  # greedy
     {"temperature": 0.8, "top_k": 20, "top_p": 0.9},     # sampled
 ], ids=["greedy", "sampled"])
-def test_steady_state_decode_offload_engine_clean(sp):
+def test_steady_state_decode_offload_engine_clean(sp, kv_dtype):
     """ISSUE 10: the KV memory hierarchy lives entirely on the
     structural path. An offload-ENABLED engine whose host tier has
     already been exercised — one victim spilled (async d2h page
     gather) and restored (h2d page scatter) before the window — still
     runs 32 steady-state decode ticks at 0 h2d transfers / 0 compiles
     / 1 dispatch per tick: spill/restore ride drained structural
-    events exactly like admission uploads, never the decode loop."""
-    eng = _engine(enable_kv_offload=True, async_readback=True)
+    events exactly like admission uploads, never the decode loop.
+
+    Parametrized over kv_dtype (ISSUE 16): quantized pools thread two
+    extra scale arrays through every decode/spill/restore program, and
+    quantize-at-append rides the SAME single dispatch — the narrow
+    pages must not cost a tick, a transfer, or a compile."""
+    eng = _engine(enable_kv_offload=True, async_readback=True,
+                  kv_dtype=kv_dtype)
     rng = np.random.default_rng(5)
     for i in range(3):
         eng.add_request(Request(

@@ -885,6 +885,16 @@ def test_engine_hot_path_has_zero_baselined_findings():
         assert proc.returncode == 0, (
             f"jaxlint findings in {fname} (zero-entry module):\n"
             + proc.stdout)
+    # ISSUE 16: the quantization layer (page quantizer + EQuARX-style
+    # collectives) sits on the dispatch hot path — zero baseline, any
+    # finding is a real bug
+    for fname in ("kv_quant.py", "quantized_collectives.py"):
+        path = REPO / "ray_tpu/ops" / fname
+        assert path.exists(), fname
+        proc = _cli(f"ray_tpu/ops/{fname}")
+        assert proc.returncode == 0, (
+            f"jaxlint findings in {fname} (zero-entry module):\n"
+            + proc.stdout)
 
 
 def test_serve_llm_fleet_has_zero_baselined_findings():

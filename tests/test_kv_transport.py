@@ -251,10 +251,14 @@ def test_wire_prefix_roundtrip():
     k = rng.standard_normal((2, 3, 8, 2, 4)).astype(np.float32)
     v = rng.standard_normal((2, 3, 8, 2, 4)).astype(np.float32)
     toks = list(range(2, 26))
-    t2, k2, v2 = kvt.decode_prefix(kvt.encode_prefix(toks, k, v))
-    assert t2 == toks
-    assert k2.tobytes() == k.tobytes()
-    assert v2.tobytes() == v.tobytes()
+    pfx = kvt.decode_prefix(kvt.encode_prefix(toks, k, v))
+    assert pfx["tokens"] == toks
+    assert pfx["k"].tobytes() == k.tobytes()
+    assert pfx["v"].tobytes() == v.tobytes()
+    # an f32 frame (and any decoded v1 frame) resolves to kind f32
+    # with no scale arrays
+    assert pfx["kv_dtype"] == "f32"
+    assert pfx["k_scales"] is None and pfx["v_scales"] is None
 
 
 def test_wire_rejects_corruption():
@@ -426,8 +430,9 @@ def test_engine_prefix_export_import_hits_and_is_exact():
     _run(a)
     exp = a.export_prefix(sys_prefix)
     assert exp is not None and exp["k"].shape[1] == 4
-    toks, k, v = kvt.decode_prefix(kvt.encode_prefix(
+    pfx = kvt.decode_prefix(kvt.encode_prefix(
         exp["tokens"], exp["k"], exp["v"]))
+    toks, k, v = pfx["tokens"], pfx["k"], pfx["v"]
 
     b = _engine()
     assert b.import_prefix(toks, k, v) == 4
