@@ -54,6 +54,30 @@ print("PROBE_OK", d.platform, getattr(d, "device_kind", str(d)), flush=True)
 """
 
 
+def _mesh_arg() -> str:
+    """`--mesh DxT` (e.g. `--mesh 1x2`): run the serving bench on a
+    tp-sharded engine (ISSUE 17). Forwarded to the watchdogged inner
+    subprocess via RAY_TPU_BENCH_MESH; empty = single-chip engine."""
+    if "--mesh" in sys.argv:
+        i = sys.argv.index("--mesh")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--mesh needs a value, e.g. --mesh 1x2")
+        return sys.argv[i + 1]
+    return os.environ.get("RAY_TPU_BENCH_MESH", "")
+
+
+def _mesh_chips(text: str) -> int:
+    """Device count for a mesh text, without importing jax (the
+    orchestrator must not touch the backend; the inner run validates
+    properly via ops.tp_mesh.parse_mesh_shape)."""
+    dims = [int(p) for p in text.replace("x", ",").split(",")
+            if p.strip()]
+    out = 1
+    for d in dims:
+        out *= max(d, 1)
+    return max(out, 1)
+
+
 def peak_for(device_kind: str) -> float:
     name = (device_kind or "").lower()
     for key, val in PEAK_FLOPS.items():
@@ -221,9 +245,20 @@ def _serving_mfu_bench(on_tpu: bool) -> dict:
         else:
             cfg = llama_models.config("debug")
             batch, prompt_len, gen = 4, 16, 24
+        # --mesh (ISSUE 17): shard the whole engine tp-wise across a
+        # named mesh; the perf accountant divides the analytic
+        # envelope by the mesh size, so mfu below stays PER CHIP
+        mesh_text = os.environ.get("RAY_TPU_BENCH_MESH", "")
+        ekw = {}
+        if mesh_text:
+            from ray_tpu.ops.tp_mesh import parse_mesh_shape
+            shape = parse_mesh_shape(mesh_text)
+            if shape[0] * shape[1] > 1:
+                ekw["mesh_shape"] = shape
+                ekw["unified_step"] = True
         eng = InferenceEngine(EngineConfig(
             model=cfg, max_batch_size=batch,
-            num_pages=max(256, batch * 32), page_size=16))
+            num_pages=max(256, batch * 32), page_size=16, **ekw))
         rng = np.random.default_rng(0)
         reqs = [Request(f"s{i}",
                         rng.integers(1, cfg.vocab_size,
@@ -241,12 +276,17 @@ def _serving_mfu_bench(on_tpu: bool) -> dict:
             steps += 1
         perf = eng.stats()["perf"]
         return {
+            # per-chip: the accountant's envelope is peak × n_chips
             "mfu": perf["mfu"],
             "mbu": perf["mbu"],
             "roof": perf["roof"],
             "envelope": perf["envelope"],
             "n_chips": perf["n_chips"],
+            "mesh": mesh_text or None,
             "decode_tokens_per_s": perf["decode_tokens_per_s"],
+            "decode_tokens_per_s_per_chip": round(
+                perf["decode_tokens_per_s"]
+                / max(perf["n_chips"], 1), 3),
             "params": cfg.num_params(),
             "batch": batch,
             "vs_target_0.40": round(perf["mfu"] / 0.40, 4),
@@ -259,10 +299,20 @@ def main() -> None:
     platform, kind = probe_backend()  # exits with a "skipped" line on outage
     sys.stderr.write(
         f"backend probe ok: platform={platform} kind={kind or '?'}\n")
+    env = dict(os.environ)
+    mesh = _mesh_arg()
+    if mesh:
+        env["RAY_TPU_BENCH_MESH"] = mesh
+        if platform == "cpu":
+            # emulate the mesh on host devices so --mesh 1x2 is
+            # testable without a pod (same trick as the tier-1 suite)
+            from ray_tpu._private.cpu_mesh import apply_cpu_mesh_env
+            apply_cpu_mesh_env(env, _mesh_chips(mesh))
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--inner"],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S)
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S,
+            env=env)
     except subprocess.TimeoutExpired:
         _skip("tpu_unreachable",
               f"bench hung >{BENCH_TIMEOUT_S}s after a good probe "

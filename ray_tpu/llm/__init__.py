@@ -234,7 +234,65 @@ BENCH_CORE.md "Quantized serving anatomy"):
                                             quantized allreduce/allgather
                                             helpers (ops/quantized_collectives)
                                             for the tp mesh, tolerance-gated
-                                            vs f32 in tests/test_kv_quant.py
+                                            vs f32 in tests/test_kv_quant.py.
+                                            On the explicit mesh_shape= path
+                                            (ISSUE 17) it also routes the
+                                            row-parallel lm_head's (B, V)
+                                            partial-logits psum — the dominant
+                                            per-tick collective payload —
+                                            through quantized_psum; per-layer
+                                            residual psums stay exact f32
+
+ISSUE 17 pod-scale data plane (tp-sharded engine replicas on named
+meshes, slice-aware fleet placement; details: BENCH_CORE.md
+"Pod-scale serving anatomy"):
+
+    config knob (EngineConfig)              notes
+    mesh_shape=(1, tp)                      shard the WHOLE serving engine —
+                                            not just the kernel — across a
+                                            named (data, tp) 2D mesh: params
+                                            land in the Megatron layout
+                                            (column-parallel wq/wk/wv/wg/wi,
+                                            row-parallel wo/wd + lm_head), KV
+                                            and scale pools shard over kv
+                                            heads along `tp`, page tables and
+                                            sampling state replicate, and the
+                                            unified ragged tick runs as ONE
+                                            shard_map'd collective-bearing
+                                            program — still one dispatch, zero
+                                            h2d, zero recompiles per tick
+                                            (dispatch-guard suite at tp=2).
+                                            The data dim must be 1 (scale
+                                            replicas via the fleet). Mutually
+                                            exclusive with mesh= (the GSPMD
+                                            MeshSpec path); rejects pp,
+                                            speculative, multi-step decode,
+                                            MoE and LoRA. Session export/
+                                            import and spill/restore stay on
+                                            the topology-free wire format, so
+                                            sessions move tp=2 <-> tp=1
+                                            token-exact.
+    tp_axis="tp"                            the named tp mesh axis (rename if
+                                            an outer program owns "tp")
+
+    fleet field                             notes
+    FleetConfig.slice_shape=(1, 2)          every replica IS one slice: the
+                                            deployment builder injects
+                                            mesh_shape into each replica's
+                                            engine_kwargs, so a scale-up
+                                            provisions a whole 2-chip slice
+    stats()["chips"] / /fleet row "chips"   chips behind each replica's mesh
+                                            (ReplicaSnapshot.chips); the
+                                            /fleet autoscale block adds
+                                            chips_per_slice + active_chips,
+                                            and autoscaler decisions carry
+                                            active_chips/target_chips
+    stats()["perf"].mfu / fleet mfu         PER-CHIP: the perf accountant's
+                                            envelope is peak x n_chips, so
+                                            the 0.40 serving-MFU target reads
+                                            per chip at any slice size
+                                            (bench.py --mesh 1x2 reports the
+                                            same per-chip framing)
 
     ray_tpu_llm_kv_device_bytes_used        gauge      device HBM bytes in used
                                                        KV pages, from the
@@ -300,10 +358,16 @@ def build_llm_deployment(llm_config: LLMConfig):
         opts = dict(dep_cfg.get("ray_actor_options") or {})
         # chips follow the engine mesh: a tp x pp engine needs tp*pp
         # chips on its replica (reference sizes vLLM worker placement
-        # the same way, vllm_models.py:123-139)
-        mesh = (llm_config.engine_kwargs or {}).get("mesh")
+        # the same way, vllm_models.py:123-139). Explicit-tp slices
+        # (engine_kwargs.mesh_shape, ISSUE 17) size the same way:
+        # a (1, tp) slice reserves tp chips.
+        ekw = llm_config.engine_kwargs or {}
+        mesh = ekw.get("mesh")
+        mesh_shape = ekw.get("mesh_shape")
         chips = 1
-        if mesh is not None:
+        if mesh_shape is not None:
+            chips = max(1, int(mesh_shape[0]) * int(mesh_shape[1]))
+        elif mesh is not None:
             sizes = (mesh if isinstance(mesh, dict)
                      else {"tp": getattr(mesh, "tp", 1),
                            "pp": getattr(mesh, "pp", 1)})

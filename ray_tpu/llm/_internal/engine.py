@@ -46,6 +46,7 @@ import numpy as np
 from ...models import llama
 from ...models.llama import LlamaConfig
 from ...models.llama_infer import decode_step, prefill
+from ...ops.jax_compat import shard_map_compat as _shard_map
 from .kv_cache import PageAllocator
 from .telemetry import EngineTelemetry
 
@@ -91,6 +92,22 @@ class EngineConfig:
     # prefill/decode jit over the whole mesh (the reference reaches TP
     # only by placing external vLLM workers, vllm_models.py:123-159).
     mesh: Any = None
+    # Explicit-tp serving on a NAMED 2D mesh (ISSUE 17 / ROADMAP 4):
+    # mesh_shape=(1, tp) builds a (data, tp_axis) Mesh via
+    # ops/tp_mesh.build_serving_mesh and the whole unified tick runs as
+    # ONE shard_map'd collective-bearing program — params in the
+    # Megatron layout (llama_infer.tp_param_specs), KV/scale pools
+    # sharded over kv heads, page tables and sampling state replicated,
+    # per-layer residual psums in _layer_body, and the row-parallel
+    # lm_head's partial logits all-reduced (through
+    # ops/quantized_collectives when quantized_collectives=True).
+    # Mutually exclusive with mesh= (the GSPMD auto-partitioning path);
+    # requires unified_step and rejects pp/speculative/multi-step/LoRA.
+    # Donation, _read_tokens, async readback, and spill/restore keep
+    # the single-dispatch discipline, so the dispatch guard holds at
+    # tp>1 (tested on the virtual CPU mesh).
+    mesh_shape: Optional[tuple] = None
+    tp_axis: str = "tp"
     # Multi-LoRA capacity: adapter stacks are padded to this many slots
     # so registering adapters never changes compiled shapes (one
     # recompile when the FIRST adapter arrives, none after).
@@ -244,10 +261,13 @@ class EngineConfig:
     # (their stage/draft pools stay f32).
     kv_dtype: str = "f32"
     # EQuARX-style quantized tp collectives (ops/quantized_collectives):
-    # expose int8 psum/all_gather for mesh programs that opt in. The
-    # llama serving path is GSPMD-partitioned (no explicit collectives
-    # to swap), so this knob only arms the ops-layer helpers; they are
-    # tolerance-gated vs the f32 collectives in tests.
+    # expose int8 psum/all_gather for mesh programs that opt in. On the
+    # GSPMD mesh= path there are no explicit collectives to swap, so
+    # there this knob only arms the ops-layer helpers; on the explicit
+    # mesh_shape= path it routes the row-parallel lm_head's (B, V)
+    # partial-logits all-reduce — the dominant collective payload —
+    # through quantized_psum (per-layer residual psums stay exact f32
+    # so pool contents never compound quantization error).
     quantized_collectives: bool = False
     # Optimistic admission (ISSUE 10): None keeps the worst-case
     # prompt+max_tokens reservation. An int W shrinks the reservation
@@ -487,7 +507,54 @@ class InferenceEngine:
         self.model_cfg = config.resolve_model()
         self.max_seq = config.max_seq_len or self.model_cfg.max_seq
         cfg, ec = self.model_cfg, config
-        self.mesh, self.stages = self._build_placement(ec.mesh, cfg)
+        # explicit-tp state (EngineConfig.mesh_shape): defaults cover
+        # every other placement mode so the compiled-program builders
+        # can branch on it unconditionally
+        self._explicit_tp = False
+        self._tp = 1
+        self._tp_axis = "tp"
+        self._tp_local_cfg = None
+        self._tp_specs = None
+        self._tp_logits_psum = None
+        if ec.mesh_shape is not None:
+            if ec.mesh is not None:
+                raise ValueError(
+                    "mesh_shape (explicit shard_map tp) and mesh "
+                    "(GSPMD MeshSpec) are mutually exclusive")
+            from ...models import llama_infer
+            from ...ops import tp_mesh as _tpm
+            named = _tpm.build_serving_mesh(ec.mesh_shape,
+                                            tp_axis=ec.tp_axis)
+            tp = int(named.shape[ec.tp_axis])
+            if tp > 1:
+                if ec.speculative:
+                    raise ValueError(
+                        "mesh_shape does not compose with speculative "
+                        "decoding (the draft has no explicit-tp path)")
+                if int(ec.decode_steps_per_call or 1) > 1:
+                    raise ValueError(
+                        "mesh_shape does not compose with "
+                        "decode_steps_per_call > 1")
+                if not ec.unified_step:
+                    raise ValueError(
+                        "mesh_shape requires unified_step=True: the "
+                        "legacy prefill programs have no shard_map "
+                        "path")
+                self._explicit_tp = True
+                self._tp = tp
+                self._tp_axis = ec.tp_axis
+                # raises for MoE / non-divisible head, hidden, ffn dims
+                self._tp_local_cfg = llama_infer.tp_local_config(cfg, tp)
+                self._tp_specs = llama_infer.tp_param_specs(
+                    cfg, ec.tp_axis)
+                self._tp_logits_psum = _tpm.logits_psum_fn(
+                    "int8" if ec.quantized_collectives else "f32")
+                self.mesh, self.stages = named, None
+            else:
+                # (1, 1): a single-chip slice is just the plain engine
+                self.mesh, self.stages = None, None
+        else:
+            self.mesh, self.stages = self._build_placement(ec.mesh, cfg)
         self.pp = len(self.stages) if self.stages else 1
         if self.pp > 1:
             import logging
@@ -506,13 +573,31 @@ class InferenceEngine:
             # (pp stages split host-side below, so they load unsharded)
             params = checkpoint_io.load_llama_params(
                 cfg, ec.checkpoint,
-                mesh=self.mesh if self.pp == 1 else None)
+                mesh=(self.mesh if self.pp == 1
+                      and not self._explicit_tp else None))
         elif params is None:
             params = llama.init_params(cfg, jax.random.PRNGKey(ec.seed))
         if self.pp > 1:
             self.params = None
             self.stage_params = self._split_stage_params(params, cfg)
             self._kv_sharding = self._repl = None
+        elif self._explicit_tp:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _place(tree, spec_tree):
+                if isinstance(tree, dict):
+                    return {k: _place(v, spec_tree[k])
+                            for k, v in tree.items()}
+                return jax.device_put(
+                    tree, NamedSharding(self.mesh, spec_tree))
+
+            # one-time Megatron-layout placement: these shardings are
+            # ALSO the shard_map in_specs, so dispatch never reshards
+            self.params = _place(params, self._tp_specs)
+            self._kv_sharding = NamedSharding(
+                self.mesh,
+                PartitionSpec(None, None, None, self._tp_axis, None))
+            self._repl = NamedSharding(self.mesh, PartitionSpec())
         elif self.mesh is not None:
             from ...parallel.sharding import shard_tree
             self.params = shard_tree(
@@ -655,7 +740,7 @@ class InferenceEngine:
                 from jax.sharding import NamedSharding, PartitionSpec
                 self._scale_sharding = NamedSharding(
                     self.mesh,
-                    PartitionSpec(None, None, None, "tp"))
+                    PartitionSpec(None, None, None, self._tp_axis))
             sc_shape = kv_quant.scale_shape(kv_shape)
             self.k_scales = self._dev(jnp.zeros(sc_shape, jnp.float32),
                                       self._scale_sharding)
@@ -807,20 +892,23 @@ class InferenceEngine:
         # pending PerfSample and step() commits it with the tick wall.
         from .perfmodel import (CostModel, PerfAccountant,
                                 detect_envelope)
+        # chips this replica occupies — the fleet's slice-accounting
+        # unit (ReplicaSnapshot.chips, /fleet rows) AND the perf
+        # accountant's per-chip MFU/MBU divisor
+        if self.pp > 1:
+            self.n_chips = sum(
+                (int(st.mesh.devices.size) if st.mesh is not None
+                 else 1) for st in self.stages)
+        elif self.mesh is not None:
+            self.n_chips = int(self.mesh.devices.size)
+        else:
+            self.n_chips = 1
         self.perf: Optional[PerfAccountant] = None
         if ec.enable_perf_accounting:
-            if self.pp > 1:
-                n_chips = sum(
-                    (int(st.mesh.devices.size) if st.mesh is not None
-                     else 1) for st in self.stages)
-            elif self.mesh is not None:
-                n_chips = int(self.mesh.devices.size)
-            else:
-                n_chips = 1
             self.perf = PerfAccountant(
                 CostModel(cfg, ec.page_size, kv_dtype=self._kv_kind),
                 detect_envelope(name=ec.perf_envelope),
-                n_chips=n_chips)
+                n_chips=self.n_chips)
             if self._spec is not None:
                 # draft-model costs accounted against their own config
                 self._spec["cost_model"] = CostModel(
@@ -960,16 +1048,24 @@ class InferenceEngine:
         impl = self._resolve_impl()
         mesh = self.mesh
         kind = self._kv_kind
+        # explicit tp: the forward runs INSIDE a shard_map (shard-local
+        # cfg, no inner mesh, collectives via psum_axis/logits_psum)
+        tp = self._tp if self._explicit_tp else 1
+        cfg_fwd = self._tp_local_cfg if tp > 1 else cfg
+        mesh_fwd = None if tp > 1 else mesh
+        tp_kw = ({"psum_axis": self._tp_axis,
+                  "logits_psum": self._tp_logits_psum}
+                 if tp > 1 else {})
 
         def core(params, k_pages, v_pages, k_scales, v_scales, seen,
                  tokens, positions, page_tables, active, key, temps,
                  top_ps, top_ks, rep_pens, seeds, lora, lora_idx,
                  all_greedy):
             out = decode_step(
-                cfg, params, tokens, positions, k_pages, v_pages,
-                page_tables, active, impl=impl, mesh=mesh,
+                cfg_fwd, params, tokens, positions, k_pages, v_pages,
+                page_tables, active, impl=impl, mesh=mesh_fwd,
                 lora=lora, lora_idx=lora_idx, kv_kind=kind,
-                k_scales=k_scales, v_scales=v_scales)
+                k_scales=k_scales, v_scales=v_scales, **tp_kw)
             if kind != "f32":
                 logits, k_pages, v_pages, k_scales, v_scales = out
             else:
@@ -992,6 +1088,70 @@ class InferenceEngine:
             seen = seen.at[jnp.arange(b), new_tokens].max(active)
             return (new_tokens, k_pages, v_pages, k_scales, v_scales,
                     seen)
+
+        if tp > 1:
+            # ONE shard_map'd program per decode tick: outer signatures
+            # (and donate/static argnums at the jit sites) are
+            # IDENTICAL to the single-device path so _decode and the
+            # dispatch-guard discipline don't change at tp>1. Sampling
+            # runs inside the shard_map on the psum'd full logits —
+            # replicated on every shard, so out_specs P() is exact.
+            from jax.sharding import PartitionSpec as P
+            kvs = P(None, None, None, self._tp_axis, None)
+            scs = P(None, None, None, self._tp_axis)
+            rep = P()
+            pspec = self._tp_specs
+
+            if kind != "f32":
+                def step_q(params, k_pages, v_pages, k_scales,
+                           v_scales, seen, tokens, positions,
+                           page_tables, active, key, temps, top_ps,
+                           top_ks, rep_pens, seeds, lora, lora_idx,
+                           all_greedy):
+                    # explicit-tp engines serve no adapters (gated at
+                    # register_loras): lora/lora_idx stay in the outer
+                    # signature but never enter the shard_map
+                    def local(params, k_pages, v_pages, k_scales,
+                              v_scales, seen, tokens, positions,
+                              page_tables, active, key, temps, top_ps,
+                              top_ks, rep_pens, seeds):
+                        return core(params, k_pages, v_pages, k_scales,
+                                    v_scales, seen, tokens, positions,
+                                    page_tables, active, key, temps,
+                                    top_ps, top_ks, rep_pens, seeds,
+                                    None, None, all_greedy)
+                    sm = _shard_map(
+                        local, mesh,
+                        in_specs=(pspec, kvs, kvs, scs, scs)
+                        + (rep,) * 11,
+                        out_specs=(rep, kvs, kvs, scs, scs, rep))
+                    return sm(params, k_pages, v_pages, k_scales,
+                              v_scales, seen, tokens, positions,
+                              page_tables, active, key, temps, top_ps,
+                              top_ks, rep_pens, seeds)
+                return step_q
+
+            def step(params, k_pages, v_pages, seen, tokens,
+                     positions, page_tables, active, key, temps,
+                     top_ps, top_ks, rep_pens, seeds, lora, lora_idx,
+                     all_greedy):
+                def local(params, k_pages, v_pages, seen, tokens,
+                          positions, page_tables, active, key, temps,
+                          top_ps, top_ks, rep_pens, seeds):
+                    toks, k_pages, v_pages, _, _, seen = core(
+                        params, k_pages, v_pages, None, None, seen,
+                        tokens, positions, page_tables, active, key,
+                        temps, top_ps, top_ks, rep_pens, seeds, None,
+                        None, all_greedy)
+                    return toks, k_pages, v_pages, seen
+                sm = _shard_map(
+                    local, mesh,
+                    in_specs=(pspec, kvs, kvs) + (rep,) * 11,
+                    out_specs=(rep, kvs, kvs, rep))
+                return sm(params, k_pages, v_pages, seen, tokens,
+                          positions, page_tables, active, key, temps,
+                          top_ps, top_ks, rep_pens, seeds)
+            return step
 
         if kind != "f32":
             def step_q(params, k_pages, v_pages, k_scales, v_scales,
@@ -1209,6 +1369,14 @@ class InferenceEngine:
             from ...models.llama_infer import ragged_forward
 
             kind = self._kv_kind
+            # explicit tp: the forward runs INSIDE a shard_map (shard-
+            # local cfg, no inner mesh, collectives via psum_axis)
+            tp = self._tp if self._explicit_tp else 1
+            cfg_fwd = self._tp_local_cfg if tp > 1 else cfg
+            mesh_fwd = None if tp > 1 else mesh
+            tp_kw = ({"psum_axis": self._tp_axis,
+                      "logits_psum": self._tp_logits_psum}
+                     if tp > 1 else {})
 
             def core(params, k_pages, v_pages, k_scales, v_scales,
                      seen, tok_meta, slot_meta, samp, page_tables,
@@ -1223,12 +1391,12 @@ class InferenceEngine:
                 temps, top_ps, rep_pens = samp[0], samp[1], samp[3]
                 top_ks = samp[2].astype(jnp.int32)
                 out = ragged_forward(
-                    cfg, params, tokens, slot_ids, positions, valid,
-                    start, last_idx, k_pages, v_pages, page_tables,
-                    ctx_pages=ctx_pages, lora=lora, lora_idx=lora_idx,
-                    impl=impl, mesh=mesh, max_seg_len=max_seg,
-                    kv_kind=kind, k_scales=k_scales,
-                    v_scales=v_scales)
+                    cfg_fwd, params, tokens, slot_ids, positions,
+                    valid, start, last_idx, k_pages, v_pages,
+                    page_tables, ctx_pages=ctx_pages, lora=lora,
+                    lora_idx=lora_idx, impl=impl, mesh=mesh_fwd,
+                    max_seg_len=max_seg, kv_kind=kind,
+                    k_scales=k_scales, v_scales=v_scales, **tp_kw)
                 if kind != "f32":
                     logits, k_pages, v_pages, k_scales, v_scales = out
                 else:
@@ -1257,7 +1425,68 @@ class InferenceEngine:
                 seen = seen.at[jnp.arange(b), toks].max(emit)
                 return toks, k_pages, v_pages, k_scales, v_scales, seen
 
-            if kind != "f32":
+            if tp > 1:
+                # ONE shard_map'd collective-bearing program per tick:
+                # outer signatures (and donate/static argnums below)
+                # stay IDENTICAL to the single-device path so
+                # _ragged_step and the dispatch-guard discipline don't
+                # change at tp>1. Sampling runs inside the shard_map
+                # on the psum'd full logits — replicated on every
+                # shard, so out_specs P() is exact. lora never enters
+                # the shard_map (gated at register_loras).
+                from jax.sharding import PartitionSpec as P
+                kvs = P(None, None, None, self._tp_axis, None)
+                scs = P(None, None, None, self._tp_axis)
+                rep = P()
+                pspec = self._tp_specs
+
+                if kind != "f32":
+                    def run_q(params, k_pages, v_pages, k_scales,
+                              v_scales, seen, tok_meta, slot_meta,
+                              samp, page_tables, key, lora,
+                              all_greedy):
+                        def local(params, k_pages, v_pages, k_scales,
+                                  v_scales, seen, tok_meta, slot_meta,
+                                  samp, page_tables, key):
+                            return core(params, k_pages, v_pages,
+                                        k_scales, v_scales, seen,
+                                        tok_meta, slot_meta, samp,
+                                        page_tables, key, None,
+                                        all_greedy)
+                        sm = _shard_map(
+                            local, mesh,
+                            in_specs=(pspec, kvs, kvs, scs, scs)
+                            + (rep,) * 6,
+                            out_specs=(rep, kvs, kvs, scs, scs, rep))
+                        return sm(params, k_pages, v_pages, k_scales,
+                                  v_scales, seen, tok_meta, slot_meta,
+                                  samp, page_tables, key)
+                    fn = jax.jit(run_q,
+                                 donate_argnums=(1, 2, 3, 4, 5),
+                                 static_argnums=(12,))
+                else:
+                    def run(params, k_pages, v_pages, seen, tok_meta,
+                            slot_meta, samp, page_tables, key, lora,
+                            all_greedy):
+                        def local(params, k_pages, v_pages, seen,
+                                  tok_meta, slot_meta, samp,
+                                  page_tables, key):
+                            toks, k_pages, v_pages, _, _, seen = core(
+                                params, k_pages, v_pages, None, None,
+                                seen, tok_meta, slot_meta, samp,
+                                page_tables, key, None, all_greedy)
+                            return toks, k_pages, v_pages, seen
+                        sm = _shard_map(
+                            local, mesh,
+                            in_specs=(pspec, kvs, kvs) + (rep,) * 6,
+                            out_specs=(rep, kvs, kvs, rep))
+                        return sm(params, k_pages, v_pages, seen,
+                                  tok_meta, slot_meta, samp,
+                                  page_tables, key)
+
+                    fn = jax.jit(run, donate_argnums=(1, 2, 3),
+                                 static_argnums=(10,))
+            elif kind != "f32":
                 def run_q(params, k_pages, v_pages, k_scales, v_scales,
                           seen, tok_meta, slot_meta, samp, page_tables,
                           key, lora, all_greedy):
@@ -3240,6 +3469,12 @@ class InferenceEngine:
                 "multi-LoRA is not supported with speculative decoding "
                 "(the draft/verify programs run base weights; a greedy "
                 "adapter request would silently lose its adapter)")
+        if self._explicit_tp:
+            raise NotImplementedError(
+                "multi-LoRA is not supported on explicit-tp "
+                "(mesh_shape) engines: adapter stacks have no "
+                "Megatron-sharded layout, so the shard_map'd tick "
+                "never sees them; use the GSPMD mesh= path for LoRA")
         valid = {"wq", "wk", "wv", "wo"}
         new_raw = dict(self._lora_raw)
         for name, adapters in mapping.items():
@@ -4625,6 +4860,10 @@ class InferenceEngine:
             "dispatches": self.dispatches,
             "dispatches_per_step": round(
                 self.dispatches / max(self.ticks, 1), 3),
+            # slice topology (ISSUE 17): chips this replica occupies
+            # (mesh size; 1 off-mesh) — the fleet's slice-accounting
+            # unit, and the divisor behind the per-chip perf block
+            "chips": self.n_chips,
             # KV memory hierarchy (ISSUE 10): parked sessions, demand
             # over the device pool (>1 = oversubscribed), preemptions
             # by reason; the host-tier block (spills/restores/host

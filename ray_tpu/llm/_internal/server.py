@@ -784,6 +784,10 @@ class LLMServerImpl:
         return {
             "replica": self.replica_id,
             "model": self.model_id,
+            # slice topology (ISSUE 17): chips this replica's engine
+            # mesh occupies — the fleet's slice-accounting unit
+            # (ReplicaSnapshot.chips, /fleet rows, autoscaler sizing)
+            "chips": getattr(eng, "n_chips", 1),
             "active": eng.num_active(),
             "waiting": len(eng.waiting),
             "kv_occupancy": (used / alloc.num_usable

@@ -13,11 +13,13 @@ Two entry points, both designed to jit once and stay compiled:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
 from ..ops.attention import attention as attention_op
 # shard_map version shim: ONE shared implementation (ops/jax_compat)
@@ -59,12 +61,19 @@ def _rope_seq(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------- layer body
 
 def _layer_body(cfg: LlamaConfig, dt, x, layer, lora_l, lora_idx,
-                lead_shape: tuple, rope_fn, attn_fn):
+                lead_shape: tuple, rope_fn, attn_fn,
+                psum_axis: Optional[str] = None):
     """ONE transformer layer, shared by every inference path (prefill,
     chunked prefill, ragged step, decode) — the paths differ only in
     the leading activation shape, the rope application, and the
     attention call. Returns (x, (k, v)) with k/v rope'd, ready for the
-    KV scatter."""
+    KV scatter.
+
+    psum_axis: inside an explicit-tp shard_map (Megatron layout:
+    wq/wk/wv/wg/wi column-parallel, wo/wd row-parallel, cfg a shard-
+    local view with n_heads/n_kv_heads divided by tp) the two residual
+    projections produce PARTIAL sums — all-reduce them over the named
+    axis before the residual add so activations stay replicated."""
     y = rms_norm(x, layer["ln1"], cfg.norm_eps)
     q = _proj(y, layer["wq"], lora_l, "wq", lora_idx, dt).reshape(
         *lead_shape, cfg.n_heads, cfg.head_dim)
@@ -75,13 +84,81 @@ def _layer_body(cfg: LlamaConfig, dt, x, layer, lora_l, lora_idx,
     q = rope_fn(q)
     k = rope_fn(k)
     attn = attn_fn(q, k, v)
-    x = x + _proj(attn.reshape(*lead_shape, cfg.q_dim), layer["wo"],
-                  lora_l, "wo", lora_idx, dt)
+    attn_out = _proj(attn.reshape(*lead_shape, cfg.q_dim), layer["wo"],
+                     lora_l, "wo", lora_idx, dt)
+    if psum_axis is not None:
+        attn_out = jax.lax.psum(attn_out, psum_axis)
+    x = x + attn_out
     y = rms_norm(x, layer["ln2"], cfg.norm_eps)
     gate = jax.nn.silu(y @ layer["wg"].astype(dt))
     up = y @ layer["wi"].astype(dt)
-    x = x + (gate * up) @ layer["wd"].astype(dt)
+    mlp_out = (gate * up) @ layer["wd"].astype(dt)
+    if psum_axis is not None:
+        mlp_out = jax.lax.psum(mlp_out, psum_axis)
+    x = x + mlp_out
     return x, (k, v)
+
+
+# ----------------------------------------------------- explicit tp (shard_map)
+
+def tp_local_config(cfg: LlamaConfig, tp: int) -> LlamaConfig:
+    """Shard-local view of *cfg* for the explicit-tp forwards: inside
+    the engine's shard_map each shard sees 1/tp of the heads, so the
+    reshape arithmetic in _layer_body must use divided head counts
+    (q_dim follows automatically — it is a property of n_heads)."""
+    if tp <= 1:
+        return cfg
+    if cfg.n_experts:
+        raise ValueError("explicit tp (mesh_shape) does not support MoE "
+                         "models; use the GSPMD mesh= path")
+    for name, dim in (("n_heads", cfg.n_heads),
+                      ("n_kv_heads", cfg.n_kv_heads),
+                      ("hidden", cfg.hidden), ("ffn", cfg.ffn)):
+        if dim % tp:
+            raise ValueError(
+                f"model {name}={dim} not divisible by tp={tp}")
+    return dataclasses.replace(cfg, n_heads=cfg.n_heads // tp,
+                               n_kv_heads=cfg.n_kv_heads // tp)
+
+
+def tp_param_specs(cfg: LlamaConfig, tp_axis: str = "tp"):
+    """PartitionSpec tree for init_params' dense llama tree under the
+    Megatron layout: column-parallel wq/wk/wv/wg/wi (shard the output
+    feature dim), row-parallel wo/wd (shard the input dim, psum in
+    _layer_body), lm_head row-parallel over hidden (psum'd logits in
+    _tp_head_logits), everything norm/embed replicated. Used both for
+    device placement and as shard_map in_specs so dispatch never
+    reshards."""
+    if cfg.n_experts:
+        raise ValueError("explicit tp (mesh_shape) does not support MoE "
+                         "models; use the GSPMD mesh= path")
+    P = PartitionSpec
+    col = P(None, None, tp_axis)
+    row = P(None, tp_axis, None)
+    return {
+        "embed": P(),
+        "layers": {"wq": col, "wk": col, "wv": col, "wg": col,
+                   "wi": col, "wo": row, "wd": row,
+                   "ln1": P(), "ln2": P()},
+        "final_norm": P(),
+        "lm_head": P(tp_axis, None),
+    }
+
+
+def _tp_head_logits(last, lm_head, psum_axis, logits_psum=None):
+    """Row-parallel lm_head: each shard holds an (H/tp, V) slice over
+    hidden. Slice the replicated activations down to the shard's rows,
+    take the partial product, and all-reduce. logits_psum lets the
+    engine route the reduction through ops/quantized_collectives when
+    EngineConfig.quantized_collectives is armed."""
+    h_loc = lm_head.shape[0]
+    shard = jax.lax.axis_index(psum_axis)
+    loc = jax.lax.dynamic_slice_in_dim(last, shard * h_loc, h_loc,
+                                       axis=-1)
+    part = loc.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    if logits_psum is None:
+        return jax.lax.psum(part, psum_axis)
+    return logits_psum(part, psum_axis)
 
 
 # ------------------------------------------------------------------- prefill
@@ -284,8 +361,9 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
                    impl: str = "gather", mesh=None,
                    max_seg_len: int = -1, kv_kind: str = "f32",
                    k_scales: Optional[jax.Array] = None,
-                   v_scales: Optional[jax.Array] = None
-                   ) -> Tuple[jax.Array, ...]:
+                   v_scales: Optional[jax.Array] = None,
+                   psum_axis: Optional[str] = None,
+                   logits_psum=None) -> Tuple[jax.Array, ...]:
     """Unified ragged prefill+decode forward: ONE program per engine
     tick consumes a FLAT token batch where each active slot contributes
     between 1 token (decoding) and C tokens (prefilling), packed by the
@@ -324,6 +402,14 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
     streams scale blocks beside the pages and fuses the dequant
     multiply, and the return grows to (logits, k_pages, v_pages,
     k_scales, v_scales) with the tick's fresh KV quantized at append.
+
+    psum_axis/logits_psum: explicit-tp mode (ISSUE 17) — the CALLER is
+    already inside a shard_map over psum_axis, cfg is the shard-local
+    view (tp_local_config), params/pools are the local shards
+    (tp_param_specs layout), and mesh must be None (no nested
+    shard_map). _layer_body all-reduces the row-parallel residual
+    projections and the lm_head goes row-parallel over hidden with the
+    partial logits reduced via logits_psum (default lax.psum).
 
     Returns (last-token logits per slot (B, V) f32, k_pages, v_pages)
     with every valid token's KV scattered into the pool at its
@@ -407,7 +493,8 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
         return _layer_body(
             cfg, dt, x, layer, lora_l, lora_idx, (t,),
-            lambda a: _rope_single(a, cos, sin), attn_fn)
+            lambda a: _rope_single(a, cos, sin), attn_fn,
+            psum_axis=psum_axis)
 
     scan_xs = (params["layers"], k_by_layer, v_by_layer)
     if kernel_quant:
@@ -427,8 +514,12 @@ def ragged_forward(cfg: LlamaConfig, params: Dict[str, Any],
                                       valid)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = x[last_idx]                                  # (B, H)
-    logits = last.astype(jnp.float32) @ params["lm_head"].astype(
-        jnp.float32)
+    if psum_axis is not None:
+        logits = _tp_head_logits(last, params["lm_head"], psum_axis,
+                                 logits_psum)
+    else:
+        logits = last.astype(jnp.float32) @ params["lm_head"].astype(
+            jnp.float32)
     if quantized:
         return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages
@@ -446,8 +537,9 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
                 hidden: Optional[jax.Array] = None, emit: str = "logits",
                 kv_kind: str = "f32",
                 k_scales: Optional[jax.Array] = None,
-                v_scales: Optional[jax.Array] = None
-                ) -> Tuple[jax.Array, ...]:
+                v_scales: Optional[jax.Array] = None,
+                psum_axis: Optional[str] = None,
+                logits_psum=None) -> Tuple[jax.Array, ...]:
     """One decode step for the whole running batch.
 
     tokens: (B,) last sampled token per slot; positions: (B,) its
@@ -475,6 +567,10 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
     contract as ragged_forward: dequant-on-gather or fused-dequant
     kernel on the read side, quantize-at-append on the write side, and
     a (logits, k_pages, v_pages, k_scales, v_scales) return.
+
+    psum_axis/logits_psum: explicit-tp mode — same contract as
+    ragged_forward (caller already inside the shard_map, shard-local
+    cfg/params/pools, mesh=None).
     """
     b = tokens.shape[0]
     dt = cfg.dtype
@@ -550,7 +646,7 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
 
         return _layer_body(cfg, dt, x, layer, lora_l, lora_idx, (b,),
                            lambda a: _rope_single(a, cos, sin),
-                           attn_fn)
+                           attn_fn, psum_axis=psum_axis)
 
     scan_xs = (params["layers"], k_by_layer, v_by_layer)
     if kernel_quant:
@@ -571,7 +667,12 @@ def decode_step(cfg: LlamaConfig, params: Dict[str, Any],
             return x, k_pages, v_pages, k_scales, v_scales
         return x, k_pages, v_pages
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    if psum_axis is not None:
+        logits = _tp_head_logits(x, params["lm_head"], psum_axis,
+                                 logits_psum)
+    else:
+        logits = x.astype(jnp.float32) @ params["lm_head"].astype(
+            jnp.float32)
     if quantized:
         return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages

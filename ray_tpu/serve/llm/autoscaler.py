@@ -67,6 +67,12 @@ class FleetMetrics:
     # KV page pressure (ISSUE 10): max over active replicas of
     # (device pages used + parked host pages) / usable pages
     page_pressure: float = 0.0
+    # slice topology (ISSUE 17): chips behind each replica (a
+    # tp-sharded engine on mesh_shape=(1, tp) spans tp chips).
+    # Scaling is in whole-slice units: the decision below is still
+    # denominated in replicas, but each +1/-1 provisions or releases
+    # chips_per_slice chips at once.
+    chips_per_slice: int = 1
 
 
 class FleetAutoscaler:
@@ -122,8 +128,14 @@ class FleetAutoscaler:
         else:
             self._above_since = self._below_since = None
         target = max(c.min_replicas, min(c.max_replicas, target))
+        chips = max(int(m.chips_per_slice), 1)
         self.last_decision = {
             "ts": now, "active": active, "target": target,
+            # chip-denominated view of the same decision (ISSUE 17):
+            # one slice = chips_per_slice chips, scaled atomically
+            "chips_per_slice": chips,
+            "active_chips": active * chips,
+            "target_chips": target * chips,
             "ttft_ms": round(m.ttft_ms, 3),
             "queue_wait_ms": round(m.queue_wait_ms, 3),
             "waiting": m.waiting,

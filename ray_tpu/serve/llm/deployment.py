@@ -38,7 +38,7 @@ import asyncio
 import dataclasses
 import math
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .admission import AdmissionConfig, AdmissionRejected
 from .autoscaler import AutoscaleConfig
@@ -102,6 +102,14 @@ class FleetConfig:
     # and only the lane's soak governor (which stops LAUNCHING under
     # load) still protects interactive latency.
     batch_lane: Optional[BatchLaneConfig] = None
+    # slice topology (ISSUE 17 / ROADMAP 4): the fleet's scaling UNIT
+    # is a pod slice, not a chip. slice_shape=(1, tp) makes every
+    # provisioned replica a tp-sharded engine on its own named mesh
+    # (threaded into EngineConfig.mesh_shape), so a scale-up decision
+    # provisions a whole tp-chip slice, /fleet rows report chips per
+    # replica, and the fleet's capacity math is chip-denominated.
+    # None = single-chip replicas (every pre-slice fleet unchanged).
+    slice_shape: Optional[Tuple[int, int]] = None
 
     def resolved_autoscale(self) -> AutoscaleConfig:
         auto = self.autoscale or AutoscaleConfig()
@@ -129,6 +137,8 @@ class FleetConfig:
                               else list(self.replica_roles)),
             "batch_lane": (None if self.batch_lane is None
                            else dataclasses.asdict(self.batch_lane)),
+            "slice_shape": (None if self.slice_shape is None
+                            else list(self.slice_shape)),
         }
 
 
@@ -540,6 +550,11 @@ def build_llm_fleet_app(config: FleetConfig):
         # the replica id tags this engine's Prometheus series (and is
         # how LLMServerImpl learns its own identity)
         ek["metrics_replica_id"] = rid
+        if config.slice_shape is not None:
+            # every replica IS one slice: a tp-sharded engine on its
+            # own named mesh (explicit unless the operator pinned a
+            # per-replica mesh themselves)
+            ek.setdefault("mesh_shape", tuple(config.slice_shape))
         if config.transport is not None:
             # both ends of a session ship stage through the host
             # tier (export parks via the spill path, import restores

@@ -296,6 +296,24 @@ class FleetManager:
         return {rid: st.snapshot for rid, st in self.replicas.items()
                 if st.snapshot is not None}
 
+    # -- slice topology (ISSUE 17) --------------------------------------
+    # Each replica is one slice: an engine built with
+    # mesh_shape=(1, tp) spans tp chips and reports them in its
+    # stats, which land in ReplicaSnapshot.chips. The fleet scales in
+    # whole-slice units — activating a STANDBY replica provisions
+    # chips_per_slice chips at once, never a fraction of a slice.
+    def chips_per_slice(self) -> int:
+        chips = [st.snapshot.chips for st in self.replicas.values()
+                 if st.snapshot is not None]
+        return max(chips) if chips else 1
+
+    def active_chips(self) -> int:
+        total = 0
+        for rid in self._ids(ACTIVE):
+            snap = self.replicas[rid].snapshot
+            total += snap.chips if snap is not None else 1
+        return total
+
     # -- request path ---------------------------------------------------
     def _route(self, body: Dict[str, Any],
                fp: Optional[str] = None
@@ -1343,7 +1361,8 @@ class FleetManager:
             shed_delta=shed_delta,
             slo_page=self.watchdog.paging,
             slo_burn=self.watchdog.max_burn,
-            page_pressure=pressure)
+            page_pressure=pressure,
+            chips_per_slice=self.chips_per_slice())
 
     # -- SLO burn-rate watchdog (ISSUE 7) -------------------------------
     def _watchdog_totals(self) -> Dict[str, float]:
@@ -1656,6 +1675,11 @@ class FleetManager:
                 "requests_total": st.requests_total,
                 "breaker": st.breaker.stats(),
                 **({} if snap is None else {
+                    # slice topology (ISSUE 17): chips this replica's
+                    # engine mesh occupies (a tp slice reports tp);
+                    # mfu below is already per chip (the engine's
+                    # accountant divides by mesh size)
+                    "chips": snap.chips,
                     "active": snap.active,
                     "waiting": snap.waiting,
                     # batch lane (ISSUE 14): the preemptible share
@@ -1751,6 +1775,12 @@ class FleetManager:
                 "draining": len(self._ids(DRAINING)),
                 "standby": len(self._ids(STANDBY)),
                 "unhealthy": len(self._ids(UNHEALTHY)),
+                # slice topology (ISSUE 17): the fleet scales in
+                # whole-slice units — a scale-up provisions
+                # chips_per_slice chips, and active_chips is the
+                # chip-denominated capacity behind the replica count
+                "chips_per_slice": self.chips_per_slice(),
+                "active_chips": self.active_chips(),
                 "last_decision": self.autoscaler.last_decision,
                 "events": list(self._scale_events)[-32:],
             },

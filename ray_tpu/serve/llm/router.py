@@ -148,6 +148,12 @@ class ReplicaSnapshot:
     replica: str
     active: int = 0                  # requests holding a decode slot
     waiting: int = 0                 # engine admission queue depth
+    # slice topology (ISSUE 17): chips this replica's engine mesh
+    # occupies (tp-sharded engines on pod slices report >1) — /fleet
+    # rows show it and the fleet's capacity accounting is chip-, not
+    # replica-, denominated. Per-chip MFU: the engine's PerfAccountant
+    # already divides by mesh size, so `mfu` here is per chip.
+    chips: int = 1
     # batch lane (ISSUE 14): how much of `waiting`/`active` is
     # priority-0 batch-lane work — the autoscaler/watchdog plane
     # subtracts it from its overload signals (a deep queue of
@@ -224,6 +230,7 @@ class ReplicaSnapshot:
             replica=stats.get("replica", ""),
             active=int(stats.get("active", 0)),
             waiting=int(stats.get("waiting", 0)),
+            chips=max(int(stats.get("chips", 1)), 1),
             waiting_batch=int(stats.get("waiting_batch", 0)),
             active_batch=int(stats.get("active_batch", 0)),
             kv_occupancy_batch=float(

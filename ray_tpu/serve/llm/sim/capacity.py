@@ -36,8 +36,16 @@ def capacity_curve(trace_cfg: TraceConfig,
         lat = s["latency"]
         sessions = s["sessions"]
         shed = sum(s["shed"].values())
+        # slice topology (ISSUE 17): price each operating point per
+        # chip, not per replica — a 2-chip slice that doesn't halve
+        # the tail is a capacity loss the per-replica view hides
+        chips = n * max(fleet_cfg.chips_per_replica, 1)
+        tokens = (s["engine"]["decode_tokens"]
+                  + s["batch"]["tokens"])
+        virtual_s = s["sim"]["virtual_s"]
         points.append({
             "replicas": n,
+            "chips": chips,
             "p50_ttft_ms": lat["ttft"]["p50_ms"],
             "p99_ttft_ms": lat["ttft"]["p99_ms"],
             "p99_itl_ms": lat["itl"]["p99_ms"],
@@ -48,6 +56,10 @@ def capacity_curve(trace_cfg: TraceConfig,
                            - sessions["batch_submitted"], 1), 6),
             "completed": sessions["completed"],
             "batch_tokens": s["batch"]["tokens"],
+            "tokens_per_chip_s": round(
+                tokens / max(virtual_s, 1e-9) / chips, 3),
+            "chip_s_per_1k_tokens": round(
+                virtual_s * chips / max(tokens / 1e3, 1e-9), 3),
             "watchdog_alerts": s["watchdog"]["alerts_total"],
         })
     return {
@@ -56,6 +68,7 @@ def capacity_curve(trace_cfg: TraceConfig,
         "fleet": {
             "slots_per_replica": fleet_cfg.slots_per_replica,
             "pages_per_replica": fleet_cfg.pages_per_replica,
+            "chips_per_replica": fleet_cfg.chips_per_replica,
             "calibration": (fleet_cfg.calibration.name
                             if fleet_cfg.calibration else None),
         },

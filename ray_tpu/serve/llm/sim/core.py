@@ -70,6 +70,10 @@ class SimFleetConfig:
     min_replicas: int = 1
     slots_per_replica: int = 8
     pages_per_replica: int = 2048
+    # slice topology (ISSUE 17): chips per replica slice — a
+    # tp-sharded replica's decode tick runs ~chips× faster, and the
+    # capacity sweep prices each operating point per chip
+    chips_per_replica: int = 1
     calibration: Optional[SimCalibration] = None
     router: Optional[RouterConfig] = None
     admission: Optional[AdmissionConfig] = None
@@ -112,7 +116,8 @@ class FleetSimulator:
                              slots=cfg.slots_per_replica,
                              pages=cfg.pages_per_replica,
                              seed=cfg.seed,
-                             slo_targets=cfg.slo_targets)
+                             slo_targets=cfg.slo_targets,
+                             chips=cfg.chips_per_replica)
             for i in range(cfg.replicas)]
         self.status = [ACTIVE if i < max(cfg.min_replicas, 1)
                        else STANDBY for i in range(cfg.replicas)]
@@ -346,7 +351,8 @@ class FleetSimulator:
             shed_delta=shed_delta,
             slo_page=self.watchdog.paging,
             slo_burn=self.watchdog.max_burn,
-            page_pressure=pressure)
+            page_pressure=pressure,
+            chips_per_slice=self.cfg.chips_per_replica)
 
     def _apply_target(self, target: int) -> None:
         active = [i for i, st in enumerate(self.status)
@@ -512,6 +518,7 @@ class FleetSimulator:
                 "min_replicas": self.cfg.min_replicas,
                 "slots_per_replica": self.cfg.slots_per_replica,
                 "pages_per_replica": self.cfg.pages_per_replica,
+                "chips_per_replica": self.cfg.chips_per_replica,
                 "virtual_s": round(self.clock.t, 3),
             },
             "sessions": dict(sorted(self.counts.items())),

@@ -110,11 +110,21 @@ class SyntheticReplica:
     def __init__(self, rid: str, calib: SimCalibration,
                  slots: int = 8, pages: int = 2048,
                  seed: int = 0, slo_targets: Optional[Dict[str,
-                                                          float]] = None):
+                                                          float]] = None,
+                 chips: int = 1):
         self.rid = rid
         self.calib = calib
         self.slots = slots
         self.num_pages = pages
+        # slice topology (ISSUE 17): a tp-sharded replica spanning
+        # `chips` chips runs each decode tick ~chips× faster (the
+        # ragged dispatch is memory-bound, and tp shards the KV pool
+        # and weight reads over heads), so the calibration's
+        # single-chip tick duration divides by the slice size. The
+        # per-tick collective tax is in the calibration when it was
+        # measured on a sliced engine; this factor models topology
+        # what-ifs on a single-chip calibration.
+        self.chips = max(int(chips), 1)
         # crc32, not hash(): string hashing is salted per process and
         # would break the byte-identical-summary determinism gate
         self.rng = random.Random(
@@ -124,7 +134,7 @@ class SyntheticReplica:
         # tick-index clock
         self.tick = 0.0
         self.anchor = 0.0
-        self.tick_ms = calib.tick_point(1, "p50")
+        self.tick_ms = calib.tick_point(1, "p50") / self.chips
         # per-bucket (p50,p95,p99) memo: tick_point re-derives the
         # bucket and string keys on every call, and _retick runs ~3x
         # per session — at 1M sessions the lookup is the hot loop
@@ -211,7 +221,7 @@ class SyntheticReplica:
             self._tick_pts[b] = pts
         u = self.rng.random()
         ms = (pts[0 if u < 0.90 else (1 if u < 0.98 else 2)]
-              + pre * self.calib.prefill_ms_per_token)
+              + pre * self.calib.prefill_ms_per_token) / self.chips
         self.tick_ms = ms if ms > 1e-3 else 1e-3
 
     # -- pages ---------------------------------------------------------
@@ -510,6 +520,7 @@ class SyntheticReplica:
         parser."""
         return {
             "replica": self.rid,
+            "chips": self.chips,
             "active": len(self.active),
             "waiting": self.waiting_count(),
             "waiting_batch": self.waiting_batch_count(),

@@ -23,20 +23,26 @@ from ray_tpu.llm._internal.engine import (EngineConfig, InferenceEngine,
 from ray_tpu.util.jax_guard import GuardViolation, dispatch_guard
 
 
-def _engine(**over):
+def _engine(tp=1, **over):
     kw = dict(model=llama.config("debug", dtype=jnp.float32),
               max_batch_size=3, page_size=8, num_pages=64,
               prefill_buckets=(16, 32, 64), max_prefill_tokens=16,
               seed=9, unified_step=True)
+    if tp > 1:
+        # explicit-tp pod slice (ISSUE 17) on the conftest's emulated
+        # CPU devices: the shard_map'd collective-bearing tick must
+        # hold the exact same dispatch discipline
+        kw["mesh_shape"] = (1, tp)
     kw.update(over)
     return InferenceEngine(EngineConfig(**kw))
 
 
-def _warmed_engine(async_readback=True, enable_metrics=True, **sp_over):
+def _warmed_engine(async_readback=True, enable_metrics=True, tp=1,
+                   **sp_over):
     """Engine with 3 in-flight requests past prefill, decode loop
     settled (all shape buckets built, device-resident state live)."""
     eng = _engine(async_readback=async_readback,
-                  enable_metrics=enable_metrics)
+                  enable_metrics=enable_metrics, tp=tp)
     rng = np.random.default_rng(5)
     sp = dict(max_tokens=64)
     sp.update(sp_over)
@@ -52,6 +58,7 @@ def _warmed_engine(async_readback=True, enable_metrics=True, **sp_over):
     return eng
 
 
+@pytest.mark.parametrize("tp", [1, 2], ids=["tp1", "tp2"])
 @pytest.mark.parametrize("metrics", [True, False],
                          ids=["metrics", "no_metrics"])
 @pytest.mark.parametrize("async_rb", [True, False],
@@ -62,7 +69,7 @@ def _warmed_engine(async_readback=True, enable_metrics=True, **sp_over):
      "repetition_penalty": 1.2},                         # full sampler
 ], ids=["greedy", "sampled_penalized"])
 def test_steady_state_decode_zero_transfers_zero_compiles(
-        sp, async_rb, metrics):
+        sp, async_rb, metrics, tp):
     """32 consecutive decode ticks: no h2d upload (the loop state is
     device-resident and feeds back on device — the guard raises at
     the offending line otherwise) and no new compiled program (shape
@@ -72,9 +79,12 @@ def test_steady_state_decode_zero_transfers_zero_compiles(
     add zero uploads and zero programs) and OFF — and with the
     ISSUE 5 request-lifecycle instrumentation ENABLED (its zero-sync
     contract: TTFT/ITL observation and flight recording are host-only
-    Python on the fold path) as well as disabled."""
+    Python on the fold path) as well as disabled. Parametrized over
+    tp (ISSUE 17): at tp=2 the tick is one shard_map'd
+    collective-bearing program over the named mesh, and the identical
+    discipline must hold."""
     eng = _warmed_engine(async_readback=async_rb,
-                         enable_metrics=metrics, **sp)
+                         enable_metrics=metrics, tp=tp, **sp)
     comp0 = eng.stats()["jit_cache"]["compiled_programs"]
     disp0 = eng.dispatches
     with dispatch_guard() as rep:

@@ -231,6 +231,43 @@ def test_million_sessions_with_slos_and_capacity_artifact(tmp_path):
     assert p99[-1] <= p99[0]
 
 
+# --------------------------------------------- slice topology (ISSUE 17)
+def test_sim_chips_scale_tick_rate():
+    """A 2-chip slice replica decodes ~2x faster (the calibration's
+    single-chip tick duration divides by the slice size): same trace
+    and seed, chips_per_replica=2 must tighten the interactive ITL
+    materially while completing at least as many sessions."""
+    tc = _trace(sessions=4000, duration_s=3600.0)
+    one = FleetSimulator(generate(tc), _cfg()).run()
+    two = FleetSimulator(generate(tc),
+                         _cfg(chips_per_replica=2)).run()
+    assert two["sim"]["chips_per_replica"] == 2
+    assert (two["sessions"]["completed"]
+            >= one["sessions"]["completed"])
+    itl1 = one["latency"]["itl"]["mean_ms"]
+    itl2 = two["latency"]["itl"]["mean_ms"]
+    assert itl2 < 0.75 * itl1, (itl1, itl2)
+
+
+def test_capacity_curve_prices_per_chip():
+    """The sweep prices every operating point per chip: a 2-chip
+    slice that doesn't buy the tail is capacity the per-replica view
+    would hide."""
+    curve = capacity_curve(
+        _trace(sessions=2000, duration_s=1800.0),
+        _cfg(chips_per_replica=2), replica_counts=[2, 4])
+    assert curve["fleet"]["chips_per_replica"] == 2
+    pts = curve["points"]
+    assert [p["chips"] for p in pts] == [4, 8]
+    for p in pts:
+        assert p["tokens_per_chip_s"] > 0
+        assert p["chip_s_per_1k_tokens"] > 0
+    # same traffic over 2x the chips: per-chip throughput drops, so
+    # the chip-seconds cost of 1k tokens rises — the cost metric
+    # really is per chip, not per replica
+    assert pts[1]["tokens_per_chip_s"] < pts[0]["tokens_per_chip_s"]
+
+
 # --------------------------------------------- batch soak inside sim
 def test_sim_batch_lane_soaks_trough_without_regression():
     """The simulator models the lane the fleet ships: batch backlog

@@ -216,6 +216,34 @@ def test_jl002_factory_pattern(tmp_path):
     assert len(fs) == 1 and "k_pages" in fs[0].message
 
 
+@pytest.mark.parametrize("donate,flagged", [
+    ("donate_argnums=(1, 2)", False),
+    ("donate_argnums=(1,)", True),       # v_pages missed
+], ids=["donated", "v_pages_missed"])
+def test_jl002_sees_through_shard_map_body(tmp_path, donate, flagged):
+    """ISSUE 17 engine pattern: the jitted tick's BODY builds a
+    shard_map around a shard-local core, but donation attaches to
+    the OUTER def's k_pages/v_pages params. The analyzer must judge
+    that outer signature — the shard_map wrapper inside must neither
+    hide a missing donation nor trip a false positive on the
+    shard-local function's own pool params."""
+    fs = _lint(tmp_path, f"""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def build(mesh, specs, rep):
+            def run(params, k_pages, v_pages, tokens):
+                def local(p, k, v, t):
+                    return t, k, v
+                sm = shard_map(local, mesh,
+                               in_specs=(rep, specs, specs, rep),
+                               out_specs=(rep, specs, specs))
+                return sm(params, k_pages, v_pages, tokens)
+            return jax.jit(run, {donate})
+    """, select={"JL002"})
+    assert ("JL002" in _rules(fs)) is flagged
+
+
 # ------------------------------------------------------------------ JL003
 
 def test_jl003_unhashable_static_arg(tmp_path):
@@ -895,6 +923,14 @@ def test_engine_hot_path_has_zero_baselined_findings():
         assert proc.returncode == 0, (
             f"jaxlint findings in {fname} (zero-entry module):\n"
             + proc.stdout)
+    # ISSUE 17: the named-mesh builder feeds every explicit-tp
+    # engine's shard_map'd tick — zero baseline, any finding is a
+    # real bug
+    assert (REPO / "ray_tpu/ops/tp_mesh.py").exists()
+    proc = _cli("ray_tpu/ops/tp_mesh.py")
+    assert proc.returncode == 0, (
+        "jaxlint findings in tp_mesh.py (zero-entry module):\n"
+        + proc.stdout)
 
 
 def test_serve_llm_fleet_has_zero_baselined_findings():

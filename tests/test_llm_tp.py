@@ -39,13 +39,35 @@ def test_tp2_decode_matches_single_device():
     assert tp2 == ref
 
 
-def test_tp2_pallas_kernel_matches_gather():
+def test_tp2_pallas_kernel_matches_gather(cpu_mesh_subprocess):
     """The shard_map-wrapped Pallas decode kernel (interpret mode on
-    CPU) over tp=2 must agree with the dense gather path."""
-    ref = _generate(decode_impl="gather")
-    tp2 = _generate(decode_impl="pallas_interpret",
-                    mesh=MeshSpec(tp=2))
-    assert tp2 == ref
+    CPU) over tp=2 must agree with the dense gather path. Runs in a
+    fresh interpreter on an emulated 2-device mesh (the ISSUE 17
+    fixture) so the equivalence gate exercises backend init with
+    exactly the pod topology, not the suite's 8-device default."""
+    cpu_mesh_subprocess("""
+import jax, jax.numpy as jnp
+from ray_tpu.llm._internal.engine import (EngineConfig,
+                                          InferenceEngine,
+                                          SamplingParams)
+from ray_tpu.models import llama
+from ray_tpu.parallel import MeshSpec
+
+assert len(jax.devices()) == 2, jax.devices()
+PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7], [100, 101]]
+
+def gen(**kw):
+    cfg = llama.config("debug", dtype=jnp.float32)
+    eng = InferenceEngine(EngineConfig(
+        model=cfg, max_batch_size=4, num_pages=64, seed=3, **kw))
+    reqs = eng.generate([list(p) for p in PROMPTS],
+                        SamplingParams(max_tokens=8))
+    return [r.output_tokens for r in reqs]
+
+ref = gen(decode_impl="gather")
+tp2 = gen(decode_impl="pallas_interpret", mesh=MeshSpec(tp=2))
+assert tp2 == ref, (tp2, ref)
+""", n_devices=2)
 
 
 def test_tp2_decode_step_logits_close():

@@ -491,6 +491,22 @@ def test_autoscaler_downscale_needs_sustained_idle_and_clamps():
     assert a2.decide(idle, active=2, now=1.5) == 2   # clock restarted
 
 
+def test_autoscaler_decision_denominated_in_slices():
+    """ISSUE 17: the decision stays replica-counted, but
+    last_decision carries the chip-denominated view — one +1 buys a
+    whole chips_per_slice slice, never a fraction."""
+    a = FleetAutoscaler(AutoscaleConfig(
+        min_replicas=1, max_replicas=3, upscale_delay_s=1.0,
+        ttft_high_ms=1000.0))
+    hot = FleetMetrics(ttft_ms=5000.0, chips_per_slice=2)
+    assert a.decide(hot, active=2, now=0.0) == 2
+    assert a.decide(hot, active=2, now=1.5) == 3
+    d = a.last_decision
+    assert d["chips_per_slice"] == 2
+    assert d["active_chips"] == 4
+    assert d["target_chips"] == 6
+
+
 # ----------------------------------------- fleet /metrics aggregation
 
 def test_relabel_exposition_injects_replica_tag():
@@ -865,6 +881,47 @@ def test_e2e_prefix_affinity_colocates_and_hits_cache(fleet_servers):
         eng = fleet_servers[rid].engine
         assert eng.allocator.cache_hit_rate > hit0.get(rid, 0.0), (
             f"no prefix-cache hits on affine replica {rid}")
+
+
+def test_e2e_slice_fleet_provisions_whole_slices():
+    """ISSUE 17 acceptance: on a 2-chip-slice fleet every replica IS
+    one tp-sharded engine over a named (1, 2) mesh — /fleet rows
+    carry chips per replica, the autoscale block accounts in slice
+    units, and a scale-up provisions a WHOLE 2-chip slice (the
+    activated standby's engine already spans 2 emulated devices)."""
+    from ray_tpu.llm._internal.server import LLMServerImpl
+
+    servers = {}
+    for rid in ("r0", "r1"):
+        servers[rid] = LLMServerImpl({
+            "model_id": "m", "model_source": "debug",
+            "engine_kwargs": dict(
+                max_batch_size=2, page_size=8, num_pages=64, seed=5,
+                mesh_shape=(1, 2)),
+        })
+    fleet = FleetManager(
+        [LocalReplicaClient(rid, srv)
+         for rid, srv in servers.items()],
+        autoscale=AutoscaleConfig(min_replicas=1, max_replicas=2))
+
+    async def main():
+        await fleet.refresh()
+        st1 = await fleet.status()
+        fleet._apply_target(2)          # the scale-up decision lands
+        await fleet.refresh()
+        st2 = await fleet.status()
+        _cancel_pumps(servers)
+        return st1, st2
+
+    st1, st2 = asyncio.run(main())
+    assert st1["replicas"]["r0"]["chips"] == 2
+    assert st1["autoscale"]["chips_per_slice"] == 2
+    assert st1["autoscale"]["active_chips"] == 2
+    # the activated replica is itself a whole 2-chip slice
+    assert servers["r1"].engine.n_chips == 2
+    assert st2["replicas"]["r1"]["status"] == ACTIVE
+    assert st2["replicas"]["r1"]["chips"] == 2
+    assert st2["autoscale"]["active_chips"] == 4
 
 
 def test_e2e_fleet_stats_and_status_surface(fleet_servers):
