@@ -430,14 +430,17 @@ class ReceiptLedger:
         ring-evicted) — the conservation check's left-hand side; the
         right-hand side is PerfAccountant.totals()."""
         with self._lock:
-            out = {k: self._evicted_totals.get(k, 0)
-                   for k, _ in CONSERVED_FIELDS}
-            out["kv_page_ticks"] = self._evicted_totals.get(
-                "kv_page_ticks", 0)
-            for r in list(self._live.values()) + list(self._done):
-                for key, attr in CONSERVED_FIELDS:
-                    out[key] += getattr(r, attr)
-                out["kv_page_ticks"] += r.kv_page_ticks
+            return self._totals_locked()
+
+    def _totals_locked(self) -> Dict[str, int]:
+        out = {k: self._evicted_totals.get(k, 0)
+               for k, _ in CONSERVED_FIELDS}
+        out["kv_page_ticks"] = self._evicted_totals.get(
+            "kv_page_ticks", 0)
+        for r in list(self._live.values()) + list(self._done):
+            for key, attr in CONSERVED_FIELDS:
+                out[key] += getattr(r, attr)
+            out["kv_page_ticks"] += r.kv_page_ticks
         out["flops"] = out["flops_gemm"] + out["flops_attn"]
         out["hbm_bytes"] = (out["bytes_weights"] + out["bytes_kv_read"]
                             + out["bytes_kv_write"])
@@ -457,7 +460,18 @@ class ReceiptLedger:
             tenant: Optional[str] = None) -> List[Dict[str, Any]]:
         """Top-k receipts by FLOPs over live + retained finished."""
         with self._lock:
-            rows = list(self._live.values()) + list(self._done)
+            return self._top_locked(k, tenant)
+
+    def _top_locked(self, k: int,
+                    tenant: Optional[str] = None
+                    ) -> List[Dict[str, Any]]:
+        # sort + snapshot UNDER the ledger lock: the old version
+        # snapshotted the row list under the lock but then read
+        # r.flops (sort key) and r.snapshot() off live receipt
+        # objects the tick path mutates under this same lock — a
+        # commit landing mid-sort could tear a receipt's fields
+        # across the row
+        rows = list(self._live.values()) + list(self._done)
         if tenant:
             rows = [r for r in rows
                     if (r.tenant or "default") == tenant]
@@ -465,19 +479,24 @@ class ReceiptLedger:
         return [r.snapshot() for r in rows[:k]]
 
     def summary(self, top_k: int = _TOPK) -> Dict[str, Any]:
-        """stats()["attribution"] / GET /debug/attribution."""
+        """stats()["attribution"] / GET /debug/attribution. One lock
+        acquisition for the whole block (the lock is non-reentrant,
+        hence the _locked helpers): the old version took it four
+        times — counts, top, tenants, totals — so a tick committing
+        between acquisitions produced a summary whose totals did not
+        add up to its rows."""
         with self._lock:
-            live, done = len(self._live), len(self._done)
-        return {
-            "enabled": True,
-            "live": live,
-            "finished_retained": done,
-            "requests_total": self.requests_total,
-            "ticks_total": self.ticks_total,
-            "top": self.top(top_k),
-            "tenants": self.tenants(),
-            "totals": self.totals(),
-        }
+            return {
+                "enabled": True,
+                "live": len(self._live),
+                "finished_retained": len(self._done),
+                "requests_total": self.requests_total,
+                "ticks_total": self.ticks_total,
+                "top": self._top_locked(top_k),
+                "tenants": {t: dict(v)
+                            for t, v in self._tenants.items()},
+                "totals": self._totals_locked(),
+            }
 
 
 __all__ = ["RequestReceipt", "ReceiptLedger", "CONSERVED_FIELDS"]

@@ -47,6 +47,7 @@ from ...models import llama
 from ...models.llama import LlamaConfig
 from ...models.llama_infer import decode_step, prefill
 from ...ops.jax_compat import shard_map_compat as _shard_map
+from ...util import thread_sanitizer
 from .kv_cache import PageAllocator
 from .telemetry import EngineTelemetry
 
@@ -501,6 +502,18 @@ class _Stage:
 
 
 class InferenceEngine:
+    # thread-sanitizer-guarded state (no-op plain attributes unless the
+    # sanitizer is armed, e.g. in the tier-1 concurrency stress test):
+    # the tick-times deque is read AND written only under _step_lock
+    # (dump_blackbox's sanctioned lock-free read runs inside
+    # thread_sanitizer.unguarded()); `waiting` is write-guarded only —
+    # bare boolean/len reads of the published list reference are part
+    # of the design (has_work, blackbox).
+    _tick_times = thread_sanitizer.guarded_by("_step_lock")
+    waiting = thread_sanitizer.guarded_by("_step_lock", writes_only=True)
+    _pending_touched = thread_sanitizer.guarded_by(
+        "_step_lock", writes_only=True)
+
     def __init__(self, config: EngineConfig,
                  params: Optional[Dict[str, Any]] = None):
         self.config = config
@@ -941,8 +954,11 @@ class InferenceEngine:
         # disconnect, and an abort-triggered drain folding the
         # in-flight tick concurrently with the step that dispatched
         # it would double-fold (duplicate tokens / double position
-        # advance). Uncontended in the single-threaded case.
-        self._step_lock = threading.Lock()
+        # advance). Uncontended in the single-threaded case. A plain
+        # threading.Lock unless the thread sanitizer is armed (stress
+        # tests), in which case acquisition order and guarded-field
+        # ownership are checked at runtime.
+        self._step_lock = thread_sanitizer.make_lock("engine._step_lock")
         self.pp_mb = max(int(ec.pp_decode_microbatches or 1), 1)
         if self.pp_mb > 1:
             if self.pp <= 1:
@@ -951,6 +967,11 @@ class InferenceEngine:
             if ec.max_batch_size % self.pp_mb:
                 raise ValueError(
                     "pp_decode_microbatches must divide max_batch_size")
+        # published fleet-counter snapshot: replaced WHOLESALE under
+        # _step_lock by _publish_counters_locked, read lock-free by
+        # fleet_stats at router cadence (fleet_counters())
+        with self._step_lock:
+            self._publish_counters_locked()
 
     @staticmethod
     def _build_placement(spec, cfg: LlamaConfig):
@@ -3000,9 +3021,16 @@ class InferenceEngine:
 
     def lane_counts(self) -> Dict[str, int]:
         """Batch-lane occupancy (ISSUE 14): how much of this engine's
-        queue/slots/parked set is priority-0 bulk work. Plain host
-        reads (fleet_stats cadence) — the serving plane subtracts
-        these from its overload signals."""
+        queue/slots/parked set is priority-0 bulk work. Snapshots
+        under the step lock — the pump rebinds `waiting` mid-step, and
+        an unlocked sum over it can double-count or skip entries (the
+        serving plane subtracts these from its overload signals, so a
+        glitch here flaps the autoscaler). Lock-averse readers (the
+        fleet_stats cadence) use fleet_counters() instead."""
+        with self._step_lock:
+            return self._lane_counts_locked()
+
+    def _lane_counts_locked(self) -> Dict[str, int]:
         return {
             "waiting_batch": sum(1 for r in self.waiting
                                  if r.lane == "batch"),
@@ -3033,6 +3061,32 @@ class InferenceEngine:
         host = self.host_tier.used_pages if self.host_tier else 0
         return (self.allocator.used_pages + host) / usable
 
+    def _publish_counters_locked(self) -> None:
+        """Rebuild the published fleet-counter snapshot. Called (with
+        _step_lock held) at the end of every mutating entry point —
+        step/add_request/abort/preempt/import_session — so
+        fleet_counters() always reflects the last committed state.
+        The dict is REPLACED wholesale, never mutated in place: a
+        concurrent reader sees either the previous or the next
+        snapshot, both internally consistent."""
+        self._fleet_counters = {
+            "active": self.num_active(),
+            "waiting": len(self.waiting),
+            "parked_sessions": len(self.parked),
+            "preemptions_total": sum(self.preempt_counts.values()),
+            "page_pressure": round(self.page_pressure(), 4),
+            "lanes": self._lane_counts_locked(),
+        }
+
+    def fleet_counters(self) -> Dict[str, Any]:
+        """Immutable published snapshot of the mutable-state counters
+        the fleet router scrapes at sub-second cadence (fleet_stats /
+        health). Lock-free BY DESIGN: fleet_stats must never block
+        behind a tick, so it reads the reference the last mutator
+        published instead of taking _step_lock. Callers must not
+        mutate the returned dict."""
+        return self._fleet_counters
+
     def preempt(self, request_id: str, reason: str = "manual") -> bool:
         """Preempt one running request (operator / serving-plane hook;
         also the long-idle session-parking entry point: parking a
@@ -3041,22 +3095,28 @@ class InferenceEngine:
         like abort(). Returns False if the request is not in a slot
         or cannot be parked (no host tier for a decoding victim)."""
         with self._step_lock:
-            for slot in self.slots:
-                req = slot.request
-                if req is None or req.request_id != request_id:
-                    continue
-                if slot.ready and self.host_tier is None:
-                    return False
-                self._drain(self._pending_touched)
-                req = slot.request
-                if req is None or req.request_id != request_id:
-                    return False     # finished inside the drain fold
-                if self._preempt_slot(slot, self._pending_touched,
-                                      reason):
-                    self._refresh_device_state()
-                    return True
+            hit = self._preempt_locked(request_id, reason)
+            if hit:
+                self._publish_counters_locked()
+            return hit
+
+    def _preempt_locked(self, request_id: str, reason: str) -> bool:
+        for slot in self.slots:
+            req = slot.request
+            if req is None or req.request_id != request_id:
+                continue
+            if slot.ready and self.host_tier is None:
                 return False
+            self._drain(self._pending_touched)
+            req = slot.request
+            if req is None or req.request_id != request_id:
+                return False     # finished inside the drain fold
+            if self._preempt_slot(slot, self._pending_touched,
+                                  reason):
+                self._refresh_device_state()
+                return True
             return False
+        return False
 
     # -- fleet KV transport (ISSUE 12) ----------------------------------
     def session_ids(self) -> List[str]:
@@ -3234,9 +3294,10 @@ class InferenceEngine:
                     raise ValueError(
                         "cold session carries emitted tokens; replay "
                         "it through the continuation path instead")
-                self.add_request(req)
+                self._add_request_locked(req)
                 self.telemetry.recorder.record(
                     "session_imported", request_id=rid, pages=0)
+                self._publish_counters_locked()
                 return req
             tier = self.host_tier
             if tier is None:
@@ -3300,6 +3361,7 @@ class InferenceEngine:
             self.telemetry.recorder.record(
                 "session_imported", request_id=rid, pages=n_pages,
                 generated=len(req.output_tokens))
+            self._publish_counters_locked()
             return req
 
     def export_prefix(self, prompt_tokens: List[int]
@@ -3546,6 +3608,17 @@ class InferenceEngine:
         self._refresh_device_state()
 
     def add_request(self, request: Request) -> None:
+        """Queue a request for admission. Takes the step lock: the
+        ingress path appends from the event loop (or a client thread)
+        while the pump's step() rebinds `self.waiting` to the
+        survivors list mid-tick — an unlocked append can land on the
+        ABOUT-TO-BE-DISCARDED list and silently vanish. Admission
+        itself still happens inside step()."""
+        with self._step_lock:
+            self._add_request_locked(request)
+            self._publish_counters_locked()
+
+    def _add_request_locked(self, request: Request) -> None:
         if request.lora is not None \
                 and request.lora not in self._lora_names:
             raise ValueError(
@@ -3668,6 +3741,7 @@ class InferenceEngine:
                 # stats()/step-lock paths
                 self.dump_blackbox("engine_crash", error=repr(exc))
                 raise
+            self._publish_counters_locked()
             self._profile_tick_end()
             return touched
 
@@ -3775,14 +3849,21 @@ class InferenceEngine:
         loras = loras or [None] * len(prompts)
         if len(loras) != len(prompts):
             raise ValueError("loras must match prompts in length")
-        unknown = {l for l in loras
-                   if l is not None and l not in self._lora_names}
+        with self._step_lock:
+            # snapshot the adapter registry under the lock: a
+            # concurrent register_loras swaps _lora_names/_lora_raw
+            # mid-validation, and reading the two attributes unlocked
+            # can pair a new names-set with an old raw-set in the
+            # error message (racelint RL004 on the registry containers)
+            known = frozenset(self._lora_names)
+            registered = sorted(self._lora_raw)
+        unknown = {l for l in loras if l is not None and l not in known}
         if unknown:
             # validate BEFORE queueing anything: a bad name mid-batch
             # must not strand earlier requests in the waiting queue
             raise ValueError(
                 f"unknown LoRA adapter(s) {sorted(unknown)} "
-                f"(registered: {sorted(self._lora_raw)})")
+                f"(registered: {registered})")
         reqs = [Request(f"gen-{i}-{id(prompts)}", list(p), params,
                         lora=loras[i])
                 for i, p in enumerate(prompts)]
@@ -4520,44 +4601,50 @@ class InferenceEngine:
         while the pump steps on an executor thread, and the refresh
         below folds any in-flight tick."""
         with self._step_lock:
-            for i, req in enumerate(self.waiting):
-                if req.request_id == request_id:
-                    del self.waiting[i]
-                    req.finished = True
-                    req.finish_reason = "abort"
-                    self.telemetry.recorder.record(
-                        "abort", request_id=request_id,
-                        where="waiting")
-                    self.telemetry.on_finished(
-                        req, "abort",
-                        cost=self._attrib_finish(req, "abort"))
-                    return True
-            for slot in self.slots:
-                if slot.request is not None \
-                        and slot.request.request_id == request_id:
-                    self.telemetry.recorder.record(
-                        "abort", request_id=request_id,
-                        where="running")
-                    self._finish(slot, "abort")
-                    self._refresh_device_state()
-                    return True
-            if self.host_tier is not None \
-                    and request_id in self.host_tier:
-                # parked mid-preemption and the client gave up: drop
-                # the host KV, never restore
-                parked = self.host_tier.drop(request_id)
-                if parked in self._pending_spills:
-                    self._pending_spills.remove(parked)
-                req = parked.request
+            hit = self._abort_locked(request_id)
+            if hit:
+                self._publish_counters_locked()
+            return hit
+
+    def _abort_locked(self, request_id: str) -> bool:
+        for i, req in enumerate(self.waiting):
+            if req.request_id == request_id:
+                del self.waiting[i]
                 req.finished = True
                 req.finish_reason = "abort"
                 self.telemetry.recorder.record(
-                    "abort", request_id=request_id, where="parked")
+                    "abort", request_id=request_id,
+                    where="waiting")
                 self.telemetry.on_finished(
                     req, "abort",
                     cost=self._attrib_finish(req, "abort"))
                 return True
-            return False
+        for slot in self.slots:
+            if slot.request is not None \
+                    and slot.request.request_id == request_id:
+                self.telemetry.recorder.record(
+                    "abort", request_id=request_id,
+                    where="running")
+                self._finish(slot, "abort")
+                self._refresh_device_state()
+                return True
+        if self.host_tier is not None \
+                and request_id in self.host_tier:
+            # parked mid-preemption and the client gave up: drop
+            # the host KV, never restore
+            parked = self.host_tier.drop(request_id)
+            if parked in self._pending_spills:
+                self._pending_spills.remove(parked)
+            req = parked.request
+            req.finished = True
+            req.finish_reason = "abort"
+            self.telemetry.recorder.record(
+                "abort", request_id=request_id, where="parked")
+            self.telemetry.on_finished(
+                req, "abort",
+                cost=self._attrib_finish(req, "abort"))
+            return True
+        return False
 
     # -- observability (ISSUE 5) -------------------------------------------
     def profile_next_ticks(self, ticks: int = 8,
@@ -4704,12 +4791,17 @@ class InferenceEngine:
             return None
         try:
             ticks: List[Any] = []
-            for _ in range(4):
-                try:
-                    ticks = list(self._tick_times)[-64:]
-                    break
-                except RuntimeError:
-                    continue
+            # sanctioned bare read of a _step_lock-guarded field:
+            # unguarded() tells the runtime sanitizer this scope is
+            # lock-free on purpose, and the inline racelint disable
+            # records the same contract for the static analyzer
+            with thread_sanitizer.unguarded():
+                for _ in range(4):
+                    try:
+                        ticks = list(self._tick_times)[-64:]  # racelint: disable=RL004 -- lock-free by contract: the crash path holds _step_lock; bounded retry absorbs a concurrent append
+                        break
+                    except RuntimeError:
+                        continue
             try:
                 cfg = json.loads(json.dumps(
                     dataclasses.asdict(self.config), default=repr))
@@ -4734,7 +4826,7 @@ class InferenceEngine:
                 "tick_times_ms": [list(t) for t in ticks],
                 "flight_recorder": self.telemetry.recorder.events(),
                 "in_flight_requests": self.telemetry.live_snapshot(),
-                "waiting_requests": [r.request_id for r in self.waiting],
+                "waiting_requests": [r.request_id for r in self.waiting],  # racelint: disable=RL004 -- lock-free by contract: the crash path holds _step_lock; reads the published list reference
                 # single read of s.request per slot: the manual-dump
                 # path races the pump's retirements, and a None between
                 # a check and a .request_id deref would abort the
@@ -4765,7 +4857,7 @@ class InferenceEngine:
                      "reason": p.reason,
                      "parked_s": round(p.idle_s(), 3)}
                     for p in self.parked],
-                "preemptions": dict(self.preempt_counts),
+                "preemptions": dict(self.preempt_counts),  # racelint: disable=RL004 -- lock-free by contract: forensics-grade copy; a torn read beats a wedged crash path
                 "metrics_exposition": exposition,
                 **(extra or {}),
             }
@@ -4811,7 +4903,7 @@ class InferenceEngine:
                 len(sorted_vals) - 1)
         return sorted_vals[i]
 
-    def _tick_times_summary(self) -> Dict[str, Any]:
+    def _tick_times_summary_locked(self) -> Dict[str, Any]:
         """Tick-pipeline telemetry over the recent window (512 ticks).
         device_ms is time BLOCKED in the sanctioned readback — the
         un-hidden device share of a tick — so overlap_ratio
@@ -4820,12 +4912,14 @@ class InferenceEngine:
         share itself when running synchronously. Besides the window
         averages, p50/p95/p99 expose TAIL behavior (ISSUE 11): a
         wedging tick or periodic stall moves the p99 long before it
-        moves the mean."""
-        with self._step_lock:
-            # snapshot under the step lock: the pump's executor
-            # thread appends per tick, and iterating a deque being
-            # mutated raises RuntimeError mid-/stats request
-            ticks = tuple(self._tick_times)
+        moves the mean.
+
+        Caller holds _step_lock (stats() takes it ONCE around the
+        whole mutable-state snapshot; the lock is non-reentrant so
+        this helper must not retake it). The lock matters: the pump's
+        executor thread appends per tick, and iterating a deque being
+        mutated raises RuntimeError mid-/stats request."""
+        ticks = tuple(self._tick_times)
         n = len(ticks)
         wall = sum(t[0] for t in ticks)
         host = sum(t[1] for t in ticks)
@@ -4848,41 +4942,64 @@ class InferenceEngine:
         return out
 
     def stats(self) -> Dict[str, Any]:
+        # ONE _step_lock acquisition around the whole mutable-state
+        # snapshot (waiting/slots/parked/preempt_counts/tick deque):
+        # the pump mutates all of these mid-tick, and the pre-racelint
+        # version read them bare — len(waiting) vs lane_counts() could
+        # disagree within one response, and dict(preempt_counts) can
+        # raise RuntimeError if a preemption lands mid-copy. Component
+        # summaries with their own locks (perf/attribution/anomaly/
+        # telemetry) are read AFTER release to keep the hold short.
+        with self._step_lock:
+            snap = {
+                "active": self.num_active(),
+                "waiting": len(self.waiting),
+                "free_pages": self.allocator.free_pages,
+                "total_pages": self.allocator.num_usable,
+                # unified-step telemetry: ticks counts step() calls,
+                # dispatches counts compiled-program executions — the
+                # ragged step's contract is a 1.0 ratio on work ticks
+                "ticks": self.ticks,
+                "dispatches": self.dispatches,
+                "dispatches_per_step": round(
+                    self.dispatches / max(self.ticks, 1), 3),
+                # slice topology (ISSUE 17): chips this replica
+                # occupies (mesh size; 1 off-mesh) — the fleet's
+                # slice-accounting unit, and the divisor behind the
+                # per-chip perf block
+                "chips": self.n_chips,
+                # KV memory hierarchy (ISSUE 10): parked sessions,
+                # demand over the device pool (>1 = oversubscribed),
+                # preemptions by reason; the host-tier block (spills/
+                # restores/host pages) rides allocator.stats() below
+                # when the tier is on
+                "parked_sessions": len(self.parked),
+                "page_pressure": round(self.page_pressure(), 4),
+                # device-pool byte occupancy at the CONFIGURED page
+                # dtype (ISSUE 16 small fix: int8/fp8 pools must not
+                # report f32 bytes — per-page bytes include the quant
+                # scale sidecar)
+                "kv_dtype": self._kv_kind,
+                "kv_page_bytes": self._kv_page_bytes,
+                "kv_device_bytes_used": (self.allocator.used_pages
+                                         * self._kv_page_bytes),
+                "preemptions": dict(self.preempt_counts),
+                # batch lane (ISSUE 14): preemptible bulk-work
+                # occupancy
+                "lanes": self._lane_counts_locked(),
+                # tick-pipeline telemetry (ISSUE 4): wall vs host-fold
+                # vs blocked-readback per tick + lag/drain counters
+                "tick_times": self._tick_times_summary_locked(),
+            }
+            alloc_stats = self.allocator.stats()
+            spec = self._spec
+            spec_snap = (None if spec is None or not spec["rounds"]
+                         else {"rounds": spec["rounds"],
+                               "accepted": spec["accepted"],
+                               "emitted": spec["emitted"],
+                               "k": spec["k"]})
         out = {
-            "active": self.num_active(),
-            "waiting": len(self.waiting),
-            "free_pages": self.allocator.free_pages,
-            "total_pages": self.allocator.num_usable,
-            # unified-step telemetry: ticks counts step() calls,
-            # dispatches counts compiled-program executions — the
-            # ragged step's contract is a 1.0 ratio on work ticks
-            "ticks": self.ticks,
-            "dispatches": self.dispatches,
-            "dispatches_per_step": round(
-                self.dispatches / max(self.ticks, 1), 3),
-            # slice topology (ISSUE 17): chips this replica occupies
-            # (mesh size; 1 off-mesh) — the fleet's slice-accounting
-            # unit, and the divisor behind the per-chip perf block
-            "chips": self.n_chips,
-            # KV memory hierarchy (ISSUE 10): parked sessions, demand
-            # over the device pool (>1 = oversubscribed), preemptions
-            # by reason; the host-tier block (spills/restores/host
-            # pages) rides allocator.stats() below when the tier is on
-            "parked_sessions": len(self.parked),
-            "page_pressure": round(self.page_pressure(), 4),
-            # device-pool byte occupancy at the CONFIGURED page dtype
-            # (ISSUE 16 small fix: int8/fp8 pools must not report f32
-            # bytes — per-page bytes include the quant scale sidecar)
-            "kv_dtype": self._kv_kind,
-            "kv_page_bytes": self._kv_page_bytes,
-            "kv_device_bytes_used": (self.allocator.used_pages
-                                     * self._kv_page_bytes),
-            "preemptions": dict(self.preempt_counts),
-            # batch lane (ISSUE 14): preemptible bulk-work occupancy
-            "lanes": self.lane_counts(),
-            # tick-pipeline telemetry (ISSUE 4): wall vs host-fold vs
-            # blocked-readback per tick + lag/drain counters
-            "tick_times": self._tick_times_summary(),
+            **snap,
             # per-dispatch perf accounting (ISSUE 11): rolling
             # decode/prefill goodput, MFU/MBU vs the hardware
             # envelope, and which roof binds (perfmodel.py)
@@ -4925,10 +5042,10 @@ class InferenceEngine:
                     ("draft_fns", "verify_fns", "prefill_fns"))),
                 "compiled_programs": self.compiles,
             },
-            **self.allocator.stats(),
+            **alloc_stats,
         }
-        if self._spec is not None and self._spec["rounds"]:
-            s = self._spec
+        if spec_snap is not None:
+            s = spec_snap
             out["spec_rounds"] = s["rounds"]
             out["spec_acceptance_rate"] = round(
                 s["accepted"] / (s["rounds"] * (s["k"] - 1)), 3)

@@ -225,7 +225,11 @@ class LLMServerImpl:
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
-            self.engine.add_request(req)
+            # off-loop: add_request takes the step lock (racelint
+            # RL002 — a mid-tick pump holds it for the whole dispatch,
+            # and blocking here would stall every other stream)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.add_request, req)
             self._wake.set()
             while True:
                 _, finished, _ = await asyncio.wait_for(q.get(),
@@ -365,7 +369,11 @@ class LLMServerImpl:
         self._queues[rid] = q
         ctx = list(decode_ctx or [])
         try:
-            self.engine.add_request(req)
+            # off-loop: add_request takes the step lock (racelint
+            # RL002 — a mid-tick pump holds it for the whole dispatch,
+            # and blocking here would stall every other stream)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.add_request, req)
             self._wake.set()
             n_sent = len(self.tokenizer.decode(ctx)) if ctx else 0
             n_toks = 0
@@ -562,7 +570,11 @@ class LLMServerImpl:
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         try:
-            self.engine.add_request(req)
+            # off-loop: add_request takes the step lock (racelint
+            # RL002 — a mid-tick pump holds it for the whole dispatch,
+            # and blocking here would stall every other stream)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.add_request, req)
             self._wake.set()
             while not req.output_tokens and not req.finished:
                 await asyncio.wait_for(q.get(), timeout=300)
@@ -775,12 +787,19 @@ class LLMServerImpl:
         """Routing inputs for the fleet router. Plain host-side
         attribute reads (no step-lock, no device sync) — the router
         refreshes this at sub-second cadence and must never queue
-        behind a tick."""
+        behind a tick. The step-lock-guarded counters (active/waiting/
+        lanes/parked/preemptions/page-pressure) come from the engine's
+        PUBLISHED immutable snapshot (fleet_counters(), rebuilt under
+        the lock by every mutating entry point) instead of walking the
+        live waiting list / slot table — the pre-racelint version
+        summed over `eng.waiting` while the pump rebinds it, which
+        could glitch the autoscaler's overload signals."""
         eng = self.engine
         alloc = eng.allocator
         used = alloc.used_pages
         last = eng.last_step_at
-        lanes = eng.lane_counts()
+        counters = eng.fleet_counters()
+        lanes = counters["lanes"]
         return {
             "replica": self.replica_id,
             "model": self.model_id,
@@ -788,8 +807,8 @@ class LLMServerImpl:
             # mesh occupies — the fleet's slice-accounting unit
             # (ReplicaSnapshot.chips, /fleet rows, autoscaler sizing)
             "chips": getattr(eng, "n_chips", 1),
-            "active": eng.num_active(),
-            "waiting": len(eng.waiting),
+            "active": counters["active"],
+            "waiting": counters["waiting"],
             "kv_occupancy": (used / alloc.num_usable
                              if alloc.num_usable else 0.0),
             "free_pages": alloc.free_pages,
@@ -800,14 +819,14 @@ class LLMServerImpl:
                                 else max(time.monotonic() - last, 0.0)),
             # KV memory hierarchy (ISSUE 10): the autoscaler/watchdog's
             # page-pressure signal + host-tier occupancy for /fleet
-            "page_pressure": round(eng.page_pressure(), 4),
+            "page_pressure": counters["page_pressure"],
             # batch lane (ISSUE 14): the serving plane subtracts the
             # preemptible tier from its overload signals
             **lanes,
             "kv_occupancy_batch": (
                 lanes["batch_kv_pages"] / alloc.num_usable
                 if alloc.num_usable else 0.0),
-            "parked_sessions": len(eng.parked),
+            "parked_sessions": counters["parked_sessions"],
             "kv_offload": eng.host_tier is not None,
             "kv_host_pages_used": (eng.host_tier.used_pages
                                    if eng.host_tier else 0),
@@ -820,7 +839,7 @@ class LLMServerImpl:
                              if eng.host_tier else 0),
             "restores_total": (eng.host_tier.restores_total
                                if eng.host_tier else 0),
-            "preemptions_total": sum(eng.preempt_counts.values()),
+            "preemptions_total": counters["preemptions_total"],
             # per-dispatch perf accounting (ISSUE 11): the fleet-plane
             # brief — MFU/MBU/roofline + phase goodput — so /fleet
             # rows and the fleet gauges see utilization per replica

@@ -71,7 +71,12 @@ class Router:
             if self.targets is not None and self.targets.replicas:
                 return
             time.sleep(0.1)
-            self._last_refresh = 0.0
+            # force the next loop iteration to re-poll the controller.
+            # Under _lock: _apply writes _last_refresh while holding
+            # it, and a bare store here can clobber a refresh that
+            # landed between the sleep and the write (racelint RL001)
+            with self._lock:
+                self._last_refresh = 0.0
         raise TimeoutError(
             f"no running replicas for {self.dep_key} after {deadline_s}s")
 
@@ -87,7 +92,10 @@ class Router:
             if self.targets is not None and self.targets.replicas:
                 return
             await asyncio.sleep(0.1)
-            self._last_refresh = 0.0
+            # see refresh_sync: the re-poll marker must not race a
+            # concurrent _apply (racelint RL001)
+            with self._lock:
+                self._last_refresh = 0.0
         raise TimeoutError(
             f"no running replicas for {self.dep_key} after {deadline_s}s")
 

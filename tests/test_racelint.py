@@ -1,0 +1,668 @@
+"""racelint analyzer property tests (ISSUE 18, tools/racelint).
+
+Per-rule synthetic modules (positive AND negative cases, cross-method
+entry-lockset inference, async one-hop propagation) so rule
+regressions are caught without running against ray_tpu/ — plus the
+tier-1 repo gates: the shipped baseline is small and justified,
+`python -m tools.racelint ray_tpu` is clean against it, and the
+engine/serving-LLM planes hold a ZERO-baseline bar.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.racelint import analyze_paths, load_baseline
+from tools.racelint.rules import ALL_RULES
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, source, name="mod.py", select=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return analyze_paths([str(p)], root=str(tmp_path), select=select)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------------ RL001
+
+def test_rl001_unlocked_writer_races_locked(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._step_lock = threading.Lock()
+                self.waiting = []
+
+            def step(self):
+                with self._step_lock:
+                    self.waiting = [r for r in self.waiting
+                                    if not r.finished]
+
+            def add_request(self, r):
+                self.waiting.append(r)
+    """, select={"RL001"})
+    assert len(fs) == 1
+    assert fs[0].func == "Engine.add_request"
+    assert "waiting" in fs[0].detail
+
+
+def test_rl001_all_writers_locked_clean(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._step_lock = threading.Lock()
+                self.waiting = []
+
+            def step(self):
+                with self._step_lock:
+                    self.waiting = []
+
+            def add_request(self, r):
+                with self._step_lock:
+                    self.waiting.append(r)
+    """, select={"RL001"})
+    assert fs == []
+
+
+def test_rl001_init_writes_exempt(tmp_path):
+    """__init__ builds state before any thread can see it."""
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+    """, select={"RL001"})
+    assert fs == []
+
+
+def test_rl001_cross_method_entry_lockset(tmp_path):
+    """A private helper called only under the lock inherits the
+    caller's lock set — its writes count as locked."""
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def step(self):
+                with self._lock:
+                    self._rebuild()
+
+            def other(self):
+                with self._lock:
+                    self._rebuild()
+
+            def _rebuild(self):
+                self.items = []
+    """, select={"RL001"})
+    assert fs == []
+
+
+# ------------------------------------------------------------------ RL002
+
+@pytest.mark.parametrize("body,flagged", [
+    ("time.sleep(0.5)", True),
+    ("requests.get(url)", True),
+    ("self.engine.step()", True),
+    ("self.engine.stats()", True),
+    ("await asyncio.sleep(0.5)", False),
+    ("self.engine.has_work()", False),      # not a step-lock entry point
+], ids=["sleep", "http", "engine_step", "engine_stats",
+        "async_sleep", "lock_free_read"])
+def test_rl002_blocking_in_async_def(tmp_path, body, flagged):
+    fs = _lint(tmp_path, f"""
+        import asyncio
+        import time
+        import requests
+
+        class Server:
+            async def handler(self, url):
+                {body}
+    """, select={"RL002"})
+    assert ("RL002" in _rules(fs)) is flagged
+
+
+def test_rl002_lock_acquire_in_async_def(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def scrape(self):
+                with self._lock:
+                    return 1
+    """, select={"RL002"})
+    assert len(fs) == 1
+    assert "with:" in fs[0].detail
+
+
+def test_rl002_one_hop_sync_helper(tmp_path):
+    """async -> sync helper that blocks is flagged at the call site;
+    a helper that routes through run_in_executor is loop-aware."""
+    fs = _lint(tmp_path, """
+        import asyncio
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _slow(self):
+                with self._lock:
+                    return 1
+
+            def _offloaded(self, rid):
+                try:
+                    asyncio.get_running_loop().run_in_executor(
+                        None, self.engine.abort, rid)
+                except RuntimeError:
+                    self.engine.abort(rid)
+
+            async def bad(self):
+                return self._slow()
+
+            async def ok(self, rid):
+                self._offloaded(rid)
+    """, select={"RL002"})
+    assert len(fs) == 1
+    assert fs[0].func == "Server.bad"
+
+
+def test_rl002_unbounded_queue_get(tmp_path):
+    fs = _lint(tmp_path, """
+        class Worker:
+            async def pull(self):
+                return self.queue.get()
+    """, select={"RL002"})
+    assert len(fs) == 1
+    assert "queue" in fs[0].message
+
+
+def test_rl002_asyncio_field_receiver_clean(tmp_path):
+    """Methods on an asyncio-constructed field return awaitables —
+    they never block the loop (the util/queue.py false positive)."""
+    fs = _lint(tmp_path, """
+        import asyncio
+
+        class QueueActor:
+            def __init__(self):
+                self._q = asyncio.Queue(maxsize=8)
+
+            async def get(self, timeout):
+                return await asyncio.wait_for(self._q.get(), timeout)
+    """, select={"RL002"})
+    assert fs == []
+
+
+def test_rl002_module_level_async_fn(tmp_path):
+    fs = _lint(tmp_path, """
+        import time
+
+        async def poll():
+            time.sleep(1.0)
+    """, select={"RL002"})
+    assert len(fs) == 1
+    assert fs[0].func == "poll"
+
+
+# ------------------------------------------------------------------ RL003
+
+def test_rl003_lock_order_cycle(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Fleet:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def route(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rebalance(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, select={"RL003"})
+    assert len(fs) == 1
+    assert "cycle" in fs[0].detail
+
+
+def test_rl003_consistent_order_clean(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Fleet:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def route(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rebalance(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """, select={"RL003"})
+    assert fs == []
+
+
+def test_rl003_cross_method_cycle_via_entry_lockset(tmp_path):
+    """The inversion hides in a private helper whose entry lock set
+    comes from its only call site."""
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Fleet:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def route(self):
+                with self._a:
+                    self._inner()
+
+            def _inner(self):
+                with self._b:
+                    pass
+
+            def rebalance(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, select={"RL003"})
+    assert len(fs) == 1
+
+
+# ------------------------------------------------------------------ RL004
+
+def test_rl004_unlocked_iteration_of_locked_container(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def step(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def scrape(self):
+                return sum(1 for x in self.items)
+    """, select={"RL004"})
+    assert len(fs) == 1
+    assert fs[0].func == "Engine.scrape"
+
+
+@pytest.mark.parametrize("read", [
+    "list(self.items)",
+    "sorted(self.items)",
+    "[x for x in self.items]",
+    "sum(1 for v in self.items.values())",
+], ids=["list", "sorted", "comprehension", "values_view"])
+def test_rl004_iteration_forms(tmp_path, read):
+    fs = _lint(tmp_path, f"""
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {{}}
+
+            def step(self):
+                with self._lock:
+                    self.items.update(a=1)
+
+            def scrape(self):
+                return {read}
+    """, select={"RL004"})
+    assert len(fs) == 1
+
+
+def test_rl004_locked_iteration_clean(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def step(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def scrape(self):
+                with self._lock:
+                    return list(self.items)
+    """, select={"RL004"})
+    assert fs == []
+
+
+def test_rl004_unlocked_mutations_not_flagged(tmp_path):
+    """If no mutation is locked there is no lock discipline to
+    enforce — that's RL001 territory, not RL004."""
+    fs = _lint(tmp_path, """
+        class Bag:
+            def __init__(self):
+                self.items = []
+
+            def put(self, x):
+                self.items.append(x)
+
+            def scan(self):
+                return list(self.items)
+    """, select={"RL004"})
+    assert fs == []
+
+
+def test_rl004_annassign_and_comprehension_containers(tmp_path):
+    """Annotated (`self.x: List[int] = []`) and comprehension-built
+    containers are tracked too — the engine builds its slot table
+    with a list comprehension."""
+    fs = _lint(tmp_path, """
+        import threading
+        from typing import List
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.waiting: List[int] = []
+                self.slots = [object() for _ in range(4)]
+
+            def step(self):
+                with self._lock:
+                    self.waiting.append(1)
+
+            def scrape(self):
+                return [w for w in self.waiting]
+    """, select={"RL004"})
+    assert len(fs) == 1
+    assert "waiting" in fs[0].detail
+
+
+# ------------------------------------------------------------------ RL005
+
+def test_rl005_untracked_thread(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Pump:
+            def start(self):
+                t = threading.Thread(target=self._run)
+                t.start()
+    """, select={"RL005"})
+    assert len(fs) == 1
+    assert "t" in fs[0].detail
+
+
+@pytest.mark.parametrize("src", [
+    """
+    import threading
+
+    class Pump:
+        def start(self):
+            t = threading.Thread(target=self._run, daemon=True)
+            t.start()
+    """,
+    """
+    import threading
+
+    class Pump:
+        def start(self):
+            t = threading.Thread(target=self._run)
+            t.start()
+            t.join()
+    """,
+    """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def close(self):
+            self._t.join()
+    """,
+], ids=["daemon_kwarg", "local_join", "field_joined_elsewhere"])
+def test_rl005_tracked_threads_clean(tmp_path, src):
+    assert _lint(tmp_path, src, select={"RL005"}) == []
+
+
+def test_rl005_module_level_function(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """, select={"RL005"})
+    assert len(fs) == 1
+
+
+# ------------------------------------------------------------------ RL006
+
+def test_rl006_sibling_deadlock(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def stats(self):
+                with self._lock:
+                    return 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self.stats()
+    """, select={"RL006"})
+    assert len(fs) == 1
+    assert "deadlock" in fs[0].detail
+
+
+def test_rl006_reacquire_nonreentrant(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """, select={"RL006"})
+    assert len(fs) == 1
+    assert "reacquire" in fs[0].detail
+
+
+def test_rl006_rlock_reentry_clean(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """, select={"RL006"})
+    assert fs == []
+
+
+def test_rl006_callback_under_lock(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.alert_hook = None
+
+            def f(self):
+                with self._lock:
+                    self.alert_hook()
+    """, select={"RL006"})
+    assert len(fs) == 1
+    assert "callback" in fs[0].detail
+
+
+def test_rl006_statically_known_listener_clean(tmp_path):
+    """`self.telemetry.on_tick(...)` is a statically-known listener
+    method, not a configurable callable — only *_hook/*_callback/_cb
+    tails count for dotted calls (the engine telemetry surface would
+    otherwise drown the rule)."""
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    self.telemetry.on_tick(1)
+    """, select={"RL006"})
+    assert fs == []
+
+
+# ------------------------------------------- suppressions + CLI plumbing
+
+def test_inline_disable_comment(tmp_path):
+    fs = _lint(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def step(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def scrape(self):
+                return list(self.items)  # racelint: disable=RL004 -- lock-free by contract
+    """, select={"RL004"})
+    assert fs == []
+
+
+def test_noqa_comment(tmp_path):
+    fs = _lint(tmp_path, """
+        import time
+
+        class S:
+            async def h(self):
+                time.sleep(1)  # noqa: RL002
+    """, select={"RL002"})
+    assert fs == []
+
+
+def _cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.racelint", *args],
+        cwd=str(cwd), capture_output=True, text=True)
+
+
+VIOLATION = """
+import time
+
+class S:
+    async def h(self):
+        time.sleep(1)
+"""
+
+
+def test_cli_seeded_violation_exits_nonzero(tmp_path):
+    (tmp_path / "bad.py").write_text(VIOLATION)
+    r = _cli([str(tmp_path / "bad.py"), "--root", str(tmp_path)], REPO)
+    assert r.returncode == 1
+    assert "RL002" in r.stdout
+
+
+def test_cli_fix_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(VIOLATION)
+    base = tmp_path / "baseline.json"
+    r = _cli([str(bad), "--root", str(tmp_path),
+              "--baseline", str(base), "--fix-baseline"], REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    entries = json.loads(base.read_text())["entries"]
+    assert len(entries) == 1
+    # baselined -> clean; keys are line-independent, so adding a
+    # leading comment must not invalidate the entry
+    bad.write_text("# moved\n" + VIOLATION)
+    r = _cli([str(bad), "--root", str(tmp_path),
+              "--baseline", str(base)], REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in (r.stdout + r.stderr)
+
+
+# --------------------------------------------------------- repo gates
+
+def test_rule_catalogue_complete():
+    assert len(ALL_RULES) >= 6
+    assert ALL_RULES == tuple(f"RL{i:03d}" for i in range(1, 7))
+
+
+def test_shipped_baseline_small_and_justified():
+    base = load_baseline(str(REPO / "tools" / "racelint" /
+                             "baseline.json"))
+    assert 0 < len(base.entries) <= 12
+    data = json.loads(
+        (REPO / "tools" / "racelint" / "baseline.json").read_text())
+    for e in data["entries"]:
+        just = e.get("justification", "")
+        assert just and "TODO" not in just, \
+            f"unjustified baseline entry: {e['key']}"
+
+
+def test_repo_clean_against_shipped_baseline():
+    r = _cli(["ray_tpu", "--baseline", "tools/racelint/baseline.json"],
+             REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_llm_and_serving_planes_zero_baseline():
+    """The engine + serving-LLM planes hold a stricter bar: clean
+    with NO baseline at all (every finding there was fixed, or
+    carries an inline justified suppression)."""
+    fs = analyze_paths([str(REPO / "ray_tpu" / "llm" / "_internal"),
+                        str(REPO / "ray_tpu" / "serve" / "llm")],
+                       root=str(REPO))
+    assert fs == [], "\n".join(f.render() for f in fs)

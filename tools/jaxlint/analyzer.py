@@ -22,12 +22,15 @@ motion above a finding never churns the baseline.
 from __future__ import annotations
 
 import ast
-import dataclasses
-import io
 import os
-import re
-import tokenize
 from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..lintcore import (  # noqa: F401  (re-exported public surface)
+    Finding,
+    iter_py_files,
+    normalize_relpath,
+    parse_suppressions,
+)
 
 # Call targets that put their function argument under a jax trace.
 TRACE_ENTRY_NAMES = {
@@ -38,29 +41,6 @@ TRACE_ENTRY_NAMES = {
 }
 # Decorators that mark a def as traced.
 TRACE_DECORATOR_NAMES = {"jit", "pjit", "pmap", "shard_map"}
-
-_SUPPRESS_RE = re.compile(
-    r"#\s*(?:jaxlint:\s*disable=|noqa:\s*)([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
-
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    rule: str
-    path: str          # posix relpath (baseline-stable)
-    line: int          # for humans; NOT part of the baseline key
-    func: str          # qualname of the enclosing function ("" = module)
-    detail: str        # stable symbol-level detail
-    message: str
-
-    @property
-    def key(self) -> str:
-        return f"{self.rule}:{self.path}:{self.func}:{self.detail}"
-
-    def render(self) -> str:
-        where = self.func or "<module>"
-        return (f"{self.path}:{self.line}: {self.rule} [{where}] "
-                f"{self.message}")
-
 
 class FunctionInfo:
     """One function/lambda: identity, trace status, and the call names
@@ -141,29 +121,7 @@ class ModuleInfo:
 
 
 def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
-    out: Dict[int, Set[str]] = {}
-    try:
-        toks = tokenize.generate_tokens(io.StringIO(source).readline)
-        for tok in toks:
-            if tok.type != tokenize.COMMENT:
-                continue
-            m = _SUPPRESS_RE.search(tok.string)
-            if m:
-                rules = {r.strip() for r in m.group(1).split(",")}
-                out.setdefault(tok.start[0], set()).update(rules)
-    except tokenize.TokenError:
-        pass
-    return out
-
-
-def normalize_relpath(path: str, root: str) -> str:
-    """The ONE producer of baseline-key paths (shared by
-    Project.add_file and the CLI's analyzed-paths set — they must
-    never diverge, or scoped --fix-baseline retention breaks)."""
-    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
-    if rel.startswith(".."):
-        rel = os.path.abspath(path)
-    return rel.replace(os.sep, "/")
+    return parse_suppressions(source, "jaxlint")
 
 
 def lookup_assign(mod: "ModuleInfo", ctx: Optional["FunctionInfo"],
@@ -605,22 +563,6 @@ class Project:
         base = base[: len(base) - level] if len(base) >= level else []
         parts = base + ([suffix] if suffix else [])
         return self.by_dotted.get(".".join(p for p in parts if p))
-
-
-def iter_py_files(paths: Iterable[str]) -> List[str]:
-    out: List[str] = []
-    for p in paths:
-        if os.path.isfile(p) and p.endswith(".py"):
-            out.append(p)
-        elif os.path.isdir(p):
-            for dirpath, dirnames, filenames in os.walk(p):
-                dirnames[:] = [d for d in dirnames
-                               if d != "__pycache__"
-                               and not d.startswith(".")]
-                for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        out.append(os.path.join(dirpath, fn))
-    return out
 
 
 def analyze_paths(paths: Iterable[str], root: str = ".",

@@ -1,104 +1,16 @@
-"""Baseline handling: known findings that are accepted WITH a
-justification. New findings (keys not in the baseline, or MORE
-occurrences of a baselined key than the baseline records) fail the
-lint; stale entries (baselined keys no longer found, or found fewer
-times) are warned about so the baseline only ever shrinks — burndown
-is tracked in BENCH_CORE.md.
+"""Baseline handling — now shared machinery in tools/lintcore.
 
-Keys are line-independent (rule:path:function:detail), so each entry
-carries an occurrence COUNT: without it, adding a second identical
-violation to an already-baselined function would be silently
-accepted.
+Kept as a re-export so `tools.jaxlint.baseline` stays a stable import
+path; see tools/lintcore/baseline.py for the semantics (justified
+entries, occurrence counts, scoped --fix-baseline retention).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import json
-from collections import Counter
-from typing import Dict, Iterable, List
+from ..lintcore.baseline import (  # noqa: F401
+    Baseline,
+    load_baseline,
+    write_baseline,
+)
 
-from .analyzer import Finding
-
-
-@dataclasses.dataclass
-class Baseline:
-    entries: Dict[str, str]          # key -> justification
-    counts: Dict[str, int] = dataclasses.field(default_factory=dict)
-
-    def count(self, key: str) -> int:
-        return self.counts.get(key, 1)
-
-    def split(self, findings: Iterable[Finding]):
-        """-> (new_findings, baselined_findings, stale_keys).
-
-        Occurrences of a baselined key beyond its recorded count are
-        NEW (last by line number, so the stable earlier sites stay
-        baselined and the added one is reported); keys found fewer
-        times than recorded are stale ("N-k occurrences fixed")."""
-        new: List[Finding] = []
-        old: List[Finding] = []
-        by_key: Dict[str, List[Finding]] = {}
-        for f in findings:
-            by_key.setdefault(f.key, []).append(f)
-        for key, group in by_key.items():
-            if key not in self.entries:
-                new.extend(group)
-                continue
-            group.sort(key=lambda f: f.line)
-            allowed = self.count(key)
-            old.extend(group[:allowed])
-            new.extend(group[allowed:])
-        stale = []
-        for key in self.entries:
-            found = len(by_key.get(key, ()))
-            if found == 0:
-                stale.append(key)
-            elif found < self.count(key):
-                stale.append(
-                    f"{key} ({self.count(key) - found} of "
-                    f"{self.count(key)} occurrences fixed)")
-        return new, old, sorted(stale)
-
-
-def load_baseline(path: str) -> Baseline:
-    with open(path, "r", encoding="utf-8") as f:
-        raw = json.load(f)
-    entries: Dict[str, str] = {}
-    counts: Dict[str, int] = {}
-    for e in raw.get("entries", []):
-        entries[e["key"]] = e.get("justification", "")
-        counts[e["key"]] = int(e.get("count", 1))
-    return Baseline(entries, counts)
-
-
-def write_baseline(path: str, findings: Iterable[Finding],
-                   prior: Baseline = None,
-                   analyzed_paths: Iterable[str] = None) -> int:
-    """Rewrite the baseline from current findings, carrying forward
-    existing justifications; new entries get an explicit TODO that the
-    lint test refuses to ship.
-
-    analyzed_paths: the relpaths this run actually looked at. Prior
-    entries for files OUTSIDE that set are retained untouched —
-    running --fix-baseline on a subdirectory must not destroy the
-    rest of the tree's entries (their staleness cannot be judged
-    from a scoped run)."""
-    prior_entries = prior.entries if prior else {}
-    prior_counts = prior.counts if prior else {}
-    counts = Counter(f.key for f in findings)
-    if analyzed_paths is not None:
-        analyzed = set(analyzed_paths)
-        for key in prior_entries:
-            key_path = key.split(":", 2)[1]
-            if key_path not in analyzed and key not in counts:
-                counts[key] = prior_counts.get(key, 1)
-    entries = [{"key": k,
-                "count": counts[k],
-                "justification": prior_entries.get(
-                    k, "TODO: justify or fix")}
-               for k in sorted(counts)]
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump({"entries": entries}, f, indent=2, sort_keys=False)
-        f.write("\n")
-    return len(entries)
+__all__ = ["Baseline", "load_baseline", "write_baseline"]

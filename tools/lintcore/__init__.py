@@ -1,0 +1,31 @@
+"""lintcore: the tool-agnostic machinery shared by the repo's static
+analyzers (tools/jaxlint for dispatch discipline, tools/racelint for
+host-concurrency discipline).
+
+What lives here is exactly the part that does not know what a rule
+is: the Finding record with its line-independent baseline key, the
+baseline store (justified accepted findings, occurrence counts,
+scoped --fix-baseline retention), the inline-suppression parser
+(`# <tool>: disable=XX123 -- reason`, plus shared `# noqa:`), file
+discovery, and the CLI scaffold (exit codes, output format, baseline
+plumbing). Each analyzer keeps its own indexer and rule catalogue.
+
+Stdlib only — no new dependencies.
+"""
+
+from .findings import Finding  # noqa: F401
+from .fsutil import iter_py_files, normalize_relpath  # noqa: F401
+from .suppress import parse_suppressions, suppress_pattern  # noqa: F401
+from .baseline import (  # noqa: F401
+    Baseline,
+    load_baseline,
+    write_baseline,
+)
+from .cli import run_cli  # noqa: F401
+
+__all__ = [
+    "Finding", "iter_py_files", "normalize_relpath",
+    "parse_suppressions", "suppress_pattern",
+    "Baseline", "load_baseline", "write_baseline",
+    "run_cli",
+]

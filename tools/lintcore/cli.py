@@ -1,0 +1,110 @@
+"""Shared CLI scaffold: python -m tools.<tool> PATH... [--baseline F]
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage error. `--fix-baseline` rewrites the baseline from the
+current findings (carrying forward justifications; additions get a
+TODO placeholder each tool's tier-1 lint test refuses to ship — write
+the justification before committing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Callable, Iterable, List, Optional, Set
+
+from .baseline import Baseline, load_baseline, write_baseline
+from .findings import Finding
+from .fsutil import iter_py_files, normalize_relpath
+
+
+def _relpaths(paths, root):
+    """Baseline-key relpaths of the files this run analyzed."""
+    return {normalize_relpath(p, root) for p in iter_py_files(paths)}
+
+
+def run_cli(argv: Optional[List[str]], *, prog: str, description: str,
+            label: str, all_rules: Iterable[str],
+            analyze: Callable[..., List[Finding]]) -> int:
+    """The whole CLI, minus what makes a tool a tool.
+
+    `analyze(paths, root=..., select=...)` is the tool's driver;
+    `label` prefixes the status lines ("[jaxlint] clean: ...")."""
+    ap = argparse.ArgumentParser(prog=prog, description=description)
+    ap.add_argument("paths", nargs="+", help="files or directories")
+    ap.add_argument("--baseline", help="baseline JSON of accepted "
+                                       "findings (with justifications)")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--select", help="comma-separated rule ids "
+                                     "(default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--root", default=".",
+                    help="path-key root (default: cwd)")
+    args = ap.parse_args(argv)
+
+    select = None
+    if args.select:
+        select = {r.strip() for r in args.select.split(",")}
+        unknown = select - set(all_rules)
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    findings = analyze(args.paths, root=args.root, select=select)
+
+    baseline = Baseline({})
+    if args.baseline and not args.fix_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except FileNotFoundError:
+            print(f"baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+    if args.fix_baseline:
+        if not args.baseline:
+            print("--fix-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        if select:
+            # a rule-filtered rewrite would silently delete every
+            # entry for the unselected rules
+            print("--fix-baseline cannot be combined with --select",
+                  file=sys.stderr)
+            return 2
+        prior = Baseline({})
+        try:
+            prior = load_baseline(args.baseline)
+        except FileNotFoundError:
+            pass
+        n = write_baseline(args.baseline, findings, prior,
+                           analyzed_paths=_relpaths(args.paths,
+                                                    args.root))
+        print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+              f"to {args.baseline}")
+        return 0
+
+    new, old, stale = baseline.split(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) | {"key": f.key} for f in new],
+            "baselined": [f.key for f in old],
+            "stale_baseline_keys": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if old:
+            print(f"[{label}] {len(old)} baselined finding(s) "
+                  f"suppressed", file=sys.stderr)
+        for k in stale:
+            print(f"[{label}] stale baseline entry (fixed? remove "
+                  f"it): {k}", file=sys.stderr)
+        if not new:
+            print(f"[{label}] clean: {len(findings)} finding(s), "
+                  f"0 new", file=sys.stderr)
+    return 1 if new else 0

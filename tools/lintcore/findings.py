@@ -1,0 +1,29 @@
+"""The Finding record shared by every analyzer.
+
+Findings carry a line number for humans but their BASELINE KEY is
+line-independent (rule : path : function-qualname : detail) so code
+motion above a finding never churns the baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # posix relpath (baseline-stable)
+    line: int          # for humans; NOT part of the baseline key
+    func: str          # qualname of the enclosing function ("" = module)
+    detail: str        # stable symbol-level detail
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.func}:{self.detail}"
+
+    def render(self) -> str:
+        where = self.func or "<module>"
+        return (f"{self.path}:{self.line}: {self.rule} [{where}] "
+                f"{self.message}")

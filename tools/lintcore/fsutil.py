@@ -1,0 +1,32 @@
+"""Path normalization + file discovery shared by every analyzer."""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List
+
+
+def normalize_relpath(path: str, root: str) -> str:
+    """The ONE producer of baseline-key paths (shared by the
+    analyzers' add_file and the CLI's analyzed-paths set — they must
+    never diverge, or scoped --fix-baseline retention breaks)."""
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    if rel.startswith(".."):
+        rel = os.path.abspath(path)
+    return rel.replace(os.sep, "/")
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out

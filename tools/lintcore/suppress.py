@@ -1,0 +1,40 @@
+"""Inline-suppression parsing.
+
+Each tool has its own disable prefix (`# jaxlint: disable=JL006`,
+`# racelint: disable=RL001`) so a jaxlint suppression can never
+accidentally silence racelint on the same line; the bare `# noqa:`
+form is shared. A justification rides in the same comment after
+` -- `, by convention enforced by each tool's tier-1 lint test.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Pattern, Set
+
+_RULE_LIST = r"([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+
+
+def suppress_pattern(tool: str) -> Pattern[str]:
+    return re.compile(
+        rf"#\s*(?:{re.escape(tool)}:\s*disable=|noqa:\s*)" + _RULE_LIST)
+
+
+def parse_suppressions(source: str, tool: str) -> Dict[int, Set[str]]:
+    """line -> set of rule ids disabled on that line."""
+    pattern = suppress_pattern(tool)
+    out: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = pattern.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out

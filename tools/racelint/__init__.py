@@ -1,0 +1,33 @@
+"""racelint: host-concurrency race/lock-discipline analyzer.
+
+The reference Ray enforces concurrency discipline in a C++ core; this
+rebuild's host plane is Python — the engine pump thread, the asyncio
+ingress loop, fleet refresh/watchdog loops, and the scrape path all
+share mutable state guarded (by convention) by `_step_lock`. racelint
+checks that convention mechanically: lock-set inference from `with
+self._lock:` scopes (cross-method, via intra-class call-site
+propagation), plus rules for blocking calls on the event loop,
+lock-order cycles, unlocked iteration of locked containers, untracked
+threads, and callbacks invoked under a lock (RL001-RL006; see
+README.md).
+
+Paired with the **runtime** half, `ray_tpu/util/thread_sanitizer.py`
+(instrumented locks + guarded-field descriptors, armed in tier-1
+stress tests). Shares baseline/suppression/CLI machinery with
+jaxlint via tools/lintcore. Stdlib `ast` only; no new dependencies.
+"""
+
+from ..lintcore import (  # noqa: F401
+    Baseline,
+    Finding,
+    iter_py_files,
+    load_baseline,
+    write_baseline,
+)
+from .analyzer import ConcurrencyModule, ConcurrencyProject, analyze_paths  # noqa: F401
+
+__all__ = [
+    "Finding", "ConcurrencyModule", "ConcurrencyProject",
+    "analyze_paths", "iter_py_files",
+    "Baseline", "load_baseline", "write_baseline",
+]

@@ -1,0 +1,30 @@
+"""CLI: python -m tools.racelint PATH... [--baseline FILE]
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage error. Shares the scaffold (and therefore flags, exit
+codes, and output format) with jaxlint via tools/lintcore/cli.py.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..lintcore import run_cli
+from .analyzer import analyze_paths
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    return run_cli(
+        argv,
+        prog="python -m tools.racelint",
+        description="host-concurrency race/lock-discipline analyzer "
+                    "(rules RL001-RL006; see tools/racelint/README.md)",
+        label="racelint",
+        all_rules=ALL_RULES,
+        analyze=analyze_paths,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

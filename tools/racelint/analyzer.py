@@ -1,0 +1,596 @@
+"""Concurrency indexing + driver for racelint.
+
+The indexer builds, per class, a picture of the host threading plane:
+
+- **Lock fields** — `self._step_lock = threading.Lock()` (also RLock /
+  Condition / Semaphore, and the sanitizer's `make_lock(...)`), plus
+  module-level locks. Lock identity is `{Class}.{field}` so the
+  acquisition graph is stable across instances.
+- **Lock sets** — every interesting event (field write, container
+  iteration, call, lock acquisition, thread construction) is recorded
+  with the set of locks held at that point, from `with self._lock:`
+  nesting. Cross-method inference: a private method's ENTRY lock set
+  is the intersection over its intra-class call sites of (caller
+  entry set ∪ locks held at the site), to a fixpoint — so a
+  `_foo_locked` helper called only under `_step_lock` counts as
+  locked without any annotation. Public methods (and methods with no
+  intra-class callers) get an empty entry set: external callers hold
+  nothing.
+- **Async context** — whether an event sits directly in an
+  `async def` body (not inside a nested `def`), for the
+  blocking-call-on-the-event-loop rule.
+
+Rules (rules.py) consume this index per module; there is no
+cross-module propagation — the serving plane's locks are
+class-scoped by design, and cross-module guessing is how false
+positives happen.
+
+Findings reuse lintcore's line-independent baseline keys
+(rule:path:function:detail) and `# racelint: disable=RLnnn -- reason`
+suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from ..lintcore import (
+    Finding,
+    iter_py_files,
+    normalize_relpath,
+    parse_suppressions,
+)
+
+# Constructors whose result is a lock-like object, by call-name tail.
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",
+              "Semaphore": "sem", "BoundedSemaphore": "sem",
+              "make_lock": "lock"}
+
+# Constructors whose result is a shared mutable container (RL004
+# tracks iterate-vs-mutate on these).
+CONTAINER_CTORS = {"list", "dict", "set", "deque", "defaultdict",
+                   "Counter", "OrderedDict"}
+
+# Method calls that mutate a container in place.
+MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
+                   "add", "insert", "remove", "discard", "pop",
+                   "popleft", "popitem", "clear", "update",
+                   "setdefault", "rotate", "sort", "reverse"}
+
+# Builtins whose call iterates their (first) argument.
+ITERATING_BUILTINS = {"list", "tuple", "sorted", "set", "frozenset",
+                      "dict", "sum", "max", "min", "any", "all",
+                      "enumerate"}
+
+# Snapshot-style accessor tails: `self.f.values()` etc. iterate f.
+VIEW_METHODS = {"values", "items", "keys", "copy"}
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _self_field(node: ast.AST) -> Optional[str]:
+    """'f' for a bare `self.f` / `cls.f` attribute node."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return node.attr
+    return None
+
+
+class Event:
+    """One indexed occurrence inside a method body. `holds` is the
+    LOCAL lock set (with-nesting inside this method); the effective
+    set is entry_lockset | holds, resolved after the fixpoint."""
+
+    __slots__ = ("kind", "name", "holds", "line", "async_direct",
+                 "extra")
+
+    def __init__(self, kind: str, name: str, holds: FrozenSet[str],
+                 line: int, async_direct: bool, extra=None):
+        self.kind = kind        # write|iter|acquire|call|self_call|thread
+        self.name = name
+        self.holds = holds
+        self.line = line
+        self.async_direct = async_direct
+        self.extra = extra
+
+
+class MethodIndex:
+    def __init__(self, name: str, qualname: str, class_name: str,
+                 node: ast.AST, is_async: bool):
+        self.name = name
+        self.qualname = qualname
+        self.class_name = class_name
+        self.node = node
+        self.is_async = is_async
+        self.events: List[Event] = []
+        self.entry: FrozenSet[str] = frozenset()
+        # call sites of this method from class siblings, filled by
+        # ClassIndex.infer_entry_locksets
+        self._entry_known = False
+
+    def lockset(self, ev: Event) -> FrozenSet[str]:
+        return self.entry | ev.holds
+
+    @property
+    def is_init(self) -> bool:
+        return (self.name == "__init__"
+                or self.qualname.split(".")[-1] == "__init__"
+                or ".__init__." in f".{self.qualname}.")
+
+
+class ClassIndex:
+    def __init__(self, name: str):
+        self.name = name
+        self.lock_fields: Dict[str, str] = {}       # field -> kind
+        self.container_fields: Set[str] = set()
+        self.async_fields: Set[str] = set()          # asyncio.X() values
+        self.methods: Dict[str, MethodIndex] = {}
+        self.nested: List[MethodIndex] = []          # closures etc.
+        self.joined_fields: Set[str] = set()         # self.X with X.join()
+        self.daemon_fields: Set[str] = set()         # self.X.daemon = True
+
+    def all_methods(self) -> List[MethodIndex]:
+        return list(self.methods.values()) + self.nested
+
+    def lock_id(self, field: str) -> str:
+        return f"{self.name}.{field}"
+
+    def lock_kind(self, lock_id: str) -> str:
+        field = lock_id.rsplit(".", 1)[-1]
+        return self.lock_fields.get(field, "lock")
+
+    def infer_entry_locksets(self) -> None:
+        """Fixpoint over intra-class call sites. Only private methods
+        (leading underscore, not dunder) inherit — a public method is
+        an API surface and must assume callers hold nothing."""
+        sites: Dict[str, List[Tuple[MethodIndex, FrozenSet[str]]]] = {}
+        for m in self.all_methods():
+            for ev in m.events:
+                if ev.kind == "self_call" and ev.name in self.methods:
+                    sites.setdefault(ev.name, []).append((m, ev.holds))
+        for _ in range(20):
+            changed = False
+            for name, method in self.methods.items():
+                if (not name.startswith("_") or name.startswith("__")
+                        or name not in sites):
+                    continue
+                new = None
+                for caller, holds in sites[name]:
+                    s = caller.entry | holds
+                    new = s if new is None else (new & s)
+                new = new or frozenset()
+                if new != method.entry:
+                    method.entry = frozenset(new)
+                    changed = True
+            if not changed:
+                break
+
+
+class ConcurrencyModule:
+    def __init__(self, path: str, relpath: str, tree: ast.Module,
+                 source: str):
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.source = source
+        self.classes: Dict[str, ClassIndex] = {}
+        self.module_locks: Dict[str, str] = {}      # name -> kind
+        self.functions: List[MethodIndex] = []       # module-level defs
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.local_joins: Set[str] = set()           # "qualname:var"
+        self.local_daemons: Set[str] = set()
+
+    def all_methods(self) -> List[MethodIndex]:
+        out = list(self.functions)
+        for cls in self.classes.values():
+            out.extend(cls.all_methods())
+        return out
+
+    def suppressed(self, rule: str, line: int,
+                   method: Optional[MethodIndex]) -> bool:
+        """A disable comment suppresses on its own line or, placed on
+        any line of the enclosing `def` signature, for the whole
+        function (`# racelint: disable=RL001 -- reason`)."""
+        if rule in self.suppressions.get(line, ()):
+            return True
+        if method is not None:
+            node = method.node
+            body = getattr(node, "body", None)
+            end = (body[0].lineno if isinstance(body, list) and body
+                   else node.lineno + 1)
+            if any(rule in self.suppressions.get(ln, ())
+                   for ln in range(node.lineno, end)):
+                return True
+        return False
+
+
+class _FunctionWalker:
+    """Walks ONE function body statement-by-statement, maintaining the
+    with-nesting lock stack; nested defs are queued for their own
+    walk (empty entry lock set — their execution time is unknown)."""
+
+    def __init__(self, mod: ConcurrencyModule, cls: Optional[ClassIndex],
+                 method: MethodIndex):
+        self.mod = mod
+        self.cls = cls
+        self.method = method
+        self.holds: List[str] = []
+        self.nested_defs: List[ast.AST] = []
+
+    # -- lock identity -------------------------------------------------
+    def _lock_id_for(self, expr: ast.AST) -> Optional[str]:
+        field = _self_field(expr)
+        if field is not None and self.cls is not None \
+                and field in self.cls.lock_fields:
+            return self.cls.lock_id(field)
+        if isinstance(expr, ast.Name) \
+                and expr.id in self.mod.module_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+    # -- event emission ------------------------------------------------
+    def _emit(self, kind: str, name: str, line: int, extra=None):
+        self.method.events.append(Event(
+            kind, name, frozenset(self.holds), line,
+            self.method.is_async, extra))
+
+    # -- statement dispatch --------------------------------------------
+    def walk(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.nested_defs.append(stmt)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in stmt.items:
+                self._exprs(item.context_expr)
+                lock = self._lock_id_for(item.context_expr)
+                if lock is not None:
+                    self._emit("acquire", lock, stmt.lineno)
+                    self.holds.append(lock)
+                    acquired.append(lock)
+            self.walk(stmt.body)
+            for _ in acquired:
+                self.holds.pop()
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._iteration(stmt.iter)
+            self._exprs(stmt.iter)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._exprs(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.If):
+            self._exprs(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for h in stmt.handlers:
+                self.walk(h.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            field = _self_field(stmt.target)
+            if field is None and isinstance(stmt.target, ast.Subscript):
+                field = _self_field(stmt.target.value)
+            if field is not None:
+                self._emit("write", field, stmt.lineno, "augassign")
+            self._exprs(stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            field = _self_field(stmt.target)
+            if field is not None and stmt.value is not None:
+                self._emit("write", field, stmt.lineno, "assign")
+            if stmt.value is not None:
+                self._exprs(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Subscript):
+                    field = _self_field(tgt.value)
+                    if field is not None:
+                        self._emit("write", field, tgt.lineno, "del")
+            return
+        # Expr / Return / Raise / Assert / simple statements: scan
+        # their expression trees
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._exprs(child)
+
+    # -- assignments ---------------------------------------------------
+    def _assign(self, stmt: ast.Assign) -> None:
+        bound: Optional[str] = None
+        for tgt in stmt.targets:
+            field = _self_field(tgt)
+            if field is not None:
+                self._emit("write", field, stmt.lineno, "assign")
+                bound = f"self.{field}"
+            elif isinstance(tgt, ast.Subscript):
+                sub = _self_field(tgt.value)
+                if sub is not None:
+                    self._emit("write", sub, stmt.lineno, "setitem")
+            elif isinstance(tgt, ast.Name):
+                bound = tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                # `t.daemon = True` on a local thread handle
+                if tgt.attr == "daemon" and isinstance(tgt.value,
+                                                      ast.Name):
+                    self.mod.local_daemons.add(
+                        f"{self.method.qualname}:{tgt.value.id}")
+                dfield = _self_field(tgt.value)
+                if tgt.attr == "daemon" and dfield is not None \
+                        and self.cls is not None:
+                    self.cls.daemon_fields.add(dfield)
+        self._exprs(stmt.value, bound_to=bound)
+
+    # -- expression scanning -------------------------------------------
+    def _exprs(self, node: ast.AST, bound_to: Optional[str] = None):
+        """Scan an expression tree for events. Does not descend into
+        lambdas / nested defs; comprehension iterables count as
+        iterations. Calls directly under `await` are marked — awaiting
+        a coroutine is how the loop is SUPPOSED to wait."""
+        awaited = {id(sub.value) for sub in self._walk_expr(node)
+                   if isinstance(sub, ast.Await)
+                   and isinstance(sub.value, ast.Call)}
+        for sub in self._walk_expr(node):
+            if isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                for gen in sub.generators:
+                    self._iteration(gen.iter)
+            elif isinstance(sub, ast.Call):
+                self._call(sub, bound_to if sub is node else None,
+                           awaited=id(sub) in awaited)
+
+    def _walk_expr(self, node: ast.AST):
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                continue
+            yield cur
+            stack.extend(ast.iter_child_nodes(cur))
+
+    def _iteration(self, expr: ast.AST) -> None:
+        """`for x in <expr>` / comprehension iterable: is it a shared
+        self-container (directly, or via .values()/.items()/...)?"""
+        field = _self_field(expr)
+        if field is None and isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in VIEW_METHODS:
+            field = _self_field(expr.func.value)
+        if field is not None:
+            self._emit("iter", field, expr.lineno)
+
+    def _call(self, call: ast.Call, bound_to: Optional[str] = None,
+              awaited: bool = False) -> None:
+        name = dotted_name(call.func)
+        if not name and isinstance(call.func, ast.Attribute):
+            # method call on a computed receiver, e.g.
+            # `asyncio.get_running_loop().run_in_executor(...)` —
+            # keep the attr so loop-awareness checks still see it
+            name = f"?.{call.func.attr}"
+        tail = name.split(".")[-1] if name else ""
+        # container mutation: self.f.append(...)
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in MUTATOR_METHODS:
+            field = _self_field(call.func.value)
+            if field is not None:
+                self._emit("write", field, call.lineno, "mutcall")
+        # iterating builtin: sorted(self.f), list(self.f.items())...
+        if tail in ITERATING_BUILTINS and "." not in name and call.args:
+            self._iteration(call.args[0])
+        # thread construction
+        if tail == "Thread" and name in ("Thread", "threading.Thread"):
+            daemon = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in call.keywords)
+            self._emit("thread", bound_to or "", call.lineno, daemon)
+        # .join() / .setDaemon() tracking for RL005
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("join", "setDaemon"):
+            recv = call.func.value
+            field = _self_field(recv)
+            dest = (self.cls.joined_fields if call.func.attr == "join"
+                    else self.cls.daemon_fields) if self.cls else None
+            if field is not None and dest is not None:
+                dest.add(field)
+            elif isinstance(recv, ast.Name):
+                key = f"{self.method.qualname}:{recv.id}"
+                (self.mod.local_joins if call.func.attr == "join"
+                 else self.mod.local_daemons).add(key)
+        # self-method calls (for entry-lockset inference + RL002/RL006)
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id in ("self", "cls"):
+            self._emit("self_call", call.func.attr, call.lineno,
+                       {"nargs": len(call.args) + len(call.keywords),
+                        "awaited": awaited})
+        elif name:
+            async_recv = (self.cls is not None
+                          and isinstance(call.func, ast.Attribute)
+                          and _self_field(call.func.value)
+                          in self.cls.async_fields)
+            self._emit("call", name, call.lineno,
+                       {"nargs": len(call.args) + len(call.keywords),
+                        "awaited": awaited, "async_recv": async_recv})
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    """Top-level walk: classes, their methods, module functions,
+    module locks. Bodies are handed to _FunctionWalker."""
+
+    def __init__(self, mod: ConcurrencyModule):
+        self.mod = mod
+
+    def index(self) -> None:
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._index_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self._index_function(stmt, None, stmt.name)
+            elif isinstance(stmt, ast.Assign):
+                self._module_assign(stmt)
+
+    def _module_assign(self, stmt: ast.Assign) -> None:
+        kind = _lock_ctor_kind(stmt.value)
+        if kind is None:
+            return
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                self.mod.module_locks[tgt.id] = kind
+
+    def _index_class(self, node: ast.ClassDef) -> None:
+        cls = ClassIndex(node.name)
+        self.mod.classes[node.name] = cls
+        # pass 1: find lock + container fields from every method body
+        # (they are almost always in __init__, but restores/rebinds
+        # happen elsewhere)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+                value = sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets = [sub.target]
+                value = sub.value
+            else:
+                continue
+            for tgt in targets:
+                field = _self_field(tgt)
+                if field is None:
+                    continue
+                kind = _lock_ctor_kind(value)
+                if kind is not None:
+                    cls.lock_fields[field] = kind
+                elif _is_container_ctor(value):
+                    cls.container_fields.add(field)
+                if isinstance(value, ast.Call) and dotted_name(
+                        value.func).startswith("asyncio."):
+                    # e.g. self._q = asyncio.Queue(): methods on it
+                    # return awaitables, they don't block the loop
+                    cls.async_fields.add(field)
+        # pass 2: walk method bodies
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(stmt, cls, stmt.name)
+
+    def _index_function(self, node, cls: Optional[ClassIndex],
+                        name: str, qual_prefix: str = "") -> None:
+        qual = (f"{qual_prefix}.{name}" if qual_prefix
+                else (f"{cls.name}.{name}" if cls else name))
+        m = MethodIndex(name, qual, cls.name if cls else "", node,
+                        isinstance(node, ast.AsyncFunctionDef))
+        if cls is not None and not qual_prefix:
+            cls.methods[name] = m
+        elif cls is not None:
+            cls.nested.append(m)
+        else:
+            self.mod.functions.append(m)
+        walker = _FunctionWalker(self.mod, cls, m)
+        walker.walk(node.body)
+        for nested in walker.nested_defs:
+            self._index_function(nested, cls, nested.name,
+                                 qual_prefix=qual)
+
+
+def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    tail = name.split(".")[-1]
+    if tail not in LOCK_CTORS:
+        return None
+    base = name.split(".")[0]
+    if tail == "make_lock" or base in ("threading", "thread_sanitizer",
+                                       tail):
+        return LOCK_CTORS[tail]
+    return None
+
+
+def _is_container_ctor(value: ast.AST) -> bool:
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        tail = dotted_name(value.func).split(".")[-1]
+        return tail in CONTAINER_CTORS
+    return False
+
+
+class ConcurrencyProject:
+    def __init__(self, root: str = "."):
+        self.root = os.path.abspath(root)
+        self.modules: List[ConcurrencyModule] = []
+
+    def add_file(self, path: str) -> Optional[ConcurrencyModule]:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return None
+        rel = normalize_relpath(path, self.root)
+        mod = ConcurrencyModule(path, rel, tree, source)
+        mod.suppressions = parse_suppressions(source, "racelint")
+        _ModuleIndexer(mod).index()
+        for cls in mod.classes.values():
+            cls.infer_entry_locksets()
+        self.modules.append(mod)
+        return mod
+
+
+def analyze_paths(paths: Iterable[str], root: str = ".",
+                  select: Optional[Set[str]] = None) -> List[Finding]:
+    """Analyze files/dirs, returning suppression-filtered findings."""
+    from . import rules
+    project = ConcurrencyProject(root)
+    for path in iter_py_files(paths):
+        project.add_file(path)
+    kept: List[Finding] = []
+    for mod in project.modules:
+        for f in rules.check_module(mod):
+            if select and f.rule not in select:
+                continue
+            method = _find_method(mod, f.func)
+            if not mod.suppressed(f.rule, f.line, method):
+                kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+def _find_method(mod: ConcurrencyModule,
+                 qualname: str) -> Optional[MethodIndex]:
+    for m in mod.all_methods():
+        if m.qualname == qualname:
+            return m
+    return None

@@ -1,0 +1,376 @@
+"""The racelint rule catalogue (RL001-RL006).
+
+Each rule is tuned to this codebase's host-concurrency hazards (see
+README.md for rationale + fix patterns). Rules are deliberately
+narrow: a finding should either be fixed or carry a justified
+suppression/baseline entry — noisy rules rot baselines.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterable, List, Set, Tuple
+
+from ..lintcore import Finding
+from .analyzer import (ClassIndex, ConcurrencyModule, Event,
+                       MethodIndex)
+
+ALL_RULES = ("RL001", "RL002", "RL003", "RL004", "RL005", "RL006")
+
+# Engine entry points that serialize on the step lock (or touch the
+# device): calling one synchronously from an event-loop coroutine
+# stalls every session on that server for up to a whole tick.
+ENGINE_BLOCKING = {
+    "step", "abort", "add_request", "preempt", "export_session",
+    "import_session", "session_ids", "register_lora", "register_loras",
+    "stats", "lane_counts", "import_prefix", "export_prefix",
+    "profile_next_ticks", "dump_blackbox",
+}
+
+# Synchronous HTTP / process / misc blocking callees (RL002).
+BLOCKING_CALLS = {
+    "time.sleep",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.request",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+
+_CALLBACK_RE = re.compile(r"^(on_\w+|\w*_hook|\w*_callback|\w*_cb)$")
+# For dotted calls (`self.recorder.alert_hook(...)`) only the
+# explicitly-callback-named tails count: `obj.on_x(...)` is usually a
+# statically-known listener method (the telemetry surface), not a
+# configurable callable.
+_CALLBACK_ATTR_RE = re.compile(r"^(\w*_hook|\w*_callback|\w*_cb)$")
+
+_WRITE_KINDS_MUT = ("mutcall", "setitem", "augassign", "del", "assign")
+
+
+def _f(rule: str, mod: ConcurrencyModule, m: MethodIndex, line: int,
+       detail: str, message: str) -> Finding:
+    return Finding(rule=rule, path=mod.relpath, line=line,
+                   func=m.qualname, detail=detail, message=message)
+
+
+def _lock_names(locks: Iterable[str]) -> str:
+    return ", ".join(sorted(locks))
+
+
+# ---------------------------------------------------------------- RL001
+def check_rl001(mod: ConcurrencyModule,
+                cls: ClassIndex) -> Iterable[Finding]:
+    """A mutable field written both under a lock and outside it: the
+    unlocked writer races the locked one (the classic lost-update —
+    `add_request` appending to `waiting` while `step` rebinds it)."""
+    writes: Dict[str, List[Tuple[MethodIndex, Event]]] = {}
+    for m in cls.all_methods():
+        if m.is_init:
+            continue
+        for ev in m.events:
+            if ev.kind == "write" and ev.name not in cls.lock_fields:
+                writes.setdefault(ev.name, []).append((m, ev))
+    for field, sites in writes.items():
+        locked = [(m, ev) for m, ev in sites if m.lockset(ev)]
+        unlocked = [(m, ev) for m, ev in sites if not m.lockset(ev)]
+        if not locked or not unlocked:
+            continue
+        owners = set()
+        for m, ev in locked:
+            owners |= m.lockset(ev)
+        for m, ev in unlocked:
+            yield _f("RL001", mod, m, ev.line, f"field:{field}",
+                     f"`self.{field}` is written here without a lock "
+                     f"but elsewhere under {_lock_names(owners)} — "
+                     f"unlocked writers race the locked ones")
+
+
+# ---------------------------------------------------------------- RL002
+def _blocking_reason(ev: Event) -> str:
+    """'' if the call is loop-safe; else why it blocks."""
+    if isinstance(ev.extra, dict) and ev.extra.get("async_recv"):
+        return ""        # method on an asyncio object: awaitable
+    name, tail = ev.name, ev.name.split(".")[-1]
+    if name in BLOCKING_CALLS:
+        return f"`{name}` blocks the event loop"
+    if tail == "urlopen":
+        return f"`{name}` does synchronous I/O on the event loop"
+    if tail == "acquire" and "lock" in name.lower():
+        return f"`{name}` can block the event loop behind the holder"
+    recv = name.split(".")[:-1]
+    if tail in ENGINE_BLOCKING and any(
+            "engine" in seg.lower() or seg == "eng" for seg in recv):
+        return (f"`{name}` serializes on the engine step lock (up to "
+                f"a full tick) — run it via run_in_executor")
+    if tail == "get" and isinstance(ev.extra, dict) \
+            and ev.extra.get("nargs") == 0 and recv:
+        seg = recv[-1].lower()
+        if "queue" in seg or seg.endswith("_q"):
+            return f"unbounded `{name}()` blocks until an item arrives"
+    return ""
+
+
+def _method_blocks(m: MethodIndex) -> Tuple[str, int]:
+    """First blocking event in a sync method body (for the one-hop
+    async -> sync helper propagation). -> (reason, line) or ('', 0).
+
+    A helper that itself calls `run_in_executor`/`to_thread` is
+    loop-AWARE: its blocking branches are off-loop fallbacks by
+    construction (the server's `_abort_off_loop` teardown path), so
+    it is exempt."""
+    for ev in m.events:
+        if ev.kind == "call" and ev.name.split(".")[-1] in (
+                "run_in_executor", "to_thread"):
+            return "", 0
+    for ev in m.events:
+        if ev.kind == "call":
+            reason = _blocking_reason(ev)
+            if reason:
+                return reason, ev.line
+        if ev.kind == "acquire":
+            return f"acquires `{ev.name}`", ev.line
+    return "", 0
+
+
+def check_rl002(mod: ConcurrencyModule,
+                cls: ClassIndex) -> Iterable[Finding]:
+    """Blocking call directly in an `async def` body: stalls every
+    coroutine sharing the event loop (heartbeats, aborts, scrapes)."""
+    for m in cls.all_methods() if cls else mod.functions:
+        if not m.is_async:
+            continue
+        for ev in m.events:
+            if not ev.async_direct:
+                continue
+            if isinstance(ev.extra, dict) and ev.extra.get("awaited"):
+                continue
+            if ev.kind == "call":
+                reason = _blocking_reason(ev)
+                if reason:
+                    yield _f("RL002", mod, m, ev.line,
+                             f"call:{ev.name}",
+                             f"{reason} (inside `async def {m.name}`)")
+            elif ev.kind == "acquire":
+                yield _f("RL002", mod, m, ev.line,
+                         f"with:{ev.name}",
+                         f"`with {ev.name}` blocks the event loop "
+                         f"behind whichever thread holds it (inside "
+                         f"`async def {m.name}`)")
+            elif ev.kind == "self_call" and cls is not None \
+                    and not (isinstance(ev.extra, dict)
+                             and ev.extra.get("awaited")):
+                callee = cls.methods.get(ev.name)
+                if callee is None or callee.is_async:
+                    continue
+                reason, _line = _method_blocks(callee)
+                if reason:
+                    yield _f("RL002", mod, m, ev.line,
+                             f"call:self.{ev.name}",
+                             f"`self.{ev.name}()` {reason} — called "
+                             f"directly from `async def {m.name}`")
+
+
+# ---------------------------------------------------------------- RL003
+def _acquisition_edges(mod: ConcurrencyModule
+                       ) -> Dict[Tuple[str, str],
+                                 Tuple[MethodIndex, int]]:
+    edges: Dict[Tuple[str, str], Tuple[MethodIndex, int]] = {}
+    for m in mod.all_methods():
+        for ev in m.events:
+            if ev.kind != "acquire":
+                continue
+            held = m.lockset(ev)
+            for h in held:
+                if h != ev.name:
+                    edges.setdefault((h, ev.name), (m, ev.line))
+    return edges
+
+
+def check_rl003(mod: ConcurrencyModule) -> Iterable[Finding]:
+    """Lock-order cycle in the nested-`with` acquisition graph: two
+    threads taking the same pair of locks in opposite orders can
+    deadlock even if each path individually looks fine."""
+    edges = _acquisition_edges(mod)
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == path[0] and len(path) > 1:
+                    i = path.index(min(path))
+                    canon = tuple(path[i:] + path[:i])
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    m, line = edges[(path[-1], path[0])]
+                    order = "->".join(canon + (canon[0],))
+                    yield _f("RL003", mod, m, line,
+                             f"cycle:{order}",
+                             f"lock-order cycle {order}: another "
+                             f"thread acquiring in the opposite order "
+                             f"deadlocks")
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+
+# ---------------------------------------------------------------- RL004
+def check_rl004(mod: ConcurrencyModule,
+                cls: ClassIndex) -> Iterable[Finding]:
+    """A shared container mutated under a lock but iterated without
+    it elsewhere: the iterator sees a torn view or raises `RuntimeError:
+    ... changed size during iteration` mid-scrape."""
+    for field in sorted(cls.container_fields):
+        if field in cls.lock_fields:
+            continue
+        mut_locks: Set[str] = set()
+        for m in cls.all_methods():
+            if m.is_init:
+                continue
+            for ev in m.events:
+                if ev.kind == "write" and ev.name == field \
+                        and ev.extra in _WRITE_KINDS_MUT:
+                    mut_locks |= m.lockset(ev)
+        if not mut_locks:
+            continue
+        for m in cls.all_methods():
+            if m.is_init:
+                continue
+            for ev in m.events:
+                if ev.kind == "iter" and ev.name == field \
+                        and not (m.lockset(ev) & mut_locks):
+                    yield _f("RL004", mod, m, ev.line,
+                             f"field:{field}",
+                             f"`self.{field}` is iterated here "
+                             f"without {_lock_names(mut_locks)}, "
+                             f"which guards its mutations — snapshot "
+                             f"under the lock first")
+
+
+# ---------------------------------------------------------------- RL005
+def _thread_tracked(mod: ConcurrencyModule, cls: ClassIndex,
+                    m: MethodIndex, bound: str) -> bool:
+    if not bound:
+        return False
+    if bound.startswith("self."):
+        field = bound[5:]
+        return (cls is not None
+                and (field in cls.joined_fields
+                     or field in cls.daemon_fields))
+    key = f"{m.qualname}:{bound}"
+    return key in mod.local_joins or key in mod.local_daemons
+
+
+def check_rl005(mod: ConcurrencyModule,
+                cls: ClassIndex) -> Iterable[Finding]:
+    """`threading.Thread` started without tracked ownership: neither
+    daemon=True, nor a handle that is ever `.join()`ed — on shutdown
+    it leaks, pins the process, or races teardown."""
+    for m in cls.all_methods() if cls else mod.functions:
+        for ev in m.events:
+            if ev.kind != "thread" or ev.extra is True:
+                continue
+            if _thread_tracked(mod, cls, m, ev.name):
+                continue
+            label = ev.name or "<anonymous>"
+            yield _f("RL005", mod, m, ev.line, f"thread:{label}",
+                     f"Thread `{label}` has no tracked ownership: "
+                     f"pass daemon=True or keep the handle and "
+                     f"join() it on shutdown")
+
+
+# ---------------------------------------------------------------- RL006
+def check_rl006(mod: ConcurrencyModule,
+                cls: ClassIndex) -> Iterable[Finding]:
+    """Re-entrancy deadlock hazards under a held lock: re-acquiring a
+    non-reentrant lock, calling a sibling method that takes it, or
+    invoking a configurable callback/hook while holding it (the
+    callee can call back into a lock-taking entry point — the PR 13
+    `_arm_profile_locked` bug)."""
+    for m in cls.all_methods():
+        for ev in m.events:
+            held = m.lockset(ev)
+            if not held:
+                continue
+            if ev.kind == "acquire":
+                if ev.name in held \
+                        and cls.lock_kind(ev.name) != "rlock":
+                    yield _f("RL006", mod, m, ev.line,
+                             f"reacquire:{ev.name}",
+                             f"re-acquiring non-reentrant `{ev.name}` "
+                             f"while already holding it deadlocks")
+                continue
+            if ev.kind == "self_call":
+                callee = cls.methods.get(ev.name)
+                if callee is None:
+                    if _CALLBACK_RE.match(ev.name):
+                        yield _f("RL006", mod, m, ev.line,
+                                 f"callback:{ev.name}",
+                                 f"callback `self.{ev.name}` invoked "
+                                 f"holding {_lock_names(held)}: the "
+                                 f"callee can re-enter a lock-taking "
+                                 f"entry point and deadlock")
+                    continue
+                for cev in callee.events:
+                    if cev.kind == "acquire" and cev.name in held \
+                            and cls.lock_kind(cev.name) != "rlock":
+                        yield _f("RL006", mod, m, ev.line,
+                                 f"deadlock:{ev.name}:{cev.name}",
+                                 f"`self.{ev.name}()` acquires "
+                                 f"`{cev.name}` (line {cev.line}) "
+                                 f"which is already held here — "
+                                 f"non-reentrant deadlock")
+                        break
+            elif ev.kind == "call":
+                tail = ev.name.split(".")[-1]
+                if _CALLBACK_ATTR_RE.match(tail) and "." in ev.name:
+                    yield _f("RL006", mod, m, ev.line,
+                             f"callback:{tail}",
+                             f"callback `{ev.name}` invoked holding "
+                             f"{_lock_names(held)}: the callee can "
+                             f"re-enter a lock-taking entry point "
+                             f"and deadlock")
+
+
+def check_module(mod: ConcurrencyModule) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in mod.classes.values():
+        out.extend(check_rl001(mod, cls))
+        out.extend(check_rl002(mod, cls))
+        out.extend(check_rl004(mod, cls))
+        out.extend(check_rl005(mod, cls))
+        out.extend(check_rl006(mod, cls))
+    # module-level functions: async-blocking + thread-ownership only
+    for m in mod.functions:
+        if m.is_async:
+            for ev in m.events:
+                if not ev.async_direct or (
+                        isinstance(ev.extra, dict)
+                        and ev.extra.get("awaited")):
+                    continue
+                if ev.kind == "call":
+                    reason = _blocking_reason(ev)
+                    if reason:
+                        out.append(_f("RL002", mod, m, ev.line,
+                                      f"call:{ev.name}",
+                                      f"{reason} (inside `async def "
+                                      f"{m.name}`)"))
+                elif ev.kind == "acquire":
+                    out.append(_f("RL002", mod, m, ev.line,
+                                  f"with:{ev.name}",
+                                  f"`with {ev.name}` blocks the event "
+                                  f"loop (inside `async def {m.name}`)"))
+        for ev in m.events:
+            if ev.kind == "thread" and ev.extra is not True \
+                    and not _thread_tracked(mod, None, m, ev.name):
+                label = ev.name or "<anonymous>"
+                out.append(_f("RL005", mod, m, ev.line,
+                              f"thread:{label}",
+                              f"Thread `{label}` has no tracked "
+                              f"ownership: pass daemon=True or keep "
+                              f"the handle and join() it on shutdown"))
+    out.extend(check_rl003(mod))
+    return out
