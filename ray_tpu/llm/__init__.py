@@ -304,6 +304,35 @@ configured kind) and `kv_device_bytes_used`; the perf cost model's
 kv_read/kv_write byte streams and spill/restore d2h/h2d accounting are
 parametrized by the same kind (f32 fingerprints byte-identical).
 
+ISSUE 20 traffic capture + trace replay (always-on ingress flight
+recorder, deterministic capture replay, capture-diff regression
+gates; details: BENCH_CORE.md "Traffic capture & replay anatomy"):
+
+    endpoint                      payload
+    GET  /fleet/debug/traffic     recorder stats + recent ring records
+                                  (?n=&since= cursor polling);
+                                  ?capture=1 downloads the last sealed
+                                  capture (RTTC1 segments, crc32 per
+                                  line, typed errors on corruption)
+    POST /fleet/debug/traffic     {"action": "start"|"mark"|"stop"}:
+                                  arm / annotate / seal a capture
+
+    name                                    type       notes
+    ray_tpu_llm_traffic_captured_total      counter    requests recorded by the
+                                                       ingress traffic recorder
+                                                       (ingress registry)
+    ray_tpu_llm_traffic_capture_bytes_total counter    encoded capture bytes
+                                                       appended while a capture
+                                                       is armed (ingress registry)
+
+Records are privacy-scrubbed by construction (prefix fingerprint +
+numeric sampling allowlist, never prompt text). Sealed captures
+replay deterministically through the fleet simulator
+(`ray_tpu.serve.llm.sim.RecordedTrace`) and gate via
+`python -m tools.tracereplay` (banded capture-diff, what-if
+re-pricing, in-process fleet replay); `python -m tools.lint` runs
+every repo static analyzer as one pre-commit gate.
+
 Instrumentation is recorded purely from host-side engine events (zero
 device syncs, zero extra dispatches — the dispatch-guard suite runs
 with it enabled); disable per engine with

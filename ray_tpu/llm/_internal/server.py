@@ -38,6 +38,19 @@ INTERNAL_BODY_KEYS = ("_request_id", "_trace", "_deadline_epoch",
                       "_tenant", "_lane")
 
 
+def parse_since(raw: Any) -> "int | None":
+    """`?since=<seq>` cursor parsing (ISSUE 20 satellite), shared by
+    this ingress and the fleet's: absent or malformed → None (full
+    ring — a bad cursor must degrade to the legacy shape, never
+    500)."""
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
 class LLMServerImpl:
     """The deployment class body (decorated at app-build time)."""
 
@@ -474,7 +487,8 @@ class LLMServerImpl:
             yield {"i": idx, "toks": list(new), "text": delta,
                    "finished": bool(finished),
                    "reason": reason if finished else None,
-                   "model": self.model_id}
+                   "model": self.model_id,
+                   "prompt_tokens": len(toks)}
             idx += len(new)
 
     async def chat_stream_tokens(self, body: Dict[str, Any]):
@@ -592,7 +606,8 @@ class LLMServerImpl:
                             req.output_tokens),
                         "finished": True,
                         "reason": req.finish_reason,
-                        "model": self.model_id}}
+                        "model": self.model_id,
+                        "prompt_tokens": len(req.prompt_tokens)}}
                 return {"session": None, "final": None}
             blob = kvt.encode_session(state)
             return {"session": kvt.to_b64(blob), "bytes": len(blob),
@@ -637,7 +652,8 @@ class LLMServerImpl:
             sent = len(self.tokenizer.decode(out[:offset]))
             yield {"i": offset, "toks": out[offset:],
                    "text": full[sent:], "finished": False,
-                   "reason": None, "model": self.model_id}
+                   "reason": None, "model": self.model_id,
+                   "prompt_tokens": len(req.prompt_tokens)}
             n_sent, n_toks = len(full), len(out)
             while True:
                 _, finished, reason = await asyncio.wait_for(
@@ -734,9 +750,17 @@ class LLMServerImpl:
         return await asyncio.get_running_loop().run_in_executor(
             None, self.engine.chrome_trace)
 
-    async def debug_events(self) -> List[Dict[str, Any]]:
-        """The engine flight recorder's ring, oldest first."""
-        return self.engine.telemetry.recorder.events()
+    async def debug_events(self, since: "int | None" = None) -> Any:
+        """The engine flight recorder's ring, oldest first. Without a
+        cursor this is the legacy list shape; with `since` (ISSUE 20
+        satellite: incremental polling) it returns only events with
+        seq > since plus the ring's high-water mark, so a poller
+        stops re-downloading the whole ring every scrape."""
+        rec = self.engine.telemetry.recorder
+        if since is None:
+            return rec.events()
+        return {"events": rec.events(since),
+                "high_water": rec.stats()["total"]}
 
     async def debug_attribution(self, top_k: int = 8
                                 ) -> Dict[str, Any]:
@@ -933,11 +957,15 @@ class LLMRouterImpl:
             out.append((mid, h))
         return out
 
-    async def _handle_get(self, norm: str) -> Any:
+    async def _handle_get(self, norm: str,
+                          query: "Dict[str, Any] | None" = None
+                          ) -> Any:
         """Every GET endpoint, dispatched BEFORE any body parse — an
         unknown GET path is a clean 404 instead of the confusing
         'invalid JSON body' 400 the old fallthrough produced."""
         from ...serve import Response
+
+        query = query or {}
 
         if norm == "/v1/models":
             models = [{"id": mid, "object": "model", "owned_by": "ray_tpu"}
@@ -982,10 +1010,14 @@ class LLMRouterImpl:
             return {"traceEvents": events, "displayTimeUnit": "ms",
                     "metadata": meta}
         if norm == "/debug/events":
-            # engine flight recorders (bounded structured-event rings)
+            # engine flight recorders (bounded structured-event
+            # rings); ?since=<seq> polls incrementally (ISSUE 20
+            # satellite): each model returns only events newer than
+            # the cursor plus its ring's high-water mark
+            since = parse_since(query.get("since"))
             out: Dict[str, Any] = {}
             for mid, h in self._unique_servers():
-                out[mid] = await h.debug_events.remote()
+                out[mid] = await h.debug_events.remote(since)
             return {"object": "events", "models": out}
         if norm == "/debug/attribution":
             # per-request cost receipts + tenant rollups (ISSUE 13)
@@ -1027,7 +1059,9 @@ class LLMRouterImpl:
         method = getattr(request, "method", "POST")
         norm = path.rstrip("/") or "/"
         if method == "GET":
-            return await self._handle_get(norm)
+            return await self._handle_get(
+                norm, dict(getattr(request, "query_params", None)
+                           or {}))
         try:
             body = request.json()
         except Exception:

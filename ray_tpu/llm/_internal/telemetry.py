@@ -271,9 +271,23 @@ class FlightRecorder:
             except Exception:
                 pass    # postmortem capture must never break recording
 
-    def events(self) -> List[Dict[str, Any]]:
+    def events(self, since: Optional[int] = None
+               ) -> List[Dict[str, Any]]:
+        """Ring contents, oldest first. `since` (ISSUE 20 satellite)
+        is an incremental-poll cursor over the monotone seq: only
+        events with seq > since return. A cursor that fell off the
+        ring (wraparound evicted the events after it) simply returns
+        everything still resident — the poller's `high_water` (=
+        stats()["total"]) tells it how many it missed."""
         with self._lock:
-            return list(self._ring)
+            evs = list(self._ring)
+        if since is None:
+            return evs
+        try:
+            cursor = int(since)
+        except (TypeError, ValueError):
+            return evs
+        return [e for e in evs if e["seq"] > cursor]
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -87,11 +87,26 @@ what-if analysis, then harvests what it finds:
   watchdog planes all EXCLUDE batch-lane depth from their overload
   and burn signals.
 
+ISSUE 20 closes the loop from production back into the simulator:
+
+- an always-on bounded traffic recorder at fleet ingress
+  (trafficlog.py): one privacy-clean record per request (prefix
+  fingerprint, token counts, sampling brief, outcome/latency brief —
+  never prompt text) in a ring, sealable into a versioned
+  checksummed capture (`GET/POST /fleet/debug/traffic`);
+- deterministic trace replay: a capture replays through the fleet
+  simulator (`sim.traffic.RecordedTrace`) or an in-process fleet via
+  `python -m tools.tracereplay`, which emits a banded capture-diff
+  (recorded vs replayed SLO histograms, prefix-hit rate, route mix,
+  per-tenant rollups) and what-if re-pricing at overridden fleet
+  shapes.
+
 Scoring formula, admission thresholds, the autoscale policy, the
-observability surface, the failure plane, the KV transport, and the
-traffic simulator are documented in BENCH_CORE.md "Serving fleet
-anatomy", "Fleet observability anatomy", "Fault tolerance anatomy",
-"KV transport anatomy" and "Traffic simulation anatomy".
+observability surface, the failure plane, the KV transport, the
+traffic simulator, and the capture/replay plane are documented in
+BENCH_CORE.md "Serving fleet anatomy", "Fleet observability
+anatomy", "Fault tolerance anatomy", "KV transport anatomy",
+"Traffic simulation anatomy" and "Traffic capture & replay anatomy".
 """
 
 from __future__ import annotations
@@ -129,6 +144,10 @@ from .router import (FleetRouter, HashRing, ReplicaSnapshot,  # noqa: F401
 from .tracemerge import (IngressTraceBuffer,  # noqa: F401
                          filter_trace, merge_fleet_traces,
                          merge_flight_recorders)
+from .trafficlog import (CaptureChecksumError,  # noqa: F401
+                         CaptureError, TrafficRecorder,
+                         decode_capture, load_capture,
+                         sampling_brief, traffic_metrics)
 from .watchdog import SLOBurnWatchdog, WatchdogConfig  # noqa: F401
 
 __all__ = [
@@ -153,6 +172,10 @@ __all__ = [
     # preemptible batch lane (ISSUE 14)
     "BatchLaneConfig", "BatchLane", "BatchJob",
     "BATCH_PRIORITY", "INTERACTIVE_PRIORITY",
+    # traffic capture + replay (ISSUE 20)
+    "TrafficRecorder", "CaptureError", "CaptureChecksumError",
+    "decode_capture", "load_capture", "sampling_brief",
+    "traffic_metrics",
     # single-model surface (ray_tpu.llm re-exports)
     "LLMConfig", "build_openai_app", "build_llm_deployment",
     "InferenceEngine", "EngineConfig", "SamplingParams", "Request",

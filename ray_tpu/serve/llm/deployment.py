@@ -25,10 +25,20 @@ Ingress HTTP surface (rides the existing proxy):
     GET  /metrics                  ONE Prometheus exposition for the
                                    fleet (replica-tagged series)
     GET  /debug/events             per-replica flight recorders
+                                   (?since=<seq> polls incrementally)
     GET  /debug/trace              merged Chrome-trace lifecycles
+    GET  /fleet/debug/events       ingress+replica recorders merged
+                                   (?since= returns only newer events
+                                   + per-source high-water marks)
     GET  /fleet/debug/attribution  fleet-merged per-request cost
                                    receipts + tenant rollups
                                    (?k=&tenant= — ISSUE 13)
+    GET  /fleet/debug/traffic      traffic recorder (ISSUE 20): ring
+                                   tail + capture stats; ?capture=1
+                                   downloads the last sealed capture
+                                   (the replayable JSONL artifact)
+    POST /fleet/debug/traffic      capture controls: {"action":
+                                   "start"|"stop"|"mark", ...}
 Overload returns 429 with a Retry-After header (admission.py).
 """
 
@@ -40,6 +50,7 @@ import math
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ...llm._internal.server import parse_since
 from .admission import AdmissionConfig, AdmissionRejected
 from .autoscaler import AutoscaleConfig
 from .batch import BatchLaneConfig
@@ -49,6 +60,7 @@ from .fleet import (ACTIVE, DRAINING, STANDBY, FleetManager,
 from .kv_transport import REPLICA_ROLES, ROLE_PREFILL, TransportConfig
 from .router import RouterConfig
 from .tracemerge import merge_fleet_traces, merge_flight_recorders
+from .trafficlog import CaptureError
 from .watchdog import WatchdogConfig
 
 
@@ -110,6 +122,14 @@ class FleetConfig:
     # replica, and the fleet's capacity math is chip-denominated.
     # None = single-chip replicas (every pre-slice fleet unchanged).
     slice_shape: Optional[Tuple[int, int]] = None
+    # traffic flight-data recorder (ISSUE 20): always-on bounded
+    # request log at the ingress (privacy-scrubbed — never prompt
+    # text); armed captures become replayable JSONL artifacts
+    # (GET/POST /fleet/debug/traffic). The spool dir, when set,
+    # retains sealed captures on disk (BlackboxSpool bounds).
+    enable_traffic_log: bool = True
+    traffic_capacity: int = 4096
+    traffic_spool_dir: Optional[str] = None
 
     def resolved_autoscale(self) -> AutoscaleConfig:
         auto = self.autoscale or AutoscaleConfig()
@@ -139,6 +159,9 @@ class FleetConfig:
                            else dataclasses.asdict(self.batch_lane)),
             "slice_shape": (None if self.slice_shape is None
                             else list(self.slice_shape)),
+            "enable_traffic_log": self.enable_traffic_log,
+            "traffic_capacity": self.traffic_capacity,
+            "traffic_spool_dir": self.traffic_spool_dir,
         }
 
 
@@ -187,7 +210,12 @@ class LLMFleetIngressImpl:
             transport=(TransportConfig(**fleet_wire["transport"])
                        if fleet_wire.get("transport") else None),
             batch_lane=(BatchLaneConfig(**fleet_wire["batch_lane"])
-                        if fleet_wire.get("batch_lane") else None))
+                        if fleet_wire.get("batch_lane") else None),
+            enable_traffic_log=bool(
+                fleet_wire.get("enable_traffic_log", True)),
+            traffic_capacity=int(
+                fleet_wire.get("traffic_capacity", 4096)),
+            traffic_spool_dir=fleet_wire.get("traffic_spool_dir"))
         self._adapters: Optional[List[str]] = None
         self._adapters_ts = 0.0
 
@@ -298,8 +326,13 @@ class LLMFleetIngressImpl:
                                  for rid, info in infos.items()},
                     "fleet": await self.fleet.status()}
         if norm == "/debug/events":
-            return {"object": "events",
-                    "replicas": await self._fanout("debug_events")}
+            # ?since=<seq> (ISSUE 20 satellite): each replica returns
+            # only events newer than the cursor + its high-water mark
+            since = parse_since(query.get("since"))
+            rows = (await self._fanout("debug_events")
+                    if since is None
+                    else await self._fanout("debug_events", since))
+            return {"object": "events", "replicas": rows}
         if norm == "/debug/trace":
             events: List[Any] = []
             for doc in (await self._fanout("debug_trace")).values():
@@ -315,12 +348,54 @@ class LLMFleetIngressImpl:
                 request_id=query.get("request_id"),
                 trace_id=query.get("trace_id"))
         if norm == "/fleet/debug/events":
+            # ?since=<seq> polls incrementally. Sequence numbers are
+            # PER SOURCE (each recorder counts its own), so the
+            # scalar cursor applies to every source and the response
+            # carries per-source high-water marks for the next poll.
+            since = parse_since(query.get("since"))
+            rows = (await self._fanout("debug_events")
+                    if since is None
+                    else await self._fanout("debug_events", since))
+            high: Dict[str, Any] = {}
+            by_rid: Dict[str, Any] = {}
+            for rid, row in rows.items():
+                if isinstance(row, dict) and "events" in row:
+                    by_rid[rid] = row["events"]
+                    high[rid] = row.get("high_water")
+                else:
+                    by_rid[rid] = row    # legacy list / error row
             merged = merge_flight_recorders(
-                await self._fanout("debug_events"),
-                self.fleet.recorder.events(),
+                by_rid, self.fleet.recorder.events(since),
                 request_id=query.get("request_id"))
-            return {"object": "events", "events": merged,
-                    "ingress": self.fleet.recorder.stats()}
+            doc: Dict[str, Any] = {
+                "object": "events", "events": merged,
+                "ingress": self.fleet.recorder.stats()}
+            if since is not None:
+                high["ingress"] = doc["ingress"]["total"]
+                doc["since"] = since
+                doc["high_water"] = high
+            return doc
+        if norm == "/fleet/debug/traffic":
+            # ISSUE 20 traffic recorder: stats + ring tail;
+            # ?capture=1 downloads the last sealed capture bytes
+            tl = self.fleet.traffic
+            if query.get("capture"):
+                try:
+                    text = tl.export()
+                except CaptureError as e:
+                    return Response({"error": str(e)}, status=404,
+                                    content_type="application/json")
+                return Response(text, status=200,
+                                content_type="text/plain")
+            try:
+                n = max(int(query.get("n") or 64), 1)
+            except ValueError:
+                n = 64
+            return {"object": "traffic", "model": self.model_id,
+                    "enabled": self.fleet.enable_traffic_log,
+                    "stats": tl.stats(),
+                    "records": tl.tail(
+                        n, since=parse_since(query.get("since")))}
         if norm == "/fleet/debug/attribution":
             # ISSUE 13: fleet-merged cost attribution — every
             # replica's top receipts re-ranked into ONE top-K and the
@@ -406,6 +481,31 @@ class LLMFleetIngressImpl:
             cause = str(body.get("cause") or "manual")
             return {"object": "dump",
                     "replicas": await self.fleet.debug_dump_all(cause)}
+        if norm == "/fleet/debug/traffic":
+            # ISSUE 20 capture controls. Control misuse (double
+            # start, stop with nothing armed) is a 409 with the typed
+            # error's message — never a 500.
+            action = str(body.get("action") or "")
+            tl = self.fleet.traffic
+            try:
+                if action == "start":
+                    out = tl.start_capture(
+                        str(body.get("note") or ""))
+                elif action == "stop":
+                    out = tl.stop_capture()
+                elif action == "mark":
+                    out = tl.mark(str(body.get("label") or ""))
+                else:
+                    return Response(
+                        {"error": f"unknown traffic action "
+                                  f"{action!r} (start|stop|mark)"},
+                        status=400,
+                        content_type="application/json")
+            except CaptureError as e:
+                return Response({"error": str(e)}, status=409,
+                                content_type="application/json")
+            return {"object": "traffic_control", "action": action,
+                    **out}
         if norm == "/v1/batch" or (norm.startswith("/v1/batch/")
                                    and norm.endswith("/cancel")):
             # preemptible batch lane (ISSUE 14): submit a bulk job —

@@ -46,6 +46,7 @@ from .kv_transport import (FleetPrefixStore, TransportConfig,
 from .router import (FleetRouter, ReplicaSnapshot, RouterConfig,
                      prefix_fingerprint)
 from .tracemerge import IngressTraceBuffer, request_events
+from .trafficlog import TrafficRecorder, sampling_brief
 from .watchdog import SLOBurnWatchdog, WatchdogConfig
 
 # monotone SLO-total keys the watchdog accumulates fleet-wide
@@ -141,7 +142,10 @@ class FleetManager:
                  drain_timeout_s: float = 120.0,
                  roles: Optional[Sequence[str]] = None,
                  transport: Optional[TransportConfig] = None,
-                 batch_lane: Optional[BatchLaneConfig] = None):
+                 batch_lane: Optional[BatchLaneConfig] = None,
+                 enable_traffic_log: bool = True,
+                 traffic_capacity: int = 4096,
+                 traffic_spool_dir: Optional[str] = None):
         if not clients:
             raise ValueError("a fleet needs at least one replica")
         # per-replica roles (ISSUE 12 disaggregation): aligned with
@@ -250,6 +254,16 @@ class FleetManager:
         # the replicas' lifecycle traces at GET /fleet/debug/trace
         self.enable_tracing = enable_tracing
         self.trace = IngressTraceBuffer()
+        # -- ISSUE 20 traffic flight-data recorder ----------------------
+        # always-on bounded request log at the ingress: one
+        # privacy-scrubbed record per request (never prompt text),
+        # armed captures snapshot into the replayable JSONL format
+        # (GET /fleet/debug/traffic; sim.traffic.RecordedTrace and
+        # tools/tracereplay consume the captures)
+        self.enable_traffic_log = enable_traffic_log
+        self.traffic = TrafficRecorder(
+            capacity=traffic_capacity, model_id=model_id,
+            spool_dir=traffic_spool_dir)
         # watchdog accumulation state: per-replica clamped deltas into
         # fleet-monotone totals (membership changes / engine restarts
         # must not produce negative or replayed windows)
@@ -375,23 +389,41 @@ class FleetManager:
             except (TypeError, ValueError):
                 p = INTERACTIVE_PRIORITY
             body["priority"] = max(p, INTERACTIVE_PRIORITY)
-        if not self.enable_tracing:
+        if not self.enable_tracing and not self.enable_traffic_log:
             return body, None
         # ALWAYS mint — `_request_id` doubles as the engine request id
         # downstream, so honoring a client-supplied value would let a
         # replayed id collide with (and abort/starve) another tenant's
         # in-flight request
         rid = uuid.uuid4().hex[:16]
-        trace = {"trace_id": tracing.new_span_id(),
-                 "span_id": tracing.new_span_id(),
-                 "flow_id": tracing.new_span_id()}
-        body["_request_id"] = rid
-        body["_trace"] = trace
+        trace = None
+        if self.enable_tracing:
+            trace = {"trace_id": tracing.new_span_id(),
+                     "span_id": tracing.new_span_id(),
+                     "flow_id": tracing.new_span_id()}
+            body["_request_id"] = rid
+            body["_trace"] = trace
+        # ISSUE 20 traffic-record fields: everything the capture
+        # format needs, gathered HERE by allowlist (sampling_brief
+        # never reads text fields) and enriched along the dispatch
+        # path (fp, token counts, finish reason, failovers)
+        deadline_s = body.get("deadline_s")
+        try:
+            deadline_s = (float(deadline_s)
+                          if deadline_s is not None else None)
+        except (TypeError, ValueError):
+            deadline_s = None
         return body, {
             "rid": rid, "trace": trace, "method": method,
             "tenant": self.tenant_of(body), "t0": time.monotonic(),
             "t_admit": None, "t_route": None, "replica": None,
-            "outcome": None, "status": "ok", "done": False}
+            "outcome": None, "status": "ok", "done": False,
+            "lane": "batch" if lane == "batch" else "interactive",
+            "stream": "stream" in method,
+            "params": sampling_brief(body),
+            "deadline_s": deadline_s, "fp": "",
+            "prompt_tokens": 0, "out_tokens": 0, "finish": None,
+            "failovers": 0, "t_first": None}
 
     def _trace_end(self, rec: Optional[Dict[str, Any]],
                    status: Optional[str] = None) -> None:
@@ -403,11 +435,17 @@ class FleetManager:
         rec["done"] = True
         if status is not None:
             rec["status"] = status
-        self.trace.add(*request_events(
-            self.trace.next_tid(), rec["rid"], rec["trace"],
-            rec["t0"], rec["t_admit"], rec["t_route"],
-            time.monotonic(), rec["replica"], rec["outcome"],
-            rec["method"], rec["tenant"], rec["status"]))
+        if rec["trace"] is not None:
+            self.trace.add(*request_events(
+                self.trace.next_tid(), rec["rid"], rec["trace"],
+                rec["t0"], rec["t_admit"], rec["t_route"],
+                time.monotonic(), rec["replica"], rec["outcome"],
+                rec["method"], rec["tenant"], rec["status"]))
+        # ISSUE 20: every closed request feeds the traffic recorder
+        # (rejects and errors included — a capture that omitted sheds
+        # would replay a rosier workload than production saw)
+        if self.enable_traffic_log:
+            self.traffic.observe_request(rec)
 
     # -- deadline propagation (ISSUE 9) ---------------------------------
     def _mint_deadline(self, body: Dict[str, Any]
@@ -457,6 +495,8 @@ class FleetManager:
             rec["t_admit"] = time.monotonic()
         attempts = 0
         fp = prefix_fingerprint(body, self.router.config.prefix_depth)
+        if rec is not None:
+            rec["fp"] = fp
         try:
             while True:
                 st, outcome = self._route(body, fp)
@@ -499,6 +539,8 @@ class FleetManager:
                                                  exc, attempts):
                         raise
                     attempts += 1
+                    if rec is not None:
+                        rec["failovers"] = attempts
                     self.recorder.record(
                         "failover", mode="unary", replica=rid,
                         method=method, attempt=attempts,
@@ -512,6 +554,13 @@ class FleetManager:
                           if out.get("choices") else None)
                     if fr == "deadline":
                         self._count_deadline_shed("engine")
+                    if rec is not None:
+                        usage = out.get("usage") or {}
+                        rec["prompt_tokens"] = int(
+                            usage.get("prompt_tokens") or 0)
+                        rec["out_tokens"] = int(
+                            usage.get("completion_tokens") or 0)
+                        rec["finish"] = fr
                 # publish the (now locally-cached) prefix into the
                 # fleet store so the NEXT replica serving it imports
                 # instead of cold-prefilling (once per fingerprint)
@@ -627,6 +676,8 @@ class FleetManager:
         cur = body
         session: Optional[str] = None     # shipped payload to resume
         fp = prefix_fingerprint(body, self.router.config.prefix_depth)
+        if rec is not None:
+            rec["fp"] = fp
         if self._disagg_applies(body):
             handoff = await self._prefill_handoff(body, is_chat)
             if handoff is not None:
@@ -637,6 +688,12 @@ class FleetManager:
                     folded = transcript.fold(val)
                     if folded is not None:
                         _, text, _, reason = folded
+                        if rec is not None:
+                            rec["t_first"] = time.monotonic()
+                            rec["out_tokens"] = len(transcript.tokens)
+                            rec["finish"] = reason
+                            rec["prompt_tokens"] = int(
+                                val.get("prompt_tokens") or 0)
                         yield failover.sse_chunk(
                             is_chat, cid,
                             val.get("model") or model, created,
@@ -722,11 +779,18 @@ class FleetManager:
                             raise failover.StreamBroken(
                                 f"token stream from {rid} ended "
                                 f"without finish")
+                        if rec is not None and not rec["prompt_tokens"]:
+                            rec["prompt_tokens"] = int(
+                                chunk.get("prompt_tokens") or 0)
                         folded = transcript.fold(chunk)
                         if folded is None:
                             continue             # replayed: dedup'd
                         toks, text, fin, reason = folded
                         model = chunk.get("model") or model
+                        if rec is not None and toks:
+                            if rec["t_first"] is None:
+                                rec["t_first"] = time.monotonic()
+                            rec["out_tokens"] = len(transcript.tokens)
                         if fin and reason == "migrated":
                             # live migration marker (ISSUE 12): the
                             # session left this replica mid-stream —
@@ -752,6 +816,8 @@ class FleetManager:
                         if fin:
                             if reason == "deadline":
                                 self._count_deadline_shed("engine")
+                            if rec is not None:
+                                rec["finish"] = reason
                             yield "data: [DONE]\n\n"
                             await self._prefix_publish(fp, body, st)
                             return
@@ -783,6 +849,8 @@ class FleetManager:
                         raise
                     else:
                         attempts += 1
+                        if rec is not None:
+                            rec["failovers"] = attempts
                         self.recorder.record(
                             "failover", mode="stream", replica=rid,
                             request_id=srid,
@@ -1761,6 +1829,10 @@ class FleetManager:
                       if self.batch is not None
                       else {"enabled": False}),
             "recorder": self.recorder.stats(),
+            # ISSUE 20 traffic recorder (GET /fleet/debug/traffic)
+            "traffic": (self.traffic.stats()
+                        if self.enable_traffic_log
+                        else {"enabled": False}),
             "health": {
                 "probe_failures": self.health.probe_failures,
                 "open_cooldown_s": self.health.open_cooldown_s,

@@ -27,14 +27,15 @@ from .core import (FleetSimulator, SimFleetConfig,  # noqa: F401
                    VirtualClock, assert_slos)
 from .replica import Hist, SyntheticReplica  # noqa: F401
 from .traffic import (BATCH, INTERACTIVE, ChaosEvent,  # noqa: F401
-                      SimSession, TraceConfig, batch_backlog,
-                      chaos_overlay, generate)
+                      RecordedTrace, SimSession, TraceConfig,
+                      batch_backlog, chaos_overlay, generate)
 
 __all__ = [
     "FleetSimulator", "SimFleetConfig", "VirtualClock", "assert_slos",
     "SimCalibration", "default_cpu_calibration", "CALIBRATION_BAND",
     "SyntheticReplica", "Hist",
     "TraceConfig", "SimSession", "ChaosEvent", "generate",
-    "batch_backlog", "chaos_overlay", "INTERACTIVE", "BATCH",
+    "batch_backlog", "chaos_overlay", "RecordedTrace",
+    "INTERACTIVE", "BATCH",
     "capacity_curve", "write_artifact",
 ]

@@ -116,6 +116,15 @@ class SimCalibration:
         return json.dumps(dataclasses.asdict(self), sort_keys=True,
                           indent=2) + "\n"
 
+    def checksum(self) -> str:
+        """sha256 of the canonical JSON rendering — the artifact
+        provenance key (ISSUE 20 satellite): a committed sweep /
+        summary / capture-diff names exactly which calibration
+        produced it. Computed over to_json(), so a file round-trip
+        (load → checksum) matches the original."""
+        import hashlib
+        return hashlib.sha256(self.to_json().encode()).hexdigest()
+
     @classmethod
     def from_json(cls, text: str) -> "SimCalibration":
         doc = json.loads(text)

@@ -20,7 +20,8 @@ from .traffic import SimSession, TraceConfig, generate
 def capacity_curve(trace_cfg: TraceConfig,
                    fleet_cfg: SimFleetConfig,
                    replica_counts: List[int],
-                   batch_jobs: Optional[List[SimSession]] = None
+                   batch_jobs: Optional[List[SimSession]] = None,
+                   capture_id: Optional[str] = None
                    ) -> Dict[str, Any]:
     """Replay `trace_cfg` at each fleet size (fixed-size fleets: min
     = max = n, autoscaling off-axis so the curve isolates capacity)
@@ -71,6 +72,17 @@ def capacity_curve(trace_cfg: TraceConfig,
             "chips_per_replica": fleet_cfg.chips_per_replica,
             "calibration": (fleet_cfg.calibration.name
                             if fleet_cfg.calibration else None),
+        },
+        # artifact provenance (ISSUE 20 satellite): the committed
+        # artifact is attributable to exactly one input set
+        "provenance": {
+            "calibration": (fleet_cfg.calibration.name
+                            if fleet_cfg.calibration else None),
+            "calibration_sha256": (fleet_cfg.calibration.checksum()
+                                   if fleet_cfg.calibration
+                                   else None),
+            "seed": fleet_cfg.seed,
+            "capture_id": capture_id,
         },
         "points": points,
     }

@@ -98,6 +98,11 @@ class FleetSimulator:
         calib = cfg.calibration or SimCalibration(
             decode_tick_ms={"1": {"p50": 1.0, "p95": 1.5,
                                   "p99": 2.5}})
+        self._calib = calib
+        # artifact provenance (ISSUE 20 satellite): a RecordedTrace
+        # carries its capture id — the summary names which capture
+        # (if any) produced it
+        self._capture_id = getattr(trace, "capture_id", None)
         # ---- the PRODUCTION policy objects, virtual-clocked --------
         self.router = FleetRouter(cfg.router or RouterConfig(),
                                   clock=clk)
@@ -512,6 +517,16 @@ class FleetSimulator:
     def summary(self) -> Dict[str, Any]:
         reps = self.replicas
         return {
+            # artifact provenance (ISSUE 20 satellite): the exact
+            # input set this summary is attributable to — the
+            # calibration file by checksum, the RNG seed, and (for
+            # replayed captures) the capture id
+            "provenance": {
+                "calibration": self._calib.name,
+                "calibration_sha256": self._calib.checksum(),
+                "seed": self.cfg.seed,
+                "capture_id": self._capture_id,
+            },
             "sim": {
                 "seed": self.cfg.seed,
                 "replicas": self.cfg.replicas,

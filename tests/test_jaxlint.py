@@ -955,10 +955,42 @@ def test_serve_llm_fleet_has_zero_baselined_findings():
     for fname in ("chaos.py", "failover.py", "watchdog.py",
                   "tracemerge.py", "kv_transport.py", "batch.py",
                   "sim/core.py", "sim/replica.py", "sim/traffic.py",
-                  "sim/calibration.py", "sim/capacity.py"):
+                  "sim/calibration.py", "sim/capacity.py",
+                  "trafficlog.py"):
         assert (REPO / "ray_tpu/serve/llm" / fname).exists(), fname
     # and the package is clean with NO baseline at all
     proc = _cli("ray_tpu/serve/llm")
     assert proc.returncode == 0, (
         "jaxlint findings in ray_tpu/serve/llm (zero-entry package):\n"
         + proc.stdout)
+
+
+def test_unified_lint_runner_runs_every_analyzer():
+    """ISSUE 20 satellite: `python -m tools.lint` is the one
+    pre-commit gate — a single invocation runs jaxlint AND racelint
+    over the same discovered file set, each against its committed
+    baseline, and exits 0 only when both are clean."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "ray_tpu/serve/llm",
+         "tools/tracereplay", "tools/lint"],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"unified lint gate failed:\n{proc.stdout}\n{proc.stderr}")
+    # both analyzers reported (clean or baselined) — neither was
+    # silently skipped
+    assert "[jaxlint]" in proc.stderr
+    assert "[racelint]" in proc.stderr
+    # a nonexistent path is a usage error, not a silent no-op sweep
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "no/such/dir"],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert proc.returncode == 2
+    # machine-readable mode round-trips as JSON keyed per analyzer
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json",
+         "tools/lint"],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert proc.returncode == 0
+    report = json.loads(proc.stdout)
+    assert set(report) == {"jaxlint", "racelint"}
+    assert report["jaxlint"]["new"] == []

@@ -666,3 +666,18 @@ def test_llm_and_serving_planes_zero_baseline():
                         str(REPO / "ray_tpu" / "serve" / "llm")],
                        root=str(REPO))
     assert fs == [], "\n".join(f.render() for f in fs)
+    # the ISSUE 20 traffic recorder lives on the dispatch hot path
+    # inside that zero-baseline package: its lock discipline (metric
+    # publication outside the lock, one lock per recorder) is gated
+    # here, not baselined away
+    assert (REPO / "ray_tpu" / "serve" / "llm"
+            / "trafficlog.py").exists()
+
+
+def test_replay_tooling_zero_baseline():
+    """ISSUE 20: the replay/lint tooling is host-side stdlib code —
+    racelint-clean with no baseline, like the serving planes."""
+    fs = analyze_paths([str(REPO / "tools" / "tracereplay"),
+                        str(REPO / "tools" / "lint")],
+                       root=str(REPO))
+    assert fs == [], "\n".join(f.render() for f in fs)

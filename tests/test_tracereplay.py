@@ -1,0 +1,299 @@
+"""tools/tracereplay: capture-diff math, what-if re-pricing,
+artifact provenance, CLI exit codes (ISSUE 20).
+
+The replay-vs-real acceptance band itself is gated end-to-end in
+tests/test_trafficlog.py (a real 2-replica fleet capture). This file
+unit-tests the diff arithmetic on hand-built captures and summaries
+where every number is chosen, so each tolerance trips exactly when it
+should — plus the satellite-3 guarantee that every committed artifact
+(capture_diff, what_if, sim summary, capacity curve) names the exact
+calibration checksum / seed / capture id that produced it.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.serve.llm.trafficlog import decode_capture, encode_segment
+from tools import tracereplay
+from tools.tracereplay import (MIX_TOLERANCE, RATE_TOLERANCE,
+                               capture_diff, recorded_stats,
+                               replay_sim, replayed_stats, what_if,
+                               write_artifact)
+from tools.tracereplay.__main__ import main as cli_main
+
+FP_A = "a" * 40                       # two prefix chains, hex-shaped
+FP_B = "b" * 40
+
+
+def _rec(i, fp=FP_A, tenant="t0", route="affinity", status="ok",
+         prompt=3, out=8, ttft_ms=10.0, e2e_ms=50.0, stream=True):
+    return {"t_mono": 100.0 + i * 0.05, "rid": f"r{i}",
+            "method": "completions", "stream": stream,
+            "tenant": tenant, "lane": "interactive", "fp": fp,
+            "prompt_tokens": prompt, "out_tokens": out,
+            "params": {"max_tokens": out, "temperature": 0.5,
+                       "seed": i},
+            "deadline_s": None,
+            "outcome": {"status": status, "finish": "length",
+                        "route": route, "replica": "r0",
+                        "failovers": 0, "preemptions": 0,
+                        "ttft_ms": ttft_ms, "itl_ms": 1.0,
+                        "e2e_ms": e2e_ms}}
+
+
+def _capture(records, capture_id="feedc0defeedc0de"):
+    """A structurally valid capture built segment by segment — the
+    same codec the recorder uses, with every field under test
+    control."""
+    header = {"kind": "header", "object": "traffic_capture",
+              "version": 1, "capture_id": capture_id,
+              "model": "unit", "mono_anchor": 100.0,
+              "wall_anchor": 1.7e9, "note": "unit"}
+    lines = [encode_segment(header)]
+    for i, r in enumerate(records):
+        lines.append(encode_segment(
+            {"kind": "record", "seq": i + 1, **r}))
+    lines.append(encode_segment(
+        {"kind": "end", "capture_id": capture_id,
+         "records": len(records), "marks": 0, "dropped": 0}))
+    return "\n".join(lines) + "\n"
+
+
+def _summary(ttft_p99=12.0, e2e_p99=55.0, picks=8, hits=6, spills=2,
+             arrived=8, completed=8):
+    """A FleetSimulator-shaped summary with chosen numbers."""
+    def lat(p99):
+        return {"n": arrived, "mean_ms": p99 / 2,
+                "p50_ms": p99 / 2, "p95_ms": p99, "p99_ms": p99}
+    return {"router": {"picks": picks, "affinity_hits": hits,
+                       "spills": spills, "scored_fallbacks": 0},
+            "sessions": {"arrived": arrived, "completed": completed},
+            "latency": {"ttft": lat(ttft_p99), "e2e": lat(e2e_p99)},
+            "tenants": {"t0": completed}}
+
+
+# ------------------------------------------------------- stats math
+
+def test_recorded_stats_math():
+    records = ([_rec(i, tenant="t0") for i in range(4)]
+               + [_rec(4 + i, fp=FP_B, tenant="t1", route="spill",
+                       ttft_ms=100.0, e2e_ms=400.0)
+                  for i in range(2)]
+               + [_rec(6, tenant="t1", route=None,
+                       status="rejected:queue_full", out=0)])
+    rec = recorded_stats(records)
+    assert rec["requests"] == 7
+    assert rec["completed"] == 6          # the rejected one is not ok
+    assert rec["route_mix"] == {"affinity": 4, "spill": 2}
+    # hit rate counts only ROUTED records: 4 affinity of 6 routed
+    assert rec["prefix_hit_rate"] == pytest.approx(4 / 6, abs=1e-6)
+    assert rec["tenants"]["t0"] == {"requests": 4,
+                                    "prompt_tokens": 12,
+                                    "out_tokens": 32}
+    assert rec["tenants"]["t1"]["requests"] == 3
+    # latency percentiles ride the sim's log-spaced Hist: same bins,
+    # so recorded-vs-replayed ratios compare like with like
+    assert rec["latency"]["ttft"]["n"] == 7
+    assert rec["latency"]["ttft"]["p50_ms"] == pytest.approx(
+        10.0, rel=0.20)
+    assert rec["latency"]["e2e"]["p99_ms"] == pytest.approx(
+        400.0, rel=0.20)
+
+
+def test_recorded_stats_empty_and_unrouted():
+    assert recorded_stats([])["prefix_hit_rate"] is None
+    rec = recorded_stats([_rec(0, route=None)])
+    assert rec["route_mix"] == {}
+    assert rec["prefix_hit_rate"] is None
+
+
+def test_replayed_stats_rebuilds_route_mix():
+    rep = replayed_stats(_summary(picks=10, hits=7, spills=3))
+    assert rep["route_mix"] == {"affinity": 7, "spill": 3}
+    assert rep["prefix_hit_rate"] == pytest.approx(0.7)
+    assert rep["requests"] == 8
+    assert rep["tenants"] == {"t0": {"requests": 8}}
+    # zero-pick summary: rate is absent, not a division crash
+    assert replayed_stats(
+        {"router": {}, "sessions": {},
+         "latency": {"ttft": {}, "e2e": {}}})["prefix_hit_rate"] \
+        is None
+
+
+# ----------------------------------------------------- capture-diff
+
+def test_capture_diff_passes_inside_band():
+    cap = decode_capture(_capture(
+        [_rec(i) for i in range(6)]
+        + [_rec(6, fp=FP_B, route="spill"),
+           _rec(7, fp=FP_B, route="spill")]))
+    # recorded: 6/8 affinity, ttft ~10ms; summary replays ~the same
+    diff = capture_diff(cap, _summary(ttft_p99=12.0, e2e_p99=55.0,
+                                      picks=8, hits=6, spills=2))
+    assert diff["pass"] and diff["failures"] == []
+    assert diff["object"] == "capture_diff"
+    assert diff["capture_id"] == "feedc0defeedc0de"
+    assert diff["recorded"]["requests"] == 8
+    assert diff["replayed"]["requests"] == 8
+
+
+def test_capture_diff_trips_each_tolerance():
+    cap = decode_capture(_capture(
+        [_rec(i) for i in range(6)]
+        + [_rec(6, fp=FP_B, route="spill"),
+           _rec(7, fp=FP_B, route="spill")]))
+    # latency band: replayed p99 100x the recorded one
+    diff = capture_diff(cap, _summary(ttft_p99=1000.0))
+    assert not diff["pass"]
+    assert any(f.startswith("ttft.p99_ms") for f in diff["failures"])
+    # hit-rate drift: recorded 0.75 vs replayed 0.125
+    diff = capture_diff(cap, _summary(picks=8, hits=1, spills=7))
+    assert any(f.startswith("prefix_hit_rate")
+               for f in diff["failures"])
+    assert f"> {RATE_TOLERANCE}" in "".join(diff["failures"])
+    # route-mix share drift: replay routed everything via spill
+    diff = capture_diff(cap, _summary(picks=8, hits=0, spills=8))
+    assert any(f.startswith("route_mix[affinity]")
+               for f in diff["failures"])
+    assert f"> {MIX_TOLERANCE}" in "".join(diff["failures"])
+
+
+def test_capture_diff_skips_absent_latency():
+    # a capture with no outcome timings (all-unary shed storm) must
+    # not synthesize latency failures — absence skips the check
+    recs = [_rec(i, ttft_ms=None, e2e_ms=None) for i in range(3)]
+    cap = decode_capture(_capture(recs))
+    diff = capture_diff(cap, _summary())
+    assert not any("p99" in f for f in diff["failures"])
+
+
+# ------------------------------------------- sim replay + what-if
+
+def _sim_capture(n=10):
+    return decode_capture(_capture(
+        [_rec(i, fp=(FP_A if i % 2 else FP_B), tenant=f"t{i % 2}",
+              prompt=4, out=6) for i in range(n)]))
+
+
+def test_replay_sim_deterministic_with_provenance():
+    cap = _sim_capture()
+    s1 = replay_sim(cap, replicas=2, seed=3)
+    s2 = replay_sim(cap, replicas=2, seed=3)
+    assert json.dumps(s1, sort_keys=True) == json.dumps(
+        s2, sort_keys=True)
+    from ray_tpu.serve.llm.sim import default_cpu_calibration
+    prov = s1["provenance"]
+    assert prov["capture_id"] == "feedc0defeedc0de"
+    assert prov["seed"] == 3
+    assert prov["calibration_sha256"] == \
+        default_cpu_calibration().checksum()
+    assert s1["sessions"]["arrived"] == 10
+
+
+def test_what_if_repriced_points():
+    cap = _sim_capture()
+    doc = what_if(cap, [1, 2], chips_per_replica=2, kv_dtype="int8",
+                  seed=1)
+    assert doc["object"] == "what_if"
+    assert [p["replicas"] for p in doc["points"]] == [1, 2]
+    for p in doc["points"]:
+        assert p["chips"] == p["replicas"] * 2
+        assert p["kv_dtype"] == "int8"
+        for k in ("p99_ttft_ms", "p99_e2e_ms", "tokens_per_chip_s",
+                  "chip_s_per_1k_tokens", "shed", "completed"):
+            assert k in p
+    assert doc["provenance"]["capture_id"] == "feedc0defeedc0de"
+    assert doc["provenance"]["seed"] == 1
+
+
+# -------------------------------------- artifact provenance (sat 3)
+
+def test_artifact_provenance_roundtrip(tmp_path):
+    """Every committed artifact reloads with the calibration sha256,
+    seed, and capture id of the run that produced it."""
+    from ray_tpu.serve.llm.sim import default_cpu_calibration
+    sha = default_cpu_calibration().checksum()
+    cap = _sim_capture()
+    diff = capture_diff(cap, replay_sim(cap, replicas=2, seed=7),
+                        seed=7)
+    path = write_artifact(diff, str(tmp_path / "diff.json"))
+    loaded = json.load(open(path))
+    assert loaded["provenance"] == {
+        "calibration": "cpu-debug-tier1",
+        "calibration_sha256": sha,
+        "seed": 7,
+        "capture_id": "feedc0defeedc0de"}
+    # sha256 is the committed calibration file's content hash: 64 hex
+    assert len(sha) == 64 and int(sha, 16) >= 0
+
+
+def test_capacity_curve_carries_provenance():
+    from ray_tpu.serve.llm.sim import (SimFleetConfig, TraceConfig,
+                                       capacity_curve,
+                                       default_cpu_calibration)
+    calib = default_cpu_calibration()
+    doc = capacity_curve(
+        TraceConfig(kind="steady", sessions=6, duration_s=3.0,
+                    seed=5, out_tokens_mean=4, out_tokens_max=8),
+        SimFleetConfig(replicas=1, min_replicas=1,
+                       calibration=calib, seed=5),
+        [1], capture_id="cap123")
+    assert doc["provenance"]["calibration_sha256"] == \
+        calib.checksum()
+    assert doc["provenance"]["seed"] == 5
+    assert doc["provenance"]["capture_id"] == "cap123"
+
+
+# ------------------------------------------------------------- CLI
+
+def test_cli_corrupt_capture_exits_2(tmp_path, capsys):
+    p = tmp_path / "bad.rttc"
+    p.write_text(_capture([_rec(0)])[:-80])      # cut mid-write
+    assert cli_main([str(p)]) == 2
+    assert "bad capture" in capsys.readouterr().err
+    assert cli_main([str(tmp_path / "missing.rttc")]) == 2
+
+
+def test_cli_bad_replicas_exits_2(tmp_path, capsys):
+    p = tmp_path / "cap.rttc"
+    p.write_text(_capture([_rec(0)]))
+    assert cli_main([str(p), "--replicas", "zero"]) == 2
+    assert cli_main([str(p), "--replicas", "0"]) == 2
+    assert "bad --replicas" in capsys.readouterr().err
+
+
+def test_cli_what_if_writes_artifact(tmp_path, capsys):
+    p = tmp_path / "cap.rttc"
+    p.write_text(_capture([_rec(i) for i in range(4)]))
+    out = tmp_path / "what_if.json"
+    assert cli_main([str(p), "--what-if", "--replicas", "1,2",
+                     "--chips", "2", "--kv-dtype", "int8",
+                     "--out", str(out)]) == 0
+    doc = json.load(open(out))
+    assert doc["object"] == "what_if"
+    assert len(doc["points"]) == 2
+    assert doc["points"][0]["chips"] == 2
+
+
+def test_cli_failing_diff_exits_1(tmp_path, capsys):
+    # recorded latencies three orders of magnitude above anything the
+    # sim can replay: the band gate must fail and exit 1
+    p = tmp_path / "slow.rttc"
+    p.write_text(_capture(
+        [_rec(i, ttft_ms=1e6, e2e_ms=2e6) for i in range(6)]))
+    out = tmp_path / "diff.json"
+    assert cli_main([str(p), "--replicas", "2",
+                     "--out", str(out)]) == 1
+    err = capsys.readouterr().err
+    assert "CAPTURE DIFF FAIL" in err
+    doc = json.load(open(out))
+    assert doc["object"] == "capture_diff" and not doc["pass"]
+
+
+def test_kv_dtype_page_scale_table():
+    from tools.tracereplay import KV_DTYPE_PAGE_SCALE
+    assert KV_DTYPE_PAGE_SCALE["int8"] == 2.0
+    assert KV_DTYPE_PAGE_SCALE["fp8"] == 2.0
+    assert KV_DTYPE_PAGE_SCALE["bf16"] == 1.0
+    assert KV_DTYPE_PAGE_SCALE["f32"] == 0.5

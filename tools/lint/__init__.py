@@ -1,0 +1,66 @@
+"""tools.lint: the repo's static analyzers as one gate (ISSUE 20
+satellite).
+
+`python -m tools.lint [PATH...]` discovers the Python files ONCE
+(lintcore's shared discovery) and runs every registered analyzer —
+jaxlint (dispatch discipline, JL001-JL008) and racelint
+(host-concurrency discipline, RL001-RL006) — over the same file set,
+each against its own committed baseline. One command, one exit code:
+
+    0  every analyzer clean (or baselined)
+    1  any analyzer has new findings
+    2  usage error
+
+This is the pre-commit / CI entry point; the per-tool CLIs
+(`python -m tools.jaxlint`, `python -m tools.racelint`) remain for
+baseline surgery (--fix-baseline) and rule selection.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# default sweep: the library and the tools themselves
+DEFAULT_PATHS = ("ray_tpu", "tools")
+
+
+def analyzers() -> List[Tuple[str, Any, str]]:
+    """(label, analyze_paths, baseline_path) per registered tool.
+    A function, not a constant: each tool imports lazily so a usage
+    error in one CLI arg doesn't pay for both ASTs."""
+    from tools.jaxlint.analyzer import analyze_paths as jax_analyze
+    from tools.racelint.analyzer import analyze_paths as race_analyze
+    return [
+        ("jaxlint", jax_analyze,
+         os.path.join(REPO_ROOT, "tools", "jaxlint",
+                      "baseline.json")),
+        ("racelint", race_analyze,
+         os.path.join(REPO_ROOT, "tools", "racelint",
+                      "baseline.json")),
+    ]
+
+
+def run(paths: List[str], root: str = ".") -> Dict[str, Any]:
+    """Run every analyzer over `paths`; returns a per-tool report:
+    {"<label>": {"new": [Finding...], "baselined": n, "stale": [...]},
+     "ok": bool}."""
+    from tools.lintcore import load_baseline
+
+    report: Dict[str, Any] = {}
+    ok = True
+    for label, analyze, baseline_path in analyzers():
+        findings = analyze(paths, root=root)
+        baseline = load_baseline(baseline_path)
+        new, old, stale = baseline.split(findings)
+        report[label] = {"new": new, "baselined": len(old),
+                         "stale": stale}
+        ok = ok and not new
+    report["ok"] = ok
+    return report
+
+
+__all__ = ["analyzers", "run", "DEFAULT_PATHS", "REPO_ROOT"]

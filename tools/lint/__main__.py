@@ -1,0 +1,74 @@
+"""CLI: python -m tools.lint [PATH...]
+
+Runs jaxlint + racelint in one pass over the shared lintcore file
+discovery, each against its committed baseline. Exit codes: 0 = all
+analyzers clean (or baselined), 1 = any new finding, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="run every repo static analyzer (jaxlint + "
+                    "racelint) with its committed baseline")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: ray_tpu "
+                         "and tools, from the repo root)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    from tools import lint
+
+    if args.paths:
+        paths, root = list(args.paths), "."
+    else:
+        # no args: sweep the canonical set from the repo root so the
+        # baseline keys (repo-relative) line up regardless of cwd
+        paths = [os.path.join(lint.REPO_ROOT, p)
+                 for p in lint.DEFAULT_PATHS]
+        root = lint.REPO_ROOT
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"lint: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    report = lint.run(paths, root=root)
+
+    if args.as_json:
+        print(json.dumps({
+            label: {
+                "new": [vars(f) | {"key": f.key}
+                        for f in body["new"]],
+                "baselined": body["baselined"],
+                "stale_baseline_keys": body["stale"],
+            }
+            for label, body in report.items() if label != "ok"
+        }, indent=2))
+        return 0 if report["ok"] else 1
+
+    for label, body in report.items():
+        if label == "ok":
+            continue
+        for f in body["new"]:
+            print(f.render())
+        if body["baselined"]:
+            print(f"[{label}] {body['baselined']} baselined "
+                  f"finding(s) suppressed", file=sys.stderr)
+        for k in body["stale"]:
+            print(f"[{label}] stale baseline entry (fixed? remove "
+                  f"it): {k}", file=sys.stderr)
+        if not body["new"]:
+            print(f"[{label}] clean: 0 new", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,366 @@
+"""tracereplay: deterministic replay + capture-diff for traffic
+captures (ISSUE 20).
+
+The workload-level regression gate beside `tools/perfdiff`'s
+per-dispatch one: take a capture the fleet's traffic recorder sealed
+(`POST /fleet/debug/traffic {"action":"stop"}` →
+`GET /fleet/debug/traffic?capture=1`), replay it through the
+real-objects fleet simulator (`sim.traffic.RecordedTrace`) — or
+against an in-process fleet — and compare what the replay predicts
+against what production recorded:
+
+- SLO histograms (p50/p99 TTFT and e2e), banded by the same
+  CALIBRATION_BAND the sim-vs-real A/B uses;
+- prefix-hit rate (recorded router `affinity` outcomes vs the sim
+  router's affinity_hits/picks);
+- route mix (affinity/spill/scored/... outcome counts);
+- per-tenant cost rollups (requests + token volumes).
+
+The emitted capture-diff artifact embeds provenance (calibration
+checksum, seed, capture id) and a human-readable failure list —
+empty means the workload still behaves. What-if mode re-runs the
+SAME capture at overridden replica count / slice shape / kv-dtype
+page scaling and re-prices the operating points like the capacity
+sweep.
+
+    python -m tools.tracereplay capture.jsonl --replicas 2 \
+        --out capture_diff.json
+    python -m tools.tracereplay capture.jsonl --what-if \
+        --replicas 2,4,8 --chips 2
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+# the banded-compare tolerances: latency ratios ride the sim's
+# calibration band; rate/mix comparisons are absolute (a hit RATE
+# ratio explodes near zero)
+RATE_TOLERANCE = 0.35          # |recorded - replayed| prefix-hit rate
+MIX_TOLERANCE = 0.5            # per-outcome route-mix share drift
+
+# kv_dtype → KV-page capacity multiplier vs bf16 (half-precision
+# cache): int8/fp8 pack 2x the tokens per page budget
+KV_DTYPE_PAGE_SCALE = {"bf16": 1.0, "f32": 0.5, "int8": 2.0,
+                       "fp8": 2.0}
+
+
+def recorded_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Distill a capture's records into the recorded side of the
+    diff: latency percentiles from the outcome briefs, route mix,
+    prefix-hit rate, per-tenant rollups."""
+    from ray_tpu.serve.llm.sim.replica import Hist
+
+    ttft, e2e = Hist(), Hist()
+    mix: Dict[str, int] = {}
+    tenants: Dict[str, Dict[str, int]] = {}
+    routed = 0
+    affinity = 0
+    completed = 0
+    for r in records:
+        out = r.get("outcome") or {}
+        tenant = str(r.get("tenant") or "") or "default"
+        row = tenants.setdefault(
+            tenant, {"requests": 0, "prompt_tokens": 0,
+                     "out_tokens": 0})
+        row["requests"] += 1
+        row["prompt_tokens"] += int(r.get("prompt_tokens") or 0)
+        row["out_tokens"] += int(r.get("out_tokens") or 0)
+        route = out.get("route")
+        if route:
+            mix[str(route)] = mix.get(str(route), 0) + 1
+            routed += 1
+            if route == "affinity":
+                affinity += 1
+        if str(out.get("status") or "ok") == "ok":
+            completed += 1
+        if out.get("ttft_ms") is not None:
+            ttft.add(float(out["ttft_ms"]) / 1e3)
+        if out.get("e2e_ms") is not None:
+            e2e.add(float(out["e2e_ms"]) / 1e3)
+    return {
+        "requests": len(records),
+        "completed": completed,
+        "latency": {"ttft": ttft.summary_ms(),
+                    "e2e": e2e.summary_ms()},
+        "route_mix": dict(sorted(mix.items())),
+        "prefix_hit_rate": round(affinity / routed, 6) if routed
+        else None,
+        "tenants": dict(sorted(tenants.items())),
+    }
+
+
+def replayed_stats(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """The replay side of the diff, from a FleetSimulator summary."""
+    router = summary.get("router") or {}
+    picks = int(router.get("picks") or 0)
+    hits = int(router.get("affinity_hits") or 0)
+    sessions = summary.get("sessions") or {}
+    # FleetRouter.stats() exposes the outcome counters individually;
+    # rebuild the same outcome-keyed mix the recorder's route briefs
+    # use ("affinity"/"spill"/"scored", pick_ex's vocabulary)
+    mix = {k: int(router.get(src) or 0)
+           for k, src in (("affinity", "affinity_hits"),
+                          ("spill", "spills"),
+                          ("scored", "scored_fallbacks"))
+           if router.get(src)}
+    return {
+        "requests": int(sessions.get("arrived") or 0),
+        "completed": int(sessions.get("completed") or 0),
+        "latency": {"ttft": summary["latency"]["ttft"],
+                    "e2e": summary["latency"]["e2e"]},
+        "route_mix": dict(sorted(mix.items())),
+        "prefix_hit_rate": round(hits / picks, 6) if picks else None,
+        "tenants": {t: {"requests": int(n)}
+                    for t, n in (summary.get("tenants")
+                                 or {}).items()},
+    }
+
+
+def replay_sim(capture: Dict[str, Any], replicas: int = 2,
+               speed: float = 1.0, seed: int = 0,
+               slots_per_replica: int = 8,
+               pages_per_replica: int = 2048,
+               chips_per_replica: int = 1,
+               kv_dtype: str = "bf16",
+               calibration: Optional[Any] = None) -> Dict[str, Any]:
+    """Replay a decoded capture through the fleet simulator; returns
+    the run summary (deterministic: same capture + args → byte-
+    identical summary_json)."""
+    from ray_tpu.serve.llm.sim import (FleetSimulator, RecordedTrace,
+                                       SimFleetConfig,
+                                       default_cpu_calibration)
+    calib = calibration or default_cpu_calibration()
+    scale = KV_DTYPE_PAGE_SCALE.get(kv_dtype, 1.0)
+    cfg = SimFleetConfig(
+        replicas=replicas, min_replicas=replicas,
+        slots_per_replica=slots_per_replica,
+        pages_per_replica=max(int(pages_per_replica * scale), 1),
+        chips_per_replica=chips_per_replica,
+        calibration=calib, seed=seed)
+    sim = FleetSimulator(RecordedTrace(capture, speed=speed), cfg)
+    return sim.run()
+
+
+def _band_check(name: str, recorded: Optional[float],
+                replayed: Optional[float],
+                band) -> Optional[str]:
+    """Latency ratio check: replayed/recorded must land in `band`.
+    Either side missing (no streams recorded → no TTFT) skips the
+    check rather than failing it — absence is visible in the
+    metrics block, not a synthetic failure."""
+    if not recorded or replayed is None:
+        return None
+    ratio = replayed / recorded
+    lo, hi = band
+    if lo <= ratio <= hi:
+        return None
+    return (f"{name}: replayed/recorded ratio {ratio:.3f} outside "
+            f"band [{lo}, {hi}] (recorded {recorded:.3f}, "
+            f"replayed {replayed:.3f})")
+
+
+def capture_diff(capture: Dict[str, Any],
+                 summary: Dict[str, Any],
+                 band=None,
+                 seed: int = 0,
+                 calibration: Optional[Any] = None
+                 ) -> Dict[str, Any]:
+    """The banded comparison artifact. `failures` empty = the replay
+    reproduces the recorded workload inside tolerance — the
+    workload-level regression gate's verdict."""
+    from ray_tpu.serve.llm.sim import (CALIBRATION_BAND,
+                                       default_cpu_calibration)
+    band = band or CALIBRATION_BAND
+    calib = calibration or default_cpu_calibration()
+    rec = recorded_stats(capture["records"])
+    rep = replayed_stats(summary)
+    failures: List[str] = []
+    # gate on the SLO percentiles the fleet watches (p99): medians at
+    # CPU-tier millisecond scale are dominated by fixed per-tick
+    # overheads the calibration deliberately folds into the tail, so
+    # a p50 ratio says more about the engine's floor than about
+    # workload drift — p50s still ride the artifact for eyeballing
+    for metric in ("ttft", "e2e"):
+        f = _band_check(
+            f"{metric}.p99_ms",
+            (rec["latency"][metric] or {}).get("p99_ms"),
+            (rep["latency"][metric] or {}).get("p99_ms"), band)
+        if f:
+            failures.append(f)
+    if (rec["prefix_hit_rate"] is not None
+            and rep["prefix_hit_rate"] is not None):
+        drift = abs(rec["prefix_hit_rate"] - rep["prefix_hit_rate"])
+        if drift > RATE_TOLERANCE:
+            failures.append(
+                f"prefix_hit_rate: recorded "
+                f"{rec['prefix_hit_rate']:.3f} vs replayed "
+                f"{rep['prefix_hit_rate']:.3f} "
+                f"(drift {drift:.3f} > {RATE_TOLERANCE})")
+    # route-mix shares: every outcome present on either side
+    rec_total = max(sum(rec["route_mix"].values()), 1)
+    rep_total = max(sum(rep["route_mix"].values()), 1)
+    for outcome in sorted(set(rec["route_mix"])
+                          | set(rep["route_mix"])):
+        a = rec["route_mix"].get(outcome, 0) / rec_total
+        b = rep["route_mix"].get(outcome, 0) / rep_total
+        if abs(a - b) > MIX_TOLERANCE:
+            failures.append(
+                f"route_mix[{outcome}]: recorded share {a:.3f} vs "
+                f"replayed {b:.3f} (drift > {MIX_TOLERANCE})")
+    return {
+        "object": "capture_diff",
+        "capture_id": capture["header"].get("capture_id"),
+        "provenance": {
+            "calibration": calib.name,
+            "calibration_sha256": calib.checksum(),
+            "seed": seed,
+            "capture_id": capture["header"].get("capture_id"),
+        },
+        "band": list(band),
+        "recorded": rec,
+        "replayed": rep,
+        "failures": failures,
+        "pass": not failures,
+    }
+
+
+def what_if(capture: Dict[str, Any], replica_counts: List[int],
+            chips_per_replica: int = 1, kv_dtype: str = "bf16",
+            seed: int = 0,
+            calibration: Optional[Any] = None) -> Dict[str, Any]:
+    """Re-price the recorded workload at overridden operating points
+    (the capacity sweep over a RECORDED trace instead of a synthetic
+    one): replicas vs tail latency + per-chip token economics."""
+    from ray_tpu.serve.llm.sim import default_cpu_calibration
+    calib = calibration or default_cpu_calibration()
+    points: List[Dict[str, Any]] = []
+    for n in replica_counts:
+        s = replay_sim(capture, replicas=n, seed=seed,
+                       chips_per_replica=chips_per_replica,
+                       kv_dtype=kv_dtype, calibration=calib)
+        lat = s["latency"]
+        sessions = s["sessions"]
+        shed = sum(s["shed"].values())
+        chips = n * max(chips_per_replica, 1)
+        tokens = (s["engine"]["decode_tokens"]
+                  + s["batch"]["tokens"])
+        virtual_s = s["sim"]["virtual_s"]
+        points.append({
+            "replicas": n,
+            "chips": chips,
+            "kv_dtype": kv_dtype,
+            "p50_ttft_ms": lat["ttft"]["p50_ms"],
+            "p99_ttft_ms": lat["ttft"]["p99_ms"],
+            "p99_e2e_ms": lat["e2e"]["p99_ms"],
+            "shed": shed,
+            "completed": sessions["completed"],
+            "tokens_per_chip_s": round(
+                tokens / max(virtual_s, 1e-9) / chips, 3),
+            "chip_s_per_1k_tokens": round(
+                virtual_s * chips / max(tokens / 1e3, 1e-9), 3),
+        })
+    return {
+        "object": "what_if",
+        "capture_id": capture["header"].get("capture_id"),
+        "provenance": {
+            "calibration": calib.name,
+            "calibration_sha256": calib.checksum(),
+            "seed": seed,
+            "capture_id": capture["header"].get("capture_id"),
+        },
+        "points": points,
+    }
+
+
+async def replay_fleet(capture: Dict[str, Any], replicas: int = 1,
+                       max_tokens_cap: int = 32) -> Dict[str, Any]:
+    """Replay a capture against an in-process fleet of debug-model
+    replicas (real FleetManager + LLMServerImpl — the expensive,
+    highest-fidelity mode): each record re-dispatches with a
+    synthetic prompt of the recorded token count and the recorded
+    sampling params. Returns the replay fleet's own recorded stats,
+    diff-able against the original capture."""
+    import asyncio
+
+    from ray_tpu.llm._internal.server import LLMServerImpl
+    from ray_tpu.serve.llm import (AdmissionConfig, AutoscaleConfig,
+                                   FleetManager, LocalReplicaClient,
+                                   RouterConfig, WatchdogConfig)
+
+    servers = []
+    clients = []
+    for i in range(replicas):
+        srv = LLMServerImpl({
+            "model_id": "replay", "model_source": "debug",
+            "engine_kwargs": dict(
+                max_batch_size=4, page_size=8, num_pages=64,
+                seed=7, enable_metrics=False, enable_blackbox=False,
+                metrics_model_id="replay",
+                metrics_replica_id=f"r{i}")})
+        servers.append(srv)
+        clients.append(LocalReplicaClient(f"r{i}", srv))
+    fleet = FleetManager(
+        clients, router=RouterConfig(prefix_depth=64),
+        admission=AdmissionConfig(max_concurrent=8, max_queue=256),
+        autoscale=AutoscaleConfig(min_replicas=replicas,
+                                  max_replicas=replicas),
+        watchdog=WatchdogConfig(enabled=False),
+        enable_tracing=False, model_id="replay")
+    fleet.traffic.start_capture("replay")
+
+    async def one(r: Dict[str, Any]) -> None:
+        fp = str(r.get("fp") or "")
+        prompt = " ".join(
+            ["tok"] * max(int(r.get("prompt_tokens") or 1), 1))
+        # prefix identity: lead with the fingerprint so the replay
+        # router sees the same chain structure (never the raw text —
+        # the capture does not have it)
+        body = {"prompt": f"{fp[:16]} {prompt}",
+                "max_tokens": min(
+                    max(int(r.get("out_tokens") or 1), 1),
+                    max_tokens_cap),
+                "user": r.get("tenant") or None,
+                **{k: v for k, v in (r.get("params") or {}).items()
+                   if k in ("temperature", "top_p", "top_k", "seed")}}
+        try:
+            await fleet.dispatch("completions", body)
+        except Exception:
+            pass                     # sheds are data, not failures
+
+    try:
+        records = capture["records"]
+        for i in range(0, len(records), 8):
+            await asyncio.gather(*(one(r)
+                                   for r in records[i:i + 8]))
+        from ray_tpu.serve.llm.trafficlog import decode_capture
+        out = fleet.traffic.stop_capture()
+        replay_capture = decode_capture(fleet.traffic.export())
+        return {"object": "fleet_replay",
+                "capture_id": capture["header"].get("capture_id"),
+                "replay_capture_id": out["capture_id"],
+                "recorded": recorded_stats(capture["records"]),
+                "replayed": recorded_stats(
+                    replay_capture["records"])}
+    finally:
+        await fleet.stop()
+        for srv in servers:
+            pump = getattr(srv, "_pump", None)
+            if pump is not None:
+                pump.cancel()
+
+
+def write_artifact(doc: Dict[str, Any], path: str) -> str:
+    """Canonical JSON artifact (sorted keys — diffs are meaningful),
+    the capacity-sweep discipline."""
+    with open(path, "w") as f:
+        json.dump(doc, f, sort_keys=True, indent=2)
+        f.write("\n")
+    return path
+
+
+__all__ = ["recorded_stats", "replayed_stats", "replay_sim",
+           "capture_diff", "what_if", "replay_fleet",
+           "write_artifact", "RATE_TOLERANCE", "MIX_TOLERANCE",
+           "KV_DTYPE_PAGE_SCALE"]

@@ -1,0 +1,111 @@
+"""CLI for the capture-replay regression gate (tools/tracereplay).
+
+    # replay through the sim, emit + gate on the capture-diff
+    python -m tools.tracereplay capture.jsonl --replicas 2 \\
+        --out capture_diff.json
+
+    # what-if re-pricing: same recorded workload, swept fleet shapes
+    python -m tools.tracereplay capture.jsonl --what-if \\
+        --replicas 2,4,8 --chips 2 --kv-dtype int8
+
+    # highest-fidelity mode: re-dispatch through an in-process fleet
+    python -m tools.tracereplay capture.jsonl --fleet --replicas 2
+
+Exit status: 0 = replay inside the band (diff passes), 1 = capture-
+diff failures (regression), 2 = unreadable/corrupt capture or usage
+error. The replay path forces JAX_PLATFORMS=cpu; a given capture +
+flags replays byte-identically (seeded sim, virtual clock).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.tracereplay",
+        description="replay a traffic capture; emit a capture-diff")
+    ap.add_argument("capture", help="capture file (RTTC segments), "
+                    "e.g. from GET /fleet/debug/traffic?capture=1")
+    ap.add_argument("--replicas", default="2",
+                    help="replica count, or comma list in --what-if "
+                         "mode (default 2)")
+    ap.add_argument("--chips", type=int, default=1,
+                    help="chips per replica (slice shape)")
+    ap.add_argument("--kv-dtype", default="bf16",
+                    choices=["bf16", "f32", "int8", "fp8"],
+                    help="KV cache dtype override (scales page "
+                         "budget: int8/fp8 pack 2x)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="time-warp: >1 compresses recorded "
+                         "inter-arrival gaps")
+    ap.add_argument("--what-if", action="store_true",
+                    help="sweep --replicas list and re-price instead "
+                         "of diffing")
+    ap.add_argument("--fleet", action="store_true",
+                    help="replay against an in-process debug-model "
+                         "fleet instead of the simulator")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the artifact to stdout")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ray_tpu.serve.llm.trafficlog import CaptureError, load_capture
+    from tools import tracereplay
+
+    try:
+        capture = load_capture(args.capture)
+    except CaptureError as e:
+        print(f"tracereplay: bad capture: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        counts = [int(x) for x in str(args.replicas).split(",") if x]
+    except ValueError:
+        print(f"tracereplay: bad --replicas {args.replicas!r}",
+              file=sys.stderr)
+        return 2
+    if not counts or any(n < 1 for n in counts):
+        print(f"tracereplay: bad --replicas {args.replicas!r}",
+              file=sys.stderr)
+        return 2
+
+    if args.what_if:
+        doc = tracereplay.what_if(
+            capture, counts, chips_per_replica=args.chips,
+            kv_dtype=args.kv_dtype, seed=args.seed)
+        rc = 0
+    elif args.fleet:
+        import asyncio
+        doc = asyncio.run(tracereplay.replay_fleet(
+            capture, replicas=counts[0]))
+        rc = 0
+    else:
+        summary = tracereplay.replay_sim(
+            capture, replicas=counts[0], speed=args.speed,
+            seed=args.seed, chips_per_replica=args.chips,
+            kv_dtype=args.kv_dtype)
+        doc = tracereplay.capture_diff(capture, summary,
+                                       seed=args.seed)
+        rc = 0 if doc["pass"] else 1
+
+    if args.out:
+        tracereplay.write_artifact(doc, args.out)
+        print(f"wrote {args.out}")
+    if args.json or not args.out:
+        print(json.dumps(doc, sort_keys=True, indent=2))
+    if rc:
+        for f_ in doc.get("failures", []):
+            print(f"CAPTURE DIFF FAIL: {f_}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
